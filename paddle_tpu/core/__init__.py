@@ -1,0 +1,19 @@
+"""Core semantics (reference: paddle/platform/ + paddle/framework/).
+
+- place: device & mesh abstraction (replaces Place/DeviceContext,
+  paddle/platform/place.h:24, device_context.h:38)
+- dtypes: dtype table (replaces paddle/framework/data_type.h)
+- param: parameter specs + pytree registry (replaces Parameter buffers +
+  Scope/Variable, paddle/parameter/Parameter.h:60, paddle/framework/scope.h:38)
+- ragged: variable-length sequence batches (replaces LoDTensor /
+  Argument.sequenceStartPositions, paddle/framework/lod_tensor.h:82)
+"""
+
+from paddle_tpu.core import place
+from paddle_tpu.core import dtypes
+from paddle_tpu.core import param
+from paddle_tpu.core import ragged
+
+from paddle_tpu.core.place import default_device, default_mesh, local_devices
+from paddle_tpu.core.param import ParamSpec
+from paddle_tpu.core.ragged import SequenceBatch

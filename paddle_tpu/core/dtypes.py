@@ -1,0 +1,39 @@
+"""Dtype table (reference: paddle/framework/data_type.h, framework.proto DataType).
+
+TPU policy: parameters live in float32 (or the flag-selected default), matmul/
+conv compute in bfloat16 on the MXU, reductions/softmax accumulate in float32.
+"""
+
+import jax.numpy as jnp
+
+FP32 = jnp.float32
+BF16 = jnp.bfloat16
+FP16 = jnp.float16
+INT32 = jnp.int32
+INT64 = jnp.int64
+BOOL = jnp.bool_
+
+_NAMES = {
+    "float32": FP32, "fp32": FP32,
+    "bfloat16": BF16, "bf16": BF16,
+    "float16": FP16, "fp16": FP16,
+    "int32": INT32, "int64": INT64,
+    "bool": BOOL,
+}
+
+
+def resolve(name_or_dtype):
+    if isinstance(name_or_dtype, str):
+        return _NAMES[name_or_dtype]
+    return name_or_dtype
+
+
+def param_dtype():
+    from paddle_tpu.utils.flags import GLOBAL_FLAGS
+    return resolve(GLOBAL_FLAGS.get("default_dtype", "float32"))
+
+
+def compute_dtype():
+    """Dtype fed to the MXU for matmuls/convs."""
+    from paddle_tpu.utils.flags import GLOBAL_FLAGS
+    return resolve(GLOBAL_FLAGS.get("compute_dtype", "bfloat16"))

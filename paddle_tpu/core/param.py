@@ -1,0 +1,85 @@
+"""Parameter specs and the parameter pytree.
+
+Reference: paddle/parameter/Parameter.h:60 — a Parameter owns a set of typed
+buffers (PARAMETER_VALUE, PARAMETER_GRADIENT, PARAMETER_MOMENTUM, ...) plus a
+ParameterConfig proto (dims, initial_mean/std, sparsity, learning-rate scale,
+decay). TPU-native: parameters are entries of a flat dict pytree
+``{name: jax.Array}``; optimizer state is a parallel pytree owned by the
+optimizer (not the parameter); metadata lives in ``ParamSpec``.
+"""
+
+import dataclasses
+import math
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtypes
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamAttr:
+    """Per-parameter attributes (reference: trainer_config_helpers/attrs.py
+    ParameterAttribute — name, initial_std/mean, learning_rate, l1/l2 decay,
+    sparse flags)."""
+    name: Optional[str] = None
+    initializer: Optional[str] = None      # normal | uniform | xavier | msra | constant
+    initial_mean: float = 0.0
+    initial_std: Optional[float] = None    # None => 1/sqrt(fan_in) like reference
+    initial_value: Optional[float] = None  # for constant init
+    learning_rate: float = 1.0             # per-param lr scale
+    l1_rate: Optional[float] = None
+    l2_rate: Optional[float] = None
+    is_static: bool = False                # frozen parameter
+    sparse_update: bool = False            # row-sparse gradient path
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamSpec:
+    """Shape/dtype/init spec for one named parameter."""
+    name: str
+    shape: Tuple[int, ...]
+    dtype: object = None
+    attr: ParamAttr = dataclasses.field(default_factory=ParamAttr)
+    # axis interpretation for default init: fan_in is prod(shape[:-1]) unless set
+    fan_in: Optional[int] = None
+    # mesh-axis sharding hint, parallel layer fills this (e.g. (None,'model'))
+    sharding: Optional[Tuple] = None
+
+    def resolved_dtype(self):
+        return self.dtype or dtypes.param_dtype()
+
+    def initialize(self, key: jax.Array) -> jax.Array:
+        """Materialise the initial value (reference: Parameter::randomize,
+        paddle/parameter/Parameter.cpp — default N(0, 1/sqrt(fan_in)))."""
+        a = self.attr
+        dtype = self.resolved_dtype()
+        shape = self.shape
+        if a.initial_value is not None or a.initializer == "constant":
+            return jnp.full(shape, a.initial_value or 0.0, dtype)
+        fan_in = self.fan_in
+        if fan_in is None:
+            fan_in = int(math.prod(shape[:-1])) if len(shape) > 1 else int(shape[0])
+        init = a.initializer or "normal"
+        if init == "normal":
+            std = a.initial_std if a.initial_std is not None else 1.0 / math.sqrt(max(1, fan_in))
+            return a.initial_mean + std * jax.random.normal(key, shape, dtype)
+        if init == "uniform":
+            lim = a.initial_std if a.initial_std is not None else 1.0 / math.sqrt(max(1, fan_in))
+            return jax.random.uniform(key, shape, dtype, -lim, lim)
+        if init == "xavier":
+            fan_out = int(shape[-1]) if len(shape) > 1 else int(shape[0])
+            lim = math.sqrt(6.0 / (fan_in + fan_out))
+            return jax.random.uniform(key, shape, dtype, -lim, lim)
+        if init == "msra":
+            std = math.sqrt(2.0 / max(1, fan_in))
+            return std * jax.random.normal(key, shape, dtype)
+        raise ValueError(f"unknown initializer {init!r}")
+
+
+def init_params(specs: Sequence[ParamSpec], key_source=None) -> dict:
+    """Initialise a full parameter pytree from specs, name-keyed subkeys."""
+    from paddle_tpu.utils import rng
+    ks = key_source or rng.global_key_source()
+    return {s.name: s.initialize(ks.named(s.name)) for s in specs}

@@ -1,0 +1,64 @@
+"""Device & mesh abstraction.
+
+Reference: paddle/platform/place.h:24 (CPUPlace/GPUPlace variant) and
+device_context.h:38 (per-device contexts holding cublas/cudnn handles).
+
+TPU-native: JAX owns streams/handles; the useful abstraction is *which devices*
+and *what mesh shape*. A ``Place`` is a jax.Device; a mesh is
+``jax.sharding.Mesh`` over the local (or global) device set. Axis naming
+follows the scaling-book convention: ``data`` (DP), ``model`` (TP),
+``seq`` (SP/CP), ``expert`` (EP), ``stage`` (PP).
+"""
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical mesh-axis names used across the framework.
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+AXIS_STAGE = "stage"
+
+
+def local_devices(platform: Optional[str] = None):
+    return jax.devices(platform) if platform else jax.devices()
+
+
+def default_device():
+    return local_devices()[0]
+
+
+def is_tpu() -> bool:
+    return default_device().platform == "tpu"
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_mesh(shape: Tuple[int, ...], axes: Tuple[str, ...], ndev: int) -> Mesh:
+    devices = np.asarray(jax.devices()[:int(np.prod(shape))]).reshape(shape)
+    return Mesh(devices, axes)
+
+
+def make_mesh(shape: Sequence[int], axes: Sequence[str]) -> Mesh:
+    """Build a mesh over local devices; validates the device count."""
+    n = int(np.prod(shape))
+    avail = len(jax.devices())
+    if n > avail:
+        raise ValueError(f"mesh {tuple(shape)} needs {n} devices, have {avail}")
+    return _cached_mesh(tuple(shape), tuple(axes), avail)
+
+
+def default_mesh(data_parallel: Optional[int] = None) -> Mesh:
+    """1-D data-parallel mesh over all local devices (the
+    MultiGradientMachine replacement's default shape,
+    reference: gserver/gradientmachines/MultiGradientMachine.h:44)."""
+    n = data_parallel or len(jax.devices())
+    return make_mesh((n,), (AXIS_DATA,))
+
+
+def device_count() -> int:
+    return len(jax.devices())

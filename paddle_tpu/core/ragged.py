@@ -1,0 +1,141 @@
+"""Variable-length sequence batches under XLA static shapes.
+
+Reference machinery being replaced:
+- ``Argument.sequenceStartPositions`` / ``subSequenceStartPositions``
+  (paddle/parameter/Argument.h:84-90) — zero-padding-free nested sequences.
+- ``LoDTensor`` level-of-detail tensor (paddle/framework/lod_tensor.h:82).
+- sequence→batch reordering for RNNs (operators/math/sequence2batch.h).
+
+TPU-native design: XLA wants static shapes, so sequences are **padded to a
+bucketed max length with an explicit mask**, and sequence-level ops use
+segment-ids. Bucketing bounds recompilation (one compiled program per bucket);
+masking keeps math exact (masked softmax/pool/loss). The sequence2batch GEMM
+trick is unnecessary — a padded ``lax.scan`` already runs each timestep as one
+dense GEMM over the whole batch on the MXU, and the mask zeroes state updates
+of finished rows.
+
+Two sequence levels are supported, mirroring SEQUENCE / SUB_SEQUENCE input
+types (python/paddle/trainer/PyDataProvider2.py:25,186-250): an outer batch of
+sequences, each optionally composed of sub-sequences.
+"""
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
+
+
+def bucket_length(n: int, buckets: Sequence[int] = DEFAULT_BUCKETS) -> int:
+    """Smallest bucket >= n; beyond the last bucket, round up to a multiple of
+    it, so recompilation stays bounded for any length distribution."""
+    for b in buckets:
+        if n <= b:
+            return int(b)
+    last = int(buckets[-1])
+    return ((int(n) + last - 1) // last) * last
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class SequenceBatch:
+    """A batch of variable-length sequences, padded + masked.
+
+    data:    [batch, time, ...] padded values
+    lengths: [batch] int32 true lengths
+    sub_lengths: optional [batch, max_subseqs] int32 — lengths of the
+        sub-sequences making up each sequence (level-2 LoD); sum over valid
+        entries equals ``lengths``.
+    """
+    data: jax.Array
+    lengths: jax.Array
+    sub_lengths: Optional[jax.Array] = None
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.lengths, self.sub_lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_list(cls, seqs: List[np.ndarray], buckets=DEFAULT_BUCKETS,
+                  dtype=None, pad_value=0):
+        """Build from a python list of per-sequence arrays ([len, ...] each)."""
+        seqs = [np.asarray(s) for s in seqs]
+        max_len = bucket_length(max((len(s) for s in seqs), default=1), buckets)
+        feat = seqs[0].shape[1:] if seqs else ()
+        dtype = dtype or (seqs[0].dtype if seqs else np.float32)
+        data = np.full((len(seqs), max_len) + feat, pad_value, dtype=dtype)
+        lengths = np.zeros((len(seqs),), np.int32)
+        for i, s in enumerate(seqs):
+            data[i, : len(s)] = s
+            lengths[i] = len(s)
+        return cls(jnp.asarray(data), jnp.asarray(lengths))
+
+    @classmethod
+    def from_nested_list(cls, nested: List[List[np.ndarray]], buckets=DEFAULT_BUCKETS,
+                         dtype=None, pad_value=0):
+        """Level-2: each element is a list of sub-sequences; they are
+        concatenated on the time axis and sub_lengths records the split."""
+        # infer feat/dtype from real data so empty entries don't poison them
+        proto = next((np.asarray(s) for subs in nested for s in subs), None)
+        empty = (np.zeros((0,) + proto.shape[1:], proto.dtype) if proto is not None
+                 else np.zeros((0,), np.float32))
+        flat = [np.concatenate([np.asarray(s) for s in subs], axis=0) if subs
+                else empty for subs in nested]
+        out = cls.from_list(flat, buckets, dtype, pad_value)
+        max_subs = max((len(s) for s in nested), default=1)
+        subl = np.zeros((len(nested), max_subs), np.int32)
+        for i, subs in enumerate(nested):
+            for j, s in enumerate(subs):
+                subl[i, j] = len(s)
+        return cls(out.data, out.lengths, jnp.asarray(subl))
+
+    # -- views -------------------------------------------------------------
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        return self.data.shape[1]
+
+    def mask(self, dtype=jnp.float32) -> jax.Array:
+        """[batch, time] validity mask."""
+        t = jnp.arange(self.max_len, dtype=jnp.int32)
+        return (t[None, :] < self.lengths[:, None]).astype(dtype)
+
+    def segment_ids(self) -> jax.Array:
+        """Flattened [batch*time] segment ids; padding slots get id=batch
+        (one-past-last) so segment_sum with num_segments=batch drops them."""
+        valid = self.mask(jnp.bool_)
+        ids = jnp.broadcast_to(
+            jnp.arange(self.batch_size, dtype=jnp.int32)[:, None],
+            (self.batch_size, self.max_len))
+        ids = jnp.where(valid, ids, self.batch_size)
+        return ids.reshape(-1)
+
+    def flat_data(self) -> jax.Array:
+        """[batch*time, ...] flattened values (padding rows included)."""
+        return self.data.reshape((-1,) + self.data.shape[2:])
+
+    def with_data(self, data: jax.Array) -> "SequenceBatch":
+        return SequenceBatch(data, self.lengths, self.sub_lengths)
+
+    def sub_segment_mask(self) -> jax.Array:
+        """[batch, time] int32 sub-sequence index of each timestep (level-2);
+        requires sub_lengths. Padding gets the one-past-last sub index."""
+        if self.sub_lengths is None:
+            raise ValueError("no sub_lengths on this SequenceBatch")
+        # cum over sub lengths gives boundaries; timestep t belongs to the
+        # first sub whose cumulative end exceeds t.
+        ends = jnp.cumsum(self.sub_lengths, axis=1)          # [b, S]
+        t = jnp.arange(self.max_len, dtype=jnp.int32)        # [T]
+        # sub_idx[b, t] = #{s : ends[b, s] <= t}
+        return jnp.sum(t[None, :, None] >= ends[:, None, :], axis=-1).astype(jnp.int32)

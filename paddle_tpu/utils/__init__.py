@@ -1,0 +1,11 @@
+"""Foundation utilities (reference: paddle/utils/ — Flags.cpp, Logging.cpp,
+Stat.h, Error.h, CustomStackTrace.h)."""
+
+from paddle_tpu.utils import flags
+from paddle_tpu.utils import logger
+from paddle_tpu.utils import stat
+from paddle_tpu.utils import enforce
+from paddle_tpu.utils import rng
+
+from paddle_tpu.utils.enforce import enforce as check, EnforceError
+from paddle_tpu.utils.stat import timer_scope, global_stats

@@ -1,0 +1,58 @@
+"""Error enforcement (reference: paddle/platform/enforce.h — PADDLE_ENFORCE /
+PADDLE_THROW with rich messages; paddle/utils/CustomStackTrace.h layer-stack
+error context).
+
+The layer-stack context manager replaces CustomStackTrace: layer compilation /
+tracing pushes the layer name, so shape errors inside jit tracing report which
+layer of the user's topology failed (reference: NeuralNetwork.cpp:258-261).
+"""
+
+import contextlib
+import threading
+
+
+class EnforceError(RuntimeError):
+    pass
+
+
+_ctx = threading.local()
+
+
+def _stack():
+    if not hasattr(_ctx, "stack"):
+        _ctx.stack = []
+    return _ctx.stack
+
+
+@contextlib.contextmanager
+def layer_scope(name: str):
+    """Push a layer name onto the error-context stack while tracing it."""
+    _stack().append(name)
+    try:
+        yield
+    except Exception as e:
+        # annotate once, at the innermost frame
+        if not getattr(e, "_paddle_tpu_annotated", False):
+            e._paddle_tpu_annotated = True
+            trace = " -> ".join(_stack())
+            e.args = (f"{e.args[0] if e.args else e}\n  [layer stack: {trace}]",) + \
+                tuple(e.args[1:])
+        raise
+    finally:
+        _stack().pop()
+
+
+def enforce(cond, msg="", *fmt_args):
+    """PADDLE_ENFORCE equivalent."""
+    if not cond:
+        raise EnforceError(msg % fmt_args if fmt_args else msg)
+
+
+def enforce_eq(a, b, msg=""):
+    if a != b:
+        raise EnforceError(f"enforce_eq failed: {a!r} != {b!r}. {msg}")
+
+
+def enforce_shape_match(shape_a, shape_b, msg=""):
+    if tuple(shape_a) != tuple(shape_b):
+        raise EnforceError(f"shape mismatch: {tuple(shape_a)} vs {tuple(shape_b)}. {msg}")

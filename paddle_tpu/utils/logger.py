@@ -1,0 +1,39 @@
+"""Logging (reference: paddle/utils/Logging.cpp — glog wrappers with VLOG levels).
+
+Thin wrapper over the stdlib so the whole framework logs through one place and
+``VLOG``-style verbosity maps to levels below DEBUG.
+"""
+
+import logging
+import os
+import sys
+
+_LOGGER = logging.getLogger("paddle_tpu")
+
+if not _LOGGER.handlers:
+    _handler = logging.StreamHandler(sys.stderr)
+    _handler.setFormatter(logging.Formatter(
+        "%(levelname).1s %(asctime)s %(name)s %(filename)s:%(lineno)d] %(message)s",
+        datefmt="%m%d %H:%M:%S"))
+    _LOGGER.addHandler(_handler)
+    _level = os.environ.get("PADDLE_TPU_LOGLEVEL", "INFO").upper()
+    if _level not in logging.getLevelNamesMapping():
+        _LOGGER.warning("invalid PADDLE_TPU_LOGLEVEL=%r, using INFO", _level)
+        _level = "INFO"
+    _LOGGER.setLevel(_level)
+    _LOGGER.propagate = False
+
+
+def get_logger(name=None):
+    return _LOGGER.getChild(name) if name else _LOGGER
+
+
+def vlog(level, msg, *args):
+    """VLOG(level) — higher level == chattier (glog semantics)."""
+    _LOGGER.log(max(1, logging.DEBUG - level), msg, *args)
+
+
+info = _LOGGER.info
+warning = _LOGGER.warning
+error = _LOGGER.error
+debug = _LOGGER.debug

@@ -1,0 +1,49 @@
+"""Deterministic RNG key management.
+
+Reference: parameter init randomisation was per-Parameter seeded RNG
+(paddle/parameter/Parameter.cpp randomize paths; ThreadLocalRand). TPU-native
+replacement: a fold-in key tree — one root ``jax.random.key`` split by
+parameter name / purpose, so initialisation is reproducible and order-free.
+"""
+
+import time
+import zlib
+
+import jax
+
+
+class KeySource:
+    """Derives named subkeys from a root seed via fold_in on a stable hash."""
+
+    def __init__(self, seed: int = None):
+        if seed is None or seed == 0:
+            from paddle_tpu.utils.flags import GLOBAL_FLAGS
+            seed = GLOBAL_FLAGS.get("seed", 0)
+            if seed == 0:
+                seed = int(time.time()) & 0x7FFFFFFF
+        self.seed = int(seed)
+        self._root = jax.random.key(self.seed)
+
+    def named(self, name: str) -> jax.Array:
+        """Stable per-name key: fold_in(root, crc32(name))."""
+        return jax.random.fold_in(self._root, zlib.crc32(name.encode()) & 0x7FFFFFFF)
+
+    def step(self, name: str, step: int) -> jax.Array:
+        """Per-name, per-step key (dropout etc.)."""
+        return jax.random.fold_in(self.named(name), step)
+
+
+_GLOBAL = None
+
+
+def global_key_source() -> KeySource:
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = KeySource()
+    return _GLOBAL
+
+
+def reset_global_seed(seed: int):
+    global _GLOBAL
+    _GLOBAL = KeySource(seed)
+    return _GLOBAL

@@ -1,0 +1,104 @@
+"""Named accumulating timers (reference: paddle/utils/Stat.h — Stat/StatSet,
+REGISTER_TIMER_INFO, printed periodically and at exit).
+
+On TPU the analog also opens a ``jax.profiler`` named trace scope when
+profiling is enabled, so hot-loop scopes show up in xprof.
+"""
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict
+
+
+@dataclass
+class Stat:
+    name: str
+    total_s: float = 0.0
+    count: int = 0
+    max_s: float = 0.0
+    min_s: float = field(default=float("inf"))
+
+    def add(self, seconds: float):
+        self.total_s += seconds
+        self.count += 1
+        self.max_s = max(self.max_s, seconds)
+        self.min_s = min(self.min_s, seconds)
+
+    @property
+    def avg_ms(self):
+        return 1e3 * self.total_s / max(1, self.count)
+
+    def __str__(self):
+        return (f"{self.name}: total {self.total_s*1e3:.1f}ms count {self.count} "
+                f"avg {self.avg_ms:.3f}ms max {self.max_s*1e3:.3f}ms")
+
+
+class StatSet:
+    """Global registry of named timers (reference: Stat.h:114 StatSet)."""
+
+    def __init__(self, name="global"):
+        self.name = name
+        self._stats: Dict[str, Stat] = {}
+        self._lock = threading.Lock()
+
+    def get(self, name) -> Stat:
+        with self._lock:
+            if name not in self._stats:
+                self._stats[name] = Stat(name)
+            return self._stats[name]
+
+    def reset(self):
+        with self._lock:
+            self._stats.clear()
+
+    def print_status(self, log=print):
+        with self._lock:
+            stats = sorted(self._stats.values(), key=lambda s: -s.total_s)
+        log(f"======= StatSet: [{self.name}] status ======")
+        for s in stats:
+            log("  " + str(s))
+
+
+global_stats = StatSet()
+
+
+@contextlib.contextmanager
+def timer_scope(name: str, stats: StatSet = None, use_profiler: bool = None):
+    """REGISTER_TIMER_INFO equivalent; optionally also a profiler trace scope."""
+    stats = stats or global_stats
+    if use_profiler is None:
+        from paddle_tpu.utils.flags import GLOBAL_FLAGS
+        use_profiler = GLOBAL_FLAGS.get("profile", False)
+    ctx = contextlib.nullcontext()
+    if use_profiler:
+        import jax.profiler
+        ctx = jax.profiler.TraceAnnotation(name)
+    start = time.perf_counter()
+    try:
+        with ctx:
+            yield
+    finally:
+        stats.get(name).add(time.perf_counter() - start)
+
+
+class Timer:
+    """Manual start/stop timer (reference: Stat.h:166)."""
+
+    def __init__(self):
+        self._start = None
+        self.elapsed_s = 0.0
+
+    def start(self):
+        self._start = time.perf_counter()
+
+    def stop(self):
+        if self._start is not None:
+            self.elapsed_s += time.perf_counter() - self._start
+            self._start = None
+        return self.elapsed_s
+
+    def reset(self):
+        self._start = None
+        self.elapsed_s = 0.0

@@ -1,0 +1,14 @@
+"""Version info (reference: paddle/utils/Version.cpp, cmake version stamping)."""
+
+__version__ = "0.1.0"
+
+major = 0
+minor = 1
+patch = 0
+rc = 0
+istaged = False
+with_tpu = True
+
+
+def show():
+    print("paddle_tpu", __version__)

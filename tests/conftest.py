@@ -1,0 +1,28 @@
+"""Test config: force an 8-device virtual CPU mesh before the JAX backend
+initialises.
+
+Mirrors the reference's strategy of running distributed tests without a real
+cluster (SURVEY.md §4.6 — in-process pservers); on TPU the analog is a
+host-simulated multi-device mesh. jax is already imported by the time conftest
+runs (a site hook pulls it in), so we use the config API rather than env vars —
+it takes effect as long as no backend has been initialised yet.
+"""
+
+import os
+
+os.environ.setdefault("PADDLE_TPU_SEED", "42")
+# keep tests fp32-exact on CPU: matmuls would otherwise downcast to bf16
+os.environ.setdefault("PADDLE_TPU_COMPUTE_DTYPE", "float32")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(1234)

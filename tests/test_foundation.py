@@ -1,0 +1,111 @@
+"""Foundation tests (flags, stats, enforce, rng, place, ragged core)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.param import ParamAttr, ParamSpec, init_params
+from paddle_tpu.core.ragged import SequenceBatch, bucket_length
+from paddle_tpu.core import place
+from paddle_tpu.utils import enforce, rng, stat
+from paddle_tpu.utils.flags import GLOBAL_FLAGS
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_flags_roundtrip():
+    assert GLOBAL_FLAGS.trainer_count == 1
+    GLOBAL_FLAGS.set("trainer_count", 4)
+    assert GLOBAL_FLAGS.trainer_count == 4
+    GLOBAL_FLAGS.set("trainer_count", 1)
+    with pytest.raises(KeyError):
+        GLOBAL_FLAGS.set("no_such_flag", 1)
+    paddle.init(use_tpu=False, bogus_flag=3)  # unknown silently ignored
+    assert GLOBAL_FLAGS.use_tpu is False
+    GLOBAL_FLAGS.set("use_tpu", True)
+
+
+def test_stats_timer():
+    s = stat.StatSet("t")
+    with stat.timer_scope("fwd", s, use_profiler=False):
+        pass
+    assert s.get("fwd").count == 1
+
+
+def test_enforce_layer_stack():
+    with pytest.raises(enforce.EnforceError) as ei:
+        with enforce.layer_scope("fc1"):
+            with enforce.layer_scope("relu"):
+                enforce.enforce(False, "boom")
+    assert "fc1 -> relu" in str(ei.value)
+
+
+def test_rng_deterministic():
+    ks = rng.KeySource(7)
+    a = jax.random.normal(ks.named("w"), (3,))
+    b = jax.random.normal(rng.KeySource(7).named("w"), (3,))
+    assert np.allclose(a, b)
+    c = jax.random.normal(ks.named("w2"), (3,))
+    assert not np.allclose(a, c)
+
+
+def test_param_init():
+    specs = [
+        ParamSpec("w", (64, 32)),
+        ParamSpec("b", (32,), attr=ParamAttr(initializer="constant", initial_value=0.0)),
+        ParamSpec("u", (16, 16), attr=ParamAttr(initializer="uniform")),
+    ]
+    p = init_params(specs, rng.KeySource(3))
+    assert p["w"].shape == (64, 32)
+    # default init std ~ 1/sqrt(fan_in)=0.125
+    assert 0.08 < float(jnp.std(p["w"])) < 0.17
+    assert float(jnp.abs(p["b"]).max()) == 0.0
+
+
+def test_mesh():
+    m = place.make_mesh((8,), (place.AXIS_DATA,))
+    assert m.shape[place.AXIS_DATA] == 8
+    m2 = place.make_mesh((4, 2), (place.AXIS_DATA, place.AXIS_MODEL))
+    assert m2.shape[place.AXIS_MODEL] == 2
+
+
+def test_bucket_length():
+    assert bucket_length(1) == 16
+    assert bucket_length(17) == 32
+    assert bucket_length(2000) == 2048  # rounds up to multiple of last bucket
+
+
+def test_sequence_batch():
+    seqs = [np.arange(3, dtype=np.float32), np.arange(5, dtype=np.float32)]
+    sb = SequenceBatch.from_list(seqs)
+    assert sb.data.shape == (2, 16)
+    assert list(np.asarray(sb.lengths)) == [3, 5]
+    m = np.asarray(sb.mask())
+    assert m[0].sum() == 3 and m[1].sum() == 5
+    ids = np.asarray(sb.segment_ids()).reshape(2, 16)
+    assert (ids[0, :3] == 0).all() and (ids[0, 3:] == 2).all()
+    assert (ids[1, :5] == 1).all()
+
+
+def test_sequence_batch_nested():
+    nested = [
+        [np.array([1, 2], np.int32), np.array([3], np.int32)],
+        [np.array([4, 5, 6], np.int32)],
+    ]
+    sb = SequenceBatch.from_nested_list(nested)
+    assert list(np.asarray(sb.lengths)) == [3, 3]
+    sub = np.asarray(sb.sub_segment_mask())
+    assert list(sub[0, :3]) == [0, 0, 1]
+    assert list(sub[1, :3]) == [0, 0, 0]
+
+
+def test_sequence_batch_is_pytree():
+    sb = SequenceBatch.from_list([np.ones(3, np.float32)])
+    leaves = jax.tree_util.tree_leaves(sb)
+    assert len(leaves) == 2  # data, lengths (sub_lengths None dropped)
+    out = jax.jit(lambda s: s.with_data(s.data * 2))(sb)
+    assert float(out.data[0, 0]) == 2.0
