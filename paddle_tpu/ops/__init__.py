@@ -1,0 +1,29 @@
+"""Functional kernel layer — jnp/lax compositions (+ Pallas where fusion is
+insufficient).
+
+Replaces the reference's four kernel layers with one functional namespace:
+- paddle/math/ (Matrix/Vector virtuals, BaseMatrix element-wise engine)
+- paddle/cuda/ (hl_* CUDA primitives + CPU stubs)
+- paddle/function/ (portable CPU/GPU functor pairs)
+- paddle/operators/math/ (new-stack functors)
+
+Everything is a pure function on jax arrays: autodiff comes from jax.grad
+(replacing paddle/framework/backward.cc and every hand-written *Grad kernel),
+device portability comes from XLA (replacing the CPU/GPU dual implementations
+and stub headers), and fusion comes from the compiler (replacing the lazy
+tensor-expression templates in paddle/math/TensorExpression.h).
+"""
+
+from paddle_tpu.ops import math
+from paddle_tpu.ops import activations
+from paddle_tpu.ops import conv
+from paddle_tpu.ops import pool
+from paddle_tpu.ops import norm
+from paddle_tpu.ops import loss
+from paddle_tpu.ops import sequence
+from paddle_tpu.ops import rnn
+from paddle_tpu.ops import sparse
+from paddle_tpu.ops import topk
+
+from paddle_tpu.ops.math import matmul, linear
+from paddle_tpu.ops.sparse import embedding_lookup

@@ -1,0 +1,57 @@
+"""Activation functions.
+
+Replaces the ActivationFunction registry (reference:
+paddle/gserver/activations/ActivationFunction.cpp — sigmoid, softmax, relu,
+brelu, tanh, stanh, linear, exponential, softrelu, abs, square, log,
+sequence_softmax) and paddle/cuda hl_activation kernels. All are elementwise
+jnp — XLA fuses them into adjacent matmuls/convs, which is exactly what the
+hand-fused hl_* kernels were for.
+"""
+
+import jax
+import jax.numpy as jnp
+
+linear = lambda x: x
+relu = jax.nn.relu
+sigmoid = jax.nn.sigmoid
+tanh = jnp.tanh
+exponential = jnp.exp
+softrelu = jax.nn.softplus  # log(1+e^x), clipped internally
+square = lambda x: x * x
+abs_ = jnp.abs
+log = jnp.log
+gelu = jax.nn.gelu
+silu = jax.nn.silu
+
+
+def brelu(x, t_min=0.0, t_max=24.0):
+    """Bounded relu (reference: BReluActivation)."""
+    return jnp.clip(x, t_min, t_max)
+
+
+def stanh(x, scale_a=2.0 / 3.0, scale_b=1.7159):
+    """Scaled tanh (reference: STanhActivation)."""
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+_REGISTRY = {
+    "linear": linear, "relu": relu, "sigmoid": sigmoid, "tanh": tanh,
+    "exponential": exponential, "softrelu": softrelu, "square": square,
+    "abs": abs_, "log": log, "brelu": brelu, "stanh": stanh,
+    "softmax": softmax, "gelu": gelu, "silu": silu,
+}
+
+
+def get(name: str):
+    """ActivationFunction::create equivalent."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown activation {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name]

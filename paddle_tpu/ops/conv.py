@@ -1,0 +1,75 @@
+"""Convolutions — NHWC, MXU-shaped.
+
+Replaces ExpandConvLayer/GemmConv/DepthwiseConv/cuDNN wrappers (reference:
+paddle/gserver/layers/ExpandConvLayer.cpp, paddle/function/GemmConvOp.cpp,
+paddle/function/DepthwiseConvOp.cpp, paddle/cuda/src/hl_cuda_cudnn.cc,
+paddle/operators/conv_op.cc, conv_cudnn_op.cc, conv_transpose_op.cc).
+
+Layout is NHWC with HWIO filters — TPU-native; XLA tiles the contraction onto
+the MXU directly. im2col (paddle/function/Im2ColOp.cpp) is unnecessary: XLA's
+conv lowering performs the equivalent internally.
+"""
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core import dtypes
+
+IntOr2 = Union[int, Tuple[int, int], Sequence[int]]
+
+
+def _pair(v: IntOr2) -> Tuple[int, int]:
+    if isinstance(v, int):
+        return (v, v)
+    return tuple(v)
+
+
+def conv2d(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
+           padding="SAME", dilation: IntOr2 = 1, groups: int = 1) -> jax.Array:
+    """2-D convolution.
+
+    x: [N, H, W, Cin]; w: [kH, kW, Cin//groups, Cout]; padding: "SAME" |
+    "VALID" | int | ((ph0,ph1),(pw0,pw1)).
+    """
+    cdt = dtypes.compute_dtype()
+    if isinstance(padding, int):
+        p = _pair(padding)
+        padding = ((p[0], p[0]), (p[1], p[1]))
+    elif isinstance(padding, (tuple, list)) and padding and isinstance(padding[0], int):
+        padding = ((padding[0], padding[0]), (padding[1], padding[1]))
+    # Both operands in the compute dtype, output in the compute dtype: the MXU
+    # accumulates fp32 internally regardless, and a float32
+    # preferred_element_type would break the conv VJP transpose rule (the f32
+    # cotangent meets a bf16 operand).
+    out = lax.conv_general_dilated(
+        x.astype(cdt), w.astype(cdt),
+        window_strides=_pair(stride),
+        padding=padding,
+        rhs_dilation=_pair(dilation),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+    )
+    return out.astype(x.dtype)
+
+
+def depthwise_conv2d(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
+                     padding="SAME", dilation: IntOr2 = 1) -> jax.Array:
+    """Depthwise conv: w is [kH, kW, 1, C*multiplier], groups = Cin
+    (reference: paddle/function/DepthwiseConvOp.cpp)."""
+    return conv2d(x, w, stride=stride, padding=padding, dilation=dilation,
+                  groups=x.shape[-1])
+
+
+def conv2d_transpose(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
+                     padding="SAME") -> jax.Array:
+    """Transposed conv (reference: operators/conv_transpose_op.cc)."""
+    cdt = dtypes.compute_dtype()
+    out = lax.conv_transpose(
+        x.astype(cdt), w.astype(cdt),
+        strides=_pair(stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return out.astype(x.dtype)

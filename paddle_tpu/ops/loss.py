@@ -1,0 +1,80 @@
+"""Loss / cost functions.
+
+Replaces the cost layer family (reference: paddle/gserver/layers/CostLayer.cpp
+— MultiClassCrossEntropy, SoftBinaryClassCrossEntropy, SumOfSquaresCostLayer,
+HuberTwoClassification, MultiBinaryLabelCrossEntropy, RankingCost,
+LambdaCost, SmoothL1Cost) and new-stack ops (operators/cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, squared_l2_distance_op.cc, rank_loss_op.cc,
+smooth_l1_loss_op.cc, huber_loss_op.cc, hinge_loss_op.cc).
+
+All return per-example losses [batch]; reduction is the caller's choice
+(the trainer averages). Softmax+CE is fused in log-space for stability —
+the same reason the reference had a fused softmax_with_cross_entropy op.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Integer labels [batch] against logits [batch, classes]."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
+                                axis=-1)[..., 0]
+
+
+def soft_cross_entropy(logits: jax.Array, label_probs: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.sum(label_probs * logp, axis=-1)
+
+
+def cross_entropy_with_probs(probs: jax.Array, labels: jax.Array,
+                             eps=1e-8) -> jax.Array:
+    """CE against already-softmaxed probabilities (the v1 layer contract:
+    classification_cost ran on softmax output)."""
+    p = jnp.take_along_axis(probs, labels[..., None].astype(jnp.int32),
+                            axis=-1)[..., 0]
+    return -jnp.log(p + eps)
+
+
+def binary_cross_entropy(p: jax.Array, label: jax.Array, eps=1e-8) -> jax.Array:
+    p = p.astype(jnp.float32)
+    return -(label * jnp.log(p + eps) + (1 - label) * jnp.log(1 - p + eps))
+
+
+def multi_binary_cross_entropy(p: jax.Array, labels: jax.Array,
+                               eps=1e-8) -> jax.Array:
+    """Sum of per-class BCE (reference: MultiBinaryLabelCrossEntropy)."""
+    return jnp.sum(binary_cross_entropy(p, labels, eps), axis=-1)
+
+
+def square_error(pred: jax.Array, target: jax.Array) -> jax.Array:
+    """0.5*||pred-t||^2 (reference: SumOfSquaresCostLayer)."""
+    d = (pred - target).astype(jnp.float32)
+    return 0.5 * jnp.sum(d * d, axis=tuple(range(1, d.ndim)))
+
+
+def smooth_l1(pred: jax.Array, target: jax.Array, delta=1.0) -> jax.Array:
+    d = jnp.abs((pred - target).astype(jnp.float32))
+    per = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return jnp.sum(per, axis=tuple(range(1, per.ndim)))
+
+
+def huber_classification(pred: jax.Array, label: jax.Array) -> jax.Array:
+    """Two-class huber on {0,1} labels, internally mapped to {-1,1}
+    (reference: HuberTwoClassification)."""
+    y = 2.0 * label.astype(jnp.float32) - 1.0
+    a = y * pred.astype(jnp.float32).squeeze(-1)
+    return jnp.where(a < -1.0, -4.0 * a, jnp.where(a < 1.0, jnp.square(1.0 - a), 0.0))
+
+
+def hinge(pred: jax.Array, label: jax.Array) -> jax.Array:
+    y = 2.0 * label.astype(jnp.float32) - 1.0
+    return jnp.maximum(0.0, 1.0 - y * pred.astype(jnp.float32).squeeze(-1))
+
+
+def rank_cost(left: jax.Array, right: jax.Array, label: jax.Array) -> jax.Array:
+    """Pairwise ranking (RankNet) cost (reference: RankingCost layer):
+    C = -o*label + log(1+exp(o)), o = left - right."""
+    o = (left - right).astype(jnp.float32).squeeze(-1)
+    return jax.nn.softplus(o) - o * label.astype(jnp.float32)

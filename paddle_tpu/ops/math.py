@@ -1,0 +1,40 @@
+"""Dense linear algebra with TPU dtype policy.
+
+Replaces Matrix::mul → hl_matrix_mul → cuBLAS GEMM
+(reference: paddle/math/Matrix.h:476, paddle/cuda/src/hl_cuda_cublas.cc) and
+operators/math/math_function.cc. On TPU the MXU natively consumes bfloat16
+with float32 accumulation, so the policy is: cast operands to the compute
+dtype (flag `compute_dtype`, default bf16), accumulate fp32 via
+``preferred_element_type``, return in the params dtype.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtypes
+
+
+def matmul(a: jax.Array, b: jax.Array, *, out_dtype=None) -> jax.Array:
+    """bf16-in / fp32-accumulate matmul on the MXU. Integer operands skip the
+    compute-dtype cast (bf16's 8-bit mantissa would round values > 256)."""
+    if not (jnp.issubdtype(a.dtype, jnp.floating) and
+            jnp.issubdtype(b.dtype, jnp.floating)):
+        return jnp.matmul(a, b, preferred_element_type=out_dtype)
+    cdt = dtypes.compute_dtype()
+    out_dtype = out_dtype or a.dtype
+    out = jnp.matmul(a.astype(cdt), b.astype(cdt),
+                     preferred_element_type=jnp.float32)
+    return out.astype(out_dtype)
+
+
+def linear(x: jax.Array, w: jax.Array, b: jax.Array = None) -> jax.Array:
+    """x @ w (+ b) — FullyConnectedLayer forward
+    (reference: paddle/gserver/layers/FullyConnectedLayer.cpp:73-100)."""
+    out = matmul(x, w)
+    if b is not None:
+        out = out + b.astype(out.dtype)
+    return out
+
+
+def outer(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.einsum("i,j->ij", a, b)

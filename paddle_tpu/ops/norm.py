@@ -1,0 +1,71 @@
+"""Normalisation ops.
+
+Replaces BatchNormalizationLayer / CudnnBatchNormLayer (reference:
+paddle/gserver/layers/BatchNormalizationLayer.cpp, CudnnBatchNormLayer.cpp,
+paddle/operators/batch_norm_op.cc) and cross-map response normalisation
+(paddle/function/CrossMapNormalOp.cpp, gserver/layers/NormLayer.cpp).
+
+batch_norm returns (y, new_running_mean, new_running_var) in training mode so
+running stats thread functionally through the train step — the reference
+mutated movingMean/movingVar buffers in place; here they are explicit state.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def batch_norm_train(x, gamma, beta, running_mean, running_var, *,
+                     momentum=0.9, eps=1e-5, axes=None
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Training-mode BN over all axes except the last (channel)."""
+    axes = axes if axes is not None else tuple(range(x.ndim - 1))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+    inv = jax.lax.rsqrt(var + eps)
+    y = (xf - mean) * inv * gamma.astype(jnp.float32) + beta.astype(jnp.float32)
+    new_mean = momentum * running_mean + (1 - momentum) * mean
+    new_var = momentum * running_var + (1 - momentum) * var
+    return y.astype(x.dtype), new_mean.astype(running_mean.dtype), \
+        new_var.astype(running_var.dtype)
+
+
+def batch_norm_infer(x, gamma, beta, running_mean, running_var, *, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(running_var.astype(jnp.float32) + eps)
+    y = (xf - running_mean) * inv * gamma.astype(jnp.float32) + \
+        beta.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def layer_norm(x, gamma, beta, *, eps=1e-5, axis=-1):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axis, keepdims=True)
+    var = jnp.var(xf, axis=axis, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+    return y.astype(x.dtype)
+
+
+def rms_norm(x, gamma, *, eps=1e-6, axis=-1):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(xf * xf, axis=axis, keepdims=True)
+    return (xf * jax.lax.rsqrt(ms + eps) * gamma).astype(x.dtype)
+
+
+def lrn(x, *, size=5, alpha=1e-4, beta=0.75, k=1.0):
+    """Cross-map (channel) local response normalisation, NHWC.
+    (reference: paddle/function/CrossMapNormalOp.cpp — same formula as
+    AlexNet's LRN: y = x / (k + alpha * sum_local(x^2))^beta)."""
+    sq = jnp.square(x.astype(jnp.float32))
+    # sum over a window of `size` channels centered at each channel
+    half = size // 2
+    padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, size - 1 - half)])
+    # cumulative-sum trick over channel windows
+    csum = jnp.cumsum(padded, axis=-1)
+    zeros = jnp.zeros_like(csum[..., :1])
+    csum = jnp.concatenate([zeros, csum], axis=-1)
+    local = csum[..., size:] - csum[..., :-size]
+    y = x.astype(jnp.float32) / jnp.power(k + alpha * local, beta)
+    return y.astype(x.dtype)

@@ -1,0 +1,84 @@
+"""Pooling (reference: paddle/gserver/layers/PoolLayer.cpp,
+paddle/function/PoolOp（via hl_pooling）, paddle/operators/pool_op.cc,
+pool_cudnn_op.cc). NHWC layout; lax.reduce_window maps directly to the TPU
+vector unit's windowed reductions.
+"""
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+IntOr2 = Union[int, Tuple[int, int]]
+
+from paddle_tpu.ops.conv import _pair
+
+
+def _resolve_pads(x_shape, padding, k, s):
+    """Resolve padding to explicit per-dim pairs for reduce_window.
+    Accepts "SAME"/"VALID", int, (ph, pw), or ((ph0,ph1),(pw0,pw1))."""
+    if isinstance(padding, str):
+        return lax.padtype_to_pads(x_shape, (1, k[0], k[1], 1),
+                                   (1, s[0], s[1], 1), padding)
+    if isinstance(padding, int):
+        padding = ((padding, padding), (padding, padding))
+    else:
+        padding = tuple(padding)
+        if isinstance(padding[0], int):
+            padding = ((padding[0], padding[0]), (padding[1], padding[1]))
+    return [(0, 0), tuple(padding[0]), tuple(padding[1]), (0, 0)]
+
+
+def max_pool2d(x: jax.Array, ksize: IntOr2, *, stride: IntOr2 = None,
+               padding="VALID") -> jax.Array:
+    k, s = _pair(ksize), _pair(stride if stride is not None else ksize)
+    pads = _resolve_pads(x.shape, padding, k, s)
+    return lax.reduce_window(x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                             else jnp.iinfo(x.dtype).min,
+                             lax.max, (1, k[0], k[1], 1), (1, s[0], s[1], 1), pads)
+
+
+def avg_pool2d(x: jax.Array, ksize: IntOr2, *, stride: IntOr2 = None,
+               padding="VALID", count_include_pad=False) -> jax.Array:
+    """Average pooling; excludes padding from the divisor by default
+    (matches cuDNN AVERAGE_COUNT_EXCLUDE_PADDING used by the reference)."""
+    k, s = _pair(ksize), _pair(stride if stride is not None else ksize)
+    pads = _resolve_pads(x.shape, padding, k, s)
+    summed = lax.reduce_window(x, 0.0, lax.add, (1, k[0], k[1], 1),
+                               (1, s[0], s[1], 1), pads)
+    if count_include_pad:
+        return summed / (k[0] * k[1])
+    ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+    counts = lax.reduce_window(ones, 0.0, lax.add, (1, k[0], k[1], 1),
+                               (1, s[0], s[1], 1), pads)
+    return summed / counts
+
+
+def global_avg_pool2d(x: jax.Array) -> jax.Array:
+    """[N,H,W,C] -> [N,C]."""
+    return jnp.mean(x, axis=(1, 2))
+
+
+def global_max_pool2d(x: jax.Array) -> jax.Array:
+    return jnp.max(x, axis=(1, 2))
+
+
+def spp(x: jax.Array, pyramid_height: int, pool_type="max") -> jax.Array:
+    """Spatial pyramid pooling (reference: gserver/layers/SpatialPyramidPoolLayer.cpp):
+    concat of pooled [1x1, 2x2, ... 2^(h-1) bins] flattened per image.
+
+    Output length is fixed at sum(4^lvl)*C regardless of input resolution —
+    each level pads the image up to bins*ceil(dim/bins) so the window grid
+    yields exactly bins x bins cells (the SPP contract)."""
+    n, h, w, c = x.shape
+    fn = max_pool2d if pool_type == "max" else avg_pool2d
+    outs = []
+    for lvl in range(pyramid_height):
+        bins = 2 ** lvl
+        kh, kw = -(-h // bins), -(-w // bins)
+        ph, pw = kh * bins - h, kw * bins - w
+        pooled = fn(x, (kh, kw), stride=(kh, kw),
+                    padding=((0, ph), (0, pw)))
+        outs.append(pooled.reshape(n, -1))
+    return jnp.concatenate(outs, axis=1)

@@ -1,0 +1,164 @@
+"""Recurrent cells and scans.
+
+Replaces the fused CUDA LSTM/GRU kernels and their layer wrappers (reference:
+paddle/cuda/src/hl_cuda_lstm.cu, hl_gpu_gru.cuh, gserver/layers/LstmLayer.cpp,
+GatedRecurrentLayer.cpp, operators/lstm_op.cc, gru_op.cc,
+operators/math/lstm_compute.cc, gru_compute.cc, sequence2batch.h).
+
+TPU design: one big input GEMM for all timesteps up front
+(x @ W for every gate, batched over time — MXU-friendly), then a ``lax.scan``
+over time carrying (h, c) where each step is a single [batch, 4*hidden] GEMM
+against the recurrent weights plus fused elementwise gate math. Masking
+freezes the state of finished sequences — this replaces the reference's
+sequence2batch reordering (operators/math/sequence2batch.h) which existed to
+avoid wasted GEMM rows; on the MXU the padded rows are free relative to the
+cost of data movement.
+
+Gate order here is i, f, g(candidate), o for LSTM and r(reset), u(update),
+c(candidate) for GRU. NOTE: the reference packs gates differently —
+(candidate, input, forget, output) for LSTM (operators/math/detail/
+lstm_cpu_kernel.h:45-48) and (update, reset, candidate) for GRU
+(gru_cpu_kernel.h:36-65) — so weights ported from Paddle checkpoints must be
+column-permuted accordingly.
+"""
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.math import matmul
+
+
+class LSTMState(NamedTuple):
+    h: jax.Array
+    c: jax.Array
+
+
+def lstm_cell(x_proj: jax.Array, state: LSTMState, w_hh: jax.Array,
+              forget_bias: float = 0.0) -> LSTMState:
+    """One LSTM step. x_proj: [b, 4H] precomputed x@W_ih + b."""
+    h, c = state
+    gates = x_proj + matmul(h, w_hh)
+    i, f, g, o = jnp.split(gates.astype(jnp.float32), 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f + forget_bias)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    new_c = f * c.astype(jnp.float32) + i * g
+    new_h = o * jnp.tanh(new_c)
+    return LSTMState(new_h.astype(h.dtype), new_c.astype(c.dtype))
+
+
+def lstm(x: jax.Array, lengths: jax.Array, w_ih: jax.Array, w_hh: jax.Array,
+         b: Optional[jax.Array] = None, *, reverse: bool = False,
+         h0: Optional[jax.Array] = None, c0: Optional[jax.Array] = None,
+         forget_bias: float = 0.0) -> Tuple[jax.Array, LSTMState]:
+    """Full-sequence LSTM.
+
+    x: [b, t, d]; w_ih: [d, 4H]; w_hh: [H, 4H]; b: [4H].
+    Returns (outputs [b, t, H], final LSTMState).
+    """
+    bsz, tmax, _ = x.shape
+    hidden = w_hh.shape[0]
+    # one big MXU GEMM over all timesteps
+    xp = matmul(x.reshape(bsz * tmax, -1), w_ih).reshape(bsz, tmax, 4 * hidden)
+    if b is not None:
+        xp = xp + b.astype(xp.dtype)
+    mask = (jnp.arange(tmax)[None, :] < lengths[:, None])  # [b, t]
+    h = h0 if h0 is not None else jnp.zeros((bsz, hidden), x.dtype)
+    c = c0 if c0 is not None else jnp.zeros((bsz, hidden), x.dtype)
+
+    xs = jnp.moveaxis(xp, 1, 0)      # [t, b, 4H]
+    ms = jnp.moveaxis(mask, 1, 0)    # [t, b]
+    if reverse:
+        xs, ms = xs[::-1], ms[::-1]
+
+    def step(state, inp):
+        xt, mt = inp
+        nxt = lstm_cell(xt, state, w_hh, forget_bias)
+        mt = mt[:, None]
+        # freeze finished rows (padding): carry old state through
+        h_ = jnp.where(mt, nxt.h, state.h)
+        c_ = jnp.where(mt, nxt.c, state.c)
+        return LSTMState(h_, c_), h_
+
+    final, outs = jax.lax.scan(step, LSTMState(h, c), (xs, ms))
+    if reverse:
+        outs = outs[::-1]
+    outs = jnp.moveaxis(outs, 0, 1)  # [b, t, H]
+    outs = outs * mask[..., None].astype(outs.dtype)
+    return outs, final
+
+
+def gru_cell(x_proj: jax.Array, h: jax.Array, w_hh: jax.Array) -> jax.Array:
+    """One GRU step. x_proj: [b, 3H]; w_hh: [H, 3H] packed (r, u, c)."""
+    hidden = h.shape[-1]
+    hp = matmul(h, w_hh[:, : 2 * hidden])
+    xr, xu, xc = jnp.split(x_proj.astype(jnp.float32), 3, axis=-1)
+    hr, hu = jnp.split(hp.astype(jnp.float32), 2, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    u = jax.nn.sigmoid(xu + hu)
+    hc = matmul(r * h.astype(jnp.float32), w_hh[:, 2 * hidden:])
+    c = jnp.tanh(xc + hc.astype(jnp.float32))
+    new_h = u * h.astype(jnp.float32) + (1 - u) * c
+    return new_h.astype(h.dtype)
+
+
+def gru(x: jax.Array, lengths: jax.Array, w_ih: jax.Array, w_hh: jax.Array,
+        b: Optional[jax.Array] = None, *, reverse: bool = False,
+        h0: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+    """Full-sequence GRU. x: [b,t,d]; w_ih: [d,3H]; w_hh: [H,3H]."""
+    bsz, tmax, _ = x.shape
+    hidden = w_hh.shape[0]
+    xp = matmul(x.reshape(bsz * tmax, -1), w_ih).reshape(bsz, tmax, 3 * hidden)
+    if b is not None:
+        xp = xp + b.astype(xp.dtype)
+    mask = (jnp.arange(tmax)[None, :] < lengths[:, None])
+    h = h0 if h0 is not None else jnp.zeros((bsz, hidden), x.dtype)
+    xs = jnp.moveaxis(xp, 1, 0)
+    ms = jnp.moveaxis(mask, 1, 0)
+    if reverse:
+        xs, ms = xs[::-1], ms[::-1]
+
+    def step(state, inp):
+        xt, mt = inp
+        nh = gru_cell(xt, state, w_hh)
+        nh = jnp.where(mt[:, None], nh, state)
+        return nh, nh
+
+    final, outs = jax.lax.scan(step, h, (xs, ms))
+    if reverse:
+        outs = outs[::-1]
+    outs = jnp.moveaxis(outs, 0, 1)
+    outs = outs * mask[..., None].astype(outs.dtype)
+    return outs, final
+
+
+def simple_rnn(x: jax.Array, lengths: jax.Array, w_ih: jax.Array,
+               w_hh: jax.Array, b: Optional[jax.Array] = None, *,
+               act=jnp.tanh, reverse: bool = False,
+               h0: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+    """Vanilla RNN (reference: gserver RecurrentLayer.cpp)."""
+    bsz, tmax, _ = x.shape
+    hidden = w_hh.shape[0]
+    xp = matmul(x.reshape(bsz * tmax, -1), w_ih).reshape(bsz, tmax, hidden)
+    if b is not None:
+        xp = xp + b.astype(xp.dtype)
+    mask = (jnp.arange(tmax)[None, :] < lengths[:, None])
+    h = h0 if h0 is not None else jnp.zeros((bsz, hidden), x.dtype)
+    xs, ms = jnp.moveaxis(xp, 1, 0), jnp.moveaxis(mask, 1, 0)
+    if reverse:
+        xs, ms = xs[::-1], ms[::-1]
+
+    def step(state, inp):
+        xt, mt = inp
+        nh = act((xt + matmul(state, w_hh)).astype(jnp.float32)).astype(state.dtype)
+        nh = jnp.where(mt[:, None], nh, state)
+        return nh, nh
+
+    final, outs = jax.lax.scan(step, h, (xs, ms))
+    if reverse:
+        outs = outs[::-1]
+    outs = jnp.moveaxis(outs, 0, 1)
+    return outs * mask[..., None].astype(outs.dtype), final

@@ -1,0 +1,141 @@
+"""Sequence ops over padded+masked batches.
+
+Replaces the LoD/sequence machinery (reference:
+paddle/gserver/layers/SequencePoolLayer.cpp, SequenceLastInstanceLayer.cpp,
+MaxLayer/AverageLayer (sequence modes), ExpandLayer.cpp,
+SequenceConcatLayer.cpp, SequenceReshapeLayer.cpp, SequenceSliceLayer.cpp,
+KmaxSeqScoreLayer.cpp, paddle/function/ContextProjectionOp.cpp,
+paddle/function/RowConvOp.cpp, operators/sequence_pool_op.cc,
+sequence_conv_op.cc, sequence_softmax_op.cc, seq_expand_op.cc).
+
+Inputs are [batch, time, ...] + lengths [batch] (see core.ragged) — masked
+compute replaces the reference's zero-padding-free start-position indexing;
+on TPU, masking + dense batched ops beat gather/scatter of ragged rows.
+"""
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _mask(lengths, max_len, dtype=jnp.float32):
+    t = jnp.arange(max_len, dtype=jnp.int32)
+    return (t[None, :] < lengths[:, None]).astype(dtype)
+
+
+def seq_sum(x, lengths):
+    """[b,t,...] -> [b,...] sum over valid steps (SequencePoolLayer sum)."""
+    m = _mask(lengths, x.shape[1]).reshape(x.shape[:2] + (1,) * (x.ndim - 2))
+    return jnp.sum(x * m.astype(x.dtype), axis=1)
+
+
+def seq_avg(x, lengths):
+    denom = jnp.maximum(lengths, 1).astype(x.dtype)
+    return seq_sum(x, lengths) / denom.reshape((-1,) + (1,) * (x.ndim - 2))
+
+
+def seq_sqrt(x, lengths):
+    """sum / sqrt(len) (reference: AverageLayer "sqrt" mode)."""
+    denom = jnp.sqrt(jnp.maximum(lengths, 1).astype(x.dtype))
+    return seq_sum(x, lengths) / denom.reshape((-1,) + (1,) * (x.ndim - 2))
+
+
+def seq_max(x, lengths):
+    m = _mask(lengths, x.shape[1], jnp.bool_).reshape(
+        x.shape[:2] + (1,) * (x.ndim - 2))
+    return jnp.max(jnp.where(m, x, NEG_INF), axis=1)
+
+
+def seq_last(x, lengths):
+    """Last valid step (reference: SequenceLastInstanceLayer)."""
+    idx = jnp.maximum(lengths - 1, 0).astype(jnp.int32)
+    return jax.vmap(lambda row, i: row[i])(x, idx)
+
+
+def seq_first(x, lengths):
+    return x[:, 0]
+
+
+def seq_softmax(x, lengths):
+    """Softmax over the time axis per sequence, padding masked out
+    (reference: sequence_softmax_op.cc, SequenceSoftmaxActivation)."""
+    m = _mask(lengths, x.shape[1], jnp.bool_)
+    while m.ndim < x.ndim:
+        m = m[..., None]
+    logits = jnp.where(m, x.astype(jnp.float32), NEG_INF)
+    out = jax.nn.softmax(logits, axis=1)
+    return jnp.where(m, out, 0.0).astype(x.dtype)
+
+
+def seq_expand(x, lengths, max_len: int):
+    """Broadcast one vector per sequence across its timesteps
+    (reference: ExpandLayer / seq_expand_op): [b, d] -> [b, t, d] masked."""
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], max_len) + x.shape[1:])
+    return out * _mask(lengths, max_len, x.dtype).reshape(
+        x.shape[0], max_len, *([1] * (x.ndim - 1)))
+
+
+def seq_reverse(x, lengths):
+    """Reverse each sequence within its valid region (reference:
+    gserver SequenceReverse used for bidirectional RNNs)."""
+    t = jnp.arange(x.shape[1], dtype=jnp.int32)
+    idx = jnp.where(t[None, :] < lengths[:, None],
+                    lengths[:, None] - 1 - t[None, :], t[None, :])
+    return jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)).astype(jnp.int32), axis=1)
+
+
+def context_projection(x, lengths, context_len: int, context_start: int):
+    """Sliding context-window concat (reference:
+    paddle/function/ContextProjectionOp.cpp — the core of text CNNs):
+    out[:, t] = concat(x[:, t+context_start], ..., x[:, t+context_start+len-1])
+    with out-of-sequence positions zero."""
+    b, tmax, d = x.shape
+    m = _mask(lengths, tmax, x.dtype)[..., None]
+    xm = x * m
+    cols = []
+    for k in range(context_len):
+        shift = context_start + k
+        rolled = jnp.roll(xm, -shift, axis=1)
+        t = jnp.arange(tmax)
+        valid = (t[None, :] + shift >= 0) & (t[None, :] + shift < lengths[:, None])
+        cols.append(jnp.where(valid[..., None], rolled, 0.0))
+    return jnp.concatenate(cols, axis=-1)
+
+
+def row_conv(x, lengths, w):
+    """Lookahead row convolution (reference: paddle/function/RowConvOp.cpp,
+    gserver RowConvLayer — DeepSpeech2): out[:, t] = sum_k x[:, t+k] * w[k]."""
+    k = w.shape[0]
+    ctx = context_projection(x, lengths, k, 0)  # [b,t,k*d]
+    b, tmax, _ = x.shape
+    ctx = ctx.reshape(b, tmax, k, -1)
+    return jnp.einsum("btkd,kd->btd", ctx, w.astype(x.dtype))
+
+
+def kmax_score_indices(scores, lengths, k: int):
+    """Top-k step indices per sequence by score (reference:
+    KmaxSeqScoreLayer.cpp). scores: [b, t]. Returns [b, k] indices."""
+    masked = jnp.where(_mask(lengths, scores.shape[1], jnp.bool_),
+                       scores, NEG_INF)
+    _, idx = jax.lax.top_k(masked, k)
+    return idx
+
+
+def seq_concat(x, x_len, y, y_len):
+    """Per-sequence time-axis concat (reference: SequenceConcatLayer.cpp).
+    Output padded to x.max_len + y.max_len."""
+    b, tx, d = x.shape
+    ty = y.shape[1]
+    out_t = tx + ty
+    # scatter y after each x's valid length
+    t = jnp.arange(out_t, dtype=jnp.int32)
+    from_x = t[None, :] < x_len[:, None]
+    y_idx = jnp.clip(t[None, :] - x_len[:, None], 0, ty - 1)
+    x_idx = jnp.clip(t[None, :], 0, tx - 1)
+    gx = jnp.take_along_axis(x, x_idx[..., None].astype(jnp.int32), axis=1)
+    gy = jnp.take_along_axis(y, y_idx[..., None].astype(jnp.int32), axis=1)
+    out = jnp.where(from_x[..., None], gx, gy)
+    valid = t[None, :] < (x_len + y_len)[:, None]
+    return jnp.where(valid[..., None], out, 0.0), x_len + y_len

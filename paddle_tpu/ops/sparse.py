@@ -1,0 +1,50 @@
+"""Sparse / embedding ops.
+
+Replaces the embedding + row-sparse gradient machinery (reference:
+paddle/gserver/layers/TableProjection.cpp, operators/lookup_table_op.cc with
+SelectedRows grads, paddle/math/SparseRowMatrix.h, framework/selected_rows.h).
+
+On TPU an embedding lookup is a gather feeding the MXU; the row-sparse
+gradient materialises through XLA's scatter-add in the backward pass of
+``jnp.take`` — the SelectedRows representation is unnecessary on-chip. The
+*sharded* table variant (the sparse_remote_update capability,
+trainer/RemoteParameterUpdater.h:265) lives in paddle_tpu.parallel.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def embedding_lookup(table: jax.Array, ids: jax.Array,
+                     padding_idx: int = None) -> jax.Array:
+    """table: [vocab, dim]; ids: int[...]. Returns [..., dim]."""
+    out = jnp.take(table, ids.astype(jnp.int32), axis=0)
+    if padding_idx is not None:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+    return out
+
+
+def one_hot(ids: jax.Array, depth: int, dtype=jnp.float32) -> jax.Array:
+    return jax.nn.one_hot(ids, depth, dtype=dtype)
+
+
+def scatter_add_rows(table: jax.Array, ids: jax.Array,
+                     rows: jax.Array) -> jax.Array:
+    """table[ids] += rows (duplicate ids accumulate) — the SelectedRows apply
+    operation (reference: operators/math/selected_rows_functor.cc)."""
+    return table.at[ids.astype(jnp.int32)].add(rows)
+
+
+def sparse_vector_to_dense(indices, values, dim, batch_offsets=None):
+    """Host-side helper used by the data feeder for sparse_vector input types
+    (reference: python/paddle/trainer/PyDataProvider2.py sparse slots)."""
+    import numpy as np
+    n = len(batch_offsets) - 1 if batch_offsets is not None else 1
+    out = np.zeros((n, dim), np.float32)
+    if batch_offsets is None:
+        out[0, indices] = values if values is not None else 1.0
+        return out
+    for i in range(n):
+        lo, hi = batch_offsets[i], batch_offsets[i + 1]
+        out[i, indices[lo:hi]] = values[lo:hi] if values is not None else 1.0
+    return out

@@ -1,0 +1,17 @@
+"""Top-k / argmax ops (reference: paddle/cuda/src/hl_top_k.cu,
+operators/top_k_op.cc, gserver MaxIdLayer.cpp). lax.top_k lowers to the TPU's
+sort/partial-sort; nothing hand-written needed."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top_k(x: jax.Array, k: int):
+    """Returns (values, indices) over the last axis."""
+    return lax.top_k(x, k)
+
+
+def max_id(x: jax.Array) -> jax.Array:
+    """Argmax over last axis, kept as [..., 1] (reference: MaxIdLayer)."""
+    return jnp.argmax(x, axis=-1, keepdims=True).astype(jnp.int32)

@@ -1,0 +1,63 @@
+"""Op test harness: numpy-reference forward checks + numeric gradient checks.
+
+Mirrors the reference's OpTest (python/paddle/v2/framework/tests/op_test.py:
+check_output at :315, get_numeric_gradient central-difference at :80,
+check_grad at :341) — but autodiff correctness here means jax.grad vs numeric
+gradients, replacing per-op hand-written Grad kernels as the thing under test.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check_forward(fn, args, expected, rtol=1e-5, atol=1e-5, jit=True):
+    """Run fn (optionally jitted) and compare to a numpy reference."""
+    f = jax.jit(fn) if jit else fn
+    out = f(*args)
+    np.testing.assert_allclose(np.asarray(out, np.float64), expected,
+                               rtol=rtol, atol=atol)
+    return out
+
+
+def numeric_grad(fn, args, wrt=0, eps=1e-3):
+    """Central-difference gradient of sum(fn(*args)) wrt args[wrt]
+    (reference: op_test.py:80 get_numeric_gradient)."""
+    args = [np.asarray(a, np.float64) if np.issubdtype(np.asarray(a).dtype, np.floating)
+            else np.asarray(a) for a in args]
+    x = args[wrt]
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = g.reshape(-1)
+
+    def f(xv):
+        a = list(args)
+        a[wrt] = xv
+        return float(np.sum(np.asarray(fn(*[jnp.asarray(v, jnp.float32) if
+                                            np.issubdtype(v.dtype, np.floating)
+                                            else jnp.asarray(v) for v in a]),
+                                       np.float64)))
+
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        hi = f(x)
+        flat[i] = orig - eps
+        lo = f(x)
+        flat[i] = orig
+        gflat[i] = (hi - lo) / (2 * eps)
+    return g
+
+
+def check_grad(fn, args, wrt=0, rtol=2e-2, atol=2e-3, eps=1e-3):
+    """jax.grad of sum(fn) vs central differences (reference: op_test.py:341)."""
+    def scalar_fn(*a):
+        return jnp.sum(fn(*a))
+
+    jargs = [jnp.asarray(a, jnp.float32) if np.issubdtype(np.asarray(a).dtype,
+                                                          np.floating)
+             else jnp.asarray(a) for a in args]
+    analytic = np.asarray(jax.grad(scalar_fn, argnums=wrt)(*jargs), np.float64)
+    numeric = numeric_grad(fn, args, wrt, eps)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)
+    return analytic

@@ -1,0 +1,93 @@
+"""Tests: ops.conv, ops.pool vs numpy/torch references."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import conv, pool
+from tests.op_test_util import check_forward, check_grad
+
+
+def _np_conv2d(x, w, stride=1, pad=0):
+    """Naive NHWC conv reference."""
+    n, h, wd, cin = x.shape
+    kh, kw, _, cout = w.shape
+    xp = np.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, oh, ow, cout), np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, i * stride:i * stride + kh, j * stride:j * stride + kw, :]
+            out[:, i, j, :] = np.tensordot(patch, w, axes=([1, 2, 3], [0, 1, 2]))
+    return out
+
+
+def test_conv2d_valid(rng):
+    x = rng.randn(2, 8, 8, 3).astype(np.float32)
+    w = rng.randn(3, 3, 3, 4).astype(np.float32)
+    ref = _np_conv2d(x, w)
+    check_forward(lambda a, b: conv.conv2d(a, b, padding="VALID"), (x, w), ref,
+                  rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_stride_pad(rng):
+    x = rng.randn(1, 7, 7, 2).astype(np.float32)
+    w = rng.randn(3, 3, 2, 5).astype(np.float32)
+    ref = _np_conv2d(x, w, stride=2, pad=1)
+    check_forward(lambda a, b: conv.conv2d(a, b, stride=2, padding=1), (x, w),
+                  ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_grad(rng):
+    x = rng.randn(1, 5, 5, 2).astype(np.float32)
+    w = rng.randn(3, 3, 2, 2).astype(np.float32)
+    check_grad(lambda a, b: conv.conv2d(a, b, padding="VALID"), (x, w), wrt=0)
+    check_grad(lambda a, b: conv.conv2d(a, b, padding="VALID"), (x, w), wrt=1)
+
+
+def test_depthwise(rng):
+    x = rng.randn(1, 6, 6, 3).astype(np.float32)
+    w = rng.randn(3, 3, 1, 3).astype(np.float32)  # multiplier 1
+    out = conv.depthwise_conv2d(jnp.asarray(x), jnp.asarray(w), padding="VALID")
+    # per-channel independent conv
+    for c in range(3):
+        ref = _np_conv2d(x[..., c:c + 1], w[..., c:c + 1])
+        np.testing.assert_allclose(np.asarray(out)[..., c:c + 1], ref,
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_transpose_shape(rng):
+    x = rng.randn(2, 4, 4, 3).astype(np.float32)
+    w = rng.randn(2, 2, 3, 6).astype(np.float32)
+    out = conv.conv2d_transpose(jnp.asarray(x), jnp.asarray(w), stride=2,
+                                padding="VALID")
+    assert out.shape == (2, 8, 8, 6)
+
+
+def test_max_pool(rng):
+    x = rng.randn(2, 4, 4, 3).astype(np.float32)
+    out = pool.max_pool2d(jnp.asarray(x), 2)
+    ref = x.reshape(2, 2, 2, 2, 2, 3).max(axis=(2, 4))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+
+def test_avg_pool_excludes_padding(rng):
+    x = np.ones((1, 3, 3, 1), np.float32)
+    out = pool.avg_pool2d(jnp.asarray(x), 2, stride=2, padding=((0, 1), (0, 1)))
+    # all windows average only valid elements => all ones
+    np.testing.assert_allclose(np.asarray(out), np.ones((1, 2, 2, 1)), rtol=1e-6)
+
+
+def test_global_pools(rng):
+    x = rng.randn(2, 3, 3, 4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(pool.global_avg_pool2d(jnp.asarray(x))),
+                               x.mean((1, 2)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pool.global_max_pool2d(jnp.asarray(x))),
+                               x.max((1, 2)), rtol=1e-6)
+
+
+def test_spp_shape(rng):
+    x = rng.randn(2, 8, 8, 3).astype(np.float32)
+    out = pool.spp(jnp.asarray(x), 3)
+    # bins: 1 + 4 + 16 = 21 positions x 3 channels
+    assert out.shape == (2, 21 * 3)

@@ -1,0 +1,70 @@
+"""Tests: ops.math, ops.activations, ops.topk, ops.sparse."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import activations, math as pmath, sparse, topk
+from tests.op_test_util import check_forward, check_grad
+
+
+def test_matmul_fp32_exact(rng):
+    a = rng.randn(8, 16).astype(np.float32)
+    b = rng.randn(16, 4).astype(np.float32)
+    check_forward(lambda x, y: pmath.matmul(x, y), (a, b), a @ b, rtol=1e-5)
+
+
+def test_linear_bias(rng):
+    x = rng.randn(4, 8).astype(np.float32)
+    w = rng.randn(8, 3).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+    check_forward(pmath.linear, (x, w, b), x @ w + b)
+    check_grad(pmath.linear, (x, w, b), wrt=1)
+
+
+def test_activations(rng):
+    x = rng.randn(5, 7).astype(np.float32)
+    check_forward(activations.relu, (x,), np.maximum(x, 0))
+    check_forward(activations.sigmoid, (x,), 1 / (1 + np.exp(-x)), rtol=1e-5)
+    check_forward(activations.stanh, (x,), 1.7159 * np.tanh(2 / 3 * x), rtol=1e-5)
+    check_forward(activations.brelu, (x * 20,), np.clip(x * 20, 0, 24))
+    sm = np.exp(x) / np.exp(x).sum(-1, keepdims=True)
+    check_forward(activations.softmax, (x,), sm, rtol=1e-5)
+    check_grad(activations.softmax, (x,))
+    assert activations.get("relu") is activations.relu
+
+
+def test_topk(rng):
+    x = rng.randn(3, 10).astype(np.float32)
+    v, i = topk.top_k(jnp.asarray(x), 3)
+    ref = np.sort(x, axis=-1)[:, ::-1][:, :3]
+    np.testing.assert_allclose(np.asarray(v), ref, rtol=1e-6)
+    mid = topk.max_id(jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(mid)[:, 0], x.argmax(-1))
+
+
+def test_embedding(rng):
+    table = rng.randn(20, 6).astype(np.float32)
+    ids = np.array([[1, 3], [19, 0]], np.int32)
+    check_forward(sparse.embedding_lookup, (table, ids), table[ids])
+    # gradient wrt table is a scatter-add of ones rows
+    check_grad(lambda t: sparse.embedding_lookup(t, jnp.asarray(ids)), (table,))
+
+
+def test_embedding_padding_idx(rng):
+    table = rng.randn(5, 4).astype(np.float32)
+    ids = np.array([0, 2], np.int32)
+    out = sparse.embedding_lookup(jnp.asarray(table), jnp.asarray(ids), padding_idx=0)
+    assert np.abs(np.asarray(out)[0]).max() == 0.0
+    np.testing.assert_allclose(np.asarray(out)[1], table[2])
+
+
+def test_scatter_add_rows(rng):
+    table = np.zeros((4, 2), np.float32)
+    ids = np.array([1, 1, 3], np.int32)
+    rows = np.ones((3, 2), np.float32)
+    out = sparse.scatter_add_rows(jnp.asarray(table), jnp.asarray(ids),
+                                  jnp.asarray(rows))
+    expected = np.zeros((4, 2), np.float32)
+    expected[1] = 2
+    expected[3] = 1
+    np.testing.assert_allclose(np.asarray(out), expected)

@@ -1,0 +1,40 @@
+"""Exercise the bf16 compute-dtype policy (normally disabled in the CPU test
+config) — guards the conv/matmul VJP dtype rules that only bite when the MXU
+cast path is active (see ops/conv.py dtype note)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import conv, math as pmath
+from paddle_tpu.utils.flags import GLOBAL_FLAGS
+
+
+@pytest.fixture
+def bf16_compute():
+    old = GLOBAL_FLAGS.get("compute_dtype")
+    GLOBAL_FLAGS.set("compute_dtype", "bfloat16")
+    yield
+    GLOBAL_FLAGS.set("compute_dtype", old)
+
+
+def test_matmul_bf16_grad(bf16_compute, rng):
+    a = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    b = jnp.asarray(rng.randn(16, 4).astype(np.float32))
+    out = pmath.matmul(a, b)
+    assert out.dtype == jnp.float32  # fp32 accumulate + cast back
+    g = jax.jit(jax.grad(lambda x, y: pmath.matmul(x, y).sum(), argnums=(0, 1)))(a, b)
+    assert g[0].dtype == jnp.float32 and g[1].dtype == jnp.float32
+    # bf16 mantissa is 8 bits: expect ~1e-2 relative agreement
+    np.testing.assert_allclose(np.asarray(out), np.asarray(a) @ np.asarray(b),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_conv_bf16_grad(bf16_compute, rng):
+    x = jnp.asarray(rng.randn(2, 8, 8, 3).astype(np.float32))
+    w = jnp.asarray(rng.randn(3, 3, 3, 4).astype(np.float32))
+    out = conv.conv2d(x, w, padding="SAME")
+    assert out.dtype == jnp.float32
+    g = jax.jit(jax.grad(lambda a, b: conv.conv2d(a, b).sum(), argnums=(0, 1)))(x, w)
+    assert g[0].dtype == jnp.float32 and g[1].dtype == jnp.float32

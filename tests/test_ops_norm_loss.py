@@ -1,0 +1,106 @@
+"""Tests: ops.norm, ops.loss."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import loss, norm
+from tests.op_test_util import check_forward, check_grad
+
+
+def test_batch_norm_train(rng):
+    x = rng.randn(16, 4).astype(np.float32) * 3 + 1
+    gamma, beta = np.ones(4, np.float32), np.zeros(4, np.float32)
+    rm, rv = np.zeros(4, np.float32), np.ones(4, np.float32)
+    y, nm, nv = norm.batch_norm_train(jnp.asarray(x), jnp.asarray(gamma),
+                                      jnp.asarray(beta), jnp.asarray(rm),
+                                      jnp.asarray(rv))
+    np.testing.assert_allclose(np.asarray(y).mean(0), 0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y).std(0), 1, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(nm), 0.1 * x.mean(0), rtol=1e-4)
+
+
+def test_batch_norm_infer(rng):
+    x = rng.randn(8, 4).astype(np.float32)
+    gamma = rng.rand(4).astype(np.float32) + 0.5
+    beta = rng.randn(4).astype(np.float32)
+    rm = rng.randn(4).astype(np.float32)
+    rv = rng.rand(4).astype(np.float32) + 0.5
+    ref = (x - rm) / np.sqrt(rv + 1e-5) * gamma + beta
+    check_forward(lambda *a: norm.batch_norm_infer(*a),
+                  (x, gamma, beta, rm, rv), ref, rtol=1e-4)
+
+
+def test_layer_norm(rng):
+    x = rng.randn(6, 10).astype(np.float32)
+    g, b = np.ones(10, np.float32), np.zeros(10, np.float32)
+    y = norm.layer_norm(jnp.asarray(x), jnp.asarray(g), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(y).mean(-1), 0, atol=1e-5)
+    check_grad(lambda a: norm.layer_norm(a, jnp.asarray(g), jnp.asarray(b)), (x,))
+
+
+def test_lrn(rng):
+    x = rng.rand(1, 2, 2, 7).astype(np.float32)
+    size, alpha, beta, k = 5, 1e-4, 0.75, 1.0
+    out = norm.lrn(jnp.asarray(x), size=size, alpha=alpha, beta=beta, k=k)
+    # naive reference
+    ref = np.zeros_like(x)
+    half = size // 2
+    for c in range(7):
+        lo, hi = max(0, c - half), min(7, c + size - half)
+        local = (x[..., lo:hi] ** 2).sum(-1)
+        ref[..., c] = x[..., c] / (k + alpha * local) ** beta
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+
+def test_softmax_cross_entropy(rng):
+    logits = rng.randn(6, 5).astype(np.float32)
+    labels = rng.randint(0, 5, 6).astype(np.int32)
+    p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(6), labels])
+    check_forward(loss.softmax_cross_entropy, (logits, labels), ref, rtol=1e-5)
+    check_grad(lambda lg: loss.softmax_cross_entropy(lg, jnp.asarray(labels)),
+               (logits,))
+
+
+def test_cross_entropy_with_probs(rng):
+    logits = rng.randn(4, 3).astype(np.float32)
+    p = (np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)).astype(np.float32)
+    labels = np.array([0, 2, 1, 1], np.int32)
+    ref = -np.log(p[np.arange(4), labels] + 1e-8)
+    check_forward(loss.cross_entropy_with_probs, (p, labels), ref, rtol=1e-5)
+
+
+def test_square_error(rng):
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(3, 4).astype(np.float32)
+    ref = 0.5 * ((a - b) ** 2).sum(-1)
+    check_forward(loss.square_error, (a, b), ref, rtol=1e-5)
+    check_grad(loss.square_error, (a, b), wrt=0)
+
+
+def test_bce_and_multibinary(rng):
+    p = rng.rand(4, 3).astype(np.float32) * 0.9 + 0.05
+    y = (rng.rand(4, 3) > 0.5).astype(np.float32)
+    ref = -(y * np.log(p + 1e-8) + (1 - y) * np.log(1 - p + 1e-8))
+    check_forward(loss.binary_cross_entropy, (p, y), ref, rtol=1e-4)
+    check_forward(loss.multi_binary_cross_entropy, (p, y), ref.sum(-1), rtol=1e-4)
+
+
+def test_rank_cost(rng):
+    l = rng.randn(5, 1).astype(np.float32)
+    r = rng.randn(5, 1).astype(np.float32)
+    y = (rng.rand(5) > 0.5).astype(np.float32)
+    o = (l - r)[:, 0]
+    ref = np.log1p(np.exp(o)) - o * y
+    check_forward(loss.rank_cost, (l, r, y), ref, rtol=1e-5)
+
+
+def test_huber_hinge(rng):
+    pred = rng.randn(6, 1).astype(np.float32)
+    lab = (rng.rand(6) > 0.5).astype(np.float32)
+    y = 2 * lab - 1
+    a = y * pred[:, 0]
+    ref_huber = np.where(a < -1, -4 * a, np.where(a < 1, (1 - a) ** 2, 0.0))
+    check_forward(loss.huber_classification, (pred, lab), ref_huber, rtol=1e-5)
+    ref_hinge = np.maximum(0, 1 - a)
+    check_forward(loss.hinge, (pred, lab), ref_hinge, rtol=1e-5)

@@ -1,0 +1,123 @@
+"""Tests: ops.rnn LSTM/GRU/simple RNN vs step-by-step numpy references."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import rnn
+from tests.op_test_util import check_grad
+
+
+def _sigmoid(x):
+    return 1 / (1 + np.exp(-x))
+
+
+def _np_lstm(x, lens, w_ih, w_hh, b):
+    bsz, tmax, _ = x.shape
+    H = w_hh.shape[0]
+    h = np.zeros((bsz, H))
+    c = np.zeros((bsz, H))
+    outs = np.zeros((bsz, tmax, H))
+    for t in range(tmax):
+        gates = x[:, t] @ w_ih + h @ w_hh + b
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        i, f, o = _sigmoid(i), _sigmoid(f), _sigmoid(o)
+        g = np.tanh(g)
+        nc = f * c + i * g
+        nh = o * np.tanh(nc)
+        alive = (t < lens)[:, None]
+        c = np.where(alive, nc, c)
+        h = np.where(alive, nh, h)
+        outs[:, t] = np.where(alive, nh, 0)
+    return outs, h, c
+
+
+def test_lstm_matches_numpy(rng):
+    bsz, tmax, d, H = 3, 6, 5, 4
+    lens = np.array([6, 3, 1], np.int32)
+    x = rng.randn(bsz, tmax, d).astype(np.float32)
+    w_ih = (rng.randn(d, 4 * H) * 0.3).astype(np.float32)
+    w_hh = (rng.randn(H, 4 * H) * 0.3).astype(np.float32)
+    b = (rng.randn(4 * H) * 0.1).astype(np.float32)
+    outs, final = rnn.lstm(jnp.asarray(x), jnp.asarray(lens), jnp.asarray(w_ih),
+                           jnp.asarray(w_hh), jnp.asarray(b))
+    ref_o, ref_h, ref_c = _np_lstm(x, lens, w_ih, w_hh, b)
+    np.testing.assert_allclose(np.asarray(outs), ref_o, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(final.h), ref_h, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(final.c), ref_c, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_reverse_state_is_first_step(rng):
+    bsz, tmax, d, H = 2, 5, 3, 4
+    lens = np.array([4, 2], np.int32)
+    x = rng.randn(bsz, tmax, d).astype(np.float32)
+    w_ih = (rng.randn(d, 4 * H) * 0.3).astype(np.float32)
+    w_hh = (rng.randn(H, 4 * H) * 0.3).astype(np.float32)
+    outs, final = rnn.lstm(jnp.asarray(x), jnp.asarray(lens), jnp.asarray(w_ih),
+                           jnp.asarray(w_hh), None, reverse=True)
+    outs = np.asarray(outs)
+    # reverse scan: output at t=0 equals final hidden state
+    np.testing.assert_allclose(outs[0, 0], np.asarray(final.h)[0], rtol=1e-5)
+    # outputs past each length are zero
+    assert np.abs(outs[1, 2:]).max() == 0
+
+
+def test_lstm_grad(rng):
+    bsz, tmax, d, H = 2, 3, 3, 2
+    lens = np.array([3, 2], np.int32)
+    x = rng.randn(bsz, tmax, d).astype(np.float32)
+    w_ih = (rng.randn(d, 4 * H) * 0.3).astype(np.float32)
+    w_hh = (rng.randn(H, 4 * H) * 0.3).astype(np.float32)
+
+    def f(xa, wa, wb):
+        outs, _ = rnn.lstm(xa, jnp.asarray(lens), wa, wb, None)
+        return outs
+
+    check_grad(f, (x, w_ih, w_hh), wrt=0)
+    check_grad(f, (x, w_ih, w_hh), wrt=2)
+
+
+def _np_gru(x, lens, w_ih, w_hh):
+    bsz, tmax, _ = x.shape
+    H = w_hh.shape[0]
+    h = np.zeros((bsz, H))
+    outs = np.zeros((bsz, tmax, H))
+    for t in range(tmax):
+        xp = x[:, t] @ w_ih
+        xr, xu, xc = np.split(xp, 3, axis=-1)
+        hr = h @ w_hh[:, :H]
+        hu = h @ w_hh[:, H:2 * H]
+        r, u = _sigmoid(xr + hr), _sigmoid(xu + hu)
+        c = np.tanh(xc + (r * h) @ w_hh[:, 2 * H:])
+        nh = u * h + (1 - u) * c
+        alive = (t < lens)[:, None]
+        h = np.where(alive, nh, h)
+        outs[:, t] = np.where(alive, nh, 0)
+    return outs, h
+
+
+def test_gru_matches_numpy(rng):
+    bsz, tmax, d, H = 2, 4, 3, 5
+    lens = np.array([4, 2], np.int32)
+    x = rng.randn(bsz, tmax, d).astype(np.float32)
+    w_ih = (rng.randn(d, 3 * H) * 0.3).astype(np.float32)
+    w_hh = (rng.randn(H, 3 * H) * 0.3).astype(np.float32)
+    outs, final = rnn.gru(jnp.asarray(x), jnp.asarray(lens), jnp.asarray(w_ih),
+                          jnp.asarray(w_hh))
+    ref_o, ref_h = _np_gru(x, lens, w_ih, w_hh)
+    np.testing.assert_allclose(np.asarray(outs), ref_o, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(final), ref_h, rtol=1e-4, atol=1e-5)
+
+
+def test_simple_rnn(rng):
+    bsz, tmax, d, H = 2, 3, 4, 3
+    lens = np.array([3, 1], np.int32)
+    x = rng.randn(bsz, tmax, d).astype(np.float32)
+    w_ih = (rng.randn(d, H) * 0.3).astype(np.float32)
+    w_hh = (rng.randn(H, H) * 0.3).astype(np.float32)
+    outs, final = rnn.simple_rnn(jnp.asarray(x), jnp.asarray(lens),
+                                 jnp.asarray(w_ih), jnp.asarray(w_hh))
+    h = np.zeros((bsz, H))
+    for t in range(tmax):
+        nh = np.tanh(x[:, t] @ w_ih + h @ w_hh)
+        h = np.where((t < lens)[:, None], nh, h)
+    np.testing.assert_allclose(np.asarray(final), h, rtol=1e-4, atol=1e-5)

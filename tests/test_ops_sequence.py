@@ -1,0 +1,102 @@
+"""Tests: ops.sequence masked segment ops vs per-sequence numpy references."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import sequence as seq
+
+
+def _mk(rng, lens, d=4):
+    t = max(lens) + 2  # deliberately over-padded
+    x = rng.randn(len(lens), t, d).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(np.array(lens, np.int32)), x
+
+
+def test_pools(rng):
+    x, lens, xn = _mk(rng, [3, 5, 1])
+    for fn, ref in [
+        (seq.seq_sum, lambda r, n: r[:n].sum(0)),
+        (seq.seq_avg, lambda r, n: r[:n].mean(0)),
+        (seq.seq_sqrt, lambda r, n: r[:n].sum(0) / np.sqrt(n)),
+        (seq.seq_max, lambda r, n: r[:n].max(0)),
+        (seq.seq_last, lambda r, n: r[n - 1]),
+        (seq.seq_first, lambda r, n: r[0]),
+    ]:
+        out = np.asarray(fn(x, lens))
+        for i, n in enumerate([3, 5, 1]):
+            np.testing.assert_allclose(out[i], ref(xn[i], n), rtol=1e-5,
+                                       err_msg=str(fn))
+
+
+def test_seq_softmax(rng):
+    x, lens, xn = _mk(rng, [3, 5], d=1)
+    out = np.asarray(seq.seq_softmax(x, lens))[..., 0]
+    for i, n in enumerate([3, 5]):
+        e = np.exp(xn[i, :n, 0] - xn[i, :n, 0].max())
+        np.testing.assert_allclose(out[i, :n], e / e.sum(), rtol=1e-5)
+        assert np.abs(out[i, n:]).max() == 0
+
+
+def test_seq_reverse(rng):
+    x, lens, xn = _mk(rng, [3, 5])
+    out = np.asarray(seq.seq_reverse(x, lens))
+    np.testing.assert_allclose(out[0, :3], xn[0, :3][::-1], rtol=1e-6)
+    np.testing.assert_allclose(out[1, :5], xn[1, :5][::-1], rtol=1e-6)
+    # padding region untouched positions map to themselves
+    np.testing.assert_allclose(out[0, 3:], xn[0, 3:], rtol=1e-6)
+
+
+def test_seq_expand(rng):
+    v = rng.randn(2, 4).astype(np.float32)
+    lens = jnp.asarray(np.array([2, 3], np.int32))
+    out = np.asarray(seq.seq_expand(jnp.asarray(v), lens, 5))
+    np.testing.assert_allclose(out[0, :2], np.tile(v[0], (2, 1)), rtol=1e-6)
+    assert np.abs(out[0, 2:]).max() == 0
+    np.testing.assert_allclose(out[1, :3], np.tile(v[1], (3, 1)), rtol=1e-6)
+
+
+def test_context_projection(rng):
+    x, lens, xn = _mk(rng, [4, 2], d=3)
+    out = np.asarray(seq.context_projection(x, lens, context_len=3,
+                                            context_start=-1))
+    # sequence 0, t=0: [zero, x0, x1]
+    np.testing.assert_allclose(out[0, 0, :3], 0, atol=1e-6)
+    np.testing.assert_allclose(out[0, 0, 3:6], xn[0, 0], rtol=1e-6)
+    np.testing.assert_allclose(out[0, 0, 6:9], xn[0, 1], rtol=1e-6)
+    # sequence 0, t=3 (last): [x2, x3, zero]
+    np.testing.assert_allclose(out[0, 3, :3], xn[0, 2], rtol=1e-6)
+    np.testing.assert_allclose(out[0, 3, 3:6], xn[0, 3], rtol=1e-6)
+    np.testing.assert_allclose(out[0, 3, 6:9], 0, atol=1e-6)
+    # sequence 1 has len 2: t=1 -> [x0, x1, zero]
+    np.testing.assert_allclose(out[1, 1, 6:9], 0, atol=1e-6)
+
+
+def test_row_conv(rng):
+    x, lens, xn = _mk(rng, [4], d=2)
+    w = rng.randn(2, 2).astype(np.float32)
+    out = np.asarray(seq.row_conv(x, lens, jnp.asarray(w)))
+    # t=0: x0*w0 + x1*w1
+    np.testing.assert_allclose(out[0, 0], xn[0, 0] * w[0] + xn[0, 1] * w[1],
+                               rtol=1e-5)
+    # t=3 (last): only x3*w0
+    np.testing.assert_allclose(out[0, 3], xn[0, 3] * w[0], rtol=1e-5)
+
+
+def test_kmax_scores(rng):
+    s = np.array([[0.1, 0.9, 0.5, 99.0], [0.3, 0.2, 0.0, 0.0]], np.float32)
+    lens = jnp.asarray(np.array([3, 2], np.int32))
+    idx = np.asarray(seq.kmax_score_indices(jnp.asarray(s), lens, 2))
+    assert list(idx[0]) == [1, 2]  # 99.0 at t=3 is padding, excluded
+    assert list(idx[1]) == [0, 1]
+
+
+def test_seq_concat(rng):
+    x, xl, xn = _mk(rng, [2, 3], d=2)
+    y, yl, yn = _mk(rng, [1, 2], d=2)
+    out, lens = seq.seq_concat(x, xl, y, yl)
+    out = np.asarray(out)
+    assert list(np.asarray(lens)) == [3, 5]
+    np.testing.assert_allclose(out[0, :2], xn[0, :2], rtol=1e-6)
+    np.testing.assert_allclose(out[0, 2], yn[0, 0], rtol=1e-6)
+    assert np.abs(out[0, 3:]).max() == 0
+    np.testing.assert_allclose(out[1, 3:5], yn[1, :2], rtol=1e-6)
