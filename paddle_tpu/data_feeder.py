@@ -1,0 +1,90 @@
+"""Host-side batch assembly: python samples → device-ready Values.
+
+Reference: python/paddle/v2/data_feeder.py:28 (DataFeeder → Arguments) and
+py_paddle/dataprovider_converter.py — converts per-slot python data
+(dense / sparse / index, with optional sequence nesting per
+PyDataProvider2.py:109-250) into the engine's input structures.
+
+TPU-native: everything becomes padded/bucketed numpy, so batch shapes come
+from a small fixed set and XLA compiles once per bucket:
+- DENSE           -> [b, dim] float32
+- INDEX           -> [b] int32
+- DENSE seq       -> [b, T] + lengths (T bucketed)
+- INDEX seq       -> [b, T] int32 + lengths
+- SPARSE_*        -> indices [b, K] + weights [b, K] (K bucketed nonzeros)
+"""
+
+from typing import Dict, List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.ragged import DEFAULT_BUCKETS, SequenceBatch, bucket_length
+from paddle_tpu.data_type import InputType, Kind, SeqLevel
+from paddle_tpu.topology import Value
+
+
+class DataFeeder:
+    def __init__(self, data_types: Dict[str, InputType],
+                 feeding: Dict[str, int] = None, buckets=DEFAULT_BUCKETS):
+        """data_types: layer name -> InputType; feeding: name -> index in the
+        sample tuple (defaults to declaration order)."""
+        self.data_types = data_types
+        names = list(data_types)
+        self.feeding = feeding or {n: i for i, n in enumerate(names)}
+        self.buckets = buckets
+
+    def __call__(self, batch: Sequence) -> Dict[str, Value]:
+        return self.feed(batch)
+
+    def feed(self, batch: Sequence) -> Dict[str, Value]:
+        feeds = {}
+        for name, itype in self.data_types.items():
+            col = [sample[self.feeding[name]] for sample in batch]
+            feeds[name] = self._convert(col, itype)
+        return feeds
+
+    def _convert(self, col: List, itype: InputType) -> Value:
+        if itype.seq == SeqLevel.NO_SEQUENCE:
+            if itype.kind == Kind.DENSE:
+                return Value(jnp.asarray(np.asarray(col, np.float32)))
+            if itype.kind == Kind.INDEX:
+                return Value(jnp.asarray(np.asarray(col, np.int32)))
+            return self._sparse(col, itype)
+        if itype.seq == SeqLevel.SUB_SEQUENCE:
+            if itype.kind == Kind.INDEX:
+                sb = SequenceBatch.from_nested_list(
+                    [[np.asarray(s, np.int32) for s in subs] for subs in col],
+                    self.buckets)
+            else:
+                sb = SequenceBatch.from_nested_list(
+                    [[np.asarray(s, np.float32) for s in subs] for subs in col],
+                    self.buckets)
+            return Value(sb.data, sb.lengths, sb.sub_lengths)
+        # SEQUENCE
+        if itype.kind == Kind.INDEX:
+            sb = SequenceBatch.from_list([np.asarray(s, np.int32) for s in col],
+                                         self.buckets)
+        elif itype.kind == Kind.DENSE:
+            sb = SequenceBatch.from_list([np.asarray(s, np.float32) for s in col],
+                                         self.buckets)
+        else:
+            raise NotImplementedError("sparse sequences not yet supported")
+        return Value(sb.data, sb.lengths)
+
+    def _sparse(self, col, itype) -> Value:
+        """sparse_binary_vector: sample is a list of indices;
+        sparse_float_vector: list of (index, value)."""
+        k = bucket_length(max((len(s) for s in col), default=1), self.buckets)
+        ids = np.zeros((len(col), k), np.int32)
+        w = np.zeros((len(col), k), np.float32)
+        for i, s in enumerate(col):
+            if itype.kind == Kind.SPARSE_BINARY:
+                idx = list(s)
+                vals = [1.0] * len(idx)
+            else:
+                idx = [p[0] for p in s]
+                vals = [p[1] for p in s]
+            ids[i, : len(idx)] = idx
+            w[i, : len(vals)] = vals
+        return Value(jnp.asarray(ids), weights=jnp.asarray(w))

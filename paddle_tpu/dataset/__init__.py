@@ -1,0 +1,18 @@
+"""Datasets (reference: python/paddle/v2/dataset/ — mnist, cifar, imdb,
+imikolov, movielens, conll05, uci_housing, wmt14, ...).
+
+This environment has no network egress, so each dataset module follows the
+reference's download-cache protocol (common.py:62) but falls back to a
+deterministic synthetic generator with identical sample schema when no cache
+is present — training plumbing, shapes, and convergence behaviour stay
+testable offline; drop real files into ~/.cache/paddle_tpu/dataset to use
+real data.
+"""
+
+from paddle_tpu.dataset import common
+from paddle_tpu.dataset import mnist
+from paddle_tpu.dataset import cifar
+from paddle_tpu.dataset import uci_housing
+from paddle_tpu.dataset import imdb
+from paddle_tpu.dataset import imikolov
+from paddle_tpu.dataset import synthetic

@@ -1,0 +1,43 @@
+"""Dataset cache helpers (reference: python/paddle/v2/dataset/common.py:62 —
+download cache under ~/.cache/paddle/dataset, md5 check, cluster file split)."""
+
+import hashlib
+import os
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def cache_path(module: str, filename: str) -> str:
+    return os.path.join(DATA_HOME, module, filename)
+
+
+def cached_file(module: str, filename: str, md5=None):
+    """Return the cached path if present (and md5-valid), else None.
+    (No download: this environment has no egress; the reference's download()
+    lives here in spirit.)"""
+    path = cache_path(module, filename)
+    if not os.path.exists(path):
+        return None
+    if md5:
+        h = hashlib.md5()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        if h.hexdigest() != md5:
+            return None
+    return path
+
+
+def split(reader_fn, line_count, suffix_formatter=None):
+    """Cluster file split helper (reference: common.py split/cluster_files) —
+    partition a reader into chunks for the task-dispatch data service."""
+    chunks, current = [], []
+    for sample in reader_fn():
+        current.append(sample)
+        if len(current) >= line_count:
+            chunks.append(current)
+            current = []
+    if current:
+        chunks.append(current)
+    return chunks

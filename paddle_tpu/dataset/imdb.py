@@ -1,0 +1,22 @@
+"""IMDB sentiment (reference: python/paddle/v2/dataset/imdb.py — tokenized
+reviews as word-id sequences + binary label)."""
+
+from paddle_tpu.dataset import synthetic
+
+VOCAB_SIZE = 5000
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(VOCAB_SIZE)}
+
+
+def train(word_idx=None):
+    n = len(word_idx) if word_idx else VOCAB_SIZE
+    return synthetic.sequence_classification(4096, n, 2, seed=21,
+                                             min_len=8, max_len=60)
+
+
+def test(word_idx=None):
+    n = len(word_idx) if word_idx else VOCAB_SIZE
+    return synthetic.sequence_classification(512, n, 2, seed=211,
+                                             min_len=8, max_len=60)

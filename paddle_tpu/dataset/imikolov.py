@@ -1,0 +1,36 @@
+"""PTB language-model n-grams (reference: python/paddle/v2/dataset/imikolov.py
+— n-gram windows of word ids for word2vec-style training)."""
+
+import numpy as np
+
+from paddle_tpu.dataset import synthetic
+
+VOCAB_SIZE = 2000
+
+
+def build_dict():
+    return {f"w{i}": i for i in range(VOCAB_SIZE)}
+
+
+def train(word_idx=None, n=5):
+    vocab = len(word_idx) if word_idx else VOCAB_SIZE
+    seq = synthetic.sequence_classification(2048, vocab, 2, seed=31,
+                                            min_len=n + 2, max_len=40)
+
+    def reader():
+        for toks, _ in seq():
+            for i in range(len(toks) - n + 1):
+                yield tuple(toks[i:i + n])
+    return reader
+
+
+def test(word_idx=None, n=5):
+    vocab = len(word_idx) if word_idx else VOCAB_SIZE
+    seq = synthetic.sequence_classification(256, vocab, 2, seed=311,
+                                            min_len=n + 2, max_len=40)
+
+    def reader():
+        for toks, _ in seq():
+            for i in range(len(toks) - n + 1):
+                yield tuple(toks[i:i + n])
+    return reader

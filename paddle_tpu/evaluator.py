@@ -1,0 +1,169 @@
+"""Evaluators — training/test metrics.
+
+Reference: paddle/gserver/evaluators/Evaluator.h:42 hierarchy (classification
+error, precision/recall, AUC, chunk-F1, CTC error, ...) wrapped by
+python/paddle/v2/evaluator.py. Design here: an evaluator is a LayerOutput
+emitting a small vector of *accumulables* per batch (device-side, inside the
+jitted step), plus a host-side finalize() that turns summed accumulables into
+the metric — so metric math rides the same traced program and only a few
+scalars cross the host boundary each batch.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu.topology import LayerOutput, Value, auto_name
+
+
+class MetricAccumulator:
+    """Host-side accumulator over batch accumulable vectors."""
+
+    def __init__(self, name, finalize_fn, width):
+        self.name = name
+        self.finalize_fn = finalize_fn
+        self.width = width
+        self.total = None
+
+    def reset(self):
+        self.total = None
+
+    def add(self, vec):
+        import numpy as np
+        vec = np.asarray(vec, np.float64)
+        self.total = vec if self.total is None else self.total + vec
+
+    def value(self):
+        if self.total is None:
+            return float("nan")
+        return self.finalize_fn(self.total)
+
+
+def _evaluator_layer(name, etype, inputs, accum_fn, finalize_fn, width):
+    def fwd(params, parents, ctx):
+        return Value(accum_fn(params, parents, ctx))
+    lo = LayerOutput(name, etype, inputs, fwd, [], size=width)
+    lo.metric_finalize = finalize_fn
+    lo.metric_width = width
+    return lo
+
+
+def classification_error(input, label, name: Optional[str] = None, top_k=1):
+    """Error rate (reference: ClassificationErrorEvaluator, Evaluator.cpp).
+    Accumulables: [#wrong, #examples]. Sequence inputs count per-token."""
+    name = name or auto_name("classification_error_evaluator")
+
+    def accum(params, parents, ctx):
+        pv, lv = parents
+        pred, lab = pv.array, lv.array
+        if pv.is_sequence:
+            mask = (jnp.arange(pred.shape[1])[None, :] <
+                    pv.lengths[:, None]).astype(jnp.float32)
+            lab2 = lab if lab.ndim == 2 else lab.reshape(lab.shape[0], -1)
+            wrong = (jnp.argmax(pred, -1) != lab2).astype(jnp.float32) * mask
+            return jnp.stack([wrong.sum(), mask.sum()])
+        lab1 = lab.reshape(-1)
+        if top_k == 1:
+            wrong = (jnp.argmax(pred, -1) != lab1).astype(jnp.float32)
+        else:
+            topi = jnp.argsort(-pred, axis=-1)[:, :top_k]
+            wrong = 1.0 - jnp.any(topi == lab1[:, None], axis=-1
+                                  ).astype(jnp.float32)
+        return jnp.stack([wrong.sum(), jnp.full((), wrong.shape[0],
+                                                jnp.float32)])
+
+    return _evaluator_layer(name, "classification_error", [input, label],
+                            accum, lambda t: t[0] / max(t[1], 1), 2)
+
+
+def precision_recall(input, label, name: Optional[str] = None,
+                     positive_label=1):
+    """Binary precision/recall/F1 (reference: PrecisionRecallEvaluator).
+    Accumulables: [tp, fp, fn]."""
+    name = name or auto_name("precision_recall_evaluator")
+
+    def accum(params, parents, ctx):
+        pred = jnp.argmax(parents[0].array, -1)
+        lab = parents[1].array.reshape(-1)
+        pos = pred == positive_label
+        truth = lab == positive_label
+        tp = jnp.sum(pos & truth).astype(jnp.float32)
+        fp = jnp.sum(pos & ~truth).astype(jnp.float32)
+        fn = jnp.sum(~pos & truth).astype(jnp.float32)
+        return jnp.stack([tp, fp, fn])
+
+    def fin(t):
+        tp, fp, fn = t
+        p = tp / max(tp + fp, 1e-12)
+        r = tp / max(tp + fn, 1e-12)
+        return {"precision": p, "recall": r,
+                "f1": 2 * p * r / max(p + r, 1e-12)}
+
+    return _evaluator_layer(name, "precision_recall", [input, label],
+                            accum, fin, 3)
+
+
+def auc(input, label, name: Optional[str] = None, num_thresholds=200):
+    """Binned AUC (reference: AucEvaluator — bucketed ROC like the original;
+    operators/auc_op.cc). Accumulables: [pos_hist..., neg_hist...]."""
+    name = name or auto_name("auc_evaluator")
+
+    def accum(params, parents, ctx):
+        probs = parents[0].array
+        # positive-class probability: column 1 of softmax output, or the
+        # single sigmoid output
+        p = probs[:, 1] if probs.shape[-1] >= 2 else probs[:, 0]
+        lab = parents[1].array.reshape(-1).astype(jnp.float32)
+        bins = jnp.clip((p * num_thresholds).astype(jnp.int32), 0,
+                        num_thresholds - 1)
+        pos = jnp.zeros(num_thresholds).at[bins].add(lab)
+        neg = jnp.zeros(num_thresholds).at[bins].add(1.0 - lab)
+        return jnp.concatenate([pos, neg])
+
+    def fin(t):
+        import numpy as np
+        pos, neg = t[:num_thresholds], t[num_thresholds:]
+        # sweep thresholds high->low accumulating TPR/FPR, trapezoid rule
+        tp = np.cumsum(pos[::-1])
+        fp = np.cumsum(neg[::-1])
+        tot_p, tot_n = max(tp[-1], 1e-12), max(fp[-1], 1e-12)
+        tpr = np.concatenate([[0.0], tp / tot_p])
+        fpr = np.concatenate([[0.0], fp / tot_n])
+        return float(np.trapezoid(tpr, fpr))
+
+    return _evaluator_layer(name, "auc", [input, label], accum, fin,
+                            2 * num_thresholds)
+
+
+def sum_cost(input, name: Optional[str] = None):
+    """(reference: SumCostEvaluator) Accumulables: [sum, count]."""
+    name = name or auto_name("sum_evaluator")
+
+    def accum(params, parents, ctx):
+        v = parents[0].array.astype(jnp.float32)
+        return jnp.stack([v.sum(), jnp.full((), v.shape[0], jnp.float32)])
+
+    return _evaluator_layer(name, "sum_cost", [input], accum,
+                            lambda t: t[0] / max(t[1], 1), 2)
+
+
+class EvaluatorSet:
+    """Host-side bundle the trainer drives (reset per pass / per test)."""
+
+    def __init__(self, layers):
+        self.layers = [l for l in layers if hasattr(l, "metric_finalize")]
+        self.accs = {l.name: MetricAccumulator(l.name, l.metric_finalize,
+                                               l.metric_width)
+                     for l in self.layers}
+
+    def reset(self):
+        for a in self.accs.values():
+            a.reset()
+
+    def add_batch(self, outputs):
+        for l in self.layers:
+            if l.name in outputs:
+                self.accs[l.name].add(outputs[l.name].array)
+
+    def result(self):
+        return {name: acc.value() for name, acc in self.accs.items()}

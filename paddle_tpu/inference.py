@@ -1,0 +1,48 @@
+"""Inference API (reference: python/paddle/v2/inference.py:10,111 —
+Inference prunes the topology to the output layer and runs
+forward-only; paddle.infer is the one-call surface)."""
+
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.parameters import Parameters
+from paddle_tpu.topology import LayerOutput, Topology
+
+
+class Inference:
+    def __init__(self, output_layer, parameters: Parameters):
+        outputs = output_layer if isinstance(output_layer, (list, tuple)) \
+            else [output_layer]
+        self.topology = Topology(list(outputs))
+        self._forward = jax.jit(
+            lambda params, state, feeds: self.topology.compile()(
+                params, state, feeds, is_training=False)[0])
+        self.parameters = parameters
+
+    def iter_infer(self, input, feeding=None, batch_size=None):
+        dtypes = {l.name: l.data_spec for l in self.topology.data_layers}
+        feeder = DataFeeder(dtypes, feeding)
+        batch_size = batch_size or len(input)
+        for i in range(0, len(input), batch_size):
+            feeds = feeder.feed(input[i:i + batch_size])
+            outs = self._forward(self.parameters.values,
+                                 self.parameters.state, feeds)
+            yield [np.asarray(outs[o.name].array)
+                   for o in self.topology.outputs]
+
+    def infer(self, input, field="value", feeding=None, batch_size=None):
+        chunks = list(self.iter_infer(input, feeding, batch_size))
+        n_out = len(self.topology.outputs)
+        results = [np.concatenate([c[i] for c in chunks], axis=0)
+                   for i in range(n_out)]
+        return results[0] if n_out == 1 else results
+
+
+def infer(output_layer, parameters, input, feeding=None, field="value",
+          batch_size=None):
+    """paddle.infer (reference: inference.py:111)."""
+    return Inference(output_layer, parameters).infer(
+        input, field=field, feeding=feeding, batch_size=batch_size)

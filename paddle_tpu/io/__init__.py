@@ -1,0 +1,6 @@
+"""Checkpointing and model artifacts (reference: trainer/ParamUtil.cpp
+per-pass save dirs, v2 parameters.to_tar, operators/save_op.cc/load_op.cc,
+trainer/MergeModel.cpp)."""
+
+from paddle_tpu.io.checkpoint import (load_checkpoint, save_checkpoint,
+                                      latest_checkpoint)

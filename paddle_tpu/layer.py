@@ -1,0 +1,815 @@
+"""The declarative layer API — the v2 capability surface.
+
+Reference: python/paddle/trainer_config_helpers/layers.py (7,144 LoC of config
+functions) + python/paddle/v2/layer.py (auto-wrapping into v2). Each function
+here returns a LayerOutput node holding parameter specs and a pure forward
+callable; paddle_tpu.topology.Topology compiles the graph into one traced
+function (no protobuf, no config parser — the Python call graph IS the
+config).
+
+Image tensors follow the reference's flat-CHW convention at the data boundary
+(config_parser stored images as channel-major flat vectors) but flow as NHWC
+internally — TPU-native layout.
+"""
+
+import math
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import activation as act_mod
+from paddle_tpu import pooling as pooling_mod
+from paddle_tpu.core.param import ParamAttr, ParamSpec
+from paddle_tpu.ops import activations as ops_act
+from paddle_tpu.ops import conv as ops_conv
+from paddle_tpu.ops import loss as ops_loss
+from paddle_tpu.ops import norm as ops_norm
+from paddle_tpu.ops import pool as ops_pool
+from paddle_tpu.ops import rnn as ops_rnn
+from paddle_tpu.ops import sequence as ops_seq
+from paddle_tpu.ops import sparse as ops_sparse
+from paddle_tpu.ops import topk as ops_topk
+from paddle_tpu.ops.math import linear as ops_linear, matmul
+from paddle_tpu.topology import LayerOutput, Value, auto_name
+from paddle_tpu.utils import enforce
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _param_attr(attr, default_name) -> ParamAttr:
+    attr = attr or ParamAttr()
+    if attr.name is None:
+        attr = type(attr)(**{**attr.__dict__, "name": default_name})
+    return attr
+
+
+def _bias_spec(name, size, bias_attr) -> Optional[ParamSpec]:
+    """bias_attr False disables bias (reference convention)."""
+    if bias_attr is False:
+        return None
+    attr = bias_attr if isinstance(bias_attr, ParamAttr) else ParamAttr(
+        initializer="constant", initial_value=0.0)
+    attr = _param_attr(attr, f"{name}.b")
+    return ParamSpec(attr.name, (size,), attr=attr)
+
+
+def _apply_act(value: Value, act_name: str) -> Value:
+    if act_name == "sequence_softmax":
+        enforce.enforce(value.is_sequence, "sequence_softmax needs sequence input")
+        return value.with_array(ops_seq.seq_softmax(value.array, value.lengths))
+    return value.with_array(ops_act.get(act_name)(value.array))
+
+
+def _flatten_if_image(x: jax.Array) -> jax.Array:
+    """FC over a conv output: flatten NHWC back to CHW-flat so parameter
+    layouts match the reference's channel-major convention."""
+    if x.ndim == 4:
+        n = x.shape[0]
+        return jnp.transpose(x, (0, 3, 1, 2)).reshape(n, -1)
+    return x
+
+
+def _feat_size(x: jax.Array) -> int:
+    if x.ndim == 4:
+        return int(x.shape[1] * x.shape[2] * x.shape[3])
+    return int(x.shape[-1])
+
+
+# ---------------------------------------------------------------------------
+# data
+# ---------------------------------------------------------------------------
+
+def data(name: str, type):
+    """Input declaration (reference: v2 layer.data / DataConfig)."""
+    return LayerOutput(name, "data", [], fn=None, size=type.dim, is_data=True,
+                       data_spec=type)
+
+
+# ---------------------------------------------------------------------------
+# fc / embedding / mixed-style projections
+# ---------------------------------------------------------------------------
+
+def fc(input, size: int, act=None, name: Optional[str] = None,
+       param_attr=None, bias_attr=None):
+    """Fully-connected over one or more inputs (summed), mirroring
+    fc_layer's multi-input form (reference: trainer_config_helpers/layers.py
+    fc_layer; gserver/layers/FullyConnectedLayer.cpp)."""
+    name = name or auto_name("fc")
+    inputs = _as_list(input)
+    act_name = act_mod.resolve(act)
+    attrs = _as_list(param_attr) if isinstance(param_attr, (list, tuple)) \
+        else [param_attr] * len(inputs)
+    specs = []
+    for i, (inp, attr) in enumerate(zip(inputs, attrs)):
+        suffix = f".w{i}" if len(inputs) > 1 else ".w"
+        a = _param_attr(attr if isinstance(attr, ParamAttr) else ParamAttr(),
+                        f"{name}{suffix}")
+        in_size = inp.size
+        specs.append(ParamSpec(a.name, (in_size, size), attr=a, fan_in=in_size))
+    bias = _bias_spec(name, size, bias_attr)
+    if bias:
+        specs.append(bias)
+
+    def fwd(params, parents, ctx):
+        total = None
+        for spec, pv in zip(specs, parents):
+            if pv.is_sparse:
+                # sparse input: gather rows of W by nonzero index and
+                # weight-sum — sparse matmul without materialising the
+                # multi-hot vector (reference: MulOp sparse path,
+                # paddle/function/MulOp.cpp)
+                rows = jnp.take(params[spec.name], pv.array.astype(jnp.int32),
+                                axis=0)                      # [b, k, size]
+                out = jnp.sum(rows * pv.weights[..., None].astype(rows.dtype),
+                              axis=-2)
+            else:
+                x = _flatten_if_image(pv.array)
+                out = matmul(x, params[spec.name])
+            total = out if total is None else total + out
+        if bias:
+            total = total + params[bias.name].astype(total.dtype)
+        v = Value(total, parents[0].lengths, parents[0].sub_lengths)
+        return _apply_act(v, act_name)
+
+    return LayerOutput(name, "fc", inputs, fwd, specs, size=size,
+                       activation=act_name)
+
+
+def embedding(input, size: int, name: Optional[str] = None, param_attr=None,
+              padding_idx: Optional[int] = None):
+    """Embedding lookup (reference: v2 layer.embedding / TableProjection /
+    operators/lookup_table_op.cc)."""
+    name = name or auto_name("embedding")
+    a = _param_attr(param_attr or ParamAttr(), f"{name}.w")
+    vocab = input.size
+    spec = ParamSpec(a.name, (vocab, size), attr=a, fan_in=size)
+
+    def fwd(params, parents, ctx):
+        pv = parents[0]
+        out = ops_sparse.embedding_lookup(params[spec.name], pv.array,
+                                          padding_idx)
+        return Value(out, pv.lengths, pv.sub_lengths)
+
+    return LayerOutput(name, "embedding", [input], fwd, [spec], size=size)
+
+
+# ---------------------------------------------------------------------------
+# images
+# ---------------------------------------------------------------------------
+
+def _to_nhwc(x: jax.Array, channels: int, img_h: Optional[int],
+             img_w: Optional[int]) -> jax.Array:
+    if x.ndim == 4:
+        return x
+    n, flat = x.shape
+    if img_h is None:
+        side = int(math.isqrt(flat // channels))
+        img_h = img_w = side
+    x = x.reshape(n, channels, img_h, img_w)      # reference flat layout: CHW
+    return jnp.transpose(x, (0, 2, 3, 1))
+
+
+def _infer_img_shape(input, cin, img_size):
+    """Static (H, W) of a layer's image input — the config_parser equivalent
+    (reference: python/paddle/trainer/config_parser.py ConvConfig/ImgSize
+    computation; it tracked img dims through every conv/pool)."""
+    if img_size is not None:
+        return (img_size, img_size) if isinstance(img_size, int) \
+            else tuple(img_size)
+    shp = getattr(input, "_img_shape", None)
+    if shp is not None:
+        return shp
+    if input.size and cin:
+        side = int(math.isqrt(input.size // cin))
+        if side * side * cin == input.size:
+            return (side, side)
+    return (None, None)
+
+
+def _conv_out_dim(in_dim, k, s, pad, dilation=1):
+    """Output spatial size, floor mode (matches explicit-pad reduce_window
+    and lax conv arithmetic)."""
+    if in_dim is None:
+        return None
+    eff_k = (k - 1) * dilation + 1
+    if pad == "SAME":
+        return -(-in_dim // s)
+    if pad == "VALID":
+        p0 = p1 = 0
+    elif isinstance(pad, int):
+        p0 = p1 = pad
+    else:
+        p0, p1 = pad
+    return (in_dim + p0 + p1 - eff_k) // s + 1
+
+
+def img_conv(input, filter_size, num_filters: int, num_channels: Optional[int] = None,
+             stride=1, padding=None, groups=1, act=None, name: Optional[str] = None,
+             param_attr=None, bias_attr=None, img_size=None, dilation=1,
+             trans: bool = False):
+    """2-D conv layer (reference: img_conv_layer in
+    trainer_config_helpers/layers.py; gserver/layers/ExpandConvLayer.cpp;
+    operators/conv_op.cc). Accepts flat-CHW or NHWC input; emits NHWC."""
+    name = name or auto_name("img_conv")
+    act_name = act_mod.resolve(act)
+    k = (filter_size, filter_size) if isinstance(filter_size, int) else tuple(filter_size)
+    user_padding = padding
+    if padding is None:
+        padding = ((k[0] - 1) // 2, (k[1] - 1) // 2)  # reference default: same-ish
+    a = _param_attr(param_attr or ParamAttr(initializer="msra"), f"{name}.w")
+    cin = num_channels
+    if cin is None:
+        # infer from parent conv layers; flat data needs explicit channels
+        cin = getattr(input, "_out_channels", None)
+        enforce.enforce(cin is not None,
+                        f"img_conv {name}: num_channels required for flat input")
+    if trans:
+        enforce.enforce(groups == 1 and dilation == 1,
+                        "img_conv trans=True supports groups=1, dilation=1")
+    # HWIO for both directions: lax.conv_transpose takes the same
+    # (kh, kw, cin, cout) filter layout as the forward conv
+    wshape = (k[0], k[1], cin // groups, num_filters)
+    spec = ParamSpec(a.name, wshape, attr=a, fan_in=k[0] * k[1] * (cin // groups))
+    bias = _bias_spec(name, num_filters, bias_attr)
+    specs = [spec] + ([bias] if bias else [])
+    ih, iw = _infer_img_shape(input, cin, img_size)
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    if trans:
+        if user_padding in (None, "SAME"):
+            oh = ih * s[0] if ih else None
+            ow = iw * s[1] if iw else None
+        else:
+            oh = ow = None  # non-SAME transposed shapes resolved at runtime
+    else:
+        pads = padding if isinstance(padding, str) else (
+            (padding, padding) if isinstance(padding, int) else tuple(padding))
+        ph = pads if isinstance(pads, str) else pads[0]
+        pw = pads if isinstance(pads, str) else pads[1]
+        oh = _conv_out_dim(ih, k[0], s[0], ph, dilation)
+        ow = _conv_out_dim(iw, k[1], s[1], pw, dilation)
+
+    def fwd(params, parents, ctx):
+        x = _to_nhwc(parents[0].array, cin, ih, iw)
+        if trans:
+            if user_padding is None:
+                tpad = "SAME"
+            elif isinstance(user_padding, str):
+                tpad = user_padding
+            elif isinstance(user_padding, int):
+                tpad = ((user_padding, user_padding),) * 2
+            else:
+                p = tuple(user_padding)
+                tpad = ((p[0], p[0]), (p[1], p[1])) if isinstance(p[0], int) \
+                    else p
+            out = ops_conv.conv2d_transpose(x, params[spec.name], stride=stride,
+                                            padding=tpad)
+        else:
+            out = ops_conv.conv2d(x, params[spec.name], stride=stride,
+                                  padding=padding, dilation=dilation,
+                                  groups=groups)
+        if bias:
+            out = out + params[bias.name].astype(out.dtype)
+        return _apply_act(Value(out), act_name)
+
+    lo = LayerOutput(name, "img_conv", [input], fwd, specs,
+                     size=oh * ow * num_filters if oh and ow else None,
+                     activation=act_name)
+    lo._out_channels = num_filters
+    lo._img_shape = (oh, ow)
+    return lo
+
+
+def img_pool(input, pool_size, stride=None, padding=0, pool_type=None,
+             num_channels=None, name: Optional[str] = None, img_size=None):
+    """Image pooling (reference: img_pool_layer; gserver PoolLayer.cpp)."""
+    name = name or auto_name("img_pool")
+    ptype = pooling_mod.resolve(pool_type)
+    cin = num_channels or getattr(input, "_out_channels", None)
+    ih, iw = _infer_img_shape(input, cin, img_size)
+    k = (pool_size, pool_size) if isinstance(pool_size, int) else tuple(pool_size)
+    st = stride if stride is not None else pool_size
+    st = (st, st) if isinstance(st, int) else tuple(st)
+    pad = padding
+    oh = _conv_out_dim(ih, k[0], st[0],
+                       pad if isinstance(pad, (str, int)) else pad[0])
+    ow = _conv_out_dim(iw, k[1], st[1],
+                       pad if isinstance(pad, (str, int)) else pad[1])
+
+    def fwd(params, parents, ctx):
+        x = _to_nhwc(parents[0].array, cin, ih, iw)
+        if ptype == "max":
+            out = ops_pool.max_pool2d(x, pool_size, stride=stride, padding=padding)
+        else:
+            out = ops_pool.avg_pool2d(x, pool_size, stride=stride, padding=padding)
+        return Value(out)
+
+    lo = LayerOutput(name, "img_pool", [input], fwd, [],
+                     size=oh * ow * cin if oh and ow and cin else None)
+    lo._out_channels = cin
+    lo._img_shape = (oh, ow)
+    return lo
+
+
+def spp(input, pyramid_height: int, num_channels=None, pool_type=None,
+        name: Optional[str] = None):
+    """Spatial pyramid pooling layer (reference: spp_layer)."""
+    name = name or auto_name("spp")
+    ptype = pooling_mod.resolve(pool_type)
+    cin = num_channels or getattr(input, "_out_channels", None)
+
+    def fwd(params, parents, ctx):
+        x = _to_nhwc(parents[0].array, cin, None, None)
+        return Value(ops_pool.spp(x, pyramid_height, ptype))
+
+    bins = sum(4 ** l for l in range(pyramid_height))
+    return LayerOutput(name, "spp", [input], fwd, [],
+                       size=bins * cin if cin else None)
+
+
+def batch_norm(input, act=None, name: Optional[str] = None, num_channels=None,
+               param_attr=None, bias_attr=None, moving_average_fraction=0.9,
+               epsilon=1e-5):
+    """Batch normalisation with functional running stats (reference:
+    batch_norm_layer; gserver/layers/BatchNormalizationLayer.cpp;
+    operators/batch_norm_op.cc). Stats live in the state pytree keyed
+    '<name>.mean' / '<name>.var'."""
+    name = name or auto_name("batch_norm")
+    act_name = act_mod.resolve(act)
+    cin = num_channels or getattr(input, "_out_channels", None) or input.size
+    ga = _param_attr(param_attr if isinstance(param_attr, ParamAttr) else
+                     ParamAttr(initializer="constant", initial_value=1.0),
+                     f"{name}.gamma")
+    ba = _param_attr(bias_attr if isinstance(bias_attr, ParamAttr) else
+                     ParamAttr(initializer="constant", initial_value=0.0),
+                     f"{name}.beta")
+    gamma = ParamSpec(ga.name, (cin,), attr=ga)
+    beta = ParamSpec(ba.name, (cin,), attr=ba)
+    mean_s = ParamSpec(f"{name}.mean", (cin,),
+                       attr=ParamAttr(initializer="constant", initial_value=0.0))
+    var_s = ParamSpec(f"{name}.var", (cin,),
+                      attr=ParamAttr(initializer="constant", initial_value=1.0))
+
+    def fwd(params, parents, ctx):
+        x = parents[0].array
+        if x.ndim == 2 and x.shape[-1] != cin:
+            # flat CHW image: reshape so stats are per channel
+            x = _to_nhwc(x, cin, None, None)
+        rm = ctx.state_in[mean_s.name]
+        rv = ctx.state_in[var_s.name]
+        if ctx.is_training:
+            y, nm, nv = ops_norm.batch_norm_train(
+                x, params[gamma.name], params[beta.name], rm, rv,
+                momentum=moving_average_fraction, eps=epsilon)
+            ctx.state_out[mean_s.name] = nm
+            ctx.state_out[var_s.name] = nv
+        else:
+            y = ops_norm.batch_norm_infer(x, params[gamma.name],
+                                          params[beta.name], rm, rv, eps=epsilon)
+            ctx.state_out[mean_s.name] = rm
+            ctx.state_out[var_s.name] = rv
+        return _apply_act(Value(y, parents[0].lengths), act_name)
+
+    lo = LayerOutput(name, "batch_norm", [input], fwd, [gamma, beta],
+                     size=input.size, activation=act_name,
+                     state_specs=[mean_s, var_s])
+    lo._out_channels = getattr(input, "_out_channels", None)
+    lo._img_shape = getattr(input, "_img_shape", None)
+    return lo
+
+
+# ---------------------------------------------------------------------------
+# regularisation / elementwise composition
+# ---------------------------------------------------------------------------
+
+def dropout(input, dropout_rate: float, name: Optional[str] = None):
+    """Inverted dropout (reference: dropout_layer / ExtraAttr.drop_rate)."""
+    name = name or auto_name("dropout")
+
+    def fwd(params, parents, ctx):
+        pv = parents[0]
+        if not ctx.is_training or dropout_rate <= 0.0:
+            return pv
+        key = ctx.layer_key(name)
+        enforce.enforce(key is not None,
+                        "dropout in training mode needs a dropout_key")
+        keep = 1.0 - dropout_rate
+        mask = jax.random.bernoulli(key, keep, pv.array.shape)
+        return pv.with_array(jnp.where(mask, pv.array / keep, 0.0))
+
+    return LayerOutput(name, "dropout", [input], fwd, [], size=input.size)
+
+
+def concat(input: Sequence[LayerOutput], name: Optional[str] = None, act=None):
+    """Feature-axis concat (reference: concat_layer)."""
+    name = name or auto_name("concat")
+    act_name = act_mod.resolve(act)
+    inputs = _as_list(input)
+
+    def fwd(params, parents, ctx):
+        arrs = [_flatten_if_image(p.array) if p.array.ndim == 4 else p.array
+                for p in parents]
+        return _apply_act(Value(jnp.concatenate(arrs, axis=-1),
+                                parents[0].lengths), act_name)
+
+    return LayerOutput(name, "concat", inputs, fwd, [],
+                       size=sum(i.size for i in inputs if i.size),
+                       activation=act_name)
+
+
+def addto(input: Sequence[LayerOutput], act=None, name: Optional[str] = None,
+          bias_attr=False):
+    """Elementwise sum (reference: addto_layer; gserver AddtoLayer.cpp)."""
+    name = name or auto_name("addto")
+    act_name = act_mod.resolve(act)
+    inputs = _as_list(input)
+    bias = _bias_spec(name, inputs[0].size, bias_attr) if inputs[0].size else None
+
+    def fwd(params, parents, ctx):
+        total = parents[0].array
+        for p in parents[1:]:
+            total = total + p.array
+        if bias:
+            total = total + params[bias.name].astype(total.dtype)
+        return _apply_act(Value(total, parents[0].lengths), act_name)
+
+    lo = LayerOutput(name, "addto", inputs, fwd, [bias] if bias else [],
+                     size=inputs[0].size, activation=act_name)
+    lo._out_channels = getattr(inputs[0], "_out_channels", None)
+    lo._img_shape = getattr(inputs[0], "_img_shape", None)
+    return lo
+
+
+def scaling(input, weight, name: Optional[str] = None):
+    """Row-wise scale by a scalar per example (reference: scaling_layer)."""
+    name = name or auto_name("scaling")
+
+    def fwd(params, parents, ctx):
+        w, x = parents[0].array, parents[1].array
+        return Value(x * w.reshape(w.shape[0], *([1] * (x.ndim - 1))),
+                     parents[1].lengths)
+
+    return LayerOutput(name, "scaling", [weight, input], fwd, [],
+                       size=input.size)
+
+
+def slope_intercept(input, slope=1.0, intercept=0.0, name: Optional[str] = None):
+    """y = slope*x + intercept (reference: slope_intercept_layer)."""
+    name = name or auto_name("slope_intercept")
+
+    def fwd(params, parents, ctx):
+        return parents[0].with_array(parents[0].array * slope + intercept)
+
+    return LayerOutput(name, "slope_intercept", [input], fwd, [],
+                       size=input.size)
+
+
+def cos_sim(a, b, scale=1.0, name: Optional[str] = None):
+    """Cosine similarity rows of a vs b (reference: cos_sim layer;
+    gserver CosSimLayer.cpp). Output [b, 1]."""
+    name = name or auto_name("cos_sim")
+
+    def fwd(params, parents, ctx):
+        x, y = parents[0].array, parents[1].array
+        xf, yf = x.astype(jnp.float32), y.astype(jnp.float32)
+        num = jnp.sum(xf * yf, axis=-1, keepdims=True)
+        den = jnp.linalg.norm(xf, axis=-1, keepdims=True) * \
+            jnp.linalg.norm(yf, axis=-1, keepdims=True)
+        return Value(scale * num / jnp.maximum(den, 1e-12))
+
+    return LayerOutput(name, "cos_sim", [a, b], fwd, [], size=1)
+
+
+# ---------------------------------------------------------------------------
+# recurrent layers
+# ---------------------------------------------------------------------------
+
+def lstmemory(input, size: Optional[int] = None, reverse: bool = False,
+              act=None, gate_act=None, name: Optional[str] = None,
+              param_attr=None, bias_attr=None):
+    """LSTM over a pre-projected sequence: input.size must be 4*size — the
+    x@W projection is supplied by the preceding fc/mixed layer, the layer owns
+    only recurrent weights, exactly the reference contract
+    (reference: lstmemory in trainer_config_helpers/layers.py:3321,
+    gserver/layers/LstmLayer.cpp)."""
+    name = name or auto_name("lstmemory")
+    enforce.enforce(input.size % 4 == 0, "lstmemory input size must be 4*size")
+    size = size or input.size // 4
+    enforce.enforce(input.size == 4 * size, "lstmemory input size != 4*size")
+    a = _param_attr(param_attr or ParamAttr(), f"{name}.w")
+    w_hh = ParamSpec(a.name, (size, 4 * size), attr=a, fan_in=size)
+    bias = _bias_spec(name, 4 * size, bias_attr)
+    specs = [w_hh] + ([bias] if bias else [])
+
+    def fwd(params, parents, ctx):
+        pv = parents[0]
+        enforce.enforce(pv.is_sequence, "lstmemory needs sequence input")
+        xp = pv.array
+        if bias:
+            xp = xp + params[bias.name].astype(xp.dtype)
+        bsz, tmax, _ = xp.shape
+        mask = (jnp.arange(tmax)[None, :] < pv.lengths[:, None])
+        h = jnp.zeros((bsz, size), xp.dtype)
+        c = jnp.zeros((bsz, size), xp.dtype)
+        xs, ms = jnp.moveaxis(xp, 1, 0), jnp.moveaxis(mask, 1, 0)
+        if reverse:
+            xs, ms = xs[::-1], ms[::-1]
+
+        def step(state, inp):
+            xt, mt = inp
+            nxt = ops_rnn.lstm_cell(xt, state, params[w_hh.name])
+            h_ = jnp.where(mt[:, None], nxt.h, state.h)
+            c_ = jnp.where(mt[:, None], nxt.c, state.c)
+            return ops_rnn.LSTMState(h_, c_), h_
+
+        _, outs = jax.lax.scan(step, ops_rnn.LSTMState(h, c), (xs, ms))
+        if reverse:
+            outs = outs[::-1]
+        outs = jnp.moveaxis(outs, 0, 1) * mask[..., None].astype(xp.dtype)
+        return Value(outs, pv.lengths, pv.sub_lengths)
+
+    return LayerOutput(name, "lstmemory", [input], fwd, specs, size=size)
+
+
+def grumemory(input, size: Optional[int] = None, reverse: bool = False,
+              act=None, name: Optional[str] = None, param_attr=None,
+              bias_attr=None):
+    """GRU over a pre-projected sequence (input.size == 3*size)
+    (reference: grumemory; gserver/layers/GatedRecurrentLayer.cpp)."""
+    name = name or auto_name("grumemory")
+    enforce.enforce(input.size % 3 == 0, "grumemory input size must be 3*size")
+    size = size or input.size // 3
+    a = _param_attr(param_attr or ParamAttr(), f"{name}.w")
+    w_hh = ParamSpec(a.name, (size, 3 * size), attr=a, fan_in=size)
+    bias = _bias_spec(name, 3 * size, bias_attr)
+    specs = [w_hh] + ([bias] if bias else [])
+
+    def fwd(params, parents, ctx):
+        pv = parents[0]
+        enforce.enforce(pv.is_sequence, "grumemory needs sequence input")
+        xp = pv.array
+        if bias:
+            xp = xp + params[bias.name].astype(xp.dtype)
+        bsz, tmax, _ = xp.shape
+        mask = (jnp.arange(tmax)[None, :] < pv.lengths[:, None])
+        h = jnp.zeros((bsz, size), xp.dtype)
+        xs, ms = jnp.moveaxis(xp, 1, 0), jnp.moveaxis(mask, 1, 0)
+        if reverse:
+            xs, ms = xs[::-1], ms[::-1]
+
+        def step(state, inp):
+            xt, mt = inp
+            nh = ops_rnn.gru_cell(xt, state, params[w_hh.name])
+            nh = jnp.where(mt[:, None], nh, state)
+            return nh, nh
+
+        _, outs = jax.lax.scan(step, h, (xs, ms))
+        if reverse:
+            outs = outs[::-1]
+        outs = jnp.moveaxis(outs, 0, 1) * mask[..., None].astype(xp.dtype)
+        return Value(outs, pv.lengths, pv.sub_lengths)
+
+    return LayerOutput(name, "grumemory", [input], fwd, specs, size=size)
+
+
+def recurrent(input, act=None, reverse: bool = False, name: Optional[str] = None,
+              param_attr=None, bias_attr=False):
+    """Simple full-matrix recurrent layer over a pre-projected sequence
+    (reference: gserver/layers/RecurrentLayer.cpp)."""
+    name = name or auto_name("recurrent")
+    size = input.size
+    act_name = act_mod.resolve(act or "tanh")
+    a = _param_attr(param_attr or ParamAttr(), f"{name}.w")
+    w_hh = ParamSpec(a.name, (size, size), attr=a, fan_in=size)
+    bias = _bias_spec(name, size, bias_attr)
+    specs = [w_hh] + ([bias] if bias else [])
+
+    def fwd(params, parents, ctx):
+        pv = parents[0]
+        xp = pv.array
+        if bias:
+            xp = xp + params[bias.name].astype(xp.dtype)
+        outs, _ = ops_rnn.simple_rnn(
+            xp, pv.lengths, None,  # input already projected by contract
+            params[w_hh.name], act=ops_act.get(act_name), reverse=reverse)
+        return Value(outs, pv.lengths, pv.sub_lengths)
+
+    return LayerOutput(name, "recurrent", [input], fwd, specs, size=size,
+                       activation=act_name)
+
+
+# ---------------------------------------------------------------------------
+# sequence layers
+# ---------------------------------------------------------------------------
+
+def pool(input, pooling_type=None, name: Optional[str] = None):
+    """Sequence pooling (reference: pooling_layer; SequencePoolLayer.cpp)."""
+    name = name or auto_name("seq_pool")
+    ptype = pooling_mod.resolve(pooling_type)
+
+    def fwd(params, parents, ctx):
+        pv = parents[0]
+        enforce.enforce(pv.is_sequence, "pooling_layer needs sequence input")
+        fn = {"max": ops_seq.seq_max, "avg": ops_seq.seq_avg,
+              "sum": ops_seq.seq_sum, "sqrt": ops_seq.seq_sqrt}[ptype]
+        return Value(fn(pv.array, pv.lengths))
+
+    return LayerOutput(name, "seq_pool", [input], fwd, [], size=input.size)
+
+
+pooling_layer = pool
+
+
+def last_seq(input, name: Optional[str] = None):
+    """(reference: last_seq / SequenceLastInstanceLayer.cpp)"""
+    name = name or auto_name("last_seq")
+
+    def fwd(params, parents, ctx):
+        pv = parents[0]
+        return Value(ops_seq.seq_last(pv.array, pv.lengths))
+
+    return LayerOutput(name, "last_seq", [input], fwd, [], size=input.size)
+
+
+def first_seq(input, name: Optional[str] = None):
+    name = name or auto_name("first_seq")
+
+    def fwd(params, parents, ctx):
+        pv = parents[0]
+        return Value(ops_seq.seq_first(pv.array, pv.lengths))
+
+    return LayerOutput(name, "first_seq", [input], fwd, [], size=input.size)
+
+
+def expand(input, expand_as, name: Optional[str] = None):
+    """Broadcast per-sequence vectors over timesteps (reference: expand_layer)."""
+    name = name or auto_name("expand")
+
+    def fwd(params, parents, ctx):
+        v, ref = parents
+        out = ops_seq.seq_expand(v.array, ref.lengths, ref.array.shape[1])
+        return Value(out, ref.lengths, ref.sub_lengths)
+
+    return LayerOutput(name, "expand", [input, expand_as], fwd, [],
+                       size=input.size)
+
+
+def seq_reverse(input, name: Optional[str] = None):
+    name = name or auto_name("seq_reverse")
+
+    def fwd(params, parents, ctx):
+        pv = parents[0]
+        return Value(ops_seq.seq_reverse(pv.array, pv.lengths), pv.lengths,
+                     pv.sub_lengths)
+
+    return LayerOutput(name, "seq_reverse", [input], fwd, [], size=input.size)
+
+
+def seq_concat(a, b, name: Optional[str] = None):
+    """Per-sequence time concat (reference: seq_concat_layer)."""
+    name = name or auto_name("seq_concat")
+
+    def fwd(params, parents, ctx):
+        x, y = parents
+        out, lens = ops_seq.seq_concat(x.array, x.lengths, y.array, y.lengths)
+        return Value(out, lens)
+
+    return LayerOutput(name, "seq_concat", [a, b], fwd, [], size=a.size)
+
+
+def context_projection(input, context_len: int, context_start: Optional[int] = None,
+                       name: Optional[str] = None):
+    """Context-window concat as a standalone layer (reference:
+    context_projection inside mixed_layer; function/ContextProjectionOp.cpp)."""
+    name = name or auto_name("context_projection")
+    start = context_start if context_start is not None else -(context_len // 2)
+
+    def fwd(params, parents, ctx):
+        pv = parents[0]
+        out = ops_seq.context_projection(pv.array, pv.lengths, context_len, start)
+        return Value(out, pv.lengths, pv.sub_lengths)
+
+    return LayerOutput(name, "context_projection", [input], fwd, [],
+                       size=input.size * context_len)
+
+
+# ---------------------------------------------------------------------------
+# outputs / decisions
+# ---------------------------------------------------------------------------
+
+def max_id(input, name: Optional[str] = None):
+    """Argmax layer (reference: maxid_layer / MaxIdLayer.cpp)."""
+    name = name or auto_name("max_id")
+
+    def fwd(params, parents, ctx):
+        pv = parents[0]
+        return Value(ops_topk.max_id(pv.array), pv.lengths, pv.sub_lengths)
+
+    return LayerOutput(name, "max_id", [input], fwd, [], size=1)
+
+
+# ---------------------------------------------------------------------------
+# costs
+# ---------------------------------------------------------------------------
+
+def _seq_token_cost(per_token: jax.Array, lengths) -> jax.Array:
+    """Sum per-token losses over valid steps → per-sequence cost."""
+    tmax = per_token.shape[1]
+    mask = (jnp.arange(tmax)[None, :] < lengths[:, None]).astype(per_token.dtype)
+    return jnp.sum(per_token * mask, axis=1)
+
+
+def _cost_layer(name, layer_type, inputs, per_example_fn, size=1):
+    def fwd(params, parents, ctx):
+        return Value(per_example_fn(params, parents, ctx))
+    return LayerOutput(name, layer_type, inputs, fwd, [], size=size)
+
+
+def classification_cost(input, label, name: Optional[str] = None):
+    """Softmax classification cost (reference: classification_cost in v2;
+    MultiClassCrossEntropy CostLayer). If the input layer already applied
+    softmax (the v1 convention), computes CE on the probabilities; otherwise
+    uses the fused log-softmax form on logits. Sequence inputs produce
+    per-token CE summed over each sequence."""
+    name = name or auto_name("classification_cost")
+    on_probs = input.activation == "softmax" or input.activation == "sequence_softmax"
+
+    def per_example(params, parents, ctx):
+        pv, lv = parents
+        pred, lab = pv.array, lv.array
+        if pv.is_sequence:
+            lab3 = lab if lab.ndim == 2 else lab.reshape(lab.shape[0], -1)
+            if on_probs:
+                tok = ops_loss.cross_entropy_with_probs(pred, lab3)
+            else:
+                tok = ops_loss.softmax_cross_entropy(pred, lab3)
+            return _seq_token_cost(tok, pv.lengths)
+        lab1 = lab.reshape(-1)
+        if on_probs:
+            return ops_loss.cross_entropy_with_probs(pred, lab1)
+        return ops_loss.softmax_cross_entropy(pred, lab1)
+
+    return _cost_layer(name, "classification_cost", [input, label], per_example)
+
+
+def cross_entropy_cost(input, label, name: Optional[str] = None):
+    name = name or auto_name("cross_entropy")
+    return classification_cost(input, label, name=name)
+
+
+def square_error_cost(input, label, name: Optional[str] = None):
+    """(reference: square_error_cost / SumOfSquaresCostLayer)"""
+    name = name or auto_name("square_error")
+
+    def per_example(params, parents, ctx):
+        return ops_loss.square_error(parents[0].array, parents[1].array)
+
+    return _cost_layer(name, "square_error", [input, label], per_example)
+
+
+regression_cost = square_error_cost
+mse_cost = square_error_cost
+
+
+def multi_binary_label_cross_entropy_cost(input, label, name=None):
+    name = name or auto_name("multi_binary_ce")
+
+    def per_example(params, parents, ctx):
+        return ops_loss.multi_binary_cross_entropy(parents[0].array,
+                                                   parents[1].array)
+
+    return _cost_layer(name, "multi_binary_ce", [input, label], per_example)
+
+
+def rank_cost(left, right, label, name: Optional[str] = None):
+    """(reference: rank_cost / RankingCost)"""
+    name = name or auto_name("rank_cost")
+
+    def per_example(params, parents, ctx):
+        return ops_loss.rank_cost(parents[0].array, parents[1].array,
+                                  parents[2].array.reshape(-1))
+
+    return _cost_layer(name, "rank_cost", [left, right, label], per_example)
+
+
+def huber_classification_cost(input, label, name: Optional[str] = None):
+    name = name or auto_name("huber_cost")
+
+    def per_example(params, parents, ctx):
+        return ops_loss.huber_classification(parents[0].array,
+                                             parents[1].array.reshape(-1))
+
+    return _cost_layer(name, "huber_cost", [input, label], per_example)
+
+
+def hinge_cost(input, label, name: Optional[str] = None):
+    name = name or auto_name("hinge_cost")
+
+    def per_example(params, parents, ctx):
+        return ops_loss.hinge(parents[0].array, parents[1].array.reshape(-1))
+
+    return _cost_layer(name, "hinge_cost", [input, label], per_example)

@@ -1,0 +1,2 @@
+"""Model zoo (reference: benchmark/paddle/image/{alexnet,googlenet,vgg,
+resnet,smallnet_mnist_cifar}.py, v1_api_demo/ configs)."""

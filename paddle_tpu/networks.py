@@ -1,0 +1,85 @@
+"""Composite network builders (reference: python/paddle/trainer_config_helpers/
+networks.py — simple_img_conv_pool, simple_lstm, bidirectional_lstm,
+sequence_conv_pool, simple_gru...)."""
+
+from typing import Optional
+
+from paddle_tpu import activation as act_mod
+from paddle_tpu import layer
+from paddle_tpu import pooling as pooling_mod
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         num_channel=None, pool_stride=None, act=None,
+                         pool_type=None, name=None, padding=None):
+    """(reference: networks.py simple_img_conv_pool)"""
+    conv = layer.img_conv(input, filter_size=filter_size,
+                          num_filters=num_filters, num_channels=num_channel,
+                          act=act, padding=padding,
+                          name=f"{name}_conv" if name else None)
+    return layer.img_pool(conv, pool_size=pool_size, stride=pool_stride,
+                          pool_type=pool_type,
+                          name=f"{name}_pool" if name else None)
+
+
+def simple_lstm(input, size, reverse=False, name=None, act=None,
+                mat_param_attr=None, bias_param_attr=None,
+                inner_param_attr=None):
+    """fc(4*size) + lstmemory (reference: networks.py simple_lstm)."""
+    proj = layer.fc(input, size * 4, param_attr=mat_param_attr,
+                    bias_attr=False,
+                    name=f"{name}_transform" if name else None)
+    return layer.lstmemory(proj, size=size, reverse=reverse,
+                           param_attr=inner_param_attr,
+                           bias_attr=bias_param_attr,
+                           name=name)
+
+
+def simple_gru(input, size, reverse=False, name=None, act=None):
+    """fc(3*size) + grumemory (reference: networks.py simple_gru)."""
+    proj = layer.fc(input, size * 3, bias_attr=False,
+                    name=f"{name}_transform" if name else None)
+    return layer.grumemory(proj, size=size, reverse=reverse, name=name)
+
+
+def bidirectional_lstm(input, size, name=None, return_seq=False):
+    """Forward + backward LSTM, concat (reference: networks.py
+    bidirectional_lstm)."""
+    fwd = simple_lstm(input, size, reverse=False,
+                      name=f"{name}_fw" if name else None)
+    bwd = simple_lstm(input, size, reverse=True,
+                      name=f"{name}_bw" if name else None)
+    if return_seq:
+        return layer.concat([fwd, bwd], name=name)
+    last_f = layer.last_seq(fwd)
+    first_b = layer.first_seq(bwd)
+    return layer.concat([last_f, first_b], name=name)
+
+
+def sequence_conv_pool(input, context_len, hidden_size, context_start=None,
+                       pool_type=None, context_proj_name=None, fc_name=None,
+                       pool_name=None, fc_act=None, name=None):
+    """Text CNN block: context window -> fc -> seq pool (reference:
+    networks.py sequence_conv_pool, the quick-start text model)."""
+    ctx = layer.context_projection(input, context_len=context_len,
+                                   context_start=context_start,
+                                   name=context_proj_name)
+    hidden = layer.fc(ctx, hidden_size, act=fc_act or act_mod.Tanh(),
+                      name=fc_name)
+    return layer.pool(hidden, pooling_type=pool_type or pooling_mod.Max(),
+                      name=pool_name or name)
+
+
+def img_conv_bn_pool(input, filter_size, num_filters, pool_size,
+                     num_channel=None, pool_stride=None, act=None,
+                     pool_type=None, name=None):
+    """conv -> batch_norm -> pool (reference: networks.py img_conv_bn_pool)."""
+    conv = layer.img_conv(input, filter_size=filter_size,
+                          num_filters=num_filters, num_channels=num_channel,
+                          act=None, bias_attr=False,
+                          name=f"{name}_conv" if name else None)
+    bn = layer.batch_norm(conv, act=act,
+                          name=f"{name}_bn" if name else None)
+    return layer.img_pool(bn, pool_size=pool_size, stride=pool_stride,
+                          pool_type=pool_type,
+                          name=f"{name}_pool" if name else None)

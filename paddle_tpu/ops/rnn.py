@@ -135,14 +135,18 @@ def gru(x: jax.Array, lengths: jax.Array, w_ih: jax.Array, w_hh: jax.Array,
     return outs, final
 
 
-def simple_rnn(x: jax.Array, lengths: jax.Array, w_ih: jax.Array,
+def simple_rnn(x: jax.Array, lengths: jax.Array, w_ih: Optional[jax.Array],
                w_hh: jax.Array, b: Optional[jax.Array] = None, *,
                act=jnp.tanh, reverse: bool = False,
                h0: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
-    """Vanilla RNN (reference: gserver RecurrentLayer.cpp)."""
+    """Vanilla RNN (reference: gserver RecurrentLayer.cpp). w_ih=None means
+    the input is already projected to hidden size (RecurrentLayer contract)."""
     bsz, tmax, _ = x.shape
     hidden = w_hh.shape[0]
-    xp = matmul(x.reshape(bsz * tmax, -1), w_ih).reshape(bsz, tmax, hidden)
+    if w_ih is None:
+        xp = x
+    else:
+        xp = matmul(x.reshape(bsz * tmax, -1), w_ih).reshape(bsz, tmax, hidden)
     if b is not None:
         xp = xp + b.astype(xp.dtype)
     mask = (jnp.arange(tmax)[None, :] < lengths[:, None])

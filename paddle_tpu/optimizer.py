@@ -1,0 +1,334 @@
+"""Optimizers as pure pytree update transforms.
+
+Replaces three reference implementations at once:
+- paddle/parameter/FirstOrderOptimizer.h (Sgd/Momentum/Adagrad/AdaDelta/
+  RMSProp/Adam/Adamax + sparse variants) applied per-parameter on the trainer
+- paddle/optimizer/ (the standalone C library the Go pserver drives via cgo)
+- paddle/operators/{sgd,momentum,adam,...}_op.cc (the new-stack update ops)
+
+plus LearningRateScheduler.cpp (poly/exp/discexp/linear schedules),
+Regularizer.cpp (L1/L2 decay) and error clipping. One implementation serves
+local and distributed training because distributed updates are just the same
+pure function applied to psum-reduced gradients — there is no separate
+"remote" optimizer path on TPU.
+
+The v2 surface is kept: ``paddle.optimizer.Momentum(momentum=0.9,
+learning_rate=0.1, regularization=L2Regularization(1e-4))``
+(reference: python/paddle/v2/optimizer.py).
+"""
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.param import ParamSpec
+
+
+# ---------------------------------------------------------------------------
+# learning-rate schedules (reference: parameter/LearningRateScheduler.cpp)
+# ---------------------------------------------------------------------------
+
+def constant_schedule(base_lr):
+    return lambda step: jnp.asarray(base_lr, jnp.float32)
+
+
+def poly_schedule(base_lr, a, b):
+    """lr = base * (1 + a*step)^(-b)"""
+    return lambda step: base_lr * jnp.power(1.0 + a * step, -b)
+
+
+def exp_schedule(base_lr, a, b):
+    """lr = base * a^(step/b)"""
+    return lambda step: base_lr * jnp.power(a, step / b)
+
+
+def discexp_schedule(base_lr, a, b):
+    """lr = base * a^floor(step/b) (reference: discrete exponential)"""
+    return lambda step: base_lr * jnp.power(a, jnp.floor(step / b))
+
+
+def linear_schedule(base_lr, a, b):
+    """lr = max(base - a*step, b)"""
+    return lambda step: jnp.maximum(base_lr - a * step, b)
+
+
+def warmup_cosine_schedule(base_lr, warmup_steps, total_steps, min_lr=0.0):
+    """TPU-native addition for large-batch training."""
+    def fn(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = base_lr * step / jnp.maximum(warmup_steps, 1)
+        prog = jnp.clip((step - warmup_steps) /
+                        jnp.maximum(total_steps - warmup_steps, 1), 0.0, 1.0)
+        cos = min_lr + 0.5 * (base_lr - min_lr) * (1 + jnp.cos(jnp.pi * prog))
+        return jnp.where(step < warmup_steps, warm, cos)
+    return fn
+
+
+def make_schedule(learning_rate, learning_rate_schedule=None,
+                  learning_rate_args="", **kw):
+    """Parse the reference's string-typed schedule config
+    (TrainerConfig.proto learning_rate_schedule)."""
+    if callable(learning_rate_schedule):
+        return learning_rate_schedule
+    name = learning_rate_schedule or "constant"
+    args = [float(x) for x in str(learning_rate_args).split(",") if x != ""]
+    if name == "constant":
+        return constant_schedule(learning_rate)
+    if name == "poly":
+        return poly_schedule(learning_rate, *args)
+    if name == "exp":
+        return exp_schedule(learning_rate, *args)
+    if name == "discexp":
+        return discexp_schedule(learning_rate, *args)
+    if name == "linear":
+        return linear_schedule(learning_rate, *args)
+    raise ValueError(f"unknown lr schedule {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# regularization (reference: parameter/Regularizer.cpp)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class L2Regularization:
+    rate: float
+
+
+@dataclasses.dataclass
+class L1Regularization:
+    rate: float
+
+
+# ---------------------------------------------------------------------------
+# optimizer base
+# ---------------------------------------------------------------------------
+
+class Optimizer:
+    """Stateful-spec, pure-update optimizer.
+
+    init_state(params) -> state pytree;
+    update(step, grads, params, state) -> (new_params, new_state).
+    Both are pure and jit/shard-safe.
+    """
+
+    def __init__(self, learning_rate=0.01, regularization=None,
+                 gradient_clipping_threshold=None,
+                 learning_rate_schedule=None, learning_rate_args="", **kw):
+        self.schedule = make_schedule(learning_rate, learning_rate_schedule,
+                                      learning_rate_args)
+        self.regularization = regularization
+        self.clip_threshold = gradient_clipping_threshold
+        self.specs: Dict[str, ParamSpec] = {}
+
+    def bind(self, specs):
+        """Attach per-parameter attrs (lr scale, per-param decay, static)."""
+        self.specs = {s.name: s for s in specs}
+        return self
+
+    # -- per-array rules, overridden by subclasses -------------------------
+    def _init_one(self, p):
+        return ()
+
+    def _update_one(self, g, p, s, lr):
+        raise NotImplementedError
+
+    # -- pytree plumbing ---------------------------------------------------
+    def init_state(self, params: Dict) -> Dict:
+        return {k: self._init_one(v) for k, v in params.items()}
+
+    def _decay(self, name, g, p):
+        """Apply global + per-param regularization as gradient decay
+        (reference: OptimizerWithRegularizer / Regularizer.cpp)."""
+        spec = self.specs.get(name)
+        l1 = getattr(spec.attr, "l1_rate", None) if spec else None
+        l2 = getattr(spec.attr, "l2_rate", None) if spec else None
+        if l2 is None and isinstance(self.regularization, L2Regularization):
+            l2 = self.regularization.rate
+        if l1 is None and isinstance(self.regularization, L1Regularization):
+            l1 = self.regularization.rate
+        gf = g.astype(jnp.float32)
+        if l2:
+            gf = gf + l2 * p.astype(jnp.float32)
+        if l1:
+            gf = gf + l1 * jnp.sign(p.astype(jnp.float32))
+        return gf
+
+    def _clip(self, grads: Dict) -> Dict:
+        """Global-norm clipping (reference: error_clipping / the v2
+        gradient_clipping_threshold optimizer arg)."""
+        if not self.clip_threshold:
+            return grads
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                             for g in grads.values()))
+        scale = jnp.minimum(1.0, self.clip_threshold / (gnorm + 1e-12))
+        return {k: g * scale.astype(g.dtype) for k, g in grads.items()}
+
+    def update(self, step, grads: Dict, params: Dict, state: Dict):
+        lr_t = self.schedule(step)
+        grads = self._clip(grads)
+        new_p, new_s = {}, {}
+        for name, p in params.items():
+            spec = self.specs.get(name)
+            if spec is not None and spec.attr.is_static:
+                new_p[name], new_s[name] = p, state[name]
+                continue
+            g = grads[name]
+            gf = self._decay(name, g, p)
+            lr = lr_t * (spec.attr.learning_rate if spec else 1.0)
+            np_, ns_ = self._update_one(gf, p.astype(jnp.float32),
+                                        state[name], lr)
+            new_p[name] = np_.astype(p.dtype)
+            new_s[name] = ns_
+        return new_p, new_s
+
+
+class SGD(Optimizer):
+    """Plain SGD (reference: SgdOptimizer, FirstOrderOptimizer.h:24)."""
+
+    def _update_one(self, g, p, s, lr):
+        return p - lr * g, s
+
+
+class Momentum(Optimizer):
+    """Heavy-ball momentum; use_nesterov for NAG (reference:
+    MomentumOptimizer; operators/momentum_op.cc)."""
+
+    def __init__(self, momentum=0.9, use_nesterov=False, **kw):
+        super().__init__(**kw)
+        self.mu = momentum
+        self.nesterov = use_nesterov
+
+    def _init_one(self, p):
+        return jnp.zeros_like(p, jnp.float32)
+
+    def _update_one(self, g, p, v, lr):
+        nv = self.mu * v + g
+        if self.nesterov:
+            return p - lr * (g + self.mu * nv), nv
+        return p - lr * nv, nv
+
+
+class AdaGrad(Optimizer):
+    """(reference: AdagradParameterOptimizer, FirstOrderOptimizer.h:111)"""
+
+    def __init__(self, epsilon=1e-6, **kw):
+        super().__init__(**kw)
+        self.eps = epsilon
+
+    def _init_one(self, p):
+        return jnp.zeros_like(p, jnp.float32)
+
+    def _update_one(self, g, p, acc, lr):
+        nacc = acc + g * g
+        return p - lr * g / (jnp.sqrt(nacc) + self.eps), nacc
+
+
+class AdaDelta(Optimizer):
+    """(reference: AdaDeltaParameterOptimizer; rho/epsilon semantics)"""
+
+    def __init__(self, rho=0.95, epsilon=1e-6, **kw):
+        super().__init__(**kw)
+        self.rho, self.eps = rho, epsilon
+
+    def _init_one(self, p):
+        return (jnp.zeros_like(p, jnp.float32), jnp.zeros_like(p, jnp.float32))
+
+    def _update_one(self, g, p, s, lr):
+        acc_g, acc_dx = s
+        acc_g = self.rho * acc_g + (1 - self.rho) * g * g
+        dx = jnp.sqrt((acc_dx + self.eps) / (acc_g + self.eps)) * g
+        acc_dx = self.rho * acc_dx + (1 - self.rho) * dx * dx
+        return p - lr * dx, (acc_g, acc_dx)
+
+
+class RMSProp(Optimizer):
+    """(reference: RMSPropParameterOptimizer, FirstOrderOptimizer.h:255)"""
+
+    def __init__(self, rho=0.95, epsilon=1e-6, momentum=0.0, **kw):
+        super().__init__(**kw)
+        self.rho, self.eps, self.mu = rho, epsilon, momentum
+
+    def _init_one(self, p):
+        return (jnp.zeros_like(p, jnp.float32), jnp.zeros_like(p, jnp.float32))
+
+    def _update_one(self, g, p, s, lr):
+        acc, mom = s
+        acc = self.rho * acc + (1 - self.rho) * g * g
+        step = lr * g / jnp.sqrt(acc + self.eps)
+        mom = self.mu * mom + step
+        return p - mom, (acc, mom)
+
+
+class Adam(Optimizer):
+    """(reference: AdamParameterOptimizer, FirstOrderOptimizer.h:290;
+    operators/adam_op.cc — with bias correction)"""
+
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+        super().__init__(**kw)
+        self.b1, self.b2, self.eps = beta1, beta2, epsilon
+
+    def _init_one(self, p):
+        return (jnp.zeros_like(p, jnp.float32), jnp.zeros_like(p, jnp.float32))
+
+    def update(self, step, grads, params, state):
+        self._t = jnp.asarray(step, jnp.float32) + 1.0
+        return super().update(step, grads, params, state)
+
+    def _update_one(self, g, p, s, lr):
+        m, v = s
+        m = self.b1 * m + (1 - self.b1) * g
+        v = self.b2 * v + (1 - self.b2) * g * g
+        mhat = m / (1 - jnp.power(self.b1, self._t))
+        vhat = v / (1 - jnp.power(self.b2, self._t))
+        return p - lr * mhat / (jnp.sqrt(vhat) + self.eps), (m, v)
+
+
+class AdaMax(Optimizer):
+    """(reference: AdamaxParameterOptimizer; operators/adamax_op.cc)"""
+
+    def __init__(self, beta1=0.9, beta2=0.999, **kw):
+        super().__init__(**kw)
+        self.b1, self.b2 = beta1, beta2
+
+    def _init_one(self, p):
+        return (jnp.zeros_like(p, jnp.float32), jnp.zeros_like(p, jnp.float32))
+
+    def update(self, step, grads, params, state):
+        self._t = jnp.asarray(step, jnp.float32) + 1.0
+        return super().update(step, grads, params, state)
+
+    def _update_one(self, g, p, s, lr):
+        m, u = s
+        m = self.b1 * m + (1 - self.b1) * g
+        u = jnp.maximum(self.b2 * u, jnp.abs(g))
+        return p - lr / (1 - jnp.power(self.b1, self._t)) * m / (u + 1e-12), (m, u)
+
+
+class ModelAverage:
+    """Bounded-window parameter averaging (reference: AverageOptimizer,
+    parameter/AverageOptimizer.cpp — window = min(steps * average_window,
+    max_average_window)). Implemented as a running mean that transitions to
+    an EMA with decay 1-1/window once the window fills — cumulative average
+    early, bounded-memory thereafter. Functional: accumulate alongside
+    training, swap in averaged() for eval."""
+
+    def __init__(self, average_window=0.0, max_average_window=10000):
+        self.window = float(max_average_window or 10000)
+
+    def init_state(self, params):
+        return {"avg": jax.tree.map(lambda p: p.astype(jnp.float32), params),
+                "count": jnp.zeros((), jnp.float32)}
+
+    def accumulate(self, params, state):
+        c = state["count"] + 1.0
+        decay = jnp.minimum((c - 1.0) / c, 1.0 - 1.0 / self.window)
+        return {"avg": jax.tree.map(
+            lambda a, p: decay * a + (1.0 - decay) * p.astype(jnp.float32),
+            state["avg"], params),
+            "count": c}
+
+    def averaged(self, params, state):
+        return jax.tree.map(lambda a, p: a.astype(p.dtype),
+                            state["avg"], params)

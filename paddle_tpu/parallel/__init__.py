@@ -1,0 +1,12 @@
+"""Distribution layer: mesh + shardings + collectives.
+
+Replaces the reference's entire distribution stack — MultiGradientMachine
+thread-ring data parallelism (gserver/gradientmachines/MultiGradientMachine.h),
+the C++ parameter server (paddle/pserver/), the Go pserver/master (go/), and
+the NCCL ops (operators/nccl_op.cc) — with in-graph XLA collectives over
+ICI/DCN driven by jax.sharding meshes.
+"""
+
+from paddle_tpu.core.place import (AXIS_DATA, AXIS_EXPERT, AXIS_MODEL,
+                                   AXIS_SEQ, AXIS_STAGE, default_mesh,
+                                   make_mesh)

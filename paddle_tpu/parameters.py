@@ -1,0 +1,87 @@
+"""User-facing parameter dict.
+
+Reference: python/paddle/v2/parameters.py:44 — a numpy-backed dict mirroring
+GradientMachine parameters, with to_tar/from_tar serialization. Here the
+backing store is the jax pytree itself; numpy views are produced on access.
+Non-trainable state (batch-norm stats) lives alongside in ``.state``.
+"""
+
+import pickle
+from typing import Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.param import ParamSpec, init_params
+from paddle_tpu.topology import Topology
+
+
+class Parameters:
+    def __init__(self, specs: List[ParamSpec], values: Dict = None,
+                 state_specs: List[ParamSpec] = (), state: Dict = None):
+        self.specs = {s.name: s for s in specs}
+        self.state_specs = {s.name: s for s in state_specs}
+        self.values: Dict = values or {}
+        self.state: Dict = state or {}
+
+    # -- dict-ish API (reference: parameters.py __getitem__/__setitem__) ----
+    def names(self):
+        return list(self.specs)
+
+    def keys(self):
+        return self.names()
+
+    def __contains__(self, name):
+        return name in self.specs
+
+    def __getitem__(self, name) -> np.ndarray:
+        return np.asarray(self.values[name])
+
+    def __setitem__(self, name, arr):
+        spec = self.specs[name]
+        arr = np.asarray(arr)
+        if tuple(arr.shape) != tuple(spec.shape):
+            raise ValueError(f"shape mismatch for {name}: "
+                             f"{arr.shape} vs {spec.shape}")
+        self.values[name] = jnp.asarray(arr, spec.resolved_dtype())
+
+    def get_shape(self, name):
+        return tuple(self.specs[name].shape)
+
+    # -- (de)serialisation (replaces to_tar/from_tar, v2 parameters.py) -----
+    def to_tar(self, f):
+        payload = {
+            "values": {k: np.asarray(v) for k, v in self.values.items()},
+            "state": {k: np.asarray(v) for k, v in self.state.items()},
+        }
+        pickle.dump(payload, f, protocol=4)
+
+    def from_tar_into(self, f):
+        payload = pickle.load(f)
+        for k, v in payload["values"].items():
+            if k in self.specs:
+                self.values[k] = jnp.asarray(v)
+        for k, v in payload.get("state", {}).items():
+            self.state[k] = jnp.asarray(v)
+        return self
+
+    @staticmethod
+    def from_tar(f, topology=None):
+        payload = pickle.load(f)
+        specs = [ParamSpec(k, tuple(v.shape)) for k, v in payload["values"].items()]
+        p = Parameters(specs)
+        p.values = {k: jnp.asarray(v) for k, v in payload["values"].items()}
+        p.state = {k: jnp.asarray(v) for k, v in payload.get("state", {}).items()}
+        return p
+
+
+def create(output_or_topology, key_source=None) -> Parameters:
+    """paddle.parameters.create(cost) (reference: v2 parameters.py create)."""
+    topo = output_or_topology if isinstance(output_or_topology, Topology) \
+        else Topology(output_or_topology)
+    specs = topo.param_specs()
+    state_specs = topo.state_specs()
+    p = Parameters(specs, state_specs=state_specs)
+    p.values = init_params(specs, key_source)
+    p.state = init_params(state_specs, key_source)
+    return p

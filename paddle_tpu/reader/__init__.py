@@ -1,0 +1,9 @@
+"""Reader framework (reference: python/paddle/v2/reader/ — a reader is a
+zero-arg callable returning an iterable of samples; decorators compose them)."""
+
+from paddle_tpu.reader import creator
+from paddle_tpu.reader import minibatch
+from paddle_tpu.reader.decorator import (
+    buffered, cache, chain, compose, firstn, map_readers, shuffle,
+    xmap_readers,
+)

@@ -1,0 +1,161 @@
+"""Reader decorators (reference: python/paddle/v2/reader/decorator.py:26-292
+— map_readers, shuffle, chain, compose, buffered, firstn, xmap_readers)."""
+
+import itertools
+import queue
+import random
+import threading
+
+
+def map_readers(func, *readers):
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return reader
+
+
+def shuffle(reader_fn, buf_size):
+    """Pool-shuffle within a bounded buffer (reference: decorator.py:68)."""
+    def reader():
+        buf = []
+        for e in reader_fn():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        random.shuffle(buf)
+        yield from buf
+    return reader
+
+
+def chain(*readers):
+    def reader():
+        return itertools.chain(*[r() for r in readers])
+    return reader
+
+
+def compose(*readers, check_alignment=True):
+    """Zip readers into tuple samples (reference: decorator.py:125)."""
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        for items in zip(*rs):
+            yield sum((make_tuple(i) for i in items), ())
+    return reader
+
+
+def buffered(reader_fn, size):
+    """Thread-prefetch up to `size` samples (reference: decorator.py:180).
+    Source exceptions propagate to the consumer rather than silently
+    truncating the stream."""
+    end = object()
+
+    def reader():
+        q = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for e in reader_fn():
+                    q.put(e)
+                q.put(end)
+            except BaseException as exc:
+                q.put((end, exc))
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is end:
+                break
+            if isinstance(e, tuple) and len(e) == 2 and e[0] is end:
+                raise e[1]
+            yield e
+    return reader
+
+
+def firstn(reader_fn, n):
+    def reader():
+        return itertools.islice(reader_fn(), n)
+    return reader
+
+
+def cache(reader_fn):
+    """Materialise once, replay from memory."""
+    data = []
+    filled = []
+
+    def reader():
+        if not filled:
+            data.extend(reader_fn())
+            filled.append(True)
+        return iter(data)
+    return reader
+
+
+def xmap_readers(mapper, reader_fn, process_num, buffer_size, order=False):
+    """Parallel map over samples with worker threads (reference:
+    decorator.py:229 XmapEndSignal machinery)."""
+    end = object()
+
+    def reader():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def feed():
+            for i, s in enumerate(reader_fn()):
+                in_q.put((i, s))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is end:
+                        out_q.put(end)
+                        break
+                    i, s = item
+                    out_q.put((i, mapper(s)))
+            except BaseException as exc:
+                out_q.put((end, exc))
+
+        threads = [threading.Thread(target=feed, daemon=True)]
+        threads += [threading.Thread(target=work, daemon=True)
+                    for _ in range(process_num)]
+        for t in threads:
+            t.start()
+
+        def classify(item):
+            """Returns 'end', 'error', or 'data'; raises worker errors."""
+            if item is end:
+                return "end"
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is end:
+                raise item[1]
+            return "data"
+
+        finished = 0
+        if not order:
+            while finished < process_num:
+                item = out_q.get()
+                if classify(item) == "end":
+                    finished += 1
+                else:
+                    yield item[1]
+        else:
+            pending, want = {}, 0
+            while finished < process_num or pending:
+                if want in pending:
+                    yield pending.pop(want)
+                    want += 1
+                    continue
+                if finished >= process_num:
+                    break  # workers done but a gap remains (dropped index)
+                item = out_q.get()
+                if classify(item) == "end":
+                    finished += 1
+                else:
+                    pending[item[0]] = item[1]
+    return reader

@@ -1,0 +1,17 @@
+"""paddle.batch (reference: python/paddle/v2/minibatch.py)."""
+
+
+def batch(reader_fn, batch_size, drop_last=True):
+    """Group samples into lists of batch_size. drop_last defaults True on TPU:
+    a ragged final batch would trigger an extra XLA compilation for one step
+    (the reference kept it; static shapes argue otherwise)."""
+    def reader():
+        b = []
+        for sample in reader_fn():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return reader

@@ -1,0 +1,10 @@
+"""Host-side runtime: record IO, data pipeline, distributed data service.
+
+Replaces the native runtime pieces of the reference — the recordio chunk
+format consumed by the Go master (go/master/service.go), the C++ data
+providers (gserver/dataproviders/), and the task-dispatch service. The
+recordio codec has a pure-Python implementation and a C++ accelerated one
+(paddle_tpu/runtime/native/) loaded via ctypes when built.
+"""
+
+from paddle_tpu.runtime import recordio

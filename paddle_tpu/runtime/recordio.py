@@ -1,0 +1,79 @@
+"""Chunked record file format.
+
+Reference: the Go recordio library consumed by go/master (service.go
+partitions datasets into recordio chunk tasks). Format here: sequence of
+chunks, each = [u32 magic][u32 nrecords][u64 payload_len][crc32]
+[payload: nrecords x (u32 len + bytes)]. Pickled python objects ride as
+records. A chunk is the unit of task dispatch for the data service.
+"""
+
+import pickle
+import struct
+import zlib
+from typing import Iterable, Iterator, List, Tuple
+
+MAGIC = 0x0A0D5EC5
+HEADER = struct.Struct("<IIQI")
+
+
+def write_records(path: str, records: Iterable, chunk_records: int = 1024):
+    """Write records (pickled) into chunks of chunk_records each."""
+    def flush(out, buf):
+        payload = b"".join(struct.pack("<I", len(r)) + r for r in buf)
+        out.write(HEADER.pack(MAGIC, len(buf), len(payload),
+                              zlib.crc32(payload) & 0xFFFFFFFF))
+        out.write(payload)
+
+    n = 0
+    with open(path, "wb") as out:
+        buf: List[bytes] = []
+        for rec in records:
+            buf.append(pickle.dumps(rec, protocol=4))
+            n += 1
+            if len(buf) >= chunk_records:
+                flush(out, buf)
+                buf = []
+        if buf:
+            flush(out, buf)
+    return n
+
+
+def chunk_offsets(path: str) -> List[Tuple[int, int]]:
+    """Index pass: [(offset, nrecords)] per chunk — what the master
+    partitions into tasks (go/master/service.go:106 partition)."""
+    out = []
+    with open(path, "rb") as f:
+        while True:
+            pos = f.tell()
+            hdr = f.read(HEADER.size)
+            if len(hdr) < HEADER.size:
+                break
+            magic, n, plen, crc = HEADER.unpack(hdr)
+            if magic != MAGIC:
+                raise IOError(f"bad chunk magic at {pos} in {path}")
+            out.append((pos, n))
+            f.seek(plen, 1)
+    return out
+
+
+def read_chunk(path: str, offset: int) -> Iterator:
+    with open(path, "rb") as f:
+        f.seek(offset)
+        hdr = f.read(HEADER.size)
+        magic, n, plen, crc = HEADER.unpack(hdr)
+        if magic != MAGIC:
+            raise IOError(f"bad chunk magic at {offset}")
+        payload = f.read(plen)
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            raise IOError(f"chunk crc mismatch at {offset} in {path}")
+        pos = 0
+        for _ in range(n):
+            (rlen,) = struct.unpack_from("<I", payload, pos)
+            pos += 4
+            yield pickle.loads(payload[pos:pos + rlen])
+            pos += rlen
+
+
+def read_records(path: str) -> Iterator:
+    for offset, _ in chunk_offsets(path):
+        yield from read_chunk(path, offset)

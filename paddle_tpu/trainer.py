@@ -1,0 +1,140 @@
+"""The training loop.
+
+Reference: python/paddle/v2/trainer.py:124 SGD.train — per-pass/per-batch loop
+driving GradientMachine.forwardBackward + ParameterUpdater over SWIG, firing
+user events; plus the C++ Trainer/TrainerInternal
+(paddle/trainer/TrainerInternal.cpp:66 trainOneBatch).
+
+TPU-native: the whole batch step — forward, backward, optimizer update,
+metric accumulables — is ONE jitted function with donated pytrees, so
+parameters never leave device and XLA overlaps everything it can. The Python
+loop only feeds data and reads back scalars (the reference crossed the SWIG
+boundary per layer call; here the boundary is once per step).
+"""
+
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import event as events
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.evaluator import EvaluatorSet
+from paddle_tpu.optimizer import Optimizer
+from paddle_tpu.parameters import Parameters
+from paddle_tpu.topology import LayerOutput, Topology
+from paddle_tpu.utils import logger, stat
+from paddle_tpu.utils.flags import GLOBAL_FLAGS
+from paddle_tpu.utils.rng import global_key_source
+
+
+class SGD:
+    """paddle.trainer.SGD (reference: python/paddle/v2/trainer.py:48)."""
+
+    def __init__(self, cost: LayerOutput, parameters: Parameters,
+                 update_equation: Optimizer,
+                 extra_layers: Optional[List[LayerOutput]] = None,
+                 is_local: bool = True, mesh=None):
+        self.cost = cost
+        self.parameters = parameters
+        self.optimizer = update_equation
+        self.extra_layers = list(extra_layers or [])
+        self.topology = Topology([cost] + self.extra_layers)
+        self.optimizer.bind(self.topology.param_specs())
+        self._forward = self.topology.compile()
+        self._feeder_cache: Dict = {}
+        self.opt_state = self.optimizer.init_state(parameters.values)
+        self._step = 0
+        self._mesh = mesh
+        self._train_step = self._build_train_step()
+        self._eval_step = self._build_eval_step()
+        self.evaluators = EvaluatorSet(self.topology.layers)
+
+    # -- compiled steps ----------------------------------------------------
+    def _build_train_step(self):
+        fwd = self._forward
+        opt = self.optimizer
+        cost_name = self.cost.name
+
+        def train_step(params, opt_state, state, feeds, step, dropout_key):
+            def loss_fn(p):
+                outs, new_state = fwd(p, state, feeds, is_training=True,
+                                      dropout_key=dropout_key)
+                per_example = outs[cost_name].array
+                return jnp.mean(per_example.astype(jnp.float32)), \
+                    (outs, new_state)
+
+            (loss, (outs, new_state)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt = opt.update(step, grads, params, opt_state)
+            return loss, new_params, new_opt, new_state, outs
+
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def _build_eval_step(self):
+        fwd = self._forward
+        cost_name = self.cost.name
+
+        def eval_step(params, state, feeds):
+            outs, _ = fwd(params, state, feeds, is_training=False)
+            return jnp.mean(outs[cost_name].array.astype(jnp.float32)), outs
+
+        return jax.jit(eval_step)
+
+    def _feeder(self, feeding):
+        key = tuple(sorted(feeding.items())) if feeding else None
+        if key not in self._feeder_cache:
+            dtypes = {l.name: l.data_spec for l in self.topology.data_layers}
+            self._feeder_cache[key] = DataFeeder(dtypes, feeding)
+        return self._feeder_cache[key]
+
+    # -- public API --------------------------------------------------------
+    def train(self, reader, num_passes=1,
+              event_handler: Optional[Callable] = None,
+              feeding: Optional[Dict[str, int]] = None):
+        event_handler = event_handler or (lambda e: None)
+        feeder = self._feeder(feeding)
+        ks = global_key_source()
+        log_period = GLOBAL_FLAGS.get("log_period", 100)
+
+        for pass_id in range(num_passes):
+            event_handler(events.BeginPass(pass_id))
+            self.evaluators.reset()
+            for batch_id, data_batch in enumerate(reader()):
+                event_handler(events.BeginIteration(pass_id, batch_id))
+                with stat.timer_scope("train_step"):
+                    feeds = feeder.feed(data_batch)
+                    dropout_key = ks.step("dropout", self._step)
+                    (loss, self.parameters.values, self.opt_state,
+                     self.parameters.state, outs) = self._train_step(
+                        self.parameters.values, self.opt_state,
+                        self.parameters.state, feeds,
+                        jnp.asarray(self._step, jnp.int32), dropout_key)
+                self._step += 1
+                self.evaluators.add_batch(outs)
+                cost = float(loss)
+                if log_period and batch_id % log_period == 0:
+                    logger.info("pass %d batch %d cost %.5f %s", pass_id,
+                                batch_id, cost, self.evaluators.result())
+                event_handler(events.EndIteration(pass_id, batch_id, cost,
+                                                  self.evaluators))
+            event_handler(events.EndPass(pass_id, self.evaluators))
+
+    def test(self, reader, feeding: Optional[Dict[str, int]] = None):
+        """One evaluation sweep (reference: trainer.py:204 SGD.test)."""
+        feeder = self._feeder(feeding)
+        self.evaluators.reset()
+        total, n = 0.0, 0
+        for data_batch in reader():
+            feeds = feeder.feed(data_batch)
+            loss, outs = self._eval_step(self.parameters.values,
+                                         self.parameters.state, feeds)
+            self.evaluators.add_batch(outs)
+            total += float(loss) * len(data_batch)
+            n += len(data_batch)
+        return events.TestResult(self.evaluators,
+                                 cost=total / max(n, 1))
+
+    def save_parameter_to_tar(self, f):
+        self.parameters.to_tar(f)

@@ -1,0 +1,182 @@
+"""Layer API + topology compiler tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layer, networks
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.topology import Topology, Value
+from paddle_tpu.utils.rng import KeySource
+
+
+dt = paddle.data_type
+
+
+def _compile(cost_or_out):
+    topo = Topology(cost_or_out)
+    params = paddle.parameters.create(cost_or_out, KeySource(5))
+    return topo, topo.compile(), params
+
+
+def test_fc_graph(rng):
+    x = layer.data("x", dt.dense_vector(8))
+    out = layer.fc(x, 4, act=paddle.activation.Relu(), name="fc1")
+    topo, fwd, params = _compile(out)
+    assert params.get_shape("fc1.w") == (8, 4)
+    assert params.get_shape("fc1.b") == (4,)
+    xv = rng.randn(3, 8).astype(np.float32)
+    outs, _ = fwd(params.values, params.state, {"x": Value(jnp.asarray(xv))})
+    ref = np.maximum(xv @ params["fc1.w"] + params["fc1.b"], 0)
+    np.testing.assert_allclose(np.asarray(outs["fc1"].array), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_fc_multi_input_sum(rng):
+    a = layer.data("a", dt.dense_vector(4))
+    b = layer.data("b", dt.dense_vector(6))
+    out = layer.fc([a, b], 3, name="m", bias_attr=False)
+    topo, fwd, params = _compile(out)
+    av = rng.randn(2, 4).astype(np.float32)
+    bv = rng.randn(2, 6).astype(np.float32)
+    outs, _ = fwd(params.values, params.state,
+                  {"a": Value(jnp.asarray(av)), "b": Value(jnp.asarray(bv))})
+    ref = av @ params["m.w0"] + bv @ params["m.w1"]
+    np.testing.assert_allclose(np.asarray(outs["m"].array), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_fc_sparse_input(rng):
+    x = layer.data("x", dt.sparse_binary_vector(50))
+    out = layer.fc(x, 4, name="s", bias_attr=False)
+    topo, fwd, params = _compile(out)
+    feeder = DataFeeder({"x": dt.sparse_binary_vector(50)})
+    feeds = feeder.feed([([3, 7, 11],), ([0],)])
+    outs, _ = fwd(params.values, params.state, feeds)
+    w = params["s.w"]
+    np.testing.assert_allclose(np.asarray(outs["s"].array)[0],
+                               w[3] + w[7] + w[11], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs["s"].array)[1], w[0],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_conv_pool_stack(rng):
+    img = layer.data("img", dt.dense_vector(784))
+    cp = networks.simple_img_conv_pool(img, filter_size=5, num_filters=8,
+                                       pool_size=2, num_channel=1,
+                                       act=paddle.activation.Relu())
+    out = layer.fc(cp, 10, act=paddle.activation.Softmax(), name="out")
+    topo, fwd, params = _compile(out)
+    xv = rng.randn(2, 784).astype(np.float32)
+    outs, _ = fwd(params.values, params.state, {"img": Value(jnp.asarray(xv))})
+    probs = np.asarray(outs["out"].array)
+    assert probs.shape == (2, 10)
+    np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_batch_norm_state_threading(rng):
+    x = layer.data("x", dt.dense_vector(6))
+    bn = layer.batch_norm(layer.fc(x, 6, name="f"), name="bn")
+    topo, fwd, params = _compile(bn)
+    assert "bn.mean" in params.state
+    xv = rng.randn(16, 6).astype(np.float32) * 3 + 2
+    outs, new_state = fwd(params.values, params.state,
+                          {"x": Value(jnp.asarray(xv))}, is_training=True)
+    # stats moved toward batch stats
+    assert float(jnp.abs(new_state["bn.mean"]).sum()) > 0
+    # inference path keeps state
+    outs2, state2 = fwd(params.values, params.state,
+                        {"x": Value(jnp.asarray(xv))}, is_training=False)
+    np.testing.assert_allclose(np.asarray(state2["bn.mean"]),
+                               np.asarray(params.state["bn.mean"]))
+
+
+def test_dropout_train_vs_infer(rng):
+    x = layer.data("x", dt.dense_vector(100))
+    d = layer.dropout(x, 0.5, name="drop")
+    topo, fwd, params = _compile(d)
+    xv = np.ones((4, 100), np.float32)
+    key = jax.random.key(0)
+    outs, _ = fwd(params.values, params.state, {"x": Value(jnp.asarray(xv))},
+                  is_training=True, dropout_key=key)
+    dropped = np.asarray(outs["drop"].array)
+    assert 0.2 < (dropped == 0).mean() < 0.8
+    assert set(np.round(np.unique(dropped), 4)) <= {0.0, 2.0}
+    outs, _ = fwd(params.values, params.state, {"x": Value(jnp.asarray(xv))},
+                  is_training=False)
+    np.testing.assert_allclose(np.asarray(outs["drop"].array), xv)
+
+
+def test_embedding_sequence_lstm(rng):
+    words = layer.data("words", dt.integer_value_sequence(30))
+    emb = layer.embedding(words, 8, name="emb")
+    lstm = networks.simple_lstm(emb, 6, name="lstm")
+    pooled = layer.last_seq(lstm, name="last")
+    topo, fwd, params = _compile(pooled)
+    feeder = DataFeeder({"words": dt.integer_value_sequence(30)})
+    feeds = feeder.feed([([1, 2, 3],), ([4, 5, 6, 7, 8],)])
+    outs, _ = fwd(params.values, params.state, feeds)
+    assert outs["last"].array.shape == (2, 6)
+
+
+def test_cost_layers(rng):
+    x = layer.data("x", dt.dense_vector(5))
+    lbl = layer.data("lbl", dt.integer_value(3))
+    sm = layer.fc(x, 3, act=paddle.activation.Softmax(), name="sm")
+    cost = layer.classification_cost(sm, lbl, name="cost")
+    topo, fwd, params = _compile(cost)
+    xv = rng.randn(4, 5).astype(np.float32)
+    lv = np.array([0, 1, 2, 0], np.int32)
+    outs, _ = fwd(params.values, params.state,
+                  {"x": Value(jnp.asarray(xv)), "lbl": Value(jnp.asarray(lv))})
+    assert outs["cost"].array.shape == (4,)
+    probs = np.asarray(xv @ params["sm.w"] + params["sm.b"])
+    probs = np.exp(probs) / np.exp(probs).sum(-1, keepdims=True)
+    ref = -np.log(probs[np.arange(4), lv] + 1e-8)
+    np.testing.assert_allclose(np.asarray(outs["cost"].array), ref, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_cos_sim_and_misc(rng):
+    a = layer.data("a", dt.dense_vector(4))
+    b = layer.data("b", dt.dense_vector(4))
+    cs = layer.cos_sim(a, b, name="cs")
+    topo, fwd, params = _compile(cs)
+    av = rng.randn(3, 4).astype(np.float32)
+    bv = rng.randn(3, 4).astype(np.float32)
+    outs, _ = fwd(params.values, params.state,
+                  {"a": Value(jnp.asarray(av)), "b": Value(jnp.asarray(bv))})
+    ref = (av * bv).sum(-1) / (np.linalg.norm(av, axis=-1) *
+                               np.linalg.norm(bv, axis=-1))
+    np.testing.assert_allclose(np.asarray(outs["cs"].array)[:, 0], ref,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_topology_jit_and_grad(rng):
+    """The whole point: the compiled topology is jax-transformable."""
+    x = layer.data("x", dt.dense_vector(8))
+    lbl = layer.data("lbl", dt.integer_value(4))
+    out = layer.fc(x, 4, name="w")
+    cost = layer.classification_cost(out, lbl, name="cost")
+    topo, fwd, params = _compile(cost)
+    xv = jnp.asarray(rng.randn(6, 8).astype(np.float32))
+    lv = jnp.asarray(rng.randint(0, 4, 6).astype(np.int32))
+
+    @jax.jit
+    def loss_fn(p):
+        outs, _ = fwd(p, {}, {"x": Value(xv), "lbl": Value(lv)})
+        return jnp.mean(outs["cost"].array)
+
+    g = jax.grad(loss_fn)(params.values)
+    assert g["w.w"].shape == (8, 4)
+    assert float(jnp.abs(g["w.w"]).sum()) > 0
+
+
+def test_duplicate_names_rejected():
+    x = layer.data("x", dt.dense_vector(4))
+    a = layer.fc(x, 2, name="same")
+    b = layer.fc(a, 2, name="same")
+    with pytest.raises(Exception):
+        Topology(b)

@@ -1,0 +1,125 @@
+"""Optimizer tests vs closed-form references (the reference's
+test_TrainingAlgorithm.cpp compared vectorized kernels against
+OriginalOptimizerApi.h — same idea, numpy as the oracle)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import optimizer as opt
+from paddle_tpu.core.param import ParamAttr, ParamSpec
+
+
+def _run(optimizer, steps=3, shape=(4,), seed=0):
+    rng = np.random.RandomState(seed)
+    p = {"w": jnp.asarray(rng.randn(*shape).astype(np.float32))}
+    optimizer.bind([ParamSpec("w", shape)])
+    s = optimizer.init_state(p)
+    gs = [rng.randn(*shape).astype(np.float32) for _ in range(steps)]
+    for i, g in enumerate(gs):
+        p, s = optimizer.update(i, {"w": jnp.asarray(g)}, p, s)
+    return np.asarray(p["w"]), gs, rng
+
+
+def test_sgd():
+    rng = np.random.RandomState(0)
+    p0 = rng.randn(4).astype(np.float32)
+    got, gs, _ = _run(opt.SGD(learning_rate=0.1))
+    ref = p0.copy()
+    for g in gs:
+        ref -= 0.1 * g
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_momentum():
+    rng = np.random.RandomState(0)
+    p0 = rng.randn(4).astype(np.float32)
+    got, gs, _ = _run(opt.Momentum(momentum=0.9, learning_rate=0.1))
+    ref, v = p0.copy(), np.zeros(4)
+    for g in gs:
+        v = 0.9 * v + g
+        ref -= 0.1 * v
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_adam():
+    rng = np.random.RandomState(0)
+    p0 = rng.randn(4).astype(np.float32)
+    got, gs, _ = _run(opt.Adam(learning_rate=0.01))
+    ref, m, v = p0.copy().astype(np.float64), np.zeros(4), np.zeros(4)
+    for t, g in enumerate(gs, start=1):
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mh = m / (1 - 0.9 ** t)
+        vh = v / (1 - 0.999 ** t)
+        ref -= 0.01 * mh / (np.sqrt(vh) + 1e-8)
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_adagrad_rmsprop_adadelta_adamax_run():
+    for o in [opt.AdaGrad(learning_rate=0.1),
+              opt.RMSProp(learning_rate=0.01),
+              opt.AdaDelta(),
+              opt.AdaMax(learning_rate=0.01)]:
+        got, gs, _ = _run(o)
+        assert np.isfinite(got).all()
+
+
+def test_l2_regularization():
+    p0 = np.ones(4, np.float32)
+    o = opt.SGD(learning_rate=0.1,
+                regularization=opt.L2Regularization(0.5))
+    o.bind([ParamSpec("w", (4,))])
+    p = {"w": jnp.asarray(p0)}
+    s = o.init_state(p)
+    g = np.zeros(4, np.float32)
+    p, s = o.update(0, {"w": jnp.asarray(g)}, p, s)
+    # pure decay: p - lr*l2*p = 1 - 0.05
+    np.testing.assert_allclose(np.asarray(p["w"]), 0.95 * p0, rtol=1e-5)
+
+
+def test_per_param_lr_and_static():
+    specs = [ParamSpec("a", (2,), attr=ParamAttr(learning_rate=2.0)),
+             ParamSpec("b", (2,), attr=ParamAttr(is_static=True))]
+    o = opt.SGD(learning_rate=0.1).bind(specs)
+    p = {"a": jnp.ones(2), "b": jnp.ones(2)}
+    s = o.init_state(p)
+    g = {"a": jnp.ones(2), "b": jnp.ones(2)}
+    p, s = o.update(0, g, p, s)
+    np.testing.assert_allclose(np.asarray(p["a"]), 1 - 0.2, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p["b"]), 1.0)
+
+
+def test_gradient_clipping():
+    o = opt.SGD(learning_rate=1.0, gradient_clipping_threshold=1.0)
+    o.bind([ParamSpec("w", (2,))])
+    p = {"w": jnp.zeros(2)}
+    s = o.init_state(p)
+    g = {"w": jnp.asarray(np.array([3.0, 4.0], np.float32))}  # norm 5
+    p, s = o.update(0, g, p, s)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(p["w"])), 1.0,
+                               rtol=1e-4)
+
+
+def test_schedules():
+    s = opt.poly_schedule(1.0, 1.0, 1.0)
+    assert abs(float(s(0)) - 1.0) < 1e-6
+    assert abs(float(s(1)) - 0.5) < 1e-6
+    d = opt.discexp_schedule(1.0, 0.5, 10)
+    assert abs(float(d(9)) - 1.0) < 1e-6
+    assert abs(float(d(10)) - 0.5) < 1e-6
+    lin = opt.linear_schedule(1.0, 0.1, 0.3)
+    assert abs(float(lin(9)) - 0.3) < 1e-6
+    w = opt.warmup_cosine_schedule(1.0, 10, 100)
+    assert float(w(5)) == pytest.approx(0.5, rel=1e-3)
+    assert float(w(100)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_model_average():
+    ma = opt.ModelAverage()
+    p = {"w": jnp.ones(2)}
+    s = ma.init_state(p)
+    s = ma.accumulate(p, s)
+    s = ma.accumulate({"w": jnp.ones(2) * 3}, s)
+    avg = ma.averaged(p, s)
+    np.testing.assert_allclose(np.asarray(avg["w"]), [2.0, 2.0])

@@ -1,0 +1,103 @@
+"""The minimum end-to-end slice (SURVEY.md §7.6): MNIST LeNet-5 through the
+full v2-style API — layers → trainer → optimizer → evaluator → checkpoint →
+infer. Mirrors the reference's book tests
+(python/paddle/v2/framework/tests/book/test_recognize_digits_conv.py) and
+v1_api_demo/mnist."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import evaluator, layer, networks
+from paddle_tpu.io import checkpoint
+
+
+def _lenet(img):
+    c1 = networks.simple_img_conv_pool(img, filter_size=5, num_filters=8,
+                                       pool_size=2, num_channel=1,
+                                       act=paddle.activation.Relu(),
+                                       name="c1")
+    c2 = networks.simple_img_conv_pool(c1, filter_size=5, num_filters=16,
+                                       pool_size=2,
+                                       act=paddle.activation.Relu(),
+                                       name="c2")
+    fc1 = layer.fc(c2, 64, act=paddle.activation.Relu(), name="fc1")
+    return layer.fc(fc1, 10, act=paddle.activation.Softmax(), name="pred")
+
+
+@pytest.fixture(scope="module")
+def trained():
+    paddle.init(seed=1234)
+    img = layer.data("pixel", paddle.data_type.dense_vector(784))
+    lbl = layer.data("label", paddle.data_type.integer_value(10))
+    pred = _lenet(img)
+    cost = layer.classification_cost(pred, lbl, name="cost")
+    err = evaluator.classification_error(pred, lbl, name="err")
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(
+            momentum=0.9, learning_rate=0.05),
+        extra_layers=[err])
+
+    costs = []
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            costs.append(e.cost)
+
+    reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.mnist.train(), 2048),
+        batch_size=64)
+    trainer.train(reader=reader, num_passes=1, event_handler=handler)
+    return trainer, params, pred, img, costs
+
+
+def test_training_converges(trained):
+    trainer, params, pred, img, costs = trained
+    first = np.mean(costs[:8])
+    last = np.mean(costs[-8:])
+    assert first > 2 * last, f"no convergence: first {first} last {last}"
+    assert last < 0.5
+
+
+def test_evaluator_error_low(trained):
+    trainer, params, pred, img, costs = trained
+    res = trainer.test(paddle.batch(paddle.dataset.mnist.test(), 64))
+    metrics = res.metrics
+    assert metrics["err"] < 0.15, metrics
+    assert res.cost < 0.6
+
+
+def test_infer_matches_training(trained):
+    trainer, params, pred, img, costs = trained
+    samples = [(x,) for x, y in list(paddle.dataset.mnist.test()())[:32]]
+    labels = [y for x, y in list(paddle.dataset.mnist.test()())[:32]]
+    probs = paddle.infer(output_layer=pred, parameters=params, input=samples)
+    assert probs.shape == (32, 10)
+    acc = (probs.argmax(-1) == np.array(labels)).mean()
+    assert acc > 0.8
+
+
+def test_checkpoint_roundtrip(trained, tmp_path):
+    trainer, params, pred, img, costs = trained
+    d = str(tmp_path / "ckpt")
+    checkpoint.save_checkpoint(d, 42, params.values, trainer.opt_state,
+                               params.state)
+    path = checkpoint.latest_checkpoint(d)
+    step, p2, o2, s2 = checkpoint.load_checkpoint(
+        path, params.values, trainer.opt_state, params.state)
+    assert step == 42
+    np.testing.assert_allclose(np.asarray(p2["fc1.w"]), params["fc1.w"])
+
+
+def test_params_tar_roundtrip(trained, tmp_path):
+    trainer, params, pred, img, costs = trained
+    f = tmp_path / "params.tar"
+    with open(f, "wb") as fh:
+        params.to_tar(fh)
+    with open(f, "rb") as fh:
+        p2 = paddle.parameters.Parameters.from_tar(fh)
+    np.testing.assert_allclose(p2["pred.w"], params["pred.w"])
