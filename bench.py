@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Benchmark driver: ResNet-50 ImageNet training throughput on one TPU chip.
+
+Mirrors the reference's benchmark protocol (`paddle train --job=time`,
+benchmark/paddle/image/run.sh:9-17, resnet.py topology) — measures steady-
+state train-step time for ResNet-50 (1000 classes, 3x224x224), reporting
+images/sec/chip against the BASELINE.json north star of 4000 images/sec/chip.
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+NORTH_STAR = 4000.0  # images/sec/chip (BASELINE.json)
+# Physical plausibility ceiling: ~197 TFLOP/s bf16 on v5e, ResNet-50 train
+# ~12.3 GFLOPs/image => ~16k img/s at 100% MXU. Anything above this is a
+# measurement artifact (tunnel sync failure), not throughput.
+PLAUSIBLE_MAX = 20000.0
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_train_step():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import layer
+    from paddle_tpu.models import resnet
+    from paddle_tpu.topology import Topology, Value
+    from paddle_tpu.utils.rng import KeySource
+
+    img = layer.data("image", paddle.data_type.dense_vector(3 * 224 * 224))
+    lbl = layer.data("label", paddle.data_type.integer_value(1000))
+    out = resnet.resnet_imagenet(img, depth=50, class_num=1000)
+    cost = layer.classification_cost(out, lbl, name="cost")
+    topo = Topology(cost)
+    params = paddle.parameters.create(cost, KeySource(42))
+    opt = paddle.optimizer.Momentum(momentum=0.9, learning_rate=0.1)
+    opt.bind(topo.param_specs())
+    opt_state = opt.init_state(params.values)
+    fwd = topo.compile()
+
+    def train_step(p, o, s, images, labels, step):
+        def loss_fn(p):
+            outs, ns = fwd(p, s, {"image": Value(images),
+                                  "label": Value(labels)}, is_training=True)
+            return jnp.mean(outs["cost"].array.astype(jnp.float32)), ns
+
+        (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        np_, no_ = opt.update(step, grads, p, o)
+        return loss, np_, no_, ns
+
+    return (jax.jit(train_step, donate_argnums=(0, 1, 2)), params, opt_state)
+
+
+def bench_batch(step_fn, carry, batch, warmup=3, iters=20):
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    # NHWC device-resident synthetic batch (data pipeline measured separately)
+    images = jnp.asarray(rng.rand(batch, 224, 224, 3).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 1000, batch).astype(np.int32))
+    p, o, s = carry
+
+    def full_sync(p, loss):
+        """Host-read a value data-dependent on the LAST optimizer update —
+        on the tunneled (axon) platform block_until_ready has been observed
+        returning before the chain finished; transferring a reduction of a
+        final parameter cannot be faked."""
+        import jax.tree_util as jtu
+        leaf = jtu.tree_leaves(p)[0]
+        return float(jnp.sum(leaf.astype(jnp.float32))), float(loss)
+
+    t_compile = time.time()
+    for i in range(warmup):
+        loss, p, o, s = step_fn(p, o, s, images, labels,
+                                jnp.asarray(i, jnp.int32))
+    full_sync(p, loss)
+    log(f"bs={batch}: warmup+compile {time.time()-t_compile:.1f}s")
+    t0 = time.time()
+    for i in range(iters):
+        loss, p, o, s = step_fn(p, o, s, images, labels,
+                                jnp.asarray(i, jnp.int32))
+    _, lossv = full_sync(p, loss)
+    dt = (time.time() - t0) / iters
+    ips = batch / dt
+    log(f"bs={batch}: {dt*1e3:.2f} ms/step  {ips:.0f} images/sec  "
+        f"loss {lossv:.3f}")
+    return ips, (p, o, s)
+
+
+def main():
+    import jax
+    log("devices:", jax.devices())
+    step_fn, params, opt_state = build_train_step()
+    carry = (params.values, opt_state, params.state)
+    best = 0.0
+    for batch in (128, 256):
+        try:
+            ips, carry = bench_batch(step_fn, carry, batch)
+            if ips > PLAUSIBLE_MAX:
+                log(f"bs={batch}: {ips:.0f} img/s exceeds physical ceiling "
+                    f"{PLAUSIBLE_MAX:.0f} — discarding as a sync artifact")
+                continue
+            best = max(best, ips)
+        except Exception as e:  # OOM at larger batch: keep best so far
+            log(f"bs={batch} failed: {type(e).__name__}: {e}")
+            break
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(best, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(best / NORTH_STAR, 4),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

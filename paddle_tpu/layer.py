@@ -331,6 +331,25 @@ def spp(input, pyramid_height: int, num_channels=None, pool_type=None,
                        size=bins * cin if cin else None)
 
 
+def img_cmrnorm(input, size=5, scale=0.0001, power=0.75, name=None,
+                num_channels=None):
+    """Cross-map response normalisation — AlexNet LRN (reference:
+    img_cmrnorm_layer in trainer_config_helpers/layers.py; runtime
+    paddle/function/CrossMapNormalOp.cpp)."""
+    name = name or auto_name("cmrnorm")
+    cin = num_channels or getattr(input, "_out_channels", None)
+    ih, iw = _infer_img_shape(input, cin, None)
+
+    def fwd(params, parents, ctx):
+        x = _to_nhwc(parents[0].array, cin, ih, iw)
+        return Value(ops_norm.lrn(x, size=size, alpha=scale, beta=power))
+
+    lo = LayerOutput(name, "cmrnorm", [input], fwd, [], size=input.size)
+    lo._out_channels = cin
+    lo._img_shape = getattr(input, "_img_shape", (ih, iw))
+    return lo
+
+
 def batch_norm(input, act=None, name: Optional[str] = None, num_channels=None,
                param_attr=None, bias_attr=None, moving_average_fraction=0.9,
                epsilon=1e-5):
@@ -405,20 +424,35 @@ def dropout(input, dropout_rate: float, name: Optional[str] = None):
 
 
 def concat(input: Sequence[LayerOutput], name: Optional[str] = None, act=None):
-    """Feature-axis concat (reference: concat_layer)."""
+    """Feature-axis concat (reference: concat_layer). When every input is an
+    image layer with the same spatial shape, concatenates on the channel
+    axis and stays an image (the reference concat semantics for conv
+    branches, e.g. inception blocks); otherwise flattens and concats."""
     name = name or auto_name("concat")
     act_name = act_mod.resolve(act)
     inputs = _as_list(input)
+    shapes = [getattr(i, "_img_shape", None) for i in inputs]
+    chans = [getattr(i, "_out_channels", None) for i in inputs]
+    image_mode = (all(c for c in chans) and all(shapes) and
+                  len({s for s in shapes}) == 1 and None not in shapes[0])
 
     def fwd(params, parents, ctx):
+        if image_mode:
+            arrs = [_to_nhwc(p.array, c, s[0], s[1])
+                    for p, c, s in zip(parents, chans, shapes)]
+            return _apply_act(Value(jnp.concatenate(arrs, axis=-1)), act_name)
         arrs = [_flatten_if_image(p.array) if p.array.ndim == 4 else p.array
                 for p in parents]
         return _apply_act(Value(jnp.concatenate(arrs, axis=-1),
                                 parents[0].lengths), act_name)
 
-    return LayerOutput(name, "concat", inputs, fwd, [],
-                       size=sum(i.size for i in inputs if i.size),
-                       activation=act_name)
+    lo = LayerOutput(name, "concat", inputs, fwd, [],
+                     size=sum(i.size for i in inputs if i.size),
+                     activation=act_name)
+    if image_mode:
+        lo._out_channels = sum(chans)
+        lo._img_shape = shapes[0]
+    return lo
 
 
 def addto(input: Sequence[LayerOutput], act=None, name: Optional[str] = None,

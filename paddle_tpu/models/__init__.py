@@ -1,2 +1,9 @@
 """Model zoo (reference: benchmark/paddle/image/{alexnet,googlenet,vgg,
 resnet,smallnet_mnist_cifar}.py, v1_api_demo/ configs)."""
+
+from paddle_tpu.models import alexnet
+from paddle_tpu.models import googlenet
+from paddle_tpu.models import resnet
+from paddle_tpu.models import smallnet
+from paddle_tpu.models import text
+from paddle_tpu.models import vgg

@@ -1,0 +1,102 @@
+"""ResNet for ImageNet (reference: benchmark/paddle/image/resnet.py —
+layer_num 50/101/152, 1000 classes, 3x224x224; the north-star benchmark
+model per BASELINE.md).
+
+Built on the layer API: conv_bn blocks with addto shortcuts; NHWC throughout;
+bf16 matmul/conv compute per the global dtype policy.
+"""
+
+from paddle_tpu import activation, layer, pooling
+
+
+def conv_bn_layer(input, ch_out, filter_size, stride, padding, active_type,
+                  ch_in=None, name=None):
+    """(reference: resnet.py conv_bn_layer)"""
+    tmp = layer.img_conv(input, filter_size=filter_size, num_filters=ch_out,
+                         num_channels=ch_in, stride=stride, padding=padding,
+                         act=None, bias_attr=False,
+                         name=f"{name}_conv" if name else None)
+    return layer.batch_norm(tmp, act=active_type,
+                            name=f"{name}_bn" if name else None)
+
+
+def shortcut(input, ch_in, ch_out, stride, name=None):
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, 0, None,
+                             name=f"{name}_proj" if name else None)
+    return input
+
+
+def bottleneck_block(input, ch_in, ch_out, stride, name=None):
+    """1x1 -> 3x3 -> 1x1(x4) with identity/projection shortcut
+    (reference: resnet.py bottleneck_block)."""
+    short = shortcut(input, ch_in, ch_out * 4, stride, name=name)
+    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, activation.Relu(),
+                          name=f"{name}_a" if name else None)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, activation.Relu(),
+                          name=f"{name}_b" if name else None)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, None,
+                          name=f"{name}_c" if name else None)
+    return layer.addto([conv3, short], act=activation.Relu(),
+                       name=f"{name}_add" if name else None)
+
+
+def basic_block(input, ch_in, ch_out, stride, name=None):
+    short = shortcut(input, ch_in, ch_out, stride, name=name)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, activation.Relu(),
+                          name=f"{name}_a" if name else None)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, None,
+                          name=f"{name}_b" if name else None)
+    return layer.addto([conv2, short], act=activation.Relu(),
+                       name=f"{name}_add" if name else None)
+
+
+_DEPTH_CFG = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def resnet_imagenet(input, depth=50, class_num=1000, img_size=224):
+    """(reference: resnet.py:6 — 3x224x224, 1000 classes)"""
+    kind, counts = _DEPTH_CFG[depth]
+    block = bottleneck_block if kind == "bottleneck" else basic_block
+    expansion = 4 if kind == "bottleneck" else 1
+
+    conv1 = conv_bn_layer(input, 64, 7, 2, 3, activation.Relu(), ch_in=3,
+                          name="res_conv1")
+    pool1 = layer.img_pool(conv1, pool_size=3, stride=2, padding=1,
+                           pool_type=pooling.Max(), name="res_pool1")
+
+    ch_in = 64
+    tmp = pool1
+    for stage, (n, ch_out) in enumerate(zip(counts, [64, 128, 256, 512])):
+        for i in range(n):
+            stride = 2 if (i == 0 and stage > 0) else 1
+            tmp = block(tmp, ch_in, ch_out, stride,
+                        name=f"res{stage+2}_{i}")
+            ch_in = ch_out * expansion
+    pool = layer.img_pool(tmp, pool_size=7, stride=1,
+                          pool_type=pooling.Avg(), name="res_gap")
+    return layer.fc(pool, class_num, act=activation.Softmax(), name="res_fc")
+
+
+def resnet_cifar10(input, depth=32, class_num=10):
+    """(reference: v1_api_demo/model_zoo resnet cifar variant)"""
+    n = (depth - 2) // 6
+    conv1 = conv_bn_layer(input, 16, 3, 1, 1, activation.Relu(), ch_in=3,
+                          name="rc_conv1")
+    tmp = conv1
+    ch_in = 16
+    for stage, ch_out in enumerate([16, 32, 64]):
+        for i in range(n):
+            stride = 2 if (i == 0 and stage > 0) else 1
+            tmp = basic_block(tmp, ch_in, ch_out, stride,
+                              name=f"rc{stage}_{i}")
+            ch_in = ch_out
+    pool = layer.img_pool(tmp, pool_size=8, stride=1,
+                          pool_type=pooling.Avg(), name="rc_gap")
+    return layer.fc(pool, class_num, act=activation.Softmax(), name="rc_fc")

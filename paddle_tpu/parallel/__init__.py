@@ -10,3 +10,6 @@ ICI/DCN driven by jax.sharding meshes.
 from paddle_tpu.core.place import (AXIS_DATA, AXIS_EXPERT, AXIS_MODEL,
                                    AXIS_SEQ, AXIS_STAGE, default_mesh,
                                    make_mesh)
+from paddle_tpu.parallel.spmd import (DistConfig, data_model_parallel,
+                                      data_parallel, embedding_vocab_rule,
+                                      fc_column_rule, fc_row_rule)

@@ -35,7 +35,11 @@ class SGD:
     def __init__(self, cost: LayerOutput, parameters: Parameters,
                  update_equation: Optimizer,
                  extra_layers: Optional[List[LayerOutput]] = None,
-                 is_local: bool = True, mesh=None):
+                 is_local: bool = True, parallel=None):
+        """parallel: an optional paddle_tpu.parallel.DistConfig — shards
+        parameters per its rules and the batch across the data axis; XLA
+        inserts the gradient all-reduce (replacing the pserver round-trip,
+        reference: trainer/RemoteParameterUpdater.cpp)."""
         self.cost = cost
         self.parameters = parameters
         self.optimizer = update_equation
@@ -46,7 +50,17 @@ class SGD:
         self._feeder_cache: Dict = {}
         self.opt_state = self.optimizer.init_state(parameters.values)
         self._step = 0
-        self._mesh = mesh
+        self.parallel = parallel
+        if parallel is not None:
+            pv = parameters.values
+            parameters.values = parallel.shard_params(pv)
+            self.opt_state = jax.device_put(
+                self.opt_state, parallel.state_shardings(self.opt_state))
+            if parameters.state:
+                parameters.state = jax.device_put(
+                    parameters.state,
+                    jax.tree.map(lambda _: parallel.replicated(),
+                                 parameters.state))
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
         self.evaluators = EvaluatorSet(self.topology.layers)
@@ -105,6 +119,9 @@ class SGD:
                 event_handler(events.BeginIteration(pass_id, batch_id))
                 with stat.timer_scope("train_step"):
                     feeds = feeder.feed(data_batch)
+                    if self.parallel is not None:
+                        feeds = jax.device_put(
+                            feeds, self.parallel.feed_shardings(feeds))
                     dropout_key = ks.step("dropout", self._step)
                     (loss, self.parameters.values, self.opt_state,
                      self.parameters.state, outs) = self._train_step(
@@ -128,6 +145,9 @@ class SGD:
         total, n = 0.0, 0
         for data_batch in reader():
             feeds = feeder.feed(data_batch)
+            if self.parallel is not None:
+                feeds = jax.device_put(feeds,
+                                       self.parallel.feed_shardings(feeds))
             loss, outs = self._eval_step(self.parameters.values,
                                          self.parameters.state, feeds)
             self.evaluators.add_batch(outs)

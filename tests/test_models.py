@@ -1,0 +1,104 @@
+"""Model zoo smoke tests: build, param-count sanity, forward shapes, one
+train step. Full-size ResNet-50 is exercised on TPU by bench.py; here tiny
+variants keep CPU CI fast."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layer
+from paddle_tpu.models import googlenet, resnet, smallnet, text, vgg
+from paddle_tpu.topology import Topology, Value
+from paddle_tpu.utils.rng import KeySource
+
+
+def _forward(out, feeds, seed=3):
+    topo = Topology(out)
+    params = paddle.parameters.create(out, KeySource(seed))
+    fwd = topo.compile()
+    outs, _ = fwd(params.values, params.state, feeds, is_training=False)
+    return outs[out.name].array, params
+
+
+def test_resnet_cifar(rng):
+    img = layer.data("image", paddle.data_type.dense_vector(3 * 32 * 32))
+    out = resnet.resnet_cifar10(img, depth=8)
+    x = rng.randn(2, 3 * 32 * 32).astype(np.float32)
+    probs, params = _forward(out, {"image": Value(jnp.asarray(x))})
+    assert probs.shape == (2, 10)
+    np.testing.assert_allclose(np.asarray(probs).sum(-1), 1.0, rtol=1e-4)
+
+
+def test_resnet50_structure():
+    img = layer.data("image", paddle.data_type.dense_vector(3 * 224 * 224))
+    out = resnet.resnet_imagenet(img, depth=50)
+    topo = Topology(out)
+    n_params = sum(int(np.prod(s.shape)) for s in topo.param_specs())
+    # ResNet-50 ~25.5M params
+    assert 24e6 < n_params < 27e6, n_params
+    n_bn = sum(1 for l in topo.layers if l.layer_type == "batch_norm")
+    assert n_bn == 53, n_bn
+
+
+def test_smallnet_train_step(rng):
+    img = layer.data("image", paddle.data_type.dense_vector(3 * 32 * 32))
+    lbl = layer.data("label", paddle.data_type.integer_value(10))
+    out = smallnet.smallnet(img)
+    cost = layer.classification_cost(out, lbl, name="cost")
+    params = paddle.parameters.create(cost, KeySource(1))
+    tr = paddle.trainer.SGD(cost=cost, parameters=params,
+                            update_equation=paddle.optimizer.Momentum(
+                                momentum=0.9, learning_rate=0.01))
+    data = [(rng.randn(3 * 32 * 32).astype(np.float32), int(i % 10))
+            for i in range(32)]
+    tr.train(reader=paddle.batch(lambda: iter(data), 16), num_passes=1)
+
+
+def test_vgg_tiny_shapes(rng):
+    img = layer.data("image", paddle.data_type.dense_vector(3 * 32 * 32))
+    out = vgg.vgg(img, depth=11, class_num=10)
+    x = rng.randn(2, 3 * 32 * 32).astype(np.float32)
+    probs, _ = _forward(out, {"image": Value(jnp.asarray(x))})
+    assert probs.shape == (2, 10)
+
+
+def test_googlenet_builds():
+    img = layer.data("image", paddle.data_type.dense_vector(3 * 224 * 224))
+    out = googlenet.googlenet(img)
+    topo = Topology(out)
+    n_params = sum(int(np.prod(s.shape)) for s in topo.param_specs())
+    # GoogleNet ~7M params (incl. classifier)
+    assert 5e6 < n_params < 9e6, n_params
+
+
+def test_lstm_text_model(rng):
+    words = layer.data("words", paddle.data_type.integer_value_sequence(100))
+    out = text.lstm_text_classification(words, hidden_dim=16, emb_dim=8)
+    lbl = layer.data("label", paddle.data_type.integer_value(2))
+    cost = layer.classification_cost(out, lbl, name="cost")
+    params = paddle.parameters.create(cost, KeySource(2))
+    tr = paddle.trainer.SGD(cost=cost, parameters=params,
+                            update_equation=paddle.optimizer.Adam(
+                                learning_rate=1e-3))
+    data = [([int(w) for w in rng.randint(0, 100, rng.randint(3, 10))],
+             int(i % 2)) for i in range(16)]
+    tr.train(reader=paddle.batch(lambda: iter(data), 8), num_passes=1)
+
+
+def test_tagger_builds(rng):
+    words = layer.data("words", paddle.data_type.integer_value_sequence(50))
+    out = text.stacked_lstm_tagger(words, tag_num=5, emb_dim=8, hidden_dim=8,
+                                   depth=2)
+    assert out.size == 5
+
+
+def test_alexnet_structure():
+    img = layer.data("image", paddle.data_type.dense_vector(3 * 227 * 227))
+    out = __import__("paddle_tpu.models.alexnet", fromlist=["alexnet"]
+                     ).alexnet(img)
+    topo = Topology(out)
+    n_params = sum(int(np.prod(s.shape)) for s in topo.param_specs())
+    # AlexNet ~61M params
+    assert 55e6 < n_params < 65e6, n_params

@@ -1,0 +1,97 @@
+"""Distribution tests on the 8-device virtual CPU mesh (the in-process
+cluster strategy, SURVEY.md §4.6). DP must be numerically equivalent to
+single-device training; TP shardings must produce the declared layouts."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import evaluator, layer, parallel
+from paddle_tpu.core import place
+from paddle_tpu.utils.rng import KeySource
+
+
+def _model(seed):
+    x = layer.data("x", paddle.data_type.dense_vector(8))
+    lbl = layer.data("lbl", paddle.data_type.integer_value(3))
+    h = layer.fc(x, 16, act=paddle.activation.Relu(), name="h")
+    out = layer.fc(h, 3, act=paddle.activation.Softmax(), name="o")
+    cost = layer.classification_cost(out, lbl, name="cost")
+    params = paddle.parameters.create(cost, KeySource(seed))
+    return cost, params
+
+
+def _data(n=32):
+    rng = np.random.RandomState(0)
+    return [(rng.randn(8).astype(np.float32), int(rng.randint(3)))
+            for _ in range(n)]
+
+
+def _train(parallel_cfg, seed=11, passes=2):
+    cost, params = _model(seed)
+    tr = paddle.trainer.SGD(cost=cost, parameters=params,
+                            update_equation=paddle.optimizer.Momentum(
+                                momentum=0.9, learning_rate=0.1),
+                            parallel=parallel_cfg)
+    costs = []
+    tr.train(reader=paddle.batch(lambda: iter(_data()), 16),
+             num_passes=passes,
+             event_handler=lambda e: costs.append(e.cost) if isinstance(
+                 e, paddle.event.EndIteration) else None)
+    return costs, tr
+
+
+def test_dp_matches_single_device():
+    """Data-parallel over 8 devices must match single-device numerics —
+    the correctness bar the reference's test_CompareSparse.cpp set for
+    remote-vs-local training."""
+    costs_single, _ = _train(None)
+    costs_dp, tr = _train(parallel.data_parallel(place.default_mesh()))
+    np.testing.assert_allclose(costs_single, costs_dp, rtol=2e-4, atol=1e-5)
+    # params are replicated across the mesh
+    sh = tr.parameters.values["h.w"].sharding
+    assert sh.is_fully_replicated
+
+
+def test_tp_fc_column_sharding():
+    mesh = place.make_mesh((4, 2), (parallel.AXIS_DATA, parallel.AXIS_MODEL))
+    cfg = parallel.DistConfig(mesh, param_rules=[
+        parallel.fc_column_rule(r"^h\.w$")])
+    costs_tp, tr = _train(cfg)
+    costs_single, _ = _train(None)
+    np.testing.assert_allclose(costs_single, costs_tp, rtol=2e-4, atol=1e-5)
+    spec = tr.parameters.values["h.w"].sharding.spec
+    assert spec == jax.sharding.PartitionSpec(None, parallel.AXIS_MODEL)
+
+
+def test_sharded_embedding_training():
+    mesh = place.make_mesh((2, 4), (parallel.AXIS_DATA, parallel.AXIS_MODEL))
+    cfg = parallel.DistConfig(mesh, param_rules=[
+        parallel.embedding_vocab_rule(r"^emb\.w$")])
+    words = layer.data("words", paddle.data_type.integer_value_sequence(40))
+    lbl = layer.data("lbl", paddle.data_type.integer_value(2))
+    emb = layer.embedding(words, 8, name="emb")
+    pooled = layer.pool(emb, name="pool")
+    out = layer.fc(pooled, 2, act=paddle.activation.Softmax(), name="o")
+    cost = layer.classification_cost(out, lbl, name="cost")
+    params = paddle.parameters.create(cost, KeySource(3))
+    tr = paddle.trainer.SGD(cost=cost, parameters=params,
+                            update_equation=paddle.optimizer.Adam(
+                                learning_rate=1e-2),
+                            parallel=cfg)
+    rng = np.random.RandomState(1)
+    data = [([int(w) for w in rng.randint(0, 40, 5)], int(i % 2))
+            for i in range(16)]
+    costs = []
+    tr.train(reader=paddle.batch(lambda: iter(data), 8), num_passes=3,
+             event_handler=lambda e: costs.append(e.cost) if isinstance(
+                 e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0]
+    spec = tr.parameters.values["emb.w"].sharding.spec
+    assert spec[0] == parallel.AXIS_MODEL
+
+
+def test_dryrun_multichip_entry():
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)
