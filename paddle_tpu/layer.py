@@ -59,10 +59,17 @@ def _bias_spec(name, size, bias_attr) -> Optional[ParamSpec]:
 
 
 def _apply_act(value: Value, act_name: str) -> Value:
+    """Keeps the pre-activation on the Value so downstream cost layers can
+    fuse with the activation in log-space (the reason the reference had a
+    fused softmax_with_cross_entropy op)."""
     if act_name == "sequence_softmax":
         enforce.enforce(value.is_sequence, "sequence_softmax needs sequence input")
         return value.with_array(ops_seq.seq_softmax(value.array, value.lengths))
-    return value.with_array(ops_act.get(act_name)(value.array))
+    # only softmax keeps its logits: classification_cost fuses with them,
+    # and an unconsumed pre_act would cost a full extra output buffer at
+    # jit boundaries for every other activation
+    pre = value.array if act_name == "softmax" else None
+    return value.with_array(ops_act.get(act_name)(value.array), pre_act=pre)
 
 
 def _flatten_if_image(x: jax.Array) -> jax.Array:
@@ -765,9 +772,12 @@ def _cost_layer(name, layer_type, inputs, per_example_fn, size=1):
 
 def classification_cost(input, label, name: Optional[str] = None):
     """Softmax classification cost (reference: classification_cost in v2;
-    MultiClassCrossEntropy CostLayer). If the input layer already applied
-    softmax (the v1 convention), computes CE on the probabilities; otherwise
-    uses the fused log-softmax form on logits. Sequence inputs produce
+    MultiClassCrossEntropy CostLayer). Softmax-activated inputs (the v1
+    convention) are fused: CE is computed as log_softmax on the kept logits,
+    never as -log(p) on the probabilities (the reference's fused
+    softmax_with_cross_entropy rationale — -log(p+eps) spikes to 1/eps-scale
+    gradients when saturated). CE on probabilities remains only as a fallback
+    for inputs whose logits were not preserved. Sequence inputs produce
     per-token CE summed over each sequence."""
     name = name or auto_name("classification_cost")
     on_probs = input.activation == "softmax" or input.activation == "sequence_softmax"
@@ -775,14 +785,23 @@ def classification_cost(input, label, name: Optional[str] = None):
     def per_example(params, parents, ctx):
         pv, lv = parents
         pred, lab = pv.array, lv.array
+        # Fused path: if the input layer applied softmax and kept its logits,
+        # compute CE in log-space on the logits. -log(p+eps) on saturated
+        # probabilities produces 1/eps-scale gradient spikes that kill
+        # training (dead ReLUs); log_softmax on logits is exact and stable.
+        logits = pv.pre_act if input.activation == "softmax" else None
         if pv.is_sequence:
             lab3 = lab if lab.ndim == 2 else lab.reshape(lab.shape[0], -1)
-            if on_probs:
+            if logits is not None:
+                tok = ops_loss.softmax_cross_entropy(logits, lab3)
+            elif on_probs:
                 tok = ops_loss.cross_entropy_with_probs(pred, lab3)
             else:
                 tok = ops_loss.softmax_cross_entropy(pred, lab3)
             return _seq_token_cost(tok, pv.lengths)
         lab1 = lab.reshape(-1)
+        if logits is not None:
+            return ops_loss.softmax_cross_entropy(logits, lab1)
         if on_probs:
             return ops_loss.cross_entropy_with_probs(pred, lab1)
         return ops_loss.softmax_cross_entropy(pred, lab1)

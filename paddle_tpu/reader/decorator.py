@@ -14,17 +14,39 @@ def map_readers(func, *readers):
     return reader
 
 
-def shuffle(reader_fn, buf_size):
-    """Pool-shuffle within a bounded buffer (reference: decorator.py:68)."""
+_shuffle_ids = itertools.count()
+
+
+def shuffle(reader_fn, buf_size, seed=None):
+    """Pool-shuffle within a bounded buffer (reference: decorator.py:68).
+
+    Seeding: an explicit ``seed`` wins; else the framework seed flag (set by
+    ``paddle.init(seed=...)``) makes seeded runs reproducible end-to-end; else
+    the global ``random`` module is used, preserving the reference's
+    ``random.seed()``-before-building-readers idiom. Each shuffle() call and
+    each pass derive distinct orders (decoration id + pass count folded in)."""
+    dec_id = next(_shuffle_ids)
+    calls = itertools.count()
+
     def reader():
+        n = next(calls)
+        base = seed
+        if base is None:
+            from paddle_tpu.utils.flags import GLOBAL_FLAGS
+            s = GLOBAL_FLAGS.get("seed", 0)
+            base = s if s else None
+        if base is None:
+            rng = random  # reference behavior: the global random module
+        else:
+            rng = random.Random((base * 1000003 + dec_id) * 1000003 + n)
         buf = []
         for e in reader_fn():
             buf.append(e)
             if len(buf) >= buf_size:
-                random.shuffle(buf)
+                rng.shuffle(buf)
                 yield from buf
                 buf = []
-        random.shuffle(buf)
+        rng.shuffle(buf)
         yield from buf
     return reader
 

@@ -38,9 +38,11 @@ class Value:
     lengths: Optional[jax.Array] = None          # [batch] for sequence data
     sub_lengths: Optional[jax.Array] = None      # level-2 LoD
     weights: Optional[jax.Array] = None          # sparse nonzero values
+    pre_act: Optional[jax.Array] = None          # logits before the activation
 
     def tree_flatten(self):
-        return (self.array, self.lengths, self.sub_lengths, self.weights), None
+        return (self.array, self.lengths, self.sub_lengths, self.weights,
+                self.pre_act), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -54,8 +56,9 @@ class Value:
     def is_sparse(self):
         return self.weights is not None
 
-    def with_array(self, array) -> "Value":
-        return Value(array, self.lengths, self.sub_lengths, self.weights)
+    def with_array(self, array, pre_act=None) -> "Value":
+        return Value(array, self.lengths, self.sub_lengths, self.weights,
+                     pre_act)
 
 
 @dataclasses.dataclass
@@ -191,7 +194,11 @@ class Topology:
                     else:
                         parent_vals = [values[p.name] for p in layer.parents]
                         values[layer.name] = layer.fn(params, parent_vals, ctx)
-            outs = {o.name: values[o.name] for o in wanted}
+            # strip pre_act from returned outputs: jit can't DCE returned
+            # values, and the logits kept for cost fusion are dead weight
+            # once a softmax layer is itself an output
+            outs = {o.name: values[o.name].with_array(values[o.name].array)
+                    for o in wanted}
             return outs, ctx.state_out
 
         return forward
