@@ -30,3 +30,7 @@ class ExtraAttr:
 
 ExtraLayerAttribute = ExtraAttr
 ParameterAttribute = ParamAttr
+
+# v2 aliases (reference: python/paddle/v2/attr.py __all__ = Param/Extra/Hook)
+Param = ParamAttr
+Extra = ExtraAttr

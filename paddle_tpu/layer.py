@@ -866,3 +866,121 @@ def hinge_cost(input, label, name: Optional[str] = None):
         return ops_loss.hinge(parents[0].array, parents[1].array.reshape(-1))
 
     return _cost_layer(name, "hinge_cost", [input, label], per_example)
+
+
+def crf_layer(input, label, size: Optional[int] = None,
+              name: Optional[str] = None, param_attr=None):
+    """Linear-chain CRF cost over a sequence of emissions.
+
+    ``input`` is a sequence layer with per-token tag scores (size = #tags),
+    ``label`` an integer tag sequence. Produces the per-sequence negative
+    log-likelihood. Reference: crf_layer (trainer_config_helpers/layers.py),
+    gserver/layers/CRFLayer.cpp, operators/linear_chain_crf_op.cc — same
+    (#tags+2, #tags) transition parameterization (start/end rows first).
+    """
+    from paddle_tpu.ops import crf as ops_crf
+    name = name or auto_name("crf")
+    enforce.enforce(size is None or size == input.size,
+                    f"crf_layer size {size} != input size {input.size}")
+    n_tags = size or input.size
+    a = _param_attr(param_attr if isinstance(param_attr, ParamAttr)
+                    else ParamAttr(), f"{name}.w")
+    spec = ParamSpec(a.name, (n_tags + 2, n_tags), attr=a, fan_in=n_tags)
+
+    def fwd(params, parents, ctx):
+        ev, lv = parents
+        enforce.enforce(ev.is_sequence, "crf_layer input must be a sequence")
+        emis = ev.pre_act if ev.pre_act is not None else ev.array
+        tags = lv.array.astype(jnp.int32)
+        if tags.ndim == 3:
+            tags = tags[..., 0]
+        nll = -ops_crf.crf_log_likelihood(emis, tags, ev.lengths,
+                                          params[spec.name])
+        return Value(nll)
+
+    return LayerOutput(name, "crf", [input, label], fwd, [spec], size=1)
+
+
+def crf_decoding_layer(input, size: Optional[int] = None, label=None,
+                       name: Optional[str] = None, param_attr=None):
+    """Viterbi decode with a (shared) CRF transition parameter.
+
+    Without ``label``: outputs the best tag sequence [B, T]. With ``label``:
+    outputs a per-token 0/1 mismatch mask (the reference's evaluation mode,
+    operators/crf_decoding_op.cc:24-35, gserver CRFDecodingLayer).
+    Share transitions with the training crf_layer via
+    ``param_attr=ParamAttr(name=...)``.
+    """
+    from paddle_tpu.ops import crf as ops_crf
+    name = name or auto_name("crf_decoding")
+    n_tags = size or input.size
+    a = _param_attr(param_attr if isinstance(param_attr, ParamAttr)
+                    else ParamAttr(), f"{name}.w")
+    spec = ParamSpec(a.name, (n_tags + 2, n_tags), attr=a, fan_in=n_tags)
+    inputs = [input] + ([label] if label is not None else [])
+
+    def fwd(params, parents, ctx):
+        ev = parents[0]
+        enforce.enforce(ev.is_sequence,
+                        "crf_decoding_layer input must be a sequence")
+        emis = ev.pre_act if ev.pre_act is not None else ev.array
+        tags, _ = ops_crf.crf_decode(emis, ev.lengths, params[spec.name])
+        if label is not None:
+            lab = parents[1].array.astype(jnp.int32)
+            if lab.ndim == 3:
+                lab = lab[..., 0]
+            mask = (jnp.arange(tags.shape[1])[None, :] <
+                    ev.lengths[:, None])
+            err = jnp.where(mask, (tags != lab).astype(jnp.float32), 0.0)
+            return Value(err, ev.lengths)
+        return Value(tags, ev.lengths)
+
+    return LayerOutput(name, "crf_decoding", inputs, fwd, [spec], size=1)
+
+
+def ctc_layer(input, label, size: Optional[int] = None,
+              blank: Optional[int] = None, norm_by_times: bool = False,
+              name: Optional[str] = None):
+    """CTC cost. ``input``: sequence layer of per-frame class scores
+    (size = #labels + 1 incl. blank); ``label``: target label sequence.
+    Default blank is the LAST class index, matching the v1 ctc_layer
+    (gserver/layers/CTCLayer.cpp, LinearChainCTC.cpp uses numClasses-1);
+    warp_ctc_layer defaults to blank=0 (WarpCTCLayer.cpp).
+    Reference: ctc_layer / warp_ctc_layer (trainer_config_helpers/layers.py).
+    """
+    from paddle_tpu.ops import ctc as ops_ctc
+    name = name or auto_name("ctc")
+    enforce.enforce(size is None or size == input.size,
+                    f"ctc_layer size {size} != input size {input.size}")
+    n_classes = size or input.size
+    blank_idx = n_classes - 1 if blank is None else blank
+
+    def fwd(params, parents, ctx):
+        ev, lv = parents
+        if ev.pre_act is not None:
+            logp = jax.nn.log_softmax(ev.pre_act.astype(jnp.float32), axis=-1)
+        elif input.activation == "softmax":
+            logp = jnp.log(jnp.maximum(ev.array.astype(jnp.float32), 1e-30))
+        else:
+            logp = jax.nn.log_softmax(ev.array.astype(jnp.float32), axis=-1)
+        lab = lv.array.astype(jnp.int32)
+        if lab.ndim == 3:
+            lab = lab[..., 0]
+        enforce.enforce(ev.is_sequence and lv.is_sequence,
+                        "ctc_layer input and label must be sequences")
+        nll = ops_ctc.ctc_loss(logp, lab, ev.lengths, lv.lengths,
+                               blank=blank_idx)
+        if norm_by_times:
+            nll = nll / jnp.maximum(ev.lengths.astype(jnp.float32), 1.0)
+        return Value(nll)
+
+    return LayerOutput(name, "ctc", [input, label], fwd, [], size=1)
+
+
+def warp_ctc_layer(input, label, size: Optional[int] = None, blank: int = 0,
+                   norm_by_times: bool = False, name: Optional[str] = None):
+    """warp-ctc flavor: blank defaults to 0 (reference: WarpCTCLayer.cpp,
+    hl_warpctc_wrap.cc)."""
+    return ctc_layer(input, label, size=size, blank=blank,
+                     norm_by_times=norm_by_times,
+                     name=name or auto_name("warp_ctc"))

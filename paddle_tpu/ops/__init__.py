@@ -24,6 +24,8 @@ from paddle_tpu.ops import sequence
 from paddle_tpu.ops import rnn
 from paddle_tpu.ops import sparse
 from paddle_tpu.ops import topk
+from paddle_tpu.ops import crf
+from paddle_tpu.ops import ctc
 
 from paddle_tpu.ops.math import matmul, linear
 from paddle_tpu.ops.sparse import embedding_lookup

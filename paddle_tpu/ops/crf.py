@@ -1,0 +1,109 @@
+"""Linear-chain CRF: log-likelihood and Viterbi decoding as lax.scan
+dynamic programs.
+
+Reference: paddle/operators/linear_chain_crf_op.cc (forward/alpha recursion,
+the (D+2)-row transition parameterization: w[0]=start weights a, w[1]=end
+weights b, w[2:]=transition matrix), paddle/operators/crf_decoding_op.cc
+(Viterbi), paddle/gserver/layers/CRFLayer.cpp + LinearChainCRF.cpp.
+
+TPU design: padded batch-major emissions [B, T, N] + per-sequence lengths,
+one scan over time (each step is a dense [B, N, N] logsumexp/max — MXU/VPU
+friendly), instead of the reference's per-sequence CPU loops over LoD slices.
+Gradients come from jax.grad through the scan (the reference hand-codes the
+beta recursion in linear_chain_crf_op.h).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _split_transitions(transitions: jax.Array):
+    """transitions: [N+2, N] — row 0 start, row 1 end, rows 2: pairwise
+    (trans[i, j] = score of moving from tag i to tag j)."""
+    return transitions[0], transitions[1], transitions[2:]
+
+
+def crf_log_norm(emissions: jax.Array, lengths: jax.Array,
+                 transitions: jax.Array) -> jax.Array:
+    """log Z per sequence. emissions [B, T, N] float, lengths [B]."""
+    start, end, trans = _split_transitions(transitions)
+    em = emissions.astype(jnp.float32)
+    B, T, N = em.shape
+    alpha0 = start[None, :] + em[:, 0]
+
+    def step(alpha, inputs):
+        e_t, t = inputs
+        # [B, prev, next]: alpha + trans, logsumexp over prev
+        scores = alpha[:, :, None] + trans[None].astype(jnp.float32)
+        new = jax.scipy.special.logsumexp(scores, axis=1) + e_t
+        alive = (t < lengths)[:, None]
+        return jnp.where(alive, new, alpha), None
+
+    ts = jnp.arange(1, T)
+    alpha, _ = jax.lax.scan(step, alpha0, (em[:, 1:].swapaxes(0, 1), ts))
+    return jax.scipy.special.logsumexp(alpha + end[None, :].astype(jnp.float32),
+                                       axis=-1)
+
+
+def crf_sequence_score(emissions: jax.Array, tags: jax.Array,
+                       lengths: jax.Array, transitions: jax.Array) -> jax.Array:
+    """Unnormalized score of the given tag paths. tags [B, T] int."""
+    start, end, trans = _split_transitions(transitions)
+    em = emissions.astype(jnp.float32)
+    B, T, N = em.shape
+    tags = tags.astype(jnp.int32)
+    step_idx = jnp.arange(T)[None, :]
+    valid = step_idx < lengths[:, None]                       # [B, T]
+    emit = jnp.take_along_axis(em, tags[..., None], axis=-1)[..., 0]
+    score = jnp.sum(jnp.where(valid, emit, 0.0), axis=1)
+    score = score + start.astype(jnp.float32)[tags[:, 0]]
+    pair = trans.astype(jnp.float32)[tags[:, :-1], tags[:, 1:]]   # [B, T-1]
+    pair_valid = step_idx[:, 1:] < lengths[:, None]
+    score = score + jnp.sum(jnp.where(pair_valid, pair, 0.0), axis=1)
+    last = jnp.take_along_axis(tags, (lengths - 1)[:, None], axis=1)[:, 0]
+    return score + end.astype(jnp.float32)[last]
+
+
+def crf_log_likelihood(emissions: jax.Array, tags: jax.Array,
+                       lengths: jax.Array, transitions: jax.Array) -> jax.Array:
+    """Per-sequence log p(tags | emissions). Negate for the training cost
+    (reference: linear_chain_crf_op.cc computes the same -log-likelihood)."""
+    return (crf_sequence_score(emissions, tags, lengths, transitions)
+            - crf_log_norm(emissions, lengths, transitions))
+
+
+def crf_decode(emissions: jax.Array, lengths: jax.Array,
+               transitions: jax.Array):
+    """Viterbi decode → (best_tags [B, T] int32, best_score [B]).
+    Padded steps repeat the final tag (reference crf_decoding_op zeroes
+    them; callers mask by lengths either way)."""
+    start, end, trans = _split_transitions(transitions)
+    em = emissions.astype(jnp.float32)
+    B, T, N = em.shape
+    trans_f = trans.astype(jnp.float32)
+    delta0 = start[None, :].astype(jnp.float32) + em[:, 0]
+
+    def fwd(delta, inputs):
+        e_t, t = inputs
+        scores = delta[:, :, None] + trans_f[None]            # [B, prev, next]
+        bp = jnp.argmax(scores, axis=1).astype(jnp.int32)     # [B, next]
+        new = jnp.max(scores, axis=1) + e_t
+        alive = (t < lengths)[:, None]
+        return jnp.where(alive, new, delta), bp
+
+    ts = jnp.arange(1, T)
+    delta, bps = jax.lax.scan(fwd, delta0, (em[:, 1:].swapaxes(0, 1), ts))
+    final = delta + end[None, :].astype(jnp.float32)
+    last_tag = jnp.argmax(final, axis=-1).astype(jnp.int32)
+    best_score = jnp.max(final, axis=-1)
+
+    def back(tag, inputs):
+        bp, t = inputs
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        # step t only happened for sequences with t < length
+        tag_prev = jnp.where(t < lengths, prev, tag)
+        return tag_prev, tag
+
+    first, tags_rev = jax.lax.scan(back, last_tag, (bps, ts), reverse=True)
+    tags = jnp.concatenate([first[None], tags_rev], axis=0)   # [T, B]
+    return tags.swapaxes(0, 1), best_score

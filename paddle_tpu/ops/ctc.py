@@ -1,0 +1,92 @@
+"""CTC loss (Connectionist Temporal Classification) as a log-space
+alpha-recursion lax.scan.
+
+Reference: the warp-ctc integration (paddle/cuda/src/hl_warpctc_wrap.cc,
+gserver/layers/WarpCTCLayer.cpp) and the in-tree CPU DP
+(gserver/layers/LinearChainCTC.cpp), plus operators' CTC evaluator
+(gserver/evaluators/CTCErrorEvaluator.cpp for edit-distance decoding).
+
+TPU design: one scan over time on the extended label lattice [B, 2L+1];
+every step is a batched gather + logsumexp of three shifted lanes — no
+per-sequence host loops. Gradients via jax.grad through the scan (warp-ctc
+hand-codes the beta recursion).
+"""
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _extended_labels(labels: jax.Array, blank: int):
+    """labels [B, L] → lattice labels [B, 2L+1]: blank, l1, blank, l2, ..."""
+    B, L = labels.shape
+    ext = jnp.full((B, 2 * L + 1), blank, labels.dtype)
+    return ext.at[:, 1::2].set(labels)
+
+
+def ctc_loss(log_probs: jax.Array, labels: jax.Array,
+             input_lengths: jax.Array, label_lengths: jax.Array,
+             blank: int = 0) -> jax.Array:
+    """Negative log p(labels | inputs) per sequence.
+
+    log_probs: [B, T, C] log-softmax outputs (C includes the blank class),
+    labels: [B, L] int padded, input_lengths/label_lengths: [B].
+    """
+    lp = log_probs.astype(jnp.float32)
+    B, T, C = lp.shape
+    labels = labels.astype(jnp.int32)
+    ext = _extended_labels(labels, blank)                     # [B, S]
+    S = ext.shape[1]
+
+    # alpha[s] may also come from s-2 when ext[s] is a label differing from
+    # ext[s-2] (the standard CTC skip rule)
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    can_skip = (ext != blank) & (ext != ext_m2)               # [B, S]
+
+    emit0 = jnp.take_along_axis(lp[:, 0], ext, axis=1)        # [B, S]
+    s_idx = jnp.arange(S)[None, :]
+    alpha0 = jnp.where(s_idx < 2, emit0, NEG_INF)
+
+    def shift(a, k):
+        return jnp.pad(a, ((0, 0), (k, 0)), constant_values=NEG_INF)[:, :S]
+
+    def step(alpha, inputs):
+        lp_t, t = inputs                                       # [B, C], scalar
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)          # [B, S]
+        stay = alpha
+        prev1 = shift(alpha, 1)
+        prev2 = jnp.where(can_skip, shift(alpha, 2), NEG_INF)
+        new = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2) + emit
+        alive = (t < input_lengths)[:, None]
+        return jnp.where(alive, new, alpha), None
+
+    ts = jnp.arange(1, T)
+    alpha, _ = jax.lax.scan(step, alpha0, (lp[:, 1:].swapaxes(0, 1), ts))
+
+    # total prob = alpha[2*label_len] (final blank) + alpha[2*label_len - 1]
+    send = (2 * label_lengths).astype(jnp.int32)[:, None]      # [B, 1]
+    a_last = jnp.take_along_axis(alpha, send, axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, jnp.maximum(send - 1, 0), axis=1)[:, 0]
+    # empty label sequences (label_len == 0) only have the final-blank path
+    a_prev = jnp.where(label_lengths > 0, a_prev, NEG_INF)
+    return -jnp.logaddexp(a_last, a_prev)
+
+
+def ctc_greedy_decode(log_probs: jax.Array, input_lengths: jax.Array,
+                      blank: int = 0):
+    """Best-path decode: argmax per frame, collapse repeats, drop blanks.
+    Returns (decoded [B, T] int32 padded with blank, lengths [B]).
+    Reference: CTCErrorEvaluator.cpp best-path decoding."""
+    ids = jnp.argmax(log_probs, axis=-1).astype(jnp.int32)    # [B, T]
+    B, T = ids.shape
+    prev = jnp.pad(ids, ((0, 0), (1, 0)), constant_values=-1)[:, :T]
+    frame_valid = jnp.arange(T)[None, :] < input_lengths[:, None]
+    keep = (ids != blank) & (ids != prev) & frame_valid       # [B, T]
+    # stable left-compaction of kept symbols
+    pos = jnp.cumsum(keep, axis=1) - 1                        # target slot
+    out = jnp.full((B, T), blank, jnp.int32)
+    bidx = jnp.arange(B)[:, None]
+    out = out.at[bidx, jnp.where(keep, pos, T)].set(ids, mode="drop")
+    dec_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return out, dec_len

@@ -1,0 +1,195 @@
+"""CRF + CTC dynamic programs vs brute-force references.
+
+Mirrors the reference's strategy for these ops: linear_chain_crf_op is tested
+against a per-sequence numpy DP (test_linear_chain_crf_op.py) and CTC against
+path enumeration (gserver/tests/test_LinearChainCRF.cpp, test_WarpCTCLayer).
+Here tiny cases are checked by *exhaustive path enumeration* in float64 —
+stronger than a second DP — plus jax.grad vs numeric gradients.
+"""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import crf as ops_crf
+from paddle_tpu.ops import ctc as ops_ctc
+from op_test_util import check_grad
+
+
+def brute_crf(emis, tags, length, w):
+    """Path score and logZ by enumeration. emis [T, N], w [(N+2), N]."""
+    start, end, trans = w[0], w[1], w[2:]
+    N = emis.shape[1]
+
+    def score(path):
+        s = start[path[0]] + end[path[length - 1]]
+        for t in range(length):
+            s += emis[t, path[t]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]]
+        return s
+
+    all_scores = [score(p) for p in itertools.product(range(N), repeat=length)]
+    logz = np.logaddexp.reduce(np.array(all_scores, np.float64))
+    return score(tags[:length]), logz
+
+
+class TestCRF:
+    def setup_method(self, _):
+        rng = np.random.RandomState(7)
+        self.B, self.T, self.N = 3, 4, 3
+        self.emis = rng.randn(self.B, self.T, self.N).astype(np.float64)
+        self.w = (0.5 * rng.randn(self.N + 2, self.N)).astype(np.float64)
+        self.lengths = np.array([4, 2, 3], np.int32)
+        self.tags = rng.randint(0, self.N, (self.B, self.T)).astype(np.int32)
+
+    def test_log_likelihood_vs_enumeration(self):
+        got = np.asarray(ops_crf.crf_log_likelihood(
+            jnp.asarray(self.emis, jnp.float32), jnp.asarray(self.tags),
+            jnp.asarray(self.lengths), jnp.asarray(self.w, jnp.float32)))
+        for b in range(self.B):
+            sc, logz = brute_crf(self.emis[b], self.tags[b],
+                                 int(self.lengths[b]), self.w)
+            np.testing.assert_allclose(got[b], sc - logz, rtol=1e-4, atol=1e-4)
+
+    def test_decode_vs_enumeration(self):
+        tags, score = ops_crf.crf_decode(
+            jnp.asarray(self.emis, jnp.float32), jnp.asarray(self.lengths),
+            jnp.asarray(self.w, jnp.float32))
+        tags, score = np.asarray(tags), np.asarray(score)
+        for b in range(self.B):
+            L, N = int(self.lengths[b]), self.N
+            best, best_p = -1e30, None
+            for p in itertools.product(range(N), repeat=L):
+                s, _ = brute_crf(self.emis[b], list(p), L, self.w)
+                if s > best:
+                    best, best_p = s, p
+            assert tuple(tags[b, :L]) == best_p
+            np.testing.assert_allclose(score[b], best, rtol=1e-4, atol=1e-4)
+
+    def test_grads(self):
+        lengths, tags = jnp.asarray(self.lengths), jnp.asarray(self.tags)
+
+        def nll_wrt_emis(emis, w):
+            return -ops_crf.crf_log_likelihood(emis, tags, lengths, w)
+
+        check_grad(nll_wrt_emis, [self.emis.astype(np.float32),
+                                  self.w.astype(np.float32)], wrt=0)
+        check_grad(nll_wrt_emis, [self.emis.astype(np.float32),
+                                  self.w.astype(np.float32)], wrt=1)
+
+    def test_jit_and_padding_invariance(self):
+        # padded tail values must not affect results
+        e2 = self.emis.copy()
+        e2[1, 2:] = 999.0  # sequence 1 has length 2
+        f = jax.jit(ops_crf.crf_log_likelihood)
+        a = f(jnp.asarray(self.emis, jnp.float32), jnp.asarray(self.tags),
+              jnp.asarray(self.lengths), jnp.asarray(self.w, jnp.float32))
+        b = f(jnp.asarray(e2, jnp.float32), jnp.asarray(self.tags),
+              jnp.asarray(self.lengths), jnp.asarray(self.w, jnp.float32))
+        np.testing.assert_allclose(a[1], b[1], rtol=1e-5)
+
+
+def brute_ctc(logp, label, T):
+    """-log p(label) by enumerating all T-length alignment paths."""
+    C = logp.shape[1]
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        # collapse: remove repeats then blanks (blank=0)
+        collapsed = []
+        prev = -1
+        for c in path:
+            if c != prev and c != 0:
+                collapsed.append(c)
+            prev = c
+        if collapsed == list(label):
+            total = np.logaddexp(total, sum(logp[t, path[t]]
+                                            for t in range(T)))
+    return -total
+
+
+class TestCTC:
+    def _logp(self, rng, B, T, C):
+        x = rng.randn(B, T, C).astype(np.float64)
+        return x - np.log(np.sum(np.exp(x), -1, keepdims=True))
+
+    def test_vs_enumeration(self):
+        rng = np.random.RandomState(3)
+        B, T, C, L = 3, 4, 3, 2
+        logp = self._logp(rng, B, T, C)
+        labels = np.array([[1, 2], [2, 2], [1, 0]], np.int32)
+        lab_len = np.array([2, 2, 1], np.int32)
+        in_len = np.array([4, 4, 3], np.int32)
+        got = np.asarray(ops_ctc.ctc_loss(
+            jnp.asarray(logp, jnp.float32), jnp.asarray(labels),
+            jnp.asarray(in_len), jnp.asarray(lab_len)))
+        for b in range(B):
+            want = brute_ctc(logp[b, :in_len[b]],
+                             list(labels[b, :lab_len[b]]), int(in_len[b]))
+            np.testing.assert_allclose(got[b], want, rtol=1e-4, atol=1e-4)
+
+    def test_empty_label(self):
+        rng = np.random.RandomState(4)
+        logp = self._logp(rng, 1, 3, 3)
+        got = float(ops_ctc.ctc_loss(jnp.asarray(logp, jnp.float32),
+                                     jnp.zeros((1, 2), jnp.int32),
+                                     jnp.array([3]), jnp.array([0]))[0])
+        want = -float(logp[0, 0, 0] + logp[0, 1, 0] + logp[0, 2, 0])
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_grad(self):
+        rng = np.random.RandomState(5)
+        B, T, C = 2, 4, 3
+        x = rng.randn(B, T, C).astype(np.float32)
+        labels = jnp.asarray(np.array([[1, 2], [2, 1]], np.int32))
+        in_len, lab_len = jnp.array([4, 3]), jnp.array([2, 2])
+
+        def loss(logits):
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return ops_ctc.ctc_loss(logp, labels, in_len, lab_len)
+
+        check_grad(loss, [x], wrt=0)
+
+    def test_greedy_decode(self):
+        # frames argmax: [1,1,0,2] -> collapse -> [1,2]
+        logp = np.full((1, 4, 3), -5.0, np.float32)
+        for t, c in enumerate([1, 1, 0, 2]):
+            logp[0, t, c] = 0.0
+        out, n = ops_ctc.ctc_greedy_decode(jnp.asarray(logp), jnp.array([4]))
+        assert int(n[0]) == 2
+        assert list(np.asarray(out[0, :2])) == [1, 2]
+
+
+class TestCRFLayers:
+    def test_crf_train_and_decode_layers(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import layer
+        from paddle_tpu.topology import Topology, Value
+        from paddle_tpu.utils.rng import KeySource
+
+        T, N = 5, 4
+        feat = layer.data("feat", paddle.data_type.dense_vector_sequence(8))
+        lab = layer.data("lab", paddle.data_type.integer_value_sequence(N))
+        emis = layer.fc(feat, size=N, act="linear", name="emis")
+        cost = layer.crf_layer(emis, lab, name="crf",
+                               param_attr=paddle.attr.Param(name="crfw"))
+        dec = layer.crf_decoding_layer(
+            emis, size=N, param_attr=paddle.attr.Param(name="crfw"),
+            name="dec")
+        topo = Topology([cost, dec])
+        params = paddle.parameters.create([cost, dec], KeySource(0))
+        fwd = topo.compile()
+        rng = np.random.RandomState(0)
+        B = 3
+        x = jnp.asarray(rng.randn(B, T, 8).astype(np.float32))
+        lens = jnp.asarray(np.array([5, 3, 4], np.int32))
+        y = jnp.asarray(rng.randint(0, N, (B, T)).astype(np.int32))
+        outs, _ = fwd(params.values, params.state,
+                      {"feat": Value(x, lengths=lens),
+                       "lab": Value(y, lengths=lens)})
+        assert outs["crf"].array.shape == (B,)
+        assert np.all(np.asarray(outs["crf"].array) > 0)  # NLL positive
+        assert outs["dec"].array.shape == (B, T)
