@@ -36,7 +36,7 @@ _SUBMODULES = (
     "utils", "core", "ops", "layer", "activation", "attr", "data_type",
     "initializer", "networks", "optimizer", "parameters", "pooling",
     "topology", "trainer", "event", "reader", "dataset", "inference",
-    "evaluator", "parallel", "models", "io", "runtime",
+    "evaluator", "parallel", "models", "io", "runtime", "recurrent",
 )
 
 

@@ -34,6 +34,12 @@ from paddle_tpu.ops.math import linear as ops_linear, matmul
 from paddle_tpu.topology import LayerOutput, Value, auto_name
 from paddle_tpu.utils import enforce
 
+# the dynamic-RNN DSL lives in paddle_tpu.recurrent; re-exported here to
+# mirror the reference surface (trainer_config_helpers/layers.py had
+# recurrent_group/memory/beam_search in the same namespace as fc/lstmemory)
+from paddle_tpu.recurrent import (recurrent_group, memory, beam_search,
+                                  StaticInput, GeneratedInput)
+
 
 def _as_list(x):
     if x is None:
@@ -913,6 +919,8 @@ def crf_decoding_layer(input, size: Optional[int] = None, label=None,
     """
     from paddle_tpu.ops import crf as ops_crf
     name = name or auto_name("crf_decoding")
+    enforce.enforce(size is None or size == input.size,
+                    f"crf_decoding size {size} != input size {input.size}")
     n_tags = size or input.size
     a = _param_attr(param_attr if isinstance(param_attr, ParamAttr)
                     else ParamAttr(), f"{name}.w")
@@ -984,3 +992,70 @@ def warp_ctc_layer(input, label, size: Optional[int] = None, blank: int = 0,
     return ctc_layer(input, label, size=size, blank=blank,
                      norm_by_times=norm_by_times,
                      name=name or auto_name("warp_ctc"))
+
+
+def gru_step(input, state, size: Optional[int] = None,
+             name: Optional[str] = None, param_attr=None, bias_attr=None):
+    """One GRU step for use inside recurrent_group (reference:
+    gru_step_layer, trainer_config_helpers/layers.py; GruStepLayer.cpp).
+    ``input``: the projected step input [B, 3H] (W·x, as in the reference —
+    compute it with an fc of size 3*size); ``state``: an H-wide memory."""
+    name = name or auto_name("gru_step")
+    size = size or input.size // 3
+    a = _param_attr(param_attr if isinstance(param_attr, ParamAttr)
+                    else ParamAttr(), f"{name}.w")
+    w_spec = ParamSpec(a.name, (size, 3 * size), attr=a, fan_in=size)
+    bias = _bias_spec(name, 3 * size, bias_attr)
+    specs = [w_spec] + ([bias] if bias else [])
+
+    def fwd(params, parents, ctx):
+        xv, sv = parents
+        xp = xv.array
+        if bias:
+            xp = xp + params[bias.name].astype(xp.dtype)
+        h = ops_rnn.gru_cell(xp, sv.array, params[w_spec.name])
+        return Value(h, xv.lengths, xv.sub_lengths)
+
+    return LayerOutput(name, "gru_step", [input, state], fwd, specs,
+                       size=size)
+
+
+def lstm_step(input, state, cell_state, size: Optional[int] = None,
+              name: Optional[str] = None, param_attr=None, bias_attr=None,
+              forget_bias: float = 0.0):
+    """One LSTM step for recurrent_group (reference: lstm_step_layer).
+    ``input``: projected step input [B, 4H]; ``state``/``cell_state``:
+    H-wide memories for h and c. Returns (h_layer, c_layer) — link the h
+    memory to the first and the c memory to the second."""
+    name = name or auto_name("lstm_step")
+    size = size or input.size // 4
+    a = _param_attr(param_attr if isinstance(param_attr, ParamAttr)
+                    else ParamAttr(), f"{name}.w")
+    w_spec = ParamSpec(a.name, (size, 4 * size), attr=a, fan_in=size)
+    bias = _bias_spec(name, 4 * size, bias_attr)
+    specs = [w_spec] + ([bias] if bias else [])
+
+    def fwd_h(params, parents, ctx):
+        xv, hv, cv = parents
+        xp = xv.array
+        if bias:
+            xp = xp + params[bias.name].astype(xp.dtype)
+        st = ops_rnn.lstm_cell(xp, ops_rnn.LSTMState(hv.array, cv.array),
+                               params[w_spec.name], forget_bias)
+        return Value(st.h, xv.lengths, xv.sub_lengths)
+
+    h_layer = LayerOutput(name, "lstm_step", [input, state, cell_state],
+                          fwd_h, specs, size=size)
+
+    def fwd_c(params, parents, ctx):
+        xv, hv, cv = parents
+        xp = xv.array
+        if bias:
+            xp = xp + params[bias.name].astype(xp.dtype)
+        st = ops_rnn.lstm_cell(xp, ops_rnn.LSTMState(hv.array, cv.array),
+                               params[w_spec.name], forget_bias)
+        return Value(st.c, xv.lengths, xv.sub_lengths)
+
+    c_layer = LayerOutput(f"{name}@cell", "lstm_step_cell",
+                          [input, state, cell_state], fwd_c, specs, size=size)
+    return h_layer, c_layer

@@ -5,5 +5,6 @@ from paddle_tpu.models import alexnet
 from paddle_tpu.models import googlenet
 from paddle_tpu.models import resnet
 from paddle_tpu.models import smallnet
+from paddle_tpu.models import seq2seq
 from paddle_tpu.models import text
 from paddle_tpu.models import vgg

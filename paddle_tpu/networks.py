@@ -83,3 +83,59 @@ def img_conv_bn_pool(input, filter_size, num_filters, pool_size,
     return layer.img_pool(bn, pool_size=pool_size, stride=pool_stride,
                           pool_type=pool_type,
                           name=f"{name}_pool" if name else None)
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     name=None, transform_param_attr=None):
+    """Bahdanau-style additive attention inside a recurrent_group step.
+
+    Reference: simple_attention (trainer_config_helpers/networks.py) — score
+    each encoder position by tanh(enc_proj + W·state)·v via a sequence
+    softmax, return the weighted sum of ``encoded_sequence``.
+
+    ``encoded_sequence``/``encoded_proj`` are step placeholders fed from
+    StaticInput(..., is_seq=True); ``decoder_state`` is a memory.
+    """
+    from paddle_tpu.core.param import ParamSpec, ParamAttr
+    from paddle_tpu.layer import _param_attr
+    from paddle_tpu.topology import LayerOutput, Value, auto_name
+    import jax.numpy as jnp
+
+    name = name or auto_name("attention")
+    proj_size = encoded_proj.size
+    a = _param_attr(transform_param_attr, f"{name}.decoder_proj.w")
+    w_spec = ParamSpec(a.name, (decoder_state.size, proj_size), attr=a,
+                       fan_in=decoder_state.size)
+    v_attr = ParamAttr(name=f"{name}.v")
+    v_spec = ParamSpec(v_attr.name, (proj_size,), attr=v_attr,
+                       fan_in=proj_size)
+
+    def fwd(params, parents, ctx):
+        enc, enc_proj, state = parents
+        # enc.array [B, T, F]; state.array [B, H]
+        dec = jnp.matmul(state.array, params[w_spec.name])       # [B, P]
+        e = jnp.tanh(enc_proj.array + dec[:, None, :])           # [B, T, P]
+        scores = jnp.einsum("btp,p->bt", e, params[v_spec.name])
+        from paddle_tpu.ops import sequence as ops_seq
+        w = ops_seq.seq_softmax(scores[..., None], enc.lengths)[..., 0]
+        cvec = jnp.einsum("bt,btf->bf", w, enc.array)
+        return Value(cvec)
+
+    return LayerOutput(name, "attention",
+                       [encoded_sequence, encoded_proj, decoder_state],
+                       fwd, [w_spec, v_spec], size=encoded_sequence.size)
+
+
+def gru_decoder_with_attention(encoded_sequence, encoded_proj, current_word,
+                               decoder_size, boot_layer, name="gru_decoder"):
+    """One decoder step: attention context + previous word → GRU → softmax
+    (reference: the seqToseq demo's gru_decoder_with_attention,
+    v1_api_demo-era seqToseq_net). Use inside recurrent_group/beam_search."""
+    state = layer.memory(name=name, size=decoder_size,
+                         boot_layer=boot_layer)
+    context = simple_attention(encoded_sequence, encoded_proj, state,
+                               name=f"{name}_att")
+    inputs = layer.fc([context, current_word], size=decoder_size * 3,
+                      act="linear", name=f"{name}_input", bias_attr=False)
+    gru = layer.gru_step(inputs, state=state, size=decoder_size, name=name)
+    return gru

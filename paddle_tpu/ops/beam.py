@@ -1,0 +1,105 @@
+"""Fixed-width batched beam search as a lax.while_loop.
+
+Reference: paddle/gserver/gradientmachines/RecurrentGradientMachine.cpp
+(generateSequence/beamSearch — per-path dynamic beams on the host, 1,501 LoC)
+and the new-stack beam_search_op.cc / beam_search_decode_op.cc; exposed to
+users as SWIG SequenceGenerator (paddle/api/PaddleAPI.h:1025).
+
+TPU design: the beam is a static [batch, beam] lattice — every step scores
+all beam*vocab continuations with one batched matmul-backed step function,
+takes a single top-k, and gathers the recurrent state pytree by parent index.
+Finished beams are masked (forced to extend with EOS at zero cost) instead of
+being removed, so shapes stay static for XLA. The dynamic per-path pruning
+of the reference becomes dense masking — the idiomatic accelerator trade.
+"""
+
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+class BeamState(NamedTuple):
+    tokens: jax.Array      # [B, K, T_max] int32, bos-seeded, eos-padded
+    scores: jax.Array      # [B, K] cumulative log-prob
+    finished: jax.Array    # [B, K] bool
+    lengths: jax.Array     # [B, K] int32 generated length (excl. bos)
+    state: object          # step-fn recurrent state pytree, leaves [B, K, ...]
+
+
+def _gather_beams(tree, parent: jax.Array):
+    """Gather leaves [B, K, ...] along the beam axis by parent [B, K]."""
+    def g(x):
+        return jnp.take_along_axis(
+            x, parent.reshape(parent.shape + (1,) * (x.ndim - 2)), axis=1)
+    return jax.tree_util.tree_map(g, tree)
+
+
+def beam_search(step_fn: Callable, init_state, batch: int, beam_size: int,
+                vocab: int, bos_id: int, eos_id: int, max_len: int,
+                length_penalty: float = 0.0) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Run beam search.
+
+    step_fn(tokens_last [B, K] int32, state) -> (logp [B, K, V], new_state);
+    init_state leaves must be [B, K, ...] (tile the encoder context over K).
+    Returns (tokens [B, K, max_len], lengths [B, K], scores [B, K]) sorted
+    best-first, eos included in the length.
+    """
+    K, V = beam_size, vocab
+    tokens0 = jnp.full((batch, K, max_len + 1), eos_id, jnp.int32)
+    tokens0 = tokens0.at[:, :, 0].set(bos_id)
+    # only beam 0 live at t=0 so identical bos paths aren't duplicated
+    scores0 = jnp.where(jnp.arange(K)[None, :] == 0, 0.0, NEG_INF)
+    scores0 = jnp.broadcast_to(scores0, (batch, K)).astype(jnp.float32)
+    st = BeamState(tokens0, scores0, jnp.zeros((batch, K), bool),
+                   jnp.zeros((batch, K), jnp.int32), init_state)
+
+    def cond(carry):
+        t, st = carry
+        return (t < max_len) & ~jnp.all(st.finished)
+
+    def body(carry):
+        t, st = carry
+        last = jax.lax.dynamic_slice_in_dim(st.tokens, t, 1, axis=2)[:, :, 0]
+        logp, new_state = step_fn(last, st.state)
+        logp = logp.astype(jnp.float32)
+        # finished beams may only "extend" with eos at zero cost
+        eos_only = jnp.full((V,), NEG_INF).at[eos_id].set(0.0)
+        logp = jnp.where(st.finished[:, :, None], eos_only[None, None, :], logp)
+        total = st.scores[:, :, None] + logp                  # [B, K, V]
+        flat = total.reshape(batch, K * V)
+        top_scores, top_idx = jax.lax.top_k(flat, K)          # [B, K]
+        parent = (top_idx // V).astype(jnp.int32)
+        tok = (top_idx % V).astype(jnp.int32)
+
+        tokens = _gather_beams(st.tokens, parent)
+        tokens = jax.lax.dynamic_update_slice_in_dim(
+            tokens, tok[:, :, None], t + 1, axis=2)
+        was_finished = jnp.take_along_axis(st.finished, parent, axis=1)
+        lengths = jnp.take_along_axis(st.lengths, parent, axis=1)
+        lengths = jnp.where(was_finished, lengths, lengths + 1)
+        finished = was_finished | (tok == eos_id)
+        state = _gather_beams(new_state, parent)
+        return t + 1, BeamState(tokens, top_scores, finished, lengths, state)
+
+    _, st = jax.lax.while_loop(cond, body, (0, st))
+
+    final = st.scores
+    if length_penalty > 0.0:
+        final = final / (st.lengths.astype(jnp.float32) ** length_penalty)
+    order = jnp.argsort(-final, axis=1)
+    tokens = jnp.take_along_axis(st.tokens[:, :, 1:],
+                                 order[:, :, None], axis=1)
+    return tokens, jnp.take_along_axis(st.lengths, order, axis=1), \
+        jnp.take_along_axis(final, order, axis=1)
+
+
+def greedy_search(step_fn: Callable, init_state, batch: int, vocab: int,
+                  bos_id: int, eos_id: int, max_len: int):
+    """Greedy decode = beam_size 1 (reference: generateSequence with
+    beam_size=1 takes the argmax path)."""
+    tok, lens, sc = beam_search(step_fn, init_state, batch, 1, vocab,
+                                bos_id, eos_id, max_len)
+    return tok[:, 0], lens[:, 0], sc[:, 0]

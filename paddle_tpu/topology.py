@@ -77,6 +77,18 @@ class ForwardContext:
                                   zlib.crc32(name.encode()) & 0x7FFFFFFF)
 
 
+# recurrent-group builds install a (thread-local) hook to capture every
+# LayerOutput created while tracing a step function (memory links resolve by
+# name even when the linked layer is not an ancestor of the step outputs —
+# e.g. an LSTM cell state carried but never emitted)
+_hook_local = threading.local()
+
+
+def set_layer_creation_hook(fn):
+    prev = getattr(_hook_local, "fn", None)
+    _hook_local.fn = fn
+    return prev
+
 _name_lock = threading.Lock()
 _name_counters: Dict[str, "itertools.count"] = {}
 
@@ -109,6 +121,9 @@ class LayerOutput:
         self.activation = activation
         self.is_data = is_data
         self.data_spec = data_spec
+        hook = getattr(_hook_local, "fn", None)
+        if hook is not None:
+            hook(self)
 
     def __repr__(self):
         return f"<{self.layer_type} {self.name} size={self.size}>"
