@@ -1059,3 +1059,893 @@ def lstm_step(input, state, cell_state, size: Optional[int] = None,
     c_layer = LayerOutput(f"{name}@cell", "lstm_step_cell",
                           [input, state, cell_state], fwd_c, specs, size=size)
     return h_layer, c_layer
+
+
+# ---------------------------------------------------------------------------
+# elementwise / structural layers (reference: trainer_config_helpers/layers.py
+# interpolation_layer, power_layer, sum_to_one_norm_layer, clip_layer,
+# resize_layer, trans_layer, rotate_layer, repeat_layer, maxout_layer,
+# multiplex_layer, out_prod_layer, tensor_layer, linear_comb_layer,
+# conv_shift_layer, scale_shift_layer, prelu_layer, row_l2_norm_layer,
+# gated_unit_layer, eos_layer, sampling_id_layer and their gserver/*.cpp
+# implementations)
+# ---------------------------------------------------------------------------
+
+def _simple_layer(name, ltype, inputs, fn, size, activation=None, specs=(),
+                  meta_from=0):
+    """Stateless layer from an array function over parent Values.
+    ``meta_from``: index of the parent whose sequence metadata carries over
+    (None drops it — for layers that change the row structure)."""
+    def fwd(params, parents, ctx):
+        arr = fn(params, parents, ctx)
+        if meta_from is None:
+            return Value(arr)
+        p0 = parents[meta_from]
+        return Value(arr, p0.lengths, p0.sub_lengths)
+    return LayerOutput(name, ltype, inputs, fwd, list(specs), size=size,
+                       activation=activation)
+
+
+def interpolation(input, weight, name: Optional[str] = None):
+    """out = w*x + (1-w)*y, per-sample scalar w (reference:
+    interpolation_layer; InterpolationLayer.cpp)."""
+    name = name or auto_name("interpolation")
+    x, y = input
+    enforce.enforce(x.size == y.size, "interpolation inputs must match")
+
+    def fn(params, parents, ctx):
+        x = parents[1].array
+        w = parents[0].array.reshape((-1,) + (1,) * (x.ndim - 1))
+        return w * x + (1.0 - w) * parents[2].array
+
+    return _simple_layer(name, "interpolation", [weight, x, y], fn,
+                         x.size, meta_from=1)
+
+
+def power(input, weight, name: Optional[str] = None):
+    """out = x ** w, per-sample scalar w (reference: power_layer)."""
+    name = name or auto_name("power")
+
+    def fn(params, parents, ctx):
+        x = parents[1].array
+        w = parents[0].array.reshape((-1,) + (1,) * (x.ndim - 1))
+        return jnp.power(x, w)
+
+    return _simple_layer(name, "power", [weight, input], fn, input.size,
+                         meta_from=1)
+
+
+def sum_to_one_norm(input, name: Optional[str] = None):
+    """x / sum(x) per row (reference: sum_to_one_norm_layer)."""
+    name = name or auto_name("sum_to_one_norm")
+
+    def fn(params, parents, ctx):
+        x = parents[0].array
+        return x / jnp.sum(x, axis=-1, keepdims=True)
+
+    return _simple_layer(name, "sum_to_one_norm", [input], fn, input.size)
+
+
+def row_l2_norm(input, name: Optional[str] = None, eps: float = 1e-12):
+    """x / ||x||_2 per row (reference: row_l2_norm_layer)."""
+    name = name or auto_name("row_l2_norm")
+
+    def fn(params, parents, ctx):
+        x = parents[0].array
+        n = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True) + eps)
+        return x / n
+
+    return _simple_layer(name, "row_l2_norm", [input], fn, input.size)
+
+
+def clip(input, min: float, max: float, name: Optional[str] = None):
+    """elementwise clip (reference: clip_layer / clip_op.cc)."""
+    name = name or auto_name("clip")
+    lo, hi = min, max
+
+    def fn(params, parents, ctx):
+        return jnp.clip(parents[0].array, lo, hi)
+
+    return _simple_layer(name, "clip", [input], fn, input.size)
+
+
+def resize(input, size: int, name: Optional[str] = None):
+    """Reshape the whole batch matrix to rows of ``size`` (reference:
+    resize_layer — ResizeLayer.cpp reinterprets [B, D] as [B*D/size, size])."""
+    name = name or auto_name("resize")
+
+    def fn(params, parents, ctx):
+        return parents[0].array.reshape(-1, size)
+
+    return _simple_layer(name, "resize", [input], fn, size,
+                         meta_from=None)
+
+
+def trans(input, name: Optional[str] = None):
+    """Transpose the [B, D] batch matrix (reference: trans_layer,
+    TransLayer.cpp — used for tied-weight tricks)."""
+    name = name or auto_name("trans")
+
+    def fn(params, parents, ctx):
+        return parents[0].array.T
+
+    return _simple_layer(name, "trans", [input], fn, input.size,
+                         meta_from=None)
+
+
+def repeat(input, num_repeats: int, as_row_vector: bool = True,
+           act=None, name: Optional[str] = None):
+    """Tile each row n times (reference: repeat_layer, FeatureMapExpand).
+    as_row_vector: [a b c] -> [a b c a b c]; else [a a b b c c]."""
+    name = name or auto_name("repeat")
+    act_name = act_mod.resolve(act)
+
+    def fn(params, parents, ctx):
+        x = parents[0].array
+        if as_row_vector:
+            out = jnp.tile(x, (1,) * (x.ndim - 1) + (num_repeats,))
+        else:
+            out = jnp.repeat(x, num_repeats, axis=-1)
+        return ops_act.get(act_name)(out)
+
+    return _simple_layer(name, "repeat", [input], fn,
+                         input.size * num_repeats, activation=act_name)
+
+
+def maxout(input, groups: int, num_channels: Optional[int] = None,
+           name: Optional[str] = None):
+    """Max over ``groups`` consecutive channels (reference: maxout_layer,
+    MaxOutLayer.cpp; new stack maxout_op.cc)."""
+    name = name or auto_name("maxout")
+
+    def fn(params, parents, ctx):
+        x = parents[0].array
+        if x.ndim == 4:                        # NHWC
+            n, h, w, c = x.shape
+            return jnp.max(x.reshape(n, h, w, c // groups, groups), axis=-1)
+        n, d = x.shape
+        return jnp.max(x.reshape(n, d // groups, groups), axis=-1)
+
+    lo = _simple_layer(name, "maxout", [input], fn, input.size // groups)
+    cin = getattr(input, "_out_channels", None)
+    if cin:
+        lo._out_channels = cin // groups
+        lo._img_shape = getattr(input, "_img_shape", None)
+    return lo
+
+
+def multiplex(input, name: Optional[str] = None):
+    """Row-wise select among inputs by an index layer (reference:
+    multiplex_layer, MultiplexLayer.cpp; multiplex_op.cc). input[0] is the
+    integer selector; input[1:] the candidates."""
+    name = name or auto_name("multiplex")
+    sel, cands = input[0], list(input[1:])
+
+    def fn(params, parents, ctx):
+        idx = parents[0].array.reshape(-1).astype(jnp.int32)
+        stack = jnp.stack([p.array for p in parents[1:]], axis=0)  # [K, B, F]
+        return jnp.take_along_axis(
+            stack, idx[None, :, None].astype(jnp.int32), axis=0)[0]
+
+    return _simple_layer(name, "multiplex", [sel] + cands, fn,
+                         cands[0].size, meta_from=1)
+
+
+def out_prod(a, b, name: Optional[str] = None):
+    """Flattened outer product per sample (reference: out_prod_layer,
+    OuterProdLayer.cpp)."""
+    name = name or auto_name("out_prod")
+
+    def fn(params, parents, ctx):
+        x, y = parents[0].array, parents[1].array
+        return jnp.einsum("bi,bj->bij", x, y).reshape(x.shape[0], -1)
+
+    return _simple_layer(name, "out_prod", [a, b], fn, a.size * b.size)
+
+
+def tensor(a, b, size: int, act=None, name: Optional[str] = None,
+           param_attr=None, bias_attr=None):
+    """Bilinear tensor product out_k = a^T W_k b (reference: tensor_layer,
+    TensorLayer.cpp)."""
+    name = name or auto_name("tensor")
+    act_name = act_mod.resolve(act)
+    attr = _param_attr(param_attr if isinstance(param_attr, ParamAttr)
+                       else ParamAttr(), f"{name}.w")
+    w_spec = ParamSpec(attr.name, (size, a.size, b.size), attr=attr,
+                       fan_in=a.size * b.size)
+    bias = _bias_spec(name, size, bias_attr)
+    specs = [w_spec] + ([bias] if bias else [])
+
+    def fwd(params, parents, ctx):
+        x, y = parents[0].array, parents[1].array
+        out = jnp.einsum("bi,kij,bj->bk", x, params[w_spec.name], y)
+        if bias:
+            out = out + params[bias.name].astype(out.dtype)
+        v = Value(out, parents[0].lengths, parents[0].sub_lengths)
+        return _apply_act(v, act_name)
+
+    return LayerOutput(name, "tensor", [a, b], fwd, specs, size=size,
+                       activation=act_name)
+
+
+def linear_comb(weights, vectors, size: int, name: Optional[str] = None):
+    """out = sum_i w_i * v_i with vectors viewed as [M, size] per sample
+    (reference: linear_comb_layer, LinearChainCRF... no — ConvexCombinationLayer.cpp)."""
+    name = name or auto_name("linear_comb")
+
+    def fn(params, parents, ctx):
+        w = parents[0].array                       # [B, M]
+        v = parents[1].array.reshape(w.shape[0], w.shape[1], size)
+        return jnp.einsum("bm,bms->bs", w, v)
+
+    return _simple_layer(name, "linear_comb", [weights, vectors], fn,
+                         size, meta_from=1)
+
+
+def conv_shift(a, b, name: Optional[str] = None):
+    """Circular 1-D convolution of each row of ``a`` by the (odd-sized)
+    kernel row of ``b`` (reference: conv_shift_layer, ConvShiftLayer.cpp)."""
+    name = name or auto_name("conv_shift")
+    enforce.enforce(b.size % 2 == 1,
+                    f"conv_shift kernel size must be odd, got {b.size}")
+
+    def fn(params, parents, ctx):
+        x, k = parents[0].array, parents[1].array
+        m = k.shape[-1]
+        half = (m - 1) // 2
+        idx = (jnp.arange(x.shape[-1])[:, None] +
+               jnp.arange(-half, half + 1)[None, :]) % x.shape[-1]
+        windows = x[:, idx]                        # [B, D, M]
+        return jnp.einsum("bdm,bm->bd", windows, k)
+
+    return _simple_layer(name, "conv_shift", [a, b], fn, a.size)
+
+
+def scale_shift(input, name: Optional[str] = None, param_attr=None,
+                bias_attr=None):
+    """w*x + b with scalar learnable w (and b) (reference:
+    scale_shift_layer, ScaleShiftLayer.cpp)."""
+    name = name or auto_name("scale_shift")
+    attr = _param_attr(param_attr if isinstance(param_attr, ParamAttr)
+                       else ParamAttr(initializer="constant",
+                                      initial_value=1.0), f"{name}.w")
+    w_spec = ParamSpec(attr.name, (1,), attr=attr)
+    bias = _bias_spec(name, 1, bias_attr)
+    specs = [w_spec] + ([bias] if bias else [])
+
+    def fn(params, parents, ctx):
+        out = parents[0].array * params[w_spec.name].astype(
+            parents[0].array.dtype)
+        if bias:
+            out = out + params[bias.name].astype(out.dtype)
+        return out
+
+    return _simple_layer(name, "scale_shift", [input], fn, input.size,
+                         specs=specs)
+
+
+def prelu(input, name: Optional[str] = None, param_attr=None,
+          channel_shared: bool = False):
+    """Parametric ReLU (reference: prelu_layer, ParameterReluLayer.cpp;
+    new stack prelu_op). Slope is per-channel unless channel_shared."""
+    name = name or auto_name("prelu")
+    channels = getattr(input, "_out_channels", None)
+    nslopes = 1 if channel_shared else (channels or input.size)
+    attr = _param_attr(param_attr if isinstance(param_attr, ParamAttr)
+                       else ParamAttr(initializer="constant",
+                                      initial_value=0.25), f"{name}.w")
+    w_spec = ParamSpec(attr.name, (nslopes,), attr=attr)
+
+    def fn(params, parents, ctx):
+        x = parents[0].array
+        a = params[w_spec.name].astype(x.dtype)
+        if x.ndim == 4 and not channel_shared:
+            a = a.reshape(1, 1, 1, -1)
+        return jnp.where(x > 0, x, a * x)
+
+    return _simple_layer(name, "prelu", [input], fn, input.size,
+                         specs=[w_spec])
+
+
+def gated_unit(input, size: int, act=None, name: Optional[str] = None,
+               gate_attr=None, inproj_attr=None):
+    """act(fc(x)) * sigmoid(fc_gate(x)) (reference: gated_unit_layer,
+    GatedRecurrentLayer-adjacent GLU, layers.py:6458)."""
+    name = name or auto_name("gated_unit")
+    proj = fc(input, size=size, act=act, name=f"{name}_input",
+              param_attr=inproj_attr)
+    gate = fc(input, size=size, act="sigmoid", name=f"{name}_gate",
+              param_attr=gate_attr)
+
+    def fn(params, parents, ctx):
+        return parents[0].array * parents[1].array
+
+    return _simple_layer(name, "gated_unit", [proj, gate], fn, size)
+
+
+def eos(input, eos_id: int, name: Optional[str] = None):
+    """1.0 where the integer input equals eos_id (reference: eos_layer,
+    EosIdCheckLayer.cpp)."""
+    name = name or auto_name("eos")
+
+    def fn(params, parents, ctx):
+        return (parents[0].array == eos_id).astype(jnp.float32)
+
+    return _simple_layer(name, "eos", [input], fn, 1)
+
+
+def sampling_id(input, name: Optional[str] = None):
+    """Sample an id per row from the input distribution (reference:
+    sampling_id_layer, SamplingIdLayer.cpp). Uses the per-layer RNG key in
+    training; argmax fallback when no key is present (deterministic eval)."""
+    name = name or auto_name("sampling_id")
+
+    def fwd(params, parents, ctx):
+        p = parents[0].array
+        key = ctx.layer_key(name)
+        if key is None:
+            ids = jnp.argmax(p, axis=-1)
+        else:
+            ids = jax.random.categorical(
+                key, jnp.log(jnp.maximum(p.astype(jnp.float32), 1e-30)))
+        return Value(ids.astype(jnp.int32), parents[0].lengths)
+
+    return LayerOutput(name, "sampling_id", [input], fwd, [], size=1)
+
+
+# ---------------------------------------------------------------------------
+# image geometry / 3D layers (reference: pad_layer PadLayer.cpp, crop_layer
+# CropLayer.cpp, bilinear_interp_layer BilinearInterpLayer.cpp, rotate_layer
+# RotateLayer.cpp, cross_channel_norm_layer CrossChannelNormLayer (detection),
+# block_expand_layer BlockExpandLayer.cpp, img_conv3d/img_pool3d)
+# ---------------------------------------------------------------------------
+
+def _img_layer(name, ltype, input, fn, out_c, out_h, out_w, extra_specs=()):
+    c_in, h_in, w_in = _img_in_shape(input)
+
+    def fwd(params, parents, ctx):
+        x = _to_nhwc(parents[0].array, c_in, h_in, w_in)
+        return Value(fn(params, x, ctx))
+    lo = LayerOutput(name, ltype, [input], fwd, list(extra_specs),
+                     size=out_c * out_h * out_w)
+    lo._out_channels = out_c
+    lo._img_shape = (out_h, out_w)
+    return lo
+
+
+def _img_in_shape(input):
+    """(channels, H, W) of a layer's image output, via the conv-layer shape
+    hints (_out_channels/_img_shape, the config_parser ImgSize equivalent)."""
+    c = getattr(input, "_out_channels", None) or 1
+    h, w = _infer_img_shape(input, c, None)
+    return c, h, w
+
+
+def pad(input, pad_c=(0, 0), pad_h=(0, 0), pad_w=(0, 0),
+        name: Optional[str] = None):
+    """Zero-pad channels/height/width (reference: pad_layer, PadLayer.cpp)."""
+    name = name or auto_name("pad")
+    c, h, w = _img_in_shape(input)
+    oc, oh, ow = c + sum(pad_c), h + sum(pad_h), w + sum(pad_w)
+
+    def fn(params, x, ctx):
+        return jnp.pad(x, ((0, 0), tuple(pad_h), tuple(pad_w), tuple(pad_c)))
+
+    return _img_layer(name, "pad", input, fn, oc, oh, ow)
+
+
+def crop(input, offset, shape, name: Optional[str] = None):
+    """Static crop of CHW dims: offset/shape are (c, h, w) triples
+    (reference: crop_layer, CropLayer.cpp / crop_op.cc)."""
+    name = name or auto_name("crop")
+    oc, oh, ow = shape
+
+    def fn(params, x, ctx):
+        co, ho, wo = offset
+        return x[:, ho:ho + oh, wo:wo + ow, co:co + oc]
+
+    return _img_layer(name, "crop", input, fn, oc, oh, ow)
+
+
+def bilinear_interp(input, out_size_x: int, out_size_y: int,
+                    name: Optional[str] = None):
+    """Bilinear resize (reference: bilinear_interp_layer,
+    BilinearInterpLayer.cpp; bilinear_interp_op.cc)."""
+    name = name or auto_name("bilinear_interp")
+    c, h, w = _img_in_shape(input)
+
+    def fn(params, x, ctx):
+        return jax.image.resize(x, (x.shape[0], out_size_y, out_size_x,
+                                    x.shape[3]), method="bilinear")
+
+    return _img_layer(name, "bilinear_interp", input, fn, c, out_size_y,
+                      out_size_x)
+
+
+def rotate(input, height: Optional[int] = None, width: Optional[int] = None,
+           name: Optional[str] = None):
+    """Rotate each feature map 90° counter-clockwise (reference:
+    rotate_layer, RotateLayer.cpp)."""
+    name = name or auto_name("rotate")
+    c, h0, w0 = _img_in_shape(input)
+    h, w = height or h0, width or w0
+
+    def fwd(params, parents, ctx):
+        x = _to_nhwc(parents[0].array, c, h, w)
+        return Value(jnp.rot90(x, k=1, axes=(1, 2)))
+
+    lo = LayerOutput(name, "rotate", [input], fwd, [], size=c * h * w)
+    lo._out_channels = c
+    lo._img_shape = (w, h)
+    return lo
+
+
+def switch_order(input, reshape_order=None, name: Optional[str] = None):
+    """NCHW <-> NHWC reorder of the flat representation (reference:
+    switch_order_layer, SwitchOrderLayer.cpp). Internally tensors are NHWC;
+    this re-lays the *flat* output so downstream fc sees HWC-major."""
+    name = name or auto_name("switch_order")
+    c, h, w = _img_in_shape(input)
+
+    def fwd(params, parents, ctx):
+        x = _to_nhwc(parents[0].array, c, h, w)
+        return Value(x.reshape(x.shape[0], -1))     # HWC-major flat
+
+    return LayerOutput(name, "switch_order", [input], fwd, [],
+                       size=c * h * w)
+
+
+def cross_channel_norm(input, name: Optional[str] = None, param_attr=None):
+    """L2-normalize across channels at each spatial position, with a
+    learned per-channel scale (reference: cross_channel_norm_layer,
+    CrossChannelNormLayer.cpp — the SSD detection normalizer)."""
+    name = name or auto_name("cross_channel_norm")
+    c, h, w = _img_in_shape(input)
+    attr = _param_attr(param_attr if isinstance(param_attr, ParamAttr)
+                       else ParamAttr(initializer="constant",
+                                      initial_value=1.0), f"{name}.w")
+    w_spec = ParamSpec(attr.name, (c,), attr=attr)
+
+    def fn(params, x, ctx):
+        norm = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True) + 1e-10)
+        return x / norm * params[w_spec.name].astype(x.dtype)
+
+    return _img_layer(name, "cross_channel_norm", input, fn, c, h, w,
+                      extra_specs=[w_spec])
+
+
+def scale_sub_region(input, indices, value: float,
+                     name: Optional[str] = None):
+    """Scale a per-sample CHW sub-region by ``value``; indices rows are
+    1-based [c1, c2, h1, h2, w1, w2] (reference: scale_sub_region_layer,
+    ScaleSubRegionLayer.cpp)."""
+    name = name or auto_name("scale_sub_region")
+    c, h, w = _img_in_shape(input)
+
+    def fwd(params, parents, ctx):
+        x = _to_nhwc(parents[0].array, c, h, w)
+        idx = parents[1].array.astype(jnp.int32)    # [B, 6]
+        ci = jnp.arange(c)[None, None, None, :]
+        hi = jnp.arange(h)[None, :, None, None]
+        wi = jnp.arange(w)[None, None, :, None]
+        def rng(k):
+            return idx[:, k][:, None, None, None] - 1
+        mask = ((ci >= rng(0)) & (ci <= rng(1)) &
+                (hi >= rng(2)) & (hi <= rng(3)) &
+                (wi >= rng(4)) & (wi <= rng(5)))
+        return Value(jnp.where(mask, x * value, x))
+
+    lo = LayerOutput(name, "scale_sub_region", [input, indices], fwd, [],
+                     size=c * h * w)
+    lo._out_channels = c
+    lo._img_shape = (h, w)
+    return lo
+
+
+def block_expand(input, block_x: int, block_y: int, stride_x: int = 1,
+                 stride_y: int = 1, padding_x: int = 0, padding_y: int = 0,
+                 num_channels: Optional[int] = None,
+                 name: Optional[str] = None):
+    """im2col as a sequence: each sliding block becomes one timestep
+    (reference: block_expand_layer, BlockExpandLayer.cpp — feeds OCR CTC
+    pipelines)."""
+    name = name or auto_name("block_expand")
+    c, h, w = _img_in_shape(input)
+    c = num_channels or c
+    oh = (h + 2 * padding_y - block_y) // stride_y + 1
+    ow = (w + 2 * padding_x - block_x) // stride_x + 1
+
+    def fwd(params, parents, ctx):
+        x = _to_nhwc(parents[0].array, c, h, w)
+        x = jnp.transpose(x, (0, 3, 1, 2))          # NCHW for patch order
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (block_y, block_x), (stride_y, stride_x),
+            padding=((padding_y, padding_y), (padding_x, padding_x)))
+        # [B, C*by*bx, oh, ow] -> [B, oh*ow, C*by*bx]
+        B = x.shape[0]
+        seq = jnp.transpose(patches.reshape(B, -1, oh * ow), (0, 2, 1))
+        lengths = jnp.full((B,), oh * ow, jnp.int32)
+        return Value(seq, lengths)
+
+    return LayerOutput(name, "block_expand", [input], fwd, [],
+                       size=c * block_x * block_y)
+
+
+def img_conv3d(input, filter_size, num_filters: int, shape,
+               num_channels: Optional[int] = None, stride=1, padding=0,
+               act=None, name: Optional[str] = None, param_attr=None,
+               bias_attr=None):
+    """3-D convolution over DHW volumes; ``shape``=(C, D, H, W) of the input
+    (reference: img_conv3d_layer; conv3d_op.cc)."""
+    name = name or auto_name("conv3d")
+    act_name = act_mod.resolve(act)
+    cin, d, h, w = shape
+    cin = num_channels or cin
+    k = (filter_size,) * 3 if isinstance(filter_size, int) else tuple(filter_size)
+    s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    attr = _param_attr(param_attr if isinstance(param_attr, ParamAttr)
+                       else ParamAttr(), f"{name}.w")
+    w_spec = ParamSpec(attr.name, k + (cin, num_filters), attr=attr,
+                       fan_in=cin * k[0] * k[1] * k[2])
+    bias = _bias_spec(name, num_filters, bias_attr)
+    specs = [w_spec] + ([bias] if bias else [])
+    od = (d + 2 * p[0] - k[0]) // s[0] + 1
+    oh = (h + 2 * p[1] - k[1]) // s[1] + 1
+    ow = (w + 2 * p[2] - k[2]) // s[2] + 1
+
+    def fwd(params, parents, ctx):
+        x = parents[0].array
+        if x.ndim == 2:
+            x = x.reshape(x.shape[0], cin, d, h, w)
+            x = jnp.transpose(x, (0, 2, 3, 4, 1))   # NDHWC
+        out = jax.lax.conv_general_dilated(
+            x, params[w_spec.name].astype(x.dtype), window_strides=s,
+            padding=[(p[0], p[0]), (p[1], p[1]), (p[2], p[2])],
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if bias:
+            out = out + params[bias.name].astype(out.dtype)
+        # flatten channel-major (C, D, H, W) so chained 3-D layers can
+        # re-interpret the flat vector consistently
+        out = jnp.transpose(out, (0, 4, 1, 2, 3)).reshape(out.shape[0], -1)
+        return _apply_act(Value(out), act_name)
+
+    lo = LayerOutput(name, "conv3d", [input], fwd, specs,
+                     size=num_filters * od * oh * ow, activation=act_name)
+    lo.shape3d = (num_filters, od, oh, ow)
+    return lo
+
+
+def img_pool3d(input, pool_size, shape, stride=None, padding=0,
+               pool_type=None, name: Optional[str] = None):
+    """3-D max/avg pooling; ``shape``=(C, D, H, W) (reference:
+    img_pool3d_layer; pool3d_op.cc)."""
+    name = name or auto_name("pool3d")
+    c, d, h, w = shape
+    k = (pool_size,) * 3 if isinstance(pool_size, int) else tuple(pool_size)
+    s = k if stride is None else ((stride,) * 3 if isinstance(stride, int)
+                                  else tuple(stride))
+    p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    is_avg = pooling_mod.resolve(pool_type) == "avg"
+    od = (d + 2 * p[0] - k[0]) // s[0] + 1
+    oh = (h + 2 * p[1] - k[1]) // s[1] + 1
+    ow = (w + 2 * p[2] - k[2]) // s[2] + 1
+
+    def fwd(params, parents, ctx):
+        x = parents[0].array
+        if x.ndim == 2:
+            x = x.reshape(x.shape[0], c, d, h, w)
+            x = jnp.transpose(x, (0, 2, 3, 4, 1))
+        pads = ((0, 0),) + tuple((pi, pi) for pi in p) + ((0, 0),)
+        if is_avg:
+            out = jax.lax.reduce_window(
+                x, 0.0, jax.lax.add, (1,) + k + (1,), (1,) + s + (1,), pads)
+            out = out / float(k[0] * k[1] * k[2])
+        else:
+            out = jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, (1,) + k + (1,), (1,) + s + (1,),
+                pads)
+        out = jnp.transpose(out, (0, 4, 1, 2, 3)).reshape(out.shape[0], -1)
+        return Value(out)
+
+    lo = LayerOutput(name, "pool3d", [input], fwd, [],
+                     size=c * od * oh * ow)
+    lo.shape3d = (c, od, oh, ow)
+    return lo
+
+
+# ---------------------------------------------------------------------------
+# sequence slicing (reference: seq_reshape_layer SequenceReshapeLayer.cpp,
+# seq_slice_layer SeqSliceLayer.cpp, sub_seq_layer SubSequenceLayer.cpp,
+# kmax_seq_score_layer KmaxSeqScoreLayer.cpp)
+# ---------------------------------------------------------------------------
+
+def seq_reshape(input, reshape_size: int, name: Optional[str] = None):
+    """Re-tokenize a sequence: total per-sequence features regrouped into
+    tokens of ``reshape_size`` (reference: seq_reshape_layer)."""
+    name = name or auto_name("seq_reshape")
+    enforce.enforce(input.size % reshape_size == 0 or
+                    reshape_size % input.size == 0,
+                    "seq_reshape sizes must divide")
+
+    def fwd(params, parents, ctx):
+        pv = parents[0]
+        x = pv.array                               # [B, T, F]
+        B, T, F = x.shape
+        factor_num = F
+        new_total = T * F // reshape_size
+        out = x.reshape(B, new_total, reshape_size)
+        lengths = (pv.lengths * F) // reshape_size
+        return Value(out, lengths)
+
+    return LayerOutput(name, "seq_reshape", [input], fwd, [],
+                       size=reshape_size)
+
+
+def seq_slice(input, starts=None, ends=None, name: Optional[str] = None):
+    """Slice each sequence to [start, end) given per-sample scalar layers
+    (reference: seq_slice_layer)."""
+    name = name or auto_name("seq_slice")
+    parents = [input] + [l for l in (starts, ends) if l is not None]
+
+    def fwd(params, parent_vals, ctx):
+        pv = parent_vals[0]
+        x, lens = pv.array, pv.lengths
+        B, T = x.shape[:2]
+        i = 1
+        if starts is not None:
+            s = parent_vals[i].array.reshape(-1).astype(jnp.int32)
+            i += 1
+        else:
+            s = jnp.zeros((B,), jnp.int32)
+        if ends is not None:
+            e = parent_vals[i].array.reshape(-1).astype(jnp.int32)
+        else:
+            e = lens
+        e = jnp.minimum(e, lens)
+        idx = jnp.arange(T)[None, :] + s[:, None]      # shifted gather
+        idx = jnp.minimum(idx, T - 1)
+        out = jnp.take_along_axis(
+            x, idx[..., None].astype(jnp.int32), axis=1)
+        return Value(out, jnp.maximum(e - s, 0))
+
+    return LayerOutput(name, "seq_slice", parents, fwd, [], size=input.size)
+
+
+def sub_seq(input, offsets, sizes, name: Optional[str] = None):
+    """Per-sample subsequence by (offset, size) layers (reference:
+    sub_seq_layer, SubSequenceLayer.cpp)."""
+    name = name or auto_name("sub_seq")
+
+    def fwd(params, parent_vals, ctx):
+        pv = parent_vals[0]
+        x, lens = pv.array, pv.lengths
+        B, T = x.shape[:2]
+        off = parent_vals[1].array.reshape(-1).astype(jnp.int32)
+        sz = parent_vals[2].array.reshape(-1).astype(jnp.int32)
+        idx = jnp.minimum(jnp.arange(T)[None, :] + off[:, None], T - 1)
+        out = jnp.take_along_axis(x, idx[..., None].astype(jnp.int32), axis=1)
+        new_len = jnp.clip(sz, 0, jnp.maximum(lens - off, 0))
+        return Value(out, new_len)
+
+    return LayerOutput(name, "sub_seq", [input, offsets, sizes], fwd, [],
+                       size=input.size)
+
+
+def kmax_seq_score(input, beam_size: int = 1, name: Optional[str] = None):
+    """Indices of the k largest per-token scores in each sequence
+    (reference: kmax_seq_score_layer, KmaxSeqScoreLayer.cpp)."""
+    name = name or auto_name("kmax_seq_score")
+
+    def fwd(params, parents, ctx):
+        pv = parents[0]
+        scores = pv.array
+        if scores.ndim == 3:
+            scores = scores[..., 0]
+        idx = ops_seq.kmax_score_indices(scores, pv.lengths, beam_size)
+        return Value(idx)
+
+    return LayerOutput(name, "kmax_seq_score", [input], fwd, [],
+                       size=beam_size)
+
+
+def printer(input, name: Optional[str] = None, format: str = "{}"):
+    """Debug-print a layer's value at run time (reference: printer_layer,
+    PrintLayer.cpp — glog; here jax.debug.print inside the traced fn)."""
+    name = name or auto_name("printer")
+
+    def fwd(params, parents, ctx):
+        jax.debug.print(name + ": " + format, parents[0].array)
+        return parents[0]
+
+    return LayerOutput(name, "printer", [input], fwd, [], size=input.size)
+
+
+# ---------------------------------------------------------------------------
+# mixed layer + sampled-output layers
+# ---------------------------------------------------------------------------
+
+def mixed(size: Optional[int] = None, input=None, act=None,
+          bias_attr=False, name: Optional[str] = None):
+    """Sum of projections/operators (reference: mixed_layer,
+    MixedLayer.cpp — the composite of Projection/Operator sub-units)."""
+    from paddle_tpu import projection as proj_mod
+    name = name or auto_name("mixed")
+    projs = _as_list(input)
+    for pr in projs:
+        enforce.enforce(isinstance(pr, proj_mod.Projection),
+                        "mixed() inputs must be projections/operators")
+    out_size = size or projs[0].size
+    for pr in projs:
+        enforce.enforce(pr.size == out_size,
+                        f"projection size {pr.size} != mixed size {out_size}")
+    act_name = act_mod.resolve(act)
+    specs = []
+    seen = set()
+    for pr in projs:
+        for sp in pr.param_specs:
+            if sp.name not in seen:
+                seen.add(sp.name)
+                specs.append(sp)
+    bias = _bias_spec(name, out_size, bias_attr)
+    if bias:
+        specs.append(bias)
+    parents = []
+    slices = []
+    for pr in projs:
+        lo = len(parents)
+        parents.extend(pr.inputs)
+        slices.append((pr, lo, len(parents)))
+
+    def fwd(params, parent_vals, ctx):
+        total = None
+        for pr, lo, hi in slices:
+            out = pr.apply(params, parent_vals[lo:hi], ctx)
+            total = out if total is None else total + out
+        if bias:
+            total = total + params[bias.name].astype(total.dtype)
+        p0 = parent_vals[0]
+        return _apply_act(Value(total, p0.lengths, p0.sub_lengths), act_name)
+
+    return LayerOutput(name, "mixed", parents, fwd, specs, size=out_size,
+                       activation=act_name)
+
+
+mixed_layer = mixed
+
+
+def selective_fc(input, select, size: int, act=None,
+                 name: Optional[str] = None, param_attr=None,
+                 bias_attr=None):
+    """FC evaluated only on selected output columns (reference:
+    selective_fc_layer, SelectiveFullyConnectedLayer.cpp — computes just the
+    rows named by ``select``). ``select``: integer ids [B, K]; output [B, K]
+    scores aligned with the ids. The TPU form is a gather of W columns +
+    batched dot — the SelectedRows idea applied to outputs."""
+    name = name or auto_name("selective_fc")
+    act_name = act_mod.resolve(act)
+    a = _param_attr(param_attr if isinstance(param_attr, ParamAttr)
+                    else ParamAttr(), f"{name}.w")
+    w_spec = ParamSpec(a.name, (input.size, size), attr=a, fan_in=input.size)
+    bias = _bias_spec(name, size, bias_attr)
+    specs = [w_spec] + ([bias] if bias else [])
+
+    def fwd(params, parents, ctx):
+        x = parents[0].array                       # [B, D]
+        sel = parents[1].array.astype(jnp.int32)   # [B, K]
+        w_cols = jnp.take(params[w_spec.name].T, sel, axis=0)  # [B, K, D]
+        out = jnp.einsum("bkd,bd->bk", w_cols.astype(x.dtype), x)
+        if bias:
+            out = out + jnp.take(params[bias.name], sel).astype(out.dtype)
+        return _apply_act(Value(out), act_name)
+
+    return LayerOutput(name, "selective_fc", [input, select], fwd, specs,
+                       size=size, activation=act_name)
+
+
+def nce(input, label, num_classes: int, num_neg_samples: int = 10,
+        name: Optional[str] = None, param_attr=None, bias_attr=None):
+    """Noise-contrastive estimation cost over a big softmax (reference:
+    nce_layer, NCELayer.cpp — binary logistic on the true class plus sampled
+    noise classes; uniform noise distribution).
+
+    Negatives are drawn per batch from the per-layer RNG key (training);
+    without a key a fixed fold of the seed is used. Returns per-example cost.
+    """
+    name = name or auto_name("nce")
+    inputs = _as_list(input)
+    a = _param_attr(param_attr if isinstance(param_attr, ParamAttr)
+                    else ParamAttr(), f"{name}.w")
+    specs = []
+    w_specs = []
+    for i, inp in enumerate(inputs):
+        nm = a.name if len(inputs) == 1 else f"{a.name}{i}"
+        sp = ParamSpec(nm, (num_classes, inp.size), attr=type(a)(
+            **{**a.__dict__, "name": nm}), fan_in=inp.size)
+        w_specs.append(sp)
+        specs.append(sp)
+    bias = _bias_spec(name, num_classes, bias_attr)
+    if bias:
+        specs.append(bias)
+
+    def fwd(params, parents, ctx):
+        xs = parents[:-1]
+        lab = parents[-1].array.reshape(-1).astype(jnp.int32)
+        B = lab.shape[0]
+        key = ctx.layer_key(name)
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        negs = jax.random.randint(key, (B, num_neg_samples), 0, num_classes)
+        ids = jnp.concatenate([lab[:, None], negs], axis=1)  # [B, 1+S]
+
+        def scores(ids_):
+            total = None
+            for sp, xv in zip(w_specs, xs):
+                w_rows = jnp.take(params[sp.name], ids_, axis=0)  # [B,S,D]
+                o = jnp.einsum("bsd,bd->bs", w_rows.astype(jnp.float32),
+                               xv.array.astype(jnp.float32))
+                total = o if total is None else total + o
+            if bias:
+                total = total + jnp.take(params[bias.name], ids_)
+            return total
+
+        s = scores(ids)
+        pos_loss = jax.nn.softplus(-s[:, 0])
+        neg_loss = jnp.sum(jax.nn.softplus(s[:, 1:]), axis=1)
+        return Value(pos_loss + neg_loss)
+
+    return LayerOutput(name, "nce", inputs + [label], fwd, specs, size=1)
+
+
+def hsigmoid(input, label, num_classes: int, name: Optional[str] = None,
+             param_attr=None, bias_attr=None):
+    """Hierarchical sigmoid cost: binary logistic along the complete-binary-
+    tree path of the label class (reference: hsigmoid,
+    HierarchicalSigmoidLayer.cpp — leaves numbered c+num_classes, internal
+    nodes are the label's ancestors).
+
+    Σ_c p(c) = 1 by construction; cost is -log p(label).
+    """
+    name = name or auto_name("hsigmoid")
+    inputs = _as_list(input)
+    a = _param_attr(param_attr if isinstance(param_attr, ParamAttr)
+                    else ParamAttr(), f"{name}.w")
+    specs, w_specs = [], []
+    for i, inp in enumerate(inputs):
+        nm = a.name if len(inputs) == 1 else f"{a.name}{i}"
+        sp = ParamSpec(nm, (num_classes - 1, inp.size), attr=type(a)(
+            **{**a.__dict__, "name": nm}), fan_in=inp.size)
+        w_specs.append(sp)
+        specs.append(sp)
+    bias = _bias_spec(name, num_classes - 1, bias_attr)
+    if bias:
+        specs.append(bias)
+    depth = max(1, math.ceil(math.log2(num_classes)))
+
+    def fwd(params, parents, ctx):
+        xs = parents[:-1]
+        lab = parents[-1].array.reshape(-1).astype(jnp.int32)
+        leaf = lab + num_classes
+        # ancestors leaf>>1 .. 1; child bit at each
+        ks = jnp.arange(1, depth + 1)
+        anc = leaf[:, None] >> ks[None, :]            # [B, depth]
+        bit = (leaf[:, None] >> (ks[None, :] - 1)) & 1
+        valid = anc >= 1
+        node = jnp.maximum(anc - 1, 0)                # weight row index
+
+        total = None
+        for sp, xv in zip(w_specs, xs):
+            w_rows = jnp.take(params[sp.name], node, axis=0)   # [B,depth,D]
+            o = jnp.einsum("bkd,bd->bk", w_rows.astype(jnp.float32),
+                           xv.array.astype(jnp.float32))
+            total = o if total is None else total + o
+        if bias:
+            total = total + jnp.take(params[bias.name], node)
+        # p(child=right)=sigmoid(s): step cost = softplus(-s) if bit==1
+        # (going right) else softplus(s)
+        step_cost = jnp.where(bit == 1, jax.nn.softplus(-total),
+                              jax.nn.softplus(total))
+        return Value(jnp.sum(jnp.where(valid, step_cost, 0.0), axis=1))
+
+    return LayerOutput(name, "hsigmoid", inputs + [label], fwd, specs,
+                       size=1)
