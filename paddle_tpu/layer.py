@@ -1270,7 +1270,7 @@ def tensor(a, b, size: int, act=None, name: Optional[str] = None,
 
 def linear_comb(weights, vectors, size: int, name: Optional[str] = None):
     """out = sum_i w_i * v_i with vectors viewed as [M, size] per sample
-    (reference: linear_comb_layer, LinearChainCRF... no — ConvexCombinationLayer.cpp)."""
+    (reference: linear_comb_layer, ConvexCombinationLayer.cpp)."""
     name = name or auto_name("linear_comb")
 
     def fn(params, parents, ctx):
@@ -1550,8 +1550,11 @@ def block_expand(input, block_x: int, block_y: int, stride_x: int = 1,
     (reference: block_expand_layer, BlockExpandLayer.cpp — feeds OCR CTC
     pipelines)."""
     name = name or auto_name("block_expand")
-    c, h, w = _img_in_shape(input)
-    c = num_channels or c
+    if num_channels is not None:
+        c = num_channels
+        h, w = _infer_img_shape(input, c, None)
+    else:
+        c, h, w = _img_in_shape(input)
     oh = (h + 2 * padding_y - block_y) // stride_y + 1
     ow = (w + 2 * padding_x - block_x) // stride_x + 1
 
@@ -1672,7 +1675,6 @@ def seq_reshape(input, reshape_size: int, name: Optional[str] = None):
         pv = parents[0]
         x = pv.array                               # [B, T, F]
         B, T, F = x.shape
-        factor_num = F
         new_total = T * F // reshape_size
         out = x.reshape(B, new_total, reshape_size)
         lengths = (pv.lengths * F) // reshape_size

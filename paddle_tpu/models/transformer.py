@@ -1,0 +1,167 @@
+"""Decoder-only transformer LM — the long-context / large-scale flagship.
+
+The reference predates transformers; this is the modern capability filling
+the "scale sequence length / scale out" slot (SURVEY.md §2.3, §5): causal LM
+with ring-attention context parallelism over the ``seq`` mesh axis, tensor
+parallelism over ``model`` (heads + MLP), data parallelism over ``data``,
+all as one jit-compiled GSPMD program.
+
+Functional design (not the v1 layer DSL): parameters are a pytree with
+blocks stacked on a leading axis and the layer loop is a ``lax.scan`` —
+one compiled block body regardless of depth, weights ride the MXU in bf16.
+"""
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core import place
+from paddle_tpu.ops import loss as ops_loss
+from paddle_tpu.ops import norm as ops_norm
+from paddle_tpu.parallel import ring
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_len: int = 2048
+    dtype: object = jnp.bfloat16
+    use_ring_attention: bool = False   # shard_map CP over the seq axis
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig):
+    """Parameter pytree; block weights stacked on axis 0 (scan layout)."""
+    k = jax.random.split(key, 8)
+    D, F, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab
+    s = 1.0 / math.sqrt(D)
+
+    def nrm(kk, shape, scale):
+        return (jax.random.normal(kk, shape, jnp.float32) * scale).astype(
+            jnp.float32)
+
+    return {
+        "embed": nrm(k[0], (V, D), 1.0 / math.sqrt(D)),
+        "pos": nrm(k[1], (cfg.max_len, D), 0.02),
+        "blocks": {
+            "ln1": jnp.ones((L, D), jnp.float32),
+            "ln1_b": jnp.zeros((L, D), jnp.float32),
+            "qkv": nrm(k[2], (L, D, 3 * D), s),
+            "attn_out": nrm(k[3], (L, D, D), s / math.sqrt(2 * L)),
+            "ln2": jnp.ones((L, D), jnp.float32),
+            "ln2_b": jnp.zeros((L, D), jnp.float32),
+            "mlp_in": nrm(k[4], (L, D, F), s),
+            "mlp_out": nrm(k[5], (L, F, D), 1.0 / math.sqrt(F) /
+                           math.sqrt(2 * L)),
+        },
+        "ln_f": jnp.ones((D,), jnp.float32),
+        "ln_f_b": jnp.zeros((D,), jnp.float32),
+    }
+
+
+def param_shardings(cfg: TransformerConfig, mesh: Mesh):
+    """TP layout (scaling-book): qkv/mlp_in column-parallel, attn_out/mlp_out
+    row-parallel over ``model``; embeddings vocab-sharded over ``model``."""
+    M = place.AXIS_MODEL
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    return {
+        "embed": ns(M, None),
+        "pos": ns(),
+        "blocks": {
+            "ln1": ns(), "ln1_b": ns(), "ln2": ns(), "ln2_b": ns(),
+            "qkv": ns(None, None, M),
+            "attn_out": ns(None, M, None),
+            "mlp_in": ns(None, None, M),
+            "mlp_out": ns(None, M, None),
+        },
+        "ln_f": ns(), "ln_f_b": ns(),
+    }
+
+
+def _layer_norm(x, g, b):
+    return ops_norm.layer_norm(x, g, b).astype(x.dtype)
+
+
+def forward(params, tokens: jax.Array, cfg: TransformerConfig, *,
+            mesh: Optional[Mesh] = None,
+            lengths: Optional[jax.Array] = None) -> jax.Array:
+    """tokens [B, T] int32 → logits [B, T, vocab] (float32).
+
+    With ``cfg.use_ring_attention`` and a mesh carrying a >1 ``seq`` axis,
+    attention runs as ring CP; activations get seq-sharding constraints so
+    XLA keeps the [B, T, D] tensors distributed end-to-end.
+    """
+    B, T = tokens.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    x = x + params["pos"][:T].astype(cfg.dtype)[None]
+
+    seq_sharded = (mesh is not None and place.AXIS_SEQ in mesh.axis_names
+                   and mesh.shape[place.AXIS_SEQ] > 1)
+
+    def constrain(h):
+        if mesh is None:
+            return h
+        spec = P(place.AXIS_DATA,
+                 place.AXIS_SEQ if seq_sharded else None, None)
+        return jax.lax.with_sharding_constraint(
+            h, NamedSharding(mesh, spec))
+
+    x = constrain(x)
+
+    def block(x, w):
+        h = _layer_norm(x, w["ln1"], w["ln1_b"])
+        qkv = jnp.einsum("btd,de->bte", h, w["qkv"].astype(h.dtype))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, Dh)
+        k = k.reshape(B, T, H, Dh)
+        v = v.reshape(B, T, H, Dh)
+        if seq_sharded and cfg.use_ring_attention:
+            attn = ring.ring_attention_spmd(q, k, v, mesh, causal=True,
+                                            lengths=lengths)
+        else:
+            attn = ring.full_attention(q, k, v, causal=True, lengths=lengths)
+        attn = attn.reshape(B, T, cfg.d_model)
+        x = x + jnp.einsum("btd,de->bte", attn,
+                           w["attn_out"].astype(attn.dtype))
+        x = constrain(x)
+        h2 = _layer_norm(x, w["ln2"], w["ln2_b"])
+        ff = jnp.einsum("btd,df->btf", h2, w["mlp_in"].astype(h2.dtype))
+        ff = jax.nn.gelu(ff)
+        x = x + jnp.einsum("btf,fd->btd", ff,
+                           w["mlp_out"].astype(ff.dtype))
+        return constrain(x), None
+
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+    x = _layer_norm(x, params["ln_f"], params["ln_f_b"])
+    logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
+                        params["embed"].astype(jnp.float32))
+    return logits
+
+
+def lm_loss(params, tokens, targets, cfg: TransformerConfig, *,
+            mesh: Optional[Mesh] = None,
+            lengths: Optional[jax.Array] = None) -> jax.Array:
+    """Mean next-token cross-entropy over valid positions."""
+    logits = forward(params, tokens, cfg, mesh=mesh, lengths=lengths)
+    tok_ce = ops_loss.softmax_cross_entropy(logits, targets)
+    if lengths is not None:
+        mask = (jnp.arange(tokens.shape[1])[None, :] <
+                lengths[:, None]).astype(jnp.float32)
+    else:
+        mask = jnp.ones_like(tok_ce)
+    return jnp.sum(tok_ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -1,0 +1,154 @@
+"""Ring attention: exact attention over sequences sharded across a mesh axis.
+
+The long-context scaling path (SURVEY.md §5 — the reference's capability slot
+was zero-padding LoD sequences; the modern TPU-native equivalent is context
+parallelism). Design follows the ring-attention pattern: each device holds a
+sequence shard of Q/K/V; K/V blocks rotate around the ring via
+``lax.ppermute`` over ICI while an online-softmax accumulator (m, l, o) folds
+in one block per step — compute overlaps the neighbor-exchange, memory stays
+O(T/P) per chip, and the result is bit-for-bit exact attention (no
+approximation).
+
+Used inside ``shard_map`` over the ``seq`` mesh axis; composes with data
+(batch) and model (heads) axes.
+"""
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.core import place
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, mask, scale):
+    """One blockwise attention piece → (scores-exp sum l, running max m,
+    unnormalized out). q [B,Tq,H,D] k/v [B,Tk,H,D] mask [B,Tq,Tk] bool."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    s = jnp.where(mask[:, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                                  # [B,H,Tq]
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
+    m_safe = jnp.maximum(m, -1e29)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(mask[:, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)                                  # [B,H,Tq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m_safe, l, o
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
+                   lengths: Optional[jax.Array] = None,
+                   scale: Optional[float] = None):
+    """Exact attention with K/V rotating around the ``axis_name`` ring.
+
+    Call inside shard_map. q/k/v: local shards [B, T_local, H, D] (sequence
+    axis sharded); lengths: global per-example valid lengths [B] (replicated).
+    Returns [B, T_local, H, D].
+    """
+    B, Tl, H, D = q.shape
+    nshards = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    scale = scale or (1.0 / math.sqrt(D))
+    q32 = q.astype(jnp.float32)
+
+    q_pos = my * Tl + jnp.arange(Tl)                         # [Tq] global
+
+    def step_mask(src):
+        k_pos = src * Tl + jnp.arange(Tl)                    # [Tk] global
+        m = jnp.ones((B, Tl, Tl), bool)
+        if causal:
+            m = m & (q_pos[None, :, None] >= k_pos[None, None, :])
+        if lengths is not None:
+            m = m & (k_pos[None, None, :] < lengths[:, None, None])
+        return m
+
+    perm = [(i, (i + 1) % nshards) for i in range(nshards)]
+
+    def body(step, carry):
+        o, mx, l, k_cur, v_cur = carry
+        src = (my - step) % nshards
+        bm, bl, bo = _block_attn(q32, k_cur, v_cur, step_mask(src), scale)
+        new_m = jnp.maximum(mx, bm)
+        c_old = jnp.exp(mx - new_m)
+        c_new = jnp.exp(bm - new_m)
+        l = l * c_old + bl * c_new
+        o = (o * c_old[..., None].swapaxes(1, 2) +
+             bo * c_new[..., None].swapaxes(1, 2))
+        # rotate K/V to the next device; skip the final dead rotation
+        k_nxt, v_nxt = jax.lax.cond(
+            step < nshards - 1,
+            lambda kv: (jax.lax.ppermute(kv[0], axis_name, perm),
+                        jax.lax.ppermute(kv[1], axis_name, perm)),
+            lambda kv: kv, (k_cur, v_cur))
+        return o, new_m, l, k_nxt, v_nxt
+
+    o0 = jnp.zeros((B, Tl, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Tl), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl), jnp.float32)
+    o, mx, l, _, _ = jax.lax.fori_loop(0, nshards, body, (o0, m0, l0, k, v))
+    l = jnp.maximum(l, 1e-30)
+    out = o / l[..., None].swapaxes(1, 2)
+    return out.astype(q.dtype)
+
+
+def full_attention(q, k, v, *, causal: bool = False,
+                   lengths: Optional[jax.Array] = None,
+                   scale: Optional[float] = None):
+    """Reference single-device attention with the same masking semantics."""
+    B, T, H, D = q.shape
+    scale = scale or (1.0 / math.sqrt(D))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    mask = jnp.ones((B, T, T), bool)
+    if causal:
+        i = jnp.arange(T)
+        mask = mask & (i[None, :, None] >= i[None, None, :])
+    if lengths is not None:
+        mask = mask & (jnp.arange(T)[None, None, :] < lengths[:, None, None])
+    s = jnp.where(mask[:, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(mask[:, None], p, 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def ring_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
+                        lengths: Optional[jax.Array] = None,
+                        batch_axis: str = place.AXIS_DATA,
+                        seq_axis: str = place.AXIS_SEQ,
+                        head_axis: str = place.AXIS_MODEL,
+                        scale: Optional[float] = None):
+    """shard_map wrapper: q/k/v [B, T, H, D] with B over ``batch_axis``,
+    T over ``seq_axis``, and heads over ``head_axis`` when the mesh has one
+    (tensor parallelism: each model-shard attends its own heads — attention
+    is head-separable so no collective is needed on that axis); lengths [B]
+    sharded with the batch."""
+    from jax import shard_map
+
+    H = q.shape[2]
+    tp = (head_axis if head_axis in mesh.axis_names
+          and mesh.shape[head_axis] > 1 and H % mesh.shape[head_axis] == 0
+          else None)
+    qkv_spec = P(batch_axis, seq_axis, tp, None)
+    len_spec = P(batch_axis)
+    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
+                           scale=scale)
+
+    if lengths is None:
+        def wrapped(q_, k_, v_):
+            return fn(q_, k_, v_, lengths=None)
+        return shard_map(wrapped, mesh=mesh,
+                         in_specs=(qkv_spec,) * 3,
+                         out_specs=qkv_spec, check_vma=False)(q, k, v)
+
+    def wrapped(q_, k_, v_, len_):
+        return fn(q_, k_, v_, lengths=len_)
+    return shard_map(wrapped, mesh=mesh,
+                     in_specs=(qkv_spec, qkv_spec, qkv_spec, len_spec),
+                     out_specs=qkv_spec, check_vma=False)(q, k, v, lengths)
