@@ -1,0 +1,109 @@
+"""Background-thread prefetch loader over recordio files.
+
+The DataProvider double-buffer equivalent (reference:
+gserver/dataproviders/DataProvider.h:292 DoubleBuffer — a background thread
+fills batch buffers while the trainer consumes; PyDataProvider2.cpp:195 runs
+the Python provider on a worker thread). Here the hot path — disk reads,
+chunk CRC, record framing — runs on native C++ threads
+(runtime/native/recordio.cc Loader); Python only unpickles records as they
+pop. Falls back to a Python thread when the native lib is unavailable.
+"""
+
+import ctypes
+import pickle
+import queue
+import random
+import threading
+from typing import Iterator, Optional
+
+from paddle_tpu.runtime import native, recordio
+
+
+class PrefetchLoader:
+    """Iterate records of a recordio file with prefetching.
+
+    shuffle=True shuffles chunk order per epoch (record-level shuffling is
+    the reader decorator's job — matching the master's chunk-task dispatch
+    granularity, go/master/service.go partition).
+    """
+
+    def __init__(self, path: str, shuffle: bool = False,
+                 seed: Optional[int] = 0, num_threads: int = 2,
+                 capacity: int = 4096):
+        self.path = path
+        self.shuffle = shuffle
+        self.num_threads = num_threads
+        self.capacity = capacity
+        self._rng = random.Random(seed)
+        self._chunks = recordio.chunk_offsets(path)
+
+    def __iter__(self) -> Iterator:
+        offsets = [off for off, _ in self._chunks]
+        if self.shuffle:
+            self._rng.shuffle(offsets)
+        lib = native.get()
+        if lib is not None:
+            yield from self._iter_native(lib, offsets)
+        else:
+            yield from self._iter_python(offsets)
+
+    def _iter_native(self, lib, offsets):
+        arr = (ctypes.c_longlong * len(offsets))(*offsets)
+        handle = lib.loader_create(self.path.encode(), arr, len(offsets),
+                                   self.num_threads, self.capacity)
+        if not handle:
+            raise IOError(f"loader_create failed for {self.path}")
+        try:
+            buf = ctypes.POINTER(ctypes.c_uint8)()
+            while True:
+                n = lib.loader_next(handle, ctypes.byref(buf))
+                if n == 0:
+                    break
+                if n < 0:
+                    raise IOError(f"native loader error {n} on {self.path}")
+                try:
+                    rec = ctypes.string_at(buf, n)
+                finally:
+                    lib.rio_free(buf)
+                yield pickle.loads(rec)
+        finally:
+            lib.loader_destroy(handle)
+
+    def _iter_python(self, offsets):
+        q: "queue.Queue" = queue.Queue(maxsize=self.capacity)
+        sentinel = object()
+        err: list = []
+
+        def worker():
+            try:
+                for off in offsets:
+                    for rec in recordio.read_chunk(self.path, off):
+                        q.put(rec)
+            except BaseException as e:        # propagate to the consumer
+                err.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        t.join()
+        if err:
+            raise err[0]
+
+
+def reader_creator(path: str, shuffle: bool = False, seed: Optional[int] = 0,
+                   num_threads: int = 2):
+    """A v2-style reader() factory over a recordio file (reference:
+    python/paddle/v2/reader/creator.py recordio)."""
+    loader = PrefetchLoader(path, shuffle=shuffle, seed=seed,
+                            num_threads=num_threads)
+
+    def reader():
+        return iter(loader)
+
+    return reader

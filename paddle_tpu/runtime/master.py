@@ -1,0 +1,348 @@
+"""Elastic data-dispatch service — the Go master equivalent.
+
+Reference: go/master/service.go — a dataset is partitioned into recordio-chunk
+tasks held in todo/pending/done queues (:56-131); trainers lease tasks,
+leases time out back to todo; tasks failing more than ``failure_max`` times
+are discarded; state snapshots to etcd for crash recovery (:99,149-177).
+Python client: python/paddle/v2/master/client.py (set_dataset/next_record).
+
+TPU-native design: trainers are stateless task consumers (any chip-holder can
+die and its chunk is re-dispatched), the state store is a JSON snapshot file
+(the etcd slot — swap in any kv store), and the wire protocol is
+newline-delimited JSON over TCP for multi-host, or direct calls in-process.
+"""
+
+import dataclasses
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from paddle_tpu.runtime import recordio
+from paddle_tpu.utils.logger import get_logger
+
+log = get_logger("master")
+
+
+@dataclasses.dataclass
+class Task:
+    """One unit of dispatch: a group of chunks of one file (go/master
+    Task holds recordio chunks)."""
+    task_id: int
+    path: str
+    chunks: List[List[int]]            # [[offset, nrecords], ...]
+    fail_count: int = 0
+
+    @property
+    def nrecords(self):
+        return sum(c[1] for c in self.chunks)
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**d)
+
+
+class MasterService:
+    """Task queues with leases (thread-safe).
+
+    Lifecycle per epoch (pass): todo → pending(lease) → done; expired leases
+    requeue; over-failed tasks are dropped (service.go task lifecycle).
+    """
+
+    def __init__(self, lease_seconds: float = 60.0, failure_max: int = 3,
+                 num_passes: Optional[int] = None,
+                 snapshot_path: Optional[str] = None,
+                 time_fn=time.monotonic):
+        """num_passes: stop refilling after this many completed passes
+        (None = refill forever; the reference's pass barriers are
+        WaitPassStart/Finish, proto/ParameterService.proto:89-95)."""
+        self._lock = threading.Lock()
+        self._todo: List[Task] = []
+        self._pending: Dict[int, tuple] = {}     # id -> (task, deadline)
+        self._done: List[Task] = []
+        self._discarded: List[Task] = []
+        self.lease_seconds = lease_seconds
+        self.failure_max = failure_max
+        self.snapshot_path = snapshot_path
+        self._time = time_fn
+        self.num_passes = num_passes
+        self._epoch = 0
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._restore()
+
+    # -- dataset -----------------------------------------------------------
+    def set_dataset(self, paths: Sequence[str], chunks_per_task: int = 1):
+        """Partition recordio files into tasks of ``chunks_per_task`` chunks
+        each (service.go partition)."""
+        tasks, tid = [], 0
+        for path in paths:
+            buf = []
+            for offset, n in recordio.chunk_offsets(path):
+                buf.append([offset, n])
+                if len(buf) >= chunks_per_task:
+                    tasks.append(Task(tid, path, buf))
+                    tid += 1
+                    buf = []
+            if buf:
+                tasks.append(Task(tid, path, buf))
+                tid += 1
+        with self._lock:
+            self._todo = tasks
+            self._pending.clear()
+            self._done.clear()
+            self._discarded.clear()
+            self._epoch = 0
+        self._snapshot()
+        log.info("master: dataset set, %d tasks", len(tasks))
+
+    # -- task protocol -----------------------------------------------------
+    def get_task(self) -> Optional[Task]:
+        """Lease one task; None when this pass is drained (caller should
+        retry after pending tasks finish, or treat the pass as over when
+        num_pending()==0)."""
+        with self._lock:
+            self._requeue_expired_locked()
+            if not self._todo:
+                return None
+            task = self._todo.pop(0)
+            self._pending[task.task_id] = (task,
+                                           self._time() + self.lease_seconds)
+            return task
+
+    def report_done(self, task_id: int) -> bool:
+        with self._lock:
+            ent = self._pending.pop(task_id, None)
+            if ent is None:
+                return False                    # late report after re-lease
+            self._done.append(ent[0])
+            self._maybe_finish_pass_locked()
+            return True
+
+    def report_failed(self, task_id: int):
+        """Failed lease: requeue unless over the failure cap
+        (service.go failureMax discard)."""
+        with self._lock:
+            ent = self._pending.pop(task_id, None)
+            if ent is None:
+                return
+            task = ent[0]
+            task.fail_count += 1
+            if task.fail_count >= self.failure_max:
+                log.warning("master: task %d discarded after %d failures",
+                            task.task_id, task.fail_count)
+                self._discarded.append(task)
+                self._maybe_finish_pass_locked()
+            else:
+                self._todo.append(task)
+
+    def _requeue_expired_locked(self):
+        now = self._time()
+        expired = [tid for tid, (_, dl) in self._pending.items() if dl < now]
+        for tid in expired:
+            task, _ = self._pending.pop(tid)
+            task.fail_count += 1
+            if task.fail_count >= self.failure_max:
+                self._discarded.append(task)
+            else:
+                log.info("master: lease expired, requeueing task %d", tid)
+                self._todo.append(task)
+
+    def _maybe_finish_pass_locked(self):
+        if not self._todo and not self._pending:
+            # pass complete: everything done/discarded flows back to todo
+            # for the next pass, unless num_passes is exhausted
+            self._epoch += 1
+            finished = self._done + self._discarded
+            self._done, self._discarded = [], []
+            if self.num_passes is not None and self._epoch >= self.num_passes:
+                return                       # terminal: queues stay empty
+            self._todo = finished
+            for t in self._todo:
+                t.fail_count = 0
+
+    # -- introspection -----------------------------------------------------
+    def num_todo(self):
+        with self._lock:
+            return len(self._todo)
+
+    def num_pending(self):
+        with self._lock:
+            self._requeue_expired_locked()
+            return len(self._pending)
+
+    def epoch(self):
+        with self._lock:
+            return self._epoch
+
+    # -- persistence (the etcd slot) ---------------------------------------
+    def _snapshot(self):
+        if not self.snapshot_path:
+            return
+        with self._lock:
+            state = {
+                "epoch": self._epoch,
+                "todo": [t.to_dict() for t in self._todo],
+                # pending leases are deliberately snapshotted as todo: after
+                # a master restart their trainers may be gone (service.go
+                # recover path re-dispatches)
+                "pending": [t.to_dict() for t, _ in self._pending.values()],
+                "done": [t.to_dict() for t in self._done],
+            }
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self.snapshot_path)
+
+    def snapshot(self):
+        self._snapshot()
+
+    def _restore(self):
+        with open(self.snapshot_path) as f:
+            state = json.load(f)
+        self._epoch = state["epoch"]
+        self._todo = ([Task.from_dict(d) for d in state["todo"]] +
+                      [Task.from_dict(d) for d in state["pending"]])
+        self._done = [Task.from_dict(d) for d in state["done"]]
+        log.info("master: restored %d todo / %d done (epoch %d)",
+                 len(self._todo), len(self._done), self._epoch)
+
+
+# ---------------------------------------------------------------------------
+# TCP wire (newline-delimited JSON) — multi-host trainers
+# ---------------------------------------------------------------------------
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        for line in self.rfile:
+            try:
+                req = json.loads(line)
+                method = req["method"]
+                svc = self.server.service            # type: ignore
+                if method == "get_task":
+                    t = svc.get_task()
+                    resp = {"task": t.to_dict() if t else None}
+                elif method == "report_done":
+                    resp = {"ok": svc.report_done(req["task_id"])}
+                elif method == "report_failed":
+                    svc.report_failed(req["task_id"])
+                    resp = {"ok": True}
+                elif method == "status":
+                    resp = {"todo": svc.num_todo(),
+                            "pending": svc.num_pending(),
+                            "epoch": svc.epoch()}
+                else:
+                    resp = {"error": f"unknown method {method}"}
+            except Exception as e:                   # noqa: BLE001
+                resp = {"error": str(e)}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class MasterServer:
+    """Serve a MasterService over TCP (the ProtoServer/net-rpc slot)."""
+
+    def __init__(self, service: MasterService, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._srv = socketserver.ThreadingTCPServer((host, port), _Handler,
+                                                    bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.service = service                  # type: ignore
+        self.addr = self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def shutdown(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class MasterClient:
+    """Client for trainers. ``addr=None`` talks to an in-process service
+    (reference: python/paddle/v2/master/client.py set_dataset/next_record
+    over the C binding; here JSON/TCP or direct calls)."""
+
+    def __init__(self, service: Optional[MasterService] = None,
+                 addr: Optional[tuple] = None):
+        assert (service is None) != (addr is None), \
+            "pass exactly one of service/addr"
+        self._svc = service
+        self._addr = addr
+        self._sock = None
+
+    def _rpc(self, method, **kw):
+        if self._svc is not None:
+            if method == "get_task":
+                t = self._svc.get_task()
+                return {"task": t.to_dict() if t else None}
+            if method == "report_done":
+                return {"ok": self._svc.report_done(kw["task_id"])}
+            if method == "report_failed":
+                self._svc.report_failed(kw["task_id"])
+                return {"ok": True}
+            if method == "status":
+                return {"todo": self._svc.num_todo(),
+                        "pending": self._svc.num_pending(),
+                        "epoch": self._svc.epoch()}
+        if self._sock is None:
+            self._sock = socket.create_connection(self._addr)
+            self._file = self._sock.makefile("rwb")
+        self._file.write((json.dumps({"method": method, **kw}) + "\n")
+                         .encode())
+        self._file.flush()
+        resp = json.loads(self._file.readline())
+        if "error" in resp:
+            raise RuntimeError(f"master rpc error: {resp['error']}")
+        return resp
+
+    def get_task(self) -> Optional[Task]:
+        d = self._rpc("get_task")["task"]
+        return Task.from_dict(d) if d else None
+
+    def report_done(self, task_id: int):
+        self._rpc("report_done", task_id=task_id)
+
+    def report_failed(self, task_id: int):
+        self._rpc("report_failed", task_id=task_id)
+
+    def status(self):
+        return self._rpc("status")
+
+    def close(self):
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def reader(self, poll_interval: float = 0.05, max_epochs: int = 1):
+        """A v2 reader(): stream records task-by-task until ``max_epochs``
+        passes complete — the trainer.train(reader=...) integration
+        (reference: master client next_record consumed by the v2 reader)."""
+
+        def gen():
+            start_epoch = self.status()["epoch"]
+            while True:
+                st = self.status()
+                if st["epoch"] >= start_epoch + max_epochs:
+                    return
+                task = self.get_task()
+                if task is None:
+                    if st["pending"] == 0 and \
+                            self.status()["epoch"] >= start_epoch + max_epochs:
+                        return
+                    time.sleep(poll_interval)
+                    continue
+                try:
+                    for off, _ in task.chunks:
+                        yield from recordio.read_chunk(task.path, off)
+                except Exception:
+                    self.report_failed(task.task_id)
+                    raise
+                self.report_done(task.task_id)
+
+        return gen

@@ -1,0 +1,70 @@
+"""ctypes loader for the native runtime library.
+
+Builds recordio.cc with g++ on first use (cached beside the source; no
+pybind11 in the image — C ABI + ctypes per the environment constraints),
+falling back to None so pure-Python paths keep working without a toolchain.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_SRC = os.path.join(os.path.dirname(__file__), "recordio.cc")
+_SO = os.path.join(os.path.dirname(__file__), "_librecordio.so")
+
+
+def _build() -> bool:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return True
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", _SO + ".tmp", "-lz"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _bind(lib):
+    c = ctypes
+    lib.rio_index.restype = c.c_long
+    lib.rio_index.argtypes = [c.c_char_p, c.POINTER(c.POINTER(c.c_longlong)),
+                              c.POINTER(c.POINTER(c.c_uint))]
+    lib.rio_read_chunk.restype = c.c_longlong
+    lib.rio_read_chunk.argtypes = [c.c_char_p, c.c_longlong,
+                                   c.POINTER(c.POINTER(c.c_uint8)),
+                                   c.POINTER(c.c_uint)]
+    lib.rio_write_chunk.restype = c.c_longlong
+    lib.rio_write_chunk.argtypes = [c.c_char_p, c.c_char_p,
+                                    c.POINTER(c.c_uint), c.c_uint]
+    lib.rio_free.restype = None
+    lib.rio_free.argtypes = [c.c_void_p]
+    lib.loader_create.restype = c.c_void_p
+    lib.loader_create.argtypes = [c.c_char_p, c.POINTER(c.c_longlong),
+                                  c.c_long, c.c_int, c.c_long]
+    lib.loader_next.restype = c.c_longlong
+    lib.loader_next.argtypes = [c.c_void_p, c.POINTER(c.POINTER(c.c_uint8))]
+    lib.loader_destroy.restype = None
+    lib.loader_destroy.argtypes = [c.c_void_p]
+    return lib
+
+
+def get():
+    """The loaded native library, or None when unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if _build():
+            try:
+                _lib = _bind(ctypes.CDLL(_SO))
+            except OSError:
+                _lib = None
+        return _lib

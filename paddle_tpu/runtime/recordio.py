@@ -7,40 +7,77 @@ chunks, each = [u32 magic][u32 nrecords][u64 payload_len][crc32]
 records. A chunk is the unit of task dispatch for the data service.
 """
 
+import ctypes
 import pickle
 import struct
 import zlib
 from typing import Iterable, Iterator, List, Tuple
+
+from paddle_tpu.runtime import native
 
 MAGIC = 0x0A0D5EC5
 HEADER = struct.Struct("<IIQI")
 
 
 def write_records(path: str, records: Iterable, chunk_records: int = 1024):
-    """Write records (pickled) into chunks of chunk_records each."""
-    def flush(out, buf):
+    """Write records (pickled) into chunks of chunk_records each. Framing +
+    CRC run in the native codec when built."""
+    lib = native.get()
+
+    def flush_py(out, buf):
         payload = b"".join(struct.pack("<I", len(r)) + r for r in buf)
         out.write(HEADER.pack(MAGIC, len(buf), len(payload),
                               zlib.crc32(payload) & 0xFFFFFFFF))
         out.write(payload)
 
+    def flush_native(buf):
+        data = b"".join(buf)
+        lens = (ctypes.c_uint * len(buf))(*[len(r) for r in buf])
+        rc = lib.rio_write_chunk(path.encode(), data, lens, len(buf))
+        if rc < 0:
+            raise IOError(f"rio_write_chunk failed ({rc}) for {path}")
+
     n = 0
-    with open(path, "wb") as out:
-        buf: List[bytes] = []
+    buf: List[bytes] = []
+    if lib is not None:
+        open(path, "wb").close()          # native writer appends
         for rec in records:
             buf.append(pickle.dumps(rec, protocol=4))
             n += 1
             if len(buf) >= chunk_records:
-                flush(out, buf)
+                flush_native(buf)
                 buf = []
         if buf:
-            flush(out, buf)
+            flush_native(buf)
+        return n
+    with open(path, "wb") as out:
+        for rec in records:
+            buf.append(pickle.dumps(rec, protocol=4))
+            n += 1
+            if len(buf) >= chunk_records:
+                flush_py(out, buf)
+                buf = []
+        if buf:
+            flush_py(out, buf)
     return n
 
 
 def chunk_offsets(path: str) -> List[Tuple[int, int]]:
     """Index pass: [(offset, nrecords)] per chunk — what the master
     partitions into tasks (go/master/service.go:106 partition)."""
+    lib = native.get()
+    if lib is not None:
+        offs = ctypes.POINTER(ctypes.c_longlong)()
+        cnts = ctypes.POINTER(ctypes.c_uint)()
+        n = lib.rio_index(path.encode(), ctypes.byref(offs),
+                          ctypes.byref(cnts))
+        if n < 0:
+            raise IOError(f"rio_index failed ({n}) for {path}")
+        try:
+            return [(int(offs[i]), int(cnts[i])) for i in range(n)]
+        finally:
+            lib.rio_free(offs)
+            lib.rio_free(cnts)
     out = []
     with open(path, "rb") as f:
         while True:
@@ -56,7 +93,31 @@ def chunk_offsets(path: str) -> List[Tuple[int, int]]:
     return out
 
 
+def _iter_payload(payload: bytes, n: int) -> Iterator:
+    pos = 0
+    for _ in range(n):
+        (rlen,) = struct.unpack_from("<I", payload, pos)
+        pos += 4
+        yield pickle.loads(payload[pos:pos + rlen])
+        pos += rlen
+
+
 def read_chunk(path: str, offset: int) -> Iterator:
+    lib = native.get()
+    if lib is not None:
+        buf = ctypes.POINTER(ctypes.c_uint8)()
+        nrec = ctypes.c_uint()
+        plen = lib.rio_read_chunk(path.encode(), offset, ctypes.byref(buf),
+                                  ctypes.byref(nrec))
+        if plen < 0:
+            raise IOError(f"rio_read_chunk failed ({plen}) at {offset} "
+                          f"in {path}")
+        try:
+            payload = ctypes.string_at(buf, plen)
+        finally:
+            lib.rio_free(buf)
+        yield from _iter_payload(payload, nrec.value)
+        return
     with open(path, "rb") as f:
         f.seek(offset)
         hdr = f.read(HEADER.size)
@@ -66,12 +127,7 @@ def read_chunk(path: str, offset: int) -> Iterator:
         payload = f.read(plen)
         if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
             raise IOError(f"chunk crc mismatch at {offset} in {path}")
-        pos = 0
-        for _ in range(n):
-            (rlen,) = struct.unpack_from("<I", payload, pos)
-            pos += 4
-            yield pickle.loads(payload[pos:pos + rlen])
-            pos += rlen
+        yield from _iter_payload(payload, n)
 
 
 def read_records(path: str) -> Iterator:

@@ -4,6 +4,8 @@ Distributed tests run on the 8-device virtual CPU mesh (SURVEY.md §4.6
 strategy — the in-process pserver analog).
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -109,7 +111,7 @@ class TestTransformer:
             max_len=32, dtype=jnp.float32, use_ring_attention=True)
         mesh = place.make_mesh(
             (2, 2, 2), (place.AXIS_DATA, place.AXIS_SEQ, place.AXIS_MODEL))
-        params = transformer.init_params(jax.random.PRNGKey(1), CFG)
+        params = transformer.init_params(jax.random.PRNGKey(1), cfg)
         shardings = transformer.param_shardings(cfg, mesh)
         sharded = jax.tree_util.tree_map(jax.device_put, params, shardings)
         B, T = 4, 16
@@ -117,7 +119,8 @@ class TestTransformer:
         tgt = jnp.asarray(rng.randint(0, 50, (B, T)).astype(np.int32))
         lens = jnp.asarray(np.array([16, 10, 16, 7], np.int32))
 
-        ref = transformer.lm_loss(params, toks, tgt, CFG, lengths=lens)
+        ref_cfg = dataclasses.replace(cfg, use_ring_attention=False)
+        ref = transformer.lm_loss(params, toks, tgt, ref_cfg, lengths=lens)
 
         @jax.jit
         def dist_loss(p, tk, tg, ln):
@@ -128,7 +131,7 @@ class TestTransformer:
 
         # grads too: the backward collectives must be correct
         g_ref = jax.grad(lambda p: transformer.lm_loss(
-            p, toks, tgt, CFG, lengths=lens))(params)
+            p, toks, tgt, ref_cfg, lengths=lens))(params)
         g_got = jax.jit(jax.grad(lambda p: transformer.lm_loss(
             p, toks, tgt, cfg, mesh=mesh, lengths=lens)))(sharded)
         ref_flat = jax.tree_util.tree_leaves(g_ref)
