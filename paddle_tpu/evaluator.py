@@ -11,6 +11,7 @@ scalars cross the host boundary each batch.
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu.topology import LayerOutput, Value, auto_name
@@ -167,3 +168,234 @@ class EvaluatorSet:
 
     def result(self):
         return {name: acc.value() for name, acc in self.accs.items()}
+
+
+def chunk(input, label, num_chunk_types: int, chunk_scheme: str = "IOB",
+          name: Optional[str] = None):
+    """Chunking F1 (NER-style) over predicted vs gold tag sequences
+    (reference: ChunkEvaluator.cpp — IOB/IOE/IOBES/plain schemes; tag
+    layout tag = chunk_type * num_tag_types + tag_type, O = the last id).
+
+    Accumulables: [#correct_chunks, #pred_chunks, #label_chunks].
+    TPU design: chunk extraction and matching are vectorized boundary
+    masks + a segmented all-equal scan — no host-side segment lists.
+    """
+    name = name or auto_name("chunk_evaluator")
+    schemes = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}
+    if chunk_scheme not in schemes:
+        raise ValueError(f"unknown chunk scheme {chunk_scheme}")
+    num_tag_types = schemes[chunk_scheme]
+    other_tag = num_chunk_types * num_tag_types      # the "O" id
+
+    def boundaries(tags):
+        """begin/end/type masks [B, T] for one scheme."""
+        inside = tags < other_tag
+        ttype = jnp.where(inside, tags % num_tag_types, -1)
+        ctype = jnp.where(inside, tags // num_tag_types, -1)
+        prev_in = jnp.pad(inside, ((0, 0), (1, 0)))[:, :-1]
+        prev_ct = jnp.pad(ctype, ((0, 0), (1, 0)),
+                          constant_values=-1)[:, :-1]
+        nxt_in = jnp.pad(inside, ((0, 0), (0, 1)))[:, 1:]
+        nxt_ct = jnp.pad(ctype, ((0, 0), (0, 1)),
+                         constant_values=-1)[:, 1:]
+        nxt_tt = jnp.pad(ttype, ((0, 0), (0, 1)),
+                         constant_values=-1)[:, 1:]
+        if chunk_scheme == "IOB":          # B=0, I=1
+            begin = inside & ((ttype == 0) | ~prev_in | (prev_ct != ctype))
+            end = inside & (~nxt_in | (nxt_ct != ctype) | (nxt_tt == 0))
+        elif chunk_scheme == "IOE":        # I=0, E=1
+            prev_tt = jnp.pad(ttype, ((0, 0), (1, 0)),
+                              constant_values=-1)[:, :-1]
+            begin = inside & (~prev_in | (prev_ct != ctype) |
+                              (prev_tt == 1))
+            end = inside & ((ttype == 1) | ~nxt_in | (nxt_ct != ctype))
+        elif chunk_scheme == "IOBES":      # B=0, I=1, E=2, S=3
+            prev_tt = jnp.pad(ttype, ((0, 0), (1, 0)),
+                              constant_values=-1)[:, :-1]
+            begin = inside & ((ttype == 0) | (ttype == 3) | ~prev_in |
+                              (prev_ct != ctype) | (prev_tt == 2) |
+                              (prev_tt == 3))
+            end = inside & ((ttype == 2) | (ttype == 3) | ~nxt_in |
+                            (nxt_ct != ctype) | (nxt_tt == 0) |
+                            (nxt_tt == 3))
+        else:                              # plain: every tag its own chunk run
+            begin = inside & (~prev_in | (prev_ct != ctype))
+            end = inside & (~nxt_in | (nxt_ct != ctype))
+        return begin, end, ctype
+
+    def accum(params, parents, ctx):
+        pv, lv = parents
+        pred = pv.array
+        if pred.ndim == 3:                 # scores -> tag ids
+            pred = jnp.argmax(pred, axis=-1)
+        pred = pred.astype(jnp.int32)
+        lab = lv.array.astype(jnp.int32)
+        if lab.ndim == 3:
+            lab = lab[..., 0]
+        T = pred.shape[1]
+        valid = jnp.arange(T)[None, :] < pv.lengths[:, None]
+        pred = jnp.where(valid, pred, other_tag)
+        lab = jnp.where(valid, lab, other_tag)
+        pb, pe, pc = boundaries(pred)
+        lb, le, lc = boundaries(lab)
+        align = (pb == lb) & (pe == le) & (pc == lc)
+
+        # segmented "all aligned since the label-chunk start" scan
+        def scan_t(run, xs):
+            a_t, b_t = xs
+            run = a_t & jnp.where(b_t, True, run)
+            return run, run
+
+        _, run_ok = jax.lax.scan(
+            scan_t, jnp.zeros(pred.shape[0], bool),
+            (align.swapaxes(0, 1), lb.swapaxes(0, 1)))
+        run_ok = run_ok.swapaxes(0, 1)
+        correct = le & pe & run_ok
+        return jnp.stack([jnp.sum(correct).astype(jnp.float32),
+                          jnp.sum(pb).astype(jnp.float32),
+                          jnp.sum(lb).astype(jnp.float32)])
+
+    def fin(t):
+        c, p, l = t
+        prec = c / max(p, 1e-12)
+        rec = c / max(l, 1e-12)
+        return {"precision": prec, "recall": rec,
+                "f1": 2 * prec * rec / max(prec + rec, 1e-12)}
+
+    return _evaluator_layer(name, "chunk", [input, label], accum, fin, 3)
+
+
+def ctc_error(input, label, blank: Optional[int] = None,
+              name: Optional[str] = None):
+    """Sequence error: edit distance between the greedy CTC decode of
+    ``input`` and the label, normalized by label length (reference:
+    CTCErrorEvaluator.cpp). Accumulables: [total_edit, total_label_len]."""
+    from paddle_tpu.ops import ctc as ops_ctc
+    name = name or auto_name("ctc_error_evaluator")
+
+    def edit_distance(a, a_len, b, b_len):
+        """Levenshtein via scan over rows of the DP table.
+        a [La], b [Lb] padded int arrays."""
+        La, Lb = a.shape[0], b.shape[0]
+        row0 = jnp.arange(Lb + 1, dtype=jnp.float32)
+
+        def step(row, xs):
+            ai, i = xs
+
+            def inner(left, xs2):
+                bj, up, diag = xs2
+                cost = jnp.where(ai == bj, 0.0, 1.0)
+                val = jnp.minimum(jnp.minimum(left + 1, up + 1), diag + cost)
+                return val, val
+
+            _, vals = jax.lax.scan(inner, i + 1.0, (b, row[1:], row[:-1]))
+            new_row = jnp.concatenate([jnp.array([i + 1.0]), vals])
+            # beyond a_len keep previous row (no-op)
+            return jnp.where(i < a_len, new_row, row), None
+
+        final, _ = jax.lax.scan(step, row0,
+                                (a, jnp.arange(La, dtype=jnp.float32)))
+        return final[b_len.astype(jnp.int32)]
+
+    def accum(params, parents, ctx):
+        pv, lv = parents
+        n_cls = pv.array.shape[-1]
+        blk = (n_cls - 1) if blank is None else blank
+        logp = jnp.log(jnp.maximum(pv.array.astype(jnp.float32), 1e-30)) \
+            if input.activation == "softmax" else \
+            jax.nn.log_softmax(pv.array.astype(jnp.float32), -1)
+        dec, dec_len = ops_ctc.ctc_greedy_decode(logp, pv.lengths, blank=blk)
+        lab = lv.array.astype(jnp.int32)
+        if lab.ndim == 3:
+            lab = lab[..., 0]
+        dists = jax.vmap(edit_distance)(dec, dec_len, lab, lv.lengths)
+        return jnp.stack([jnp.sum(dists),
+                          jnp.sum(lv.lengths).astype(jnp.float32)])
+
+    return _evaluator_layer(name, "ctc_error", [input, label], accum,
+                            lambda t: t[0] / max(t[1], 1e-12), 2)
+
+
+def detection_map(detections, label, num_classes: int,
+                  overlap_threshold: float = 0.5, background_id: int = 0,
+                  score_bins: int = 100, name: Optional[str] = None):
+    """Detection mAP over detection_output results (reference:
+    DetectionMAPEvaluator.cpp — 11-point / integral AP).
+
+    TPU design: instead of host-side per-detection score lists, TP/FP are
+    histogrammed into ``score_bins`` confidence bins per class on device;
+    AP integrates the binned precision/recall curve on the host.
+    Accumulables per class: [tp_hist, fp_hist, #gt].
+    """
+    name = name or auto_name("detection_map_evaluator")
+    C, BINS = num_classes, score_bins
+
+    def accum(params, parents, ctx):
+        dv, lv = parents
+        det = dv.array                                   # [B, K, 6]
+        gt = lv.array                                    # [B, G, 5]
+        gt_valid = (jnp.arange(gt.shape[1])[None, :] <
+                    lv.lengths[:, None])
+
+        def one(det_b, gt_b, valid_b):
+            from paddle_tpu.ops import detection as ops_det
+            iou = ops_det.iou_matrix(det_b[:, 2:6], gt_b[:, 1:5])  # [K,G]
+            cls_match = (det_b[:, 0:1] == gt_b[None, :, 0]) & valid_b[None]
+            iou = jnp.where(cls_match, iou, 0.0)
+            K, G = iou.shape
+            # greedy: detections are score-sorted (detection_output output);
+            # each claims its best unclaimed gt above threshold
+            def body(i, carry):
+                claimed, tp = carry
+                row = jnp.where(claimed, 0.0, iou[i])
+                j = jnp.argmax(row)
+                hit = (row[j] >= overlap_threshold) & (det_b[i, 0] >= 0)
+                claimed = claimed.at[j].set(claimed[j] | hit)
+                tp = tp.at[i].set(hit)
+                return claimed, tp
+
+            _, tp = jax.lax.fori_loop(
+                0, K, body, (jnp.zeros(G, bool), jnp.zeros(K, bool)))
+            valid_det = det_b[:, 0] >= 0
+            fp = valid_det & ~tp
+            bins = jnp.clip((det_b[:, 1] * BINS).astype(jnp.int32), 0,
+                            BINS - 1)
+            cls = jnp.maximum(det_b[:, 0].astype(jnp.int32), 0)
+            flat = cls * BINS + bins
+            tp_h = jnp.zeros(C * BINS).at[flat].add(
+                tp.astype(jnp.float32) * valid_det)
+            fp_h = jnp.zeros(C * BINS).at[flat].add(
+                fp.astype(jnp.float32))
+            gt_h = jnp.zeros(C).at[gt_b[:, 0].astype(jnp.int32)].add(
+                valid_b.astype(jnp.float32))
+            return jnp.concatenate([tp_h, fp_h, gt_h])
+
+        per = jax.vmap(one)(det, gt, gt_valid)
+        return jnp.sum(per, axis=0)
+
+    def fin(t):
+        import numpy as np
+        tp_h = t[:C * BINS].reshape(C, BINS)
+        fp_h = t[C * BINS:2 * C * BINS].reshape(C, BINS)
+        ngt = t[2 * C * BINS:]
+        aps = []
+        for c in range(C):
+            if c == background_id or ngt[c] <= 0:
+                continue
+            # sweep score bins high -> low
+            tp = np.cumsum(tp_h[c][::-1])
+            fp = np.cumsum(fp_h[c][::-1])
+            rec = tp / ngt[c]
+            prec = tp / np.maximum(tp + fp, 1e-12)
+            # integral AP with monotone precision envelope
+            prec = np.maximum.accumulate(prec[::-1])[::-1]
+            ap = 0.0
+            prev_r = 0.0
+            for r, p in zip(rec, prec):
+                ap += (r - prev_r) * p
+                prev_r = r
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0
+
+    return _evaluator_layer(name, "detection_map", [detections, label],
+                            accum, fin, 2 * C * BINS + C)

@@ -1951,3 +1951,191 @@ def hsigmoid(input, label, num_classes: int, name: Optional[str] = None,
 
     return LayerOutput(name, "hsigmoid", inputs + [label], fwd, specs,
                        size=1)
+
+
+# ---------------------------------------------------------------------------
+# detection suite (reference: priorbox_layer, multibox_loss_layer,
+# detection_output_layer, roi_pool_layer — gserver/layers/PriorBox.cpp,
+# MultiBoxLossLayer.cpp, DetectionOutputLayer.cpp, ROIPoolLayer.cpp,
+# DetectionUtil.cpp). Ground-truth boxes feed as a padded Value
+# [B, G, 5] = (class, x1, y1, x2, y2) with lengths = #boxes per image.
+# ---------------------------------------------------------------------------
+
+def priorbox(input, image_size, min_size, max_size=None,
+             aspect_ratio=(2.0,), variance=(0.1, 0.1, 0.2, 0.2),
+             name: Optional[str] = None):
+    """SSD prior boxes for one feature map → [P, 4] plus variances kept as
+    a layer attribute (reference: priorbox_layer / PriorBox.cpp)."""
+    from paddle_tpu.ops import detection as ops_det
+    name = name or auto_name("priorbox")
+    c, fh, fw = _img_in_shape(input)
+    ih, iw = ((image_size, image_size) if isinstance(image_size, int)
+              else tuple(image_size))
+    boxes = ops_det.prior_boxes(fh, fw, ih, iw, min_size, max_size,
+                                aspect_ratios=tuple(aspect_ratio))
+    nprior = boxes.shape[0]
+
+    def fwd(params, parents, ctx):
+        return Value(boxes)
+
+    lo = LayerOutput(name, "priorbox", [input], fwd, [], size=nprior * 4)
+    lo.num_priors = nprior
+    lo.variances = tuple(variance)
+    return lo
+
+
+def multibox_loss(input_loc, input_conf, priorbox, label,
+                  num_classes: int, overlap_threshold: float = 0.5,
+                  neg_pos_ratio: float = 3.0, background_id: int = 0,
+                  name: Optional[str] = None):
+    """SSD training loss: matched-prior smooth-L1 localization + softmax
+    confidence with hard negative mining (reference: multibox_loss_layer,
+    MultiBoxLossLayer.cpp).
+
+    input_loc/input_conf: layer(s) of per-prior predictions, concatenated to
+    [B, P*4] and [B, P*num_classes]; priorbox: priorbox layer(s).
+    """
+    from paddle_tpu.ops import detection as ops_det
+    name = name or auto_name("multibox_loss")
+    locs = _as_list(input_loc)
+    confs = _as_list(input_conf)
+    pbs = _as_list(priorbox)
+    variances = pbs[0].variances
+
+    def fwd(params, parents, ctx):
+        nl, nc, npb = len(locs), len(confs), len(pbs)
+        loc_v = parents[:nl]
+        conf_v = parents[nl:nl + nc]
+        pb_v = parents[nl + nc:nl + nc + npb]
+        lab_v = parents[-1]
+        priors = jnp.concatenate([p.array for p in pb_v], axis=0)  # [P,4]
+        P = priors.shape[0]
+        B = loc_v[0].array.shape[0]
+        loc = jnp.concatenate(
+            [v.array.reshape(B, -1) for v in loc_v], axis=1).reshape(B, P, 4)
+        conf = jnp.concatenate(
+            [v.array.reshape(B, -1) for v in conf_v],
+            axis=1).reshape(B, P, num_classes)
+        gt = lab_v.array                                   # [B, G, 5]
+        gt_valid = (jnp.arange(gt.shape[1])[None, :] <
+                    lab_v.lengths[:, None])                # [B, G]
+
+        def one(loc_b, conf_b, gt_b, valid_b):
+            match, _ = ops_det.match_priors(priors, gt_b[:, 1:5], valid_b,
+                                            overlap_threshold)
+            pos = match >= 0
+            npos = jnp.sum(pos)
+            safe_match = jnp.maximum(match, 0)
+            gt_box = jnp.take(gt_b[:, 1:5], safe_match, axis=0)
+            gt_cls = jnp.take(gt_b[:, 0], safe_match).astype(jnp.int32)
+            target = ops_det.encode_boxes(gt_box, priors, variances)
+            d = (loc_b - target).astype(jnp.float32)
+            ad = jnp.abs(d)
+            sl1 = jnp.where(ad < 1.0, 0.5 * ad * ad, ad - 0.5).sum(-1)
+            loc_loss = jnp.sum(jnp.where(pos, sl1, 0.0))
+            # conf loss per prior, target = matched class or background
+            tgt_cls = jnp.where(pos, gt_cls, background_id)
+            logp = jax.nn.log_softmax(conf_b.astype(jnp.float32), -1)
+            ce = -jnp.take_along_axis(logp, tgt_cls[:, None], axis=1)[:, 0]
+            # hard negative mining: top (ratio*npos) background priors by ce
+            nneg = jnp.minimum((neg_pos_ratio * npos).astype(jnp.int32),
+                               P - npos)
+            neg_ce = jnp.where(pos, -jnp.inf, ce)
+            order = jnp.argsort(-neg_ce)
+            rank = jnp.zeros(P, jnp.int32).at[order].set(jnp.arange(P))
+            neg = (~pos) & (rank < nneg)
+            conf_loss = jnp.sum(jnp.where(pos | neg, ce, 0.0))
+            denom = jnp.maximum(npos.astype(jnp.float32), 1.0)
+            return (loc_loss + conf_loss) / denom
+
+        losses = jax.vmap(one)(loc, conf, gt, gt_valid)
+        return Value(losses)
+
+    return LayerOutput(name, "multibox_loss",
+                       locs + confs + pbs + [label], fwd, [], size=1)
+
+
+def detection_output(input_loc, input_conf, priorbox, num_classes: int,
+                     nms_threshold: float = 0.45, nms_top_k: int = 400,
+                     keep_top_k: int = 200,
+                     confidence_threshold: float = 0.01,
+                     background_id: int = 0, name: Optional[str] = None):
+    """Decode + per-class NMS → [B, keep_top_k, 6] rows of
+    (label, score, x1, y1, x2, y2), label −1 padding (reference:
+    detection_output_layer / DetectionOutputLayer.cpp)."""
+    from paddle_tpu.ops import detection as ops_det
+    name = name or auto_name("detection_output")
+    locs = _as_list(input_loc)
+    confs = _as_list(input_conf)
+    pbs = _as_list(priorbox)
+    variances = pbs[0].variances
+
+    def fwd(params, parents, ctx):
+        nl, nc = len(locs), len(confs)
+        loc_v = parents[:nl]
+        conf_v = parents[nl:nl + nc]
+        pb_v = parents[nl + nc:]
+        priors = jnp.concatenate([p.array for p in pb_v], axis=0)
+        P = priors.shape[0]
+        B = loc_v[0].array.shape[0]
+        loc = jnp.concatenate(
+            [v.array.reshape(B, -1) for v in loc_v], axis=1).reshape(B, P, 4)
+        conf = jnp.concatenate(
+            [v.array.reshape(B, -1) for v in conf_v],
+            axis=1).reshape(B, P, num_classes)
+        probs = jax.nn.softmax(conf.astype(jnp.float32), -1)
+
+        def one(loc_b, probs_b):
+            boxes = ops_det.decode_boxes(loc_b, priors, variances)
+            per_k = max(1, min(nms_top_k, P))
+            rows = []
+            for cls in range(num_classes):
+                if cls == background_id:
+                    continue
+                sel, sc = ops_det.nms(boxes, probs_b[:, cls], per_k,
+                                      nms_threshold, confidence_threshold)
+                bx = jnp.take(boxes, jnp.maximum(sel, 0), axis=0)
+                valid = sel >= 0
+                row = jnp.concatenate([
+                    jnp.where(valid, cls, -1)[:, None].astype(jnp.float32),
+                    sc[:, None], bx], axis=1)              # [per_k, 6]
+                rows.append(row)
+            allr = jnp.concatenate(rows, axis=0)           # [(C-1)*per_k, 6]
+            if allr.shape[0] < keep_top_k:                 # honor size contract
+                pad = jnp.full((keep_top_k - allr.shape[0], 6), -1.0)
+                allr = jnp.concatenate([allr, pad.at[:, 1:].set(0.0)], axis=0)
+            order = jnp.argsort(-jnp.where(allr[:, 0] >= 0, allr[:, 1],
+                                           -jnp.inf))
+            return jnp.take(allr, order[:keep_top_k], axis=0)
+
+        return Value(jax.vmap(one)(loc, probs))
+
+    return LayerOutput(name, "detection_output", locs + confs + pbs, fwd,
+                       [], size=keep_top_k * 6)
+
+
+def roi_pool(input, rois, pooled_width: int, pooled_height: int,
+             spatial_scale: float = 1.0, num_channels: Optional[int] = None,
+             name: Optional[str] = None):
+    """ROI max pooling (reference: roi_pool_layer / ROIPoolLayer.cpp).
+    ``rois``: Value [B, R, 4] with lengths = #rois; output
+    [B, R, pooled_h, pooled_w, C] (invalid rois are zero)."""
+    from paddle_tpu.ops import detection as ops_det
+    name = name or auto_name("roi_pool")
+    c, h, w = _img_in_shape(input)
+    c = num_channels or c
+
+    def fwd(params, parents, ctx):
+        xv, rv = parents
+        x = _to_nhwc(xv.array, c, h, w)
+        rois_b = rv.array                                  # [B, R, 4]
+        out = jax.vmap(lambda f, r: ops_det.roi_pool(
+            f, r, pooled_height, pooled_width, spatial_scale))(x, rois_b)
+        if rv.lengths is not None:
+            valid = (jnp.arange(rois_b.shape[1])[None, :] <
+                     rv.lengths[:, None])
+            out = jnp.where(valid[..., None, None, None], out, 0.0)
+        return Value(out, rv.lengths)
+
+    return LayerOutput(name, "roi_pool", [input, rois], fwd, [],
+                       size=pooled_width * pooled_height * c)

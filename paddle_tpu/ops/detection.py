@@ -1,0 +1,198 @@
+"""Detection kernels: box IoU, SSD prior boxes, box codec, NMS, ROI pooling.
+
+Reference: the SSD detection suite — gserver/layers/PriorBox.cpp,
+MultiBoxLossLayer.cpp, DetectionOutputLayer.cpp, DetectionUtil.cpp
+(encodeBBox/decodeBBox/applyNMSFast), ROIPoolLayer.cpp; new stack
+operators/prior_box_op.cc, multiclass_nms equivalents.
+
+TPU design: boxes ride as fixed-width padded tensors ([B, N, 4] + validity
+masks); matching is a dense IoU matrix + argmax; NMS is a fixed-iteration
+suppression loop (fori_loop over the k kept slots) instead of dynamic
+queues. Boxes are (xmin, ymin, xmax, ymax), normalized [0, 1].
+"""
+
+import math
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def iou_matrix(a: jax.Array, b: jax.Array) -> jax.Array:
+    """IoU of every pair: a [N, 4] x b [M, 4] → [N, M]."""
+    ax1, ay1, ax2, ay2 = jnp.split(a, 4, axis=-1)        # [N,1]
+    bx1, by1, bx2, by2 = [v[None, :, 0] for v in jnp.split(b, 4, axis=-1)]
+    ix1 = jnp.maximum(ax1, bx1)
+    iy1 = jnp.maximum(ay1, by1)
+    ix2 = jnp.minimum(ax2, bx2)
+    iy2 = jnp.minimum(ay2, by2)
+    inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+    area_a = jnp.clip(ax2 - ax1, 0) * jnp.clip(ay2 - ay1, 0)
+    area_b = jnp.clip(bx2 - bx1, 0) * jnp.clip(by2 - by1, 0)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def prior_boxes(feat_h: int, feat_w: int, img_h: int, img_w: int,
+                min_size: float, max_size: float = None,
+                aspect_ratios: Sequence[float] = (2.0,),
+                flip: bool = True, clip: bool = True) -> jax.Array:
+    """SSD prior boxes for one feature map → [feat_h*feat_w*P, 4]
+    (reference: PriorBox.cpp — one square min box, optional sqrt(min*max)
+    box, plus aspect-ratio boxes per cell center)."""
+    ratios = [1.0]
+    for ar in aspect_ratios:
+        ratios.append(ar)
+        if flip:
+            ratios.append(1.0 / ar)
+    whs = [(min_size, min_size)]
+    if max_size:
+        s = math.sqrt(min_size * max_size)
+        whs.append((s, s))
+    for r in ratios[1:]:
+        whs.append((min_size * math.sqrt(r), min_size / math.sqrt(r)))
+
+    cx = (jnp.arange(feat_w) + 0.5) / feat_w
+    cy = (jnp.arange(feat_h) + 0.5) / feat_h
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), -1)  # [H,W,2]
+    boxes = []
+    for w, h in whs:
+        wn, hn = w / img_w, h / img_h
+        box = jnp.concatenate([
+            cyx[..., 1:2] - wn / 2, cyx[..., 0:1] - hn / 2,
+            cyx[..., 1:2] + wn / 2, cyx[..., 0:1] + hn / 2], -1)
+        boxes.append(box)
+    out = jnp.stack(boxes, 2).reshape(-1, 4)              # [H*W*P, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def encode_boxes(gt: jax.Array, priors: jax.Array,
+                 variances=(0.1, 0.1, 0.2, 0.2)) -> jax.Array:
+    """SSD box targets: center/size offsets scaled by variances
+    (reference: DetectionUtil.cpp encodeBBoxWithVar)."""
+    pw = priors[..., 2] - priors[..., 0]
+    ph = priors[..., 3] - priors[..., 1]
+    pcx = (priors[..., 0] + priors[..., 2]) / 2
+    pcy = (priors[..., 1] + priors[..., 3]) / 2
+    gw = jnp.clip(gt[..., 2] - gt[..., 0], 1e-8)
+    gh = jnp.clip(gt[..., 3] - gt[..., 1], 1e-8)
+    gcx = (gt[..., 0] + gt[..., 2]) / 2
+    gcy = (gt[..., 1] + gt[..., 3]) / 2
+    v = variances
+    return jnp.stack([
+        (gcx - pcx) / pw / v[0], (gcy - pcy) / ph / v[1],
+        jnp.log(gw / pw) / v[2], jnp.log(gh / ph) / v[3]], -1)
+
+
+def decode_boxes(loc: jax.Array, priors: jax.Array,
+                 variances=(0.1, 0.1, 0.2, 0.2)) -> jax.Array:
+    """Inverse of encode_boxes (reference: decodeBBoxWithVar)."""
+    pw = priors[..., 2] - priors[..., 0]
+    ph = priors[..., 3] - priors[..., 1]
+    pcx = (priors[..., 0] + priors[..., 2]) / 2
+    pcy = (priors[..., 1] + priors[..., 3]) / 2
+    v = variances
+    cx = loc[..., 0] * v[0] * pw + pcx
+    cy = loc[..., 1] * v[1] * ph + pcy
+    w = jnp.exp(loc[..., 2] * v[2]) * pw
+    h = jnp.exp(loc[..., 3] * v[3]) * ph
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+
+
+def match_priors(priors: jax.Array, gt_boxes: jax.Array, gt_valid: jax.Array,
+                 overlap_threshold: float = 0.5):
+    """SSD bipartite + per-prediction matching (reference:
+    DetectionUtil.cpp matchBBox): every gt claims its best prior; remaining
+    priors match their best gt if IoU >= threshold.
+
+    priors [P, 4], gt_boxes [G, 4], gt_valid [G] bool →
+    (match_idx [P] int32 — gt index or -1, match_iou [P]).
+    """
+    iou = iou_matrix(priors, gt_boxes)                    # [P, G]
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)   # per prior
+    best_gt_iou = jnp.max(iou, axis=1)
+    match = jnp.where(best_gt_iou >= overlap_threshold, best_gt, -1)
+    # bipartite pass: each gt's best prior is forced to that gt
+    best_prior = jnp.argmax(iou, axis=0).astype(jnp.int32)  # [G]
+    prior_ids = jnp.arange(priors.shape[0])
+    for_gt = (prior_ids[:, None] == best_prior[None, :]) & gt_valid[None, :] \
+        & (jnp.max(iou, axis=0) > 0)[None, :]
+    forced = jnp.argmax(for_gt, axis=1).astype(jnp.int32)
+    has_forced = jnp.any(for_gt, axis=1)
+    match = jnp.where(has_forced, forced, match)
+    match_iou = jnp.where(has_forced,
+                          jnp.take_along_axis(iou, forced[:, None],
+                                              axis=1)[:, 0],
+                          best_gt_iou)
+    return match, match_iou
+
+
+def nms(boxes: jax.Array, scores: jax.Array, max_out: int,
+        iou_threshold: float = 0.45, score_threshold: float = 0.01):
+    """Greedy NMS with static shapes (reference: applyNMSFast).
+
+    boxes [N, 4], scores [N] → (sel_idx [max_out] int32 (-1 pad),
+    sel_scores [max_out]). Iterates max_out times; each step takes the
+    best remaining score and suppresses overlaps.
+    """
+    N = boxes.shape[0]
+    iou = iou_matrix(boxes, boxes)                        # [N, N]
+    alive = scores >= score_threshold
+
+    def body(i, carry):
+        alive, sel, sel_sc = carry
+        masked = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(masked).astype(jnp.int32)
+        ok = masked[best] > -jnp.inf
+        sel = sel.at[i].set(jnp.where(ok, best, -1))
+        sel_sc = sel_sc.at[i].set(jnp.where(ok, scores[best], 0.0))
+        # suppress: the chosen one and all with IoU above threshold
+        suppress = (iou[best] >= iou_threshold) | \
+            (jnp.arange(N) == best)
+        alive = alive & jnp.where(ok, ~suppress, True)
+        return alive, sel, sel_sc
+
+    sel0 = jnp.full((max_out,), -1, jnp.int32)
+    sc0 = jnp.zeros((max_out,), jnp.float32)
+    _, sel, sel_sc = jax.lax.fori_loop(0, max_out, body, (alive, sel0, sc0))
+    return sel, sel_sc
+
+
+def roi_pool(feat: jax.Array, rois: jax.Array, out_h: int, out_w: int,
+             spatial_scale: float = 1.0) -> jax.Array:
+    """Max-pool each ROI to a fixed grid (reference: ROIPoolLayer.cpp,
+    roi_pool_op.cc). feat [H, W, C] (one image), rois [R, 4] in feature
+    coords after spatial_scale → [R, out_h, out_w, C].
+
+    TPU design: instead of per-cell dynamic slices, build a dense
+    [cell, position] membership mask and reduce — static shapes, MXU/VPU
+    friendly for the moderate ROI counts detection uses.
+    """
+    H, W, C = feat.shape
+    x1 = rois[:, 0] * spatial_scale
+    y1 = rois[:, 1] * spatial_scale
+    x2 = rois[:, 2] * spatial_scale
+    y2 = rois[:, 3] * spatial_scale
+    rw = jnp.maximum(x2 - x1, 1e-6)
+    rh = jnp.maximum(y2 - y1, 1e-6)
+
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+    # cell boundaries per roi/cell
+    cy0 = y1[:, None] + (jnp.arange(out_h) / out_h)[None, :] * rh[:, None]
+    cy1 = y1[:, None] + ((jnp.arange(out_h) + 1) / out_h)[None, :] * rh[:, None]
+    cx0 = x1[:, None] + (jnp.arange(out_w) / out_w)[None, :] * rw[:, None]
+    cx1 = x1[:, None] + ((jnp.arange(out_w) + 1) / out_w)[None, :] * rw[:, None]
+    # membership: [R, out_h, H], [R, out_w, W] — floor/ceil like the ref
+    in_y = ((ys[None, None, :] >= jnp.floor(cy0[..., None])) &
+            (ys[None, None, :] < jnp.ceil(cy1[..., None])))
+    in_x = ((xs[None, None, :] >= jnp.floor(cx0[..., None])) &
+            (xs[None, None, :] < jnp.ceil(cx1[..., None])))
+    m = (in_y[:, :, None, :, None] & in_x[:, None, :, None, :])
+    # [R, oh, ow, H, W] mask; reduce max over H, W
+    masked = jnp.where(m[..., None], feat[None, None, None], -jnp.inf)
+    out = jnp.max(masked, axis=(3, 4))
+    return jnp.where(jnp.isfinite(out), out, 0.0)

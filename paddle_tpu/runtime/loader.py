@@ -73,25 +73,44 @@ class PrefetchLoader:
         q: "queue.Queue" = queue.Queue(maxsize=self.capacity)
         sentinel = object()
         err: list = []
+        stop = threading.Event()
+
+        def put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker():
             try:
                 for off in offsets:
                     for rec in recordio.read_chunk(self.path, off):
-                        q.put(rec)
-            except BaseException as e:        # propagate to the consumer
+                        if not put(rec):
+                            return          # consumer abandoned us
+            except BaseException as e:      # propagate to the consumer
                 err.append(e)
             finally:
-                q.put(sentinel)
+                put(sentinel)
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                break
-            yield item
-        t.join()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                yield item
+        finally:
+            stop.set()
+            while not q.empty():            # unblock a full queue
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join()
         if err:
             raise err[0]
 

@@ -35,6 +35,7 @@ class Task:
     path: str
     chunks: List[List[int]]            # [[offset, nrecords], ...]
     fail_count: int = 0
+    lease: int = 0                     # lease token; stale reports rejected
 
     @property
     def nrecords(self):
@@ -73,6 +74,7 @@ class MasterService:
         self._time = time_fn
         self.num_passes = num_passes
         self._epoch = 0
+        self._lease_counter = 0
         if snapshot_path and os.path.exists(snapshot_path):
             self._restore()
 
@@ -111,26 +113,30 @@ class MasterService:
             if not self._todo:
                 return None
             task = self._todo.pop(0)
+            self._lease_counter += 1
+            task.lease = self._lease_counter
             self._pending[task.task_id] = (task,
                                            self._time() + self.lease_seconds)
             return task
 
-    def report_done(self, task_id: int) -> bool:
+    def report_done(self, task_id: int, lease: Optional[int] = None) -> bool:
         with self._lock:
-            ent = self._pending.pop(task_id, None)
-            if ent is None:
-                return False                    # late report after re-lease
+            ent = self._pending.get(task_id)
+            if ent is None or (lease is not None and ent[0].lease != lease):
+                return False       # stale report from a timed-out trainer
+            self._pending.pop(task_id)
             self._done.append(ent[0])
             self._maybe_finish_pass_locked()
             return True
 
-    def report_failed(self, task_id: int):
+    def report_failed(self, task_id: int, lease: Optional[int] = None):
         """Failed lease: requeue unless over the failure cap
         (service.go failureMax discard)."""
         with self._lock:
-            ent = self._pending.pop(task_id, None)
-            if ent is None:
-                return
+            ent = self._pending.get(task_id)
+            if ent is None or (lease is not None and ent[0].lease != lease):
+                return             # stale report from a timed-out trainer
+            self._pending.pop(task_id)
             task = ent[0]
             task.fail_count += 1
             if task.fail_count >= self.failure_max:
@@ -149,6 +155,7 @@ class MasterService:
             task.fail_count += 1
             if task.fail_count >= self.failure_max:
                 self._discarded.append(task)
+                self._maybe_finish_pass_locked()
             else:
                 log.info("master: lease expired, requeueing task %d", tid)
                 self._todo.append(task)
@@ -193,6 +200,7 @@ class MasterService:
                 # recover path re-dispatches)
                 "pending": [t.to_dict() for t, _ in self._pending.values()],
                 "done": [t.to_dict() for t in self._done],
+                "discarded": [t.to_dict() for t in self._discarded],
             }
         tmp = self.snapshot_path + ".tmp"
         with open(tmp, "w") as f:
@@ -209,6 +217,8 @@ class MasterService:
         self._todo = ([Task.from_dict(d) for d in state["todo"]] +
                       [Task.from_dict(d) for d in state["pending"]])
         self._done = [Task.from_dict(d) for d in state["done"]]
+        self._discarded = [Task.from_dict(d)
+                           for d in state.get("discarded", [])]
         log.info("master: restored %d todo / %d done (epoch %d)",
                  len(self._todo), len(self._done), self._epoch)
 
@@ -228,9 +238,10 @@ class _Handler(socketserver.StreamRequestHandler):
                     t = svc.get_task()
                     resp = {"task": t.to_dict() if t else None}
                 elif method == "report_done":
-                    resp = {"ok": svc.report_done(req["task_id"])}
+                    resp = {"ok": svc.report_done(req["task_id"],
+                                                  req.get("lease"))}
                 elif method == "report_failed":
-                    svc.report_failed(req["task_id"])
+                    svc.report_failed(req["task_id"], req.get("lease"))
                     resp = {"ok": True}
                 elif method == "status":
                     resp = {"todo": svc.num_todo(),
@@ -282,9 +293,10 @@ class MasterClient:
                 t = self._svc.get_task()
                 return {"task": t.to_dict() if t else None}
             if method == "report_done":
-                return {"ok": self._svc.report_done(kw["task_id"])}
+                return {"ok": self._svc.report_done(kw["task_id"],
+                                                    kw.get("lease"))}
             if method == "report_failed":
-                self._svc.report_failed(kw["task_id"])
+                self._svc.report_failed(kw["task_id"], kw.get("lease"))
                 return {"ok": True}
             if method == "status":
                 return {"todo": self._svc.num_todo(),
@@ -305,11 +317,11 @@ class MasterClient:
         d = self._rpc("get_task")["task"]
         return Task.from_dict(d) if d else None
 
-    def report_done(self, task_id: int):
-        self._rpc("report_done", task_id=task_id)
+    def report_done(self, task_id: int, lease: Optional[int] = None):
+        self._rpc("report_done", task_id=task_id, lease=lease)
 
-    def report_failed(self, task_id: int):
-        self._rpc("report_failed", task_id=task_id)
+    def report_failed(self, task_id: int, lease: Optional[int] = None):
+        self._rpc("report_failed", task_id=task_id, lease=lease)
 
     def status(self):
         return self._rpc("status")
@@ -341,8 +353,8 @@ class MasterClient:
                     for off, _ in task.chunks:
                         yield from recordio.read_chunk(task.path, off)
                 except Exception:
-                    self.report_failed(task.task_id)
+                    self.report_failed(task.task_id, task.lease)
                     raise
-                self.report_done(task.task_id)
+                self.report_done(task.task_id, task.lease)
 
         return gen

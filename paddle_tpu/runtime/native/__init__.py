@@ -21,11 +21,12 @@ _SO = os.path.join(os.path.dirname(__file__), "_librecordio.so")
 def _build() -> bool:
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return True
+    tmp = f"{_SO}.tmp.{os.getpid()}"       # per-process: concurrent builds
     cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           _SRC, "-o", _SO + ".tmp", "-lz"]
+           _SRC, "-o", tmp, "-lz"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(_SO + ".tmp", _SO)
+        os.replace(tmp, _SO)
         return True
     except (OSError, subprocess.SubprocessError):
         return False

@@ -1,0 +1,279 @@
+"""Detection suite (priorbox/multibox_loss/detection_output/roi_pool) and
+chunk/CTC-error/mAP evaluators vs numpy references.
+
+Reference analog: gserver/tests/test_PriorBox.cpp, test_DetectionOutput.cpp,
+test_Evaluator.cpp, ChunkEvaluator/CTCErrorEvaluator/DetectionMAPEvaluator.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import evaluator, layer
+from paddle_tpu.ops import detection as ops_det
+from paddle_tpu.topology import Topology, Value
+from paddle_tpu.utils.rng import KeySource
+
+
+def np_iou(a, b):
+    x1 = max(a[0], b[0]); y1 = max(a[1], b[1])
+    x2 = min(a[2], b[2]); y2 = min(a[3], b[3])
+    inter = max(0, x2 - x1) * max(0, y2 - y1)
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+class TestDetectionOps:
+    def test_iou_matrix(self, rng):
+        a = np.sort(rng.rand(5, 2, 2), axis=1).reshape(5, 4)[:, [0, 2, 1, 3]]
+        b = np.sort(rng.rand(4, 2, 2), axis=1).reshape(4, 4)[:, [0, 2, 1, 3]]
+        got = np.asarray(ops_det.iou_matrix(jnp.asarray(a, jnp.float32),
+                                            jnp.asarray(b, jnp.float32)))
+        for i in range(5):
+            for j in range(4):
+                np.testing.assert_allclose(got[i, j], np_iou(a[i], b[j]),
+                                           rtol=1e-4, atol=1e-5)
+
+    def test_encode_decode_roundtrip(self, rng):
+        priors = np.array([[0.1, 0.1, 0.5, 0.5], [0.4, 0.4, 0.9, 0.8]],
+                          np.float32)
+        gt = np.array([[0.15, 0.2, 0.45, 0.55], [0.35, 0.42, 0.8, 0.85]],
+                      np.float32)
+        enc = ops_det.encode_boxes(jnp.asarray(gt), jnp.asarray(priors))
+        dec = ops_det.decode_boxes(enc, jnp.asarray(priors))
+        np.testing.assert_allclose(np.asarray(dec), gt, rtol=1e-4, atol=1e-5)
+
+    def test_prior_boxes_properties(self):
+        pb = np.asarray(ops_det.prior_boxes(2, 3, 100, 100, min_size=30,
+                                            max_size=60,
+                                            aspect_ratios=(2.0,)))
+        # 2x3 cells x (1 min + 1 sqrt + 2 ar) = 24 boxes
+        assert pb.shape == (24, 4)
+        assert (pb >= 0).all() and (pb <= 1).all()
+        # first box is the min box at cell (0,0): center ~ (1/6, 1/4)
+        np.testing.assert_allclose((pb[0, 0] + pb[0, 2]) / 2, 1 / 6,
+                                   atol=1e-6)
+
+    def test_nms_suppresses_overlaps(self):
+        boxes = np.array([
+            [0.1, 0.1, 0.4, 0.4],
+            [0.12, 0.12, 0.42, 0.42],   # overlaps 0
+            [0.6, 0.6, 0.9, 0.9],
+            [0.61, 0.61, 0.91, 0.91],   # overlaps 2
+        ], np.float32)
+        scores = np.array([0.9, 0.8, 0.95, 0.5], np.float32)
+        sel, sc = ops_det.nms(jnp.asarray(boxes), jnp.asarray(scores),
+                              max_out=4, iou_threshold=0.5)
+        sel = [int(i) for i in np.asarray(sel) if i >= 0]
+        assert sel == [2, 0]
+
+    def test_match_priors_forces_best(self):
+        priors = jnp.asarray(np.array([
+            [0.0, 0.0, 0.3, 0.3],
+            [0.5, 0.5, 0.9, 0.9],
+            [0.05, 0.05, 0.35, 0.35],
+        ], np.float32))
+        gt = jnp.asarray(np.array([[0.0, 0.0, 0.31, 0.31]], np.float32))
+        match, miou = ops_det.match_priors(priors, gt,
+                                           jnp.asarray([True]), 0.5)
+        match = np.asarray(match)
+        assert match[0] == 0          # high IoU
+        assert match[1] == -1         # no overlap
+        assert float(miou[0]) > 0.8
+
+    def test_roi_pool(self, rng):
+        feat = rng.randn(6, 6, 2).astype(np.float32)
+        rois = np.array([[0, 0, 3, 3], [2, 2, 6, 6]], np.float32)
+        out = np.asarray(ops_det.roi_pool(jnp.asarray(feat),
+                                          jnp.asarray(rois), 2, 2))
+        assert out.shape == (2, 2, 2, 2)
+        # top-left cell of roi 0 = max over feat[0:2, 0:2]
+        np.testing.assert_allclose(out[0, 0, 0], feat[0:2, 0:2].max((0, 1)),
+                                   rtol=1e-6)
+
+
+class TestDetectionLayers:
+    def _build(self, num_classes=3, npri=None):
+        img = layer.data("img", paddle.data_type.dense_vector(2 * 4 * 4))
+        img._out_channels = 2
+        img._img_shape = (4, 4)
+        pb = layer.priorbox(img, image_size=100, min_size=30,
+                            aspect_ratio=(), name="pb")
+        P = pb.num_priors
+        loc = layer.fc(img, P * 4, act="linear", name="loc")
+        conf = layer.fc(img, P * num_classes, act="linear", name="conf")
+        return img, pb, loc, conf, P
+
+    def test_multibox_loss_trains(self, rng):
+        C = 3
+        img, pb, loc, conf, P = self._build(C)
+        gt = layer.data("gt", paddle.data_type.dense_vector(5))
+        cost = layer.multibox_loss(loc, conf, pb, gt, num_classes=C,
+                                   name="mbl")
+        topo = Topology(cost)
+        params = paddle.parameters.create(cost, KeySource(0))
+        fwd = topo.compile()
+        B, G = 4, 2
+        x = jnp.asarray(rng.randn(B, 32).astype(np.float32))
+        gtb = np.zeros((B, G, 5), np.float32)
+        for b in range(B):
+            gtb[b, 0] = [1, 0.1, 0.1, 0.45, 0.45]
+            gtb[b, 1] = [2, 0.55, 0.55, 0.95, 0.95]
+        glens = jnp.asarray(np.full(B, G, np.int32))
+        feeds = {"img": Value(x),
+                 "gt": Value(jnp.asarray(gtb), lengths=glens)}
+
+        def loss(p):
+            o, _ = fwd(p, params.state, feeds)
+            return jnp.mean(o["mbl"].array)
+
+        step = jax.jit(jax.value_and_grad(loss))
+        vals, hist = params.values, []
+        for _ in range(40):
+            l, g = step(vals)
+            vals = jax.tree_util.tree_map(lambda w, gr: w - 0.01 * gr,
+                                          vals, g)
+            hist.append(float(l))
+        assert np.isfinite(hist).all()
+        assert hist[-1] < hist[0] * 0.8, (hist[0], hist[-1])
+        self._trained = (vals, params)
+
+    def test_detection_output_shape_and_order(self, rng):
+        C = 3
+        img, pb, loc, conf, P = self._build(C)
+        det = layer.detection_output(loc, conf, pb, num_classes=C,
+                                     keep_top_k=10, name="det")
+        topo = Topology(det)
+        params = paddle.parameters.create(det, KeySource(0))
+        fwd = jax.jit(lambda p, s, f: topo.compile()(p, s, f)[0])
+        x = jnp.asarray(rng.randn(2, 32).astype(np.float32))
+        o = fwd(params.values, params.state, {"img": Value(x)})
+        d = np.asarray(o["det"].array)
+        assert d.shape == (2, 10, 6)
+        valid = d[0][d[0][:, 0] >= 0]
+        assert np.all(np.diff(valid[:, 1]) <= 1e-6)   # score-sorted
+
+
+class TestChunkEvaluator:
+    def _run(self, pred_tags, lab_tags, lens, num_types=2, scheme="IOB"):
+        T = pred_tags.shape[1]
+        ntag = pred_tags.max() + 1
+        p = layer.data("p", paddle.data_type.integer_value_sequence(10))
+        l = layer.data("l", paddle.data_type.integer_value_sequence(10))
+        ev = evaluator.chunk(p, l, num_chunk_types=num_types,
+                             chunk_scheme=scheme, name="ch")
+        topo = Topology(ev)
+        params = paddle.parameters.create(ev, KeySource(0))
+        fwd = topo.compile()
+        o, _ = fwd(params.values, params.state, {
+            "p": Value(jnp.asarray(pred_tags), jnp.asarray(lens)),
+            "l": Value(jnp.asarray(lab_tags), jnp.asarray(lens))})
+        acc = evaluator.MetricAccumulator("ch", ev.metric_finalize, 3)
+        acc.add(o["ch"].array)
+        return np.asarray(o["ch"].array), acc.value()
+
+    def test_iob_exact(self):
+        # 2 chunk types, IOB: B0=0 I0=1 B1=2 I1=3 O=4
+        lab = np.array([[0, 1, 4, 2, 3, 4]], np.int32)       # 2 gold chunks
+        pred = np.array([[0, 1, 4, 2, 1, 4]], np.int32)      # 2nd broken
+        vec, m = self._run(pred, lab, np.array([6], np.int32))
+        assert list(vec) == [1.0, 3.0, 2.0]   # pred has B0,B1,B0(I-as-start)
+        assert abs(m["recall"] - 0.5) < 1e-9
+
+    def test_iob_perfect(self):
+        lab = np.array([[0, 1, 1, 4, 2, 4], [4, 0, 4, 4, 4, 4]], np.int32)
+        vec, m = self._run(lab, lab, np.array([6, 3], np.int32))
+        assert m["f1"] == pytest.approx(1.0)
+        assert list(vec) == [3.0, 3.0, 3.0]
+
+    def test_padding_ignored(self):
+        lab = np.array([[0, 1, 0, 0, 0, 0]], np.int32)
+        # length 2: only one chunk [0,1]; padded zeros must not count
+        vec, _ = self._run(lab, lab, np.array([2], np.int32))
+        assert list(vec) == [1.0, 1.0, 1.0]
+
+
+class TestCTCErrorEvaluator:
+    def test_edit_distance(self):
+        V = 4   # classes incl blank(last)
+        T, L = 5, 4
+        p = layer.data("p", paddle.data_type.dense_vector_sequence(V))
+        l = layer.data("l", paddle.data_type.integer_value_sequence(V))
+        ev = evaluator.ctc_error(p, l, name="cer")
+        topo = Topology(ev)
+        params = paddle.parameters.create(ev, KeySource(0))
+        fwd = topo.compile()
+        # frames argmax: [1,1,3,2,2] -> collapse(blank=3) -> [1,2]
+        logits = np.full((1, T, V), -5.0, np.float32)
+        for t, c in enumerate([1, 1, 3, 2, 2]):
+            logits[1 - 1, t, c] = 5.0
+        lab = np.zeros((1, L), np.int32)
+        lab[0, :3] = [1, 0, 2]            # gold [1,0,2]: edit dist 1
+        o, _ = fwd(params.values, params.state, {
+            "p": Value(jnp.asarray(logits), jnp.asarray([T])),
+            "l": Value(jnp.asarray(lab), jnp.asarray([3]))})
+        vec = np.asarray(o["cer"].array)
+        assert vec[0] == pytest.approx(1.0)    # one insertion missing
+        assert vec[1] == 3.0
+
+
+class TestDetectionMAP:
+    def test_perfect_detections_map_1(self):
+        C, K, G = 3, 4, 2
+        det_l = layer.data("d", paddle.data_type.dense_vector(6))
+        gt_l = layer.data("g", paddle.data_type.dense_vector(5))
+        ev = evaluator.detection_map(det_l, gt_l, num_classes=C, name="map")
+        topo = Topology(ev)
+        params = paddle.parameters.create(ev, KeySource(0))
+        fwd = topo.compile()
+        gt = np.array([[[1, 0.1, 0.1, 0.4, 0.4],
+                        [2, 0.5, 0.5, 0.9, 0.9]]], np.float32)
+        det = np.full((1, K, 6), -1, np.float32)
+        det[0, 0] = [1, 0.9, 0.1, 0.1, 0.4, 0.4]
+        det[0, 1] = [2, 0.8, 0.5, 0.5, 0.9, 0.9]
+        o, _ = fwd(params.values, params.state, {
+            "d": Value(jnp.asarray(det)),
+            "g": Value(jnp.asarray(gt), lengths=jnp.asarray([G]))})
+        acc = evaluator.MetricAccumulator("map", ev.metric_finalize,
+                                          ev.metric_width)
+        acc.add(o["map"].array)
+        assert acc.value() == pytest.approx(1.0, abs=1e-6)
+
+    def test_false_positives_lower_map(self):
+        C, K = 3, 4
+        det_l = layer.data("d", paddle.data_type.dense_vector(6))
+        gt_l = layer.data("g", paddle.data_type.dense_vector(5))
+        ev = evaluator.detection_map(det_l, gt_l, num_classes=C, name="map2")
+        topo = Topology(ev)
+        params = paddle.parameters.create(ev, KeySource(0))
+        fwd = topo.compile()
+        gt = np.array([[[1, 0.1, 0.1, 0.4, 0.4]]], np.float32)
+        det = np.full((1, K, 6), -1, np.float32)
+        det[0, 0] = [1, 0.9, 0.6, 0.6, 0.9, 0.9]   # FP (wrong place)
+        det[0, 1] = [1, 0.8, 0.1, 0.1, 0.4, 0.4]   # TP at lower score
+        o, _ = fwd(params.values, params.state, {
+            "d": Value(jnp.asarray(det)),
+            "g": Value(jnp.asarray(gt), lengths=jnp.asarray([1]))})
+        acc = evaluator.MetricAccumulator("m", ev.metric_finalize,
+                                          ev.metric_width)
+        acc.add(o["map2"].array)
+        v = acc.value()
+        assert 0.0 < v < 1.0
+
+    def test_iobes_chunk_to_sequence_end(self):
+        # IOBES: B=0 I=1 E=2 S=3 per type; 1 type => O=4
+        # chunk [B, I] running to sequence end must count as one chunk
+        lab = np.array([[0, 1]], np.int32)
+        vec, m = self._run(lab, lab, np.array([2], np.int32),
+                           num_types=1, scheme="IOBES")
+        assert list(vec) == [1.0, 1.0, 1.0]
+        assert m["f1"] == pytest.approx(1.0)
+
+    def test_iobes_singles_and_pairs(self):
+        # S(3), then B-E pair, then O
+        lab = np.array([[3, 0, 2, 4]], np.int32)
+        vec, m = self._run(lab, lab, np.array([4], np.int32),
+                           num_types=1, scheme="IOBES")
+        assert list(vec) == [2.0, 2.0, 2.0]
