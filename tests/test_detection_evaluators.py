@@ -188,6 +188,22 @@ class TestChunkEvaluator:
         assert m["f1"] == pytest.approx(1.0)
         assert list(vec) == [3.0, 3.0, 3.0]
 
+    def test_iobes_chunk_to_sequence_end(self):
+        # IOBES: B=0 I=1 E=2 S=3 per type; 1 type => O=4
+        # chunk [B, I] running to sequence end must count as one chunk
+        lab = np.array([[0, 1]], np.int32)
+        vec, m = self._run(lab, lab, np.array([2], np.int32),
+                           num_types=1, scheme="IOBES")
+        assert list(vec) == [1.0, 1.0, 1.0]
+        assert m["f1"] == pytest.approx(1.0)
+
+    def test_iobes_singles_and_pairs(self):
+        # S(3), then B-E pair, then O
+        lab = np.array([[3, 0, 2, 4]], np.int32)
+        vec, m = self._run(lab, lab, np.array([4], np.int32),
+                           num_types=1, scheme="IOBES")
+        assert list(vec) == [2.0, 2.0, 2.0]
+
     def test_padding_ignored(self):
         lab = np.array([[0, 1, 0, 0, 0, 0]], np.int32)
         # length 2: only one chunk [0,1]; padded zeros must not count
@@ -261,19 +277,3 @@ class TestDetectionMAP:
         acc.add(o["map2"].array)
         v = acc.value()
         assert 0.0 < v < 1.0
-
-    def test_iobes_chunk_to_sequence_end(self):
-        # IOBES: B=0 I=1 E=2 S=3 per type; 1 type => O=4
-        # chunk [B, I] running to sequence end must count as one chunk
-        lab = np.array([[0, 1]], np.int32)
-        vec, m = self._run(lab, lab, np.array([2], np.int32),
-                           num_types=1, scheme="IOBES")
-        assert list(vec) == [1.0, 1.0, 1.0]
-        assert m["f1"] == pytest.approx(1.0)
-
-    def test_iobes_singles_and_pairs(self):
-        # S(3), then B-E pair, then O
-        lab = np.array([[3, 0, 2, 4]], np.int32)
-        vec, m = self._run(lab, lab, np.array([4], np.int32),
-                           num_types=1, scheme="IOBES")
-        assert list(vec) == [2.0, 2.0, 2.0]
