@@ -1,0 +1,105 @@
+"""Mixture-of-Experts with expert parallelism over the ``expert`` mesh axis.
+
+The modern occupant of the reference's "scale parameters beyond one box"
+slot (SURVEY.md §2.3 — sharded sparse embeddings / pserver-sharded weights;
+here the GShard/Switch design): tokens are routed by a learned gate, experts
+are sharded over the ``expert`` axis, and dispatch/combine are dense one-hot
+einsums so XLA lowers them to all-to-alls over ICI instead of host gathers.
+
+Capacity-factor dispatch keeps every shape static (XLA requirement): each
+expert processes at most ``capacity`` tokens per batch; overflow tokens are
+dropped (standard Switch behavior) and the aux loss keeps the router
+balanced so drops stay rare.
+"""
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core import place
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int
+    d_ff: int
+    num_experts: int
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+
+
+def init_params(key: jax.Array, cfg: MoEConfig):
+    k1, k2, k3 = jax.random.split(key, 3)
+    D, F, E = cfg.d_model, cfg.d_ff, cfg.num_experts
+    s = 1.0 / math.sqrt(D)
+    return {
+        "gate": jax.random.normal(k1, (D, E), jnp.float32) * s,
+        "w_in": jax.random.normal(k2, (E, D, F), jnp.float32) * s,
+        "w_out": jax.random.normal(k3, (E, F, D), jnp.float32) *
+        (1.0 / math.sqrt(F)),
+    }
+
+
+def param_shardings(cfg: MoEConfig, mesh: Mesh):
+    """Experts sharded over the ``expert`` axis; gate replicated."""
+    E = place.AXIS_EXPERT
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    return {"gate": ns(), "w_in": ns(E, None, None),
+            "w_out": ns(E, None, None)}
+
+
+def moe_ffn(params, x: jax.Array, cfg: MoEConfig,
+            mesh: Optional[Mesh] = None) -> Tuple[jax.Array, jax.Array]:
+    """Top-1 (Switch) MoE feed-forward.
+
+    x: [N, D] tokens (flatten batch*seq first) → (out [N, D], aux_loss).
+    With a mesh carrying an ``expert`` axis, einsum operands get sharding
+    constraints so dispatch/combine become all-to-alls over ICI.
+    """
+    N, D = x.shape
+    E = cfg.num_experts
+    cap = max(1, int(cfg.capacity_factor * N / E))
+
+    logits = jnp.einsum("nd,de->ne", x.astype(jnp.float32), params["gate"])
+    probs = jax.nn.softmax(logits, axis=-1)                 # [N, E]
+    expert = jnp.argmax(probs, axis=-1)                     # [N]
+    gate_val = jnp.max(probs, axis=-1)
+
+    # position of each token within its expert's capacity buffer
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)     # [N, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1           # [N, E]
+    pos_in_expert = jnp.sum(pos * onehot, axis=1)           # [N]
+    keep = pos_in_expert < cap
+
+    # dispatch tensor [N, E, cap]: one-hot of (expert, slot)
+    disp = (onehot.astype(jnp.float32)[:, :, None] *
+            jax.nn.one_hot(jnp.clip(pos_in_expert, 0, cap - 1), cap)[:, None, :])
+    disp = jnp.where(keep[:, None, None], disp, 0.0)
+
+    def constrain(v, spec):
+        if mesh is None or place.AXIS_EXPERT not in mesh.axis_names:
+            return v
+        return jax.lax.with_sharding_constraint(v, NamedSharding(mesh, spec))
+
+    # expert inputs [E, cap, D] — the all-to-all boundary
+    xe = jnp.einsum("nec,nd->ecd", disp, x.astype(jnp.float32))
+    xe = constrain(xe, P(place.AXIS_EXPERT, None, None))
+    h = jnp.einsum("ecd,edf->ecf", xe, params["w_in"])
+    h = jax.nn.gelu(h)
+    ye = jnp.einsum("ecf,efd->ecd", h, params["w_out"])
+    ye = constrain(ye, P(place.AXIS_EXPERT, None, None))
+    out = jnp.einsum("nec,ecd->nd", disp, ye)
+    out = out * gate_val[:, None]                           # Switch scaling
+
+    # load-balance aux loss (Switch eq. 4): E * Σ_e frac_tokens_e * mean_prob_e
+    frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    aux = cfg.aux_loss_weight * E * jnp.sum(frac * mean_p)
+    return out.astype(x.dtype), aux

@@ -1,0 +1,97 @@
+"""Pipeline parallelism over the ``stage`` mesh axis (GPipe schedule).
+
+The modern occupant of the reference's per-layer device placement slot
+(SURVEY.md §2.3 — ParallelNeuralNetwork's parallel_nn layer->device
+dispatch): the network is cut into S stages with identical signatures;
+each device on the ``stage`` axis holds one stage's weights; microbatches
+flow through the ring via ``lax.ppermute`` under one ``shard_map``.
+
+Schedule: T = M + S - 1 scanned steps (GPipe fill/drain bubble); step t has
+stage s working on microbatch t - s. The scan is reverse-differentiable, so
+the same program trains — XLA stitches the backward pipeline automatically
+(activations rematerialize per jax.checkpoint policy if requested).
+"""
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.core import place
+
+
+def pipeline_apply(stage_params, x: jax.Array, stage_fn: Callable,
+                   mesh: Mesh, num_microbatches: int,
+                   stage_axis: str = place.AXIS_STAGE) -> jax.Array:
+    """Run ``stage_fn`` S times (once per stage) as a pipeline.
+
+    stage_params: pytree whose leaves have a leading stage dim [S, ...];
+    x: [B, ...] with B divisible by num_microbatches; stage_fn(params_s, mb)
+    must map [mb, ...] -> [mb, ...] (same shape/dtype — residual stages).
+    Returns [B, ...] equal to applying the stages sequentially.
+    """
+    from jax import shard_map
+
+    S = mesh.shape[stage_axis]
+    M = num_microbatches
+    B = x.shape[0]
+    assert B % M == 0, f"batch {B} not divisible by {M} microbatches"
+    mb = B // M
+    xs = x.reshape((M, mb) + x.shape[1:])
+
+    param_specs = jax.tree_util.tree_map(
+        lambda leaf: P(stage_axis), stage_params)
+
+    def run(params_local, xs_all):
+        # params_local leaves: [1, ...] (this stage's slice); drop the dim
+        p_here = jax.tree_util.tree_map(lambda l: l[0], params_local)
+        idx = jax.lax.axis_index(stage_axis)
+        nst = jax.lax.psum(1, stage_axis)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def step(carry, t):
+            state, outs = carry
+            # stage 0 injects microbatch t (clamped; masked later)
+            mb_idx = jnp.clip(t, 0, M - 1)
+            inj = jax.lax.dynamic_index_in_dim(xs_all, mb_idx, 0,
+                                               keepdims=False)
+            cur = jnp.where(idx == 0, inj, state)
+            out = stage_fn(p_here, cur)
+            # last stage completes microbatch t - (S-1)
+            done = t - (nst - 1)
+            valid = (idx == nst - 1) & (done >= 0) & (done < M)
+            outs = jax.lax.cond(
+                valid,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, out, jnp.clip(done, 0, M - 1), 0),
+                lambda o: o, outs)
+            state = jax.lax.ppermute(out, stage_axis, perm)
+            return (state, outs), None
+
+        state0 = jnp.zeros_like(xs_all[0])
+        outs0 = jnp.zeros_like(xs_all)
+        (_, outs), _ = jax.lax.scan(step, (state0, outs0),
+                                    jnp.arange(M + S - 1))
+        # only the last stage holds real outputs; broadcast via psum
+        outs = jax.lax.psum(
+            jnp.where(idx == nst - 1, outs, jnp.zeros_like(outs)),
+            stage_axis)
+        return outs
+
+    specs_x = P()          # microbatches replicated; only stage 0 reads them
+    outs = shard_map(run, mesh=mesh,
+                     in_specs=(param_specs, specs_x),
+                     out_specs=P(), check_vma=False)(stage_params, xs)
+    return outs.reshape((B,) + x.shape[1:])
+
+
+def sequential_apply(stage_params, x: jax.Array,
+                     stage_fn: Callable) -> jax.Array:
+    """Reference semantics: apply the S stages one after another."""
+    def body(h, p_s):
+        return stage_fn(p_s, h), None
+
+    out, _ = jax.lax.scan(body, x, stage_params)
+    return out
