@@ -1,0 +1,211 @@
+"""The ``paddle_tpu`` command-line trainer.
+
+Reference: paddle/trainer/TrainerMain.cpp:32-64 — jobs train / test /
+checkgrad / time driven by ``--config=conf.py``; the config is a Python file
+evaluated to produce the network (the reference embedded Python via
+config_parser; here the config file simply builds layers with this package
+and exposes a few names). ``paddle_tpu.scripts.submit`` mirrors the
+``paddle`` wrapper (scripts/submit_local.sh.in).
+
+Config file contract (module-level names):
+  cost            — required for train/checkgrad/time: the cost LayerOutput
+  reader          — callable() -> iterator of data tuples (train/time)
+  test_reader     — optional, for --job=test and per-pass testing
+  optimizer       — optional paddle_tpu optimizer (default Momentum)
+  batch_size      — optional int (default 64)
+  feeding         — optional dict name->index
+  evaluators      — optional list of evaluator layers
+  outputs         — required for job=infer: list of output LayerOutputs
+
+Run: ``python -m paddle_tpu train --config=conf.py --num_passes=2``.
+"""
+
+import argparse
+import os
+import runpy
+import sys
+import time as _time
+
+import numpy as np
+
+
+def _load_config(path):
+    cfg = runpy.run_path(path)
+    return cfg
+
+
+def _build_trainer(cfg, args):
+    import paddle_tpu as paddle
+    cost = cfg["cost"]
+    params = paddle.parameters.create(cost)
+    if args.init_model_path:
+        with open(args.init_model_path, "rb") as f:
+            params.from_tar_into(f)
+    opt = cfg.get("optimizer") or paddle.optimizer.Momentum(
+        momentum=0.9, learning_rate=0.01)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params, update_equation=opt,
+        extra_layers=cfg.get("evaluators"))
+    return trainer, params
+
+
+def job_train(cfg, args):
+    import paddle_tpu as paddle
+    trainer, params = _build_trainer(cfg, args)
+    batch_size = cfg.get("batch_size", 64)
+    reader = paddle.batch(cfg["reader"], batch_size)
+    test_reader = cfg.get("test_reader")
+    save_dir = args.save_dir
+    costs = []
+
+    def handler(ev):
+        if isinstance(ev, paddle.event.EndIteration):
+            costs.append(ev.cost)
+            if ev.batch_id % args.log_period == 0:
+                print(f"pass {ev.pass_id} batch {ev.batch_id} "
+                      f"cost {ev.cost:.5f} {ev.metrics}")
+        if isinstance(ev, paddle.event.EndPass):
+            if test_reader is not None:
+                res = trainer.test(paddle.batch(test_reader, batch_size),
+                                   feeding=cfg.get("feeding"))
+                print(f"pass {ev.pass_id} test: cost {res.cost:.5f} "
+                      f"{res.metrics}")
+            if save_dir:
+                # per-pass dirs like the reference's save_dir/pass-%05d
+                # (trainer/ParamUtil.cpp)
+                pdir = os.path.join(save_dir, f"pass-{ev.pass_id:05d}")
+                os.makedirs(pdir, exist_ok=True)
+                with open(os.path.join(pdir, "params.tar"), "wb") as f:
+                    trainer.save_parameter_to_tar(f)
+
+    trainer.train(reader, num_passes=args.num_passes, event_handler=handler,
+                  feeding=cfg.get("feeding"))
+    return 0
+
+
+def job_test(cfg, args):
+    import paddle_tpu as paddle
+    trainer, params = _build_trainer(cfg, args)
+    reader = paddle.batch(cfg.get("test_reader") or cfg["reader"],
+                          cfg.get("batch_size", 64))
+    res = trainer.test(reader, feeding=cfg.get("feeding"))
+    print(f"test: cost {res.cost:.5f} {res.metrics}")
+    return 0
+
+
+def job_time(cfg, args):
+    """Steady-state ms/batch (reference: --job=time,
+    benchmark/paddle/image/run.sh:9)."""
+    import jax
+    import paddle_tpu as paddle
+    trainer, params = _build_trainer(cfg, args)
+    batch_size = cfg.get("batch_size", 64)
+    reader = paddle.batch(cfg["reader"], batch_size)
+    batches = []
+    for i, b in enumerate(reader()):
+        if i >= args.time_batches + args.warmup_batches:
+            break
+        batches.append(b)
+    feeder = trainer._feeder(cfg.get("feeding"))
+    step = trainer._train_step_fn
+    pstate = trainer.parameters.values, trainer._opt_state, \
+        trainer.parameters.state
+    key = jax.random.PRNGKey(0)
+    pv, ov, sv = pstate
+    times = []
+    for i, b in enumerate(batches):
+        feeds = feeder(b)
+        t0 = _time.perf_counter()
+        pv, ov, sv, cost, _ = step(pv, ov, sv, feeds,
+                                   np.int64(i), key)
+        jax.block_until_ready(cost)
+        if i >= args.warmup_batches:
+            times.append(_time.perf_counter() - t0)
+    ms = 1000 * float(np.mean(times)) if times else float("nan")
+    ips = batch_size / (ms / 1000) if times else float("nan")
+    print(f"time job: {ms:.2f} ms/batch, {ips:.1f} examples/sec "
+          f"(batch_size={batch_size}, {len(times)} timed batches)")
+    return 0
+
+
+def job_checkgrad(cfg, args):
+    """Whole-model finite-difference gradient verification (reference:
+    Trainer::checkGradient, trainer/Trainer.cpp:299-377)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.topology import Topology, Value
+
+    cost = cfg["cost"]
+    topo = Topology(cost)
+    params = paddle.parameters.create(cost)
+    fwd = topo.compile()
+    batch = next(iter(paddle.batch(cfg["reader"],
+                                   cfg.get("batch_size", 8))()))
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(learning_rate=0.0))
+    feeds = trainer._feeder(cfg.get("feeding"))(batch)
+
+    def loss(vals):
+        outs, _ = fwd(vals, params.state, feeds)
+        return jnp.mean(outs[cost.name].array.astype(jnp.float32))
+
+    analytic = jax.jit(jax.grad(loss))(params.values)
+    loss_f = jax.jit(loss)
+    eps = args.checkgrad_eps
+    rng = np.random.RandomState(0)
+    worst = 0.0
+    for name, arr in params.values.items():
+        arr = np.asarray(arr, np.float64)
+        flat = arr.reshape(-1)
+        g = np.asarray(analytic[name], np.float64).reshape(-1)
+        # sample a few coordinates per parameter (reference samples too)
+        for idx in rng.choice(flat.size, size=min(4, flat.size),
+                              replace=False):
+            orig = flat[idx]
+            vals = dict(params.values)
+            pert = arr.copy().reshape(-1)
+            pert[idx] = orig + eps
+            vals[name] = pert.reshape(arr.shape).astype(np.float32)
+            hi = float(loss_f(vals))
+            pert[idx] = orig - eps
+            vals[name] = pert.reshape(arr.shape).astype(np.float32)
+            lo = float(loss_f(vals))
+            numeric = (hi - lo) / (2 * eps)
+            denom = max(abs(numeric), abs(g[idx]), 1e-6)
+            rel = abs(numeric - g[idx]) / denom
+            worst = max(worst, rel)
+            status = "OK" if rel < args.checkgrad_tol else "FAIL"
+            print(f"checkgrad {name}[{idx}]: analytic {g[idx]:+.6f} "
+                  f"numeric {numeric:+.6f} rel_err {rel:.2e} {status}")
+    print(f"checkgrad worst rel err: {worst:.2e}")
+    return 0 if worst < args.checkgrad_tol else 1
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu",
+        description="TPU-native trainer CLI (reference: paddle_trainer, "
+                    "TrainerMain.cpp)")
+    p.add_argument("job", choices=["train", "test", "time", "checkgrad"],
+                   help="what to run (TrainerMain.cpp:52-61)")
+    p.add_argument("--config", required=True, help="python config file")
+    p.add_argument("--num_passes", type=int, default=1)
+    p.add_argument("--save_dir", default=None)
+    p.add_argument("--init_model_path", default=None)
+    p.add_argument("--log_period", type=int, default=10)
+    p.add_argument("--time_batches", type=int, default=20)
+    p.add_argument("--warmup_batches", type=int, default=3)
+    p.add_argument("--checkgrad_eps", type=float, default=1e-3)
+    p.add_argument("--checkgrad_tol", type=float, default=2e-2)
+    args = p.parse_args(argv)
+
+    cfg = _load_config(args.config)
+    jobs = {"train": job_train, "test": job_test, "time": job_time,
+            "checkgrad": job_checkgrad}
+    return jobs[args.job](cfg, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
