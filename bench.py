@@ -6,12 +6,18 @@ benchmark/paddle/image/run.sh:9-17, resnet.py topology) — measures steady-
 state train-step time for ResNet-50 (1000 classes, 3x224x224), reporting
 images/sec/chip against the BASELINE.json north star of 4000 images/sec/chip.
 
-Prints exactly ONE JSON line on stdout:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints exactly ONE JSON line on stdout — always, even when the backend is
+unreachable: a watchdog thread guards every stage (backend init, compile,
+timed steps) and on a stall emits `{"value": 0, ..., "error": ...}` and
+exits, instead of hanging or stack-tracing. A hung backend init is retried
+once in a fresh process (re-exec), since a second attempt in the same
+process would just join the stuck init.
 """
 
 import json
+import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -21,10 +27,109 @@ NORTH_STAR = 4000.0  # images/sec/chip (BASELINE.json)
 # ~12.3 GFLOPs/image => ~16k img/s at 100% MXU. Anything above this is a
 # measurement artifact (tunnel sync failure), not throughput.
 PLAUSIBLE_MAX = 20000.0
+INIT_TIMEOUT = float(os.environ.get("BENCH_INIT_TIMEOUT", 420))
+COMPILE_TIMEOUT = float(os.environ.get("BENCH_COMPILE_TIMEOUT", 900))
+STEP_TIMEOUT = float(os.environ.get("BENCH_STEP_TIMEOUT", 600))
+RETRY_ENV = "PADDLE_TPU_BENCH_RETRY"
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+_emit_lock = threading.Lock()
+_emitted = False
+
+
+def emit(value, error=None, **extra):
+    """The one stdout JSON line. Exits the process. First caller wins —
+    the watchdog and the main thread may race at a stage boundary."""
+    global _emitted
+    with _emit_lock:
+        if _emitted:
+            os._exit(0)
+        _emitted = True
+    rec = {"metric": "resnet50_train_images_per_sec_per_chip",
+           "value": round(value, 1), "unit": "images/sec",
+           "vs_baseline": round(value / NORTH_STAR, 4)}
+    if error:
+        rec["error"] = error
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # os._exit: a hung backend-init thread or stuck RPC must not block
+    # interpreter shutdown after we have produced the artifact.
+    os._exit(0 if not error else 1)
+
+
+class Watchdog:
+    """Emits an error artifact and kills the process if a stage stalls."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stage = "startup"
+        self._deadline = time.time() + INIT_TIMEOUT
+        self._best = 0.0
+        t = threading.Thread(target=self._watch, daemon=True)
+        t.start()
+
+    def stage(self, name, timeout):
+        with self._lock:
+            self._stage = name
+            self._deadline = time.time() + timeout
+        log(f"[watchdog] stage={name} timeout={timeout:.0f}s")
+
+    def best(self, v):
+        with self._lock:
+            self._best = max(self._best, v)
+
+    def _watch(self):
+        while True:
+            time.sleep(5)
+            with self._lock:
+                stage, deadline, best = (self._stage, self._deadline,
+                                         self._best)
+            if time.time() > deadline:
+                log(f"[watchdog] STALL in stage {stage!r}")
+                if best > 0:
+                    emit(best, stalled_stage=stage)
+                emit(0.0, error=f"stalled in stage {stage!r} "
+                     f"(no progress within timeout)")
+
+
+def init_backend(dog):
+    """jax.devices() under the watchdog; hung init retried via re-exec."""
+    dog.stage("backend-init", INIT_TIMEOUT)
+    box = {}
+
+    def target():
+        try:
+            import jax
+            if os.environ.get("BENCH_PLATFORM"):
+                # local testing / driver fallback: the JAX_PLATFORMS env
+                # var is overridden by the site hook, so use the config API
+                jax.config.update("jax_platforms",
+                                  os.environ["BENCH_PLATFORM"])
+            box["devices"] = jax.devices()
+        except Exception as e:
+            box["error"] = f"{type(e).__name__}: {e}"
+
+    th = threading.Thread(target=target, daemon=True)
+    th.start()
+    th.join(INIT_TIMEOUT - 10)
+    if th.is_alive() or "error" in box:
+        reason = box.get("error",
+                         f"jax.devices() hung >{INIT_TIMEOUT - 10:.0f}s")
+        if os.environ.get(RETRY_ENV) != "1":
+            log(f"backend init failed ({reason}); retrying in a fresh "
+                f"process")
+            os.environ[RETRY_ENV] = "1"
+            sys.stderr.flush()
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+        emit(0.0, error=f"backend init failed after retry: {reason}")
+    log("devices:", box["devices"])
+    return box["devices"]
 
 
 def build_train_step():
@@ -60,8 +165,7 @@ def build_train_step():
     return (jax.jit(train_step, donate_argnums=(0, 1, 2)), params, opt_state)
 
 
-def bench_batch(step_fn, carry, batch, warmup=3, iters=20):
-    import jax
+def bench_batch(dog, step_fn, carry, batch, warmup=3, iters=20):
     import jax.numpy as jnp
     rng = np.random.RandomState(0)
     # NHWC device-resident synthetic batch (data pipeline measured separately)
@@ -78,12 +182,14 @@ def bench_batch(step_fn, carry, batch, warmup=3, iters=20):
         leaf = jtu.tree_leaves(p)[0]
         return float(jnp.sum(leaf.astype(jnp.float32))), float(loss)
 
+    dog.stage(f"compile-bs{batch}", COMPILE_TIMEOUT)
     t_compile = time.time()
     for i in range(warmup):
         loss, p, o, s = step_fn(p, o, s, images, labels,
                                 jnp.asarray(i, jnp.int32))
     full_sync(p, loss)
     log(f"bs={batch}: warmup+compile {time.time()-t_compile:.1f}s")
+    dog.stage(f"steps-bs{batch}", STEP_TIMEOUT)
     t0 = time.time()
     for i in range(iters):
         loss, p, o, s = step_fn(p, o, s, images, labels,
@@ -97,28 +203,29 @@ def bench_batch(step_fn, carry, batch, warmup=3, iters=20):
 
 
 def main():
-    import jax
-    log("devices:", jax.devices())
+    dog = Watchdog()
+    init_backend(dog)
+    dog.stage("build", 300)
     step_fn, params, opt_state = build_train_step()
     carry = (params.values, opt_state, params.state)
     best = 0.0
-    for batch in (128, 256):
+    err = None
+    sizes = tuple(int(b) for b in
+                  os.environ.get("BENCH_BATCH_SIZES", "128,256").split(","))
+    for batch in sizes:
         try:
-            ips, carry = bench_batch(step_fn, carry, batch)
+            ips, carry = bench_batch(dog, step_fn, carry, batch)
             if ips > PLAUSIBLE_MAX:
                 log(f"bs={batch}: {ips:.0f} img/s exceeds physical ceiling "
                     f"{PLAUSIBLE_MAX:.0f} — discarding as a sync artifact")
                 continue
             best = max(best, ips)
+            dog.best(best)
         except Exception as e:  # OOM at larger batch: keep best so far
             log(f"bs={batch} failed: {type(e).__name__}: {e}")
+            err = f"{type(e).__name__} at bs={batch}"
             break
-    print(json.dumps({
-        "metric": "resnet50_train_images_per_sec_per_chip",
-        "value": round(best, 1),
-        "unit": "images/sec",
-        "vs_baseline": round(best / NORTH_STAR, 4),
-    }), flush=True)
+    emit(best, error=None if best > 0 else (err or "no batch completed"))
 
 
 if __name__ == "__main__":
