@@ -6,14 +6,27 @@ dispatch): the network is cut into S stages with identical signatures;
 each device on the ``stage`` axis holds one stage's weights; microbatches
 flow through the ring via ``lax.ppermute`` under one ``shard_map``.
 
+Memory layout: microbatches are **sharded across the stage axis** (blocked:
+device d owns microbatches [d*K, (d+1)*K), K = M/S) for both inputs and
+outputs — per-device activation residency is O(M/S), not O(M). Two
+single-microbatch rings move data to where it is consumed:
+
+- input ring: device d injects its slot-q microbatch m = d*K+q at step
+  m - d; one down-hop per step lands it on stage 0 exactly at step m.
+- output ring: stage S-1 finishes microbatch m at step m + S-1 and pushes
+  it down the ring; device m//K captures it (S-1 - m//K) hops later.
+
+Injections never collide with in-flight values: the value from device e
+passes device d < e during steps [e*K - d, e*K+K-1 - d], disjoint from
+d's injection window [d*K - d, d*K+K-1 - d] for e != d.
+
 Schedule: T = M + S - 1 scanned steps (GPipe fill/drain bubble); step t has
 stage s working on microbatch t - s. The scan is reverse-differentiable, so
 the same program trains — XLA stitches the backward pipeline automatically
 (activations rematerialize per jax.checkpoint policy if requested).
 """
 
-import functools
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -40,51 +53,65 @@ def pipeline_apply(stage_params, x: jax.Array, stage_fn: Callable,
     assert B % M == 0, f"batch {B} not divisible by {M} microbatches"
     mb = B // M
     xs = x.reshape((M, mb) + x.shape[1:])
+    # microbatch dim is sharded over stages: pad M up to a multiple of S
+    # (padding slots run through the pipe but their outputs are dropped)
+    K = -(-M // S)
+    Mp = K * S
+    if Mp != M:
+        pad = jnp.zeros((Mp - M, mb) + x.shape[1:], x.dtype)
+        xs = jnp.concatenate([xs, pad], 0)
 
     param_specs = jax.tree_util.tree_map(
         lambda leaf: P(stage_axis), stage_params)
 
-    def run(params_local, xs_all):
+    def run(params_local, xs_local):
         # params_local leaves: [1, ...] (this stage's slice); drop the dim
         p_here = jax.tree_util.tree_map(lambda l: l[0], params_local)
         idx = jax.lax.axis_index(stage_axis)
-        nst = jax.lax.psum(1, stage_axis)
-        perm = [(i, (i + 1) % S) for i in range(S)]
+        down = [(i, (i - 1) % S) for i in range(S)]
+        up = [(i, (i + 1) % S) for i in range(S)]
 
         def step(carry, t):
-            state, outs = carry
-            # stage 0 injects microbatch t (clamped; masked later)
-            mb_idx = jnp.clip(t, 0, M - 1)
-            inj = jax.lax.dynamic_index_in_dim(xs_all, mb_idx, 0,
-                                               keepdims=False)
-            cur = jnp.where(idx == 0, inj, state)
+            state, g, h, outs_local = carry
+
+            # --- input ring: device d injects local slot q = t - d*(K-1)
+            q_in = t - idx * (K - 1)
+            inject = (q_in >= 0) & (q_in < K)
+            cand = jax.lax.dynamic_index_in_dim(
+                xs_local, jnp.clip(q_in, 0, K - 1), 0, keepdims=False)
+            g = jnp.where(inject, cand, g)
+
+            # --- stage work: stage 0 consumes the ring head
+            cur = jnp.where(idx == 0, g, state)
             out = stage_fn(p_here, cur)
-            # last stage completes microbatch t - (S-1)
-            done = t - (nst - 1)
-            valid = (idx == nst - 1) & (done >= 0) & (done < M)
-            outs = jax.lax.cond(
-                valid,
-                lambda o: jax.lax.dynamic_update_index_in_dim(
-                    o, out, jnp.clip(done, 0, M - 1), 0),
-                lambda o: o, outs)
-            state = jax.lax.ppermute(out, stage_axis, perm)
-            return (state, outs), None
 
-        state0 = jnp.zeros_like(xs_all[0])
-        outs0 = jnp.zeros_like(xs_all)
-        (_, outs), _ = jax.lax.scan(step, (state0, outs0),
-                                    jnp.arange(M + S - 1))
-        # only the last stage holds real outputs; broadcast via psum
-        outs = jax.lax.psum(
-            jnp.where(idx == nst - 1, outs, jnp.zeros_like(outs)),
-            stage_axis)
-        return outs
+            # --- output ring: last stage pushes its completed microbatch
+            h = jnp.where(idx == S - 1, out, h)
+            # device d captures microbatch m = t + d - 2(S-1) when it owns it
+            m_here = t + idx - 2 * (S - 1)
+            own = (m_here >= 0) & (m_here < Mp) & (m_here // K == idx)
+            slot = jnp.clip(m_here - idx * K, 0, K - 1)
+            old = jax.lax.dynamic_index_in_dim(outs_local, slot, 0,
+                                               keepdims=False)
+            outs_local = jax.lax.dynamic_update_index_in_dim(
+                outs_local, jnp.where(own, h, old), slot, 0)
 
-    specs_x = P()          # microbatches replicated; only stage 0 reads them
+            state = jax.lax.ppermute(out, stage_axis, up)
+            g = jax.lax.ppermute(g, stage_axis, down)
+            h = jax.lax.ppermute(h, stage_axis, down)
+            return (state, g, h, outs_local), None
+
+        zero_mb = jnp.zeros_like(xs_local[0])
+        carry0 = (zero_mb, zero_mb, zero_mb, jnp.zeros_like(xs_local))
+        (_, _, _, outs_local), _ = jax.lax.scan(
+            step, carry0, jnp.arange(Mp + S - 1))
+        return outs_local
+
+    specs_mb = P(stage_axis)   # microbatch dim blocked over stages
     outs = shard_map(run, mesh=mesh,
-                     in_specs=(param_specs, specs_x),
-                     out_specs=P(), check_vma=False)(stage_params, xs)
-    return outs.reshape((B,) + x.shape[1:])
+                     in_specs=(param_specs, specs_mb),
+                     out_specs=specs_mb, check_vma=False)(stage_params, xs)
+    return outs[:M].reshape((B,) + x.shape[1:])
 
 
 def sequential_apply(stage_params, x: jax.Array,

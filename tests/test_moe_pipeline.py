@@ -138,3 +138,27 @@ class TestPipeline:
             p, xx, self._stage_fn, mesh, M))(params, x)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-6)
+
+    def test_per_device_memory_drops_with_stages(self, rng):
+        """Microbatches are sharded over the stage axis: per-device input
+        and output residency must shrink ~linearly with S (the pre-fix
+        design replicated all microbatches to every stage)."""
+        M, B, D = 8, 64, 128
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"])
+
+        def per_device(S):
+            mesh = place.make_mesh((S,), (place.AXIS_STAGE,))
+            params = {"w": jnp.asarray(
+                rng.randn(S, D, D).astype(np.float32) * 0.1)}
+            x = jnp.asarray(rng.randn(B, D).astype(np.float32))
+            f = jax.jit(lambda p, xx: pipeline.pipeline_apply(
+                p, xx, stage_fn, mesh, M))
+            ma = f.lower(params, x).compile().memory_analysis()
+            return ma.output_size_in_bytes, ma.argument_size_in_bytes
+
+        out1, arg1 = per_device(1)
+        out8, arg8 = per_device(8)
+        assert out8 * 8 <= out1 * 1.25, (out1, out8)
+        assert arg8 < arg1, (arg1, arg8)
