@@ -128,6 +128,88 @@ def job_time(cfg, args):
     return 0
 
 
+def job_infer(cfg, args):
+    """Forward-only inference (reference: paddle.v2.infer, inference.py:111;
+    capi serving when --model points at a merged artifact).
+
+    Two sources for the model:
+    - --model=artifact.tar  (merged-model file; config only supplies data)
+    - config ``outputs`` + --init_model_path weights
+    Input comes from config ``infer_reader`` (or ``test_reader``/``reader``),
+    yielding the same tuples as training minus the label when ``feeding``
+    maps only input fields. Results print as shapes + optionally save to
+    --output_path (.npz keyed by output layer name).
+    """
+    import paddle_tpu as paddle
+    import numpy as np
+
+    batch_size = cfg.get("batch_size", 64)
+    reader = cfg.get("infer_reader") or cfg.get("test_reader") \
+        or cfg.get("reader")
+    if reader is None:
+        print("config must define infer_reader/test_reader/reader",
+              file=sys.stderr)
+        return 1
+    rows = []
+    for sample in reader():
+        rows.append(sample)
+        if args.infer_limit and len(rows) >= args.infer_limit:
+            break
+
+    if args.model:
+        from paddle_tpu.data_feeder import DataFeeder
+        from paddle_tpu.data_type import InputType, Kind, SeqLevel
+        from paddle_tpu.io import merged
+        from paddle_tpu.topology import Value
+        m = merged.load_inference_model(args.model)
+        specs = {name: InputType(d, Kind(k), SeqLevel(s))
+                 for name, (d, k, s) in m.meta["data_specs"].items()}
+        feeder = DataFeeder(specs, cfg.get("feeding"))
+        chunks = []
+        for i in range(0, len(rows), batch_size):
+            feeds = feeder.feed(rows[i:i + batch_size])
+            flat = {}
+            for k, v in feeds.items():
+                if isinstance(v, Value):
+                    flat[k] = np.asarray(v.array)
+                    if v.lengths is not None:
+                        flat[f"{k}.lengths"] = np.asarray(v.lengths)
+                else:
+                    flat[k] = np.asarray(v)
+            chunks.append(m.infer(flat))
+        outs = {k: np.concatenate([c[k] for c in chunks], axis=0)
+                for k in chunks[0]}
+    else:
+        outputs = cfg.get("outputs")
+        if outputs is None:
+            print("config must define `outputs` for job=infer "
+                  "(or pass --model)", file=sys.stderr)
+            return 1
+        if not args.init_model_path:
+            print("job=infer needs trained weights: pass "
+                  "--init_model_path=params.tar (or --model=artifact.tar)",
+                  file=sys.stderr)
+            return 1
+        params = paddle.parameters.create(
+            outputs if isinstance(outputs, (list, tuple)) else [outputs])
+        with open(args.init_model_path, "rb") as f:
+            params.from_tar_into(f)
+        res = paddle.infer(output_layer=outputs, parameters=params,
+                           input=rows, feeding=cfg.get("feeding"),
+                           batch_size=batch_size)
+        names = [o.name for o in (outputs if isinstance(outputs,
+                 (list, tuple)) else [outputs])]
+        outs = dict(zip(names, res if isinstance(res, list) else [res]))
+
+    for name, arr in outs.items():
+        print(f"infer output {name}: shape {np.asarray(arr).shape}")
+    if args.output_path:
+        np.savez(args.output_path,
+                 **{k: np.asarray(v) for k, v in outs.items()})
+        print(f"saved outputs to {args.output_path}")
+    return 0
+
+
 def job_checkgrad(cfg, args):
     """Whole-model finite-difference gradient verification (reference:
     Trainer::checkGradient, trainer/Trainer.cpp:299-377)."""
@@ -188,12 +270,19 @@ def main(argv=None):
         prog="paddle_tpu",
         description="TPU-native trainer CLI (reference: paddle_trainer, "
                     "TrainerMain.cpp)")
-    p.add_argument("job", choices=["train", "test", "time", "checkgrad"],
+    p.add_argument("job", choices=["train", "test", "time", "checkgrad",
+                                   "infer"],
                    help="what to run (TrainerMain.cpp:52-61)")
     p.add_argument("--config", required=True, help="python config file")
     p.add_argument("--num_passes", type=int, default=1)
     p.add_argument("--save_dir", default=None)
     p.add_argument("--init_model_path", default=None)
+    p.add_argument("--model", default=None,
+                   help="merged-model artifact for job=infer")
+    p.add_argument("--output_path", default=None,
+                   help="where job=infer saves outputs (.npz)")
+    p.add_argument("--infer_limit", type=int, default=0,
+                   help="max samples for job=infer (0 = all)")
     p.add_argument("--log_period", type=int, default=10)
     p.add_argument("--time_batches", type=int, default=20)
     p.add_argument("--warmup_batches", type=int, default=3)
@@ -203,7 +292,7 @@ def main(argv=None):
 
     cfg = _load_config(args.config)
     jobs = {"train": job_train, "test": job_test, "time": job_time,
-            "checkgrad": job_checkgrad}
+            "checkgrad": job_checkgrad, "infer": job_infer}
     return jobs[args.job](cfg, args)
 
 

@@ -4,3 +4,5 @@ trainer/MergeModel.cpp)."""
 
 from paddle_tpu.io.checkpoint import (load_checkpoint, save_checkpoint,
                                       latest_checkpoint)
+from paddle_tpu.io.merged import (save_inference_model, load_inference_model,
+                                  MergedModel)

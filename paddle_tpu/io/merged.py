@@ -1,0 +1,224 @@
+"""Merged-model serving artifacts — one file holding topology + weights.
+
+Reference: paddle/trainer/MergeModel.cpp packed the ModelConfig proto and
+the parameter files into a single binary consumed by the C inference API
+(paddle/capi/gradient_machine.h:36-88, create_for_inference_with_parameters);
+multi-thread serving cloned the machine sharing parameters (:88).
+
+TPU-native equivalents, both in one tar:
+
+- **replayable topology** (``topology.json``): Topology.to_dict records of
+  the public layer-API calls; the loader replays them (Topology.from_dict)
+  and jit-compiles forward — works for any batch size, needs the
+  paddle_tpu package but NOT the user's model-building code.
+- **AOT StableHLO export** (``exported.bin``): jax.export serialization of
+  the jitted forward at fixed example shapes — runs with zero model code,
+  the capi-style deployment surface; compile happens at save time
+  (jit().lower() under the hood), load is compile-free on the same
+  platform.
+"""
+
+import io as _io
+import json
+import tarfile
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _npz_bytes(arrays: Dict[str, np.ndarray]) -> bytes:
+    buf = _io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+    return buf.getvalue()
+
+
+def _npz_load(data: bytes) -> Dict[str, np.ndarray]:
+    with np.load(_io.BytesIO(data), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def _add_member(tar, name, data: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    info.mtime = int(time.time())
+    tar.addfile(info, _io.BytesIO(data))
+
+
+def _serve_fn(topology):
+    """forward(params, state, feeds-of-arrays) -> {output: array}; plain
+    containers only, so jax.export can serialize the calling convention.
+    Sequence inputs pass their lengths as a sibling '<name>.lengths' key."""
+    from paddle_tpu.topology import Value
+
+    fwd = topology.compile()
+
+    def serve(params, state, feeds):
+        vals = {k: Value(v, lengths=feeds.get(f"{k}.lengths"))
+                for k, v in feeds.items() if not k.endswith(".lengths")}
+        outs, _ = fwd(params, state, vals, is_training=False)
+        return {k: v.array for k, v in outs.items()}
+
+    return serve
+
+
+def example_feeds(topology, batch_size: int) -> Dict[str, np.ndarray]:
+    """Zero-filled feed arrays matching the topology's data specs."""
+    from paddle_tpu.data_type import Kind, SeqLevel
+
+    feeds = {}
+    for l in topology.data_layers:
+        spec = l.data_spec
+        if spec is None:
+            raise ValueError(f"data layer {l.name!r} has no data spec")
+        if spec.kind == Kind.INDEX:
+            shape = (batch_size,) if spec.seq == SeqLevel.NO_SEQUENCE \
+                else (batch_size, 16)
+            feeds[l.name] = np.zeros(shape, np.int32)
+        else:
+            shape = (batch_size, spec.dim) if spec.seq == SeqLevel.NO_SEQUENCE \
+                else (batch_size, 16, spec.dim)
+            feeds[l.name] = np.zeros(shape, np.float32)
+        if spec.seq != SeqLevel.NO_SEQUENCE:
+            feeds[f"{l.name}.lengths"] = np.full((batch_size,), 16, np.int32)
+    return feeds
+
+
+def save_inference_model(path: str, output_layer, parameters,
+                         export_batch_sizes: Sequence[int] = (),
+                         platforms: Optional[Sequence[str]] = None) -> None:
+    """Write the one-file serving artifact.
+
+    output_layer: LayerOutput or list; parameters: paddle.parameters
+    Parameters (or any object with .values/.state dicts).
+    export_batch_sizes: also AOT-export the forward at these fixed batch
+    sizes (jax.export) for the zero-model-code deployment path.
+    """
+    import jax
+    from paddle_tpu.topology import Topology
+
+    outputs = output_layer if isinstance(output_layer, (list, tuple)) \
+        else [output_layer]
+    topo = Topology(list(outputs))
+    rebuildable = topo.is_rebuildable()
+    if not rebuildable and not export_batch_sizes:
+        raise ValueError(
+            "topology has unrecordable layers and no export_batch_sizes "
+            "were given — the artifact would not be servable; pass "
+            "export_batch_sizes=[...] to AOT-export instead")
+
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "outputs": [o.name for o in topo.outputs],
+        "data_layers": topo.data_names(),
+        "data_specs": {l.name: [l.data_spec.dim, l.data_spec.kind.value,
+                                l.data_spec.seq.value]
+                       for l in topo.data_layers if l.data_spec is not None},
+        "rebuildable": rebuildable,
+        "export_batch_sizes": list(export_batch_sizes),
+    }
+
+    with tarfile.open(path, "w") as tar:
+        if rebuildable:
+            _add_member(tar, "topology.json",
+                        json.dumps(topo.to_dict()).encode())
+        _add_member(tar, "params.npz", _npz_bytes(parameters.values))
+        _add_member(tar, "state.npz", _npz_bytes(parameters.state))
+        if export_batch_sizes:
+            serve = jax.jit(_serve_fn(topo))
+            for bs in export_batch_sizes:
+                feeds = example_feeds(topo, bs)
+                kw = {}
+                if platforms:
+                    kw["platforms"] = list(platforms)
+                exp = jax.export.export(serve, **kw)(
+                    {k: jax.ShapeDtypeStruct(np.shape(v),
+                                             np.asarray(v).dtype)
+                     for k, v in parameters.values.items()},
+                    {k: jax.ShapeDtypeStruct(np.shape(v),
+                                             np.asarray(v).dtype)
+                     for k, v in parameters.state.items()},
+                    {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                     for k, v in feeds.items()})
+                _add_member(tar, f"exported_bs{bs}.bin", exp.serialize())
+        _add_member(tar, "meta.json", json.dumps(meta).encode())
+
+
+class MergedModel:
+    """Loaded serving artifact (the create_for_inference_with_parameters
+    equivalent). ``infer`` uses the replayed topology (any batch size);
+    ``call_exported`` uses the AOT module (fixed shapes, no tracing)."""
+
+    def __init__(self, meta, topology, params, state, exported):
+        self.meta = meta
+        self.topology = topology
+        self.params = params
+        self.state = state
+        self._exported = exported          # {batch_size: Exported|bytes}
+        self._jit_forward = None
+
+    @property
+    def outputs(self):
+        return self.meta["outputs"]
+
+    def _forward(self):
+        import jax
+        if self._jit_forward is None:
+            if self.topology is None:
+                raise ValueError("artifact has no replayable topology; "
+                                 "use call_exported()")
+            self._jit_forward = jax.jit(_serve_fn(self.topology))
+        return self._jit_forward
+
+    def infer(self, feeds: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        outs = self._forward()(self.params, self.state, feeds)
+        return {k: np.asarray(v) for k, v in outs.items()}
+
+    def aot_compile(self, batch_size: int):
+        """Ahead-of-time compile the forward at a fixed batch size
+        (jit().lower().compile()); returns the compiled executable."""
+        import jax
+        if self.topology is None:
+            raise ValueError("no replayable topology to compile")
+        feeds = example_feeds(self.topology, batch_size)
+        return self._forward().lower(self.params, self.state,
+                                     feeds).compile()
+
+    def call_exported(self, feeds: Dict[str, np.ndarray],
+                      batch_size: Optional[int] = None):
+        """Run the AOT StableHLO module — no model code, no tracing."""
+        import jax
+        bs = batch_size or next(iter(feeds.values())).shape[0]
+        if bs not in self._exported:
+            raise KeyError(f"no export for batch size {bs}; "
+                           f"available: {sorted(self._exported)}")
+        exp = self._exported[bs]
+        if isinstance(exp, (bytes, bytearray)):
+            exp = self._exported[bs] = jax.export.deserialize(bytes(exp))
+        outs = exp.call(self.params, self.state, feeds)
+        return {k: np.asarray(v) for k, v in outs.items()}
+
+
+def load_inference_model(path: str) -> MergedModel:
+    """Load the artifact in a process that never built the model."""
+    from paddle_tpu.topology import Topology
+
+    with tarfile.open(path, "r") as tar:
+        members = {m.name: tar.extractfile(m).read()
+                   for m in tar.getmembers()}
+    meta = json.loads(members["meta.json"])
+    if meta["format_version"] > FORMAT_VERSION:
+        raise ValueError(f"artifact format {meta['format_version']} is "
+                         f"newer than this loader ({FORMAT_VERSION})")
+    topo = None
+    if "topology.json" in members:
+        topo = Topology.from_dict(json.loads(members["topology.json"]))
+    params = _npz_load(members["params.npz"])
+    state = _npz_load(members["state.npz"])
+    exported = {}
+    for name, data in members.items():
+        if name.startswith("exported_bs"):
+            exported[int(name[len("exported_bs"):-len(".bin")])] = data
+    return MergedModel(meta, topo, params, state, exported)

@@ -2139,3 +2139,14 @@ def roi_pool(input, rois, pooled_width: int, pooled_height: int,
 
     return LayerOutput(name, "roi_pool", [input, rois], fwd, [],
                        size=pooled_width * pooled_height * c)
+
+
+# install call recording over this module's public API so built graphs are
+# serializable (Topology.to_dict/from_dict — the program save format)
+def _install_recording():
+    import sys
+    from paddle_tpu import record
+    record.install(sys.modules[__name__])
+
+
+_install_recording()

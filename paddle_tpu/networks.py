@@ -139,3 +139,15 @@ def gru_decoder_with_attention(encoded_sequence, encoded_proj, current_word,
                       act="linear", name=f"{name}_input", bias_attr=False)
     gru = layer.gru_step(inputs, state=state, size=decoder_size, name=name)
     return gru
+
+
+# composite nets are thin wrappers over recorded layer calls — the inner
+# records suffice for serialization, but install anyway so composites whose
+# inner calls are unrecordable still get a fallback record when possible
+def _install_recording():
+    import sys
+    from paddle_tpu import record
+    record.install(sys.modules[__name__])
+
+
+_install_recording()

@@ -1,0 +1,145 @@
+"""Layer-call recording — the program save format's front half.
+
+The reference persisted models as a ModelConfig protobuf next to the
+weights (python/paddle/trainer/config_parser.py; trainer/MergeModel.cpp
+packed both into one artifact). Here the Python call graph IS the config,
+so the equivalent is to record each public layer-API call (name + JSON-able
+kwargs) on the LayerOutput it produces; ``Topology.to_dict`` persists those
+records and ``Topology.from_dict`` replays them to rebuild the graph in a
+process that never saw the model-building code.
+
+Calls whose arguments cannot be serialized (e.g. ``recurrent_group`` step
+closures) simply carry no record — such graphs must be served through the
+AOT StableHLO export path instead (paddle_tpu.io.merged).
+"""
+
+import dataclasses
+import functools
+import inspect
+import itertools
+import threading
+
+_SCALARS = (bool, int, float, str, bytes, type(None))
+_call_ids = itertools.count()
+_lock = threading.Lock()
+
+
+class Unserializable(Exception):
+    """Argument cannot be represented in the program save format."""
+
+
+def encode_value(v):
+    from paddle_tpu.activation import BaseActivation
+    from paddle_tpu.core.param import ParamAttr
+    from paddle_tpu.data_type import InputType
+    from paddle_tpu.pooling import BasePoolingType
+    from paddle_tpu.topology import LayerOutput
+
+    if isinstance(v, _SCALARS):
+        return v
+    if isinstance(v, LayerOutput):
+        return {"$layer": v.name}
+    if isinstance(v, (list, tuple)):
+        return [encode_value(x) for x in v]
+    if isinstance(v, dict):
+        if not all(isinstance(k, str) for k in v):
+            raise Unserializable(f"non-string dict keys: {v!r}")
+        return {"$dict": {k: encode_value(x) for k, x in v.items()}}
+    if isinstance(v, ParamAttr):
+        return {"$param_attr": dataclasses.asdict(v)}
+    if isinstance(v, InputType):
+        return {"$input_type": [v.dim, v.kind.value, v.seq.value]}
+    if isinstance(v, BaseActivation):
+        return {"$act": v.name}
+    if isinstance(v, BasePoolingType) or (
+            isinstance(v, type) and issubclass(v, BasePoolingType)):
+        return {"$pool": v.name}
+    raise Unserializable(f"{type(v).__name__}: {v!r}")
+
+
+def decode_value(v, nodes):
+    """Inverse of encode_value; ``nodes`` maps layer name -> LayerOutput."""
+    from paddle_tpu import activation as act_mod
+    from paddle_tpu.core.param import ParamAttr
+    from paddle_tpu.data_type import InputType, Kind, SeqLevel
+
+    if isinstance(v, _SCALARS):
+        return v
+    if isinstance(v, list):
+        return [decode_value(x, nodes) for x in v]
+    if isinstance(v, dict):
+        if "$layer" in v:
+            return nodes[v["$layer"]]
+        if "$dict" in v:
+            return {k: decode_value(x, nodes) for k, x in v["$dict"].items()}
+        if "$param_attr" in v:
+            return ParamAttr(**v["$param_attr"])
+        if "$input_type" in v:
+            dim, kind, seq = v["$input_type"]
+            return InputType(dim, Kind(kind), SeqLevel(seq))
+        if "$act" in v:
+            for cls in vars(act_mod).values():
+                if (isinstance(cls, type)
+                        and issubclass(cls, act_mod.BaseActivation)
+                        and cls.name == v["$act"] ):
+                    return cls()
+            raise ValueError(f"unknown activation {v['$act']!r}")
+        if "$pool" in v:
+            return v["$pool"]   # layer APIs accept the string name
+    raise ValueError(f"cannot decode {v!r}")
+
+
+def _outputs_of(result):
+    from paddle_tpu.topology import LayerOutput
+    if isinstance(result, LayerOutput):
+        return [result]
+    if isinstance(result, (list, tuple)):
+        return [r for r in result if isinstance(r, LayerOutput)]
+    return []
+
+
+def _recorded(api_path, fn):
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return fn
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        result = fn(*args, **kwargs)
+        outs = _outputs_of(result)
+        if outs and all(getattr(o, "config", None) is None for o in outs):
+            # inner (already-recorded) calls win: a composite that merely
+            # wraps recorded layer calls needs no record of its own
+            try:
+                bound = sig.bind(*args, **kwargs)
+                enc = {k: encode_value(v) for k, v in bound.arguments.items()}
+                with _lock:
+                    cid = next(_call_ids)
+                cfg = {"api": api_path, "kwargs": enc, "call": cid,
+                       "out_names": [o.name for o in outs]}
+                for i, o in enumerate(outs):
+                    o.config = {**cfg, "out_index": i}
+            except Unserializable:
+                pass
+        return result
+
+    return wrapped
+
+
+def install(module, public=None):
+    """Wrap a module's public layer functions with call recording."""
+    names = public if public is not None else [
+        n for n in vars(module)
+        if not n.startswith("_") and inspect.isfunction(vars(module)[n])
+        and vars(module)[n].__module__ == module.__name__]
+    for n in names:
+        setattr(module, n, _recorded(f"{module.__name__}.{n}",
+                                     getattr(module, n)))
+
+
+def resolve_api(api_path):
+    """'paddle_tpu.layer.fc' -> the (recorded) function object."""
+    import importlib
+    mod_name, _, attr = api_path.rpartition(".")
+    return getattr(importlib.import_module(mod_name), attr)

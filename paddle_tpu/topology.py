@@ -121,6 +121,10 @@ class LayerOutput:
         self.activation = activation
         self.is_data = is_data
         self.data_spec = data_spec
+        # creation record (api + encoded kwargs) attached by the recorder
+        # installed over the public layer API (paddle_tpu.record) — the
+        # program save format's rebuild handle
+        self.config = None
         hook = getattr(_hook_local, "fn", None)
         if hook is not None:
             hook(self)
@@ -220,9 +224,13 @@ class Topology:
 
     # -- serialization (program save format) --------------------------------
     def to_dict(self):
-        """Structural description for merged-model artifacts (replaces the
-        ModelConfig proto written next to checkpoints)."""
+        """Structural + rebuildable description for merged-model artifacts
+        (replaces the ModelConfig proto written next to checkpoints,
+        proto/ModelConfig.proto:652). Each layer carries its creation
+        record (api + encoded kwargs) when the public API recorded one;
+        ``from_dict`` replays those records."""
         return {
+            "format_version": 1,
             "outputs": [o.name for o in self.outputs],
             "layers": [
                 {
@@ -230,6 +238,56 @@ class Topology:
                     "parents": [p.name for p in l.parents],
                     "params": [s.name for s in l.param_specs],
                     "activation": l.activation,
+                    "config": l.config,
                 } for l in self.layers
             ],
         }
+
+    def is_rebuildable(self):
+        """True if every non-data layer carries a creation record."""
+        return all(l.config is not None for l in self.layers)
+
+    @classmethod
+    def from_dict(cls, d) -> "Topology":
+        """Rebuild the layer graph by replaying recorded API calls — the
+        merged-model loader's half of the program save format (reference
+        slot: config_parser.parse_config re-creating a GradientMachine
+        from a saved ModelConfig, paddle/capi/gradient_machine.h:52)."""
+        from paddle_tpu import record
+
+        nodes: Dict[str, LayerOutput] = {}
+        calls: Dict[int, List[LayerOutput]] = {}
+        for entry in d["layers"]:
+            cfg = entry.get("config")
+            if cfg is None:
+                raise ValueError(
+                    f"layer {entry['name']!r} ({entry['type']}) has no "
+                    f"creation record — this graph cannot be rebuilt from "
+                    f"its dict; serve it via the AOT StableHLO export "
+                    f"(paddle_tpu.io.merged.save_inference_model(..., "
+                    f"export_shapes=...)) instead")
+            cid = cfg["call"]
+            if cid not in calls:
+                fn = record.resolve_api(cfg["api"])
+                kwargs = {k: record.decode_value(v, nodes)
+                          for k, v in cfg["kwargs"].items()}
+                # pin the recorded name so parameters keyed by layer name
+                # resolve identically in the rebuilding process (auto_name
+                # counters differ between processes)
+                import inspect
+                if ("name" in inspect.signature(fn).parameters
+                        and not kwargs.get("name")
+                        and len(cfg["out_names"]) == 1):
+                    kwargs["name"] = entry["name"]
+                result = fn(**kwargs)
+                outs = result if isinstance(result, (list, tuple)) \
+                    else [result]
+                outs = [o for o in outs if isinstance(o, LayerOutput)]
+                calls[cid] = outs
+            node = calls[cid][cfg["out_index"]]
+            enforce.enforce(
+                node.name == entry["name"],
+                "rebuilt layer name %r != recorded %r (api %s)"
+                % (node.name, entry["name"], cfg["api"]))
+            nodes[entry["name"]] = node
+        return cls([nodes[n] for n in d["outputs"]])

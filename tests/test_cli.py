@@ -1,0 +1,76 @@
+"""CLI jobs end-to-end in-process (reference: TrainerMain.cpp:52-61 job
+dispatch; job=infer mirrors paddle.v2.infer / capi serving)."""
+
+import os
+
+import numpy as np
+
+from paddle_tpu import cli
+
+CONFIG = """
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import layer
+
+img = layer.data("image", paddle.data_type.dense_vector(16))
+lbl = layer.data("label", paddle.data_type.integer_value(4))
+h = layer.fc(img, 8, act=paddle.activation.Relu(), name="cli_h")
+out = layer.fc(h, 4, act=paddle.activation.Softmax(), name="cli_out")
+cost = layer.classification_cost(out, lbl, name="cost")
+outputs = [out]
+batch_size = 8
+
+_rng = np.random.RandomState(0)
+_data = [( _rng.rand(16).astype("float32"), int(_rng.randint(4)) )
+         for _ in range(32)]
+
+def reader():
+    return iter(_data)
+
+def infer_reader():
+    return iter([(x,) for x, _ in _data])
+"""
+
+
+def _write_config(tmp_path):
+    p = tmp_path / "conf.py"
+    p.write_text(CONFIG)
+    return str(p)
+
+
+class TestCliJobs:
+    def test_train_then_infer_from_saved(self, tmp_path):
+        conf = _write_config(tmp_path)
+        save_dir = str(tmp_path / "out")
+        rc = cli.main(["train", f"--config={conf}", "--num_passes=1",
+                       f"--save_dir={save_dir}"])
+        assert rc == 0
+        tar = os.path.join(save_dir, "pass-00000", "params.tar")
+        assert os.path.exists(tar)
+        out_npz = str(tmp_path / "preds.npz")
+        rc = cli.main(["infer", f"--config={conf}",
+                       f"--init_model_path={tar}",
+                       f"--output_path={out_npz}", "--infer_limit=8"])
+        assert rc == 0
+        preds = np.load(out_npz)["cli_out"]
+        assert preds.shape == (8, 4)
+        np.testing.assert_allclose(preds.sum(-1), 1.0, rtol=1e-4)
+
+    def test_infer_from_merged_model(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu import layer
+        from paddle_tpu.io import merged
+        img = layer.data("image", paddle.data_type.dense_vector(16))
+        h = layer.fc(img, 8, act=paddle.activation.Relu(), name="cm_h")
+        out = layer.fc(h, 4, act=paddle.activation.Softmax(), name="cm_out")
+        params = paddle.parameters.create(out)
+        model = str(tmp_path / "m.tar")
+        merged.save_inference_model(model, out, params)
+
+        conf = _write_config(tmp_path)
+        out_npz = str(tmp_path / "preds.npz")
+        rc = cli.main(["infer", f"--config={conf}", f"--model={model}",
+                       f"--output_path={out_npz}", "--infer_limit=8"])
+        assert rc == 0
+        preds = np.load(out_npz)["cm_out"]
+        assert preds.shape == (8, 4)

@@ -1,0 +1,127 @@
+"""Merged-model serving artifact: save in one process, load + infer in a
+fresh process that never sees the model-building code (the capi
+create_for_inference_with_parameters bar, paddle/capi/gradient_machine.h:52,
+trainer/MergeModel.cpp)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layer
+from paddle_tpu.io import merged
+from paddle_tpu.topology import Topology
+from paddle_tpu.utils.rng import KeySource
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_model():
+    img = layer.data("image", paddle.data_type.dense_vector(64))
+    h = layer.fc(img, 32, act=paddle.activation.Relu(), name="mm_h")
+    out = layer.fc(h, 10, act=paddle.activation.Softmax(), name="mm_out")
+    return out
+
+
+class TestTopologyRoundTrip:
+    def test_from_dict_same_forward(self, rng):
+        out = _build_model()
+        topo = Topology(out)
+        params = paddle.parameters.create(out, KeySource(3))
+        x = rng.randn(4, 64).astype(np.float32)
+        fwd = topo.compile()
+        want, _ = fwd(params.values, params.state, {"image": x})
+
+        topo2 = Topology.from_dict(
+            json.loads(json.dumps(topo.to_dict())))
+        got, _ = topo2.compile()(params.values, params.state, {"image": x})
+        np.testing.assert_allclose(np.asarray(got["mm_out"].array),
+                                   np.asarray(want["mm_out"].array),
+                                   rtol=1e-6)
+
+    def test_unrecordable_graph_raises(self):
+        from paddle_tpu.topology import LayerOutput, Value
+        raw = LayerOutput("raw", "custom", [],
+                          lambda p, vals, ctx: Value(None))
+        topo = Topology(raw)
+        assert not topo.is_rebuildable()
+        with pytest.raises(ValueError, match="creation record"):
+            Topology.from_dict(topo.to_dict())
+
+
+class TestMergedArtifact:
+    def _save(self, tmp_path, export=()):
+        out = _build_model()
+        params = paddle.parameters.create(out, KeySource(5))
+        path = str(tmp_path / "model.tar")
+        merged.save_inference_model(path, out, params,
+                                    export_batch_sizes=export)
+        return path, out, params
+
+    def test_same_process_roundtrip(self, tmp_path, rng):
+        path, out, params = self._save(tmp_path)
+        x = rng.randn(6, 64).astype(np.float32)
+        want = paddle.infer(output_layer=out, parameters=params,
+                            input=[(r,) for r in x])
+        m = merged.load_inference_model(path)
+        got = m.infer({"image": x})["mm_out"]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_aot_compile(self, tmp_path, rng):
+        path, out, params = self._save(tmp_path)
+        m = merged.load_inference_model(path)
+        compiled = m.aot_compile(batch_size=4)
+        x = rng.randn(4, 64).astype(np.float32)
+        outs = compiled(m.params, m.state, {"image": x})
+        want = m.infer({"image": x})["mm_out"]
+        np.testing.assert_allclose(np.asarray(outs["mm_out"]), want,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_exported_stablehlo(self, tmp_path, rng):
+        path, out, params = self._save(tmp_path, export=(4,))
+        m = merged.load_inference_model(path)
+        x = rng.randn(4, 64).astype(np.float32)
+        got = m.call_exported({"image": x})["mm_out"]
+        want = m.infer({"image": x})["mm_out"]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        with pytest.raises(KeyError):
+            m.call_exported({"image": rng.randn(3, 64).astype(np.float32)})
+
+    def test_fresh_process_no_model_code(self, tmp_path, rng):
+        """The merged-model bar: a separate python process loads the tar
+        and infers, importing only paddle_tpu — none of the model-building
+        code in this test module."""
+        path, out, params = self._save(tmp_path, export=(4,))
+        x = rng.randn(4, 64).astype(np.float32)
+        np.save(tmp_path / "x.npy", x)
+        want = paddle.infer(output_layer=out, parameters=params,
+                            input=[(r,) for r in x])
+
+        script = f"""
+import sys
+sys.path.insert(0, {REPO!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from paddle_tpu.io import merged
+m = merged.load_inference_model({path!r})
+x = np.load({str(tmp_path / 'x.npy')!r})
+got = m.infer({{"image": x}})["mm_out"]
+exp = m.call_exported({{"image": x}})["mm_out"]
+np.save({str(tmp_path / 'got.npy')!r}, got)
+np.save({str(tmp_path / 'exp.npy')!r}, exp)
+print("fresh-process infer OK")
+"""
+        env = dict(os.environ, PADDLE_TPU_COMPUTE_DTYPE="float32")
+        r = subprocess.run([sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr
+        got = np.load(tmp_path / "got.npy")
+        exp = np.load(tmp_path / "exp.npy")
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(exp, want, rtol=1e-5, atol=1e-6)
