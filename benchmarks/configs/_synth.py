@@ -1,0 +1,34 @@
+"""Shared synthetic readers for the benchmark configs (the counterpart of
+the reference's provider.py feeding random data in benchmark/paddle/)."""
+
+import os
+
+import numpy as np
+
+
+def env_int(name, default):
+    return int(os.environ.get(name, default))
+
+
+def image_reader(img_size, channels=3, classes=1000, n=4096, seed=0):
+    """Flat-CHW image samples (the data-boundary convention)."""
+    dim = channels * img_size * img_size
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            yield rng.rand(dim).astype(np.float32), int(rng.randint(classes))
+
+    return reader, dim
+
+
+def text_reader(vocab, seq_len, classes=2, n=4096, seed=0):
+    """Fixed-length token sequences (benchmark/paddle/rnn pad_seq=True)."""
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            yield ([int(t) for t in rng.randint(0, vocab, seq_len)],
+                   int(rng.randint(classes)))
+
+    return reader

@@ -1,0 +1,20 @@
+"""AlexNet benchmark config (reference: benchmark/paddle/image/alexnet.py;
+baseline 1xK40m ms/batch: 195/334/602/1629 @ bs 64/128/256/512)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _synth import env_int, image_reader
+
+import paddle_tpu as paddle
+from paddle_tpu import layer
+from paddle_tpu.models import alexnet
+
+batch_size = env_int("BENCH_BATCH", 128)
+reader, dim = image_reader(227)
+img = layer.data("image", paddle.data_type.dense_vector(dim))
+lbl = layer.data("label", paddle.data_type.integer_value(1000))
+out = alexnet.alexnet(img, class_num=1000, img_size=227)
+cost = layer.classification_cost(out, lbl, name="cost")
+optimizer = paddle.optimizer.Momentum(momentum=0.9, learning_rate=0.01)

@@ -1,0 +1,20 @@
+"""CTR wide&deep benchmark config (BASELINE config 5 — the high-dim
+sparse path; reference: v1_api_demo/quick_start/trainer_config.lr.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _synth import env_int
+
+import paddle_tpu as paddle
+from paddle_tpu.models import ctr
+
+batch_size = env_int("BENCH_BATCH", 256)
+wide_dim = env_int("BENCH_WIDE_DIM", 1000000)
+vocab = env_int("BENCH_VOCAB", 100000)
+
+out, cost = ctr.ctr_wide_deep(wide_dim, vocab, emb_dim=64,
+                              hidden=(128, 64))
+reader = ctr.synthetic_reader(wide_dim, vocab, n=8192)
+optimizer = paddle.optimizer.Adam(learning_rate=1e-3)

@@ -1,0 +1,20 @@
+"""GoogleNet benchmark config (reference: benchmark/paddle/image/
+googlenet.py; baseline 1xK40m ms/batch: 613/1149/2348 @ bs 64/128/256)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _synth import env_int, image_reader
+
+import paddle_tpu as paddle
+from paddle_tpu import layer
+from paddle_tpu.models import googlenet
+
+batch_size = env_int("BENCH_BATCH", 128)
+reader, dim = image_reader(224)
+img = layer.data("image", paddle.data_type.dense_vector(dim))
+lbl = layer.data("label", paddle.data_type.integer_value(1000))
+out = googlenet.googlenet(img, class_num=1000)
+cost = layer.classification_cost(out, lbl, name="cost")
+optimizer = paddle.optimizer.Momentum(momentum=0.9, learning_rate=0.01)

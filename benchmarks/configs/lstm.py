@@ -1,0 +1,25 @@
+"""LSTM text-classification benchmark config (reference: benchmark/paddle/
+rnn/rnn.py — vocab 30000, emb 128, fixed len 100, hidden/batch swept;
+baseline 1xK40m ms/batch @ bs 64: 83/184/641 for hidden 256/512/1280)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _synth import env_int, text_reader
+
+import paddle_tpu as paddle
+from paddle_tpu import layer
+from paddle_tpu.models import text
+
+batch_size = env_int("BENCH_BATCH", 64)
+hidden = env_int("BENCH_HIDDEN", 256)
+vocab, seq_len = 30000, 100
+
+reader = text_reader(vocab, seq_len)
+words = layer.data("words", paddle.data_type.integer_value_sequence(vocab))
+lbl = layer.data("label", paddle.data_type.integer_value(2))
+out = text.lstm_text_classification(words, hidden_dim=hidden, class_num=2,
+                                    emb_dim=128)
+cost = layer.classification_cost(out, lbl, name="cost")
+optimizer = paddle.optimizer.Adam(learning_rate=2e-3)

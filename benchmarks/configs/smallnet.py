@@ -1,0 +1,21 @@
+"""SmallNet CIFAR benchmark config (reference: benchmark/paddle/image/
+smallnet_mnist_cifar.py; baseline 1xK40m ms/batch: 10.463/18.184/33.113/
+63.039 @ bs 64/128/256/512)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _synth import env_int, image_reader
+
+import paddle_tpu as paddle
+from paddle_tpu import layer
+from paddle_tpu.models import smallnet
+
+batch_size = env_int("BENCH_BATCH", 128)
+reader, dim = image_reader(32, channels=3, classes=10)
+img = layer.data("image", paddle.data_type.dense_vector(dim))
+lbl = layer.data("label", paddle.data_type.integer_value(10))
+out = smallnet.smallnet(img, class_num=10, num_channels=3)
+cost = layer.classification_cost(out, lbl, name="cost")
+optimizer = paddle.optimizer.Momentum(momentum=0.9, learning_rate=0.01)

@@ -93,38 +93,70 @@ def job_test(cfg, args):
     return 0
 
 
-def job_time(cfg, args):
-    """Steady-state ms/batch (reference: --job=time,
-    benchmark/paddle/image/run.sh:9)."""
+def measure_time(cfg, batch_size=None, time_batches=20, warmup_batches=3,
+                 init_model_path=None):
+    """Steady-state train-step timing — the measurement core of job=time
+    (reference protocol: `paddle train --job=time`,
+    benchmark/paddle/image/run.sh:9-17). Returns a dict with ms/batch and
+    examples/sec; reused by benchmarks/run_all.py."""
     import jax
     import paddle_tpu as paddle
-    trainer, params = _build_trainer(cfg, args)
-    batch_size = cfg.get("batch_size", 64)
+
+    import jax.numpy as jnp
+
+    def jnp_int32(i):
+        return jnp.asarray(i, jnp.int32)
+
+    class _Args:
+        pass
+
+    a = _Args()
+    a.init_model_path = init_model_path
+    trainer, params = _build_trainer(cfg, a)
+    batch_size = batch_size or cfg.get("batch_size", 64)
     reader = paddle.batch(cfg["reader"], batch_size)
     batches = []
     for i, b in enumerate(reader()):
-        if i >= args.time_batches + args.warmup_batches:
+        if i >= time_batches + warmup_batches:
             break
         batches.append(b)
     feeder = trainer._feeder(cfg.get("feeding"))
-    step = trainer._train_step_fn
-    pstate = trainer.parameters.values, trainer._opt_state, \
-        trainer.parameters.state
+    step = trainer._train_step
+    pv, ov, sv = (trainer.parameters.values, trainer.opt_state,
+                  trainer.parameters.state)
     key = jax.random.PRNGKey(0)
-    pv, ov, sv = pstate
     times = []
+    t_start = _time.perf_counter()
     for i, b in enumerate(batches):
-        feeds = feeder(b)
+        feeds = feeder.feed(b)
         t0 = _time.perf_counter()
-        pv, ov, sv, cost, _ = step(pv, ov, sv, feeds,
-                                   np.int64(i), key)
+        cost, pv, ov, sv, _ = step(pv, ov, sv, feeds,
+                                   jnp_int32(i), key)
         jax.block_until_ready(cost)
-        if i >= args.warmup_batches:
+        if i >= warmup_batches:
             times.append(_time.perf_counter() - t0)
     ms = 1000 * float(np.mean(times)) if times else float("nan")
-    ips = batch_size / (ms / 1000) if times else float("nan")
-    print(f"time job: {ms:.2f} ms/batch, {ips:.1f} examples/sec "
-          f"(batch_size={batch_size}, {len(times)} timed batches)")
+    return {
+        "ms_per_batch": ms,
+        "examples_per_sec": batch_size / (ms / 1000) if times else
+        float("nan"),
+        "batch_size": batch_size,
+        "timed_batches": len(times),
+        "compile_plus_warmup_s": (_time.perf_counter() - t_start
+                                  - sum(times)),
+    }
+
+
+def job_time(cfg, args):
+    """Steady-state ms/batch (reference: --job=time,
+    benchmark/paddle/image/run.sh:9)."""
+    r = measure_time(cfg, time_batches=args.time_batches,
+                     warmup_batches=args.warmup_batches,
+                     init_model_path=args.init_model_path)
+    print(f"time job: {r['ms_per_batch']:.2f} ms/batch, "
+          f"{r['examples_per_sec']:.1f} examples/sec "
+          f"(batch_size={r['batch_size']}, "
+          f"{r['timed_batches']} timed batches)")
     return 0
 
 

@@ -1,0 +1,168 @@
+"""Multi-host runtime initialisation — the cluster-training control plane.
+
+Reference: the trainer/pserver process topology was assembled by gflags
+(--trainer_id/--num_gradient_servers/--pservers, utils/Flags.cpp:58-81)
+and launcher scripts (paddle/scripts/cluster_train/paddle.py SSH fan-out,
+submit_local.sh.in); the Go master + etcd coordinated elasticity.
+
+TPU-native: one JAX process per host joins the cluster through
+``jax.distributed.initialize`` (coordinator + process id); after that,
+``jax.devices()`` is the *global* device set, meshes span hosts, and every
+collective rides ICI within a slice and DCN across slices — there is no
+trainer/pserver asymmetry to configure. This module wraps that runtime:
+
+- ``init()``         — join the cluster (env-var or explicit args)
+- ``hybrid_mesh()``  — ICI x DCN mesh for multi-slice jobs
+- the local N-process simulation used by tests/launcher lives in
+  paddle_tpu.runtime.launch
+
+Env contract (set by paddle_tpu.runtime.launch or your scheduler):
+  PADDLE_COORDINATOR   host:port of process 0
+  PADDLE_NUM_PROCESSES total process count
+  PADDLE_PROCESS_ID    this process's rank
+  PADDLE_LOCAL_CPU_DEVICES  (simulation) CPU device count per process
+On real TPU pods all three are discovered from the TPU metadata by JAX and
+``init()`` degenerates to ``jax.distributed.initialize()``.
+"""
+
+import os
+from typing import Optional, Sequence
+
+from paddle_tpu.utils.logger import get_logger
+
+log = get_logger("distributed")
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init(coordinator_address: Optional[str] = None,
+         num_processes: Optional[int] = None,
+         process_id: Optional[int] = None,
+         platform: Optional[str] = None,
+         local_cpu_devices: Optional[int] = None) -> None:
+    """Join (or create) the multi-host JAX cluster.
+
+    With no arguments, reads the PADDLE_* env contract; with nothing set,
+    falls back to JAX auto-detection (TPU pod metadata). Safe to call on a
+    single host with no env — it then does nothing, keeping single-process
+    semantics.
+    """
+    global _initialized
+    if _initialized:
+        log.warning("distributed.init() called twice; ignoring")
+        return
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "PADDLE_COORDINATOR")
+    if num_processes is None and os.environ.get("PADDLE_NUM_PROCESSES"):
+        num_processes = int(os.environ["PADDLE_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("PADDLE_PROCESS_ID"):
+        process_id = int(os.environ["PADDLE_PROCESS_ID"])
+    platform = platform or os.environ.get("PADDLE_PLATFORM")
+    if local_cpu_devices is None and os.environ.get(
+            "PADDLE_LOCAL_CPU_DEVICES"):
+        local_cpu_devices = int(os.environ["PADDLE_LOCAL_CPU_DEVICES"])
+
+    # simulation mode: force the CPU platform with k virtual devices per
+    # process (the JAX_PLATFORMS env var may be overridden by site hooks,
+    # so use the config API — same technique as tests/conftest.py)
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    if local_cpu_devices:
+        jax.config.update("jax_num_cpu_devices", local_cpu_devices)
+
+    if coordinator_address is None and num_processes is None:
+        # single-host (or TPU-pod auto-detect) path
+        try:
+            jax.distributed.initialize()
+            _initialized = True
+            log.info("distributed: auto-initialized, %d processes, "
+                     "%d global devices", jax.process_count(),
+                     len(jax.devices()))
+        except Exception as e:  # noqa: BLE001 — single-process fallback
+            log.info("distributed: single-process mode (%s)", e)
+        return
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    _initialized = True
+    log.info("distributed: joined as process %d/%d, %d global devices "
+             "(%d local)", jax.process_index(), jax.process_count(),
+             len(jax.devices()), len(jax.local_devices()))
+
+
+def shutdown():
+    global _initialized
+    if _initialized:
+        import jax
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def process_index() -> int:
+    import jax
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+    return jax.process_count()
+
+
+def hybrid_mesh(ici_shape: Sequence[int], axis_names: Sequence[str],
+                dcn_axis: str = "dcn",
+                num_slices: Optional[int] = None):
+    """ICI x DCN mesh for multi-slice / multi-host jobs.
+
+    ici_shape/axis_names lay out the devices *within* a slice; the leading
+    ``dcn_axis`` spans slices (usually the pure-DP axis — gradients cross
+    DCN once per step, everything else stays on ICI). Single-slice jobs
+    (num_slices==1) get a plain mesh without the DCN axis.
+
+    Replaces: the trainer↔pserver split (sync grads crossed the datacenter
+    network via ParameterClient2, pserver/ParameterClient2.h:216); here the
+    cross-slice all-reduce is one XLA collective on the dcn axis.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if num_slices is None:
+        # slice count from device attributes when present (TPU pods);
+        # else processes-as-slices (CPU simulation); else 1
+        if hasattr(devices[0], "slice_index"):
+            num_slices = len({d.slice_index for d in devices})
+        elif jax.process_count() > 1:
+            num_slices = jax.process_count()
+        else:
+            num_slices = 1
+    per_slice = int(np.prod(ici_shape))
+    if per_slice * num_slices != len(devices):
+        raise ValueError(
+            f"ici {tuple(ici_shape)} x {num_slices} slices needs "
+            f"{per_slice * num_slices} devices, have {len(devices)}")
+    if num_slices == 1:
+        arr = np.asarray(devices).reshape(tuple(ici_shape))
+        return Mesh(arr, tuple(axis_names))
+    if hasattr(devices[0], "slice_index"):
+        from jax.experimental import mesh_utils
+        arr = mesh_utils.create_hybrid_device_mesh(
+            tuple(ici_shape), (num_slices,), devices=devices,
+            allow_split_physical_axes=True)
+        # create_hybrid_device_mesh puts DCN axes last; move it first
+        arr = np.moveaxis(arr, -1, 0)
+    else:
+        # simulation: group devices by process = slice
+        order = sorted(range(len(devices)),
+                       key=lambda i: (devices[i].process_index, i))
+        arr = np.asarray([devices[i] for i in order]).reshape(
+            (num_slices,) + tuple(ici_shape))
+    return Mesh(arr, (dcn_axis,) + tuple(axis_names))

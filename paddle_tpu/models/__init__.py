@@ -2,6 +2,7 @@
 resnet,smallnet_mnist_cifar}.py, v1_api_demo/ configs)."""
 
 from paddle_tpu.models import alexnet
+from paddle_tpu.models import ctr
 from paddle_tpu.models import googlenet
 from paddle_tpu.models import resnet
 from paddle_tpu.models import smallnet

@@ -1,0 +1,86 @@
+"""Multi-process launcher — the cluster_train script slot.
+
+Reference: paddle/scripts/cluster_train/paddle.py (SSH fan-out of
+pserver+trainer processes with --trainer_id etc.) and submit_local.sh.in
+(the `paddle` CLI wrapper).
+
+TPU-native: every process is identical (no pserver role); the launcher
+just sets the PADDLE_* env contract consumed by paddle_tpu.distributed.init
+and execs the worker. Local mode spawns N processes on this machine with
+the CPU platform and K virtual devices each — the no-cluster simulation of
+a K-chip x N-host pod used by the tests (SURVEY §4.6's in-process-pserver
+strategy, one level up).
+
+Usage:
+  python -m paddle_tpu.runtime.launch --nprocs=2 --devices-per-proc=4 \
+      worker.py [worker args...]
+On a real pod, run one process per host with PADDLE_COORDINATOR pointing
+at host 0 (or let TPU metadata auto-configure) instead.
+"""
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional, Sequence
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch_local(nprocs: int, argv: Sequence[str],
+                 devices_per_proc: int = 1,
+                 coordinator_port: Optional[int] = None,
+                 env_extra: Optional[dict] = None,
+                 timeout: float = 600.0) -> List[int]:
+    """Spawn ``nprocs`` local worker processes and wait; returns their
+    return codes. Workers must call paddle_tpu.distributed.init()."""
+    port = coordinator_port or free_port()
+    procs = []
+    for rank in range(nprocs):
+        env = dict(os.environ,
+                   PADDLE_COORDINATOR=f"127.0.0.1:{port}",
+                   PADDLE_NUM_PROCESSES=str(nprocs),
+                   PADDLE_PROCESS_ID=str(rank),
+                   PADDLE_PLATFORM="cpu",
+                   PADDLE_LOCAL_CPU_DEVICES=str(devices_per_proc),
+                   **(env_extra or {}))
+        procs.append(subprocess.Popen([sys.executable, *argv], env=env))
+    deadline = time.time() + timeout
+    rcs = []
+    for p in procs:
+        remain = max(1.0, deadline - time.time())
+        try:
+            rcs.append(p.wait(timeout=remain))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            rcs.append(-9)
+    return rcs
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.runtime.launch",
+        description="local multi-process launcher (cluster simulation)")
+    ap.add_argument("--nprocs", type=int, default=2)
+    ap.add_argument("--devices-per-proc", type=int, default=1)
+    ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument("worker", nargs=argparse.REMAINDER,
+                    help="worker script and args")
+    args = ap.parse_args(argv)
+    if not args.worker:
+        ap.error("worker script required")
+    rcs = launch_local(args.nprocs, args.worker,
+                       devices_per_proc=args.devices_per_proc,
+                       timeout=args.timeout)
+    print(f"launch: workers exited {rcs}")
+    return 0 if all(rc == 0 for rc in rcs) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,20 @@ class TestCliJobs:
         assert preds.shape == (8, 4)
         np.testing.assert_allclose(preds.sum(-1), 1.0, rtol=1e-4)
 
+    def test_job_time_measures(self, tmp_path, capsys):
+        conf = _write_config(tmp_path)
+        rc = cli.main(["time", f"--config={conf}", "--time_batches=2",
+                       "--warmup_batches=1"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "ms/batch" in out and "examples/sec" in out
+
+    def test_measure_time_returns_metrics(self, tmp_path):
+        cfg = cli._load_config(_write_config(tmp_path))
+        r = cli.measure_time(cfg, time_batches=2, warmup_batches=1)
+        assert r["ms_per_batch"] > 0
+        assert r["timed_batches"] == 2
+
     def test_infer_from_merged_model(self, tmp_path):
         import paddle_tpu as paddle
         from paddle_tpu import layer

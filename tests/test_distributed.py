@@ -1,0 +1,158 @@
+"""Multi-host runtime: N-process cluster simulation via the local launcher
+(the no-real-cluster strategy of trainer/tests/test_CompareSparse.cpp:65 —
+in-process pservers — one level up: separate OS processes joined by
+jax.distributed), plus hybrid ICI x DCN meshes and the master-fed trainer."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    import paddle_tpu.distributed as dist
+    dist.init()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    nglobal = len(jax.devices())
+    nlocal = len(jax.local_devices())
+    assert nglobal == 8 and nlocal == 4, (nglobal, nlocal)
+
+    # hybrid mesh: dcn axis across the 2 processes, data axis within
+    from paddle_tpu import distributed
+    mesh = distributed.hybrid_mesh((4,), ("data",))
+    assert dict(mesh.shape) == {{"dcn": 2, "data": 4}}, mesh.shape
+
+    # a cross-host psum over both axes: every device contributes 1
+    from jax import shard_map
+    ones = jnp.ones((8,), jnp.float32)
+    sharded = jax.device_put(
+        ones, NamedSharding(mesh, P(("dcn", "data"))))
+
+    def f(x):
+        return jax.lax.psum(jnp.sum(x), ("dcn", "data"))
+
+    total = jax.jit(shard_map(f, mesh=mesh,
+                              in_specs=P(("dcn", "data")), out_specs=P()
+                              ))(sharded)
+    # the psum result is replicated; every process sees 8.0
+    assert float(total) == 8.0, float(total)
+    out_dir = os.environ["TEST_OUT_DIR"]
+    rank = jax.process_index()
+    with open(os.path.join(out_dir, f"ok_{{rank}}"), "w") as fh:
+        fh.write(f"{{float(total)}} {{nglobal}} {{nlocal}}")
+    print("worker", rank, "OK", flush=True)
+""")
+
+
+@pytest.mark.slow
+class TestMultiProcessCluster:
+    def test_two_process_psum(self, tmp_path):
+        """2 processes x 4 virtual CPU devices join one cluster; a hybrid
+        dcn x data mesh spans them and a global psum sees all 8 devices."""
+        from paddle_tpu.runtime import launch
+
+        worker = tmp_path / "worker.py"
+        worker.write_text(WORKER.format(repo=REPO))
+        rcs = launch.launch_local(
+            2, [str(worker)], devices_per_proc=4,
+            env_extra={"TEST_OUT_DIR": str(tmp_path)}, timeout=300)
+        assert rcs == [0, 0], rcs
+        for rank in range(2):
+            body = (tmp_path / f"ok_{rank}").read_text()
+            assert body.startswith("8.0"), body
+
+
+class TestHybridMeshSingleProcess:
+    def test_single_slice_falls_back_to_plain_mesh(self):
+        from paddle_tpu import distributed
+        mesh = distributed.hybrid_mesh((4, 2), ("data", "model"),
+                                       num_slices=1)
+        assert dict(mesh.shape) == {"data": 4, "model": 2}
+
+    def test_shape_mismatch_raises(self):
+        from paddle_tpu import distributed
+        with pytest.raises(ValueError, match="devices"):
+            distributed.hybrid_mesh((4,), ("data",), num_slices=3)
+
+
+class TestMasterFedTrainer:
+    """The go/master -> trainer integration: the reader leases tasks from
+    the master; a consumer that dies mid-task loses its lease and the
+    work is re-dispatched (task-lease fault tolerance, service.go:106)."""
+
+    def _write_recordio(self, tmp_path, n=64):
+        from paddle_tpu.runtime import recordio
+        path = str(tmp_path / "data.rio")
+        w = recordio.Writer(path, records_per_chunk=8)
+        rng = np.random.RandomState(0)
+        for i in range(n):
+            import pickle
+            w.write(pickle.dumps(
+                (rng.rand(4).astype(np.float32), int(rng.randint(2)))))
+        w.close()
+        return path
+
+    def test_trainer_trains_from_master_reader(self, tmp_path):
+        import pickle
+
+        import paddle_tpu as paddle
+        from paddle_tpu import layer
+        from paddle_tpu.runtime.master import MasterClient, MasterService
+        from paddle_tpu.utils.rng import KeySource
+
+        path = self._write_recordio(tmp_path)
+        svc = MasterService(lease_seconds=30)
+        svc.set_dataset([path])
+        client = MasterClient(service=svc)
+
+        x = layer.data("x", paddle.data_type.dense_vector(4))
+        lbl = layer.data("lbl", paddle.data_type.integer_value(2))
+        out = layer.fc(x, 2, act=paddle.activation.Softmax(), name="mf_out")
+        cost = layer.classification_cost(out, lbl, name="mf_cost")
+        params = paddle.parameters.create(cost, KeySource(0))
+        tr = paddle.trainer.SGD(cost=cost, parameters=params,
+                                update_equation=paddle.optimizer.Momentum(
+                                    learning_rate=0.1))
+        seen = []
+        raw = client.reader(max_epochs=1)
+        decoded = lambda: (pickle.loads(r) for r in raw())  # noqa: E731
+        tr.train(reader=paddle.batch(decoded, 16), num_passes=1,
+                 event_handler=lambda e: seen.append(e.cost) if isinstance(
+                     e, paddle.event.EndIteration) else None)
+        assert len(seen) == 4          # 64 records / bs 16
+        assert svc.epoch() == 1
+
+    def test_killed_consumer_work_is_redelivered(self, tmp_path):
+        """Consumer A leases a task and dies (never reports); consumer B
+        still streams every record after A's lease expires."""
+        import pickle
+
+        from paddle_tpu.runtime.master import MasterClient, MasterService
+
+        path = self._write_recordio(tmp_path, n=32)
+        clock = [0.0]
+        svc = MasterService(lease_seconds=1.0, time_fn=lambda: clock[0])
+        svc.set_dataset([path])
+
+        # consumer A leases one task and is never heard from again
+        a = MasterClient(service=svc)
+        dead_task = a.get_task()
+        assert dead_task is not None
+
+        clock[0] += 2.0                # A's lease expires
+
+        b = MasterClient(service=svc)
+        got = []
+        for rec in b.reader(max_epochs=1)():
+            got.append(pickle.loads(rec))
+        assert len(got) == 32          # including A's abandoned records
+        assert svc.epoch() == 1
