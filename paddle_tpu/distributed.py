@@ -135,10 +135,12 @@ def hybrid_mesh(ici_shape: Sequence[int], axis_names: Sequence[str],
     from jax.sharding import Mesh
 
     devices = jax.devices()
+    is_cpu_sim = devices[0].platform == "cpu"
     if num_slices is None:
-        # slice count from device attributes when present (TPU pods);
-        # else processes-as-slices (CPU simulation); else 1
-        if hasattr(devices[0], "slice_index"):
+        # slice count from device attributes when present (TPU pods); the
+        # CPU backend reports slice_index=0 for every device regardless of
+        # process, so in simulation use processes-as-slices instead
+        if hasattr(devices[0], "slice_index") and not is_cpu_sim:
             num_slices = len({d.slice_index for d in devices})
         elif jax.process_count() > 1:
             num_slices = jax.process_count()
@@ -152,7 +154,7 @@ def hybrid_mesh(ici_shape: Sequence[int], axis_names: Sequence[str],
     if num_slices == 1:
         arr = np.asarray(devices).reshape(tuple(ici_shape))
         return Mesh(arr, tuple(axis_names))
-    if hasattr(devices[0], "slice_index"):
+    if hasattr(devices[0], "slice_index") and not is_cpu_sim:
         from jax.experimental import mesh_utils
         arr = mesh_utils.create_hybrid_device_mesh(
             tuple(ici_shape), (num_slices,), devices=devices,

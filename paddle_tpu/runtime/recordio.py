@@ -19,47 +19,69 @@ MAGIC = 0x0A0D5EC5
 HEADER = struct.Struct("<IIQI")
 
 
-def write_records(path: str, records: Iterable, chunk_records: int = 1024):
-    """Write records (pickled) into chunks of chunk_records each. Framing +
-    CRC run in the native codec when built."""
-    lib = native.get()
+class Writer:
+    """Streaming chunk writer; each ``records_per_chunk`` records become one
+    chunk (the master's task-dispatch unit). Framing + CRC run in the native
+    codec when built."""
 
-    def flush_py(out, buf):
+    def __init__(self, path: str, records_per_chunk: int = 1024):
+        self.path = path
+        self.records_per_chunk = records_per_chunk
+        self._lib = native.get()
+        self._buf: List[bytes] = []
+        self._count = 0
+        if self._lib is not None:
+            open(path, "wb").close()      # native writer appends
+            self._out = None
+        else:
+            self._out = open(path, "wb")
+
+    def write(self, record) -> None:
+        """Append one record (any picklable object, including raw bytes)."""
+        self._buf.append(pickle.dumps(record, protocol=4))
+        self._count += 1
+        if len(self._buf) >= self.records_per_chunk:
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self._buf:
+            return
+        buf, self._buf = self._buf, []
+        if self._lib is not None:
+            data = b"".join(buf)
+            lens = (ctypes.c_uint * len(buf))(*[len(r) for r in buf])
+            rc = self._lib.rio_write_chunk(self.path.encode(), data, lens,
+                                           len(buf))
+            if rc < 0:
+                raise IOError(f"rio_write_chunk failed ({rc}) "
+                              f"for {self.path}")
+            return
         payload = b"".join(struct.pack("<I", len(r)) + r for r in buf)
-        out.write(HEADER.pack(MAGIC, len(buf), len(payload),
-                              zlib.crc32(payload) & 0xFFFFFFFF))
-        out.write(payload)
+        self._out.write(HEADER.pack(MAGIC, len(buf), len(payload),
+                                    zlib.crc32(payload) & 0xFFFFFFFF))
+        self._out.write(payload)
 
-    def flush_native(buf):
-        data = b"".join(buf)
-        lens = (ctypes.c_uint * len(buf))(*[len(r) for r in buf])
-        rc = lib.rio_write_chunk(path.encode(), data, lens, len(buf))
-        if rc < 0:
-            raise IOError(f"rio_write_chunk failed ({rc}) for {path}")
+    def close(self) -> int:
+        """Flush the tail chunk; returns total records written."""
+        self._flush()
+        if self._out is not None:
+            self._out.close()
+            self._out = None
+        return self._count
 
-    n = 0
-    buf: List[bytes] = []
-    if lib is not None:
-        open(path, "wb").close()          # native writer appends
-        for rec in records:
-            buf.append(pickle.dumps(rec, protocol=4))
-            n += 1
-            if len(buf) >= chunk_records:
-                flush_native(buf)
-                buf = []
-        if buf:
-            flush_native(buf)
-        return n
-    with open(path, "wb") as out:
-        for rec in records:
-            buf.append(pickle.dumps(rec, protocol=4))
-            n += 1
-            if len(buf) >= chunk_records:
-                flush_py(out, buf)
-                buf = []
-        if buf:
-            flush_py(out, buf)
-    return n
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_records(path: str, records: Iterable, chunk_records: int = 1024):
+    """Write records (pickled) into chunks of chunk_records each."""
+    w = Writer(path, records_per_chunk=chunk_records)
+    for rec in records:
+        w.write(rec)
+    return w.close()
 
 
 def chunk_offsets(path: str) -> List[Tuple[int, int]]:

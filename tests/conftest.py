@@ -23,6 +23,11 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests (multi-process clusters etc.)")
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(1234)
