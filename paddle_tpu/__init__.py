@@ -78,4 +78,15 @@ def init(**kwargs):
         _flags.GLOBAL_FLAGS.set_if_known(_LEGACY_FLAG_ALIASES.get(k, k), v)
     if kwargs.get("seed"):
         _rng.reset_global_seed(int(kwargs["seed"]))
+    # FP-exception tripwires (reference: feenableexcept(FE_INVALID|
+    # FE_DIVBYZERO|FE_OVERFLOW), paddle/trainer/TrainerMain.cpp:49) — the XLA
+    # equivalent re-runs jitted computations op-by-op on a non-finite result
+    # and raises at the producing op.
+    if _flags.GLOBAL_FLAGS.get("debug_nans") or \
+            _flags.GLOBAL_FLAGS.get("debug_infs"):
+        import jax
+        if _flags.GLOBAL_FLAGS.get("debug_nans"):
+            jax.config.update("jax_debug_nans", True)
+        if _flags.GLOBAL_FLAGS.get("debug_infs"):
+            jax.config.update("jax_debug_infs", True)
     return _flags.GLOBAL_FLAGS

@@ -27,26 +27,34 @@ def _tar_reader(path, sub_name, label_key):
 def train10():
     p = common.cached_file("cifar", CIFAR10)
     if p:
-        return _tar_reader(p, "data_batch", "labels")
-    return synthetic.classification(8192, 3072, 10, seed=11, noise=0.5)
+        return common.real_data(_tar_reader(p, "data_batch", "labels"))
+    return common.synthetic_fallback(
+        "cifar", "train10",
+        synthetic.classification(8192, 3072, 10, seed=11, noise=0.5))
 
 
 def test10():
     p = common.cached_file("cifar", CIFAR10)
     if p:
-        return _tar_reader(p, "test_batch", "labels")
-    return synthetic.classification(1024, 3072, 10, seed=111, noise=0.5)
+        return common.real_data(_tar_reader(p, "test_batch", "labels"))
+    return common.synthetic_fallback(
+        "cifar", "test10",
+        synthetic.classification(1024, 3072, 10, seed=111, noise=0.5))
 
 
 def train100():
     p = common.cached_file("cifar", CIFAR100)
     if p:
-        return _tar_reader(p, "train", "fine_labels")
-    return synthetic.classification(8192, 3072, 100, seed=13, noise=0.5)
+        return common.real_data(_tar_reader(p, "train", "fine_labels"))
+    return common.synthetic_fallback(
+        "cifar", "train100",
+        synthetic.classification(8192, 3072, 100, seed=13, noise=0.5))
 
 
 def test100():
     p = common.cached_file("cifar", CIFAR100)
     if p:
-        return _tar_reader(p, "test", "fine_labels")
-    return synthetic.classification(1024, 3072, 100, seed=131, noise=0.5)
+        return common.real_data(_tar_reader(p, "test", "fine_labels"))
+    return common.synthetic_fallback(
+        "cifar", "test100",
+        synthetic.classification(1024, 3072, 100, seed=131, noise=0.5))

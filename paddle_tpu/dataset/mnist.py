@@ -29,21 +29,23 @@ def _idx_reader(images_path, labels_path):
     return reader
 
 
-def _synthetic(n, seed):
-    return synthetic.classification(n, 784, 10, seed=seed, noise=0.4)
+def _synthetic(split, n, seed):
+    return common.synthetic_fallback(
+        "mnist", split, synthetic.classification(n, 784, 10, seed=seed,
+                                                 noise=0.4))
 
 
 def train():
     imgs = common.cached_file("mnist", TRAIN_IMAGES)
     labs = common.cached_file("mnist", TRAIN_LABELS)
     if imgs and labs:
-        return _idx_reader(imgs, labs)
-    return _synthetic(8192, seed=7)
+        return common.real_data(_idx_reader(imgs, labs))
+    return _synthetic("train", 8192, seed=7)
 
 
 def test():
     imgs = common.cached_file("mnist", TEST_IMAGES)
     labs = common.cached_file("mnist", TEST_LABELS)
     if imgs and labs:
-        return _idx_reader(imgs, labs)
-    return _synthetic(1024, seed=77)
+        return common.real_data(_idx_reader(imgs, labs))
+    return _synthetic("test", 1024, seed=77)

@@ -21,12 +21,16 @@ def _file_reader(path, start, end):
 def train():
     p = common.cached_file("uci_housing", "housing.data")
     if p:
-        return _file_reader(p, 0, 404)
-    return synthetic.regression(404, FEATURE_DIM, seed=3)
+        return common.real_data(_file_reader(p, 0, 404))
+    return common.synthetic_fallback(
+        "uci_housing", "train", synthetic.regression(404, FEATURE_DIM,
+                                                     seed=3))
 
 
 def test():
     p = common.cached_file("uci_housing", "housing.data")
     if p:
-        return _file_reader(p, 404, 506)
-    return synthetic.regression(102, FEATURE_DIM, seed=33)
+        return common.real_data(_file_reader(p, 404, 506))
+    return common.synthetic_fallback(
+        "uci_housing", "test", synthetic.regression(102, FEATURE_DIM,
+                                                    seed=33))

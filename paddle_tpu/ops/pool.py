@@ -45,19 +45,22 @@ def avg_pool2d(x: jax.Array, ksize: IntOr2, *, stride: IntOr2 = None,
     (matches cuDNN AVERAGE_COUNT_EXCLUDE_PADDING used by the reference)."""
     k, s = _pair(ksize), _pair(stride if stride is not None else ksize)
     pads = _resolve_pads(x.shape, padding, k, s)
-    summed = lax.reduce_window(x, 0.0, lax.add, (1, k[0], k[1], 1),
+    # accumulate in fp32: summing a window of bf16 values loses mantissa
+    xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+    summed = lax.reduce_window(xf, 0.0, lax.add, (1, k[0], k[1], 1),
                                (1, s[0], s[1], 1), pads)
     if count_include_pad:
-        return summed / (k[0] * k[1])
-    ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+        return (summed / (k[0] * k[1])).astype(x.dtype)
+    ones = jnp.ones(x.shape[:3] + (1,), summed.dtype)
     counts = lax.reduce_window(ones, 0.0, lax.add, (1, k[0], k[1], 1),
                                (1, s[0], s[1], 1), pads)
-    return summed / counts
+    return (summed / counts).astype(x.dtype)
 
 
 def global_avg_pool2d(x: jax.Array) -> jax.Array:
-    """[N,H,W,C] -> [N,C]."""
-    return jnp.mean(x, axis=(1, 2))
+    """[N,H,W,C] -> [N,C]; fp32 accumulation."""
+    return jnp.mean(x, axis=(1, 2),
+                    dtype=jnp.float32).astype(x.dtype)
 
 
 def global_max_pool2d(x: jax.Array) -> jax.Array:

@@ -78,9 +78,9 @@ class Writer:
 
 def write_records(path: str, records: Iterable, chunk_records: int = 1024):
     """Write records (pickled) into chunks of chunk_records each."""
-    w = Writer(path, records_per_chunk=chunk_records)
-    for rec in records:
-        w.write(rec)
+    with Writer(path, records_per_chunk=chunk_records) as w:
+        for rec in records:
+            w.write(rec)
     return w.close()
 
 

@@ -12,6 +12,7 @@ loop only feeds data and reads back scalars (the reference crossed the SWIG
 boundary per layer call; here the boundary is once per step).
 """
 
+import math
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -111,6 +112,8 @@ class SGD:
         feeder = self._feeder(feeding)
         ks = global_key_source()
         log_period = GLOBAL_FLAGS.get("log_period", 100)
+        self._check_finite = (GLOBAL_FLAGS.get("debug_nans") or
+                              GLOBAL_FLAGS.get("debug_infs"))
 
         for pass_id in range(num_passes):
             event_handler(events.BeginPass(pass_id))
@@ -131,6 +134,12 @@ class SGD:
                 self._step += 1
                 self.evaluators.add_batch(outs)
                 cost = float(loss)
+                if self._check_finite and not math.isfinite(cost):
+                    from paddle_tpu.utils import enforce
+                    enforce.check_numerics(self.parameters.values, "param")
+                    raise enforce.EnforceError(
+                        f"non-finite cost {cost} at pass {pass_id} batch "
+                        f"{batch_id} (params are finite — check inputs/loss)")
                 if log_period and batch_id % log_period == 0:
                     logger.info("pass %d batch %d cost %.5f %s", pass_id,
                                 batch_id, cost, self.evaluators.result())

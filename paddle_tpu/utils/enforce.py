@@ -56,3 +56,33 @@ def enforce_eq(a, b, msg=""):
 def enforce_shape_match(shape_a, shape_b, msg=""):
     if tuple(shape_a) != tuple(shape_b):
         raise EnforceError(f"shape mismatch: {tuple(shape_a)} vs {tuple(shape_b)}. {msg}")
+
+
+def check_numerics(tree, name="value"):
+    """Raise EnforceError if any leaf of ``tree`` contains NaN/Inf — the
+    host-side finite tripwire (reference: the FE_* traps of TrainerMain.cpp:49
+    caught non-finite arithmetic at the instruction; here the check runs on
+    materialised arrays between steps)."""
+    import jax
+    import numpy as np
+    bad = []
+    import jax.numpy as jnp
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        # np.issubdtype is False for ml_dtypes.bfloat16 (kind 'V');
+        # jnp.issubdtype knows the extended float types
+        dt = jnp.asarray(leaf).dtype
+        if not jnp.issubdtype(dt, jnp.floating):
+            continue
+        # widen only the narrow ml_dtypes floats numpy can't isfinite()
+        # (kind 'V'); never narrow f64 (finite 1e40 would overflow in f32)
+        arr = np.asarray(leaf)
+        if arr.dtype.kind != "f":
+            arr = arr.astype(np.float32)
+        if not np.isfinite(arr).all():
+            n_nan = int(np.isnan(arr).sum())
+            n_inf = int(np.isinf(arr).sum())
+            bad.append(f"{name}{jax.tree_util.keystr(path)}: "
+                       f"{n_nan} NaN, {n_inf} Inf of {arr.size}")
+    if bad:
+        raise EnforceError("non-finite values detected:\n  " +
+                           "\n  ".join(bad))

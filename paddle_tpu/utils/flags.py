@@ -95,4 +95,11 @@ GLOBAL_FLAGS.define("enable_x64", False, "enable float64/int64 (jax_enable_x64)"
 GLOBAL_FLAGS.define("default_dtype", "float32", "parameter dtype")
 GLOBAL_FLAGS.define("compute_dtype", "bfloat16", "matmul/conv compute dtype on TPU")
 GLOBAL_FLAGS.define("profile", False, "emit jax.profiler traces around hot loops")
+GLOBAL_FLAGS.define("debug_nans", False,
+                    "trap NaNs: re-run jitted code op-by-op and raise at the "
+                    "producing op (was: feenableexcept FE_INVALID, "
+                    "TrainerMain.cpp:49)")
+GLOBAL_FLAGS.define("debug_infs", False,
+                    "trap Infs like debug_nans (was: feenableexcept "
+                    "FE_OVERFLOW|FE_DIVBYZERO)")
 GLOBAL_FLAGS.define("checkpoint_period", 0, "batches between async checkpoints (0=per pass)")

@@ -1,0 +1,252 @@
+"""Dataset modules: schema contracts, provenance labelling, split streaming,
+and the real-file parsers where a fixture can be synthesised on the fly
+(reference test strategy: python/paddle/v2/dataset/tests/*)."""
+
+import gzip
+import os
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.dataset import (cifar, common, conll05, flowers, imdb,
+                                imikolov, mnist, movielens, mq2007,
+                                sentiment, synthetic, uci_housing, voc2012,
+                                wmt14)
+
+
+def take(reader_fn, n):
+    out = []
+    for sample in reader_fn():
+        out.append(sample)
+        if len(out) >= n:
+            break
+    return out
+
+
+class TestProvenance:
+    def test_synthetic_fallbacks_are_labelled(self):
+        for reader in (mnist.train(), cifar.train10(), uci_housing.train(),
+                       imdb.train(), movielens.train(), conll05.train(),
+                       wmt14.train(), sentiment.train(), voc2012.train(),
+                       flowers.train(), mq2007.train()):
+            assert getattr(reader, "provenance", None) in (
+                "synthetic", "real")
+
+    def test_real_data_marks(self, tmp_path):
+        # fabricate a tiny idx-format MNIST cache and check provenance flips
+        old = common.DATA_HOME
+        common.DATA_HOME = str(tmp_path)
+        try:
+            d = tmp_path / "mnist"
+            d.mkdir()
+            imgs = np.random.RandomState(0).randint(
+                0, 255, (4, 28, 28), np.uint8)
+            labs = np.arange(4, dtype=np.uint8)
+            with gzip.open(d / mnist.TRAIN_IMAGES, "wb") as f:
+                f.write(struct.pack(">IIII", 2051, 4, 28, 28))
+                f.write(imgs.tobytes())
+            with gzip.open(d / mnist.TRAIN_LABELS, "wb") as f:
+                f.write(struct.pack(">II", 2049, 4))
+                f.write(labs.tobytes())
+            r = mnist.train()
+            assert r.provenance == "real"
+            samples = take(r, 4)
+            assert len(samples) == 4
+            assert samples[0][0].shape == (784,)
+            assert [s[1] for s in samples] == [0, 1, 2, 3]
+        finally:
+            common.DATA_HOME = old
+
+
+class TestSchemas:
+    def test_movielens_schema(self):
+        s = take(movielens.train(), 3)[0]
+        uid, gender, age, job, mid, cats, title, rating = s
+        assert gender in (0, 1)
+        assert 0 <= age < len(movielens.age_table)
+        assert isinstance(cats, list) and isinstance(title, list)
+        assert isinstance(rating, list) and len(rating) == 1
+        assert -5.0 <= rating[0] <= 5.0
+        assert movielens.max_user_id() >= uid
+        assert movielens.max_movie_id() >= mid
+        assert movielens.max_job_id() >= job
+
+    def test_conll05_schema(self):
+        word_d, verb_d, label_d = conll05.get_dict()
+        s = take(conll05.train(), 2)[0]
+        assert len(s) == 9
+        n = len(s[0])
+        for feat in s:
+            assert len(feat) == n
+        # ctx features are constant across the sentence
+        assert len(set(s[1])) == 1 and len(set(s[6])) == 1
+        assert set(s[7]) <= {0, 1}
+        assert all(0 <= t < len(label_d) for t in s[8])
+
+    def test_wmt14_schema(self):
+        src, trg, trg_next = take(wmt14.train(dict_size=1000), 2)[0]
+        assert trg[0] == 0                      # <s>
+        assert trg_next[-1] == 1                # <e>
+        assert trg[1:] == trg_next[:-1]
+
+    def test_sentiment_schema(self):
+        toks, lbl = take(sentiment.train(), 2)[0]
+        assert lbl in (0, 1) and all(isinstance(t, (int, np.integer))
+                                     for t in toks)
+
+    def test_voc2012_schema(self):
+        img, mask = take(voc2012.train(), 1)[0]
+        assert img.ndim == 3 and img.shape[2] == 3 and img.dtype == np.uint8
+        assert mask.shape == img.shape[:2]
+        assert mask.max() < voc2012.NUM_CLASSES
+
+    def test_flowers_schema(self):
+        x, y = take(flowers.train(), 1)[0]
+        assert x.shape == (flowers.IMG_DIM,)
+        assert 0 <= y < 102
+
+    def test_mq2007_formats(self):
+        lbl, better, worse = take(mq2007.train("pairwise"), 1)[0]
+        assert better.shape == (mq2007.FEATURE_DIM,)
+        s, v = take(mq2007.train("listwise"), 1)[0]
+        assert v.shape == (len(s), mq2007.FEATURE_DIM)
+        score, vec = take(mq2007.train("pointwise"), 1)[0]
+        assert vec.shape == (mq2007.FEATURE_DIM,)
+
+    def test_imikolov_seq_fallback_schema(self):
+        src, trg = take(imikolov.train(n=0, data_type=imikolov.DataType.SEQ),
+                        2)[0]
+        assert src[1:] == trg[:-1]
+
+    def test_imdb_word_dict_has_unk(self):
+        d = imdb.build_dict()
+        assert "<unk>" in d
+
+
+class TestRealParsers:
+    def test_wmt14_tar_roundtrip(self, tmp_path):
+        old = common.DATA_HOME
+        common.DATA_HOME = str(tmp_path)
+        try:
+            d = tmp_path / "wmt14"
+            d.mkdir()
+            root = tmp_path / "build"
+            (root / "train").mkdir(parents=True)
+            (root / "test").mkdir()
+            words = ["le", "chat", "sits", "the", "cat", "sat"]
+            (root / "src.dict").write_text(
+                "\n".join(["<s>", "<e>", "<unk>"] + words) + "\n")
+            (root / "trg.dict").write_text(
+                "\n".join(["<s>", "<e>", "<unk>"] + words) + "\n")
+            (root / "train" / "train").write_text(
+                "le chat\tthe cat\nle chat sits\tthe cat sat\n")
+            (root / "test" / "test").write_text("le\tthe\n")
+            with tarfile.open(d / wmt14.ARCHIVE, "w:gz") as tf:
+                for p in root.rglob("*"):
+                    if p.is_file():
+                        tf.add(p, arcname=str(p.relative_to(root)))
+            wmt14._dict_cache.clear()
+            r = wmt14.train(dict_size=100)
+            assert r.provenance == "real"
+            samples = list(r())
+            assert len(samples) == 2
+            src, trg, trg_next = samples[0]
+            assert src[0] == 0 and src[-1] == 1       # <s> ... <e>
+            assert trg_next[-1] == 1
+        finally:
+            wmt14._dict_cache.clear()
+            common.DATA_HOME = old
+
+    def test_mq2007_letor_parser(self, tmp_path):
+        fold = tmp_path / "mq2007" / "MQ2007" / "Fold1"
+        fold.mkdir(parents=True)
+        lines = []
+        for qid, rels in ((10, [2, 0]), (11, [1, 1, 0])):
+            for r in rels:
+                feats = " ".join(f"{k}:{0.1*k}" for k in range(1, 47))
+                lines.append(f"{r} qid:{qid} {feats} #docid x")
+        (fold / "train.txt").write_text("\n".join(lines) + "\n")
+        old = common.DATA_HOME
+        common.DATA_HOME = str(tmp_path)
+        try:
+            r = mq2007.train("listwise")
+            assert r.provenance == "real"
+            qs = list(r())
+            assert len(qs) == 2
+            assert len(qs[0][0]) == 2 and len(qs[1][0]) == 3
+            # pairwise emits only score-ordered pairs
+            pairs = list(mq2007.train("pairwise")())
+            assert len(pairs) == 1 + 2       # (2>0), (1>0)x2
+        finally:
+            common.DATA_HOME = old
+
+
+class TestCommonHelpers:
+    def test_split_streams(self):
+        chunks = list(common.split(lambda: iter(range(10)), 4))
+        assert chunks == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
+
+    def test_split_to_recordio_requires_slot(self, tmp_path):
+        with pytest.raises(ValueError, match="slot"):
+            common.split_to_recordio(lambda: iter(range(4)),
+                                     str(tmp_path / "out.rio"))
+
+    def test_split_to_recordio(self, tmp_path):
+        from paddle_tpu.runtime import recordio
+        paths = common.split_to_recordio(
+            lambda: iter(range(10)), str(tmp_path / "c-%d.rio"),
+            line_count=4)
+        assert len(paths) == 3
+        got = [r for p in paths for r in recordio.read_records(p)]
+        assert got == list(range(10))
+
+
+class TestTripwires:
+    def test_check_numerics_catches_bf16_nan(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.utils import enforce
+        bad = {"w": jnp.asarray([1.0, float("nan")], jnp.bfloat16)}
+        with pytest.raises(enforce.EnforceError, match="NaN"):
+            enforce.check_numerics(bad, "param")
+        enforce.check_numerics({"w": jnp.ones(3, jnp.bfloat16)})
+
+    def test_init_debug_nans_sets_jax_config(self):
+        import jax
+
+        import paddle_tpu as paddle
+        from paddle_tpu.utils.flags import GLOBAL_FLAGS
+        try:
+            paddle.init(debug_nans=True)
+            assert jax.config.jax_debug_nans
+        finally:
+            jax.config.update("jax_debug_nans", False)
+            GLOBAL_FLAGS.set("debug_nans", False)
+
+    def test_trainer_raises_on_nan_cost(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import layer
+        from paddle_tpu.utils import enforce
+        from paddle_tpu.utils.flags import GLOBAL_FLAGS
+        from paddle_tpu.utils.rng import KeySource
+
+        x = layer.data("x", paddle.data_type.dense_vector(4))
+        lbl = layer.data("lbl", paddle.data_type.integer_value(2))
+        out = layer.fc(x, 2, act=paddle.activation.Softmax(), name="tw_out")
+        cost = layer.classification_cost(out, lbl, name="tw_cost")
+        params = paddle.parameters.create(cost, KeySource(0))
+        tr = paddle.trainer.SGD(cost=cost, parameters=params,
+                                update_equation=paddle.optimizer.Momentum(
+                                    learning_rate=0.1))
+
+        def reader():
+            yield [np.array([np.inf, 1, 1, 1], np.float32), 0]
+
+        GLOBAL_FLAGS.set("debug_infs", True)
+        try:
+            with pytest.raises(enforce.EnforceError, match="non-finite"):
+                tr.train(reader=paddle.batch(reader, 1), num_passes=1)
+        finally:
+            GLOBAL_FLAGS.set("debug_infs", False)

@@ -35,6 +35,14 @@ def test_conv_bf16_grad(bf16_compute, rng):
     x = jnp.asarray(rng.randn(2, 8, 8, 3).astype(np.float32))
     w = jnp.asarray(rng.randn(3, 3, 3, 4).astype(np.float32))
     out = conv.conv2d(x, w, padding="SAME")
-    assert out.dtype == jnp.float32
-    g = jax.jit(jax.grad(lambda a, b: conv.conv2d(a, b).sum(), argnums=(0, 1)))(x, w)
+    # activations stay in the compute dtype between ops (HBM-traffic policy,
+    # see ops/conv.py); fp32 master weights still get fp32 grads
+    assert out.dtype == jnp.bfloat16
+    g = jax.jit(jax.grad(
+        lambda a, b: conv.conv2d(a, b).astype(jnp.float32).sum(),
+        argnums=(0, 1)))(x, w)
     assert g[0].dtype == jnp.float32 and g[1].dtype == jnp.float32
+    ref = conv.conv2d(x.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+                      padding="SAME")
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), rtol=1e-6)
