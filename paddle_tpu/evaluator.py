@@ -148,6 +148,52 @@ def sum_cost(input, name: Optional[str] = None):
                             lambda t: t[0] / max(t[1], 1), 2)
 
 
+def positive_negative_pair(input, label, query_id,
+                           name: Optional[str] = None, weight=None):
+    """Pos/neg pair ordering ratio for ranking (reference: PnpairEvaluator,
+    Evaluator.cpp:932-960 — within each query, pairs with differing labels
+    count pos if the higher-labelled sample scores strictly higher, neg if
+    strictly lower, spe on score ties; pair weight = mean of sample
+    weights). Accumulables: [pos, neg, spe]. Pairs are counted within each
+    minibatch, so keep a query's samples in one batch (the reference
+    accumulated the whole pass on host — an O(N^2) host sort; in-graph
+    batch-local counting is the TPU-friendly form)."""
+    name = name or auto_name("pnpair_evaluator")
+    inputs = [input, label, query_id] + ([weight] if weight else [])
+
+    def accum(params, parents, ctx):
+        score = parents[0].array.reshape(-1).astype(jnp.float32)
+        lab = parents[1].array.reshape(-1).astype(jnp.int32)
+        qid = parents[2].array.reshape(-1).astype(jnp.int32)
+        w = (parents[3].array.reshape(-1).astype(jnp.float32)
+             if len(parents) > 3 else jnp.ones_like(score))
+        same_q = qid[:, None] == qid[None, :]
+        diff_lab = lab[:, None] != lab[None, :]
+        upper = (jnp.arange(score.shape[0])[:, None] <
+                 jnp.arange(score.shape[0])[None, :])
+        pair_w = (w[:, None] + w[None, :]) * 0.5
+        consider = same_q & diff_lab & upper
+        hi = (score[:, None] > score[None, :]) & (lab[:, None] > lab[None, :])
+        lo = (score[:, None] < score[None, :]) & (lab[:, None] < lab[None, :])
+        correct = hi | lo
+        wrong = ((score[:, None] > score[None, :]) &
+                 (lab[:, None] < lab[None, :])) | \
+                ((score[:, None] < score[None, :]) &
+                 (lab[:, None] > lab[None, :]))
+        tie = ~(correct | wrong)
+        pos = jnp.sum(jnp.where(consider & correct, pair_w, 0.0))
+        neg = jnp.sum(jnp.where(consider & wrong, pair_w, 0.0))
+        spe = jnp.sum(jnp.where(consider & tie, pair_w, 0.0))
+        return jnp.stack([pos, neg, spe])
+
+    def fin(t):
+        pos, neg, spe = t
+        return {"pos": pos, "neg": neg, "spe": spe,
+                "ratio": pos / max(neg, 1e-12)}
+
+    return _evaluator_layer(name, "pnpair", inputs, accum, fin, 3)
+
+
 class EvaluatorSet:
     """Host-side bundle the trainer drives (reset per pass / per test)."""
 

@@ -6,14 +6,27 @@ checksum + etcd meta (go/pserver/service.go:119-174).
 
 TPU-native: one directory per checkpoint holding a numpy .npz per pytree
 (params / optimizer state / model state) + a JSON manifest with step counter
-and a content checksum (the Go pserver's integrity scheme). Async-friendly:
-arrays are pulled to host once, written atomically via tempfile+rename.
+and a content checksum (the Go pserver's integrity scheme), written
+atomically via tempdir+rename. Checksums are computed in bounded-memory
+chunks. Two scaling paths:
+
+- ``AsyncCheckpointer`` — snapshots to host synchronously (bounded by one
+  device→host copy) and does serialization/checksum/IO/pruning on a worker
+  thread, so training never waits on disk (the orbax-style async slot; the
+  reference's pserver checkpoints were also written off the serving path,
+  go/pserver/service.go:119).
+- ``save_checkpoint(..., process_index/process_count)`` — multi-host layout:
+  each process writes only its addressable shards to its own npz
+  (``params.p{K}.npz``); load merges every process file present. Shard
+  overlap is fine (replicated arrays): last writer wins on identical data.
 """
 
 import hashlib
 import json
 import os
+import queue
 import tempfile
+import threading
 from typing import Dict, Optional
 
 import jax.numpy as jnp
@@ -46,35 +59,117 @@ def _unflatten_into(flat: Dict[str, np.ndarray], tree):
     return build(tree, "")
 
 
-def save_checkpoint(save_dir: str, step: int, params: Dict,
-                    opt_state=None, model_state=None, keep: int = 3):
-    """Write checkpoint 'pass-%05d' style dir; prunes old ones."""
+def _file_md5(path):
+    """Chunked digest — npz writing seeks (zip headers), so a write-through
+    hash cannot work; a 1MB-chunk re-read keeps memory bounded (the old
+    path read whole files into memory)."""
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _local_shards(arr):
+    """[(index_tuple_of_slices, np_shard)] for this process's addressable
+    shards; a single [(None, full_array)] for unsharded/numpy values."""
+    shards = getattr(arr, "addressable_shards", None)
+    if shards is None or len(shards) <= 1:
+        return [(None, np.asarray(arr))]
+    seen, out = set(), []
+    for s in shards:
+        key = tuple((sl.start, sl.stop, sl.step) for sl in s.index)
+        if key in seen:
+            continue            # replicated across devices: write once
+        seen.add(key)
+        out.append((s.index, np.asarray(s.data)))
+    return out
+
+
+def _write_tree(tmp, fname, tree, manifest, sharded, host_trees=None):
+    flat = host_trees[fname] if host_trees else _flatten(tree)
+    path = os.path.join(tmp, fname + ".npz")
+    entries, index_meta = {}, {}
+    for key, arr in flat.items():
+        if not sharded:
+            entries[key] = np.asarray(arr)
+            continue
+        for i, (idx, shard) in enumerate(_local_shards(arr)):
+            if idx is None:
+                entries[key] = np.asarray(shard)
+            else:
+                entries[f"{key}@@{i}"] = shard
+                index_meta.setdefault(key, {})[str(i)] = [
+                    [sl.start, sl.stop] for sl in idx]
+    with open(path, "wb") as raw:
+        np.savez(raw, **entries)
+    manifest["files"][fname] = _file_md5(path)
+    if index_meta:
+        manifest.setdefault("shards", {})[fname] = {
+            "full_shapes": {k: list(np.shape(v)) for k, v in flat.items()},
+            "index": index_meta}
+
+
+def _prune_old(save_dir, keep):
+    import shutil
+    kept = sorted(d for d in os.listdir(save_dir) if d.startswith("ckpt-"))
+    for d in kept[:-keep]:
+        shutil.rmtree(os.path.join(save_dir, d), ignore_errors=True)
+
+
+def _write_single(save_dir, step, trees, keep, host_trees=None,
+                  sharded=False, process_index=0, process_count=1):
+    """Shared atomic-write core for save_checkpoint and AsyncCheckpointer.
+    ``trees``: {fname: pytree} (ignored per-entry when host_trees carries
+    the pre-flattened host copy)."""
     name = f"ckpt-{step:08d}"
     final = os.path.join(save_dir, name)
     os.makedirs(save_dir, exist_ok=True)
-    tmp = tempfile.mkdtemp(dir=save_dir, prefix=".tmp-" + name)
-    manifest = {"step": int(step), "files": {}}
-    for fname, tree in (("params", params), ("opt_state", opt_state),
-                        ("model_state", model_state)):
-        if tree is None:
+    suffix = f".p{process_index}" if process_count > 1 else ""
+    tmp = tempfile.mkdtemp(dir=save_dir, prefix=".tmp-" + name + suffix)
+    manifest = {"step": int(step), "files": {},
+                "process_index": process_index,
+                "process_count": process_count}
+    for base, tree in trees.items():
+        if tree is None and not (host_trees and base in host_trees):
             continue
-        flat = _flatten(tree)
-        path = os.path.join(tmp, fname + ".npz")
-        np.savez(path, **flat)
-        with open(path, "rb") as f:
-            manifest["files"][fname] = hashlib.md5(f.read()).hexdigest()
-    with open(os.path.join(tmp, "manifest.json"), "w") as f:
+        _write_tree(tmp, base + suffix, tree, manifest, sharded,
+                    host_trees={base + suffix: host_trees[base]}
+                    if host_trees else None)
+    with open(os.path.join(tmp, f"manifest{suffix}.json"), "w") as f:
         json.dump(manifest, f)
-    if os.path.exists(final):
+    if process_count > 1:
+        # multi-host: move our files into the shared dir; process 0 owns
+        # directory lifecycle, others only add their piece
+        os.makedirs(final, exist_ok=True)
+        for fn in os.listdir(tmp):
+            os.replace(os.path.join(tmp, fn), os.path.join(final, fn))
+        os.rmdir(tmp)
+    else:
         import shutil
-        shutil.rmtree(final)
-    os.rename(tmp, final)
-    # prune
-    kept = sorted(d for d in os.listdir(save_dir) if d.startswith("ckpt-"))
-    for d in kept[:-keep]:
-        import shutil
-        shutil.rmtree(os.path.join(save_dir, d), ignore_errors=True)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+    if process_index == 0:
+        _prune_old(save_dir, keep)
     return final
+
+
+def save_checkpoint(save_dir: str, step: int, params: Dict,
+                    opt_state=None, model_state=None, keep: int = 3,
+                    process_index: int = 0, process_count: int = 1,
+                    sharded: bool = False):
+    """Write checkpoint 'pass-%05d' style dir; prunes old ones.
+
+    With ``sharded=True`` (or process_count>1) each array entry stores this
+    process's addressable shards plus their index metadata — the multi-host
+    layout where every host writes only what it owns."""
+    return _write_single(
+        save_dir, step,
+        {"params": params, "opt_state": opt_state,
+         "model_state": model_state},
+        keep, sharded=sharded or process_count > 1,
+        process_index=process_index, process_count=process_count)
 
 
 def latest_checkpoint(save_dir: str) -> Optional[str]:
@@ -84,23 +179,130 @@ def latest_checkpoint(save_dir: str) -> Optional[str]:
     return os.path.join(save_dir, cks[-1]) if cks else None
 
 
+def _verify_file(fpath, want):
+    if _file_md5(fpath) != want:
+        raise IOError(f"checkpoint checksum mismatch: {fpath}")
+
+
+def _load_group(path, base, manifests, verify):
+    """Merge a logical tree ('params') across every process file present,
+    reassembling sharded entries from their index metadata."""
+    flat, pending = {}, {}
+    for manifest in manifests:
+        suffix = (f".p{manifest['process_index']}"
+                  if manifest.get("process_count", 1) > 1 else "")
+        fname = base + suffix
+        if fname not in manifest["files"]:
+            return None
+        fpath = os.path.join(path, fname + ".npz")
+        if verify:
+            _verify_file(fpath, manifest["files"][fname])
+        data = dict(np.load(fpath))
+        shard_meta = manifest.get("shards", {}).get(fname, {})
+        index = shard_meta.get("index", {})
+        shapes = shard_meta.get("full_shapes", {})
+        for key, arr in data.items():
+            if "@@" not in key:
+                flat[key] = arr
+                continue
+            base_key, i = key.rsplit("@@", 1)
+            buf = pending.get(base_key)
+            if buf is None:
+                buf = pending[base_key] = np.zeros(
+                    shapes[base_key], arr.dtype)
+            slices = tuple(slice(a, b) for a, b in index[base_key][i])
+            buf[slices] = arr
+    flat.update(pending)
+    return flat
+
+
 def load_checkpoint(path: str, params: Dict, opt_state=None, model_state=None,
                     verify: bool = True):
     """Load into the *structure* of the given pytrees; returns
-    (step, params, opt_state, model_state)."""
-    with open(os.path.join(path, "manifest.json")) as f:
-        manifest = json.load(f)
+    (step, params, opt_state, model_state). Handles both single-process
+    checkpoints and the multi-host per-process shard layout (merges every
+    manifest*.json present)."""
+    manifests = []
+    for fn in sorted(os.listdir(path)):
+        if fn.startswith("manifest") and fn.endswith(".json"):
+            with open(os.path.join(path, fn)) as f:
+                manifests.append(json.load(f))
+    if not manifests:
+        raise IOError(f"no manifest in checkpoint dir {path}")
+    # a partial multi-host checkpoint (a host died mid-save) must not load:
+    # _load_group would silently zero-fill the missing hosts' shards
+    want = max(m.get("process_count", 1) for m in manifests)
+    have = sorted(m.get("process_index", 0) for m in manifests)
+    if have != list(range(want)):
+        raise IOError(
+            f"incomplete checkpoint {path}: have manifests for processes "
+            f"{have} of {want} — a host's save did not finish")
     out = []
-    for fname, tree in (("params", params), ("opt_state", opt_state),
-                        ("model_state", model_state)):
-        if tree is None or fname not in manifest["files"]:
+    for base, tree in (("params", params), ("opt_state", opt_state),
+                       ("model_state", model_state)):
+        if tree is None:
             out.append(tree)
             continue
-        fpath = os.path.join(path, fname + ".npz")
-        if verify:
-            with open(fpath, "rb") as f:
-                if hashlib.md5(f.read()).hexdigest() != manifest["files"][fname]:
-                    raise IOError(f"checkpoint checksum mismatch: {fpath}")
-        flat = dict(np.load(fpath))
-        out.append(_unflatten_into(flat, tree))
-    return (manifest["step"], *out)
+        flat = _load_group(path, base, manifests, verify)
+        out.append(_unflatten_into(flat, tree) if flat is not None else tree)
+    return (manifests[0]["step"], *out)
+
+
+class AsyncCheckpointer:
+    """Asynchronous checkpoint writer.
+
+    ``save()`` snapshots the pytrees to host (one blocking device→host
+    copy — unavoidable with donated buffers: the next step reuses the
+    device memory) and enqueues serialization + checksum + disk IO +
+    pruning on a worker thread. Training resumes immediately; call
+    ``wait()`` before reading the directory or exiting."""
+
+    def __init__(self, save_dir: str, keep: int = 3, max_pending: int = 2):
+        self.save_dir = save_dir
+        self.keep = keep
+        self._q = queue.Queue(maxsize=max_pending)
+        self._err = None
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            step, host_trees = item
+            try:
+                self._write(step, host_trees)
+            except Exception as e:  # surfaced on next save()/wait()
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def _write(self, step, host_trees):
+        _write_single(self.save_dir, step,
+                      {base: None for base in host_trees}, self.keep,
+                      host_trees=host_trees)
+
+    def save(self, step: int, params: Dict, opt_state=None,
+             model_state=None):
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+        host_trees = {}
+        for fname, tree in (("params", params), ("opt_state", opt_state),
+                            ("model_state", model_state)):
+            if tree is not None:
+                host_trees[fname] = {k: np.asarray(v)
+                                     for k, v in _flatten(tree).items()}
+        self._q.put((int(step), host_trees))
+
+    def wait(self):
+        self._q.join()
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def close(self):
+        self.wait()
+        self._q.put(None)
+        self._worker.join(timeout=10)

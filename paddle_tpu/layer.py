@@ -2141,6 +2141,256 @@ def roi_pool(input, rois, pooled_width: int, pooled_height: int,
                        size=pooled_width * pooled_height * c)
 
 
+# ---------------------------------------------------------------------------
+# parity tail: lookahead/row conv, data norm, featmap expand, MDLSTM,
+# remaining cost layers (reference: RowConvLayer.cpp, DataNormLayer.cpp,
+# FeatureMapExpandLayer.cpp, MDLstmLayer.cpp, CostLayer.cpp)
+# ---------------------------------------------------------------------------
+
+def row_conv(input, context_len: int, act=None, name: Optional[str] = None,
+             param_attr=None):
+    """Lookahead (row) convolution over a sequence — DeepSpeech2's future
+    context without full bidirectionality (reference: row_conv_layer,
+    gserver/layers/RowConvLayer.cpp, paddle/function/RowConvOp.cpp):
+    out[t] = sum_k x[t+k] * w[k], per-feature weights [context_len, d]."""
+    name = name or auto_name("row_conv")
+    act_name = act_mod.resolve(act)
+    attr = _param_attr(param_attr if isinstance(param_attr, ParamAttr)
+                       else ParamAttr(), f"{name}.w")
+    w_spec = ParamSpec(attr.name, (context_len, input.size), attr=attr,
+                       fan_in=context_len)
+
+    def fwd(params, parents, ctx):
+        pv = parents[0]
+        enforce.enforce(pv.is_sequence, "row_conv needs sequence input")
+        out = ops_seq.row_conv(pv.array, pv.lengths, params[w_spec.name])
+        return _apply_act(Value(out, pv.lengths, pv.sub_lengths), act_name)
+
+    return LayerOutput(name, "row_conv", [input], fwd, [w_spec],
+                       size=input.size, activation=act_name)
+
+
+row_conv_layer = row_conv
+
+
+def data_norm(input, strategy: str = "z-score", name: Optional[str] = None):
+    """Normalise dense input by dataset statistics (reference:
+    data_norm_layer, gserver/layers/DataNormLayer.cpp — strategies z-score
+    (x-mean)/std, min-max (x-min)/(max-min), decimal-scaling x/10^j). The
+    statistics live in non-learned parameters '<name>.mean/.std/.min/.max/
+    .decimal' — set them from your data via parameters.set()."""
+    name = name or auto_name("data_norm")
+    enforce.enforce(strategy in ("z-score", "min-max", "decimal-scaling"),
+                    f"unknown data_norm strategy {strategy!r}")
+    d = input.size
+
+    def const(suffix, value):
+        return ParamSpec(
+            f"{name}.{suffix}", (d,),
+            attr=ParamAttr(initializer="constant", initial_value=value,
+                           is_static=True))
+
+    mean_s, std_s = const("mean", 0.0), const("std", 1.0)
+    min_s, max_s = const("min", 0.0), const("max", 1.0)
+    dec_s = const("decimal", 1.0)
+
+    def fn(params, parents, ctx):
+        x = parents[0].array
+        xf = x.astype(jnp.float32)
+        if strategy == "z-score":
+            out = (xf - params[mean_s.name]) / jnp.maximum(
+                params[std_s.name], 1e-8)
+        elif strategy == "min-max":
+            out = (xf - params[min_s.name]) / jnp.maximum(
+                params[max_s.name] - params[min_s.name], 1e-8)
+        else:
+            out = xf / jnp.maximum(params[dec_s.name], 1e-8)
+        return out.astype(x.dtype)
+
+    return _simple_layer(name, "data_norm", [input], fn, d,
+                         specs=[mean_s, std_s, min_s, max_s, dec_s])
+
+
+def featmap_expand(input, num_filters: int, as_row_vector: bool = True,
+                   name: Optional[str] = None):
+    """Replicate each sample's feature row into num_filters channels
+    (reference: featmap_expand, FeatureMapExpandLayer.cpp:22-37 —
+    y.row[i] = x tiled num_filters times; as_col_vec repeats elementwise)."""
+    name = name or auto_name("featmap_expand")
+
+    def fn(params, parents, ctx):
+        x = parents[0].array
+        flat = x.reshape(x.shape[0], -1)
+        if as_row_vector:
+            return jnp.tile(flat, (1, num_filters))
+        return jnp.repeat(flat, num_filters, axis=-1)
+
+    lo = _simple_layer(name, "featmap_expand", [input], fn,
+                       input.size * num_filters)
+    lo._out_channels = num_filters
+    return lo
+
+
+def mdlstmemory(input, size: int, shape=None, name: Optional[str] = None,
+                reverse_x: bool = False, reverse_y: bool = False,
+                param_attr=None, bias_attr=True):
+    """Multi-dimensional (2-D) LSTM over a feature map (reference:
+    mdlstmemory, gserver/layers/MDLstmLayer.cpp — Graves MDLSTM; five gates
+    with one forget gate per spatial dimension). ``shape``=(C, H, W) of the
+    input when it cannot be inferred; output keeps the (size, H, W) map
+    flattened channel-major like the conv layers."""
+    name = name or auto_name("mdlstm")
+    if shape is not None:
+        cin, ih, iw = shape
+    else:
+        cin, ih, iw = _img_in_shape(input)
+    a = _param_attr(param_attr if isinstance(param_attr, ParamAttr)
+                    else ParamAttr(), f"{name}.w")
+    w_ih = ParamSpec(f"{name}.w_ih", (cin, 5 * size), attr=a, fan_in=cin)
+    w_hx = ParamSpec(f"{name}.w_hx", (size, 5 * size),
+                     attr=_param_attr(ParamAttr(), f"{name}.w_hx"),
+                     fan_in=size)
+    w_hy = ParamSpec(f"{name}.w_hy", (size, 5 * size),
+                     attr=_param_attr(ParamAttr(), f"{name}.w_hy"),
+                     fan_in=size)
+    bias = _bias_spec(name, 5 * size, bias_attr)
+    specs = [w_ih, w_hx, w_hy] + ([bias] if bias else [])
+
+    def fwd(params, parents, ctx):
+        x = _to_nhwc(parents[0].array, cin, ih, iw)
+        out = ops_rnn.mdlstm(
+            x, params[w_ih.name], params[w_hx.name], params[w_hy.name],
+            params[bias.name] if bias else None,
+            reverse_x=reverse_x, reverse_y=reverse_y)
+        return Value(out)
+
+    lo = LayerOutput(name, "mdlstm", [input], fwd, specs,
+                     size=size * ih * iw)
+    lo._out_channels = size
+    lo._img_shape = (ih, iw)
+    return lo
+
+
+def img_conv3d_transpose(input, filter_size, num_filters: int, shape,
+                         num_channels: Optional[int] = None, stride=1,
+                         act=None, name: Optional[str] = None,
+                         param_attr=None, bias_attr=None):
+    """3-D transposed convolution over DHW volumes; ``shape``=(C, D, H, W)
+    of the input (reference: deconv3d, gserver/layers/Conv3DLayer.cpp
+    DeConv3DLayer; conv3d_transpose via conv_transpose_op.cc). SAME
+    padding: output spatial dims = input dims * stride."""
+    name = name or auto_name("deconv3d")
+    act_name = act_mod.resolve(act)
+    cin, d, h, w = shape
+    cin = num_channels or cin
+    k = (filter_size,) * 3 if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    attr = _param_attr(param_attr if isinstance(param_attr, ParamAttr)
+                       else ParamAttr(), f"{name}.w")
+    w_spec = ParamSpec(attr.name, k + (cin, num_filters), attr=attr,
+                       fan_in=cin * k[0] * k[1] * k[2])
+    bias = _bias_spec(name, num_filters, bias_attr)
+    specs = [w_spec] + ([bias] if bias else [])
+    od, oh, ow = d * s[0], h * s[1], w * s[2]
+
+    def fwd(params, parents, ctx):
+        x = parents[0].array
+        if x.ndim == 2:
+            x = x.reshape(x.shape[0], cin, d, h, w)
+            x = jnp.transpose(x, (0, 2, 3, 4, 1))     # NDHWC
+        out = jax.lax.conv_transpose(
+            x, params[w_spec.name].astype(x.dtype), strides=s,
+            padding="SAME", dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if bias:
+            out = out + params[bias.name].astype(out.dtype)
+        out = jnp.transpose(out, (0, 4, 1, 2, 3)).reshape(out.shape[0], -1)
+        return _apply_act(Value(out), act_name)
+
+    lo = LayerOutput(name, "deconv3d", [input], fwd, specs,
+                     size=num_filters * od * oh * ow, activation=act_name)
+    lo.shape3d = (num_filters, od, oh, ow)
+    return lo
+
+
+def huber_regression_cost(input, label, delta: float = 1.0,
+                          name: Optional[str] = None):
+    """(reference: huber_regression_cost, HuberRegressionLoss)"""
+    name = name or auto_name("huber_regression")
+
+    def per_example(params, parents, ctx):
+        return ops_loss.huber_regression(parents[0].array,
+                                         parents[1].array, delta)
+
+    return _cost_layer(name, "huber_regression", [input, label], per_example)
+
+
+def smooth_l1_cost(input, label, name: Optional[str] = None):
+    """(reference: smooth_l1_cost, SmoothL1CostLayer; smooth_l1_loss_op.cc)"""
+    name = name or auto_name("smooth_l1")
+
+    def per_example(params, parents, ctx):
+        return ops_loss.smooth_l1(parents[0].array, parents[1].array)
+
+    return _cost_layer(name, "smooth_l1", [input, label], per_example)
+
+
+def soft_binary_class_cross_entropy(input, label, name: Optional[str] = None):
+    """Per-dim binary CE with soft (probability) labels (reference:
+    soft_binary_class_cross_entropy, SoftBinaryClassCrossEntropy)."""
+    name = name or auto_name("soft_binary_ce")
+
+    def per_example(params, parents, ctx):
+        return ops_loss.multi_binary_cross_entropy(parents[0].array,
+                                                   parents[1].array)
+
+    return _cost_layer(name, "soft_binary_ce", [input, label], per_example)
+
+
+def cross_entropy_with_selfnorm(input, label, alpha: float = 0.1,
+                                name: Optional[str] = None):
+    """CE plus alpha*log(Z)^2 self-normalisation (reference:
+    cross_entropy_with_selfnorm, CostLayer.cpp:105 — trains the softmax
+    partition function toward 1 so inference can skip normalisation).
+    Needs the input layer's logits (softmax activation keeps them)."""
+    name = name or auto_name("ce_selfnorm")
+
+    def per_example(params, parents, ctx):
+        pv, lv = parents
+        logits = pv.pre_act if pv.pre_act is not None else pv.array
+        return ops_loss.cross_entropy_with_selfnorm(
+            logits, lv.array.reshape(-1), alpha)
+
+    return _cost_layer(name, "ce_selfnorm", [input, label], per_example)
+
+
+def sum_cost_layer(input, name: Optional[str] = None):
+    """Cost = sum of the input row (reference: sum_cost, SumCostLayer)."""
+    name = name or auto_name("sum_cost")
+
+    def per_example(params, parents, ctx):
+        return jnp.sum(parents[0].array.astype(jnp.float32), axis=-1)
+
+    return _cost_layer(name, "sum_cost", [input], per_example)
+
+
+def lambda_cost(input, score, ndcg_num: int = 5,
+                name: Optional[str] = None):
+    """LambdaRank NDCG cost over each query sequence (reference:
+    lambda_cost, gserver CostLayer.h:252 LambdaCost). ``input`` is the
+    model score sequence, ``score`` the relevance sequence."""
+    name = name or auto_name("lambda_cost")
+
+    def per_example(params, parents, ctx):
+        pv, rv = parents
+        enforce.enforce(pv.is_sequence, "lambda_cost needs sequence input")
+        s = pv.array[..., 0] if pv.array.ndim == 3 else pv.array
+        r = rv.array[..., 0] if rv.array.ndim == 3 else rv.array
+        return ops_loss.lambda_rank(s, r, pv.lengths, ndcg_num)
+
+    return _cost_layer(name, "lambda_cost", [input, score], per_example)
+
+
 # install call recording over this module's public API so built graphs are
 # serializable (Topology.to_dict/from_dict — the program save format)
 def _install_recording():

@@ -78,3 +78,64 @@ def rank_cost(left: jax.Array, right: jax.Array, label: jax.Array) -> jax.Array:
     C = -o*label + log(1+exp(o)), o = left - right."""
     o = (left - right).astype(jnp.float32).squeeze(-1)
     return jax.nn.softplus(o) - o * label.astype(jnp.float32)
+
+
+def huber_regression(pred: jax.Array, target: jax.Array,
+                     delta: float = 1.0) -> jax.Array:
+    """Classic Huber regression loss summed over output dims (reference:
+    HuberRegressionLoss, gserver CostLayer.cpp; huber_loss_op.cc)."""
+    a = jnp.abs((pred - target).astype(jnp.float32))
+    per_dim = jnp.where(a <= delta, 0.5 * jnp.square(a),
+                        delta * (a - 0.5 * delta))
+    return jnp.sum(per_dim, axis=-1)
+
+
+def cross_entropy_with_selfnorm(logits: jax.Array, labels: jax.Array,
+                                alpha: float = 0.1) -> jax.Array:
+    """CE + alpha * log(Z)^2 self-normalisation penalty (reference:
+    MultiClassCrossEntropyWithSelfNorm, CostLayer.cpp:105-141 — drives the
+    softmax partition function toward 1 so serving can skip the
+    normalisation)."""
+    lf = logits.astype(jnp.float32)
+    log_z = jax.nn.logsumexp(lf, axis=-1)
+    ce = log_z - jnp.take_along_axis(
+        lf, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return ce + alpha * jnp.square(log_z)
+
+
+def lambda_rank(scores: jax.Array, relevance: jax.Array, lengths: jax.Array,
+                ndcg_num: int = 5) -> jax.Array:
+    """LambdaRank NDCG cost per query (reference: LambdaCost,
+    gserver CostLayer.h:252 — lambda gradients weighted by |ΔNDCG|).
+
+    scores/relevance: [B, T] padded query lists; returns [B] costs. Each
+    mis-ordered pair contributes its RankNet logistic loss weighted by the
+    (stop-gradient) |ΔNDCG| of swapping the pair at the current ranking,
+    truncated at ndcg_num as the reference truncates."""
+    b, t = scores.shape
+    mask = jnp.arange(t)[None, :] < lengths[:, None]
+    s = jnp.where(mask, scores.astype(jnp.float32), -jnp.inf)
+    rel = jnp.where(mask, relevance.astype(jnp.float32), 0.0)
+    # current rank of each item (0-based) under the model's scores
+    order = jnp.argsort(-s, axis=1)
+    ranks = jnp.argsort(order, axis=1).astype(jnp.float32)
+    disc = jnp.where(ranks < ndcg_num, 1.0 / jnp.log2(ranks + 2.0), 0.0)
+    gain = (jnp.exp2(rel) - 1.0) * mask
+    # ideal DCG normaliser from the relevance-sorted list
+    rel_best = -jnp.sort(-rel, axis=1)
+    pos = jnp.arange(t, dtype=jnp.float32)[None, :]
+    ideal_disc = jnp.where((pos < ndcg_num) & (pos < lengths[:, None]),
+                           1.0 / jnp.log2(pos + 2.0), 0.0)
+    idcg = jnp.sum((jnp.exp2(rel_best) - 1.0) * ideal_disc, axis=1)
+    idcg = jnp.maximum(idcg, 1e-8)
+    # pairwise |ΔNDCG| of swapping i and j at the current ranking
+    dgain = gain[:, :, None] - gain[:, None, :]
+    ddisc = disc[:, :, None] - disc[:, None, :]
+    delta = jnp.abs(dgain * ddisc) / idcg[:, None, None]
+    valid = mask[:, :, None] & mask[:, None, :]
+    better = (rel[:, :, None] > rel[:, None, :]) & valid
+    diff = s[:, :, None] - s[:, None, :]
+    diff = jnp.where(valid, diff, 0.0)
+    pair_loss = jax.nn.softplus(-diff)
+    w = jax.lax.stop_gradient(jnp.where(better, delta, 0.0))
+    return jnp.sum(w * pair_loss, axis=(1, 2))

@@ -166,3 +166,77 @@ def simple_rnn(x: jax.Array, lengths: jax.Array, w_ih: Optional[jax.Array],
         outs = outs[::-1]
     outs = jnp.moveaxis(outs, 0, 1)
     return outs * mask[..., None].astype(outs.dtype), final
+
+
+class MDLSTMState(NamedTuple):
+    h: jax.Array
+    c: jax.Array
+
+
+def mdlstm_cell(x_proj: jax.Array, left: MDLSTMState, up: MDLSTMState,
+                w_hx: jax.Array, w_hy: jax.Array) -> MDLSTMState:
+    """One 2-D LSTM step (reference: gserver/layers/MDLstmLayer.cpp —
+    multi-dimensional LSTM, Graves et al.). Five gates packed as
+    (i, f_x, f_y, g, o): the cell takes TWO predecessor states, one per
+    spatial dimension, each with its own forget gate:
+
+        c = i*g + f_x*c_left + f_y*c_up;  h = o * tanh(c)
+
+    x_proj: [b, 5H] precomputed x@W_ih (+bias)."""
+    gates = x_proj + matmul(left.h, w_hx) + matmul(up.h, w_hy)
+    i, fx, fy, g, o = jnp.split(gates.astype(jnp.float32), 5, axis=-1)
+    i = jax.nn.sigmoid(i)
+    fx = jax.nn.sigmoid(fx)
+    fy = jax.nn.sigmoid(fy)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c = i * g + fx * left.c.astype(jnp.float32) + fy * up.c.astype(jnp.float32)
+    h = o * jnp.tanh(c)
+    return MDLSTMState(h.astype(left.h.dtype), c.astype(left.c.dtype))
+
+
+def mdlstm(x: jax.Array, w_ih: jax.Array, w_hx: jax.Array, w_hy: jax.Array,
+           b: Optional[jax.Array] = None, *, reverse_x: bool = False,
+           reverse_y: bool = False) -> jax.Array:
+    """2-D multi-dimensional LSTM over a feature map.
+
+    x: [N, H, W, C]; w_ih: [C, 5D]; w_hx/w_hy: [D, 5D] (left/up recurrent
+    weights). Returns hidden maps [N, H, W, D]. Scans rows with an inner
+    column scan — the j-th cell of row i sees h[i][j-1] (left) and
+    h[i-1][j] (up), the MDLstmLayer recurrence. reverse_x/_y flip the scan
+    direction per dimension (the layer's 4-direction variants compose from
+    flips)."""
+    n, hh, ww, _ = x.shape
+    d = w_hx.shape[0]
+    xp = matmul(x.reshape(n * hh * ww, -1), w_ih).reshape(n, hh, ww, 5 * d)
+    if b is not None:
+        xp = xp + b.astype(xp.dtype)
+    if reverse_y:
+        xp = xp[:, ::-1]
+    if reverse_x:
+        xp = xp[:, :, ::-1]
+    xp = jnp.moveaxis(xp, 1, 0)            # [H, N, W, 5D]
+    zeros = jnp.zeros((n, d), x.dtype)
+
+    def row_step(prev_row, xrow):
+        # prev_row: (h_up [N, W, D], c_up [N, W, D]); xrow: [N, W, 5D]
+        def col_step(left, inp):
+            xt, h_up, c_up = inp
+            nxt = mdlstm_cell(xt, left, MDLSTMState(h_up, c_up), w_hx, w_hy)
+            return nxt, nxt
+        h_up, c_up = prev_row
+        init = MDLSTMState(zeros, zeros)
+        cols = (jnp.moveaxis(xrow, 1, 0), jnp.moveaxis(h_up, 1, 0),
+                jnp.moveaxis(c_up, 1, 0))
+        _, outs = jax.lax.scan(col_step, init, cols)
+        new_row = (jnp.moveaxis(outs.h, 0, 1), jnp.moveaxis(outs.c, 0, 1))
+        return new_row, new_row[0]
+
+    init_row = (jnp.zeros((n, ww, d), x.dtype), jnp.zeros((n, ww, d), x.dtype))
+    _, hmaps = jax.lax.scan(row_step, init_row, xp)    # [H, N, W, D]
+    out = jnp.moveaxis(hmaps, 0, 1)                    # [N, H, W, D]
+    if reverse_y:
+        out = out[:, ::-1]
+    if reverse_x:
+        out = out[:, :, ::-1]
+    return out

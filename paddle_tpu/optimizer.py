@@ -332,3 +332,114 @@ class ModelAverage:
     def averaged(self, params, state):
         return jax.tree.map(lambda a, p: a.astype(p.dtype),
                             state["avg"], params)
+
+
+class DecayedAdagrad(Optimizer):
+    """Adagrad with a decayed accumulator (reference:
+    DecayedAdagradParameterOptimizer, parameter/FirstOrderOptimizer.h;
+    operators/decayed_adagrad_op.cc)."""
+
+    def __init__(self, rho=0.95, epsilon=1e-6, **kw):
+        super().__init__(**kw)
+        self.rho, self.eps = rho, epsilon
+
+    def _init_one(self, p):
+        return jnp.zeros_like(p, jnp.float32)
+
+    def _update_one(self, g, p, acc, lr):
+        nacc = self.rho * acc + (1 - self.rho) * g * g
+        return p - lr * g / (jnp.sqrt(nacc) + self.eps), nacc
+
+
+class ProximalGD(Optimizer):
+    """Proximal gradient descent with L1/L2 proximal steps (reference:
+    operators/proximal_gd_op.cc): prox = sign(w')*max(|w'|-lr*l1, 0) /
+    (1+lr*l2) after the plain step w' = w - lr*g."""
+
+    def __init__(self, l1=0.0, l2=0.0, **kw):
+        super().__init__(**kw)
+        self.l1, self.l2 = l1, l2
+
+    def _update_one(self, g, p, s, lr):
+        w = p - lr * g
+        if self.l1:
+            w = jnp.sign(w) * jnp.maximum(jnp.abs(w) - lr * self.l1, 0.0)
+        if self.l2:
+            w = w / (1.0 + lr * self.l2)
+        return w, s
+
+
+class ProximalAdagrad(Optimizer):
+    """Adagrad step with the same proximal projection (reference:
+    operators/proximal_adagrad_op.cc)."""
+
+    def __init__(self, l1=0.0, l2=0.0, epsilon=1e-6, **kw):
+        super().__init__(**kw)
+        self.l1, self.l2, self.eps = l1, l2, epsilon
+
+    def _init_one(self, p):
+        return jnp.zeros_like(p, jnp.float32)
+
+    def _update_one(self, g, p, acc, lr):
+        nacc = acc + g * g
+        alr = lr / (jnp.sqrt(nacc) + self.eps)
+        w = p - alr * g
+        if self.l1:
+            w = jnp.sign(w) * jnp.maximum(jnp.abs(w) - alr * self.l1, 0.0)
+        if self.l2:
+            w = w / (1.0 + alr * self.l2)
+        return w, nacc
+
+
+class StaticPruning:
+    """Magnitude pruning mask applied to a trained/initial parameter set and
+    every subsequent update (reference: StaticPruningHook,
+    parameter/ParameterUpdaterHook.cpp:39 — zeroes the smallest
+    ``sparsity_ratio`` fraction of each hooked parameter by |w| and keeps
+    them zero through training).
+
+    Usage: masks = StaticPruning(ratio).make_masks(params, names);
+    wrap the optimizer with .apply(optimizer) so updates re-mask."""
+
+    def __init__(self, sparsity_ratio: float):
+        assert 0.0 <= sparsity_ratio < 1.0
+        self.ratio = sparsity_ratio
+        self.masks = {}
+
+    def make_masks(self, params, names=None):
+        """Build {name: 0/1 mask} from current magnitudes (the hook ran at
+        init / after load, ParameterUpdaterHook.cpp init path). Exactly the
+        k smallest-|w| entries are pruned (rank-based, so magnitude ties —
+        e.g. zero-initialised tensors — never over-prune)."""
+        import numpy as _np
+        self.masks.clear()
+        for name, p in params.items():
+            if names is not None and name not in names:
+                continue
+            mag = _np.abs(_np.asarray(p, _np.float32)).reshape(-1)
+            k = int(self.ratio * mag.size)
+            mask = _np.ones(mag.size, _np.float32)
+            if k > 0:
+                mask[_np.argpartition(mag, k - 1)[:k]] = 0.0
+            self.masks[name] = jnp.asarray(mask.reshape(_np.shape(p)))
+        return self.masks
+
+    def prune(self, params):
+        return {k: (p * self.masks[k].astype(p.dtype)
+                    if k in self.masks else p) for k, p in params.items()}
+
+    def apply(self, optimizer: Optimizer) -> Optimizer:
+        """Wrap optimizer.update so every step re-applies the masks (reads
+        self.masks at call time — make_masks may run after apply)."""
+        inner = optimizer.update
+        hook = self
+
+        def update(step, grads, params, state):
+            masks = hook.masks
+            grads = {k: (g * masks[k].astype(g.dtype) if k in masks else g)
+                     for k, g in grads.items()}
+            new_p, new_s = inner(step, grads, params, state)
+            return hook.prune(new_p), new_s
+
+        optimizer.update = update
+        return optimizer

@@ -107,14 +107,41 @@ class SGD:
     # -- public API --------------------------------------------------------
     def train(self, reader, num_passes=1,
               event_handler: Optional[Callable] = None,
-              feeding: Optional[Dict[str, int]] = None):
+              feeding: Optional[Dict[str, int]] = None,
+              checkpoint_dir: Optional[str] = None):
+        """checkpoint_dir: when set, checkpoints (params + optimizer state +
+        model state) are written asynchronously every ``checkpoint_period``
+        batches (flag; 0 = once per pass) and training resumes from the
+        latest checkpoint found there (reference: ParamUtil per-pass dirs +
+        --init_model_path/--start_pass, trainer/ParamUtil.cpp)."""
         event_handler = event_handler or (lambda e: None)
         feeder = self._feeder(feeding)
         ks = global_key_source()
         log_period = GLOBAL_FLAGS.get("log_period", 100)
         self._check_finite = (GLOBAL_FLAGS.get("debug_nans") or
                               GLOBAL_FLAGS.get("debug_infs"))
+        ckpt = None
+        if checkpoint_dir is not None:
+            from paddle_tpu.io import checkpoint as ckpt_io
+            latest = ckpt_io.latest_checkpoint(checkpoint_dir)
+            if latest:
+                (self._step, self.parameters.values, self.opt_state,
+                 self.parameters.state) = ckpt_io.load_checkpoint(
+                    latest, self.parameters.values, self.opt_state,
+                    self.parameters.state)
+                logger.info("resumed from %s (step %d)", latest, self._step)
+            ckpt = ckpt_io.AsyncCheckpointer(checkpoint_dir)
 
+        try:
+            self._train_passes(reader, num_passes, event_handler, feeder,
+                               ks, log_period, ckpt,
+                               GLOBAL_FLAGS.get("checkpoint_period", 0))
+        finally:
+            if ckpt is not None:
+                ckpt.close()
+
+    def _train_passes(self, reader, num_passes, event_handler, feeder, ks,
+                      log_period, ckpt, period):
         for pass_id in range(num_passes):
             event_handler(events.BeginPass(pass_id))
             self.evaluators.reset()
@@ -145,6 +172,12 @@ class SGD:
                                 batch_id, cost, self.evaluators.result())
                 event_handler(events.EndIteration(pass_id, batch_id, cost,
                                                   self.evaluators))
+                if ckpt is not None and period and self._step % period == 0:
+                    ckpt.save(self._step, self.parameters.values,
+                              self.opt_state, self.parameters.state)
+            if ckpt is not None and not period:
+                ckpt.save(self._step, self.parameters.values,
+                          self.opt_state, self.parameters.state)
             event_handler(events.EndPass(pass_id, self.evaluators))
 
     def test(self, reader, feeding: Optional[Dict[str, int]] = None):

@@ -1,0 +1,141 @@
+"""Async + sharded checkpointing (reference: go/pserver/service.go:119-174
+checksummed disk checkpoints; orbax-style async slot) and trainer-integrated
+resume (ParamUtil per-pass dirs, trainer/ParamUtil.cpp)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layer
+from paddle_tpu.io import checkpoint as ckpt
+from paddle_tpu.utils.rng import KeySource
+
+
+class TestAsyncCheckpointer:
+    def test_roundtrip_and_prune(self, tmp_path):
+        d = str(tmp_path)
+        ac = ckpt.AsyncCheckpointer(d, keep=2)
+        params = {"w": jnp.arange(6.0).reshape(2, 3)}
+        opt = {"m": (jnp.zeros(3), jnp.ones(2))}
+        for step in (1, 2, 3, 4):
+            ac.save(step, {"w": params["w"] * step}, opt)
+        ac.close()
+        kept = sorted(x for x in os.listdir(d) if x.startswith("ckpt-"))
+        assert kept == ["ckpt-00000003", "ckpt-00000004"]
+        step, p, o, _ = ckpt.load_checkpoint(
+            os.path.join(d, kept[-1]), params, opt)
+        assert step == 4
+        np.testing.assert_allclose(np.asarray(p["w"]),
+                                   np.arange(6.0).reshape(2, 3) * 4)
+        assert isinstance(o["m"], tuple) and o["m"][1].shape == (2,)
+
+    def test_checksum_detects_corruption(self, tmp_path):
+        d = str(tmp_path)
+        path = ckpt.save_checkpoint(d, 7, {"w": jnp.ones(4)})
+        target = os.path.join(path, "params.npz")
+        raw = bytearray(open(target, "rb").read())
+        raw[-1] ^= 0xFF
+        open(target, "wb").write(bytes(raw))
+        with pytest.raises(IOError, match="checksum"):
+            ckpt.load_checkpoint(path, {"w": jnp.ones(4)})
+
+    def test_worker_error_surfaces(self, tmp_path):
+        ac = ckpt.AsyncCheckpointer(str(tmp_path / "nope"))
+        # break the writer: save_dir is a file
+        open(tmp_path / "nope", "w").close()
+        ac.save(1, {"w": jnp.ones(2)})
+        with pytest.raises(Exception):
+            ac.wait()
+            ac.save(2, {"w": jnp.ones(2)})
+            ac.wait()
+
+
+class TestShardedLayout:
+    def test_sharded_save_reassembles(self, tmp_path):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        devs = jax.devices()[:4]
+        mesh = Mesh(np.array(devs), ("data",))
+        x = jnp.arange(32.0).reshape(4, 8)
+        xs = jax.device_put(x, NamedSharding(mesh, P("data", None)))
+        d = str(tmp_path)
+        ckpt.save_checkpoint(d, 3, {"w": xs}, sharded=True)
+        path = ckpt.latest_checkpoint(d)
+        step, p, _, _ = ckpt.load_checkpoint(path, {"w": x})
+        assert step == 3
+        np.testing.assert_allclose(np.asarray(p["w"]), np.asarray(x))
+
+    def test_multi_process_files_merge(self, tmp_path):
+        """Simulate two hosts each saving a half of a row-sharded array."""
+        d = str(tmp_path)
+        full = np.arange(16.0).reshape(4, 4)
+
+        class FakeShard:
+            def __init__(self, index, data):
+                self.index = index
+                self.data = data
+
+        class FakeArr:
+            def __init__(self, idx):
+                rows = slice(idx * 2, idx * 2 + 2)
+                self.addressable_shards = [
+                    FakeShard((rows, slice(0, 4)), full[rows]),
+                    FakeShard((rows, slice(0, 4)), full[rows]),
+                ]
+                self.shape = full.shape
+
+            def __array__(self, dtype=None):
+                return full
+
+        for proc in (0, 1):
+            ckpt.save_checkpoint(d, 5, {"w": FakeArr(proc)},
+                                 process_index=proc, process_count=2)
+        path = ckpt.latest_checkpoint(d)
+        step, p, _, _ = ckpt.load_checkpoint(path, {"w": jnp.zeros((4, 4))})
+        assert step == 5
+        np.testing.assert_allclose(np.asarray(p["w"]), full)
+
+
+class TestTrainerResume:
+    def _build(self):
+        x = layer.data("cr_x", paddle.data_type.dense_vector(4))
+        lbl = layer.data("cr_l", paddle.data_type.integer_value(2))
+        out = layer.fc(x, 2, act=paddle.activation.Softmax(), name="cr_out")
+        cost = layer.classification_cost(out, lbl, name="cr_cost")
+        params = paddle.parameters.create(cost, KeySource(3))
+        tr = paddle.trainer.SGD(cost=cost, parameters=params,
+                                update_equation=paddle.optimizer.Momentum(
+                                    learning_rate=0.1))
+        return tr
+
+    def _reader(self, n=32):
+        def reader():
+            r = np.random.RandomState(0)
+            for _ in range(n):
+                y = int(r.randint(2))
+                yield [(r.randn(4) + 3 * y).astype(np.float32), y]
+        return reader
+
+    def test_train_writes_and_resumes(self, tmp_path):
+        d = str(tmp_path / "ck")
+        tr = self._build()
+        tr.train(reader=paddle.batch(self._reader(), 8), num_passes=2,
+                 checkpoint_dir=d)
+        assert tr._step == 8
+        latest = ckpt.latest_checkpoint(d)
+        assert latest and latest.endswith("00000008")
+        # a fresh trainer resumes at step 8 and continues to 12
+        tr2 = self._build()
+        tr2.train(reader=paddle.batch(self._reader(), 8), num_passes=1,
+                  checkpoint_dir=d)
+        assert tr2._step == 12
+        w_trained = np.asarray(tr2.parameters.values["cr_out.w"])
+        # resumed params came from the checkpoint, not re-init
+        step, p, _, _ = ckpt.load_checkpoint(
+            ckpt.latest_checkpoint(d), tr2.parameters.values)
+        assert step == 12
+        np.testing.assert_allclose(np.asarray(p["cr_out.w"]), w_trained,
+                                   rtol=1e-6)

@@ -1,0 +1,310 @@
+"""Parity-tail layers and ops: row_conv, data_norm, featmap_expand, MDLSTM,
+the remaining cost layers, Pnpair evaluator, and the proximal/pruning
+optimizers (reference: RowConvLayer.cpp, DataNormLayer.cpp,
+FeatureMapExpandLayer.cpp, MDLstmLayer.cpp, CostLayer.cpp,
+Evaluator.cpp:932, proximal_*_op.cc, ParameterUpdaterHook.cpp:39)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layer, optimizer
+from paddle_tpu.ops import loss as ops_loss
+from paddle_tpu.ops import rnn as ops_rnn
+from paddle_tpu.topology import Topology, Value
+from paddle_tpu.utils.rng import KeySource
+
+
+def run_layer(out, feed, extra_params=None):
+    topo = Topology(out)
+    params = paddle.parameters.create(out, KeySource(0))
+    if extra_params:
+        for k, v in extra_params.items():
+            params.values[k] = jnp.asarray(v)
+    fwd = topo.compile()
+    outs, _ = fwd(params.values, params.state,
+                  {k: Value(jnp.asarray(a)) if not isinstance(v, tuple)
+                   else Value(jnp.asarray(v[0]), jnp.asarray(v[1]))
+                   for k, (a, v) in
+                   {k: (v if not isinstance(v, tuple) else v[0], v)
+                    for k, v in feed.items()}.items()},
+                  is_training=False)
+    return outs[out.name], params
+
+
+class TestMDLSTM:
+    def test_mdlstm_matches_naive(self, rng):
+        n, H, W, C, D = 2, 3, 4, 5, 6
+        x = rng.randn(n, H, W, C).astype(np.float32)
+        w_ih = (rng.randn(C, 5 * D) * 0.3).astype(np.float32)
+        w_hx = (rng.randn(D, 5 * D) * 0.3).astype(np.float32)
+        w_hy = (rng.randn(D, 5 * D) * 0.3).astype(np.float32)
+        out = ops_rnn.mdlstm(jnp.asarray(x), jnp.asarray(w_ih),
+                             jnp.asarray(w_hx), jnp.asarray(w_hy))
+        assert out.shape == (n, H, W, D)
+
+        # naive python recurrence
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+        h = np.zeros((n, H, W, D), np.float32)
+        c = np.zeros((n, H, W, D), np.float32)
+        for i in range(H):
+            for j in range(W):
+                hl = h[:, i, j - 1] if j > 0 else np.zeros((n, D))
+                cl = c[:, i, j - 1] if j > 0 else np.zeros((n, D))
+                hu = h[:, i - 1, j] if i > 0 else np.zeros((n, D))
+                cu = c[:, i - 1, j] if i > 0 else np.zeros((n, D))
+                g = x[:, i, j] @ w_ih + hl @ w_hx + hu @ w_hy
+                ii, fx, fy, gg, oo = np.split(g, 5, axis=-1)
+                cc = (sig(ii) * np.tanh(gg) + sig(fx) * cl + sig(fy) * cu)
+                c[:, i, j] = cc
+                h[:, i, j] = sig(oo) * np.tanh(cc)
+        np.testing.assert_allclose(np.asarray(out), h, rtol=2e-4, atol=2e-4)
+
+    def test_mdlstm_layer_and_grad(self, rng):
+        img = layer.data("mdin", paddle.data_type.dense_vector(3 * 4 * 4))
+        lo = layer.mdlstmemory(img, size=5, shape=(3, 4, 4), name="md0")
+        lbl = layer.data("mdlbl", paddle.data_type.dense_vector(5 * 4 * 4))
+        fcn = layer.fc(lo, 5 * 4 * 4, act=None, name="md_fc")
+        cost = layer.square_error_cost(fcn, lbl, name="md_cost")
+        topo = Topology(cost)
+        params = paddle.parameters.create(cost, KeySource(0))
+        fwd = topo.compile()
+        x = rng.randn(2, 48).astype(np.float32)
+        y = rng.randn(2, 80).astype(np.float32)
+
+        def loss(p):
+            outs, _ = fwd(p, params.state,
+                          {"mdin": Value(jnp.asarray(x)),
+                           "mdlbl": Value(jnp.asarray(y))},
+                          is_training=True)
+            return jnp.mean(outs["md_cost"].array)
+
+        g = jax.grad(loss)(params.values)
+        assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
+        assert float(jnp.abs(g["md0.w_hx"]).sum()) > 0
+        assert float(jnp.abs(g["md0.w_hy"]).sum()) > 0
+
+
+class TestRowConvDataNormFeatmap:
+    def test_row_conv_lookahead(self, rng):
+        # out[t] = sum_k x[t+k] w[k]: with w=[1,0,...] it's identity
+        from paddle_tpu.ops import sequence as ops_seq
+        x = rng.randn(2, 5, 3).astype(np.float32)
+        lens = np.array([5, 3])
+        w = np.zeros((2, 3), np.float32)
+        w[0] = 1.0
+        out = ops_seq.row_conv(jnp.asarray(x), jnp.asarray(lens),
+                               jnp.asarray(w))
+        mask = (np.arange(5)[None, :, None] < lens[:, None, None])
+        np.testing.assert_allclose(np.asarray(out), x * mask, rtol=1e-5)
+
+    def test_row_conv_layer_shapes(self, rng):
+        seq = layer.data("rc_in", paddle.data_type.dense_vector_sequence(4))
+        rc = layer.row_conv(seq, context_len=3, name="rc0")
+        topo = Topology(rc)
+        params = paddle.parameters.create(rc, KeySource(0))
+        fwd = topo.compile()
+        x = rng.randn(2, 6, 4).astype(np.float32)
+        outs, _ = fwd(params.values, params.state,
+                      {"rc_in": Value(jnp.asarray(x),
+                                      jnp.asarray([6, 4]))},
+                      is_training=False)
+        assert outs["rc0"].array.shape == (2, 6, 4)
+        assert params.values["rc0.w"].shape == (3, 4)
+
+    def test_data_norm_zscore(self, rng):
+        d = layer.data("dn_in", paddle.data_type.dense_vector(4))
+        dn = layer.data_norm(d, strategy="z-score", name="dn0")
+        topo = Topology(dn)
+        params = paddle.parameters.create(dn, KeySource(0))
+        params.values["dn0.mean"] = jnp.asarray([1.0, 2, 3, 4])
+        params.values["dn0.std"] = jnp.asarray([2.0, 2, 2, 2])
+        fwd = topo.compile()
+        x = np.array([[3.0, 4, 5, 6]], np.float32)
+        outs, _ = fwd(params.values, params.state,
+                      {"dn_in": Value(jnp.asarray(x))}, is_training=False)
+        np.testing.assert_allclose(np.asarray(outs["dn0"].array),
+                                   [[1.0, 1, 1, 1]], rtol=1e-5)
+
+    def test_data_norm_params_are_static(self):
+        d = layer.data("dn_in2", paddle.data_type.dense_vector(4))
+        dn = layer.data_norm(d, name="dn1")
+        topo = Topology(dn)
+        spec = {s.name: s for s in topo.param_specs()}
+        assert spec["dn1.mean"].attr.is_static
+
+    def test_featmap_expand(self):
+        d = layer.data("fm_in", paddle.data_type.dense_vector(3))
+        fm = layer.featmap_expand(d, num_filters=2, name="fm0")
+        topo = Topology(fm)
+        params = paddle.parameters.create(fm, KeySource(0))
+        fwd = topo.compile()
+        x = np.array([[1.0, 2, 3]], np.float32)
+        outs, _ = fwd(params.values, params.state,
+                      {"fm_in": Value(jnp.asarray(x))}, is_training=False)
+        np.testing.assert_allclose(np.asarray(outs["fm0"].array),
+                                   [[1, 2, 3, 1, 2, 3]])
+
+
+class TestNewCosts:
+    def test_huber_regression_regions(self):
+        pred = jnp.asarray([[0.0], [0.0], [0.0]])
+        tgt = jnp.asarray([[0.5], [1.0], [3.0]])
+        out = np.asarray(ops_loss.huber_regression(pred, tgt, delta=1.0))
+        np.testing.assert_allclose(out, [0.125, 0.5, 2.5], rtol=1e-6)
+
+    def test_selfnorm_matches_ce_plus_penalty(self, rng):
+        logits = jnp.asarray(rng.randn(4, 6).astype(np.float32))
+        labels = jnp.asarray([0, 2, 3, 5])
+        out = np.asarray(ops_loss.cross_entropy_with_selfnorm(
+            logits, labels, alpha=0.5))
+        ce = np.asarray(ops_loss.softmax_cross_entropy(logits, labels))
+        lz = np.asarray(jax.nn.logsumexp(logits, axis=-1))
+        np.testing.assert_allclose(out, ce + 0.5 * lz ** 2, rtol=1e-5)
+
+    def test_lambda_rank_perfect_order_is_low(self):
+        rel = jnp.asarray([[3.0, 2.0, 1.0, 0.0]])
+        lens = jnp.asarray([4])
+        good = ops_loss.lambda_rank(jnp.asarray([[4.0, 3.0, 2.0, 1.0]]),
+                                    rel, lens)
+        bad = ops_loss.lambda_rank(jnp.asarray([[1.0, 2.0, 3.0, 4.0]]),
+                                   rel, lens)
+        assert float(good[0]) < float(bad[0])
+
+    def test_lambda_rank_gradient_improves_ndcg(self):
+        rel = jnp.asarray([[0.0, 2.0, 1.0]])
+        lens = jnp.asarray([3])
+        s = jnp.asarray([[1.0, 0.0, 0.5]])
+
+        def f(s):
+            return jnp.sum(ops_loss.lambda_rank(s, rel, lens))
+        g = jax.grad(f)(s)
+        # pushing scores against the gradient must raise the rel-2 doc
+        assert float(g[0, 1]) < float(g[0, 0])
+
+    def test_cost_layers_build_and_run(self, rng):
+        x = layer.data("nc_x", paddle.data_type.dense_vector(4))
+        lbl_r = layer.data("nc_y", paddle.data_type.dense_vector(4))
+        fcn = layer.fc(x, 4, act=None, name="nc_fc")
+        costs = [
+            layer.huber_regression_cost(fcn, lbl_r, name="nc_hr"),
+            layer.smooth_l1_cost(fcn, lbl_r, name="nc_sl"),
+            layer.sum_cost_layer(fcn, name="nc_sum"),
+        ]
+        topo = Topology(costs)
+        params = paddle.parameters.create(costs[0], KeySource(0))
+        for c in costs[1:]:
+            params2 = paddle.parameters.create(c, KeySource(0))
+            params.values.update(params2.values)
+        fwd = topo.compile()
+        outs, _ = fwd(params.values, params.state,
+                      {"nc_x": Value(jnp.asarray(rng.randn(3, 4)
+                                                 .astype(np.float32))),
+                       "nc_y": Value(jnp.asarray(rng.randn(3, 4)
+                                                 .astype(np.float32)))},
+                      is_training=False)
+        for c in costs:
+            assert outs[c.name].array.shape == (3,)
+
+    def test_lambda_cost_layer(self, rng):
+        s = layer.data("lc_s", paddle.data_type.dense_vector_sequence(1))
+        r = layer.data("lc_r", paddle.data_type.dense_vector_sequence(1))
+        lc = layer.lambda_cost(s, r, name="lc0")
+        topo = Topology(lc)
+        params = paddle.parameters.create(lc, KeySource(0))
+        fwd = topo.compile()
+        scores = rng.randn(2, 5, 1).astype(np.float32)
+        rels = rng.randint(0, 3, (2, 5, 1)).astype(np.float32)
+        lens = jnp.asarray([5, 3])
+        outs, _ = fwd(params.values, params.state,
+                      {"lc_s": Value(jnp.asarray(scores), lens),
+                       "lc_r": Value(jnp.asarray(rels), lens)},
+                      is_training=False)
+        assert outs["lc0"].array.shape == (2,)
+        assert np.isfinite(np.asarray(outs["lc0"].array)).all()
+
+
+class TestPnpairEvaluator:
+    def test_counts(self):
+        from paddle_tpu import evaluator as ev
+        score = layer.data("pn_s", paddle.data_type.dense_vector(1))
+        lab = layer.data("pn_l", paddle.data_type.integer_value(2))
+        qid = layer.data("pn_q", paddle.data_type.integer_value(100))
+        pn = ev.positive_negative_pair(score, lab, qid, name="pn0")
+        topo = Topology(pn)
+        params = paddle.parameters.create(pn, KeySource(0))
+        fwd = topo.compile()
+        # query 1: pos(0.9) > neg(0.1) -> pos pair; query 2: pos(0.2) <
+        # neg(0.8) -> neg pair; cross-query pairs must not count
+        s = np.array([[0.9], [0.1], [0.2], [0.8]], np.float32)
+        l = np.array([1, 0, 1, 0], np.int32)
+        q = np.array([1, 1, 2, 2], np.int32)
+        outs, _ = fwd(params.values, params.state,
+                      {"pn_s": Value(jnp.asarray(s)),
+                       "pn_l": Value(jnp.asarray(l)),
+                       "pn_q": Value(jnp.asarray(q))}, is_training=False)
+        pos, neg, spe = np.asarray(outs["pn0"].array)
+        assert (pos, neg, spe) == (1.0, 1.0, 0.0)
+
+
+class TestNewOptimizers:
+    def _one_step(self, opt, w0=1.0, g=0.5):
+        params = {"w": jnp.asarray([w0], jnp.float32)}
+        opt.bind([])
+        state = opt.init_state(params)
+        newp, _ = opt.update(jnp.asarray(0, jnp.int32),
+                             {"w": jnp.asarray([g], jnp.float32)},
+                             params, state)
+        return float(newp["w"][0])
+
+    def test_decayed_adagrad(self):
+        w = self._one_step(optimizer.DecayedAdagrad(learning_rate=0.1,
+                                                    rho=0.5))
+        # acc = 0.5*0.25 -> step = 0.1*0.5/(sqrt(0.125)+eps)
+        assert abs(w - (1.0 - 0.1 * 0.5 / (0.125 ** 0.5 + 1e-6))) < 1e-6
+
+    def test_proximal_gd_l1_soft_threshold(self):
+        opt = optimizer.ProximalGD(learning_rate=0.1, l1=10.0)
+        # w' = 1 - 0.05 = 0.95; |w'| - lr*l1 = 0.95 - 1.0 < 0 -> 0
+        assert self._one_step(opt) == 0.0
+
+    def test_proximal_adagrad_shrinks(self):
+        opt = optimizer.ProximalAdagrad(learning_rate=0.1, l2=1.0)
+        w_plain = self._one_step(optimizer.AdaGrad(learning_rate=0.1))
+        w_prox = self._one_step(opt)
+        assert 0 < w_prox < w_plain
+
+    def test_static_pruning_masks_stick(self):
+        params = {"w": jnp.asarray([0.01, -0.02, 5.0, -6.0], jnp.float32)}
+        hook = optimizer.StaticPruning(0.5)
+        hook.make_masks(params)
+        np.testing.assert_array_equal(np.asarray(hook.masks["w"]),
+                                      [0, 0, 1, 1])
+        opt = hook.apply(optimizer.SGD(learning_rate=0.1))
+        opt.bind([])
+        state = opt.init_state(params)
+        pruned = hook.prune(params)
+        g = {"w": jnp.ones(4, jnp.float32)}
+        newp, _ = opt.update(jnp.asarray(0, jnp.int32), g, pruned, state)
+        out = np.asarray(newp["w"])
+        assert out[0] == 0.0 and out[1] == 0.0          # stay pruned
+        np.testing.assert_allclose(out[2:], [4.9, -6.1], rtol=1e-6)
+
+
+class TestDeconv3D:
+    def test_shapes_and_grad(self, rng):
+        d = layer.data("dc_in", paddle.data_type.dense_vector(2 * 2 * 3 * 3))
+        dc = layer.img_conv3d_transpose(d, filter_size=2, num_filters=4,
+                                        shape=(2, 2, 3, 3), stride=2,
+                                        name="dc0")
+        assert dc.shape3d == (4, 4, 6, 6)
+        topo = Topology(dc)
+        params = paddle.parameters.create(dc, KeySource(0))
+        fwd = topo.compile()
+        x = rng.randn(2, 36).astype(np.float32)
+        outs, _ = fwd(params.values, params.state,
+                      {"dc_in": Value(jnp.asarray(x))}, is_training=False)
+        assert outs["dc0"].array.shape == (2, 4 * 4 * 6 * 6)
