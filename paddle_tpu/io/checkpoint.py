@@ -74,7 +74,8 @@ def _local_shards(arr):
     """[(index_tuple_of_slices, np_shard)] for this process's addressable
     shards; a single [(None, full_array)] for unsharded/numpy values."""
     shards = getattr(arr, "addressable_shards", None)
-    if shards is None or len(shards) <= 1:
+    fully_local = getattr(arr, "is_fully_addressable", True)
+    if shards is None or (fully_local and len(shards) <= 1):
         return [(None, np.asarray(arr))]
     seen, out = set(), []
     for s in shards:
@@ -140,9 +141,13 @@ def _write_single(save_dir, step, trees, keep, host_trees=None,
         json.dump(manifest, f)
     if process_count > 1:
         # multi-host: move our files into the shared dir; process 0 owns
-        # directory lifecycle, others only add their piece
+        # directory lifecycle, others only add their piece. The manifest
+        # moves LAST — its presence is this process's commit point, so a
+        # reader that sees all manifests sees all data files too.
         os.makedirs(final, exist_ok=True)
-        for fn in os.listdir(tmp):
+        manifest_fn = f"manifest{suffix}.json"
+        for fn in sorted(os.listdir(tmp),
+                         key=lambda n: n == manifest_fn):
             os.replace(os.path.join(tmp, fn), os.path.join(final, fn))
         os.rmdir(tmp)
     else:
@@ -303,6 +308,9 @@ class AsyncCheckpointer:
             raise err
 
     def close(self):
-        self.wait()
-        self._q.put(None)
-        self._worker.join(timeout=10)
+        try:
+            self.wait()
+        finally:
+            # shut the worker down even when wait() surfaces a write error
+            self._q.put(None)
+            self._worker.join(timeout=10)

@@ -9,3 +9,5 @@ from paddle_tpu.models import smallnet
 from paddle_tpu.models import seq2seq
 from paddle_tpu.models import text
 from paddle_tpu.models import vgg
+from paddle_tpu.models import gan
+from paddle_tpu.models import vae

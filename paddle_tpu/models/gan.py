@@ -1,0 +1,154 @@
+"""GAN (DCGAN-style) — generator/discriminator with alternating training
+(reference: v1_api_demo/gan/gan_conf.py + gan_trainer.py — two
+GradientMachines trained alternately on uniform noise vs real samples).
+
+TPU-native: both networks are pure functions over parameter pytrees; the
+two alternating updates are TWO jitted train steps (the reference swapped
+GradientMachines per batch). MLP variant for vector data (gan_conf.py) and
+a conv variant for images (gan_conf_image.py) share the same trainer.
+"""
+
+import dataclasses
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import optimizer as opt_mod
+from paddle_tpu.ops import conv as ops_conv
+
+
+@dataclasses.dataclass(frozen=True)
+class GANConfig:
+    noise_dim: int = 10
+    sample_dim: int = 784
+    hidden_dim: int = 256
+    conv: bool = False          # conv G/D for images (28x28 assumed)
+    lr: float = 2e-4
+
+
+def init_params(key: jax.Array, cfg: GANConfig):
+    ks = jax.random.split(key, 8)
+    H, Z, X = cfg.hidden_dim, cfg.noise_dim, cfg.sample_dim
+
+    def dense(k, i, o):
+        return jax.random.normal(k, (i, o), jnp.float32) / math.sqrt(i)
+
+    if not cfg.conv:
+        gen = {"w1": dense(ks[0], Z, H), "b1": jnp.zeros(H),
+               "w2": dense(ks[1], H, H), "b2": jnp.zeros(H),
+               "w3": dense(ks[2], H, X), "b3": jnp.zeros(X)}
+        disc = {"w1": dense(ks[3], X, H), "b1": jnp.zeros(H),
+                "w2": dense(ks[4], H, H), "b2": jnp.zeros(H),
+                "w3": dense(ks[5], H, 1), "b3": jnp.zeros(1)}
+        return {"gen": gen, "disc": disc}
+    # conv variant: G projects noise to 7x7x32 then 2x transposed convs;
+    # D mirrors with strided convs (gan_conf_image.py shape schedule)
+    gen = {"proj": dense(ks[0], Z, 7 * 7 * 32),
+           "b0": jnp.zeros(7 * 7 * 32),
+           "k1": jax.random.normal(ks[1], (4, 4, 32, 16)) * 0.05,
+           "k2": jax.random.normal(ks[2], (4, 4, 16, 1)) * 0.05}
+    disc = {"k1": jax.random.normal(ks[3], (4, 4, 1, 16)) * 0.05,
+            "k2": jax.random.normal(ks[4], (4, 4, 16, 32)) * 0.05,
+            "w": dense(ks[5], 7 * 7 * 32, 1), "b": jnp.zeros(1)}
+    return {"gen": gen, "disc": disc}
+
+
+def generator(params, z, cfg: GANConfig):
+    g = params["gen"]
+    if not cfg.conv:
+        h = jax.nn.relu(z @ g["w1"] + g["b1"])
+        h = jax.nn.relu(h @ g["w2"] + g["b2"])
+        return jnp.tanh(h @ g["w3"] + g["b3"])
+    h = jax.nn.relu(z @ g["proj"] + g["b0"]).reshape(-1, 7, 7, 32)
+    h = jax.nn.relu(ops_conv.conv2d_transpose(h, g["k1"], stride=2))
+    x = jnp.tanh(ops_conv.conv2d_transpose(h, g["k2"], stride=2))
+    return x.reshape(x.shape[0], -1).astype(jnp.float32)
+
+
+def discriminator(params, x, cfg: GANConfig):
+    d = params["disc"]
+    if not cfg.conv:
+        h = jax.nn.leaky_relu(x @ d["w1"] + d["b1"], 0.2)
+        h = jax.nn.leaky_relu(h @ d["w2"] + d["b2"], 0.2)
+        return (h @ d["w3"] + d["b3"])[:, 0]
+    img = x.reshape(-1, 28, 28, 1)
+    h = jax.nn.leaky_relu(
+        ops_conv.conv2d(img, d["k1"], stride=2).astype(jnp.float32), 0.2)
+    h = jax.nn.leaky_relu(
+        ops_conv.conv2d(h, d["k2"], stride=2).astype(jnp.float32), 0.2)
+    return (h.reshape(h.shape[0], -1) @ d["w"] + d["b"])[:, 0]
+
+
+def _bce_logits(logits, target):
+    # -t*log σ(l) - (1-t)*log(1-σ(l)) in the stable softplus form
+    return jnp.mean(jax.nn.softplus(logits) - target * logits)
+
+
+class GANTrainer:
+    """Alternating D/G updates as two jitted steps (the gan_trainer.py
+    loop: train D on real+fake, then G through a frozen D)."""
+
+    def __init__(self, cfg: GANConfig, key: jax.Array):
+        self.cfg = cfg
+        self.params = init_params(key, cfg)
+        self.d_opt = opt_mod.Adam(learning_rate=cfg.lr, beta1=0.5).bind([])
+        self.g_opt = opt_mod.Adam(learning_rate=cfg.lr, beta1=0.5).bind([])
+        self.d_state = self.d_opt.init_state(self.params["disc"])
+        self.g_state = self.g_opt.init_state(self.params["gen"])
+        self._step = 0
+        self._d_step = jax.jit(self._make_d_step())
+        self._g_step = jax.jit(self._make_g_step())
+
+    def _make_d_step(self):
+        cfg, opt = self.cfg, self.d_opt
+
+        def step(params, d_state, real, z, i):
+            def loss(dp):
+                p = {"gen": params["gen"], "disc": dp}
+                fake = generator(p, z, cfg)
+                l_real = _bce_logits(discriminator(p, real, cfg), 1.0)
+                l_fake = _bce_logits(
+                    discriminator(p, jax.lax.stop_gradient(fake), cfg), 0.0)
+                return l_real + l_fake
+            lval, grads = jax.value_and_grad(loss)(params["disc"])
+            new_d, new_s = opt.update(i, grads, params["disc"], d_state)
+            return lval, {"gen": params["gen"], "disc": new_d}, new_s
+        return step
+
+    def _make_g_step(self):
+        cfg, opt = self.cfg, self.g_opt
+
+        def step(params, g_state, z, i):
+            def loss(gp):
+                p = {"gen": gp, "disc": params["disc"]}
+                fake = generator(p, z, cfg)
+                # non-saturating G loss: fool D into predicting real
+                return _bce_logits(discriminator(p, fake, cfg), 1.0)
+            lval, grads = jax.value_and_grad(loss)(params["gen"])
+            new_g, new_s = opt.update(i, grads, params["gen"], g_state)
+            return lval, {"gen": new_g, "disc": params["disc"]}, new_s
+        return step
+
+    def train_batch(self, key: jax.Array, real: jax.Array
+                    ) -> Tuple[float, float]:
+        """One D step + one G step; returns (d_loss, g_loss)."""
+        kd, kg = jax.random.split(key)
+        n = real.shape[0]
+        i = jnp.asarray(self._step, jnp.int32)
+        z = jax.random.uniform(kd, (n, self.cfg.noise_dim), jnp.float32,
+                               -1.0, 1.0)
+        d_loss, self.params, self.d_state = self._d_step(
+            self.params, self.d_state, real, z, i)
+        z2 = jax.random.uniform(kg, (n, self.cfg.noise_dim), jnp.float32,
+                                -1.0, 1.0)
+        g_loss, self.params, self.g_state = self._g_step(
+            self.params, self.g_state, z2, i)
+        self._step += 1
+        return float(d_loss), float(g_loss)
+
+    def sample(self, key: jax.Array, n: int) -> jnp.ndarray:
+        z = jax.random.uniform(key, (n, self.cfg.noise_dim), jnp.float32,
+                               -1.0, 1.0)
+        return generator(self.params, z, self.cfg)

@@ -35,6 +35,7 @@ class TransformerConfig:
     max_len: int = 2048
     dtype: object = jnp.bfloat16
     use_ring_attention: bool = False   # shard_map CP over the seq axis
+    use_flash_attention: bool = False  # Pallas fused attention (TPU)
 
     @property
     def head_dim(self):
@@ -133,6 +134,9 @@ def forward(params, tokens: jax.Array, cfg: TransformerConfig, *,
         if seq_sharded and cfg.use_ring_attention:
             attn = ring.ring_attention_spmd(q, k, v, mesh, causal=True,
                                             lengths=lengths)
+        elif cfg.use_flash_attention and lengths is None:
+            from paddle_tpu.ops.pallas import flash_attention
+            attn = flash_attention(q, k, v, causal=True)
         else:
             attn = ring.full_attention(q, k, v, causal=True, lengths=lengths)
         attn = attn.reshape(B, T, cfg.d_model)

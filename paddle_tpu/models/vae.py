@@ -1,0 +1,96 @@
+"""Variational autoencoder (reference: v1_api_demo/vae/vae_conf.py +
+vae_train.py — MLP encoder/decoder on MNIST with the reparameterisation
+trick and an ELBO objective).
+
+TPU-native: one jitted train step; the sampling key threads explicitly
+(the reference drew noise on the host each batch)."""
+
+import dataclasses
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import optimizer as opt_mod
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    x_dim: int = 784
+    hidden_dim: int = 400
+    z_dim: int = 20
+    lr: float = 1e-3
+
+
+def init_params(key: jax.Array, cfg: VAEConfig):
+    ks = jax.random.split(key, 5)
+    X, H, Z = cfg.x_dim, cfg.hidden_dim, cfg.z_dim
+
+    def dense(k, i, o):
+        return jax.random.normal(k, (i, o), jnp.float32) / math.sqrt(i)
+
+    return {
+        "enc_w": dense(ks[0], X, H), "enc_b": jnp.zeros(H),
+        "mu_w": dense(ks[1], H, Z), "mu_b": jnp.zeros(Z),
+        "lv_w": dense(ks[2], H, Z), "lv_b": jnp.zeros(Z),
+        "dec_w": dense(ks[3], Z, H), "dec_b": jnp.zeros(H),
+        "out_w": dense(ks[4], H, X), "out_b": jnp.zeros(X),
+    }
+
+
+def encode(params, x):
+    h = jnp.tanh(x @ params["enc_w"] + params["enc_b"])
+    mu = h @ params["mu_w"] + params["mu_b"]
+    logvar = h @ params["lv_w"] + params["lv_b"]
+    return mu, logvar
+
+
+def decode(params, z):
+    h = jnp.tanh(z @ params["dec_w"] + params["dec_b"])
+    return h @ params["out_w"] + params["out_b"]     # bernoulli logits
+
+
+def elbo_loss(params, x, key) -> Tuple[jax.Array, dict]:
+    """Negative ELBO = BCE reconstruction + KL(q(z|x) || N(0,1))."""
+    mu, logvar = encode(params, x)
+    eps = jax.random.normal(key, mu.shape, mu.dtype)
+    z = mu + jnp.exp(0.5 * logvar) * eps             # reparameterisation
+    logits = decode(params, z)
+    bce = jnp.sum(jax.nn.softplus(logits) - x * logits, axis=-1)
+    kl = -0.5 * jnp.sum(1 + logvar - jnp.square(mu) - jnp.exp(logvar),
+                        axis=-1)
+    loss = jnp.mean(bce + kl)
+    return loss, {"bce": jnp.mean(bce), "kl": jnp.mean(kl)}
+
+
+class VAETrainer:
+    def __init__(self, cfg: VAEConfig, key: jax.Array):
+        self.cfg = cfg
+        self.params = init_params(key, cfg)
+        self.opt = opt_mod.Adam(learning_rate=cfg.lr).bind([])
+        self.opt_state = self.opt.init_state(self.params)
+        self._step = 0
+
+        def step(params, opt_state, x, key, i):
+            (loss, aux), grads = jax.value_and_grad(
+                elbo_loss, has_aux=True)(params, x, key)
+            newp, news = self.opt.update(i, grads, params, opt_state)
+            return loss, aux, newp, news
+
+        self._train_step = jax.jit(step)
+
+    def train_batch(self, key: jax.Array, x: jax.Array) -> float:
+        loss, aux, self.params, self.opt_state = self._train_step(
+            self.params, self.opt_state, x,
+            key, jnp.asarray(self._step, jnp.int32))
+        self._step += 1
+        return float(loss)
+
+    def reconstruct(self, key: jax.Array, x: jax.Array) -> jnp.ndarray:
+        mu, logvar = encode(self.params, x)
+        return jax.nn.sigmoid(decode(self.params, mu))
+
+    def sample(self, key: jax.Array, n: int) -> jnp.ndarray:
+        z = jax.random.normal(key, (n, self.cfg.z_dim), jnp.float32)
+        return jax.nn.sigmoid(decode(self.params, z))

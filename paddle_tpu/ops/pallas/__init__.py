@@ -1,0 +1,9 @@
+"""Pallas TPU kernels — hand-scheduled fusions where XLA's automatic fusion
+is insufficient (reference slot: the hand-written CUDA in
+paddle/cuda/src/hl_cuda_*.cu; see /opt/skills/guides/pallas_guide.md).
+
+Each kernel ships with a jnp reference implementation and dispatches to it
+off-TPU, so the package runs everywhere; tests exercise the kernels in
+Pallas interpret mode on CPU."""
+
+from paddle_tpu.ops.pallas.attention import flash_attention  # noqa: F401

@@ -1,0 +1,183 @@
+"""Flash attention — streaming-softmax fused attention Pallas kernel.
+
+Replaces the materialised [B, H, T, T] score tensor of plain attention
+(parallel/ring.py full_attention) with an online-softmax accumulation over
+key blocks, so HBM traffic is O(T·D) instead of O(T²) and long sequences
+stop being memory-bound (the capability slot of the reference's hand-fused
+CUDA attention-precursors, paddle/cuda/src/hl_cuda_sequence.cu; design per
+the public FlashAttention recipe on the MXU).
+
+Layout: q/k/v are [B, T, H, D] (the framework's attention layout). The
+kernel grids over (batch·heads, query blocks) with an inner
+``lax.fori_loop`` over key blocks; running max/denominator live in VMEM
+scratch. Backward is a custom VJP that recomputes attention blockwise with
+XLA from the saved (out, logsumexp) — fwd memory stays O(T·D).
+
+Off-TPU the public entry falls back to the jnp reference; tests run the
+kernel in interpret mode.
+"""
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+                block_q, block_k, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # [block_q, D]
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    padded_len = k_ref.shape[1]
+    num_k = padded_len // block_k
+    if causal:
+        # only key blocks at or before this query block contribute
+        num_k = jax.lax.min(num_k, (qi * block_q + block_q + block_k - 1)
+                            // block_k)
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(ki * block_k, block_k)].astype(jnp.float32)
+        v = v_ref[0, pl.ds(ki * block_k, block_k)].astype(jnp.float32)
+        s = q @ k.T                                      # [block_q, block_k]
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < seq_len                          # mask tail padding
+        if causal:
+            valid = valid & (q_pos >= k_pos)
+        s = jnp.where(valid, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + p @ v
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_k, body, (m, l, acc))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    """q/k/v: [BH, T, D] → (out [BH, T, D], lse [BH, T]). T is padded up to
+    a block multiple so dynamic slices never clamp; padded keys are masked
+    by position, padded query rows are sliced away."""
+    bh, t, d = q.shape
+    tq = -(-t // block_q) * block_q
+    tk = -(-t // block_k) * block_k
+    tp = max(tq, tk)
+    if tp != t:
+        pad = ((0, 0), (0, tp - t), (0, 0))
+        q, k, v = (jnp.pad(a, pad) for a in (q, k, v))
+    grid = (bh, tp // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_len=t)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tp, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tp, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tp, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :t], lse[:, :t]
+
+
+def _reference(q, k, v, sm_scale, causal):
+    """jnp reference ([BH, T, D] layout), also the off-TPU fallback."""
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        t = q.shape[1]
+        i = jnp.arange(t)
+        s = jnp.where(i[:, None] >= i[None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                        interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                          interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+    """Backward from saved (q, k, v, out, lse): p is recomputed exactly via
+    the stored logsumexp, so no O(T²) tensor was saved in forward. XLA
+    handles the recompute contraction chain (it is matmul-shaped and
+    MXU-friendly); the kernel win is the forward's memory profile."""
+    q, k, v, out, lse = res
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * sm_scale
+    if causal:
+        t = q.shape[1]
+        i = jnp.arange(t)
+        s = jnp.where(i[:, None] >= i[None, :], s, NEG_INF)
+    p = jnp.exp(s - lse[..., None])                       # exact softmax
+    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # [BH, T]
+    ds = p * (dp - delta[..., None]) * sm_scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf).astype(q.dtype)
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf).astype(k.dtype)
+    return dq, dk, dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused attention. q/k/v: [B, T, H, D] → [B, T, H, D].
+
+    Dispatches to the Pallas kernel on TPU (or interpret mode when forced);
+    off-TPU uses the jnp reference so behaviour is identical everywhere."""
+    b, t, h, d = q.shape
+    sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if interpret is None and not on_tpu:
+        out = _reference(qr, kr, vr, sm_scale, causal)
+    else:
+        bq = min(block_q, t)
+        bk = min(block_k, t)
+        out = _flash(qr, kr, vr, sm_scale, causal, bq, bk,
+                     bool(interpret))
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

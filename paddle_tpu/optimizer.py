@@ -429,8 +429,18 @@ class StaticPruning:
                     if k in self.masks else p) for k, p in params.items()}
 
     def apply(self, optimizer: Optimizer) -> Optimizer:
-        """Wrap optimizer.update so every step re-applies the masks (reads
-        self.masks at call time — make_masks may run after apply)."""
+        """Wrap optimizer.update so every step re-applies the masks.
+
+        Call make_masks() FIRST: under jit the mask dict is baked in at
+        trace time, so an empty dict would silently disable pruning —
+        apply() refuses it. Re-wrapping the same optimizer also raises
+        (double-masking)."""
+        if not self.masks:
+            raise ValueError(
+                "StaticPruning.apply() before make_masks(): the masks are "
+                "trace-time constants under jit — build them first")
+        if getattr(optimizer, "_pruning_wrapped", False):
+            raise ValueError("optimizer already wrapped by StaticPruning")
         inner = optimizer.update
         hook = self
 
@@ -442,4 +452,5 @@ class StaticPruning:
             return hook.prune(new_p), new_s
 
         optimizer.update = update
+        optimizer._pruning_wrapped = True
         return optimizer

@@ -129,6 +129,19 @@ class SGD:
                  self.parameters.state) = ckpt_io.load_checkpoint(
                     latest, self.parameters.values, self.opt_state,
                     self.parameters.state)
+                if self.parallel is not None:
+                    # loaded host arrays must go back to the mesh layout
+                    # __init__ applied to the fresh init values
+                    self.parameters.values = self.parallel.shard_params(
+                        self.parameters.values)
+                    self.opt_state = jax.device_put(
+                        self.opt_state,
+                        self.parallel.state_shardings(self.opt_state))
+                    if self.parameters.state:
+                        self.parameters.state = jax.device_put(
+                            self.parameters.state,
+                            jax.tree.map(lambda _: self.parallel.replicated(),
+                                         self.parameters.state))
                 logger.info("resumed from %s (step %d)", latest, self._step)
             ckpt = ckpt_io.AsyncCheckpointer(checkpoint_dir)
 

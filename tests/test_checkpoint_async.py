@@ -43,15 +43,18 @@ class TestAsyncCheckpointer:
         with pytest.raises(IOError, match="checksum"):
             ckpt.load_checkpoint(path, {"w": jnp.ones(4)})
 
-    def test_worker_error_surfaces(self, tmp_path):
+    def test_worker_error_surfaces_and_recovers(self, tmp_path):
         ac = ckpt.AsyncCheckpointer(str(tmp_path / "nope"))
         # break the writer: save_dir is a file
         open(tmp_path / "nope", "w").close()
         ac.save(1, {"w": jnp.ones(2)})
         with pytest.raises(Exception):
             ac.wait()
-            ac.save(2, {"w": jnp.ones(2)})
-            ac.wait()
+        # after the error surfaced, the dir is fixed and saving works again
+        os.remove(tmp_path / "nope")
+        ac.save(2, {"w": jnp.ones(2)})
+        ac.close()
+        assert ckpt.latest_checkpoint(str(tmp_path / "nope")) is not None
 
 
 class TestShardedLayout:

@@ -1,0 +1,98 @@
+"""GAN/VAE models (reference: v1_api_demo/gan, v1_api_demo/vae) and the
+runnable demo scripts (reference: v1_api_demo/ entry points — the book-style
+e2e smoke layer of the test pyramid, SURVEY.md §4.5)."""
+
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from paddle_tpu.models import gan, vae
+
+
+class TestVAE:
+    def test_elbo_decreases_and_reconstructs(self, rng):
+        cfg = vae.VAEConfig(x_dim=64, hidden_dim=64, z_dim=8, lr=3e-3)
+        tr = vae.VAETrainer(cfg, jax.random.PRNGKey(0))
+        # structured data: two prototypes + noise, binarised
+        protos = (rng.rand(2, 64) > 0.5).astype(np.float32)
+        key = jax.random.PRNGKey(1)
+        losses = []
+        for i in range(60):
+            idx = rng.randint(0, 2, 32)
+            x = np.clip(protos[idx] + 0.05 * rng.randn(32, 64), 0, 1)
+            key, sub = jax.random.split(key)
+            losses.append(tr.train_batch(sub, x.astype(np.float32)))
+        assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+        rec = np.asarray(tr.reconstruct(key, protos))
+        assert np.mean((rec > 0.5) == (protos > 0.5)) > 0.8
+
+    def test_sample_shape(self):
+        tr = vae.VAETrainer(vae.VAEConfig(x_dim=32, hidden_dim=32, z_dim=4),
+                            jax.random.PRNGKey(0))
+        s = np.asarray(tr.sample(jax.random.PRNGKey(1), 5))
+        assert s.shape == (5, 32)
+        assert (s >= 0).all() and (s <= 1).all()
+
+
+class TestGAN:
+    def test_mlp_gan_learns_mean(self, rng):
+        """G should pull its sample distribution toward the data mean."""
+        cfg = gan.GANConfig(noise_dim=4, sample_dim=8, hidden_dim=32,
+                            lr=2e-3)
+        tr = gan.GANTrainer(cfg, jax.random.PRNGKey(0))
+        target_mean = 0.7
+        key = jax.random.PRNGKey(1)
+        before = float(np.mean(np.asarray(
+            tr.sample(jax.random.PRNGKey(9), 256))))
+        for i in range(150):
+            real = (target_mean +
+                    0.05 * rng.randn(64, 8)).astype(np.float32)
+            key, sub = jax.random.split(key)
+            d_loss, g_loss = tr.train_batch(sub, real)
+        after = float(np.mean(np.asarray(
+            tr.sample(jax.random.PRNGKey(9), 256))))
+        assert abs(after - target_mean) < abs(before - target_mean), \
+            (before, after)
+        assert abs(after - target_mean) < 0.3
+
+    def test_conv_gan_shapes(self, rng):
+        cfg = gan.GANConfig(noise_dim=8, sample_dim=784, conv=True)
+        tr = gan.GANTrainer(cfg, jax.random.PRNGKey(0))
+        real = rng.randn(4, 784).astype(np.float32)
+        d_loss, g_loss = tr.train_batch(jax.random.PRNGKey(1), real)
+        assert np.isfinite(d_loss) and np.isfinite(g_loss)
+        s = np.asarray(tr.sample(jax.random.PRNGKey(2), 3))
+        assert s.shape == (3, 784)
+
+
+DEMOS = [
+    ("demos/mnist/api_train.py", ["--passes", "1", "--batch-size", "512"]),
+    ("demos/quick_start/train_ctr.py",
+     ["--passes", "1", "--wide-dim", "500", "--vocab", "500"]),
+    ("demos/sequence_tagging/linear_crf.py",
+     ["--passes", "1", "--vocab", "100"]),
+    ("demos/gan/gan_trainer.py", ["--batches", "6", "--batch-size", "16"]),
+    ("demos/vae/vae_train.py", ["--batches", "6", "--batch-size", "32"]),
+    ("demos/seqToseq/train.py",
+     ["--passes", "1", "--dict-size", "200", "--batch-size", "64"]),
+]
+
+
+class TestDemoScripts:
+    @pytest.mark.parametrize("script,args",
+                             DEMOS, ids=[d[0].split("/")[1] for d in DEMOS])
+    def test_demo_runs(self, script, args):
+        env = dict(os.environ, PADDLE_TPU_COMPUTE_DTYPE="float32",
+                   JAX_PLATFORMS="")
+        code = ("import jax; jax.config.update('jax_platforms','cpu'); "
+                f"import runpy, sys; sys.argv=[{script!r}]+{args!r}; "
+                f"runpy.run_path({script!r}, run_name='__main__')")
+        r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                           capture_output=True, text=True, timeout=600)
+        assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])

@@ -1,0 +1,63 @@
+"""Flash-attention Pallas kernel vs the full-attention reference — run in
+interpret mode on CPU (the kernel itself targets TPU; SURVEY.md §4.7
+fake-backend strategy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas import attention as fa
+from paddle_tpu.parallel import ring
+
+
+def make_qkv(rng, b=2, t=64, h=2, d=16):
+    mk = lambda: jnp.asarray(rng.randn(b, t, h, d).astype(np.float32) * 0.5)
+    return mk(), mk(), mk()
+
+
+class TestFlashForward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, rng, causal):
+        q, k, v = make_qkv(rng)
+        out = fa.flash_attention(q, k, v, causal=causal, interpret=True,
+                                 block_q=32, block_k=32)
+        ref = ring.full_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_uneven_blocks(self, rng):
+        # t=48 with block 32: ragged final block
+        q, k, v = make_qkv(rng, t=48)
+        out = fa.flash_attention(q, k, v, causal=True, interpret=True,
+                                 block_q=32, block_k=32)
+        ref = ring.full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_cpu_fallback_matches(self, rng):
+        q, k, v = make_qkv(rng, t=32)
+        out = fa.flash_attention(q, k, v, causal=True)  # jnp fallback path
+        ref = ring.full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+class TestFlashBackward:
+    def test_grads_match_reference(self, rng):
+        q, k, v = make_qkv(rng, b=1, t=32, h=2, d=8)
+
+        def loss_flash(q, k, v):
+            o = fa.flash_attention(q, k, v, causal=True, interpret=True,
+                                   block_q=16, block_k=16)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        def loss_ref(q, k, v):
+            o = ring.full_attention(q, k, v, causal=True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)

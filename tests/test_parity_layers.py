@@ -17,23 +17,6 @@ from paddle_tpu.topology import Topology, Value
 from paddle_tpu.utils.rng import KeySource
 
 
-def run_layer(out, feed, extra_params=None):
-    topo = Topology(out)
-    params = paddle.parameters.create(out, KeySource(0))
-    if extra_params:
-        for k, v in extra_params.items():
-            params.values[k] = jnp.asarray(v)
-    fwd = topo.compile()
-    outs, _ = fwd(params.values, params.state,
-                  {k: Value(jnp.asarray(a)) if not isinstance(v, tuple)
-                   else Value(jnp.asarray(v[0]), jnp.asarray(v[1]))
-                   for k, (a, v) in
-                   {k: (v if not isinstance(v, tuple) else v[0], v)
-                    for k, v in feed.items()}.items()},
-                  is_training=False)
-    return outs[out.name], params
-
-
 class TestMDLSTM:
     def test_mdlstm_matches_naive(self, rng):
         n, H, W, C, D = 2, 3, 4, 5, 6
