@@ -156,3 +156,91 @@ class TestMasterFedTrainer:
             got.append(pickle.loads(rec))
         assert len(got) == 32          # including A's abandoned records
         assert svc.epoch() == 1
+
+
+class TestElasticResume:
+    """End-to-end preemption story (reference: the go/master task-lease +
+    pserver-checkpoint combination, doc/design/cluster_train — any trainer
+    can die; its task is redelivered; state resumes from checkpoints):
+    trainer A checkpoints mid-stream and is preempted holding a task lease;
+    trainer B resumes from A's checkpoint AND the master redelivers A's
+    abandoned records."""
+
+    def _build_trainer(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import layer
+        from paddle_tpu.utils.rng import KeySource
+
+        x = layer.data("el_x", paddle.data_type.dense_vector(4))
+        lbl = layer.data("el_l", paddle.data_type.integer_value(2))
+        out = layer.fc(x, 2, act=paddle.activation.Softmax(), name="el_out")
+        cost = layer.classification_cost(out, lbl, name="el_cost")
+        params = paddle.parameters.create(cost, KeySource(21))
+        return paddle.trainer.SGD(
+            cost=cost, parameters=params,
+            update_equation=paddle.optimizer.Momentum(learning_rate=0.05))
+
+    def test_preempted_trainer_resumes_and_master_redelivers(self, tmp_path):
+        import pickle
+
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.io import checkpoint as ckpt_io
+        from paddle_tpu.runtime import recordio
+        from paddle_tpu.runtime.master import MasterClient, MasterService
+
+        rng = np.random.RandomState(3)
+        path = str(tmp_path / "data.rio")
+        with recordio.Writer(path, records_per_chunk=8) as w:
+            for i in range(64):
+                y = int(rng.randint(2))
+                w.write(pickle.dumps(
+                    ((rng.randn(4) + 2 * y).astype(np.float32), y)))
+
+        clock = [0.0]
+        svc = MasterService(lease_seconds=5.0, num_passes=1,
+                            time_fn=lambda: clock[0])
+        svc.set_dataset([path])
+        ckdir = str(tmp_path / "ck")
+
+        # trainer A: consumes 3 tasks, checkpoints, then is "preempted"
+        # while holding a 4th lease it never finishes
+        a_client = MasterClient(service=svc)
+        tr_a = self._build_trainer()
+        consumed = []
+        for _ in range(3):
+            task = a_client.get_task()
+            recs = [pickle.loads(r) for off, _ in task.chunks
+                    for r in recordio.read_chunk(task.path, off)]
+            consumed.extend(recs)
+            tr_a.train(reader=paddle.batch(lambda: iter(recs), 8),
+                       num_passes=1, checkpoint_dir=ckdir)
+            a_client.report_done(task.task_id, task.lease)
+        abandoned = a_client.get_task()      # preempted holding this lease
+        assert abandoned is not None
+        step_a = tr_a._step
+        assert ckpt_io.latest_checkpoint(ckdir) is not None
+
+        clock[0] += 10.0                     # A's lease expires
+
+        # trainer B: fresh object (fresh process equivalent) resumes from
+        # A's checkpoint and streams every remaining record incl. A's
+        # abandoned task
+        b_client = MasterClient(service=svc)
+        tr_b = self._build_trainer()
+        remaining = []
+        while True:
+            task = b_client.get_task()
+            if task is None:
+                break
+            recs = [pickle.loads(r) for off, _ in task.chunks
+                    for r in recordio.read_chunk(task.path, off)]
+            remaining.extend(recs)
+            tr_b.train(reader=paddle.batch(lambda: iter(recs), 8),
+                       num_passes=1, checkpoint_dir=ckdir)
+            b_client.report_done(task.task_id, task.lease)
+
+        assert tr_b._step > step_a           # resumed, not restarted
+        assert len(consumed) + len(remaining) == 64   # no record lost
+        assert svc.epoch() == 1
