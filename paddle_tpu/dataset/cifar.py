@@ -58,3 +58,14 @@ def test100():
     return common.synthetic_fallback(
         "cifar", "test100",
         synthetic.classification(1024, 3072, 100, seed=131, noise=0.5))
+
+
+def convert(path, line_count=1024):
+    """Write the dataset as recordio chunks (reference: the
+    per-module convert() feeding cloud training)."""
+    out = []
+    out += common.convert(path, train10(), line_count, 'cifar_train10')
+    out += common.convert(path, test10(), line_count, 'cifar_test10')
+    out += common.convert(path, train100(), line_count, 'cifar_train100')
+    out += common.convert(path, test100(), line_count, 'cifar_test100')
+    return out

@@ -97,3 +97,13 @@ def split_to_recordio(reader_fn, path_pattern, line_count=1024):
         recordio.write_records(path, chunk)
         paths.append(path)
     return paths
+
+
+def convert(output_path, reader_fn, line_count, name_prefix):
+    """Convert a reader to recordio chunk files named
+    ``<name_prefix>-%05d`` under output_path (reference: common.py:194 —
+    the cloud-training preprocessing step feeding the master's task
+    dispatch). Returns the written paths."""
+    os.makedirs(output_path, exist_ok=True)
+    pattern = os.path.join(output_path, f"{name_prefix}-%05d")
+    return split_to_recordio(reader_fn, pattern, line_count)

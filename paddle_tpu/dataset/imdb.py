@@ -108,3 +108,12 @@ def test(word_idx=None):
     return common.synthetic_fallback(
         "imdb", "test", synthetic.sequence_classification(
             512, n, 2, seed=211, min_len=8, max_len=60))
+
+
+def convert(path, line_count=1024):
+    """Write the dataset as recordio chunks (reference: the
+    per-module convert() feeding cloud training)."""
+    out = []
+    out += common.convert(path, train(), line_count, 'imdb_train')
+    out += common.convert(path, test(), line_count, 'imdb_test')
+    return out

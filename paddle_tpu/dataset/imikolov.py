@@ -119,3 +119,12 @@ def test(word_idx=None, n=5, data_type=DataType.NGRAM):
         return common.real_data(_real_reader(VALID_FILE, wi, n, data_type))
     vocab = len(word_idx) if word_idx else VOCAB_SIZE
     return _synthetic("test", 256, vocab, n, 311, data_type)
+
+
+def convert(path, line_count=1024):
+    """Write the dataset as recordio chunks (reference: the
+    per-module convert() feeding cloud training)."""
+    out = []
+    out += common.convert(path, train(), line_count, 'imikolov_train')
+    out += common.convert(path, test(), line_count, 'imikolov_test')
+    return out

@@ -49,3 +49,12 @@ def test():
     if imgs and labs:
         return common.real_data(_idx_reader(imgs, labs))
     return _synthetic("test", 1024, seed=77)
+
+
+def convert(path, line_count=1024):
+    """Write the dataset as recordio chunks (reference: the
+    per-module convert() feeding cloud training)."""
+    out = []
+    out += common.convert(path, train(), line_count, 'mnist_train')
+    out += common.convert(path, test(), line_count, 'mnist_test')
+    return out

@@ -189,3 +189,12 @@ def user_info():
 
 def movie_info():
     return _load_meta()["movies"]
+
+
+def convert(path, line_count=1024):
+    """Write the dataset as recordio chunks (reference: the
+    per-module convert() feeding cloud training)."""
+    out = []
+    out += common.convert(path, train(), line_count, 'movielens_train')
+    out += common.convert(path, test(), line_count, 'movielens_test')
+    return out

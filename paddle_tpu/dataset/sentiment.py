@@ -86,3 +86,12 @@ def test():
     return common.synthetic_fallback(
         "sentiment", "test", synthetic.sequence_classification(
             400, VOCAB_SIZE, 2, seed=611, min_len=20, max_len=200))
+
+
+def convert(path, line_count=1024):
+    """Write the dataset as recordio chunks (reference: the
+    per-module convert() feeding cloud training)."""
+    out = []
+    out += common.convert(path, train(), line_count, 'sentiment_train')
+    out += common.convert(path, test(), line_count, 'sentiment_test')
+    return out

@@ -34,3 +34,12 @@ def test():
     return common.synthetic_fallback(
         "uci_housing", "test", synthetic.regression(102, FEATURE_DIM,
                                                     seed=33))
+
+
+def convert(path, line_count=1024):
+    """Write the dataset as recordio chunks (reference: the
+    per-module convert() feeding cloud training)."""
+    out = []
+    out += common.convert(path, train(), line_count, 'uci_housing_train')
+    out += common.convert(path, test(), line_count, 'uci_housing_test')
+    return out

@@ -48,3 +48,73 @@ def sparse_vector_to_dense(indices, values, dim, batch_offsets=None):
         lo, hi = batch_offsets[i], batch_offsets[i + 1]
         out[i, indices[lo:hi]] = values[lo:hi] if values is not None else 1.0
     return out
+
+
+class CSRMatrix:
+    """Compressed-sparse-row matrix with STATIC nnz — the XLA-compatible
+    CSR (reference: paddle/math/CpuSparseMatrix.h / SparseMatrix.h CSR
+    storage). indptr [rows+1], indices [nnz], data [nnz]; padding entries
+    (beyond a row's true nnz) carry index 0 / data 0 so every op is a
+    masked dense gather — no dynamic shapes under jit.
+
+    The reference used CSR for sparse *inputs* (high-dim id features) and
+    sparse weight matrices; on TPU the former maps to gathers and the
+    latter is usually better dense-bf16, but the format itself round-trips
+    for interchange and host-side construction."""
+
+    def __init__(self, indptr, indices, data, shape):
+        self.indptr = jnp.asarray(indptr, jnp.int32)
+        self.indices = jnp.asarray(indices, jnp.int32)
+        self.data = jnp.asarray(data)
+        self.shape = tuple(shape)
+
+    @classmethod
+    def from_dense(cls, dense):
+        import numpy as np
+        d = np.asarray(dense)
+        rows, cols = d.shape
+        indptr = [0]
+        indices, data = [], []
+        for r in range(rows):
+            nz = np.nonzero(d[r])[0]
+            indices.extend(nz.tolist())
+            data.extend(d[r, nz].tolist())
+            indptr.append(len(indices))
+        return cls(np.asarray(indptr), np.asarray(indices, np.int64),
+                   np.asarray(data, d.dtype), (rows, cols))
+
+    def to_dense(self) -> jax.Array:
+        import numpy as np
+        out = np.zeros(self.shape, np.asarray(self.data).dtype)
+        indptr = np.asarray(self.indptr)
+        for r in range(self.shape[0]):
+            lo, hi = int(indptr[r]), int(indptr[r + 1])
+            np.add.at(out[r], np.asarray(self.indices[lo:hi]),
+                      np.asarray(self.data[lo:hi]))
+        return jnp.asarray(out)
+
+    @property
+    def nnz(self):
+        return int(self.indptr[-1])
+
+    def matmul_dense(self, b: jax.Array) -> jax.Array:
+        """CSR @ dense via gather + segment-sum (jit-safe: static nnz).
+        Replaces Matrix::mul(CpuSparseMatrix, ...) (reference:
+        paddle/math/Matrix.cpp sparse paths)."""
+        nnz = self.indices.shape[0]
+        # row id of each stored entry from indptr (searchsorted broadcast)
+        entry = jnp.arange(nnz)
+        row_of = jnp.searchsorted(self.indptr[1:], entry, side="right")
+        contrib = self.data[:, None] * b[self.indices]      # [nnz, cols]
+        return jax.ops.segment_sum(contrib, row_of,
+                                   num_segments=self.shape[0])
+
+    def transpose_matmul_dense(self, b: jax.Array) -> jax.Array:
+        """CSR^T @ dense — scatter-add into the column space (the CSC-use
+        case; the reference kept a separate CSC format, same capability)."""
+        nnz = self.indices.shape[0]
+        entry = jnp.arange(nnz)
+        row_of = jnp.searchsorted(self.indptr[1:], entry, side="right")
+        contrib = self.data[:, None] * b[row_of]            # [nnz, cols]
+        return jnp.zeros((self.shape[1], b.shape[1]), contrib.dtype).at[
+            self.indices].add(contrib)

@@ -109,3 +109,55 @@ def test_sequence_batch_is_pytree():
     assert len(leaves) == 2  # data, lengths (sub_lengths None dropped)
     out = jax.jit(lambda s: s.with_data(s.data * 2))(sb)
     assert float(out.data[0, 0]) == 2.0
+
+
+class TestHbmBudget:
+    """utils/memory — the BuddyAllocator slot's budgeting decisions
+    (reference: paddle/memory/detail/buddy_allocator.h), done ahead of
+    time from compiled memory analysis instead of trial-and-OOM."""
+
+    def test_step_memory_reports_peak(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.utils import memory
+
+        def f(x):
+            return (x @ x).sum()
+
+        m = memory.step_memory(f, jnp.ones((256, 256), jnp.float32))
+        assert m["peak"] >= 256 * 256 * 4
+        assert m["arguments"] == 256 * 256 * 4
+
+    def test_max_batch_size_monotone(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.utils import memory
+
+        def build(batch):
+            x = jax.ShapeDtypeStruct((batch, 1024), jnp.float32)
+            w = jax.ShapeDtypeStruct((1024, 1024), jnp.float32)
+            return (lambda x, w: jax.nn.relu(x @ w) @ w.T, (x, w))
+
+        # budget sized so ~64 rows of activations fit
+        per_row = 1024 * 4 * 4
+        b = memory.max_batch_size(build, budget_bytes=64 * per_row +
+                                  2 * 1024 * 1024 * 4, start=4, limit=512)
+        assert 4 <= b <= 512
+        # a bigger budget never gives a smaller answer
+        b2 = memory.max_batch_size(build, budget_bytes=2 * (64 * per_row) +
+                                   2 * 1024 * 1024 * 4, start=4, limit=512)
+        assert b2 >= b
+
+    def test_zero_when_nothing_fits(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.utils import memory
+
+        def build(batch):
+            x = jax.ShapeDtypeStruct((batch, 4096), jnp.float32)
+            return (lambda x: (x @ x.T).sum(), (x,))
+
+        assert memory.max_batch_size(build, budget_bytes=1024,
+                                     start=8) == 0

@@ -291,3 +291,35 @@ class TestDeconv3D:
         outs, _ = fwd(params.values, params.state,
                       {"dc_in": Value(jnp.asarray(x))}, is_training=False)
         assert outs["dc0"].array.shape == (2, 4 * 4 * 6 * 6)
+
+
+class TestNumericGrads:
+    """Numeric-gradient checks for the parity-tail ops (the op_test.py
+    harness discipline, SURVEY.md §4.2)."""
+
+    def test_row_conv_grads(self, rng):
+        from op_test_util import check_grad
+
+        from paddle_tpu.ops import sequence as ops_seq
+        x = jnp.asarray(rng.randn(2, 5, 3).astype(np.float32))
+        lens = jnp.asarray([5, 3])
+        w = jnp.asarray(rng.randn(2, 3).astype(np.float32))
+        check_grad(lambda x, w: ops_seq.row_conv(x, lens, w), (x, w), wrt=0)
+        check_grad(lambda x, w: ops_seq.row_conv(x, lens, w), (x, w), wrt=1)
+
+    def test_mdlstm_grads(self, rng):
+        from op_test_util import check_grad
+        x = jnp.asarray(rng.randn(1, 3, 3, 4).astype(np.float32))
+        w_ih = jnp.asarray((rng.randn(4, 15) * 0.3).astype(np.float32))
+        w_hx = jnp.asarray((rng.randn(3, 15) * 0.3).astype(np.float32))
+        w_hy = jnp.asarray((rng.randn(3, 15) * 0.3).astype(np.float32))
+        for wrt in range(4):
+            check_grad(ops_rnn.mdlstm, (x, w_ih, w_hx, w_hy), wrt=wrt)
+
+    def test_lambda_rank_grad(self, rng):
+        from op_test_util import check_grad
+        s = jnp.asarray(rng.randn(2, 4).astype(np.float32))
+        rel = jnp.asarray(rng.randint(0, 3, (2, 4)).astype(np.float32))
+        lens = jnp.asarray([4, 3])
+        check_grad(lambda s: ops_loss.lambda_rank(s, rel, lens), (s,),
+                   wrt=0)
