@@ -104,3 +104,69 @@ def test_huber_hinge(rng):
     check_forward(loss.huber_classification, (pred, lab), ref_huber, rtol=1e-5)
     ref_hinge = np.maximum(0, 1 - a)
     check_forward(loss.hinge, (pred, lab), ref_hinge, rtol=1e-5)
+
+
+class TestFusedBNBackward:
+    """The hand-fused BN VJP (_bn_apply custom_vjp) must agree with plain
+    autodiff of the same math (reference slot: batch_norm_op.cc backward
+    kernels)."""
+
+    def _autodiff_bn(self, x, gamma, beta, eps=1e-5):
+        import jax
+        import jax.numpy as jnp
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+        mean2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes)
+        var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+        inv = jax.lax.rsqrt(var + eps)
+        g32 = gamma.astype(jnp.float32)
+        scale = (g32 * inv).astype(x.dtype)
+        shift = (beta.astype(jnp.float32) - mean * g32 * inv).astype(x.dtype)
+        return x * scale + shift
+
+    def test_fused_vjp_matches_autodiff(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import norm
+        x = jnp.asarray(rng.randn(4, 6, 6, 8).astype(np.float32) * 2 + 1)
+        g = jnp.asarray(rng.rand(8).astype(np.float32) + 0.5)
+        b = jnp.asarray(rng.randn(8).astype(np.float32))
+        dy = jnp.asarray(rng.randn(4, 6, 6, 8).astype(np.float32))
+        axes = (0, 1, 2)
+
+        def fused(x, g, b):
+            return jnp.vdot(norm._bn_apply(x, g, b, axes, 1e-5), dy)
+
+        def ref(x, g, b):
+            return jnp.vdot(self._autodiff_bn(x, g, b), dy)
+
+        gf = jax.grad(fused, argnums=(0, 1, 2))(x, g, b)
+        gr = jax.grad(ref, argnums=(0, 1, 2))(x, g, b)
+        for a, e in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_train_bn_end_to_end_grads(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import norm
+        x = jnp.asarray(rng.randn(8, 5).astype(np.float32))
+        g = jnp.asarray(rng.rand(5).astype(np.float32) + 0.5)
+        b = jnp.asarray(rng.randn(5).astype(np.float32))
+        rm, rv = jnp.zeros(5), jnp.ones(5)
+
+        def loss(x, g, b):
+            y, nm, nv = norm.batch_norm_train(x, g, b, rm, rv)
+            return jnp.sum(jnp.square(y))
+
+        gx, gg, gb = jax.grad(loss, argnums=(0, 1, 2))(x, g, b)
+        # numeric check on gamma
+        eps = 1e-3
+        for i in range(2):
+            gp = g.at[i].add(eps)
+            gm = g.at[i].add(-eps)
+            num = (loss(x, gp, b) - loss(x, gm, b)) / (2 * eps)
+            np.testing.assert_allclose(float(gg[i]), float(num), rtol=2e-2)
+        assert np.isfinite(np.asarray(gx)).all()
