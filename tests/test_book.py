@@ -1,0 +1,125 @@
+"""Book-style end-to-end model tests (reference:
+python/paddle/v2/framework/tests/book/ — test_fit_a_line.py,
+test_word2vec.py, test_recommender_system.py,
+test_understand_sentiment_lstm.py: real model topologies trained a few
+iterations through the full stack, asserting the cost moves)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layer
+
+
+def train_and_costs(cost, reader, opt=None, passes=2, batch=32,
+                    feeding=None, extra_layers=None):
+    params = paddle.parameters.create(cost)
+    tr = paddle.trainer.SGD(
+        cost=cost, parameters=params, extra_layers=extra_layers,
+        update_equation=opt or paddle.optimizer.Adam(learning_rate=1e-2))
+    costs = []
+    tr.train(reader=paddle.batch(reader, batch), num_passes=passes,
+             feeding=feeding,
+             event_handler=lambda e: costs.append(e.cost)
+             if isinstance(e, paddle.event.EndIteration) else None)
+    return costs, tr
+
+
+class TestFitALine:
+    def test_uci_housing_linear_regression(self):
+        """(reference: book/test_fit_a_line.py)"""
+        x = layer.data("x", paddle.data_type.dense_vector(
+            paddle.dataset.uci_housing.FEATURE_DIM))
+        y = layer.data("y", paddle.data_type.dense_vector(1))
+        pred = layer.fc(x, 1, act=None, name="fal_fc")
+        cost = layer.square_error_cost(pred, y, name="fal_cost")
+        costs, _ = train_and_costs(
+            cost, paddle.dataset.uci_housing.train(), passes=10,
+            opt=paddle.optimizer.Adam(learning_rate=5e-2))
+        first = np.mean(costs[:3])
+        last = np.mean(costs[-3:])
+        assert last < first * 0.5, (first, last)
+
+
+class TestWord2Vec:
+    def test_imikolov_ngram_lm(self):
+        """N-gram word embedding LM (reference: book/test_word2vec.py —
+        4 context words -> next word through shared embeddings)."""
+        N, emb_dim, hidden = 5, 16, 32
+        vocab = paddle.dataset.imikolov.VOCAB_SIZE
+        words = [layer.data(f"w{i}", paddle.data_type.integer_value(vocab))
+                 for i in range(N - 1)]
+        target = layer.data("wt", paddle.data_type.integer_value(vocab))
+        embs = [layer.embedding(w, emb_dim, name=f"w2v_emb{i}",
+                                param_attr=layer.ParamAttr(name="w2v_emb.w"))
+                for i, w in enumerate(words)]
+        ctx = layer.concat(embs, name="w2v_ctx")
+        h = layer.fc(ctx, hidden, act=paddle.activation.Relu(),
+                     name="w2v_h")
+        out = layer.fc(h, vocab, act=paddle.activation.Softmax(),
+                       name="w2v_out")
+        cost = layer.classification_cost(out, target, name="w2v_cost")
+
+        def reader():
+            for sample in paddle.dataset.imikolov.train(n=N)():
+                yield sample
+        costs, _ = train_and_costs(
+            cost, reader, passes=1, batch=64,
+            feeding={f"w{i}": i for i in range(N - 1)} | {"wt": N - 1})
+        assert costs[-1] < costs[0], (costs[0], costs[-1])
+
+
+class TestRecommender:
+    def test_movielens_dot_product_model(self):
+        """User/movie feature towers -> rating via cos similarity
+        (reference: book/test_recommender_system.py, shrunk)."""
+        ml = paddle.dataset.movielens
+        uid = layer.data("uid", paddle.data_type.integer_value(
+            ml.max_user_id() + 1))
+        mid = layer.data("mid", paddle.data_type.integer_value(
+            ml.max_movie_id() + 1))
+        rating = layer.data("rating", paddle.data_type.dense_vector(1))
+        uemb = layer.embedding(uid, 16, name="rec_uemb")
+        memb = layer.embedding(mid, 16, name="rec_memb")
+        uvec = layer.fc(uemb, 16, act=paddle.activation.Relu(),
+                        name="rec_ufc")
+        mvec = layer.fc(memb, 16, act=paddle.activation.Relu(),
+                        name="rec_mfc")
+        sim = layer.cos_sim(uvec, mvec, scale=5.0, name="rec_sim")
+        cost = layer.square_error_cost(sim, rating, name="rec_cost")
+
+        def reader():
+            for s in ml.train()():
+                # schema: [uid, gender, age, job, mid, cats, title, [rating]]
+                yield s[0], s[4], np.asarray(s[7], np.float32)
+        costs, _ = train_and_costs(
+            cost, reader, passes=1, batch=64,
+            feeding={"uid": 0, "mid": 1, "rating": 2})
+        assert np.mean(costs[-5:]) < np.mean(costs[:5])
+
+
+class TestUnderstandSentiment:
+    def test_imdb_lstm_classifier(self):
+        """LSTM sentiment classifier on the IMDB schema (reference:
+        book/test_understand_sentiment_lstm.py)."""
+        from paddle_tpu.models import text
+        vocab = paddle.dataset.imdb.VOCAB_SIZE + 1
+        words = layer.data("words",
+                           paddle.data_type.integer_value_sequence(vocab))
+        lbl = layer.data("label", paddle.data_type.integer_value(2))
+        out = text.lstm_text_classification(words, hidden_dim=32,
+                                            class_num=2, emb_dim=32)
+        cost = layer.classification_cost(out, lbl, name="us_cost")
+        err = paddle.evaluator.classification_error(out, lbl, name="us_err")
+
+        def limited():
+            for i, s in enumerate(paddle.dataset.imdb.train()()):
+                if i >= 512:
+                    break
+                yield s
+        costs, tr = train_and_costs(cost, limited, passes=3, batch=32,
+                                    extra_layers=[err])
+        assert costs[-1] < costs[0] * 0.9, (costs[0], costs[-1])
+        # the synthetic task is separable: training error should be low
+        res = tr.evaluators.result()
+        assert res["us_err"] < 0.35, res
