@@ -81,6 +81,8 @@ DEMOS = [
     ("demos/vae/vae_train.py", ["--batches", "6", "--batch-size", "32"]),
     ("demos/seqToseq/train.py",
      ["--passes", "1", "--dict-size", "200", "--batch-size", "64"]),
+    ("demos/traffic_prediction/train.py",
+     ["--passes", "1", "--batch-size", "256"]),
 ]
 
 
