@@ -10,8 +10,10 @@ the public FlashAttention recipe on the MXU).
 Layout: q/k/v are [B, T, H, D] (the framework's attention layout). The
 kernel grids over (batch·heads, query blocks) with an inner
 ``lax.fori_loop`` over key blocks; running max/denominator live in VMEM
-scratch. Backward is a custom VJP that recomputes attention blockwise with
-XLA from the saved (out, logsumexp) — fwd memory stays O(T·D).
+scratch. Backward is a second Pallas kernel gridded over key blocks that
+streams query blocks, reconstructing p exactly from the saved logsumexp —
+no O(T²) tensor exists in either direction; dq accumulates in an fp32
+output revisited across key-block grid steps.
 
 Off-TPU the public entry falls back to the jnp reference; tests run the
 kernel in interpret mode.
@@ -69,14 +71,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     lse_ref[0] = m + jnp.log(l_safe)
 
 
+def _pad_to_blocks(t, block_q, block_k):
+    """Common padded length for fwd and bwd — they must agree exactly (the
+    backward reconstructs p from the forward's lse)."""
+    return max(-(-t // block_q) * block_q, -(-t // block_k) * block_k)
+
+
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     """q/k/v: [BH, T, D] → (out [BH, T, D], lse [BH, T]). T is padded up to
     a block multiple so dynamic slices never clamp; padded keys are masked
     by position, padded query rows are sliced away."""
     bh, t, d = q.shape
-    tq = -(-t // block_q) * block_q
-    tk = -(-t // block_k) * block_k
-    tp = max(tq, tk)
+    tp = _pad_to_blocks(t, block_q, block_k)
     if tp != t:
         pad = ((0, 0), (0, tp - t), (0, 0))
         q, k, v = (jnp.pad(a, pad) for a in (q, k, v))
@@ -131,29 +137,113 @@ def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
+def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dq_ref, dk_ref, dv_ref, *, sm_scale, causal, block_q,
+                block_k, seq_len):
+    """Backward over one KEY block (grid: batch·heads × key blocks).
+
+    Inner loop streams query blocks; p is reconstructed exactly from the
+    stored logsumexp, ds from the precomputed delta = Σ(do·out), so no
+    [T, T] tensor ever exists. dk/dv accumulate locally; dq accumulates
+    into its output ref across key-block grid steps (revisited output
+    block — the TPU grid is sequential, so += is race-free); the dq
+    output is fp32 so the repeated read-modify-write never rounds in
+    bf16."""
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                     # [block_k, D]
+    v = v_ref[0].astype(jnp.float32)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    padded_len = q_ref.shape[1]
+    num_q = padded_len // block_q
+    q_start = (ki * block_k) // block_q if causal else 0
+
+    dk = jnp.zeros_like(k)
+    dv = jnp.zeros_like(v)
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qi * block_q, block_q)].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qi * block_q, block_q)].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q)]
+        s = (q @ k.T) * sm_scale                         # [block_q, block_k]
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        valid = (k_pos < seq_len) & (q_pos < seq_len)
+        if causal:
+            valid = valid & (q_pos >= k_pos)
+        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
+        dv = dv + p.T @ do
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dq_ref[0, pl.ds(qi * block_q, block_q)] += (ds @ k).astype(
+            dq_ref.dtype)
+        dk = dk + ds.T @ q
+        return dk, dv
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
+    dk, dv = jax.lax.fori_loop(q_start, num_q, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, do, sm_scale, causal, block_q,
+                      block_k, interpret):
+    bh, t, d = q.shape
+    tp = _pad_to_blocks(t, block_q, block_k)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                              # [BH, T]
+    if tp != t:
+        pad3 = ((0, 0), (0, tp - t), (0, 0))
+        pad2 = ((0, 0), (0, tp - t))
+        q, k, v, do = (jnp.pad(a, pad3) for a in (q, k, v, do))
+        # padded lse must stay finite: exp(s - lse) with lse=0 on padded
+        # rows is masked out by `valid` anyway
+        lse = jnp.pad(lse, pad2)
+        delta = jnp.pad(delta, pad2)
+    kernel = functools.partial(
+        _bwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_len=t)
+    grid = (bh, tp // block_k)
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tp, d), lambda b, i: (b, 0, 0)),   # q
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),  # k
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),  # v
+            pl.BlockSpec((1, tp, d), lambda b, i: (b, 0, 0)),   # do
+            pl.BlockSpec((1, tp), lambda b, i: (b, 0)),         # lse
+            pl.BlockSpec((1, tp), lambda b, i: (b, 0)),         # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tp, d), lambda b, i: (b, 0, 0)),   # dq
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),  # dk
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),  # dv
+        ],
+        out_shape=[
+            # dq accumulates across key-block revisits: keep it fp32 so
+            # a bf16 read-modify-write chain can't round away increments
+            jax.ShapeDtypeStruct((bh, tp, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tp, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tp, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq[:, :t].astype(q.dtype), dk[:, :t], dv[:, :t]
+
+
 def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
-    """Backward from saved (q, k, v, out, lse): p is recomputed exactly via
-    the stored logsumexp, so no O(T²) tensor was saved in forward. XLA
-    handles the recompute contraction chain (it is matmul-shaped and
-    MXU-friendly); the kernel win is the forward's memory profile."""
+    """Backward from saved (q, k, v, out, lse) — a Pallas kernel streaming
+    query blocks per key block, so no O(T²) tensor exists in backward
+    either; p/ds reconstruct exactly from the stored logsumexp."""
     q, k, v, out, lse = res
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * sm_scale
-    if causal:
-        t = q.shape[1]
-        i = jnp.arange(t)
-        s = jnp.where(i[:, None] >= i[None, :], s, NEG_INF)
-    p = jnp.exp(s - lse[..., None])                       # exact softmax
-    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
-    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
-    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # [BH, T]
-    ds = p * (dp - delta[..., None]) * sm_scale
-    dq = jnp.einsum("bqk,bkd->bqd", ds, kf).astype(q.dtype)
-    dk = jnp.einsum("bqk,bqd->bkd", ds, qf).astype(k.dtype)
-    return dq, dk, dv.astype(v.dtype)
+    return _flash_bwd_pallas(q, k, v, out, lse, do, sm_scale, causal,
+                             block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
