@@ -143,7 +143,9 @@ def build_train_step():
 
     img = layer.data("image", paddle.data_type.dense_vector(3 * 224 * 224))
     lbl = layer.data("label", paddle.data_type.integer_value(1000))
-    out = resnet.resnet_imagenet(img, depth=50, class_num=1000)
+    out = resnet.resnet_imagenet(
+        img, depth=50, class_num=1000,
+        stem_space_to_depth=os.environ.get("BENCH_S2D", "0") == "1")
     cost = layer.classification_cost(out, lbl, name="cost")
     topo = Topology(cost)
     params = paddle.parameters.create(cost, KeySource(42))

@@ -60,14 +60,26 @@ _DEPTH_CFG = {
 }
 
 
-def resnet_imagenet(input, depth=50, class_num=1000, img_size=224):
-    """(reference: resnet.py:6 — 3x224x224, 1000 classes)"""
+def resnet_imagenet(input, depth=50, class_num=1000, img_size=224,
+                    stem_space_to_depth=False):
+    """(reference: resnet.py:6 — 3x224x224, 1000 classes).
+    stem_space_to_depth: compute the 7x7/s2 stem as a stride-1 conv over
+    space-to-depth input (numerically identical; lane-utilisation lever,
+    see layer.space_to_depth_conv)."""
     kind, counts = _DEPTH_CFG[depth]
     block = bottleneck_block if kind == "bottleneck" else basic_block
     expansion = 4 if kind == "bottleneck" else 1
 
-    conv1 = conv_bn_layer(input, 64, 7, 2, 3, activation.Relu(), ch_in=3,
-                          name="res_conv1")
+    if stem_space_to_depth:
+        if getattr(input, "_img_shape", None) is None:
+            input._out_channels, input._img_shape = 3, (img_size, img_size)
+        tmp = layer.space_to_depth_conv(input, 7, 64, num_channels=3,
+                                        act=None, name="res_conv1_conv")
+        conv1 = layer.batch_norm(tmp, act=activation.Relu(),
+                                 name="res_conv1_bn")
+    else:
+        conv1 = conv_bn_layer(input, 64, 7, 2, 3, activation.Relu(),
+                              ch_in=3, name="res_conv1")
     pool1 = layer.img_pool(conv1, pool_size=3, stride=2, padding=1,
                            pool_type=pooling.Max(), name="res_pool1")
 

@@ -74,3 +74,34 @@ def conv2d_transpose(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
         strides=_pair(stride), padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
+
+
+def space_to_depth(x: jax.Array, block: int = 2) -> jax.Array:
+    """[N, H, W, C] -> [N, H/b, W/b, C*b*b] (MLPerf ResNet stem layout:
+    trades the lane-starved C=3 input for C=12 and halves the spatial
+    grid so the first conv runs stride-1 on MXU-friendly shapes)."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    return jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(
+        n, h // block, w // block, block * block * c)
+
+
+def space_to_depth_conv_weights(w: jax.Array, block: int = 2) -> jax.Array:
+    """Transform [kH, kW, Cin, Cout] weights of a stride-``block`` conv
+    into the equivalent stride-1 kernel over space-to-depth input.
+
+    Derivation (block=2, k odd with pad k//2): pad the kernel on the LEFT
+    to even size so tap parity aligns with the 2x2 cells; tap (2a+d) of
+    the padded kernel lands in s2d cell a, channel slot d. The companion
+    conv uses padding (k//2 backed off to cells: left ceil(k//2/2),
+    right (k_pad//2 - 1)) — see resnet.py conv1 usage."""
+    kh, kw, cin, cout = w.shape
+    kh_p = -(-(kh + 1) // block) * block     # pad-left to block multiple
+    kw_p = -(-(kw + 1) // block) * block
+    wp = jnp.zeros((kh_p, kw_p, cin, cout), w.dtype)
+    wp = wp.at[kh_p - kh:, kw_p - kw:].set(w)
+    wp = wp.reshape(kh_p // block, block, kw_p // block, block, cin, cout)
+    # [a, dy, b, dx, c, f] -> [a, b, dy, dx, c, f] -> merge (dy, dx, c)
+    wp = jnp.transpose(wp, (0, 2, 1, 3, 4, 5))
+    return wp.reshape(kh_p // block, kw_p // block,
+                      block * block * cin, cout)

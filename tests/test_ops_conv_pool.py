@@ -91,3 +91,80 @@ def test_spp_shape(rng):
     out = pool.spp(jnp.asarray(x), 3)
     # bins: 1 + 4 + 16 = 21 positions x 3 channels
     assert out.shape == (2, 21 * 3)
+
+
+class TestSpaceToDepthStem:
+    """space_to_depth + transformed weights must reproduce the original
+    strided conv exactly (the MLPerf ResNet stem trick; lane-utilisation
+    lever recorded in BENCHMARKS.md)."""
+
+    def test_7x7_s2_equivalence(self, rng):
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import conv as ops_conv
+        x = jnp.asarray(rng.randn(2, 32, 32, 3).astype(np.float32))
+        w = jnp.asarray(rng.randn(7, 7, 3, 8).astype(np.float32))
+        ref = ops_conv.conv2d(x, w, stride=2, padding=3)
+        xs = ops_conv.space_to_depth(x, 2)
+        ws = ops_conv.space_to_depth_conv_weights(w, 2)
+        # kernel padded 7->8 on the left: s2d padding (2, 1) per axis
+        got = ops_conv.conv2d(xs, ws, stride=1, padding=((2, 1), (2, 1)))
+        assert got.shape == ref.shape
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_3x3_s2_equivalence(self, rng):
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import conv as ops_conv
+        x = jnp.asarray(rng.randn(1, 16, 16, 4).astype(np.float32))
+        w = jnp.asarray(rng.randn(3, 3, 4, 6).astype(np.float32))
+        ref = ops_conv.conv2d(x, w, stride=2, padding=1)
+        xs = ops_conv.space_to_depth(x, 2)
+        ws = ops_conv.space_to_depth_conv_weights(w, 2)
+        # kernel padded 3->4 on the left: s2d padding (1, 0) per axis
+        got = ops_conv.conv2d(xs, ws, stride=1, padding=((1, 0), (1, 0)))
+        assert got.shape == ref.shape
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_s2d_conv_layer_matches_img_conv(self, rng):
+        """layer.space_to_depth_conv must match img_conv(stride=2) given
+        identical canonical weights (the resnet stem swap)."""
+        import jax.numpy as jnp
+
+        import paddle_tpu as paddle
+        from paddle_tpu import layer
+        from paddle_tpu.topology import Topology, Value
+        from paddle_tpu.utils.rng import KeySource
+
+        x = rng.randn(2, 3 * 32 * 32).astype(np.float32)
+
+        img1 = layer.data("sc_im1", paddle.data_type.dense_vector(
+            3 * 32 * 32))
+        plain = layer.img_conv(img1, filter_size=7, num_filters=8,
+                               num_channels=3, stride=2, padding=3,
+                               act=None, bias_attr=False, name="sc_plain")
+        t1 = Topology(plain)
+        p1 = paddle.parameters.create(plain, KeySource(5))
+        o1, _ = t1.compile()(p1.values, p1.state,
+                             {"sc_im1": Value(jnp.asarray(x))},
+                             is_training=False)
+
+        img2 = layer.data("sc_im2", paddle.data_type.dense_vector(
+            3 * 32 * 32))
+        s2d = layer.space_to_depth_conv(img2, 7, 8, num_channels=3,
+                                        act=None, name="sc_s2d")
+        t2 = Topology(s2d)
+        p2 = paddle.parameters.create(s2d, KeySource(9))
+        p2.values["sc_s2d.w"] = p1.values["sc_plain.w"]
+        o2, _ = t2.compile()(p2.values, p2.state,
+                             {"sc_im2": Value(jnp.asarray(x))},
+                             is_training=False)
+
+        a = np.asarray(o1[plain.name].array, np.float32).reshape(2, -1)
+        b = np.asarray(o2[s2d.name].array, np.float32).reshape(2, -1)
+        assert s2d._img_shape == plain._img_shape == (16, 16)
+        np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-4)
