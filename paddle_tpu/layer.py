@@ -433,7 +433,12 @@ def dropout(input, dropout_rate: float, name: Optional[str] = None):
         mask = jax.random.bernoulli(key, keep, pv.array.shape)
         return pv.with_array(jnp.where(mask, pv.array / keep, 0.0))
 
-    return LayerOutput(name, "dropout", [input], fwd, [], size=input.size)
+    lo = LayerOutput(name, "dropout", [input], fwd, [], size=input.size)
+    # elementwise: image-shape hints pass through (conv chains with
+    # BN+dropout between convs must keep inferring channels)
+    lo._out_channels = getattr(input, "_out_channels", None)
+    lo._img_shape = getattr(input, "_img_shape", None)
+    return lo
 
 
 def concat(input: Sequence[LayerOutput], name: Optional[str] = None, act=None):
@@ -2316,38 +2321,41 @@ def img_conv3d_transpose(input, filter_size, num_filters: int, shape,
 def space_to_depth_conv(input, filter_size: int, num_filters: int,
                         num_channels: Optional[int] = None, act=None,
                         name: Optional[str] = None, param_attr=None,
-                        bias_attr=False, block: int = 2):
+                        bias_attr=False, block: int = 2, img_size=None):
     """Stride-``block`` conv computed as a stride-1 conv over
     space-to-depth input — numerically identical to
     img_conv(stride=block, padding=k//2) but with ``block²``× the input
     lanes and no strided window (the MLPerf ResNet-stem trick; the C=3
     stem wastes 125/128 lanes otherwise). Weights are stored in the
-    canonical [k, k, Cin, Cout] layout so checkpoints interchange with
-    the plain conv; the transform runs per step (negligible: the kernel
-    is KB-sized)."""
+    canonical [k, k, Cin, Cout] layout (same msra init as img_conv) so
+    checkpoints interchange with the plain conv; the transform runs per
+    step (negligible: the kernel is KB-sized, derivation + companion
+    padding in ops/conv.space_to_depth_conv_transform)."""
     name = name or auto_name("s2d_conv")
     act_name = act_mod.resolve(act)
     cin = num_channels or getattr(input, "_out_channels", None)
-    ih, iw = _infer_img_shape(input, cin, None)
+    enforce.enforce(cin is not None,
+                    f"s2d_conv {name}: num_channels required")
+    ih, iw = _infer_img_shape(input, cin, img_size)
+    enforce.enforce(ih is not None and ih % block == 0 and
+                    iw % block == 0,
+                    f"s2d_conv {name}: image size {ih}x{iw} must be known "
+                    f"and divisible by block={block} (pass img_size=)")
     k = filter_size
     attr = _param_attr(param_attr if isinstance(param_attr, ParamAttr)
-                       else ParamAttr(), f"{name}.w")
+                       else ParamAttr(initializer="msra"), f"{name}.w")
     w_spec = ParamSpec(attr.name, (k, k, cin, num_filters), attr=attr,
                        fan_in=cin * k * k)
     bias = _bias_spec(name, num_filters, bias_attr)
     specs = [w_spec] + ([bias] if bias else [])
     oh, ow = ih // block, iw // block
-    kp = -(-(k + 1) // block) * block
-    pad_l = (k // 2 + kp - k) // block
-    pad_r = (k // 2) // block
 
     def fwd(params, parents, ctx):
         x = _to_nhwc(parents[0].array, cin, ih, iw)
         xs = ops_conv.space_to_depth(x, block)
-        ws = ops_conv.space_to_depth_conv_weights(params[w_spec.name],
-                                                  block)
-        out = ops_conv.conv2d(xs, ws, stride=1,
-                              padding=((pad_l, pad_r), (pad_l, pad_r)))
+        ws, pads = ops_conv.space_to_depth_conv_transform(
+            params[w_spec.name], block)
+        out = ops_conv.conv2d(xs, ws, stride=1, padding=pads)
         if bias:
             out = out + params[bias.name].astype(out.dtype)
         return _apply_act(Value(out), act_name)

@@ -71,10 +71,9 @@ def resnet_imagenet(input, depth=50, class_num=1000, img_size=224,
     expansion = 4 if kind == "bottleneck" else 1
 
     if stem_space_to_depth:
-        if getattr(input, "_img_shape", None) is None:
-            input._out_channels, input._img_shape = 3, (img_size, img_size)
         tmp = layer.space_to_depth_conv(input, 7, 64, num_channels=3,
-                                        act=None, name="res_conv1_conv")
+                                        act=None, img_size=img_size,
+                                        name="res_conv1_conv")
         conv1 = layer.batch_norm(tmp, act=activation.Relu(),
                                  name="res_conv1_bn")
     else:

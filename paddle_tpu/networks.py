@@ -144,6 +144,128 @@ def gru_decoder_with_attention(encoded_sequence, encoded_proj, current_word,
 # composite nets are thin wrappers over recorded layer calls — the inner
 # records suffice for serialization, but install anyway so composites whose
 # inner calls are unrecordable still get a fallback record when possible
+def img_conv_group(input, conv_num_filter, pool_size, num_channels=None,
+                   conv_padding=1, conv_filter_size=3, conv_act=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0,
+                   pool_stride=None, pool_type=None, name=None):
+    """Chain of convs (optionally BN + dropout each) ending in one pool
+    (reference: networks.py img_conv_group — the VGG building block)."""
+    def listify(v):
+        return v if isinstance(v, (list, tuple)) \
+            else [v] * len(conv_num_filter)
+
+    pads = listify(conv_padding)
+    ksz = listify(conv_filter_size)
+    acts = listify(conv_act)
+    bns = listify(conv_with_batchnorm)
+    drops = listify(conv_batchnorm_drop_rate)
+    tmp = input
+    for i, nf in enumerate(conv_num_filter):
+        lname = f"{name}_conv{i}" if name else None
+        tmp = layer.img_conv(
+            tmp, filter_size=ksz[i], num_filters=nf,
+            num_channels=num_channels if i == 0 else None,
+            padding=pads[i],
+            act=None if bns[i] else (acts[i] or act_mod.Relu()),
+            name=lname)
+        if bns[i]:
+            tmp = layer.batch_norm(tmp, act=acts[i] or act_mod.Relu(),
+                                   name=f"{lname}_bn" if lname else None)
+            if drops[i]:
+                tmp = layer.dropout(tmp, drops[i])
+    return layer.img_pool(tmp, pool_size=pool_size, stride=pool_stride or
+                          pool_size, pool_type=pool_type,
+                          name=f"{name}_pool" if name else None)
+
+
+def small_vgg(input_image, num_channels, num_classes, name="svgg"):
+    """(reference: networks.py small_vgg — the CIFAR VGG)"""
+    tmp = input_image
+    ch = num_channels
+    for g, (nf, times, drop) in enumerate(
+            [(64, 2, [0.3, 0]), (128, 2, [0.4, 0]),
+             (256, 3, [0.4, 0.4, 0]), (512, 3, [0.4, 0.4, 0])]):
+        tmp = img_conv_group(tmp, [nf] * times, pool_size=2,
+                             num_channels=ch if g == 0 else None,
+                             conv_with_batchnorm=True,
+                             conv_batchnorm_drop_rate=drop,
+                             name=f"{name}_g{g}")
+        ch = None
+    tmp = layer.dropout(tmp, 0.5)
+    tmp = layer.fc(tmp, 512, act=None, name=f"{name}_fc1")
+    tmp = layer.batch_norm(tmp, act=act_mod.Relu(), name=f"{name}_bn")
+    return layer.fc(tmp, num_classes, act=act_mod.Softmax(),
+                    name=f"{name}_out")
+
+
+def vgg_16_network(input_image, num_channels, num_classes=1000,
+                   name="vgg16"):
+    """(reference: networks.py vgg_16_network)"""
+    tmp = input_image
+    ch = num_channels
+    for g, (nf, times) in enumerate([(64, 2), (128, 2), (256, 3),
+                                     (512, 3), (512, 3)]):
+        tmp = img_conv_group(tmp, [nf] * times, pool_size=2,
+                             num_channels=ch if g == 0 else None,
+                             conv_act=act_mod.Relu(), name=f"{name}_g{g}")
+        ch = None
+    tmp = layer.fc(tmp, 4096, act=act_mod.Relu(), name=f"{name}_fc1")
+    tmp = layer.dropout(tmp, 0.5)
+    tmp = layer.fc(tmp, 4096, act=act_mod.Relu(), name=f"{name}_fc2")
+    tmp = layer.dropout(tmp, 0.5)
+    return layer.fc(tmp, num_classes, act=act_mod.Softmax(),
+                    name=f"{name}_out")
+
+
+def simple_gru2(input, size, reverse=False, name=None):
+    """Pure alias of simple_gru (reference: networks.py simple_gru2 —
+    same wiring; the reference variant differed only in mixed-layer
+    parameter-attr defaults, which collapse to the same init here)."""
+    return simple_gru(input, size, reverse=reverse, name=name)
+
+
+def bidirectional_gru(input, size, return_seq=False, name=None):
+    """Forward + backward GRU, concat (or concat of last steps)
+    (reference: networks.py bidirectional_gru)."""
+    fwd = simple_gru(input, size, name=f"{name}_fw" if name else None)
+    bwd = simple_gru(input, size, reverse=True,
+                     name=f"{name}_bw" if name else None)
+    if return_seq:
+        return layer.concat([fwd, bwd],
+                            name=f"{name}_concat" if name else None)
+    last_f = layer.last_seq(fwd)
+    first_b = layer.first_seq(bwd)
+    return layer.concat([last_f, first_b],
+                        name=f"{name}_concat" if name else None)
+
+
+def dot_product_attention(encoded_sequence, attended_sequence,
+                          transformed_state, name=None):
+    """Dot-product attention over an encoded sequence (reference:
+    networks.py dot_product_attention): per-step scores
+    ``<state, encoded[t]>``, masked sequence-softmax weights, context =
+    weighted sum of the attended sequence."""
+    name = name or "dot_attn"
+    import paddle_tpu.ops.sequence as ops_seq
+    from paddle_tpu.topology import LayerOutput, Value
+
+    def fwd(params, parents, ctx):
+        import jax.numpy as jnp
+        state, enc, att = parents
+        # [B, D] x [B, T, D] -> [B, T] scores
+        s = jnp.einsum("bd,btd->bt", state.array.astype(jnp.float32),
+                       enc.array.astype(jnp.float32))
+        w = ops_seq.seq_softmax(s[..., None], enc.lengths)[..., 0]
+        ctxv = jnp.einsum("bt,btd->bd", w,
+                          att.array.astype(jnp.float32))
+        return Value(ctxv.astype(att.array.dtype))
+
+    return LayerOutput(name, "dot_attention",
+                       [transformed_state, encoded_sequence,
+                        attended_sequence],
+                       fwd, [], size=attended_sequence.size)
+
+
 def _install_recording():
     import sys
     from paddle_tpu import record

@@ -86,22 +86,31 @@ def space_to_depth(x: jax.Array, block: int = 2) -> jax.Array:
         n, h // block, w // block, block * block * c)
 
 
-def space_to_depth_conv_weights(w: jax.Array, block: int = 2) -> jax.Array:
+def space_to_depth_conv_transform(w: jax.Array, block: int = 2):
     """Transform [kH, kW, Cin, Cout] weights of a stride-``block`` conv
-    into the equivalent stride-1 kernel over space-to-depth input.
+    with padding k//2 into the equivalent stride-1 kernel over
+    space-to-depth input. Returns ``(weights, padding)`` — the companion
+    explicit padding is part of the derivation, so callers can't drift.
 
-    Derivation (block=2, k odd with pad k//2): pad the kernel on the LEFT
-    to even size so tap parity aligns with the 2x2 cells; tap (2a+d) of
-    the padded kernel lands in s2d cell a, channel slot d. The companion
-    conv uses padding (k//2 backed off to cells: left ceil(k//2/2),
-    right (k_pad//2 - 1)) — see resnet.py conv1 usage."""
+    Derivation: original tap r reads offset e = r − k//2 from the strided
+    output origin; writing e = block·j + d places w[r] in s2d kernel cell
+    a = j − jmin, channel slot d, with companion padding
+    (left −jmin = ceil((k//2)/block), right jmax = (k−1−k//2)//block)."""
     kh, kw, cin, cout = w.shape
-    kh_p = -(-(kh + 1) // block) * block     # pad-left to block multiple
-    kw_p = -(-(kw + 1) // block) * block
-    wp = jnp.zeros((kh_p, kw_p, cin, cout), w.dtype)
-    wp = wp.at[kh_p - kh:, kw_p - kw:].set(w)
-    wp = wp.reshape(kh_p // block, block, kw_p // block, block, cin, cout)
-    # [a, dy, b, dx, c, f] -> [a, b, dy, dx, c, f] -> merge (dy, dx, c)
-    wp = jnp.transpose(wp, (0, 2, 1, 3, 4, 5))
-    return wp.reshape(kh_p // block, kw_p // block,
-                      block * block * cin, cout)
+
+    def axis_map(k):
+        import numpy as np
+        e = np.arange(k) - k // 2
+        j = np.floor_divide(e, block)
+        return (j - j.min(), e - j * block,
+                int(-j.min()), int(j.max()), int(j.max() - j.min() + 1))
+
+    a_h, d_h, pl_h, pr_h, ah = axis_map(kh)
+    a_w, d_w, pl_w, pr_w, aw = axis_map(kw)
+    ws = jnp.zeros((ah, aw, block, block, cin, cout), w.dtype)
+    for r in range(kh):
+        for s in range(kw):
+            ws = ws.at[a_h[r], a_w[s], d_h[r], d_w[s]].set(w[r, s])
+    # channel merge order (dy, dx, c) matches space_to_depth's layout
+    ws = ws.reshape(ah, aw, block * block * cin, cout)
+    return ws, ((pl_h, pr_h), (pl_w, pr_w))

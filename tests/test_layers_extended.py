@@ -518,3 +518,69 @@ class TestReviewRegressions:
         dk = layer.data("k", paddle.data_type.dense_vector(4))
         with pytest.raises(Exception):
             layer.conv_shift(da, dk)
+
+
+class TestNetworkComposites:
+    """networks.py composite builders (reference: networks.py
+    img_conv_group/small_vgg/vgg_16_network/bidirectional_gru/
+    dot_product_attention)."""
+
+    def _run(self, out, feed):
+        import jax.numpy as jnp
+
+        import paddle_tpu as paddle
+        from paddle_tpu.topology import Topology, Value
+        from paddle_tpu.utils.rng import KeySource
+        topo = Topology(out)
+        params = paddle.parameters.create(out, KeySource(0))
+        fwd = topo.compile()
+        outs, _ = fwd(params.values, params.state,
+                      {k: Value(jnp.asarray(v)) if not isinstance(v, tuple)
+                       else Value(jnp.asarray(v[0]), jnp.asarray(v[1]))
+                       for k, v in feed.items()}, is_training=False)
+        return np.asarray(outs[out.name].array, np.float32)
+
+    def test_img_conv_group_and_small_vgg_shapes(self, rng):
+        import paddle_tpu as paddle
+        from paddle_tpu import layer, networks
+        img = layer.data("ncg_im", paddle.data_type.dense_vector(
+            3 * 16 * 16))
+        out = networks.small_vgg(img, num_channels=3, num_classes=10)
+        o = self._run(out, {"ncg_im": rng.randn(2, 768).astype(np.float32)})
+        assert o.shape == (2, 10)
+        np.testing.assert_allclose(o.sum(-1), 1.0, rtol=1e-4)
+
+    def test_vgg16_builds(self, rng):
+        import paddle_tpu as paddle
+        from paddle_tpu import layer, networks
+        img = layer.data("v16_im", paddle.data_type.dense_vector(
+            3 * 32 * 32))
+        out = networks.vgg_16_network(img, num_channels=3, num_classes=7)
+        o = self._run(out, {"v16_im": rng.randn(1, 3072).astype(np.float32)})
+        assert o.shape == (1, 7)
+
+    def test_bidirectional_gru(self, rng):
+        import paddle_tpu as paddle
+        from paddle_tpu import layer, networks
+        seq = layer.data("bg_in", paddle.data_type.dense_vector_sequence(6))
+        out = networks.bidirectional_gru(seq, size=5, name="bg")
+        x = rng.randn(2, 4, 6).astype(np.float32)
+        o = self._run(out, {"bg_in": (x, np.array([4, 2]))})
+        assert o.shape == (2, 10)
+
+    def test_dot_product_attention_weights_sum_to_one(self, rng):
+        import paddle_tpu as paddle
+        from paddle_tpu import layer, networks
+        enc = layer.data("dpa_enc", paddle.data_type.dense_vector_sequence(4))
+        st = layer.data("dpa_st", paddle.data_type.dense_vector(4))
+        ctxl = networks.dot_product_attention(enc, enc, st, name="dpa")
+        x = rng.randn(2, 5, 4).astype(np.float32)
+        s = rng.randn(2, 4).astype(np.float32)
+        o = self._run(ctxl, {"dpa_enc": (x, np.array([5, 3])),
+                             "dpa_st": s})
+        assert o.shape == (2, 4)
+        # context is a convex combination of encoded steps: bounded by
+        # per-dim min/max over valid steps
+        for b, n in enumerate([5, 3]):
+            lo, hi = x[b, :n].min(0) - 1e-5, x[b, :n].max(0) + 1e-5
+            assert (o[b] >= lo).all() and (o[b] <= hi).all()

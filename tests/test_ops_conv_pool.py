@@ -2,6 +2,7 @@
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from paddle_tpu.ops import conv, pool
 from tests.op_test_util import check_forward, check_grad
@@ -95,36 +96,24 @@ def test_spp_shape(rng):
 
 class TestSpaceToDepthStem:
     """space_to_depth + transformed weights must reproduce the original
-    strided conv exactly (the MLPerf ResNet stem trick; lane-utilisation
+    strided conv exactly for ANY (k, block) — the transform returns its
+    own companion padding (the MLPerf ResNet stem trick; lane-utilisation
     lever recorded in BENCHMARKS.md)."""
 
-    def test_7x7_s2_equivalence(self, rng):
+    @pytest.mark.parametrize("k,block,hw", [
+        (7, 2, 32), (3, 2, 16), (5, 2, 24), (3, 4, 16), (5, 4, 24),
+        (1, 2, 8),
+    ])
+    def test_equivalence_general(self, rng, k, block, hw):
         import jax.numpy as jnp
 
         from paddle_tpu.ops import conv as ops_conv
-        x = jnp.asarray(rng.randn(2, 32, 32, 3).astype(np.float32))
-        w = jnp.asarray(rng.randn(7, 7, 3, 8).astype(np.float32))
-        ref = ops_conv.conv2d(x, w, stride=2, padding=3)
-        xs = ops_conv.space_to_depth(x, 2)
-        ws = ops_conv.space_to_depth_conv_weights(w, 2)
-        # kernel padded 7->8 on the left: s2d padding (2, 1) per axis
-        got = ops_conv.conv2d(xs, ws, stride=1, padding=((2, 1), (2, 1)))
-        assert got.shape == ref.shape
-        np.testing.assert_allclose(np.asarray(got, np.float32),
-                                   np.asarray(ref, np.float32),
-                                   rtol=1e-4, atol=1e-4)
-
-    def test_3x3_s2_equivalence(self, rng):
-        import jax.numpy as jnp
-
-        from paddle_tpu.ops import conv as ops_conv
-        x = jnp.asarray(rng.randn(1, 16, 16, 4).astype(np.float32))
-        w = jnp.asarray(rng.randn(3, 3, 4, 6).astype(np.float32))
-        ref = ops_conv.conv2d(x, w, stride=2, padding=1)
-        xs = ops_conv.space_to_depth(x, 2)
-        ws = ops_conv.space_to_depth_conv_weights(w, 2)
-        # kernel padded 3->4 on the left: s2d padding (1, 0) per axis
-        got = ops_conv.conv2d(xs, ws, stride=1, padding=((1, 0), (1, 0)))
+        x = jnp.asarray(rng.randn(2, hw, hw, 3).astype(np.float32))
+        w = jnp.asarray(rng.randn(k, k, 3, 8).astype(np.float32))
+        ref = ops_conv.conv2d(x, w, stride=block, padding=k // 2)
+        xs = ops_conv.space_to_depth(x, block)
+        ws, pads = ops_conv.space_to_depth_conv_transform(w, block)
+        got = ops_conv.conv2d(xs, ws, stride=1, padding=pads)
         assert got.shape == ref.shape
         np.testing.assert_allclose(np.asarray(got, np.float32),
                                    np.asarray(ref, np.float32),
