@@ -31,6 +31,8 @@ INIT_TIMEOUT = float(os.environ.get("BENCH_INIT_TIMEOUT", 420))
 COMPILE_TIMEOUT = float(os.environ.get("BENCH_COMPILE_TIMEOUT", 900))
 STEP_TIMEOUT = float(os.environ.get("BENCH_STEP_TIMEOUT", 600))
 RETRY_ENV = "PADDLE_TPU_BENCH_RETRY"
+# read once; build_train_step and every emitted record use this same value
+STEM_S2D = os.environ.get("BENCH_S2D", "1") == "1"
 
 
 def log(*a):
@@ -51,7 +53,8 @@ def emit(value, error=None, **extra):
         _emitted = True
     rec = {"metric": "resnet50_train_images_per_sec_per_chip",
            "value": round(value, 1), "unit": "images/sec",
-           "vs_baseline": round(value / NORTH_STAR, 4)}
+           "vs_baseline": round(value / NORTH_STAR, 4),
+           "stem_space_to_depth": STEM_S2D}
     if error:
         rec["error"] = error
     rec.update(extra)
@@ -144,8 +147,7 @@ def build_train_step():
     img = layer.data("image", paddle.data_type.dense_vector(3 * 224 * 224))
     lbl = layer.data("label", paddle.data_type.integer_value(1000))
     out = resnet.resnet_imagenet(
-        img, depth=50, class_num=1000,
-        stem_space_to_depth=os.environ.get("BENCH_S2D", "0") == "1")
+        img, depth=50, class_num=1000, stem_space_to_depth=STEM_S2D)
     cost = layer.classification_cost(out, lbl, name="cost")
     topo = Topology(cost)
     params = paddle.parameters.create(cost, KeySource(42))

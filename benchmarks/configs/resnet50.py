@@ -15,6 +15,8 @@ batch_size = env_int("BENCH_BATCH", 128)
 reader, dim = image_reader(224)
 img = layer.data("image", paddle.data_type.dense_vector(dim))
 lbl = layer.data("label", paddle.data_type.integer_value(1000))
-out = resnet.resnet_imagenet(img, depth=50, class_num=1000)
+out = resnet.resnet_imagenet(
+    img, depth=50, class_num=1000,
+    stem_space_to_depth=os.environ.get("BENCH_S2D", "1") == "1")
 cost = layer.classification_cost(out, lbl, name="cost")
 optimizer = paddle.optimizer.Momentum(momentum=0.9, learning_rate=0.01)

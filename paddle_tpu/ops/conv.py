@@ -108,9 +108,9 @@ def space_to_depth_conv_transform(w: jax.Array, block: int = 2):
     a_h, d_h, pl_h, pr_h, ah = axis_map(kh)
     a_w, d_w, pl_w, pr_w, aw = axis_map(kw)
     ws = jnp.zeros((ah, aw, block, block, cin, cout), w.dtype)
-    for r in range(kh):
-        for s in range(kw):
-            ws = ws.at[a_h[r], a_w[s], d_h[r], d_w[s]].set(w[r, s])
+    # one vectorized scatter over all kh*kw taps (tap cells are disjoint)
+    ws = ws.at[a_h[:, None], a_w[None, :],
+               d_h[:, None], d_w[None, :]].set(w)
     # channel merge order (dy, dx, c) matches space_to_depth's layout
     ws = ws.reshape(ah, aw, block * block * cin, cout)
     return ws, ((pl_h, pr_h), (pl_w, pr_w))
