@@ -64,6 +64,7 @@ if os.environ.get("BENCH_PLATFORM"):
     jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 from paddle_tpu import cli
 cfg = cli._load_config({config!r})
+print("BENCHDEVICE " + jax.devices()[0].device_kind)
 r = cli.measure_time(cfg, time_batches={timed}, warmup_batches={warmup})
 print("BENCHRESULT " + json.dumps(r))
 """
@@ -80,9 +81,14 @@ def run_one(suite, env_over, timed, warmup, timeout):
                            capture_output=True, text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
         return {"error": f"timeout >{timeout}s"}
+    out = {}
     for line in r.stdout.splitlines():
+        if line.startswith("BENCHDEVICE "):
+            out["device_kind"] = line[len("BENCHDEVICE "):].strip()
         if line.startswith("BENCHRESULT "):
-            return json.loads(line[len("BENCHRESULT "):])
+            out.update(json.loads(line[len("BENCHRESULT "):]))
+    if out.get("ms_per_batch") is not None:
+        return out
     tail = (r.stderr or "").strip().splitlines()[-5:]
     return {"error": f"rc={r.returncode} after {time.time()-t0:.0f}s: "
             + " | ".join(tail)}
@@ -118,8 +124,15 @@ def write_md(results, path):
         lines.append(
             f"| {rec['suite']} | {sstr} | {r['ms_per_batch']:.2f} | "
             f"{r['examples_per_sec']:.1f} | "
-            f"{base if base is not None else '—'} | {speed} | "
+            f"{f'{base:g}' if base is not None else '—'} | {speed} | "
             f"{rec['note']} |")
+    # hand-maintained analysis (MFU, roofline, profile findings) survives
+    # regeneration: kept in benchmarks/analysis.md and appended verbatim
+    analysis = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "analysis.md")
+    if os.path.exists(analysis):
+        with open(analysis) as f:
+            lines += ["", f.read().rstrip()]
     lines += ["", f"_Generated by benchmarks/run_all.py, "
               f"{time.strftime('%Y-%m-%d %H:%M:%S')}_", ""]
     with open(path, "w") as f:
@@ -135,21 +148,32 @@ def main():
     ap.add_argument("--timed", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--out", default=os.path.join(REPO, "BENCHMARKS.md"))
+    ap.add_argument("--from-json", action="store_true",
+                    help="rewrite the .md from benchmarks/results.json "
+                         "without re-measuring")
     args = ap.parse_args()
+
+    json_path = os.path.join(REPO, "benchmarks", "results.json")
+    if args.from_json:
+        with open(json_path) as f:
+            results = json.load(f)
+        write_md(results, args.out)
+        print(f"wrote {args.out}")
+        return
 
     timed, warmup, timeout = args.timed, args.warmup, args.timeout
     if args.quick:
         timed, warmup, timeout = 3, 1, 600
 
-    import platform as _pl
     results = {"platform": os.environ.get("BENCH_PLATFORM", "default"),
-               "device": _pl.processor() or "?", "points": []}
-    json_path = os.path.join(REPO, "benchmarks", "results.json")
+               "device": "?", "points": []}
     for suite, env_over, baseline_ms, note in SWEEP:
         if args.suite and suite != args.suite:
             continue
         print(f"== {suite} {env_over}", flush=True)
         r = run_one(suite, env_over, timed, warmup, timeout)
+        if "device_kind" in r:
+            results["device"] = r.pop("device_kind")
         print(f"   -> {r}", flush=True)
         results["points"].append({"suite": suite, "settings": env_over,
                                   "result": r, "baseline_ms": baseline_ms,

@@ -68,13 +68,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     m, l, acc = jax.lax.fori_loop(0, num_k, body, (m, l, acc))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+    # row stats are stored [BH, num_q_blocks, block_q] with block_q on the
+    # TPU lane dim — a [T]-shaped output would need a (1, block_q) block,
+    # which the (8, 128) tiling rejects, and lane-replicating to 128 wide
+    # costs 128x VMEM in the backward's whole-array block. The lse block
+    # here spans ALL q-blocks and is revisited consecutively across the
+    # inner q grid dim (each program writes its own row), so it flushes
+    # once per batch·head.
+    lse_ref[0, qi] = m + jnp.log(l_safe)
 
 
 def _pad_to_blocks(t, block_q, block_k):
     """Common padded length for fwd and bwd — they must agree exactly (the
-    backward reconstructs p from the forward's lse)."""
-    return max(-(-t // block_q) * block_q, -(-t // block_k) * block_k)
+    backward reconstructs p from the forward's lse), and it must be a
+    multiple of BOTH block sizes: the compact row-stats layout reshapes
+    [tp] -> [tp // block_q, block_q]."""
+    lcm = math.lcm(block_q, block_k)
+    return -(-t // lcm) * lcm
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
@@ -86,7 +96,8 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     if tp != t:
         pad = ((0, 0), (0, tp - t), (0, 0))
         q, k, v = (jnp.pad(a, pad) for a in (q, k, v))
-    grid = (bh, tp // block_q)
+    nq = tp // block_q
+    grid = (bh, nq)
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
         block_k=block_k, seq_len=t)
@@ -100,15 +111,15 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, nq, block_q), lambda b, i: (b, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tp, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tp), jnp.float32),
+            jax.ShapeDtypeStruct((bh, nq, block_q), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
-    return out[:, :t], lse[:, :t]
+    return out[:, :t], lse.reshape(bh, tp)[:, :t]
 
 
 def _reference(q, k, v, sm_scale, causal):
@@ -165,8 +176,8 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(qi * block_q, block_q)].astype(jnp.float32)
         do = do_ref[0, pl.ds(qi * block_q, block_q)].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(qi * block_q, block_q)]
+        lse = lse_ref[0, qi]                             # [block_q]
+        delta = delta_ref[0, qi]
         s = (q @ k.T) * sm_scale                         # [block_q, block_k]
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
@@ -205,6 +216,10 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, sm_scale, causal, block_q,
         # rows is masked out by `valid` anyway
         lse = jnp.pad(lse, pad2)
         delta = jnp.pad(delta, pad2)
+    # compact row-stats layout, block_q on the lane dim (see _fwd_kernel)
+    nq = tp // block_q
+    lse = lse.reshape(bh, nq, block_q)
+    delta = delta.reshape(bh, nq, block_q)
     kernel = functools.partial(
         _bwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
         block_k=block_k, seq_len=t)
@@ -217,8 +232,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, sm_scale, causal, block_q,
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),  # k
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),  # v
             pl.BlockSpec((1, tp, d), lambda b, i: (b, 0, 0)),   # do
-            pl.BlockSpec((1, tp), lambda b, i: (b, 0)),         # lse
-            pl.BlockSpec((1, tp), lambda b, i: (b, 0)),         # delta
+            pl.BlockSpec((1, nq, block_q), lambda b, i: (b, 0, 0)),  # lse
+            pl.BlockSpec((1, nq, block_q), lambda b, i: (b, 0, 0)),  # delta
         ],
         out_specs=[
             pl.BlockSpec((1, tp, d), lambda b, i: (b, 0, 0)),   # dq

@@ -35,6 +35,18 @@ class TestFlashForward:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    def test_mismatched_block_sizes(self, rng):
+        """block_q != block_k where neither divides the other's round-up:
+        the padded length must be a common multiple or the compact
+        [nq, block_q] row-stats layout can't hold a [tp] vector
+        (regression: t=10, block_q=6, block_k=8 → tp must be 24, not 16)."""
+        q, k, v = make_qkv(rng, t=10, d=8)
+        out = fa.flash_attention(q, k, v, causal=True, interpret=True,
+                                 block_q=6, block_k=8)
+        ref = ring.full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
     def test_cpu_fallback_matches(self, rng):
         q, k, v = make_qkv(rng, t=32)
         out = fa.flash_attention(q, k, v, causal=True)  # jnp fallback path
