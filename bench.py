@@ -177,14 +177,10 @@ def bench_batch(dog, step_fn, carry, batch, warmup=3, iters=20):
     labels = jnp.asarray(rng.randint(0, 1000, batch).astype(np.int32))
     p, o, s = carry
 
+    from paddle_tpu.utils.sync import host_sync
+
     def full_sync(p, loss):
-        """Host-read a value data-dependent on the LAST optimizer update —
-        on the tunneled (axon) platform block_until_ready has been observed
-        returning before the chain finished; transferring a reduction of a
-        final parameter cannot be faked."""
-        import jax.tree_util as jtu
-        leaf = jtu.tree_leaves(p)[0]
-        return float(jnp.sum(leaf.astype(jnp.float32))), float(loss)
+        return None, host_sync(p, loss)
 
     dog.stage(f"compile-bs{batch}", COMPILE_TIMEOUT)
     t_compile = time.time()

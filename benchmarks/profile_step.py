@@ -36,14 +36,7 @@ def capture(logdir: str, batch: int, steps: int) -> None:
     rng = np.random.RandomState(0)
     images = jnp.asarray(rng.rand(batch, 224, 224, 3).astype(np.float32))
     labels = jnp.asarray(rng.randint(0, 1000, batch).astype(np.int32))
-    import jax.tree_util as jtu
-
-    def full_sync(p, loss):
-        # host-read a value data-dependent on the last update: on the
-        # tunneled (axon) platform block_until_ready has been observed
-        # returning early (same caveat as bench.py full_sync)
-        float(jnp.sum(jtu.tree_leaves(p)[0].astype(jnp.float32)))
-        float(loss)
+    from paddle_tpu.utils.sync import host_sync as full_sync
 
     for i in range(3):  # compile + warm
         loss, p, o, s = step_fn(p, o, s, images, labels,

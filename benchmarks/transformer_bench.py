@@ -79,18 +79,19 @@ def _run_variant(args, tfm, jax, jnp, tokens, use_flash):
             p, m, v)
         return loss, newp, (m, v)
 
+    from paddle_tpu.utils.sync import host_sync
+
     step = jax.jit(train_step, donate_argnums=(0, 1))
     p, o = params, opt_state
     t0 = time.time()
     loss, p, o = step(p, o, tokens, targets, jnp.asarray(0, jnp.int32))
-    float(loss)
+    host_sync(p, loss)
     compile_s = time.time() - t0
     t0 = time.time()
     for i in range(args.iters):
         loss, p, o = step(p, o, tokens, targets,
                           jnp.asarray(i + 1, jnp.int32))
-    float(jax.tree_util.tree_leaves(p)[0].sum())
-    float(loss)
+    host_sync(p, loss)
     dt = (time.time() - t0) / args.iters
     toks_per_s = args.batch * args.seq / dt
     print(json.dumps({

@@ -131,15 +131,7 @@ def measure_time(cfg, batch_size=None, time_batches=20, warmup_batches=3,
                   trainer.parameters.state)
     key = jax.random.PRNGKey(0)
 
-    def full_sync(pv, cost):
-        """Host-read a value data-dependent on the LAST parameter update.
-        On the tunneled (axon) TPU platform block_until_ready has been
-        observed returning before the dispatch chain finished; transferring
-        a reduction of an updated parameter cannot be faked (same guard as
-        bench.py)."""
-        leaf = jax.tree_util.tree_leaves(pv)[0]
-        float(jnp.sum(leaf.astype(jnp.float32)))
-        float(cost)
+    from paddle_tpu.utils.sync import host_sync as full_sync
 
     if not batches:
         raise ValueError("job=time: reader yielded no batches")

@@ -41,21 +41,39 @@ class DataFeeder:
         feeds = {}
         for name, itype in self.data_types.items():
             col = [sample[self.feeding[name]] for sample in batch]
-            feeds[name] = self._convert(col, itype)
+            feeds[name] = self._convert(col, itype, name)
         return feeds
 
-    def _convert(self, col: List, itype: InputType) -> Value:
+    @staticmethod
+    def _check_index_range(arr: np.ndarray, dim: int, name: str):
+        """Out-of-range ids reach the device as clamped gathers / zero
+        one-hots and surface as silent NaNs many layers later (the
+        reference's DataProviderConverter validates at the boundary,
+        py_paddle/dataprovider_converter.py index scanner) — fail here
+        with the slot named instead."""
+        if arr.size and (arr.min() < 0 or arr.max() >= dim):
+            bad = int(arr.min() if arr.min() < 0 else arr.max())
+            raise ValueError(
+                f"input '{name}': index {bad} out of range for "
+                f"dimension {dim}")
+
+    def _convert(self, col: List, itype: InputType, name: str = "?") -> Value:
         if itype.seq == SeqLevel.NO_SEQUENCE:
             if itype.kind == Kind.DENSE:
                 return Value(jnp.asarray(np.asarray(col, np.float32)))
             if itype.kind == Kind.INDEX:
-                return Value(jnp.asarray(np.asarray(col, np.int32)))
-            return self._sparse(col, itype)
+                arr = np.asarray(col, np.int32)
+                self._check_index_range(arr, itype.dim, name)
+                return Value(jnp.asarray(arr))
+            return self._sparse(col, itype, name)
         if itype.seq == SeqLevel.SUB_SEQUENCE:
             if itype.kind == Kind.INDEX:
-                sb = SequenceBatch.from_nested_list(
-                    [[np.asarray(s, np.int32) for s in subs] for subs in col],
-                    self.buckets)
+                nested = [[np.asarray(s, np.int32) for s in subs]
+                          for subs in col]
+                for subs in nested:
+                    for a in subs:
+                        self._check_index_range(a, itype.dim, name)
+                sb = SequenceBatch.from_nested_list(nested, self.buckets)
             else:
                 sb = SequenceBatch.from_nested_list(
                     [[np.asarray(s, np.float32) for s in subs] for subs in col],
@@ -63,8 +81,10 @@ class DataFeeder:
             return Value(sb.data, sb.lengths, sb.sub_lengths)
         # SEQUENCE
         if itype.kind == Kind.INDEX:
-            sb = SequenceBatch.from_list([np.asarray(s, np.int32) for s in col],
-                                         self.buckets)
+            seqs = [np.asarray(s, np.int32) for s in col]
+            for a in seqs:
+                self._check_index_range(a, itype.dim, name)
+            sb = SequenceBatch.from_list(seqs, self.buckets)
         elif itype.kind == Kind.DENSE:
             sb = SequenceBatch.from_list([np.asarray(s, np.float32) for s in col],
                                          self.buckets)
@@ -72,7 +92,7 @@ class DataFeeder:
             raise NotImplementedError("sparse sequences not yet supported")
         return Value(sb.data, sb.lengths)
 
-    def _sparse(self, col, itype) -> Value:
+    def _sparse(self, col, itype, name: str = "?") -> Value:
         """sparse_binary_vector: sample is a list of indices;
         sparse_float_vector: list of (index, value)."""
         k = bucket_length(max((len(s) for s in col), default=1), self.buckets)
@@ -87,4 +107,5 @@ class DataFeeder:
                 vals = [p[1] for p in s]
             ids[i, : len(idx)] = idx
             w[i, : len(vals)] = vals
+        self._check_index_range(ids, itype.dim, name)
         return Value(jnp.asarray(ids), weights=jnp.asarray(w))

@@ -1,0 +1,27 @@
+"""Device-synchronisation helper for timing and profiling loops.
+
+On the tunneled (axon) TPU platform ``jax.block_until_ready`` has been
+observed returning before the dispatch chain actually finished, which
+silently corrupts any wall-clock measurement taken after it. The reliable
+barrier is a HOST-READ of a value data-dependent on the last computation:
+transferring a reduction of an updated array cannot be faked. Every
+measurement loop (bench.py, cli.measure_time, benchmarks/*) shares this
+helper so the workaround lives in one place.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def host_sync(tree, *scalars) -> float:
+    """Block until ``tree``'s first leaf (and any extra device scalars)
+    are computed, by reading reductions back to the host. Returns the
+    float of the last scalar (or the leaf reduction if none given) so
+    call sites can use the value they already forced."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    out = 0.0
+    if leaves:
+        out = float(jnp.sum(leaves[0].astype(jnp.float32)))
+    for s in scalars:
+        out = float(s)
+    return out

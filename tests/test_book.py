@@ -123,3 +123,74 @@ class TestUnderstandSentiment:
         # the synthetic task is separable: training error should be low
         res = tr.evaluators.result()
         assert res["us_err"] < 0.35, res
+
+
+class TestImageClassification:
+    def test_cifar10_resnet(self):
+        """Small ResNet on the CIFAR-10 schema (reference:
+        book/test_image_classification_train.py — vgg/resnet on cifar;
+        depth 8 keeps the CPU test quick)."""
+        from paddle_tpu.models import resnet
+        img = layer.data("image", paddle.data_type.dense_vector(3 * 32 * 32))
+        lbl = layer.data("label", paddle.data_type.integer_value(10))
+        out = resnet.resnet_cifar10(img, depth=8, class_num=10)
+        cost = layer.classification_cost(out, lbl, name="ic_cost")
+        reader = paddle.reader.firstn(paddle.dataset.cifar.train10(), 256)
+        costs, _ = train_and_costs(
+            cost, reader, passes=3, batch=32,
+            opt=paddle.optimizer.Adam(learning_rate=1e-3))
+        assert np.mean(costs[-4:]) < np.mean(costs[:4]), costs
+
+
+class TestLabelSemanticRoles:
+    def test_conll05_crf_tagger(self):
+        """SRL tagger over the 9-feature CoNLL-05 schema with a CRF cost
+        (reference: book/test_label_semantic_roles.py / demo
+        label_semantic_roles — word + 5 predicate-context windows +
+        predicate + mark features, sequence-tagged with a CRF)."""
+        word_dict, verb_dict, label_dict = paddle.dataset.conll05.get_dict()
+        word_n, verb_n, tag_n = len(word_dict), len(verb_dict), \
+            len(label_dict)
+        seqs = {}
+        for name, size in [("word", word_n), ("ctx_n2", word_n),
+                           ("ctx_n1", word_n), ("ctx_0", word_n),
+                           ("ctx_p1", word_n), ("ctx_p2", word_n),
+                           ("verb", verb_n), ("mark", 2)]:
+            seqs[name] = layer.data(
+                f"srl_{name}", paddle.data_type.integer_value_sequence(size))
+        target = layer.data(
+            "srl_target", paddle.data_type.integer_value_sequence(tag_n))
+        embs = [layer.embedding(seqs[n], 16, name=f"srl_emb_{n}")
+                for n in ("word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1",
+                          "ctx_p2", "verb", "mark")]
+        hidden = layer.fc(layer.concat(embs, name="srl_concat"), 32,
+                          act=paddle.activation.Tanh(), name="srl_hidden")
+        feat = layer.fc(hidden, tag_n, act=None, name="srl_feat")
+        crf = layer.crf_layer(feat, target, size=tag_n, name="srl_crf")
+        reader = paddle.reader.firstn(paddle.dataset.conll05.train(), 256)
+        feeding = {"srl_word": 0, "srl_ctx_n2": 1, "srl_ctx_n1": 2,
+                   "srl_ctx_0": 3, "srl_ctx_p1": 4, "srl_ctx_p2": 5,
+                   "srl_verb": 6, "srl_mark": 7, "srl_target": 8}
+        costs, _ = train_and_costs(
+            crf, reader, passes=3, batch=16, feeding=feeding,
+            opt=paddle.optimizer.Adam(learning_rate=5e-3))
+        assert np.mean(costs[-4:]) < np.mean(costs[:4]), costs
+
+
+class TestMachineTranslation:
+    def test_wmt14_attention_seq2seq(self):
+        """Encoder-decoder NMT with the recurrent-group attention decoder
+        on the WMT-14 schema (reference: book/test_machine_translation.py,
+        demo/seqToseq)."""
+        from paddle_tpu.models import seq2seq
+        dict_size = 200
+        cost = seq2seq.seq2seq_train(dict_size, dict_size)
+        reader = paddle.reader.firstn(
+            paddle.dataset.wmt14.train(dict_size), 128)
+        feeding = {"source_language_word": 0, "target_language_word": 1,
+                   "target_language_next_word": 2}
+        costs, _ = train_and_costs(
+            cost, reader, passes=2, batch=16, feeding=feeding,
+            opt=paddle.optimizer.Adam(learning_rate=5e-3,
+                                      gradient_clipping_threshold=5.0))
+        assert np.mean(costs[-4:]) < np.mean(costs[:4]), costs

@@ -180,3 +180,20 @@ def test_duplicate_names_rejected():
     b = layer.fc(a, 2, name="same")
     with pytest.raises(Exception):
         Topology(b)
+
+
+def test_feeder_rejects_out_of_range_indices():
+    """Out-of-range ids would reach the device as clamped gathers and
+    surface as NaNs layers later — the feeder must fail at the boundary
+    with the slot named (reference: py_paddle dataprovider_converter's
+    index scanner)."""
+    feeder = DataFeeder({"label": dt.integer_value(10)})
+    with pytest.raises(ValueError, match="label.*10"):
+        feeder.feed([(10,), (3,)])
+    with pytest.raises(ValueError, match="label"):
+        feeder.feed([(-1,)])
+    seq_feeder = DataFeeder({"words": dt.integer_value_sequence(30)})
+    with pytest.raises(ValueError, match="words.*30"):
+        seq_feeder.feed([([1, 2, 30],)])
+    # in-range passes
+    assert feeder.feed([(9,), (0,)])["label"].array.shape == (2,)
