@@ -179,22 +179,19 @@ def bench_batch(dog, step_fn, carry, batch, warmup=3, iters=20):
 
     from paddle_tpu.utils.sync import host_sync
 
-    def full_sync(p, loss):
-        return None, host_sync(p, loss)
-
     dog.stage(f"compile-bs{batch}", COMPILE_TIMEOUT)
     t_compile = time.time()
     for i in range(warmup):
         loss, p, o, s = step_fn(p, o, s, images, labels,
                                 jnp.asarray(i, jnp.int32))
-    full_sync(p, loss)
+    host_sync(p, loss)
     log(f"bs={batch}: warmup+compile {time.time()-t_compile:.1f}s")
     dog.stage(f"steps-bs{batch}", STEP_TIMEOUT)
     t0 = time.time()
     for i in range(iters):
         loss, p, o, s = step_fn(p, o, s, images, labels,
                                 jnp.asarray(i, jnp.int32))
-    _, lossv = full_sync(p, loss)
+    lossv = host_sync(p, loss)
     dt = (time.time() - t0) / iters
     ips = batch / dt
     log(f"bs={batch}: {dt*1e3:.2f} ms/step  {ips:.0f} images/sec  "

@@ -51,11 +51,13 @@ class DataFeeder:
         reference's DataProviderConverter validates at the boundary,
         py_paddle/dataprovider_converter.py index scanner) — fail here
         with the slot named instead."""
-        if arr.size and (arr.min() < 0 or arr.max() >= dim):
-            bad = int(arr.min() if arr.min() < 0 else arr.max())
+        if not arr.size:
+            return
+        mn, mx = int(arr.min()), int(arr.max())
+        if mn < 0 or mx >= dim:
             raise ValueError(
-                f"input '{name}': index {bad} out of range for "
-                f"dimension {dim}")
+                f"input '{name}': index {mn if mn < 0 else mx} out of "
+                f"range for dimension {dim}")
 
     def _convert(self, col: List, itype: InputType, name: str = "?") -> Value:
         if itype.seq == SeqLevel.NO_SEQUENCE:

@@ -1739,6 +1739,35 @@ def sub_seq(input, offsets, sizes, name: Optional[str] = None):
                        size=input.size)
 
 
+def sub_nested_seq(input, selection, name: Optional[str] = None):
+    """Select sub-sequences from a nested sequence (reference:
+    sub_nested_seq_layer, SubNestedSequenceLayer.cpp). ``input`` must be a
+    2-level LoD sequence (sub_lengths set); ``selection`` is an integer
+    sequence whose per-sample values are the indices of the sub-sequences
+    to keep (its own lengths give how many are selected per sample) —
+    the sequence-native form of the reference's -1-padded index matrix.
+    The output is again a nested sequence in selection order."""
+    name = name or auto_name("sub_nested_seq")
+
+    def fwd(params, parent_vals, ctx):
+        pv, sel = parent_vals
+        if pv.sub_lengths is None:
+            raise ValueError(
+                f"sub_nested_seq {name}: input has no sub-sequence "
+                f"structure (sub_lengths is None)")
+        if sel.lengths is None:
+            raise ValueError(
+                f"sub_nested_seq {name}: selection must be a sequence "
+                f"input (its lengths give how many are selected)")
+        out, new_len, new_sub = ops_seq.sub_nested_seq(
+            pv.array, pv.sub_lengths, sel.array.astype(jnp.int32),
+            sel.lengths.astype(jnp.int32))
+        return Value(out, new_len, new_sub)
+
+    return LayerOutput(name, "sub_nested_seq", [input, selection], fwd, [],
+                       size=input.size)
+
+
 def kmax_seq_score(input, beam_size: int = 1, name: Optional[str] = None):
     """Indices of the k largest per-token scores in each sequence
     (reference: kmax_seq_score_layer, KmaxSeqScoreLayer.cpp)."""

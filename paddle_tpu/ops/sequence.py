@@ -139,3 +139,61 @@ def seq_concat(x, x_len, y, y_len):
     out = jnp.where(from_x[..., None], gx, gy)
     valid = t[None, :] < (x_len + y_len)[:, None]
     return jnp.where(valid[..., None], out, 0.0), x_len + y_len
+
+
+def sub_nested_seq(x, sub_lengths, sel_idx, sel_count):
+    """Select sub-sequences from a nested (2-level LoD) sequence batch
+    (reference: SubNestedSequenceLayer.cpp calSelectedRows — given
+    per-sequence selected sub-sequence indices, emit a new nested
+    sequence containing only those sub-sequences, in selection order).
+
+    x: [b, T, D] sub-sequences concatenated on the time axis;
+    sub_lengths: [b, S] per-sub-sequence lengths (0-padded);
+    sel_idx: [b, K] selected sub-sequence indices (entries past
+    sel_count[b] ignored); sel_count: [b].
+    Returns (out [b, T, D], new_lengths [b], new_sub_lengths [b, K]).
+    Static shapes throughout: the output keeps the input's T bound and a
+    position→source gather map is built with comparisons over the K
+    selection slots, so backward is a scatter-add for free under autodiff.
+
+    Contract (in-graph code cannot raise on data): a selection index
+    outside [0, S) or pointing at a 0-length padded slot contributes an
+    EMPTY sub-sequence (never another slot's data — the reference CHECKs
+    this on the host, SubNestedSequenceLayer.cpp calSelectedRows);
+    selecting the same sub-sequence more than once is supported only
+    while the total stays within the input's T bound — beyond that the
+    output (and new_lengths) truncate at T.
+    """
+    b, t_max = x.shape[0], x.shape[1]
+    s = sub_lengths.shape[1]
+    k = sel_idx.shape[1]
+    i32 = jnp.int32
+    sel_idx = sel_idx.astype(i32)
+    k_valid = ((jnp.arange(k, dtype=i32)[None, :] < sel_count[:, None]) &
+               (sel_idx >= 0) & (sel_idx < s))                     # [b,K]
+    sidx = jnp.clip(sel_idx, 0, s - 1)
+    sel_lens = jnp.where(k_valid,
+                         jnp.take_along_axis(sub_lengths.astype(i32), sidx,
+                                             axis=1), 0)           # [b,K]
+    sub_starts = jnp.concatenate(
+        [jnp.zeros((b, 1), i32),
+         jnp.cumsum(sub_lengths.astype(i32), axis=1)[:, :-1]], axis=1)
+    src_starts = jnp.take_along_axis(sub_starts, sidx, axis=1)     # [b,K]
+    out_ends = jnp.cumsum(sel_lens, axis=1)                        # [b,K]
+    out_starts = out_ends - sel_lens
+    new_lengths = jnp.minimum(out_ends[:, -1], t_max)
+    t = jnp.arange(t_max, dtype=i32)
+    in_chunk = ((t[None, :, None] >= out_starts[:, None, :]) &
+                (t[None, :, None] < out_ends[:, None, :]))         # [b,T,K]
+    chunk = jnp.argmax(in_chunk, axis=2).astype(i32)               # [b,T]
+    valid = jnp.any(in_chunk, axis=2)                              # [b,T]
+    off = t[None, :] - jnp.take_along_axis(out_starts, chunk, axis=1)
+    src = jnp.take_along_axis(src_starts, chunk, axis=1) + off
+    src = jnp.clip(src, 0, t_max - 1)
+    if x.ndim == 2:
+        out = jnp.take_along_axis(x, src, axis=1)
+        out = jnp.where(valid, out, jnp.zeros((), x.dtype))
+    else:
+        out = jnp.take_along_axis(x, src[..., None], axis=1)
+        out = jnp.where(valid[..., None], out, jnp.zeros((), x.dtype))
+    return out, new_lengths, sel_lens

@@ -584,3 +584,29 @@ class TestNetworkComposites:
         for b, n in enumerate([5, 3]):
             lo, hi = x[b, :n].min(0) - 1e-5, x[b, :n].max(0) + 1e-5
             assert (o[b] >= lo).all() and (o[b] <= hi).all()
+
+
+def test_sub_nested_seq_layer(rng):
+    """Full-path nested-sequence selection (reference:
+    SubNestedSequenceLayer.cpp): feed a 2-level LoD input + an index
+    sequence of sub-sequences to keep; the output is a new nested
+    sequence in selection order."""
+    from paddle_tpu import data_type as dt
+    from paddle_tpu.data_feeder import DataFeeder
+
+    nested = layer.data("sns_in", dt.dense_vector_sub_sequence(2))
+    sel = layer.data("sns_sel", dt.integer_value_sequence(4))
+    out = layer.sub_nested_seq(nested, sel, name="sns_out")
+    feeder = DataFeeder({"sns_in": dt.dense_vector_sub_sequence(2),
+                         "sns_sel": dt.integer_value_sequence(4)})
+    s0 = [[[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]], [[4.0, 4.0]]]
+    s1 = [[[5.0, 5.0]], [[6.0, 6.0], [7.0, 7.0]]]
+    feeds = feeder.feed([(s0, [1, 0]), (s1, [1])])
+    outs, _ = run1(out, feeds)
+    v = outs["sns_out"]
+    o = np.asarray(v.array)
+    assert list(np.asarray(v.lengths)) == [4, 2]
+    np.testing.assert_allclose(o[0, 0], [4.0, 4.0])
+    np.testing.assert_allclose(o[0, 1:4], [[1, 1], [2, 2], [3, 3]])
+    np.testing.assert_allclose(o[1, :2], [[6, 6], [7, 7]])
+    assert np.asarray(v.sub_lengths).tolist()[0][:2] == [1, 3]

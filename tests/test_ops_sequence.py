@@ -1,5 +1,6 @@
 """Tests: ops.sequence masked segment ops vs per-sequence numpy references."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -100,3 +101,68 @@ def test_seq_concat(rng):
     np.testing.assert_allclose(out[0, 2], yn[0, 0], rtol=1e-6)
     assert np.abs(out[0, 3:]).max() == 0
     np.testing.assert_allclose(out[1, 3:5], yn[1, :2], rtol=1e-6)
+
+
+class TestSubNestedSeq:
+    def _build(self, rng):
+        """Two nested sequences: [[3,1],[2,2,1]] sub-lengths, d=2."""
+        sub_lengths = jnp.asarray([[3, 1, 0], [2, 2, 1]], jnp.int32)
+        data = jnp.asarray(rng.randn(2, 5, 2).astype(np.float32))
+        return data, sub_lengths
+
+    def test_selection_matches_manual(self, rng):
+        data, sub_lengths = self._build(rng)
+        # sample 0: keep sub-seq 1 then 0; sample 1: keep sub-seq 2 only
+        sel = jnp.asarray([[1, 0], [2, 0]], jnp.int32)
+        cnt = jnp.asarray([2, 1], jnp.int32)
+        out, lens, sub = seq.sub_nested_seq(data, sub_lengths, sel, cnt)
+        out, d = np.asarray(out), np.asarray(data)
+        assert list(np.asarray(lens)) == [4, 1]
+        assert np.asarray(sub).tolist() == [[1, 3], [1, 0]]
+        # sample 0: sub-seq 1 is row 3; sub-seq 0 is rows 0..2
+        np.testing.assert_allclose(out[0, 0], d[0, 3])
+        np.testing.assert_allclose(out[0, 1:4], d[0, 0:3])
+        assert np.abs(out[0, 4:]).max() == 0
+        # sample 1: sub-seq 2 is row 4
+        np.testing.assert_allclose(out[1, 0], d[1, 4])
+        assert np.abs(out[1, 1:]).max() == 0
+
+    def test_gradients_flow_to_selected_rows_only(self, rng):
+        data, sub_lengths = self._build(rng)
+        sel = jnp.asarray([[1], [0]], jnp.int32)
+        cnt = jnp.asarray([1, 1], jnp.int32)
+
+        def f(x):
+            out, _, _ = seq.sub_nested_seq(x, sub_lengths, sel, cnt)
+            return jnp.sum(out)
+
+        g = np.asarray(jax.grad(f)(data))
+        # sample 0: only row 3 selected; sample 1: rows 0..1
+        assert g[0, 3].tolist() == [1, 1]
+        assert np.abs(g[0, [0, 1, 2, 4]]).max() == 0
+        assert g[1, :2].tolist() == [[1, 1], [1, 1]]
+        assert np.abs(g[1, 2:]).max() == 0
+
+    def test_index_data_2d(self, rng):
+        """Word-id ([b, T]) nested sequences go through the same path."""
+        ids = jnp.asarray([[5, 6, 7, 8, 0], [1, 2, 3, 4, 9]], jnp.int32)
+        sub_lengths = jnp.asarray([[3, 1, 0], [2, 2, 1]], jnp.int32)
+        sel = jnp.asarray([[1, 0], [1, 0]], jnp.int32)
+        cnt = jnp.asarray([1, 2], jnp.int32)
+        out, lens, _ = seq.sub_nested_seq(ids, sub_lengths, sel, cnt)
+        assert np.asarray(out).tolist()[0][:2] == [8, 0]
+        assert np.asarray(out).tolist()[1] == [3, 4, 1, 2, 0]
+        assert list(np.asarray(lens)) == [1, 4]
+
+    def test_out_of_range_selection_contributes_nothing(self, rng):
+        """Selection index >= S must yield an EMPTY sub-sequence, never
+        another slot's data (the op's in-graph analogue of the
+        reference's host-side CHECK)."""
+        data, sub_lengths = self._build(rng)
+        sel = jnp.asarray([[7, 0], [-2, 1]], jnp.int32)
+        cnt = jnp.asarray([2, 2], jnp.int32)
+        out, lens, sub = seq.sub_nested_seq(data, sub_lengths, sel, cnt)
+        assert np.asarray(sub).tolist() == [[0, 3], [0, 2]]
+        assert list(np.asarray(lens)) == [3, 2]
+        np.testing.assert_allclose(np.asarray(out)[0, :3],
+                                   np.asarray(data)[0, :3])
