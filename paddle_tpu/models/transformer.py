@@ -132,8 +132,11 @@ def forward(params, tokens: jax.Array, cfg: TransformerConfig, *,
         k = k.reshape(B, T, H, Dh)
         v = v.reshape(B, T, H, Dh)
         if seq_sharded and cfg.use_ring_attention:
-            attn = ring.ring_attention_spmd(q, k, v, mesh, causal=True,
-                                            lengths=lengths)
+            # flash blocks inside the ring when the batch is packed —
+            # O(T/P·D) per chip with no score tensor even per ring step
+            attn = ring.ring_attention_spmd(
+                q, k, v, mesh, causal=True, lengths=lengths,
+                use_flash=cfg.use_flash_attention and lengths is None)
         elif cfg.use_flash_attention and lengths is None:
             from paddle_tpu.ops.pallas import flash_attention
             attn = flash_attention(q, k, v, causal=True)

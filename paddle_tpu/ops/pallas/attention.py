@@ -264,6 +264,27 @@ def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def flash_block_fwd(q, k, v, sm_scale, causal, block_q=128, block_k=128,
+                    interpret=False):
+    """Public block-level entry for composed attentions (ring/context
+    parallelism): returns (normalized out, logsumexp) for one q-shard
+    against one k/v-block, both [BH, T, D]. The caller folds blocks with
+    the logsumexp combination rule and drives the backward itself via
+    flash_block_bwd (see parallel/ring.ring_flash_attention)."""
+    return _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                      interpret)
+
+
+def flash_block_bwd(q, k, v, out, lse, do, sm_scale, causal,
+                    block_q=128, block_k=128, interpret=False):
+    """Block-level backward: gradients of sum(out·do) for one q-shard
+    against one k/v-block, given the GLOBAL logsumexp (the flash backward
+    identity p = exp(s − lse) is exact under any block partition of the
+    keys when lse is the all-blocks logsumexp)."""
+    return _flash_bwd_pallas(q, k, v, out, lse, do, sm_scale, causal,
+                             block_q, block_k, interpret)
+
+
 def flash_attention(q, k, v, *, causal: bool = True,
                     sm_scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,

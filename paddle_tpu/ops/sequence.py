@@ -182,6 +182,10 @@ def sub_nested_seq(x, sub_lengths, sel_idx, sel_count):
     out_ends = jnp.cumsum(sel_lens, axis=1)                        # [b,K]
     out_starts = out_ends - sel_lens
     new_lengths = jnp.minimum(out_ends[:, -1], t_max)
+    # duplicate selections past the T bound truncate (see contract above);
+    # the reported per-slot lengths must agree with the truncated content
+    sel_lens = (jnp.minimum(out_ends, t_max) -
+                jnp.minimum(out_starts, t_max))
     t = jnp.arange(t_max, dtype=i32)
     in_chunk = ((t[None, :, None] >= out_starts[:, None, :]) &
                 (t[None, :, None] < out_ends[:, None, :]))         # [b,T,K]

@@ -123,12 +123,15 @@ def ring_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
                         batch_axis: str = place.AXIS_DATA,
                         seq_axis: str = place.AXIS_SEQ,
                         head_axis: str = place.AXIS_MODEL,
-                        scale: Optional[float] = None):
+                        scale: Optional[float] = None,
+                        use_flash: bool = False,
+                        interpret: Optional[bool] = None):
     """shard_map wrapper: q/k/v [B, T, H, D] with B over ``batch_axis``,
     T over ``seq_axis``, and heads over ``head_axis`` when the mesh has one
     (tensor parallelism: each model-shard attends its own heads — attention
     is head-separable so no collective is needed on that axis); lengths [B]
-    sharded with the batch."""
+    sharded with the batch. ``use_flash`` swaps the per-block engine for
+    the Pallas flash kernel (packed equal-length sequences only)."""
     from jax import shard_map
 
     H = q.shape[2]
@@ -137,12 +140,26 @@ def ring_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
           else None)
     qkv_spec = P(batch_axis, seq_axis, tp, None)
     len_spec = P(batch_axis)
+    if use_flash and lengths is not None:
+        raise ValueError("ring flash attention supports packed equal-length "
+                         "sequences only; pass lengths=None or use the "
+                         "jnp engine (use_flash=False)")
+    if interpret is None:
+        # off-TPU the Mosaic lowering doesn't exist; interpret mode keeps
+        # the same code path (tests, CPU dryruns) at reduced speed
+        interpret = jax.devices()[0].platform != "tpu"
     fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
                            scale=scale)
 
     if lengths is None:
-        def wrapped(q_, k_, v_):
-            return fn(q_, k_, v_, lengths=None)
+        if use_flash:
+            def wrapped(q_, k_, v_):
+                return ring_flash_attention(
+                    q_, k_, v_, axis_name=seq_axis, causal=causal,
+                    scale=scale, interpret=interpret)
+        else:
+            def wrapped(q_, k_, v_):
+                return fn(q_, k_, v_, lengths=None)
         return shard_map(wrapped, mesh=mesh,
                          in_specs=(qkv_spec,) * 3,
                          out_specs=qkv_spec, check_vma=False)(q, k, v)
@@ -152,3 +169,155 @@ def ring_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
     return shard_map(wrapped, mesh=mesh,
                      in_specs=(qkv_spec, qkv_spec, qkv_spec, len_spec),
                      out_specs=qkv_spec, check_vma=False)(q, k, v, lengths)
+
+
+def ring_flash_attention(q, k, v, *, axis_name: str, causal: bool = False,
+                         scale: Optional[float] = None,
+                         block_q: int = 128, block_k: int = 128,
+                         interpret: bool = False):
+    """Ring attention with the Pallas flash kernel as the per-block engine.
+
+    Same exactness and rotation scheme as ``ring_attention``, but each
+    ring step runs the streaming-softmax kernel on (q_local, k_block) —
+    no [Tq, Tk] score tensor exists even per step, so per-chip memory is
+    O(T/P·D) and the kernel's MXU pipeline is reused across the ring.
+    Blocks fold by the logsumexp combination rule; the backward re-walks
+    the ring calling the flash backward kernel with the GLOBAL logsumexp
+    (exact: p = exp(s − lse) under any key partition), with dk/dv
+    accumulators riding the rotation so each arrives back at its owner
+    after the full cycle.
+
+    Equal-length (packed) sequences only — for ragged ``lengths`` use
+    ``ring_attention``. Call inside shard_map; q/k/v [B, T_local, H, D].
+    """
+    Tl, D = q.shape[1], q.shape[3]
+    scale = scale or (1.0 / math.sqrt(D))
+    return _ring_flash(q, k, v, axis_name, causal, scale,
+                       min(block_q, Tl), min(block_k, Tl), interpret)
+
+
+def _bhtd(x):
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _btHd(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _fold(o, lse, ob, lseb):
+    """Combine two normalized partial attentions by logsumexp weights."""
+    m = jnp.maximum(lse, lseb)
+    w1 = jnp.exp(lse - m)
+    w2 = jnp.exp(lseb - m)
+    tot = jnp.maximum(w1 + w2, 1e-30)
+    o = (o * w1[..., None] + ob * w2[..., None]) / tot[..., None]
+    return o, m + jnp.log(tot)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, axis_name, causal, scale, block_q, block_k,
+                interpret):
+    out, _ = _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q,
+                             block_k, interpret)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
+                    interpret):
+    from paddle_tpu.ops.pallas.attention import NEG_INF as FNEG
+    from paddle_tpu.ops.pallas.attention import flash_block_fwd
+
+    B, Tl, H, D = q.shape
+    nshards = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % nshards) for i in range(nshards)]
+    # rotate k/v in the kernel's [BH, T, D] layout: one transpose per
+    # tensor instead of one per ring step (ppermute is layout-agnostic)
+    qr, kr, vr = _bhtd(q), _bhtd(k), _bhtd(v)
+
+    # step 0: the diagonal block — the only one needing the causal mask
+    o, lse = flash_block_fwd(qr, kr, vr, scale, causal,
+                             block_q, block_k, interpret)
+    o = o.astype(jnp.float32)
+
+    def body(step, carry):
+        o, lse, k_cur, v_cur = carry
+        # rotate first: at step j the local block is (my - j) mod n
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        ob, lseb = flash_block_fwd(qr, k_cur, v_cur, scale,
+                                   False, block_q, block_k, interpret)
+        if causal:
+            src = (my - step) % nshards
+            lseb = jnp.where(src < my, lseb, FNEG)
+        o, lse = _fold(o, lse, ob.astype(jnp.float32), lseb)
+        return o, lse, k_cur, v_cur
+
+    o, lse, _, _ = jax.lax.fori_loop(1, nshards, body, (o, lse, kr, vr))
+    return _btHd(o, B, H).astype(q.dtype), lse
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale, block_q,
+                        block_k, interpret):
+    out, lse = _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q,
+                               block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k,
+                        interpret, res, do):
+    from paddle_tpu.ops.pallas.attention import NEG_INF as FNEG
+    from paddle_tpu.ops.pallas.attention import flash_block_bwd
+
+    q, k, v, out, lse = res
+    B, Tl, H, D = q.shape
+    nshards = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % nshards) for i in range(nshards)]
+    qr, outr, dor = _bhtd(q), _bhtd(out), _bhtd(do)
+    kr, vr = _bhtd(k), _bhtd(v)
+
+    def rot(*xs):
+        return tuple(jax.lax.ppermute(x, axis_name, perm) for x in xs)
+
+    # diagonal block first (the causal variant), then rotate the block
+    # TOGETHER with its gradient accumulator: at every step the local
+    # (k, v, dk, dv) all describe the same block, each device adds its
+    # contribution, and after n total rotations the accumulators are home
+    dq0, dk0, dv0 = flash_block_bwd(qr, kr, vr, outr, lse, dor,
+                                    scale, causal, block_q, block_k,
+                                    interpret)
+    dq_acc = dq0.astype(jnp.float32)        # [BH, Tl, D], stays local
+    k_cur, v_cur, dk_acc, dv_acc = rot(
+        kr, vr, dk0.astype(jnp.float32), dv0.astype(jnp.float32))
+
+    def body(step, carry):
+        dq_acc, dk_acc, dv_acc, k_cur, v_cur = carry
+        lse_b = lse
+        if causal:
+            # excluded (future) blocks: mask INSIDE the exponent by
+            # feeding lse=+big so p = exp(s - lse) is exactly 0 — zeroing
+            # the kernel's output after the fact would turn an overflowed
+            # p (s far above the global lse, which excludes this block)
+            # into 0·inf = NaN
+            src = (my - step) % nshards
+            lse_b = jnp.where(src < my, lse, -FNEG)
+        dqb, dkb, dvb = flash_block_bwd(qr, k_cur, v_cur,
+                                        outr, lse_b, dor, scale, False,
+                                        block_q, block_k, interpret)
+        dq_acc = dq_acc + dqb.astype(jnp.float32)
+        dk_acc = dk_acc + dkb.astype(jnp.float32)
+        dv_acc = dv_acc + dvb.astype(jnp.float32)
+        k_cur, v_cur, dk_acc, dv_acc = rot(k_cur, v_cur, dk_acc, dv_acc)
+        return dq_acc, dk_acc, dv_acc, k_cur, v_cur
+
+    dq_acc, dk_acc, dv_acc, _, _ = jax.lax.fori_loop(
+        1, nshards, body, (dq_acc, dk_acc, dv_acc, k_cur, v_cur))
+    return (_btHd(dq_acc, B, H).astype(q.dtype),
+            _btHd(dk_acc, B, H).astype(k.dtype),
+            _btHd(dv_acc, B, H).astype(v.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)

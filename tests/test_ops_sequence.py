@@ -166,3 +166,15 @@ class TestSubNestedSeq:
         assert list(np.asarray(lens)) == [3, 2]
         np.testing.assert_allclose(np.asarray(out)[0, :3],
                                    np.asarray(data)[0, :3])
+
+    def test_duplicate_overflow_truncates_consistently(self, rng):
+        """Duplicate selections past the T bound truncate; the returned
+        sub_lengths must agree with the truncated content."""
+        data, sub_lengths = self._build(rng)      # T=5, row0 subs [3,1]
+        sel = jnp.asarray([[0, 0], [1, 1]], jnp.int32)
+        cnt = jnp.asarray([2, 2], jnp.int32)
+        out, lens, sub = seq.sub_nested_seq(data, sub_lengths, sel, cnt)
+        assert list(np.asarray(lens)) == [5, 4]   # 3+3 -> 5 (truncated)
+        sub = np.asarray(sub)
+        assert sub.sum(1).tolist() == list(np.asarray(lens))
+        assert sub.tolist() == [[3, 2], [2, 2]]
