@@ -1,0 +1,128 @@
+"""Config-equivalence goldens: the same network expressed two ways must
+produce identical numbers (reference: gserver/tests/test_NetworkCompare.cpp
+with concat_dotmul_a.conf vs _b.conf, trainer/tests/test_CompareTwoNets.cpp
+— the TPU-native analog copies parameters by name between the two traced
+topologies and compares outputs and gradients)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layer, networks, projection
+from paddle_tpu.topology import Topology, Value
+from paddle_tpu.utils.rng import KeySource
+
+
+def _run(out, feeds, params):
+    topo = Topology(out)
+    fwd = topo.compile()
+    outs, _ = fwd(params.values, params.state,
+                  {k: Value(jnp.asarray(v)) for k, v in feeds.items()})
+    return outs[out.name].array
+
+
+def _grad(out, feeds, params, wname):
+    topo = Topology(out)
+    fwd = topo.compile()
+
+    def loss(pv):
+        o, _ = fwd(pv, params.state,
+                   {k: Value(jnp.asarray(v)) for k, v in feeds.items()})
+        return jnp.sum(o[out.name].array.astype(jnp.float32) ** 2)
+
+    return jax.grad(loss)(params.values)[wname]
+
+
+class TestMixedVsFc:
+    def test_full_matrix_projection_equals_fc(self, rng):
+        """mixed(full_matrix_projection) and fc are the same linear map
+        (reference: the mixed_layer/fc_layer identity the config helpers
+        document)."""
+        x = rng.randn(4, 6).astype(np.float32)
+        inp = layer.data("x", paddle.data_type.dense_vector(6))
+        a = layer.mixed(size=5, input=[projection.full_matrix_projection(
+            inp, 5, param_attr=layer.ParamAttr(name="shared.w"))],
+            act=None, bias_attr=False, name="via_mixed")
+        b = layer.fc(inp, 5, act=None, bias_attr=False, name="via_fc",
+                     param_attr=layer.ParamAttr(name="shared.w"))
+        pa = paddle.parameters.create(a, KeySource(3))
+        pb = paddle.parameters.create(b, KeySource(3))
+        # same named parameter -> same init; outputs must agree exactly
+        np.testing.assert_array_equal(np.asarray(pa["shared.w"]),
+                                      np.asarray(pb["shared.w"]))
+        oa = _run(a, {"x": x}, pa)
+        ob = _run(b, {"x": x}, pb)
+        np.testing.assert_allclose(np.asarray(oa), np.asarray(ob),
+                                   rtol=1e-6, atol=1e-6)
+        ga = _grad(a, {"x": x}, pa, "shared.w")
+        gb = _grad(b, {"x": x}, pb, "shared.w")
+        np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestConcatDotmul:
+    def test_concat_of_dotmuls_equals_elementwise_form(self, rng):
+        """concat(dotmul(a), dotmul(b)) == concat(a, b) * concat(wa, wb)
+        (reference: concat_dotmul_a.conf vs concat_dotmul_b.conf)."""
+        xa = rng.randn(3, 4).astype(np.float32)
+        xb = rng.randn(3, 4).astype(np.float32)
+        da = layer.data("a", paddle.data_type.dense_vector(4))
+        db = layer.data("b", paddle.data_type.dense_vector(4))
+        m1 = layer.mixed(size=4, input=[projection.dotmul_projection(
+            da, param_attr=layer.ParamAttr(name="dm.a"))], act=None,
+            bias_attr=False, name="dm1")
+        m2 = layer.mixed(size=4, input=[projection.dotmul_projection(
+            db, param_attr=layer.ParamAttr(name="dm.b"))], act=None,
+            bias_attr=False, name="dm2")
+        cat = layer.concat([m1, m2], name="cat_a")
+        p = paddle.parameters.create(cat, KeySource(7))
+        got = np.asarray(_run(cat, {"a": xa, "b": xb}, p))
+        wa = np.asarray(p["dm.a"]).reshape(-1)
+        wb = np.asarray(p["dm.b"]).reshape(-1)
+        want = np.concatenate([xa * wa, xb * wb], axis=1)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestBidirectionalLstm:
+    def test_composite_equals_manual_construction(self, rng):
+        """networks.bidirectional_lstm == concat(simple_lstm fwd,
+        simple_lstm reverse) when parameter names are shared
+        (reference: test_CompareTwoNets.cpp protocol)."""
+        T, D, H = 5, 3, 4
+        x = rng.randn(2, T, D).astype(np.float32)
+        lens = np.array([T, 3], np.int32)
+
+        def build(tag, composite):
+            inp = layer.data(f"seq_{tag}",
+                             paddle.data_type.dense_vector_sequence(D))
+            if composite:
+                out = networks.bidirectional_lstm(inp, H, name="bi",
+                                                  return_seq=True)
+            else:
+                f = networks.simple_lstm(inp, H, name="bi_fw")
+                b = networks.simple_lstm(inp, H, reverse=True,
+                                         name="bi_bw")
+                out = layer.concat([f, b], name="bi_manual")
+            return inp, out
+
+        _, ca = build("a", True)
+        _, cb = build("b", False)
+        pa = paddle.parameters.create(ca, KeySource(11))
+        pb = paddle.parameters.create(cb, KeySource(11))
+        # map composite names onto the manual build's names
+        mapping = {}
+        for k in pb.values:
+            mapping[k] = k
+        for k in list(pa.values):
+            assert k in pb.values, (k, sorted(pb.values))
+        fa = Topology(ca).compile()
+        fb = Topology(cb).compile()
+        va = {"seq_a": Value(jnp.asarray(x), jnp.asarray(lens))}
+        vb = {"seq_b": Value(jnp.asarray(x), jnp.asarray(lens))}
+        oa, _ = fa(pa.values, pa.state, va)
+        ob, _ = fb(pa.values, pb.state, vb)   # SAME weights on both
+        np.testing.assert_allclose(
+            np.asarray(oa[ca.name].array), np.asarray(ob[cb.name].array),
+            rtol=1e-5, atol=1e-6)
