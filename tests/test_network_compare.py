@@ -7,7 +7,6 @@ topologies and compares outputs and gradients)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import layer, networks, projection
@@ -15,24 +14,18 @@ from paddle_tpu.topology import Topology, Value
 from paddle_tpu.utils.rng import KeySource
 
 
-def _run(out, feeds, params):
-    topo = Topology(out)
-    fwd = topo.compile()
-    outs, _ = fwd(params.values, params.state,
-                  {k: Value(jnp.asarray(v)) for k, v in feeds.items()})
-    return outs[out.name].array
-
-
-def _grad(out, feeds, params, wname):
-    topo = Topology(out)
-    fwd = topo.compile()
+def _run_and_grad(out, feeds, params, wname):
+    """One compile per network: (output, d(sum(output^2))/d params[wname])."""
+    fwd = Topology(out).compile()
+    vals = {k: Value(jnp.asarray(v)) for k, v in feeds.items()}
 
     def loss(pv):
-        o, _ = fwd(pv, params.state,
-                   {k: Value(jnp.asarray(v)) for k, v in feeds.items()})
-        return jnp.sum(o[out.name].array.astype(jnp.float32) ** 2)
+        o, _ = fwd(pv, params.state, vals)
+        arr = o[out.name].array
+        return jnp.sum(arr.astype(jnp.float32) ** 2), arr
 
-    return jax.grad(loss)(params.values)[wname]
+    (_, arr), grads = jax.value_and_grad(loss, has_aux=True)(params.values)
+    return arr, grads[wname]
 
 
 class TestMixedVsFc:
@@ -52,12 +45,10 @@ class TestMixedVsFc:
         # same named parameter -> same init; outputs must agree exactly
         np.testing.assert_array_equal(np.asarray(pa["shared.w"]),
                                       np.asarray(pb["shared.w"]))
-        oa = _run(a, {"x": x}, pa)
-        ob = _run(b, {"x": x}, pb)
+        oa, ga = _run_and_grad(a, {"x": x}, pa, "shared.w")
+        ob, gb = _run_and_grad(b, {"x": x}, pb, "shared.w")
         np.testing.assert_allclose(np.asarray(oa), np.asarray(ob),
                                    rtol=1e-6, atol=1e-6)
-        ga = _grad(a, {"x": x}, pa, "shared.w")
-        gb = _grad(b, {"x": x}, pb, "shared.w")
         np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
                                    rtol=1e-5, atol=1e-6)
 
@@ -78,7 +69,8 @@ class TestConcatDotmul:
             bias_attr=False, name="dm2")
         cat = layer.concat([m1, m2], name="cat_a")
         p = paddle.parameters.create(cat, KeySource(7))
-        got = np.asarray(_run(cat, {"a": xa, "b": xb}, p))
+        got, _ = _run_and_grad(cat, {"a": xa, "b": xb}, p, "dm.a")
+        got = np.asarray(got)
         wa = np.asarray(p["dm.a"]).reshape(-1)
         wb = np.asarray(p["dm.b"]).reshape(-1)
         want = np.concatenate([xa * wa, xb * wb], axis=1)
@@ -111,12 +103,10 @@ class TestBidirectionalLstm:
         _, cb = build("b", False)
         pa = paddle.parameters.create(ca, KeySource(11))
         pb = paddle.parameters.create(cb, KeySource(11))
-        # map composite names onto the manual build's names
-        mapping = {}
-        for k in pb.values:
-            mapping[k] = k
-        for k in list(pa.values):
-            assert k in pb.values, (k, sorted(pb.values))
+        # both builds must produce the SAME parameter set for the
+        # weight-sharing comparison below to be meaningful
+        assert set(pa.values) == set(pb.values), (
+            sorted(pa.values), sorted(pb.values))
         fa = Topology(ca).compile()
         fb = Topology(cb).compile()
         va = {"seq_a": Value(jnp.asarray(x), jnp.asarray(lens))}
