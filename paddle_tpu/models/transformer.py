@@ -99,12 +99,16 @@ def _layer_norm(x, g, b):
 
 def forward(params, tokens: jax.Array, cfg: TransformerConfig, *,
             mesh: Optional[Mesh] = None,
-            lengths: Optional[jax.Array] = None) -> jax.Array:
+            lengths: Optional[jax.Array] = None,
+            return_kv: bool = False):
     """tokens [B, T] int32 → logits [B, T, vocab] (float32).
 
     With ``cfg.use_ring_attention`` and a mesh carrying a >1 ``seq`` axis,
     attention runs as ring CP; activations get seq-sharding constraints so
     XLA keeps the [B, T, D] tensors distributed end-to-end.
+    ``return_kv=True`` additionally returns the per-layer (k, v)
+    projections stacked [L, B, T, H, Dh] — the prefill path of the
+    KV-cache decoder shares this exact block so the two can't drift.
     """
     B, T = tokens.shape
     H, Dh = cfg.n_heads, cfg.head_dim
@@ -151,12 +155,16 @@ def forward(params, tokens: jax.Array, cfg: TransformerConfig, *,
         ff = jax.nn.gelu(ff)
         x = x + jnp.einsum("btf,fd->btd", ff,
                            w["mlp_out"].astype(ff.dtype))
-        return constrain(x), None
+        kv = (k.astype(cfg.dtype), v.astype(cfg.dtype)) \
+            if return_kv else None
+        return constrain(x), kv
 
-    x, _ = jax.lax.scan(block, x, params["blocks"])
+    x, kvs = jax.lax.scan(block, x, params["blocks"])
     x = _layer_norm(x, params["ln_f"], params["ln_f_b"])
     logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
                         params["embed"].astype(jnp.float32))
+    if return_kv:
+        return logits, kvs
     return logits
 
 
@@ -172,3 +180,112 @@ def lm_loss(params, tokens, targets, cfg: TransformerConfig, *,
     else:
         mask = jnp.ones_like(tok_ce)
     return jnp.sum(tok_ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
+    """Per-layer KV cache for incremental decoding: [L, B, max_len, H, Dh]
+    (the serving-side analog of the reference's recurrent generation
+    machinery, trainer/tests/test_recurrent_machine_generation.cpp slot)."""
+    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def prefill(params, tokens: jax.Array, cfg: TransformerConfig,
+            cache_len: int):
+    """Batched prompt ingestion: ``forward(..., return_kv=True)`` (the
+    SAME block the training path runs — flash/ring dispatch included)
+    plus cache padding to ``cache_len``. Returns (last-position logits
+    [B, vocab] fp32, cache)."""
+    T = tokens.shape[1]
+    logits, (kc, vc) = forward(params, tokens, cfg, return_kv=True)
+    pad = ((0, 0), (0, 0), (0, cache_len - T), (0, 0), (0, 0))
+    return logits[:, -1], {"k": jnp.pad(kc, pad), "v": jnp.pad(vc, pad)}
+
+
+def decode_step(params, cache, tokens: jax.Array, pos: jax.Array,
+                cfg: TransformerConfig):
+    """One incremental step: tokens [B] at position ``pos`` (scalar int32)
+    → (logits [B, vocab] fp32, updated cache). All shapes static; the
+    cache updates via dynamic_update_slice so the step compiles once and
+    is replayed for every position (lax.scan-friendly)."""
+    B = tokens.shape[0]
+    H, Dh = cfg.n_heads, cfg.head_dim
+    max_len = cache["k"].shape[2]
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    x = x + jax.lax.dynamic_index_in_dim(
+        params["pos"], pos, keepdims=False).astype(cfg.dtype)
+
+    def block(x, scanned):
+        w, kc, vc = scanned                      # kc/vc [B, max_len, H, Dh]
+        h = _layer_norm(x, w["ln1"], w["ln1_b"])
+        qkv = h @ w["qkv"].astype(h.dtype)       # [B, 3D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        kc = jax.lax.dynamic_update_slice_in_dim(
+            kc, k.reshape(B, 1, H, Dh).astype(kc.dtype), pos, axis=1)
+        vc = jax.lax.dynamic_update_slice_in_dim(
+            vc, v.reshape(B, 1, H, Dh).astype(vc.dtype), pos, axis=1)
+        q32 = q.reshape(B, H, Dh).astype(jnp.float32)
+        s = jnp.einsum("bhd,bthd->bht", q32,
+                       kc.astype(jnp.float32)) / math.sqrt(Dh)
+        mask = jnp.arange(max_len) <= pos
+        s = jnp.where(mask[None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bht,bthd->bhd", p, vc.astype(jnp.float32))
+        attn = attn.reshape(B, cfg.d_model).astype(cfg.dtype)
+        x = x + attn @ w["attn_out"].astype(attn.dtype)
+        h2 = _layer_norm(x, w["ln2"], w["ln2_b"])
+        ff = jax.nn.gelu(h2 @ w["mlp_in"].astype(h2.dtype))
+        x = x + ff @ w["mlp_out"].astype(ff.dtype)
+        return x, (kc, vc)
+
+    x, (kn, vn) = jax.lax.scan(block, x,
+                               (params["blocks"], cache["k"], cache["v"]))
+    x = _layer_norm(x, params["ln_f"], params["ln_f_b"])
+    logits = jnp.einsum("bd,vd->bv", x.astype(jnp.float32),
+                        params["embed"].astype(jnp.float32))
+    return logits, {"k": kn, "v": vn}
+
+
+def generate(params, prompt: jax.Array, cfg: TransformerConfig, *,
+             max_new: int, temperature: float = 0.0,
+             key: Optional[jax.Array] = None) -> jax.Array:
+    """Autoregressive generation: prompt [B, Tp] → [B, Tp + max_new].
+
+    Batched prefill fills the KV cache in one forward pass, then a
+    ``lax.scan`` replays the compiled single-token step ``max_new`` times
+    — the TPU-idiomatic decode loop (no per-step retracing, no growing
+    shapes). temperature=0 is greedy argmax; otherwise categorical
+    sampling with ``key``."""
+    B, Tp = prompt.shape
+    if max_new < 1:
+        raise ValueError(f"generate: max_new must be >= 1, got {max_new}")
+    cache_len = Tp + max_new
+    if cache_len > cfg.max_len:
+        raise ValueError(f"generate: {cache_len} positions exceed "
+                         f"cfg.max_len={cfg.max_len}")
+    if temperature > 0 and key is None:
+        raise ValueError("generate: sampling (temperature>0) needs a key")
+    logits, cache = prefill(params, prompt, cfg, cache_len)
+    key = key if key is not None else jax.random.PRNGKey(0)
+
+    def sample(logits, k):
+        if temperature > 0:
+            return jax.random.categorical(k, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    key, k0 = jax.random.split(key)
+    first = sample(logits, k0).astype(jnp.int32)
+
+    def step(carry, i):
+        cache, tok, key = carry
+        key, ks = jax.random.split(key)
+        logits, cache = decode_step(params, cache, tok, Tp + i, cfg)
+        nxt = sample(logits, ks).astype(jnp.int32)
+        return (cache, nxt, key), tok
+
+    (_, last, _), toks = jax.lax.scan(
+        step, (cache, first, key), jnp.arange(max_new - 1, dtype=jnp.int32))
+    generated = jnp.concatenate(
+        [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1) \
+        if max_new > 1 else first[:, None]
+    return jnp.concatenate([prompt, generated], axis=1)

@@ -221,3 +221,69 @@ class TestRingFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=5e-4, atol=5e-5,
                                        err_msg=f"d{name}")
+
+
+class TestGenerate:
+    CFG = transformer.TransformerConfig(
+        vocab=50, d_model=16, n_layers=2, n_heads=2, d_ff=32, max_len=24,
+        dtype=jnp.float32)
+
+    def test_decode_matches_forward_teacher_forcing(self, rng):
+        """KV-cache incremental decode must reproduce the full forward's
+        logits position by position (the correctness bar for any cache)."""
+        cfg = self.CFG
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        B, T = 2, 8
+        toks = jnp.asarray(rng.randint(0, cfg.vocab, (B, T)), jnp.int32)
+        full = transformer.forward(params, toks, cfg)        # [B, T, V]
+        cache = transformer.init_cache(cfg, B, T)
+        for t in range(T):
+            step_logits, cache = transformer.decode_step(
+                params, cache, toks[:, t], jnp.asarray(t, jnp.int32), cfg)
+            np.testing.assert_allclose(
+                np.asarray(step_logits), np.asarray(full[:, t]),
+                rtol=2e-4, atol=2e-4, err_msg=f"position {t}")
+
+    def test_prefill_matches_forward_last_position(self, rng):
+        cfg = self.CFG
+        params = transformer.init_params(jax.random.PRNGKey(1), cfg)
+        B, T = 2, 6
+        toks = jnp.asarray(rng.randint(0, cfg.vocab, (B, T)), jnp.int32)
+        full = transformer.forward(params, toks, cfg)
+        logits, cache = transformer.prefill(params, toks, cfg, T + 4)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, -1]),
+                                   rtol=2e-4, atol=2e-4)
+        assert cache["k"].shape == (2, B, T + 4, 2, 8)
+
+    def test_greedy_generate_matches_stepwise_argmax(self, rng):
+        """generate(temperature=0) must equal the naive loop that reruns
+        the full forward and takes argmax each step."""
+        cfg = self.CFG
+        params = transformer.init_params(jax.random.PRNGKey(2), cfg)
+        B, Tp, new = 2, 5, 6
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab, (B, Tp)), jnp.int32)
+        got = transformer.generate(params, prompt, cfg, max_new=new)
+        assert got.shape == (B, Tp + new)
+        ref = prompt
+        for _ in range(new):
+            logits = transformer.forward(params, ref, cfg)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            ref = jnp.concatenate([ref, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_sampling_reproducible_and_bounded(self, rng):
+        cfg = self.CFG
+        params = transformer.init_params(jax.random.PRNGKey(3), cfg)
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab, (1, 4)), jnp.int32)
+        a = transformer.generate(params, prompt, cfg, max_new=5,
+                                 temperature=1.0, key=jax.random.PRNGKey(9))
+        b = transformer.generate(params, prompt, cfg, max_new=5,
+                                 temperature=1.0, key=jax.random.PRNGKey(9))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert int(np.asarray(a).max()) < cfg.vocab
+        with pytest.raises(ValueError, match="needs a key"):
+            transformer.generate(params, prompt, cfg, max_new=2,
+                                 temperature=0.5)
+        with pytest.raises(ValueError, match="max_len"):
+            transformer.generate(params, prompt, cfg, max_new=100)
