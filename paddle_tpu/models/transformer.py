@@ -110,6 +110,11 @@ def forward(params, tokens: jax.Array, cfg: TransformerConfig, *,
     projections stacked [L, B, T, H, Dh] — the prefill path of the
     KV-cache decoder shares this exact block so the two can't drift.
     """
+    return _forward_impl(params, tokens, cfg, mesh, lengths, return_kv,
+                         head="all")
+
+
+def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head):
     B, T = tokens.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
@@ -160,6 +165,10 @@ def forward(params, tokens: jax.Array, cfg: TransformerConfig, *,
         return constrain(x), kv
 
     x, kvs = jax.lax.scan(block, x, params["blocks"])
+    if head == "last":
+        # serving prefill: only the final position feeds the vocab head —
+        # skips the O(T·vocab) logits tensor a full head would materialize
+        x = x[:, -1:]
     x = _layer_norm(x, params["ln_f"], params["ln_f_b"])
     logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
                         params["embed"].astype(jnp.float32))
@@ -191,15 +200,18 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
 
 
 def prefill(params, tokens: jax.Array, cfg: TransformerConfig,
-            cache_len: int):
-    """Batched prompt ingestion: ``forward(..., return_kv=True)`` (the
-    SAME block the training path runs — flash/ring dispatch included)
-    plus cache padding to ``cache_len``. Returns (last-position logits
-    [B, vocab] fp32, cache)."""
+            cache_len: int, *, mesh: Optional[Mesh] = None):
+    """Batched prompt ingestion: the SAME traced block the training path
+    runs (flash/ring dispatch included when ``mesh`` is passed) with the
+    vocab head applied to the last position only, plus cache padding to
+    ``cache_len``. Returns (last-position logits [B, vocab] fp32, cache).
+    Packed (equal-length) prompts only — the decode loop's position
+    counter is shared across the batch."""
     T = tokens.shape[1]
-    logits, (kc, vc) = forward(params, tokens, cfg, return_kv=True)
+    logits, (kc, vc) = _forward_impl(params, tokens, cfg, mesh, None,
+                                     True, head="last")
     pad = ((0, 0), (0, 0), (0, cache_len - T), (0, 0), (0, 0))
-    return logits[:, -1], {"k": jnp.pad(kc, pad), "v": jnp.pad(vc, pad)}
+    return logits[:, 0], {"k": jnp.pad(kc, pad), "v": jnp.pad(vc, pad)}
 
 
 def decode_step(params, cache, tokens: jax.Array, pos: jax.Array,
@@ -248,7 +260,8 @@ def decode_step(params, cache, tokens: jax.Array, pos: jax.Array,
 
 def generate(params, prompt: jax.Array, cfg: TransformerConfig, *,
              max_new: int, temperature: float = 0.0,
-             key: Optional[jax.Array] = None) -> jax.Array:
+             key: Optional[jax.Array] = None,
+             mesh: Optional[Mesh] = None) -> jax.Array:
     """Autoregressive generation: prompt [B, Tp] → [B, Tp + max_new].
 
     Batched prefill fills the KV cache in one forward pass, then a
@@ -265,7 +278,7 @@ def generate(params, prompt: jax.Array, cfg: TransformerConfig, *,
                          f"cfg.max_len={cfg.max_len}")
     if temperature > 0 and key is None:
         raise ValueError("generate: sampling (temperature>0) needs a key")
-    logits, cache = prefill(params, prompt, cfg, cache_len)
+    logits, cache = prefill(params, prompt, cfg, cache_len, mesh=mesh)
     key = key if key is not None else jax.random.PRNGKey(0)
 
     def sample(logits, k):
@@ -289,3 +302,79 @@ def generate(params, prompt: jax.Array, cfg: TransformerConfig, *,
         [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1) \
         if max_new > 1 else first[:, None]
     return jnp.concatenate([prompt, generated], axis=1)
+
+
+def beam_search(params, prompt: jax.Array, cfg: TransformerConfig, *,
+                max_new: int, beam_size: int = 4,
+                mesh: Optional[Mesh] = None) -> tuple:
+    """Beam-search decoding over the KV cache: prompt [B, Tp] →
+    (tokens [B, beam, Tp + max_new], scores [B, beam], best first.
+
+    The transformer-flagship analog of the recurrent DSL's beam_search
+    (recurrent.py; reference: RecurrentGradientMachine generation,
+    GradientMachine::eval beam path). The cache carries B·beam hypotheses
+    flattened on the batch axis; each step scores beam·vocab expansions,
+    keeps the top ``beam_size``, and GATHERS the cache rows of the
+    surviving hypotheses — all static shapes under one lax.scan. (No
+    length penalty: all hypotheses here have identical length max_new,
+    so any GNMT-style α rescales every score equally; EOS-terminated
+    variable-length decoding is the recurrent DSL's beam_search domain.)"""
+    B, Tp = prompt.shape
+    if max_new < 1:
+        raise ValueError(f"beam_search: max_new must be >= 1, got {max_new}")
+    cache_len = Tp + max_new
+    if cache_len > cfg.max_len:
+        raise ValueError(f"beam_search: {cache_len} positions exceed "
+                         f"cfg.max_len={cfg.max_len}")
+    if beam_size < 1 or beam_size > cfg.vocab:
+        raise ValueError(f"beam_search: beam_size {beam_size} must be in "
+                         f"[1, vocab={cfg.vocab}]")
+    K, V = beam_size, cfg.vocab
+
+    logits, cache = prefill(params, prompt, cfg, cache_len, mesh=mesh)
+    logp0 = jax.nn.log_softmax(logits, axis=-1)            # [B, V]
+    top0, tok0 = jax.lax.top_k(logp0, K)                   # [B, K]
+    # replicate the cache per beam: [L, B, T, H, Dh] -> [L, B*K, T, H, Dh]
+    cache = jax.tree_util.tree_map(lambda c: jnp.repeat(c, K, axis=1),
+                                   cache)
+    scores = top0                                          # [B, K]
+    toks = tok0.astype(jnp.int32)                          # [B, K] step-0 pick
+    batch_base = (jnp.arange(B, dtype=jnp.int32)[:, None] * K)  # [B, 1]
+
+    def step(carry, i):
+        cache, toks, scores = carry
+        flat = toks.reshape(B * K)
+        logits, cache = decode_step(params, cache, flat, Tp + i, cfg)
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, V)
+        total = scores[:, :, None] + logp                  # [B, K, V]
+        top, idx = jax.lax.top_k(total.reshape(B, K * V), K)
+        beam_src = (idx // V).astype(jnp.int32)            # [B, K]
+        nxt = (idx % V).astype(jnp.int32)
+        # reindex the cache rows to the surviving hypotheses
+        flat_src = (batch_base + beam_src).reshape(B * K)
+        cache = jax.tree_util.tree_map(
+            lambda c: jnp.take(c, flat_src, axis=1), cache)
+        return (cache, nxt, top), (toks, beam_src)
+
+    (cache, last, scores), (hist_toks, hist_src) = jax.lax.scan(
+        step, (cache, toks, scores),
+        jnp.arange(max_new - 1, dtype=jnp.int32))
+
+    # backtrack: hist_toks[i] holds position-i tokens in the beam order
+    # BEFORE step i's reshuffle (O_i) while hist_src[i] maps the
+    # post-reshuffle order O_{i+1} back to O_i — so the survivor pointer
+    # must step through src FIRST, then gather the token row
+    def back(carry, xs):
+        ptr = carry                                        # [B, K] in O_{i+1}
+        t, src = xs
+        ptr = jnp.take_along_axis(src, ptr, axis=1)        # now in O_i
+        tok = jnp.take_along_axis(t, ptr, axis=1)
+        return ptr, tok
+
+    ptr0 = jnp.tile(jnp.arange(K, dtype=jnp.int32)[None], (B, 1))
+    _, rev = jax.lax.scan(back, ptr0, (hist_toks, hist_src), reverse=True)
+    seq = jnp.concatenate([jnp.moveaxis(rev, 0, 2), last[:, :, None]],
+                          axis=2) if max_new > 1 else toks[:, :, None]
+    prompt_rep = jnp.repeat(prompt[:, None, :], K, axis=1)
+    out = jnp.concatenate([prompt_rep, seq], axis=2)       # [B, K, Tp+new]
+    return out, scores

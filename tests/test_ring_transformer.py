@@ -287,3 +287,58 @@ class TestGenerate:
                                  temperature=0.5)
         with pytest.raises(ValueError, match="max_len"):
             transformer.generate(params, prompt, cfg, max_new=100)
+
+
+class TestBeamSearch:
+    CFG = transformer.TransformerConfig(
+        vocab=20, d_model=16, n_layers=2, n_heads=2, d_ff=32, max_len=20,
+        dtype=jnp.float32)
+
+    def _score_of(self, params, cfg, seq, Tp):
+        """Recompute a hypothesis's logprob with the plain forward."""
+        logits = transformer.forward(params, seq[None, :-1], cfg)
+        lp = jax.nn.log_softmax(logits, axis=-1)[0]
+        tgt = seq[Tp:]
+        pos = jnp.arange(Tp - 1, Tp - 1 + tgt.shape[0])
+        return float(jnp.sum(lp[pos, tgt]))
+
+    def test_scores_match_forward_recompute(self, rng):
+        """Every returned hypothesis's reported score must equal the sum
+        of stepwise log-probs under the plain forward — this pins both
+        the lineage backtracking and the score accumulation."""
+        cfg = self.CFG
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        B, Tp, new, K = 2, 4, 5, 3
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab, (B, Tp)), jnp.int32)
+        seqs, scores = transformer.beam_search(params, prompt, cfg,
+                                               max_new=new, beam_size=K)
+        assert seqs.shape == (B, K, Tp + new)
+        for b in range(B):
+            # scores descending
+            s = np.asarray(scores[b])
+            assert (np.diff(s) <= 1e-6).all(), s
+            for j in range(K):
+                want = self._score_of(params, cfg, seqs[b, j], Tp)
+                np.testing.assert_allclose(float(scores[b, j]), want,
+                                           rtol=2e-4, atol=2e-3)
+
+    def test_beam1_equals_greedy(self, rng):
+        cfg = self.CFG
+        params = transformer.init_params(jax.random.PRNGKey(1), cfg)
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab, (2, 3)), jnp.int32)
+        beam, _ = transformer.beam_search(params, prompt, cfg, max_new=6,
+                                          beam_size=1)
+        greedy = transformer.generate(params, prompt, cfg, max_new=6)
+        np.testing.assert_array_equal(np.asarray(beam[:, 0]),
+                                      np.asarray(greedy))
+
+    def test_beam_at_least_as_good_as_greedy(self, rng):
+        cfg = self.CFG
+        params = transformer.init_params(jax.random.PRNGKey(2), cfg)
+        Tp, new = 3, 6
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab, (1, Tp)), jnp.int32)
+        _, scores = transformer.beam_search(params, prompt, cfg,
+                                            max_new=new, beam_size=4)
+        greedy = transformer.generate(params, prompt, cfg, max_new=new)
+        gs = self._score_of(params, cfg, greedy[0], Tp)
+        assert float(scores[0, 0]) >= gs - 1e-4
