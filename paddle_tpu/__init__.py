@@ -74,6 +74,11 @@ def init(**kwargs):
     """
     from paddle_tpu.utils import flags as _flags
     from paddle_tpu.utils import rng as _rng
+    if kwargs.get("platform"):
+        # must run before any jax computation; the JAX_PLATFORMS env var
+        # cannot serve here because site hooks may override it
+        import jax
+        jax.config.update("jax_platforms", kwargs["platform"])
     for k, v in kwargs.items():
         _flags.GLOBAL_FLAGS.set_if_known(_LEGACY_FLAG_ALIASES.get(k, k), v)
     if kwargs.get("seed"):

@@ -187,6 +187,17 @@ class Topology:
     def data_names(self) -> List[str]:
         return [l.name for l in self.data_layers]
 
+    def find(self, name: str) -> LayerOutput:
+        """Address any layer's output by name (the get_output capability:
+        reference gserver GetOutputLayer / classify.py --job=extract —
+        pass the result as an inference output_layer to extract features
+        at that point in the program)."""
+        for l in self.layers:
+            if l.name == name:
+                return l
+        raise KeyError(f"no layer named {name!r}; have "
+                       f"{[l.name for l in self.layers]}")
+
     # -- compile -----------------------------------------------------------
     def compile(self, extra_outputs: Sequence[LayerOutput] = ()):
         """Build forward(params, state, feeds, *, is_training, dropout_key)

@@ -197,3 +197,17 @@ def test_feeder_rejects_out_of_range_indices():
         seq_feeder.feed([([1, 2, 30],)])
     # in-range passes
     assert feeder.feed([(9,), (0,)])["label"].array.shape == (2,)
+
+
+def test_topology_find_addresses_any_layer():
+    """Topology.find gives the get_output capability: any layer's output
+    is addressable by name for feature extraction (reference:
+    model_zoo/resnet/classify.py --job=extract)."""
+    from paddle_tpu.topology import Topology
+    x = layer.data("tf_x", dt.dense_vector(4))
+    h = layer.fc(x, 8, name="tf_hidden")
+    out = layer.fc(h, 2, name="tf_out")
+    topo = Topology(out)
+    assert topo.find("tf_hidden") is h
+    with pytest.raises(KeyError, match="nope"):
+        topo.find("nope")
