@@ -14,7 +14,6 @@ Run: python demos/model_zoo/extract.py [--passes N] [--out-dir DIR]
 """
 
 import argparse
-import io
 import os
 import sys
 
@@ -81,10 +80,9 @@ def main():
 
     # (3) dump a parameter matrix as text (extract_para.py format)
     wname = sorted(loaded.names())[0]
-    mat = np.asarray(loaded[wname]).reshape(-1, 1) \
-        if np.asarray(loaded[wname]).ndim == 1 else np.asarray(loaded[wname])
+    mat = loaded[wname]
     txt_path = os.path.join(args.out_dir, f"{wname.replace('/', '_')}.txt")
-    with io.open(txt_path, "w") as f:
+    with open(txt_path, "w") as f:
         for row in mat.reshape(mat.shape[0], -1):
             f.write(" ".join(f"{x:.6f}" for x in row) + "\n")
     print(f"dumped {wname} {mat.shape} -> {txt_path}")

@@ -78,6 +78,12 @@ def init(**kwargs):
         # must run before any jax computation; the JAX_PLATFORMS env var
         # cannot serve here because site hooks may override it
         import jax
+        from jax._src import xla_bridge
+        if xla_bridge.backends_are_initialized():
+            raise RuntimeError(
+                "paddle.init(platform=...) called after the JAX backend "
+                "was already initialized - the setting would be silently "
+                "ignored. Call init() before any jax computation.")
         jax.config.update("jax_platforms", kwargs["platform"])
     for k, v in kwargs.items():
         _flags.GLOBAL_FLAGS.set_if_known(_LEGACY_FLAG_ALIASES.get(k, k), v)
