@@ -194,3 +194,39 @@ class TestMachineTranslation:
             opt=paddle.optimizer.Adam(learning_rate=5e-3,
                                       gradient_clipping_threshold=5.0))
         assert np.mean(costs[-4:]) < np.mean(costs[:4]), costs
+
+
+class TestLearnToRank:
+    def test_mq2007_pairwise_rank_cost(self):
+        """Pairwise LTR on the MQ2007 schema: a shared scoring tower
+        applied to (better, worse) documents under rank_cost
+        (reference: RankingCost / the quick_start pairwise config;
+        dataset: v2/dataset/mq2007.py pairwise mode)."""
+        dim = paddle.dataset.mq2007.FEATURE_DIM
+        shared = layer.ParamAttr(name="ltr.w")
+        better = layer.data("ltr_better", paddle.data_type.dense_vector(dim))
+        worse = layer.data("ltr_worse", paddle.data_type.dense_vector(dim))
+        lbl = layer.data("ltr_label", paddle.data_type.dense_vector(1))
+        sb = layer.fc(better, 1, act=None, param_attr=shared,
+                      bias_attr=False, name="ltr_sb")
+        sw = layer.fc(worse, 1, act=None, param_attr=shared,
+                      bias_attr=False, name="ltr_sw")
+        cost = layer.rank_cost(sb, sw, lbl, name="ltr_cost")
+
+        def raw():
+            # mq2007 pairwise yields (label, better_vec, worse_vec)
+            for lab, b, w in paddle.reader.firstn(
+                    paddle.dataset.mq2007.train("pairwise"), 256)():
+                yield b, w, [float(np.asarray(lab).reshape(-1)[0])]
+
+        # pairs stream grouped by query — shuffle so every batch mixes
+        # queries, and compare whole passes (within-pass cost is not
+        # monotone because query difficulty varies)
+        reader = paddle.reader.shuffle(raw, buf_size=256, seed=1)
+        passes = 4
+        costs, _ = train_and_costs(
+            cost, reader, passes=passes, batch=32,
+            feeding={"ltr_better": 0, "ltr_worse": 1, "ltr_label": 2},
+            opt=paddle.optimizer.Adam(learning_rate=1e-3))
+        per_pass = np.asarray(costs).reshape(passes, -1).mean(axis=1)
+        assert per_pass[-1] < per_pass[0], per_pass
