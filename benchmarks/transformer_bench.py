@@ -60,24 +60,17 @@ def _run_variant(args, tfm, jax, jnp, tokens, use_flash):
         n_heads=args.d_model // 64, d_ff=4 * args.d_model,
         max_len=args.seq, use_flash_attention=use_flash)
     params = tfm.init_params(jax.random.PRNGKey(0), cfg)
-    # tree-shaped Adam (the framework Optimizer class serves the flat
-    # layer-DSL param dicts; the transformer is a nested pytree)
-    b1, b2, lr, eps = 0.9, 0.999, 1e-4, 1e-8
-    opt_state = (jax.tree.map(lambda p: jnp.zeros_like(p), params),
-                 jax.tree.map(lambda p: jnp.zeros_like(p), params))
+    # the framework optimizer serves the transformer's nested pytree
+    # directly via tree_update (same per-array Adam rule as the v2 path)
+    from paddle_tpu import optimizer as popt
+    adam = popt.Adam(learning_rate=1e-4)
+    opt_state = adam.tree_init_state(params)
     targets = jnp.roll(tokens, -1, axis=1)
 
     def train_step(p, o, toks, tgts, i):
         loss, g = jax.value_and_grad(tfm.lm_loss)(p, toks, tgts, cfg)
-        m, v = o
-        t = i.astype(jnp.float32) + 1.0
-        m = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, m, g)
-        v = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, v, g)
-        corr = jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
-        newp = jax.tree.map(
-            lambda p, m, v: p - lr * corr * m / (jnp.sqrt(v) + eps),
-            p, m, v)
-        return loss, newp, (m, v)
+        newp, o = adam.tree_update(i, g, p, o)
+        return loss, newp, o
 
     from paddle_tpu.utils.sync import host_sync
 

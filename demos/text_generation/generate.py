@@ -39,9 +39,8 @@ def main():
                                 n_heads=2, d_ff=128, max_len=128,
                                 dtype=jnp.float32)
     params = tfm.init_params(jax.random.PRNGKey(0), cfg)
-    lr = 3e-3
-    opt = (jax.tree.map(jnp.zeros_like, params),
-           jax.tree.map(jnp.zeros_like, params))
+    adam = paddle.optimizer.Adam(learning_rate=3e-3)
+    opt = adam.tree_init_state(params)
 
     T, B = 64, 8
     rng = np.random.RandomState(0)
@@ -49,15 +48,8 @@ def main():
     @jax.jit
     def step(p, o, toks, tgts, i):
         loss, g = jax.value_and_grad(tfm.lm_loss)(p, toks, tgts, cfg)
-        m, v = o
-        t = i.astype(jnp.float32) + 1.0
-        m = jax.tree.map(lambda m, g: 0.9 * m + 0.1 * g, m, g)
-        v = jax.tree.map(lambda v, g: 0.999 * v + 0.001 * g * g, v, g)
-        corr = jnp.sqrt(1 - 0.999 ** t) / (1 - 0.9 ** t)
-        p = jax.tree.map(
-            lambda p, m, v: p - lr * corr * m / (jnp.sqrt(v) + 1e-8),
-            p, m, v)
-        return loss, p, (m, v)
+        p, o = adam.tree_update(i, g, p, o)
+        return loss, p, o
 
     for i in range(args.steps):
         starts = rng.randint(0, len(data) - T - 1, B)

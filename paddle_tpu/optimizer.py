@@ -165,6 +165,40 @@ class Optimizer:
         scale = jnp.minimum(1.0, self.clip_threshold / (gnorm + 1e-12))
         return {k: g * scale.astype(g.dtype) for k, g in grads.items()}
 
+    # -- arbitrary-pytree models (functional models: transformer, GAN…) ---
+    @staticmethod
+    def _flatten_tree(tree):
+        from jax.tree_util import keystr, tree_flatten_with_path
+        flat, treedef = tree_flatten_with_path(tree)
+        names = [keystr(path) for path, _ in flat]
+        return dict(zip(names, (v for _, v in flat))), names, treedef
+
+    def tree_init_state(self, params):
+        """init_state for ANY parameter pytree (not just the layer DSL's
+        flat name→array dict). Leaves are keyed by their jax keystr tree
+        path (e.g. ``"['blocks']['qkv']"``) — per-parameter attrs bound
+        via ``bind()`` apply only when spec names use that same path
+        format; ``tree_update`` warns if bound specs match no leaf."""
+        flat, _, _ = self._flatten_tree(params)
+        return self.init_state(flat)
+
+    def tree_update(self, step, grads, params, state):
+        """update() for ANY parameter pytree; returns (new_params with
+        the input tree structure, new_state)."""
+        from jax.tree_util import tree_unflatten
+        pd, names, treedef = self._flatten_tree(params)
+        if self.specs and not (set(self.specs) & set(names)) and \
+                not getattr(self, "_warned_spec_mismatch", False):
+            self._warned_spec_mismatch = True
+            from paddle_tpu.utils.logger import get_logger
+            get_logger().warning(
+                "optimizer: bound parameter specs %s match no pytree leaf "
+                "path (leaves look like %s) — per-parameter rules are NOT "
+                "being applied", sorted(self.specs)[:3], names[:3])
+        gd, _, _ = self._flatten_tree(grads)
+        new_p, new_s = self.update(step, gd, pd, state)
+        return tree_unflatten(treedef, [new_p[n] for n in names]), new_s
+
     def update(self, step, grads: Dict, params: Dict, state: Dict):
         lr_t = self.schedule(step)
         grads = self._clip(grads)
