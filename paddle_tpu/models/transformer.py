@@ -34,6 +34,7 @@ class TransformerConfig:
     d_ff: int = 2048
     max_len: int = 2048
     dtype: object = jnp.bfloat16
+    dropout: float = 0.0               # residual/embedding dropout rate
     use_ring_attention: bool = False   # shard_map CP over the seq axis
     use_flash_attention: bool = False  # Pallas fused attention (TPU)
 
@@ -100,7 +101,8 @@ def _layer_norm(x, g, b):
 def forward(params, tokens: jax.Array, cfg: TransformerConfig, *,
             mesh: Optional[Mesh] = None,
             lengths: Optional[jax.Array] = None,
-            return_kv: bool = False):
+            return_kv: bool = False,
+            dropout_key: Optional[jax.Array] = None):
     """tokens [B, T] int32 → logits [B, T, vocab] (float32).
 
     With ``cfg.use_ring_attention`` and a mesh carrying a >1 ``seq`` axis,
@@ -109,16 +111,37 @@ def forward(params, tokens: jax.Array, cfg: TransformerConfig, *,
     ``return_kv=True`` additionally returns the per-layer (k, v)
     projections stacked [L, B, T, H, Dh] — the prefill path of the
     KV-cache decoder shares this exact block so the two can't drift.
+    ``dropout_key`` enables inverted dropout at rate ``cfg.dropout``
+    (embedding + both residual branches per block); omit it — as eval
+    and serving paths do — for deterministic inference.
     """
     return _forward_impl(params, tokens, cfg, mesh, lengths, return_kv,
-                         head="all")
+                         head="all", dropout_key=dropout_key)
 
 
-def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head):
+def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head,
+                  dropout_key=None):
     B, T = tokens.shape
     H, Dh = cfg.n_heads, cfg.head_dim
+    if not 0.0 <= cfg.dropout < 1.0:
+        raise ValueError(f"cfg.dropout must be in [0, 1), got {cfg.dropout}")
+    rate = cfg.dropout if dropout_key is not None else 0.0
+
+    def drop(h, key):
+        if rate <= 0.0:
+            return h
+        keep = jax.random.bernoulli(key, 1.0 - rate, h.shape)
+        return jnp.where(keep, h / (1.0 - rate), 0).astype(h.dtype)
+
+    if rate > 0.0:
+        emb_key, blk_key = jax.random.split(dropout_key)
+    else:
+        emb_key = blk_key = jax.random.PRNGKey(0)   # unused (rate is static)
+    layer_keys = jax.random.split(blk_key, cfg.n_layers)
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
     x = x + params["pos"][:T].astype(cfg.dtype)[None]
+    if rate > 0.0:
+        x = drop(x, emb_key)
 
     seq_sharded = (mesh is not None and place.AXIS_SEQ in mesh.axis_names
                    and mesh.shape[place.AXIS_SEQ] > 1)
@@ -133,7 +156,9 @@ def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head):
 
     x = constrain(x)
 
-    def block(x, w):
+    def block(x, scanned):
+        w, lkey = scanned
+        k1, k2 = jax.random.split(lkey)
         h = _layer_norm(x, w["ln1"], w["ln1_b"])
         qkv = jnp.einsum("btd,de->bte", h, w["qkv"].astype(h.dtype))
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -152,19 +177,19 @@ def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head):
         else:
             attn = ring.full_attention(q, k, v, causal=True, lengths=lengths)
         attn = attn.reshape(B, T, cfg.d_model)
-        x = x + jnp.einsum("btd,de->bte", attn,
-                           w["attn_out"].astype(attn.dtype))
+        x = x + drop(jnp.einsum("btd,de->bte", attn,
+                                w["attn_out"].astype(attn.dtype)), k1)
         x = constrain(x)
         h2 = _layer_norm(x, w["ln2"], w["ln2_b"])
         ff = jnp.einsum("btd,df->btf", h2, w["mlp_in"].astype(h2.dtype))
         ff = jax.nn.gelu(ff)
-        x = x + jnp.einsum("btf,fd->btd", ff,
-                           w["mlp_out"].astype(ff.dtype))
+        x = x + drop(jnp.einsum("btf,fd->btd", ff,
+                                w["mlp_out"].astype(ff.dtype)), k2)
         kv = (k.astype(cfg.dtype), v.astype(cfg.dtype)) \
             if return_kv else None
         return constrain(x), kv
 
-    x, kvs = jax.lax.scan(block, x, params["blocks"])
+    x, kvs = jax.lax.scan(block, x, (params["blocks"], layer_keys))
     if head == "last":
         # serving prefill: only the final position feeds the vocab head —
         # skips the O(T·vocab) logits tensor a full head would materialize
@@ -179,9 +204,11 @@ def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head):
 
 def lm_loss(params, tokens, targets, cfg: TransformerConfig, *,
             mesh: Optional[Mesh] = None,
-            lengths: Optional[jax.Array] = None) -> jax.Array:
+            lengths: Optional[jax.Array] = None,
+            dropout_key: Optional[jax.Array] = None) -> jax.Array:
     """Mean next-token cross-entropy over valid positions."""
-    logits = forward(params, tokens, cfg, mesh=mesh, lengths=lengths)
+    logits = forward(params, tokens, cfg, mesh=mesh, lengths=lengths,
+                     dropout_key=dropout_key)
     tok_ce = ops_loss.softmax_cross_entropy(logits, targets)
     if lengths is not None:
         mask = (jnp.arange(tokens.shape[1])[None, :] <

@@ -187,14 +187,14 @@ class Optimizer:
         the input tree structure, new_state)."""
         from jax.tree_util import tree_unflatten
         pd, names, treedef = self._flatten_tree(params)
-        if self.specs and not (set(self.specs) & set(names)) and \
-                not getattr(self, "_warned_spec_mismatch", False):
+        unmatched = set(self.specs) - set(names)
+        if unmatched and not getattr(self, "_warned_spec_mismatch", False):
             self._warned_spec_mismatch = True
             from paddle_tpu.utils.logger import get_logger
             get_logger().warning(
                 "optimizer: bound parameter specs %s match no pytree leaf "
-                "path (leaves look like %s) — per-parameter rules are NOT "
-                "being applied", sorted(self.specs)[:3], names[:3])
+                "path (leaves look like %s) — their per-parameter rules "
+                "are NOT being applied", sorted(unmatched)[:5], names[:3])
         gd, _, _ = self._flatten_tree(grads)
         new_p, new_s = self.update(step, gd, pd, state)
         return tree_unflatten(treedef, [new_p[n] for n in names]), new_s

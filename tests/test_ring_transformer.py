@@ -342,3 +342,41 @@ class TestBeamSearch:
         greedy = transformer.generate(params, prompt, cfg, max_new=new)
         gs = self._score_of(params, cfg, greedy[0], Tp)
         assert float(scores[0, 0]) >= gs - 1e-4
+
+
+class TestDropout:
+    CFG = transformer.TransformerConfig(
+        vocab=30, d_model=16, n_layers=2, n_heads=2, d_ff=32, max_len=16,
+        dtype=jnp.float32, dropout=0.5)
+
+    def test_no_key_is_deterministic_and_matches_rate0(self, rng):
+        """Without a dropout_key the forward is the eval path — identical
+        to a dropout=0 config (serving/eval can't silently drop)."""
+        import dataclasses as dc
+        params = transformer.init_params(jax.random.PRNGKey(0), self.CFG)
+        toks = jnp.asarray(rng.randint(0, 30, (2, 8)), jnp.int32)
+        a = transformer.forward(params, toks, self.CFG)
+        b = transformer.forward(params, toks,
+                                dc.replace(self.CFG, dropout=0.0))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_keys_randomize_and_reproduce(self, rng):
+        params = transformer.init_params(jax.random.PRNGKey(0), self.CFG)
+        toks = jnp.asarray(rng.randint(0, 30, (2, 8)), jnp.int32)
+        k1, k2 = jax.random.PRNGKey(1), jax.random.PRNGKey(2)
+        a1 = transformer.forward(params, toks, self.CFG, dropout_key=k1)
+        a2 = transformer.forward(params, toks, self.CFG, dropout_key=k1)
+        b = transformer.forward(params, toks, self.CFG, dropout_key=k2)
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+        assert np.abs(np.asarray(a1) - np.asarray(b)).max() > 0
+
+    def test_grads_flow_with_dropout(self, rng):
+        params = transformer.init_params(jax.random.PRNGKey(0), self.CFG)
+        toks = jnp.asarray(rng.randint(0, 30, (2, 8)), jnp.int32)
+        tgts = jnp.roll(toks, -1, axis=1)
+        g = jax.grad(lambda p: transformer.lm_loss(
+            p, toks, tgts, self.CFG,
+            dropout_key=jax.random.PRNGKey(3)))(params)
+        assert all(np.isfinite(np.asarray(x)).all()
+                   for x in jax.tree.leaves(g))
+        assert float(jnp.abs(g["blocks"]["qkv"]).max()) > 0
