@@ -319,6 +319,27 @@ class Adam(Optimizer):
         return p - lr * mhat / (jnp.sqrt(vhat) + self.eps), (m, v)
 
 
+class AdamW(Adam):
+    """Adam with DECOUPLED weight decay (the modern transformer default):
+    decay is applied directly to the parameter, not folded into the
+    gradient like L2Regularization — the two differ under adaptive
+    per-coordinate scaling. No reference counterpart (2017 predates it);
+    included because the TPU build's functional models expect it."""
+
+    def __init__(self, weight_decay=0.01, **kw):
+        if kw.get("regularization") is not None:
+            raise ValueError(
+                "AdamW applies decoupled weight_decay; combining it with "
+                "regularization= would decay parameters twice. Use plain "
+                "Adam for gradient-coupled L1/L2.")
+        super().__init__(**kw)
+        self.weight_decay = weight_decay
+
+    def _update_one(self, g, p, s, lr):
+        newp, ns = super()._update_one(g, p, s, lr)
+        return newp - lr * self.weight_decay * p, ns
+
+
 class AdaMax(Optimizer):
     """(reference: AdamaxParameterOptimizer; operators/adamax_op.cc)"""
 

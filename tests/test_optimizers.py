@@ -173,3 +173,35 @@ class TestTreeOptimizer:
         delta = float(sum(jnp.sum(jnp.square(a - b)) for a, b in zip(
             jax.tree.leaves(p1), jax.tree.leaves(params))) ** 0.5)
         assert delta <= 0.1 * 1.0 + 1e-5, delta
+
+
+class TestAdamW:
+    def test_decoupled_decay_differs_from_l2(self, rng):
+        """AdamW's decay must NOT pass through the adaptive scaling:
+        with a large gradient history the L2-as-gradient path shrinks the
+        decay, the decoupled path doesn't."""
+        p = {"w": jnp.asarray(rng.randn(6).astype(np.float32) * 10)}
+        g = {"w": jnp.ones((6,), jnp.float32)}
+        aw = opt.AdamW(learning_rate=0.1, weight_decay=0.1)
+        al2 = opt.Adam(learning_rate=0.1,
+                       regularization=opt.L2Regularization(0.1))
+        sw, s2 = aw.init_state(p), al2.init_state(p)
+        pw, _ = aw.update(jnp.asarray(0, jnp.int32), g, p, sw)
+        p2, _ = al2.update(jnp.asarray(0, jnp.int32), g, p, s2)
+        assert np.abs(np.asarray(pw["w"]) - np.asarray(p2["w"])).max() > 1e-3
+        # decoupled step = adam step - lr*wd*p exactly
+        plain = opt.Adam(learning_rate=0.1)
+        sp = plain.init_state(p)
+        pp, _ = plain.update(jnp.asarray(0, jnp.int32), g, p, sp)
+        want = np.asarray(pp["w"]) - 0.1 * 0.1 * np.asarray(p["w"])
+        np.testing.assert_allclose(np.asarray(pw["w"]), want, rtol=1e-6)
+
+    def test_tree_path(self, rng):
+        p = {"a": {"b": jnp.ones((3,), jnp.float32)}}
+        o = opt.AdamW(learning_rate=0.1, weight_decay=0.5)
+        st = o.tree_init_state(p)
+        newp, _ = o.tree_update(jnp.asarray(0, jnp.int32),
+                                jax.tree.map(jnp.zeros_like, p), p, st)
+        # zero grad: only the decay term moves the parameter
+        np.testing.assert_allclose(np.asarray(newp["a"]["b"]),
+                                   1.0 - 0.1 * 0.5, rtol=1e-6)
