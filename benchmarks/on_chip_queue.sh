@@ -1,0 +1,36 @@
+#!/bin/bash
+# Queued real-chip measurements to run when the tunnel recovers
+# (see BENCHMARKS.md notes on multi-hour tunnel outages).
+# Usage: bash benchmarks/on_chip_queue.sh   — each step is independently
+# timed out, appends raw artifacts to benchmarks/runs/, and a failed step
+# doesn't stop the rest.
+set -u
+cd "$(dirname "$0")/.."
+STAMP=$(date +%F_%H%M)
+RUNS=benchmarks/runs
+
+probe() {
+    timeout 100 python -c "
+import jax, jax.numpy as jnp
+print('probe ok', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" \
+        || { echo "tunnel still down; aborting"; exit 1; }
+}
+
+probe
+
+echo "== resnet50 sanity (s2d default)"
+timeout 1200 python bench.py > "$RUNS/${STAMP}_resnet50_sanity.json" 2>/tmp/q1.log \
+    && cat "$RUNS/${STAMP}_resnet50_sanity.json"
+
+echo "== transformer seq=8192 (flash fits, plain OOMs)"
+timeout 1800 python benchmarks/transformer_bench.py --seq 8192 --batch 2 \
+    > "$RUNS/${STAMP}_transformer_seq8192.jsonl" 2>/tmp/q2.log \
+    && cat "$RUNS/${STAMP}_transformer_seq8192.jsonl"
+
+echo "== transformer seq=4096"
+timeout 1500 python benchmarks/transformer_bench.py --seq 4096 --batch 4 \
+    > "$RUNS/${STAMP}_transformer_seq4096.jsonl" 2>/tmp/q3.log \
+    && cat "$RUNS/${STAMP}_transformer_seq4096.jsonl"
+
+echo "done; update benchmarks/analysis.md with any new numbers and"
+echo "regenerate BENCHMARKS.md via: python benchmarks/run_all.py --from-json"
