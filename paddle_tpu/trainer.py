@@ -24,7 +24,7 @@ from paddle_tpu.data_feeder import DataFeeder
 from paddle_tpu.evaluator import EvaluatorSet
 from paddle_tpu.optimizer import Optimizer
 from paddle_tpu.parameters import Parameters
-from paddle_tpu.topology import LayerOutput, Topology
+from paddle_tpu.topology import LayerOutput, Topology, Value
 from paddle_tpu.utils import logger, stat
 from paddle_tpu.utils.flags import GLOBAL_FLAGS
 from paddle_tpu.utils.rng import global_key_source
@@ -36,11 +36,27 @@ class SGD:
     def __init__(self, cost: LayerOutput, parameters: Parameters,
                  update_equation: Optimizer,
                  extra_layers: Optional[List[LayerOutput]] = None,
-                 is_local: bool = True, parallel=None):
+                 is_local: bool = True, parallel=None,
+                 grad_accum_steps: int = 1):
         """parallel: an optional paddle_tpu.parallel.DistConfig — shards
         parameters per its rules and the batch across the data axis; XLA
         inserts the gradient all-reduce (replacing the pserver round-trip,
-        reference: trainer/RemoteParameterUpdater.cpp)."""
+        reference: trainer/RemoteParameterUpdater.cpp).
+
+        grad_accum_steps: split every batch into this many microbatches
+        inside the jitted step (a ``lax.scan``): activations live for one
+        microbatch at a time (≈N× less activation memory) while gradients
+        accumulate and the optimizer sees the full-batch mean gradient.
+        For BN-free, dropout-free models the trajectory matches
+        grad_accum_steps=1 up to summation order; batch norm normalizes
+        per MICROBATCH (ghost-BN statistics) and dropout draws one mask
+        per microbatch, so models using either train on slightly
+        different (equally valid) noise. Ragged final batches
+        (drop_last=False) fall back to the unaccumulated step."""
+        if grad_accum_steps < 1:
+            raise ValueError(f"grad_accum_steps must be >= 1, "
+                             f"got {grad_accum_steps}")
+        self.grad_accum_steps = int(grad_accum_steps)
         self.cost = cost
         self.parameters = parameters
         self.optimizer = update_equation
@@ -62,9 +78,19 @@ class SGD:
                     parameters.state,
                     jax.tree.map(lambda _: parallel.replicated(),
                                  parameters.state))
-        self._train_step = self._build_train_step()
+        self._plain_train_step = self._build_train_step()
+        self._accum_train_step = (self._build_accum_train_step()
+                                  if self.grad_accum_steps > 1 else None)
+        self._train_step = self._accum_train_step or self._plain_train_step
         self._eval_step = self._build_eval_step()
         self.evaluators = EvaluatorSet(self.topology.layers)
+        if self.grad_accum_steps > 1 and any(
+                getattr(l, "layer_type", "") == "pnpair"
+                for l in self.topology.layers):
+            logger.warning(
+                "grad_accum_steps>1 with a positive_negative_pair "
+                "evaluator: pairs spanning microbatch boundaries are not "
+                "counted — the metric differs from unaccumulated training")
 
     # -- compiled steps ----------------------------------------------------
     def _build_train_step(self):
@@ -87,6 +113,59 @@ class SGD:
 
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
+    def _build_accum_train_step(self):
+        """Microbatched step: lax.scan over grad_accum_steps slices of the
+        batch; gradients sum in the carry, model state (BN running stats)
+        threads sequentially, per-microbatch metric accumulables sum (they
+        are additive by contract, evaluator.MetricAccumulator — except
+        the batch-local pnpair counts, warned about in __init__)."""
+        fwd = self._forward
+        opt = self.optimizer
+        cost_name = self.cost.name
+        n = self.grad_accum_steps
+        metric_names = [l.name for l in self.topology.layers
+                        if hasattr(l, "metric_finalize")]
+
+        def train_step(params, opt_state, state, feeds, step, dropout_key):
+            def split(a):
+                # indivisible batches never reach this step: the train
+                # loop routes them to the plain step (_pick_train_step)
+                return a.reshape((n, a.shape[0] // n) + a.shape[1:])
+
+            mfeeds = jax.tree_util.tree_map(split, feeds)
+            keys = jax.random.split(dropout_key, n)
+
+            def micro(carry, xs):
+                st, acc = carry
+                fd, mkey = xs
+
+                def loss_fn(p):
+                    outs, st2 = fwd(p, st, fd, is_training=True,
+                                    dropout_key=mkey)
+                    per_example = outs[cost_name].array
+                    return jnp.mean(per_example.astype(jnp.float32)), \
+                        (outs, st2)
+
+                (loss, (outs, st2)), g = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                acc = jax.tree_util.tree_map(
+                    lambda a, b: a + b.astype(jnp.float32), acc, g)
+                mets = {m: outs[m].array.astype(jnp.float32)
+                        for m in metric_names if m in outs}
+                return (st2, acc), (loss, mets)
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            (new_state, acc), (losses, mets) = jax.lax.scan(
+                micro, (state, zeros), (mfeeds, keys))
+            grads = jax.tree_util.tree_map(
+                lambda a, p: (a / n).astype(p.dtype), acc, params)
+            new_params, new_opt = opt.update(step, grads, params, opt_state)
+            outs = {m: Value(v.sum(axis=0)) for m, v in mets.items()}
+            return (jnp.mean(losses), new_params, new_opt, new_state, outs)
+
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
     def _build_eval_step(self):
         fwd = self._forward
         cost_name = self.cost.name
@@ -96,6 +175,17 @@ class SGD:
             return jnp.mean(outs[cost_name].array.astype(jnp.float32)), outs
 
         return jax.jit(eval_step)
+
+    def _pick_train_step(self, feeds):
+        """Accumulated step when the batch divides by grad_accum_steps;
+        otherwise (ragged drop_last=False tail) the plain step — crashing
+        at the end of a pass over a remainder batch is not acceptable."""
+        if self._accum_train_step is None:
+            return self._plain_train_step
+        leaves = jax.tree_util.tree_leaves(feeds)
+        if leaves and leaves[0].shape[0] % self.grad_accum_steps == 0:
+            return self._accum_train_step
+        return self._plain_train_step
 
     def _feeder(self, feeding):
         key = tuple(sorted(feeding.items())) if feeding else None
@@ -167,7 +257,8 @@ class SGD:
                             feeds, self.parallel.feed_shardings(feeds))
                     dropout_key = ks.step("dropout", self._step)
                     (loss, self.parameters.values, self.opt_state,
-                     self.parameters.state, outs) = self._train_step(
+                     self.parameters.state, outs) = self._pick_train_step(
+                        feeds)(
                         self.parameters.values, self.opt_state,
                         self.parameters.state, feeds,
                         jnp.asarray(self._step, jnp.int32), dropout_key)

@@ -12,6 +12,7 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu import evaluator, layer, networks
 from paddle_tpu.io import checkpoint
+from paddle_tpu.utils.rng import KeySource
 
 
 def _lenet(img):
@@ -101,3 +102,76 @@ def test_params_tar_roundtrip(trained, tmp_path):
     with open(f, "rb") as fh:
         p2 = paddle.parameters.Parameters.from_tar(fh)
     np.testing.assert_allclose(p2["pred.w"], params["pred.w"])
+
+
+class TestGradAccum:
+    def _train(self, accum, batches=6, batch=32):
+        import paddle_tpu as paddle
+        from paddle_tpu import layer
+        from paddle_tpu.dataset import synthetic
+        x = layer.data("ga_x", paddle.data_type.dense_vector(20))
+        y = layer.data("ga_y", paddle.data_type.integer_value(5))
+        h = layer.fc(x, 16, act=paddle.activation.Relu(),
+                     name="ga_h")
+        out = layer.fc(h, 5, act=paddle.activation.Softmax(),
+                       name="ga_o")
+        cost = layer.classification_cost(out, y, name="ga_c")
+        params = paddle.parameters.create(cost, KeySource(123))
+        tr = paddle.trainer.SGD(
+            cost=cost, parameters=params,
+            update_equation=paddle.optimizer.Momentum(learning_rate=0.05,
+                                                      momentum=0.9),
+            grad_accum_steps=accum)
+        reader = paddle.reader.firstn(
+            synthetic.classification(batches * batch, 20, 5, seed=9), 
+            batches * batch)
+        losses = []
+        tr.train(reader=paddle.batch(reader, batch), num_passes=1,
+                 feeding={"ga_x": 0, "ga_y": 1},
+                 event_handler=lambda e: losses.append(e.cost)
+                 if isinstance(e, paddle.event.EndIteration) else None)
+        return losses, tr.parameters
+
+    def test_accum_matches_plain(self):
+        """grad_accum_steps=4 must reproduce accum=1 numerics on a
+        BN-free model (the optimizer sees the same full-batch mean
+        gradient; only summation order differs)."""
+        l1, p1 = self._train(1)
+        l4, p4 = self._train(4)
+        np.testing.assert_allclose(l1, l4, rtol=2e-4, atol=2e-5)
+        for name in p1.names():
+            a = np.asarray(p1[name])
+            b = np.asarray(p4[name])
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5,
+                                       err_msg=name)
+
+    def test_invalid_steps_rejected(self):
+        import paddle_tpu as paddle
+        with pytest.raises(ValueError, match="grad_accum_steps"):
+            self._train(0)
+
+    def test_ragged_tail_falls_back_to_plain_step(self):
+        """drop_last=False remainder batches must not crash the accum
+        path — they route to the unaccumulated step."""
+        import paddle_tpu as paddle
+        from paddle_tpu import layer
+        from paddle_tpu.dataset import synthetic
+        x = layer.data("gar_x", paddle.data_type.dense_vector(8))
+        y = layer.data("gar_y", paddle.data_type.integer_value(3))
+        out = layer.fc(x, 3, act=paddle.activation.Softmax(), name="gar_o")
+        cost = layer.classification_cost(out, y, name="gar_c")
+        params = paddle.parameters.create(cost, KeySource(5))
+        tr = paddle.trainer.SGD(
+            cost=cost, parameters=params,
+            update_equation=paddle.optimizer.SGD(learning_rate=0.1),
+            grad_accum_steps=4)
+        reader = paddle.reader.firstn(
+            synthetic.classification(90, 8, 3, seed=2), 90)
+        costs = []
+        tr.train(
+            reader=paddle.batch(reader, 32, drop_last=False),
+            num_passes=1, feeding={"gar_x": 0, "gar_y": 1},
+            event_handler=lambda e: costs.append(e.cost)
+            if isinstance(e, paddle.event.EndIteration) else None)
+        assert len(costs) == 3              # 32 + 32 + 26
+        assert all(np.isfinite(c) for c in costs)
