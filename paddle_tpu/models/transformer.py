@@ -35,6 +35,9 @@ class TransformerConfig:
     max_len: int = 2048
     dtype: object = jnp.bfloat16
     dropout: float = 0.0               # residual/embedding dropout rate
+    use_rope: bool = False             # rotary q/k embeddings instead of
+                                       # learned absolute positions
+    rope_theta: float = 10000.0
     use_ring_attention: bool = False   # shard_map CP over the seq axis
     use_flash_attention: bool = False  # Pallas fused attention (TPU)
 
@@ -55,7 +58,10 @@ def init_params(key: jax.Array, cfg: TransformerConfig):
 
     return {
         "embed": nrm(k[0], (V, D), 1.0 / math.sqrt(D)),
-        "pos": nrm(k[1], (cfg.max_len, D), 0.02),
+        # rope computes positions analytically; keep a 1-row stub so the
+        # pytree structure (and shardings) stay config-independent
+        "pos": (nrm(k[1], (cfg.max_len, D), 0.02) if not cfg.use_rope
+                else jnp.zeros((1, D), jnp.float32)),
         "blocks": {
             "ln1": jnp.ones((L, D), jnp.float32),
             "ln1_b": jnp.zeros((L, D), jnp.float32),
@@ -96,6 +102,34 @@ def param_shardings(cfg: TransformerConfig, mesh: Mesh):
 
 def _layer_norm(x, g, b):
     return ops_norm.layer_norm(x, g, b).astype(x.dtype)
+
+
+def _rope_tables(positions, head_dim, theta):
+    """cos/sin tables [T, Dh/2] for GLOBAL positions — computed once per
+    forward (outside the layer scan) and shared by every layer's q and k."""
+    if head_dim % 2:
+        raise ValueError(f"RoPE requires an even head_dim, got {head_dim}")
+    half = head_dim // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _rope(x, tables):
+    """Rotary position embedding over the head dim of [..., T, H, Dh]
+    (pairing halves: (x1, x2) -> (x1·cos − x2·sin, x1·sin + x2·cos)).
+    Positions entered the tables as GLOBAL indices, so the rotation is
+    correct under ring context parallelism too — it applies to q/k before
+    any attention engine (full / flash / ring), no kernel change."""
+    cos, sin = tables
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+        axis=-1).astype(x.dtype)
 
 
 def forward(params, tokens: jax.Array, cfg: TransformerConfig, *,
@@ -139,9 +173,12 @@ def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head,
         emb_key = blk_key = jax.random.PRNGKey(0)   # unused (rate is static)
     layer_keys = jax.random.split(blk_key, cfg.n_layers)
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
-    x = x + params["pos"][:T].astype(cfg.dtype)[None]
+    if not cfg.use_rope:
+        x = x + params["pos"][:T].astype(cfg.dtype)[None]
     if rate > 0.0:
         x = drop(x, emb_key)
+    rope_tabs = _rope_tables(jnp.arange(T, dtype=jnp.int32), Dh,
+                             cfg.rope_theta) if cfg.use_rope else None
 
     seq_sharded = (mesh is not None and place.AXIS_SEQ in mesh.axis_names
                    and mesh.shape[place.AXIS_SEQ] > 1)
@@ -165,6 +202,9 @@ def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head,
         q = q.reshape(B, T, H, Dh)
         k = k.reshape(B, T, H, Dh)
         v = v.reshape(B, T, H, Dh)
+        if cfg.use_rope:
+            q = _rope(q, rope_tabs)
+            k = _rope(k, rope_tabs)
         if seq_sharded and cfg.use_ring_attention:
             # flash blocks inside the ring when the batch is packed —
             # O(T/P·D) per chip with no score tensor even per ring step
@@ -251,14 +291,20 @@ def decode_step(params, cache, tokens: jax.Array, pos: jax.Array,
     H, Dh = cfg.n_heads, cfg.head_dim
     max_len = cache["k"].shape[2]
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
-    x = x + jax.lax.dynamic_index_in_dim(
-        params["pos"], pos, keepdims=False).astype(cfg.dtype)
+    if not cfg.use_rope:
+        x = x + jax.lax.dynamic_index_in_dim(
+            params["pos"], pos, keepdims=False).astype(cfg.dtype)
+    rope_tabs = _rope_tables(jnp.asarray(pos, jnp.int32).reshape(1), Dh,
+                             cfg.rope_theta) if cfg.use_rope else None
 
     def block(x, scanned):
         w, kc, vc = scanned                      # kc/vc [B, max_len, H, Dh]
         h = _layer_norm(x, w["ln1"], w["ln1_b"])
         qkv = h @ w["qkv"].astype(h.dtype)       # [B, 3D]
         q, k, v = jnp.split(qkv, 3, axis=-1)
+        if cfg.use_rope:
+            q = _rope(q.reshape(B, 1, H, Dh), rope_tabs).reshape(B, H * Dh)
+            k = _rope(k.reshape(B, 1, H, Dh), rope_tabs).reshape(B, H * Dh)
         kc = jax.lax.dynamic_update_slice_in_dim(
             kc, k.reshape(B, 1, H, Dh).astype(kc.dtype), pos, axis=1)
         vc = jax.lax.dynamic_update_slice_in_dim(
