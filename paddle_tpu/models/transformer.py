@@ -30,6 +30,8 @@ class TransformerConfig:
     vocab: int
     d_model: int = 512
     n_heads: int = 8
+    n_kv_heads: int = 0                # 0 = MHA; fewer = grouped-query
+                                       # attention (smaller KV cache)
     n_layers: int = 6
     d_ff: int = 2048
     max_len: int = 2048
@@ -45,11 +47,22 @@ class TransformerConfig:
     def head_dim(self):
         return self.d_model // self.n_heads
 
+    @property
+    def kv_heads(self):
+        """Effective number of key/value heads (GQA groups q heads over
+        fewer kv heads; 0 means standard multi-head attention)."""
+        h = self.n_kv_heads or self.n_heads
+        if h <= 0 or self.n_heads % h:
+            raise ValueError(f"n_heads={self.n_heads} must be a multiple "
+                             f"of n_kv_heads={h}")
+        return h
+
 
 def init_params(key: jax.Array, cfg: TransformerConfig):
     """Parameter pytree; block weights stacked on axis 0 (scan layout)."""
     k = jax.random.split(key, 8)
     D, F, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab
+    kvd = cfg.kv_heads * cfg.head_dim     # == D for MHA; smaller for GQA
     s = 1.0 / math.sqrt(D)
 
     def nrm(kk, shape, scale):
@@ -65,7 +78,7 @@ def init_params(key: jax.Array, cfg: TransformerConfig):
         "blocks": {
             "ln1": jnp.ones((L, D), jnp.float32),
             "ln1_b": jnp.zeros((L, D), jnp.float32),
-            "qkv": nrm(k[2], (L, D, 3 * D), s),
+            "qkv": nrm(k[2], (L, D, D + 2 * kvd), s),
             "attn_out": nrm(k[3], (L, D, D), s / math.sqrt(2 * L)),
             "ln2": jnp.ones((L, D), jnp.float32),
             "ln2_b": jnp.zeros((L, D), jnp.float32),
@@ -143,8 +156,9 @@ def forward(params, tokens: jax.Array, cfg: TransformerConfig, *,
     attention runs as ring CP; activations get seq-sharding constraints so
     XLA keeps the [B, T, D] tensors distributed end-to-end.
     ``return_kv=True`` additionally returns the per-layer (k, v)
-    projections stacked [L, B, T, H, Dh] — the prefill path of the
-    KV-cache decoder shares this exact block so the two can't drift.
+    projections stacked [L, B, T, kv_heads, Dh] (kv_heads < n_heads
+    under GQA) — the prefill path of the KV-cache decoder shares this
+    exact block so the two can't drift.
     ``dropout_key`` enables inverted dropout at rate ``cfg.dropout``
     (embedding + both residual branches per block); omit it — as eval
     and serving paths do — for deterministic inference.
@@ -193,18 +207,31 @@ def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head,
 
     x = constrain(x)
 
+    Hkv = cfg.kv_heads
+    kvd = Hkv * Dh
+
     def block(x, scanned):
         w, lkey = scanned
         k1, k2 = jax.random.split(lkey)
         h = _layer_norm(x, w["ln1"], w["ln1_b"])
         qkv = jnp.einsum("btd,de->bte", h, w["qkv"].astype(h.dtype))
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = jnp.split(qkv, [H * Dh, H * Dh + kvd], axis=-1)
         q = q.reshape(B, T, H, Dh)
-        k = k.reshape(B, T, H, Dh)
-        v = v.reshape(B, T, H, Dh)
+        k = k.reshape(B, T, Hkv, Dh)
+        v = v.reshape(B, T, Hkv, Dh)
         if cfg.use_rope:
             q = _rope(q, rope_tabs)
             k = _rope(k, rope_tabs)
+        kv = (k.astype(cfg.dtype), v.astype(cfg.dtype)) \
+            if return_kv else None
+        if Hkv != H:
+            # GQA: the KV cache carries Hkv heads; the attention engines
+            # see the q-head layout via repetition. NOTE: under ring CP
+            # the repeat currently happens before the shard_map call, so
+            # the ring collectives still move H-head K/V — keeping them
+            # at Hkv heads needs engine-side grouping (future work)
+            k = jnp.repeat(k, H // Hkv, axis=2)
+            v = jnp.repeat(v, H // Hkv, axis=2)
         if seq_sharded and cfg.use_ring_attention:
             # flash blocks inside the ring when the batch is packed —
             # O(T/P·D) per chip with no score tensor even per ring step
@@ -225,8 +252,6 @@ def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head,
         ff = jax.nn.gelu(ff)
         x = x + drop(jnp.einsum("btf,fd->btd", ff,
                                 w["mlp_out"].astype(ff.dtype)), k2)
-        kv = (k.astype(cfg.dtype), v.astype(cfg.dtype)) \
-            if return_kv else None
         return constrain(x), kv
 
     x, kvs = jax.lax.scan(block, x, (params["blocks"], layer_keys))
@@ -259,10 +284,11 @@ def lm_loss(params, tokens, targets, cfg: TransformerConfig, *,
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
-    """Per-layer KV cache for incremental decoding: [L, B, max_len, H, Dh]
+    """Per-layer KV cache for incremental decoding:
+    [L, B, max_len, kv_heads, Dh] (kv_heads < n_heads under GQA)
     (the serving-side analog of the reference's recurrent generation
     machinery, trainer/tests/test_recurrent_machine_generation.cpp slot)."""
-    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
 
@@ -289,6 +315,8 @@ def decode_step(params, cache, tokens: jax.Array, pos: jax.Array,
     is replayed for every position (lax.scan-friendly)."""
     B = tokens.shape[0]
     H, Dh = cfg.n_heads, cfg.head_dim
+    Hkv = cfg.kv_heads
+    kvd = Hkv * Dh
     max_len = cache["k"].shape[2]
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
     if not cfg.use_rope:
@@ -298,24 +326,26 @@ def decode_step(params, cache, tokens: jax.Array, pos: jax.Array,
                              cfg.rope_theta) if cfg.use_rope else None
 
     def block(x, scanned):
-        w, kc, vc = scanned                      # kc/vc [B, max_len, H, Dh]
+        w, kc, vc = scanned                  # kc/vc [B, max_len, Hkv, Dh]
         h = _layer_norm(x, w["ln1"], w["ln1_b"])
-        qkv = h @ w["qkv"].astype(h.dtype)       # [B, 3D]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        qkv = h @ w["qkv"].astype(h.dtype)   # [B, D + 2*kvd]
+        q, k, v = jnp.split(qkv, [H * Dh, H * Dh + kvd], axis=-1)
         if cfg.use_rope:
             q = _rope(q.reshape(B, 1, H, Dh), rope_tabs).reshape(B, H * Dh)
-            k = _rope(k.reshape(B, 1, H, Dh), rope_tabs).reshape(B, H * Dh)
+            k = _rope(k.reshape(B, 1, Hkv, Dh), rope_tabs).reshape(B, kvd)
         kc = jax.lax.dynamic_update_slice_in_dim(
-            kc, k.reshape(B, 1, H, Dh).astype(kc.dtype), pos, axis=1)
+            kc, k.reshape(B, 1, Hkv, Dh).astype(kc.dtype), pos, axis=1)
         vc = jax.lax.dynamic_update_slice_in_dim(
-            vc, v.reshape(B, 1, H, Dh).astype(vc.dtype), pos, axis=1)
-        q32 = q.reshape(B, H, Dh).astype(jnp.float32)
-        s = jnp.einsum("bhd,bthd->bht", q32,
+            vc, v.reshape(B, 1, Hkv, Dh).astype(vc.dtype), pos, axis=1)
+        # grouped attention: q [B, Hkv, G, Dh] against the Hkv-head cache
+        g = H // Hkv
+        q32 = q.reshape(B, Hkv, g, Dh).astype(jnp.float32)
+        s = jnp.einsum("bkgd,btkd->bkgt", q32,
                        kc.astype(jnp.float32)) / math.sqrt(Dh)
         mask = jnp.arange(max_len) <= pos
-        s = jnp.where(mask[None, None, :], s, -1e30)
+        s = jnp.where(mask[None, None, None, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        attn = jnp.einsum("bht,bthd->bhd", p, vc.astype(jnp.float32))
+        attn = jnp.einsum("bkgt,btkd->bkgd", p, vc.astype(jnp.float32))
         attn = attn.reshape(B, cfg.d_model).astype(cfg.dtype)
         x = x + attn @ w["attn_out"].astype(attn.dtype)
         h2 = _layer_norm(x, w["ln2"], w["ln2_b"])

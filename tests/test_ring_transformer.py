@@ -500,3 +500,60 @@ class TestRoPE:
         got = transformer.forward(params, toks, cfg, mesh=mesh)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-3, atol=2e-3)
+
+
+class TestGQA:
+    CFG = transformer.TransformerConfig(
+        vocab=30, d_model=16, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=32, max_len=20, dtype=jnp.float32, use_rope=True)
+
+    def test_decode_matches_forward(self, rng):
+        """Grouped-query attention: the Hkv-head cache must reproduce the
+        full forward (which repeats kv heads for the engines)."""
+        cfg = self.CFG
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        B, T = 2, 8
+        toks = jnp.asarray(rng.randint(0, 30, (B, T)), jnp.int32)
+        full = transformer.forward(params, toks, cfg)
+        cache = transformer.init_cache(cfg, B, T)
+        assert cache["k"].shape == (2, B, T, 2, 4)   # Hkv=2 not H=4
+        for t in range(T):
+            logits, cache = transformer.decode_step(
+                params, cache, toks[:, t], jnp.asarray(t, jnp.int32), cfg)
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(full[:, t]),
+                                       rtol=2e-4, atol=2e-4,
+                                       err_msg=f"position {t}")
+
+    def test_cache_half_the_size_and_generate_runs(self, rng):
+        import dataclasses as dc
+        cfg = self.CFG
+        mha = dc.replace(cfg, n_kv_heads=0)
+        gq = transformer.init_cache(cfg, 1, 16)
+        mh = transformer.init_cache(mha, 1, 16)
+        assert gq["k"].size * 2 == mh["k"].size
+        params = transformer.init_params(jax.random.PRNGKey(1), cfg)
+        prompt = jnp.asarray(rng.randint(0, 30, (1, 4)), jnp.int32)
+        out = transformer.generate(params, prompt, cfg, max_new=5)
+        assert out.shape == (1, 9)
+
+    def test_invalid_ratio_rejected(self):
+        cfg = transformer.TransformerConfig(vocab=10, d_model=16,
+                                            n_heads=4, n_kv_heads=3)
+        with pytest.raises(ValueError, match="multiple"):
+            transformer.init_params(jax.random.PRNGKey(0), cfg)
+
+    def test_lm_learns_with_gqa(self, rng):
+        cfg = self.CFG
+        params = transformer.init_params(jax.random.PRNGKey(2), cfg)
+        toks = jnp.asarray((np.arange(16)[None, :] +
+                            rng.randint(0, 30, (4, 1))) % 30, jnp.int32)
+        tgts = (toks + 1) % 30
+        step = jax.jit(jax.value_and_grad(
+            lambda p: transformer.lm_loss(p, toks, tgts, cfg)))
+        hist = []
+        for _ in range(25):
+            l, g = step(params)
+            params = jax.tree.map(lambda p, gr: p - 0.1 * gr, params, g)
+            hist.append(float(l))
+        assert hist[-1] < hist[0] * 0.6, (hist[0], hist[-1])
