@@ -9,11 +9,21 @@ images/sec/chip against the BASELINE.json north star of 4000 images/sec/chip.
 Prints exactly ONE JSON line on stdout — always, even when the backend is
 unreachable: a watchdog thread guards every stage (backend init, compile,
 timed steps) and on a stall emits `{"value": 0, ..., "error": ...}` and
-exits, instead of hanging or stack-tracing. A hung backend init is retried
-once in a fresh process (re-exec), since a second attempt in the same
-process would just join the stuck init.
+exits, instead of hanging or stack-tracing.
+
+Tunnel resilience: the backend on this box wedges for long stretches (a
+hung `jax.devices()` or a matmul that never completes). Before committing
+to the full model compile, a small matmul PROBE with a short timeout checks
+the chip actually computes; a wedged attempt is retried in a fresh process
+(re-exec — a second attempt in the same process would just join the stuck
+init) on a backoff schedule of up to BENCH_MAX_ATTEMPTS attempts, capped by
+a BENCH_WALL_BUDGET wall-clock budget. On final failure the JSON carries
+the most recent verified measurement from benchmarks/runs/ as clearly
+labelled `last_verified_value` / `last_verified_ts` fields next to the
+error, never a bare 0.0.
 """
 
+import glob
 import json
 import os
 import sys
@@ -28,9 +38,18 @@ NORTH_STAR = 4000.0  # images/sec/chip (BASELINE.json)
 # measurement artifact (tunnel sync failure), not throughput.
 PLAUSIBLE_MAX = 20000.0
 INIT_TIMEOUT = float(os.environ.get("BENCH_INIT_TIMEOUT", 420))
+PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
 COMPILE_TIMEOUT = float(os.environ.get("BENCH_COMPILE_TIMEOUT", 900))
 STEP_TIMEOUT = float(os.environ.get("BENCH_STEP_TIMEOUT", 600))
-RETRY_ENV = "PADDLE_TPU_BENCH_RETRY"
+ATTEMPT_ENV = "PADDLE_TPU_BENCH_ATTEMPT"
+START_ENV = "PADDLE_TPU_BENCH_START"
+MAX_ATTEMPTS = int(os.environ.get("BENCH_MAX_ATTEMPTS", 5))
+# total wall-clock across all attempts incl. backoff sleeps (seconds)
+WALL_BUDGET = float(os.environ.get("BENCH_WALL_BUDGET", 7200))
+# sleep before re-exec attempt N+1 (index by attempt number, 1-based)
+BACKOFF = (0, 300, 600, 900, 1200)
+RUNS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "runs")
 # read once; build_train_step and every emitted record use this same value
 STEM_S2D = os.environ.get("BENCH_S2D", "1") == "1"
 
@@ -41,6 +60,60 @@ def log(*a):
 
 _emit_lock = threading.Lock()
 _emitted = False
+
+
+def last_verified():
+    """Most recent measurement for this metric from benchmarks/runs/.
+
+    Returns (value, iso_timestamp, filename) or None. Used to annotate a
+    failure record so a wedged tunnel never erases two rounds of real
+    measurements behind a bare 0.0."""
+    best = None
+    for path in (glob.glob(os.path.join(RUNS_DIR, "*.json"))
+                 + glob.glob(os.path.join(RUNS_DIR, "*.jsonl"))):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    if (rec.get("metric") ==
+                            "resnet50_train_images_per_sec_per_chip"
+                            and rec.get("value", 0) > 0
+                            # CPU smoke runs are not chip evidence
+                            and rec.get("platform", "tpu") in
+                            ("tpu", "axon")
+                            # partial (watchdog-stalled) runs don't count
+                            # as verified measurements
+                            and "stalled_stage" not in rec):
+                        ts = rec.get("ts") or os.path.basename(path)[:10]
+                        mt = os.path.getmtime(path)
+                        # files written in the same session (<10 min apart)
+                        # tie-break by value, not mtime
+                        if best is None or mt > best[3] + 600 or (
+                                abs(mt - best[3]) <= 600
+                                and rec["value"] > best[0]):
+                            best = (rec["value"], ts,
+                                    os.path.basename(path), mt)
+        except (OSError, ValueError):
+            continue
+    return best[:3] if best else None
+
+
+def record_run(rec):
+    """Append the successful measurement to benchmarks/runs/ so future
+    failure records can cite it as last-verified."""
+    try:
+        os.makedirs(RUNS_DIR, exist_ok=True)
+        day = time.strftime("%Y-%m-%d")
+        rec = dict(rec, ts=time.strftime("%Y-%m-%dT%H:%M:%S"),
+                   platform=os.environ.get("BENCH_PLATFORM", "tpu"))
+        path = os.path.join(RUNS_DIR, f"{day}_resnet50_bench.jsonl")
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError as e:
+        log(f"could not record run artifact: {e}")
 
 
 def emit(value, error=None, **extra):
@@ -55,9 +128,19 @@ def emit(value, error=None, **extra):
            "value": round(value, 1), "unit": "images/sec",
            "vs_baseline": round(value / NORTH_STAR, 4),
            "stem_space_to_depth": STEM_S2D}
+    rec.update(extra)
     if error:
         rec["error"] = error
-    rec.update(extra)
+        lv = last_verified()
+        if lv:
+            rec["last_verified_value"] = lv[0]
+            rec["last_verified_ts"] = lv[1]
+            rec["last_verified_file"] = lv[2]
+            rec["last_verified_vs_baseline"] = round(lv[0] / NORTH_STAR, 4)
+    elif value > 0:
+        # extras (incl. any stalled_stage marker) are already merged, so
+        # the artifact records whether this was a clean full run
+        record_run(rec)
     print(json.dumps(rec), flush=True)
     sys.stdout.flush()
     sys.stderr.flush()
@@ -101,38 +184,87 @@ class Watchdog:
                      f"(no progress within timeout)")
 
 
-def init_backend(dog):
-    """jax.devices() under the watchdog; hung init retried via re-exec."""
-    dog.stage("backend-init", INIT_TIMEOUT)
+def retry_or_fail(dog, reason):
+    """Schedule another fresh-process attempt (with backoff) or emit the
+    final failure record. Wall-clock across attempts is budget-capped."""
+    attempt = int(os.environ.get(ATTEMPT_ENV, 1))
+    start = float(os.environ.get(START_ENV, time.time()))
+    elapsed = time.time() - start
+    sleep_s = BACKOFF[min(attempt, len(BACKOFF) - 1)]
+    if (attempt >= MAX_ATTEMPTS
+            or elapsed + sleep_s + INIT_TIMEOUT > WALL_BUDGET):
+        emit(0.0, error=f"backend unusable after {attempt} attempt(s) "
+             f"over {elapsed/60:.0f} min: {reason}", attempts=attempt)
+    log(f"attempt {attempt} failed ({reason}); sleeping {sleep_s}s then "
+        f"retrying in a fresh process "
+        f"({elapsed/60:.0f}/{WALL_BUDGET/60:.0f} min used)")
+    # generous watchdog so the sleep itself cannot trip a stall
+    dog.stage(f"backoff-{attempt}", sleep_s + INIT_TIMEOUT)
+    time.sleep(sleep_s)
+    os.environ[ATTEMPT_ENV] = str(attempt + 1)
+    os.environ[START_ENV] = repr(start)
+    sys.stderr.flush()
+    os.execv(sys.executable, [sys.executable] + sys.argv)
+
+
+def _run_with_timeout(fn, timeout):
+    """Run fn in a daemon thread. Returns (ok, result_or_reason). A hung
+    backend call can only be abandoned, not interrupted — the caller must
+    re-exec to get a clean process."""
     box = {}
 
     def target():
         try:
-            import jax
-            if os.environ.get("BENCH_PLATFORM"):
-                # local testing / driver fallback: the JAX_PLATFORMS env
-                # var is overridden by the site hook, so use the config API
-                jax.config.update("jax_platforms",
-                                  os.environ["BENCH_PLATFORM"])
-            box["devices"] = jax.devices()
+            box["result"] = fn()
         except Exception as e:
             box["error"] = f"{type(e).__name__}: {e}"
 
     th = threading.Thread(target=target, daemon=True)
     th.start()
-    th.join(INIT_TIMEOUT - 10)
-    if th.is_alive() or "error" in box:
-        reason = box.get("error",
-                         f"jax.devices() hung >{INIT_TIMEOUT - 10:.0f}s")
-        if os.environ.get(RETRY_ENV) != "1":
-            log(f"backend init failed ({reason}); retrying in a fresh "
-                f"process")
-            os.environ[RETRY_ENV] = "1"
-            sys.stderr.flush()
-            os.execv(sys.executable, [sys.executable] + sys.argv)
-        emit(0.0, error=f"backend init failed after retry: {reason}")
-    log("devices:", box["devices"])
-    return box["devices"]
+    th.join(timeout)
+    if th.is_alive():
+        return False, f"hung >{timeout:.0f}s"
+    if "error" in box:
+        return False, box["error"]
+    return True, box.get("result")
+
+
+def init_backend(dog):
+    """jax.devices() + a small matmul probe, both under timeouts. A wedged
+    tunnel often passes jax.devices() but hangs the first computation, so
+    the probe fails fast before we sink 10+ minutes into the full model
+    compile. Any failure goes through the backoff retry schedule."""
+    os.environ.setdefault(ATTEMPT_ENV, "1")
+    os.environ.setdefault(START_ENV, repr(time.time()))
+    dog.stage("backend-init", INIT_TIMEOUT)
+
+    def get_devices():
+        import jax
+        if os.environ.get("BENCH_PLATFORM"):
+            # local testing / driver fallback: the JAX_PLATFORMS env
+            # var is overridden by the site hook, so use the config API
+            jax.config.update("jax_platforms",
+                              os.environ["BENCH_PLATFORM"])
+        return jax.devices()
+
+    ok, res = _run_with_timeout(get_devices, INIT_TIMEOUT - 10)
+    if not ok:
+        retry_or_fail(dog, f"jax.devices(): {res}")
+    log("devices:", res)
+
+    dog.stage("probe", PROBE_TIMEOUT + 30)
+
+    def probe():
+        import jax.numpy as jnp
+        x = jnp.ones((256, 256), jnp.float32)
+        # host read of a value data-dependent on the matmul: on this
+        # tunnel block_until_ready can return early, a host read cannot
+        return float((x @ x)[0, 0])
+
+    ok, res = _run_with_timeout(probe, PROBE_TIMEOUT)
+    if not ok:
+        retry_or_fail(dog, f"matmul probe: {res}")
+    log(f"probe ok ({res})")
 
 
 def build_train_step():
