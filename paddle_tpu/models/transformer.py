@@ -224,14 +224,9 @@ def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head,
             k = _rope(k, rope_tabs)
         kv = (k.astype(cfg.dtype), v.astype(cfg.dtype)) \
             if return_kv else None
-        if Hkv != H:
-            # GQA: the KV cache carries Hkv heads; the attention engines
-            # see the q-head layout via repetition. NOTE: under ring CP
-            # the repeat currently happens before the shard_map call, so
-            # the ring collectives still move H-head K/V — keeping them
-            # at Hkv heads needs engine-side grouping (future work)
-            k = jnp.repeat(k, H // Hkv, axis=2)
-            v = jnp.repeat(v, H // Hkv, axis=2)
+        # GQA: every engine takes Hkv-head k/v directly — the ring path
+        # rotates the small tensors over ICI and broadcasts to the q-head
+        # layout locally per step; the jnp engines group in the einsum
         if seq_sharded and cfg.use_ring_attention:
             # flash blocks inside the ring when the batch is packed —
             # O(T/P·D) per chip with no score tensor even per ring step

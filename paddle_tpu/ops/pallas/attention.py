@@ -289,11 +289,19 @@ def flash_attention(q, k, v, *, causal: bool = True,
                     sm_scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
                     interpret: Optional[bool] = None):
-    """Fused attention. q/k/v: [B, T, H, D] → [B, T, H, D].
+    """Fused attention. q: [B, T, H, D], k/v: [B, T, Hkv, D] with
+    H % Hkv == 0 → [B, T, H, D].
 
-    Dispatches to the Pallas kernel on TPU (or interpret mode when forced);
-    off-TPU uses the jnp reference so behaviour is identical everywhere."""
+    Hkv < H (grouped-query attention) is expanded to the q-head layout
+    here — a single-device layout concern only; the distributed ring path
+    (parallel/ring.py) keeps collectives at Hkv heads and expands locally
+    per ring step. Dispatches to the Pallas kernel on TPU (or interpret
+    mode when forced); off-TPU uses the jnp reference so behaviour is
+    identical everywhere."""
     b, t, h, d = q.shape
+    if k.shape[2] != h:
+        k = jnp.repeat(k, h // k.shape[2], axis=2)
+        v = jnp.repeat(v, h // v.shape[2], axis=2)
     sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     qr = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     kr = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)

@@ -27,19 +27,27 @@ NEG_INF = -1e30
 
 
 def _block_attn(q, k, v, mask, scale):
-    """One blockwise attention piece → (scores-exp sum l, running max m,
-    unnormalized out). q [B,Tq,H,D] k/v [B,Tk,H,D] mask [B,Tq,Tk] bool."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+    """One blockwise attention piece → (running max m, scores-exp sum l,
+    unnormalized out). q [B,Tq,H,D]; k/v [B,Tk,Hkv,D] where H % Hkv == 0
+    — Hkv < H is grouped-query attention (query head h reads kv head
+    h // (H//Hkv)); the group broadcast happens HERE, in registers, so
+    callers (and ring collectives) carry only Hkv-head K/V."""
+    B, Tq, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    qg = q.reshape(B, Tq, Hkv, G, D)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
-    s = jnp.where(mask[:, None], s, NEG_INF)
-    m = jnp.max(s, axis=-1)                                  # [B,H,Tq]
+    s = jnp.where(mask[:, None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                                  # [B,Hkv,G,Tq]
     # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
     m_safe = jnp.maximum(m, -1e29)
     p = jnp.exp(s - m_safe[..., None])
-    p = jnp.where(mask[:, None], p, 0.0)
-    l = jnp.sum(p, axis=-1)                                  # [B,H,Tq]
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    return m_safe, l, o
+    p = jnp.where(mask[:, None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)                                  # [B,Hkv,G,Tq]
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", p, v.astype(jnp.float32))
+    return (m_safe.reshape(B, H, Tq), l.reshape(B, H, Tq),
+            o.reshape(B, Tq, H, D))
 
 
 def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
@@ -47,8 +55,11 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
                    scale: Optional[float] = None):
     """Exact attention with K/V rotating around the ``axis_name`` ring.
 
-    Call inside shard_map. q/k/v: local shards [B, T_local, H, D] (sequence
-    axis sharded); lengths: global per-example valid lengths [B] (replicated).
+    Call inside shard_map. q: local shard [B, T_local, H, D]; k/v
+    [B, T_local, Hkv, D] with H % Hkv == 0 (Hkv < H = grouped-query
+    attention — the ppermute collectives then move only Hkv-head K/V, the
+    group broadcast happens inside the block math); lengths: global
+    per-example valid lengths [B] (replicated).
     Returns [B, T_local, H, D].
     """
     B, Tl, H, D = q.shape
@@ -100,10 +111,15 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
 def full_attention(q, k, v, *, causal: bool = False,
                    lengths: Optional[jax.Array] = None,
                    scale: Optional[float] = None):
-    """Reference single-device attention with the same masking semantics."""
+    """Reference single-device attention with the same masking semantics.
+    k/v may carry Hkv <= H heads (GQA, H % Hkv == 0) — grouping is done
+    in the einsum, no materialized head repetition."""
     B, T, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
     scale = scale or (1.0 / math.sqrt(D))
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+    qg = q.reshape(B, T, Hkv, G, D)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     mask = jnp.ones((B, T, T), bool)
     if causal:
@@ -111,11 +127,11 @@ def full_attention(q, k, v, *, causal: bool = False,
         mask = mask & (i[None, :, None] >= i[None, None, :])
     if lengths is not None:
         mask = mask & (jnp.arange(T)[None, None, :] < lengths[:, None, None])
-    s = jnp.where(mask[:, None], s, NEG_INF)
+    s = jnp.where(mask[:, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    p = jnp.where(mask[:, None], p, 0.0)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    p = jnp.where(mask[:, None, None], p, 0.0)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p, v.astype(jnp.float32))
+    return out.reshape(B, T, H, D).astype(q.dtype)
 
 
 def ring_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
@@ -130,13 +146,17 @@ def ring_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
     T over ``seq_axis``, and heads over ``head_axis`` when the mesh has one
     (tensor parallelism: each model-shard attends its own heads — attention
     is head-separable so no collective is needed on that axis); lengths [B]
-    sharded with the batch. ``use_flash`` swaps the per-block engine for
-    the Pallas flash kernel (packed equal-length sequences only)."""
+    sharded with the batch. k/v may carry Hkv < H heads (GQA) — the ring
+    collectives then rotate the Hkv-head tensors; head-axis TP applies
+    only when it divides BOTH head counts. ``use_flash`` swaps the
+    per-block engine for the Pallas flash kernel (packed equal-length
+    sequences only)."""
     from jax import shard_map
 
-    H = q.shape[2]
+    H, Hkv = q.shape[2], k.shape[2]
     tp = (head_axis if head_axis in mesh.axis_names
           and mesh.shape[head_axis] > 1 and H % mesh.shape[head_axis] == 0
+          and Hkv % mesh.shape[head_axis] == 0
           else None)
     qkv_spec = P(batch_axis, seq_axis, tp, None)
     len_spec = P(batch_axis)
@@ -188,7 +208,9 @@ def ring_flash_attention(q, k, v, *, axis_name: str, causal: bool = False,
     after the full cycle.
 
     Equal-length (packed) sequences only — for ragged ``lengths`` use
-    ``ring_attention``. Call inside shard_map; q/k/v [B, T_local, H, D].
+    ``ring_attention``. Call inside shard_map; q [B, T_local, H, D],
+    k/v [B, T_local, Hkv, D] with H % Hkv == 0 (GQA: the ring rotates
+    Hkv-head K/V and dk/dv; the H-head expansion is local per step).
     """
     Tl, D = q.shape[1], q.shape[3]
     scale = scale or (1.0 / math.sqrt(D))
@@ -204,6 +226,28 @@ def _bhtd(x):
 def _btHd(x, b, h):
     bh, t, d = x.shape
     return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _expand_groups(kv_r, b, g):
+    """[B·Hkv, T, D] -> [B·H, T, D] by repeating each kv head g times —
+    the LOCAL GQA broadcast done after the ring rotation, so ppermute
+    only ever moves the Hkv-head tensor. Query head h = hkv·g + i maps
+    to kv head hkv, matching the models' head grouping convention."""
+    if g == 1:
+        return kv_r
+    bh, t, d = kv_r.shape
+    return jnp.repeat(kv_r.reshape(b, bh // b, t, d), g,
+                      axis=1).reshape(bh * g, t, d)
+
+
+def _group_sum(d_r, b, g):
+    """[B·H, T, D] -> [B·Hkv, T, D]: fold the q-head-group gradients back
+    onto their shared kv head (adjoint of _expand_groups)."""
+    if g == 1:
+        return d_r
+    bh, t, d = d_r.shape
+    return d_r.reshape(b, bh // (b * g), g, t, d).sum(axis=2).reshape(
+        bh // g, t, d)
 
 
 def _fold(o, lse, ob, lseb):
@@ -230,15 +274,20 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
     from paddle_tpu.ops.pallas.attention import flash_block_fwd
 
     B, Tl, H, D = q.shape
+    G = H // k.shape[2]                 # GQA group size (1 = MHA)
     nshards = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % nshards) for i in range(nshards)]
     # rotate k/v in the kernel's [BH, T, D] layout: one transpose per
-    # tensor instead of one per ring step (ppermute is layout-agnostic)
+    # tensor instead of one per ring step (ppermute is layout-agnostic).
+    # Under GQA kr/vr stay at Hkv heads — the ring moves the small tensor;
+    # the per-step _expand_groups broadcast is local VMEM/HBM traffic the
+    # kernel would read anyway.
     qr, kr, vr = _bhtd(q), _bhtd(k), _bhtd(v)
 
     # step 0: the diagonal block — the only one needing the causal mask
-    o, lse = flash_block_fwd(qr, kr, vr, scale, causal,
+    o, lse = flash_block_fwd(qr, _expand_groups(kr, B, G),
+                             _expand_groups(vr, B, G), scale, causal,
                              block_q, block_k, interpret)
     o = o.astype(jnp.float32)
 
@@ -247,7 +296,8 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
         # rotate first: at step j the local block is (my - j) mod n
         k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
         v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
-        ob, lseb = flash_block_fwd(qr, k_cur, v_cur, scale,
+        ob, lseb = flash_block_fwd(qr, _expand_groups(k_cur, B, G),
+                                   _expand_groups(v_cur, B, G), scale,
                                    False, block_q, block_k, interpret)
         if causal:
             src = (my - step) % nshards
@@ -273,6 +323,8 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k,
 
     q, k, v, out, lse = res
     B, Tl, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
     nshards = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % nshards) for i in range(nshards)]
@@ -285,13 +337,18 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k,
     # diagonal block first (the causal variant), then rotate the block
     # TOGETHER with its gradient accumulator: at every step the local
     # (k, v, dk, dv) all describe the same block, each device adds its
-    # contribution, and after n total rotations the accumulators are home
-    dq0, dk0, dv0 = flash_block_bwd(qr, kr, vr, outr, lse, dor,
-                                    scale, causal, block_q, block_k,
+    # contribution, and after n total rotations the accumulators are home.
+    # GQA: the kernel runs in the H-head layout (local expand) but dk/dv
+    # are group-summed back to Hkv heads BEFORE rotating, so every
+    # ppermute moves only Hkv-head tensors.
+    dq0, dk0, dv0 = flash_block_bwd(qr, _expand_groups(kr, B, G),
+                                    _expand_groups(vr, B, G), outr, lse,
+                                    dor, scale, causal, block_q, block_k,
                                     interpret)
     dq_acc = dq0.astype(jnp.float32)        # [BH, Tl, D], stays local
     k_cur, v_cur, dk_acc, dv_acc = rot(
-        kr, vr, dk0.astype(jnp.float32), dv0.astype(jnp.float32))
+        kr, vr, _group_sum(dk0.astype(jnp.float32), B, G),
+        _group_sum(dv0.astype(jnp.float32), B, G))
 
     def body(step, carry):
         dq_acc, dk_acc, dv_acc, k_cur, v_cur = carry
@@ -304,20 +361,21 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k,
             # into 0·inf = NaN
             src = (my - step) % nshards
             lse_b = jnp.where(src < my, lse, -FNEG)
-        dqb, dkb, dvb = flash_block_bwd(qr, k_cur, v_cur,
+        dqb, dkb, dvb = flash_block_bwd(qr, _expand_groups(k_cur, B, G),
+                                        _expand_groups(v_cur, B, G),
                                         outr, lse_b, dor, scale, False,
                                         block_q, block_k, interpret)
         dq_acc = dq_acc + dqb.astype(jnp.float32)
-        dk_acc = dk_acc + dkb.astype(jnp.float32)
-        dv_acc = dv_acc + dvb.astype(jnp.float32)
+        dk_acc = dk_acc + _group_sum(dkb.astype(jnp.float32), B, G)
+        dv_acc = dv_acc + _group_sum(dvb.astype(jnp.float32), B, G)
         k_cur, v_cur, dk_acc, dv_acc = rot(k_cur, v_cur, dk_acc, dv_acc)
         return dq_acc, dk_acc, dv_acc, k_cur, v_cur
 
     dq_acc, dk_acc, dv_acc, _, _ = jax.lax.fori_loop(
         1, nshards, body, (dq_acc, dk_acc, dv_acc, k_cur, v_cur))
     return (_btHd(dq_acc, B, H).astype(q.dtype),
-            _btHd(dk_acc, B, H).astype(k.dtype),
-            _btHd(dv_acc, B, H).astype(v.dtype))
+            _btHd(dk_acc, B, Hkv).astype(k.dtype),
+            _btHd(dv_acc, B, Hkv).astype(v.dtype))
 
 
 _ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
