@@ -78,8 +78,14 @@ def init(**kwargs):
         # must run before any jax computation; the JAX_PLATFORMS env var
         # cannot serve here because site hooks may override it
         import jax
-        from jax._src import xla_bridge
-        if xla_bridge.backends_are_initialized():
+        try:
+            # best-effort diagnostic only: a private API that any JAX
+            # upgrade may rename; the config update below is what matters
+            from jax._src import xla_bridge
+            already = xla_bridge.backends_are_initialized()
+        except (ImportError, AttributeError):
+            already = False
+        if already:
             raise RuntimeError(
                 "paddle.init(platform=...) called after the JAX backend "
                 "was already initialized - the setting would be silently "

@@ -29,6 +29,17 @@ import numpy as np
 DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
 
 
+def sub_lengths_matrix(nested: List[List]) -> np.ndarray:
+    """[batch, max_subseqs] int32 lengths of each sample's sub-sequences
+    (level-2 LoD split record) — shared by every level-2 ingestion path."""
+    max_subs = max((len(subs) for subs in nested), default=1)
+    subl = np.zeros((len(nested), max_subs), np.int32)
+    for i, subs in enumerate(nested):
+        for j, s in enumerate(subs):
+            subl[i, j] = len(s)
+    return subl
+
+
 def bucket_length(n: int, buckets: Sequence[int] = DEFAULT_BUCKETS) -> int:
     """Smallest bucket >= n; beyond the last bucket, round up to a multiple of
     it, so recompilation stays bounded for any length distribution."""
@@ -90,12 +101,8 @@ class SequenceBatch:
         flat = [np.concatenate([np.asarray(s) for s in subs], axis=0) if subs
                 else empty for subs in nested]
         out = cls.from_list(flat, buckets, dtype, pad_value)
-        max_subs = max((len(s) for s in nested), default=1)
-        subl = np.zeros((len(nested), max_subs), np.int32)
-        for i, subs in enumerate(nested):
-            for j, s in enumerate(subs):
-                subl[i, j] = len(s)
-        return cls(out.data, out.lengths, jnp.asarray(subl))
+        return cls(out.data, out.lengths,
+                   jnp.asarray(sub_lengths_matrix(nested)))
 
     # -- views -------------------------------------------------------------
     @property

@@ -12,6 +12,11 @@ from a small fixed set and XLA compiles once per bucket:
 - DENSE seq       -> [b, T] + lengths (T bucketed)
 - INDEX seq       -> [b, T] int32 + lengths
 - SPARSE_*        -> indices [b, K] + weights [b, K] (K bucketed nonzeros)
+- SPARSE_* seq    -> indices [b, T, K] + weights [b, T, K] + lengths
+  (reference: sparse_binary_vector_sequence / sparse_float_vector_sequence,
+  python/paddle/trainer/PyDataProvider2.py:202,324 — per-timestep sparse
+  rows; zero-weight entries are padding so downstream weighted gathers
+  are exact without a mask)
 """
 
 from typing import Dict, List, Sequence
@@ -19,7 +24,8 @@ from typing import Dict, List, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.core.ragged import DEFAULT_BUCKETS, SequenceBatch, bucket_length
+from paddle_tpu.core.ragged import (DEFAULT_BUCKETS, SequenceBatch,
+                                    bucket_length, sub_lengths_matrix)
 from paddle_tpu.data_type import InputType, Kind, SeqLevel
 from paddle_tpu.topology import Value
 
@@ -69,6 +75,13 @@ class DataFeeder:
                 return Value(jnp.asarray(arr))
             return self._sparse(col, itype, name)
         if itype.seq == SeqLevel.SUB_SEQUENCE:
+            if itype.kind in (Kind.SPARSE_BINARY, Kind.SPARSE_FLOAT):
+                # flatten sub-sequences on the time axis (same layout rule
+                # as dense/index level-2) and record the split
+                flat = [[ts for sub in subs for ts in sub] for subs in col]
+                subl = sub_lengths_matrix(col)
+                return self._sparse_seq(flat, itype, name,
+                                        sub_lengths=jnp.asarray(subl))
             if itype.kind == Kind.INDEX:
                 nested = [[np.asarray(s, np.int32) for s in subs]
                           for subs in col]
@@ -91,8 +104,37 @@ class DataFeeder:
             sb = SequenceBatch.from_list([np.asarray(s, np.float32) for s in col],
                                          self.buckets)
         else:
-            raise NotImplementedError("sparse sequences not yet supported")
+            return self._sparse_seq(col, itype, name)
         return Value(sb.data, sb.lengths)
+
+    def _sparse_seq(self, col, itype, name: str = "?",
+                    sub_lengths=None) -> Value:
+        """Per-timestep sparse rows: each sample is a list over timesteps,
+        each timestep a list of indices (binary) or (index, value) pairs.
+        Both the time axis and the per-timestep nonzero count are bucketed
+        so batch shapes stay in a small compiled set."""
+        T = bucket_length(max((len(s) for s in col), default=1),
+                          self.buckets)
+        K = bucket_length(
+            max((len(ts) for s in col for ts in s), default=1),
+            self.buckets)
+        ids = np.zeros((len(col), T, K), np.int32)
+        w = np.zeros((len(col), T, K), np.float32)
+        lengths = np.zeros((len(col),), np.int32)
+        for i, s in enumerate(col):
+            lengths[i] = len(s)
+            for t, ts in enumerate(s):
+                if itype.kind == Kind.SPARSE_BINARY:
+                    idx = list(ts)
+                    vals = [1.0] * len(idx)
+                else:
+                    idx = [p[0] for p in ts]
+                    vals = [p[1] for p in ts]
+                ids[i, t, : len(idx)] = idx
+                w[i, t, : len(vals)] = vals
+        self._check_index_range(ids, itype.dim, name)
+        return Value(jnp.asarray(ids), jnp.asarray(lengths), sub_lengths,
+                     weights=jnp.asarray(w))
 
     def _sparse(self, col, itype, name: str = "?") -> Value:
         """sparse_binary_vector: sample is a list of indices;

@@ -69,5 +69,13 @@ def dense_vector_sub_sequence(dim):
     return InputType(dim, Kind.DENSE, SeqLevel.SUB_SEQUENCE)
 
 
+def sparse_binary_vector_sub_sequence(dim):
+    return InputType(dim, Kind.SPARSE_BINARY, SeqLevel.SUB_SEQUENCE)
+
+
+def sparse_float_vector_sub_sequence(dim):
+    return InputType(dim, Kind.SPARSE_FLOAT, SeqLevel.SUB_SEQUENCE)
+
+
 def integer_value_sub_sequence(value_range):
     return InputType(value_range, Kind.INDEX, SeqLevel.SUB_SEQUENCE)

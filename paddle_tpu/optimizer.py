@@ -324,9 +324,18 @@ class AdamW(Adam):
     decay is applied directly to the parameter, not folded into the
     gradient like L2Regularization — the two differ under adaptive
     per-coordinate scaling. No reference counterpart (2017 predates it);
-    included because the TPU build's functional models expect it."""
+    included because the TPU build's functional models expect it.
 
-    def __init__(self, weight_decay=0.01, **kw):
+    ``decay_mask`` selects which parameters decay:
+    - ``"all"`` (default): every leaf, unconditionally — note this decays
+      LayerNorm gains/biases too, unlike the common transformer recipe;
+    - ``"no_1d"``: skip leaves with ndim <= 1 (norm gains, biases) — the
+      conventional recipe;
+    - a callable ``(name, param) -> bool``: True means decay. ``name`` is
+      the flat dict key or the jax keystr tree path under ``tree_update``.
+    """
+
+    def __init__(self, weight_decay=0.01, decay_mask="all", **kw):
         if kw.get("regularization") is not None:
             raise ValueError(
                 "AdamW applies decoupled weight_decay; combining it with "
@@ -334,10 +343,32 @@ class AdamW(Adam):
                 "Adam for gradient-coupled L1/L2.")
         super().__init__(**kw)
         self.weight_decay = weight_decay
+        if not (decay_mask in ("all", "no_1d") or callable(decay_mask)):
+            raise ValueError(f"decay_mask must be 'all', 'no_1d' or a "
+                             f"callable, got {decay_mask!r}")
+        self.decay_mask = decay_mask
 
-    def _update_one(self, g, p, s, lr):
-        newp, ns = super()._update_one(g, p, s, lr)
-        return newp - lr * self.weight_decay * p, ns
+    def _decays(self, name, p):
+        if self.decay_mask == "all":
+            return True
+        if self.decay_mask == "no_1d":
+            return p.ndim > 1
+        return bool(self.decay_mask(name, p))
+
+    def update(self, step, grads, params, state):
+        new_p, new_s = super().update(step, grads, params, state)
+        lr_t = self.schedule(step)
+        for name, p in params.items():
+            spec = self.specs.get(name)
+            if spec is not None and spec.attr.is_static:
+                continue
+            if not self._decays(name, p):
+                continue
+            lr = lr_t * (spec.attr.learning_rate if spec else 1.0)
+            new_p[name] = (new_p[name].astype(jnp.float32)
+                           - lr * self.weight_decay * p.astype(jnp.float32)
+                           ).astype(p.dtype)
+        return new_p, new_s
 
 
 class AdaMax(Optimizer):

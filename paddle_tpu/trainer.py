@@ -183,7 +183,13 @@ class SGD:
         if self._accum_train_step is None:
             return self._plain_train_step
         leaves = jax.tree_util.tree_leaves(feeds)
-        if leaves and leaves[0].shape[0] % self.grad_accum_steps == 0:
+        # every leaf must share the batch dim AND divide evenly; a future
+        # non-batched auxiliary input must fall back to the plain step, not
+        # die in the accumulated step's reshape with an XLA shape error
+        if (leaves
+                and all(l.ndim >= 1 and l.shape[0] == leaves[0].shape[0]
+                        for l in leaves)
+                and leaves[0].shape[0] % self.grad_accum_steps == 0):
             return self._accum_train_step
         return self._plain_train_step
 

@@ -205,3 +205,30 @@ class TestAdamW:
         # zero grad: only the decay term moves the parameter
         np.testing.assert_allclose(np.asarray(newp["a"]["b"]),
                                    1.0 - 0.1 * 0.5, rtol=1e-6)
+
+    def test_decay_mask_no_1d(self, rng):
+        """'no_1d' skips biases/gains (ndim<=1) but decays matrices."""
+        p = {"w": jnp.ones((3, 3), jnp.float32),
+             "b": jnp.ones((3,), jnp.float32)}
+        g = {k: jnp.zeros_like(v) for k, v in p.items()}
+        o = opt.AdamW(learning_rate=0.1, weight_decay=0.5,
+                      decay_mask="no_1d")
+        st = o.init_state(p)
+        newp, _ = o.update(jnp.asarray(0, jnp.int32), g, p, st)
+        # zero grad: only decay moves parameters
+        np.testing.assert_allclose(np.asarray(newp["w"]),
+                                   1.0 - 0.1 * 0.5, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(newp["b"]), 1.0, rtol=1e-6)
+
+    def test_decay_mask_callable(self, rng):
+        p = {"decay_me": jnp.ones((2, 2), jnp.float32),
+             "skip_me": jnp.ones((2, 2), jnp.float32)}
+        g = {k: jnp.zeros_like(v) for k, v in p.items()}
+        o = opt.AdamW(learning_rate=0.1, weight_decay=0.5,
+                      decay_mask=lambda name, p: "decay" in name)
+        st = o.init_state(p)
+        newp, _ = o.update(jnp.asarray(0, jnp.int32), g, p, st)
+        np.testing.assert_allclose(np.asarray(newp["decay_me"]),
+                                   1.0 - 0.05, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(newp["skip_me"]), 1.0,
+                                   rtol=1e-6)
