@@ -22,6 +22,7 @@ from paddle_tpu import activation as act_mod
 from paddle_tpu import pooling as pooling_mod
 from paddle_tpu.core.param import ParamAttr, ParamSpec
 from paddle_tpu.ops import activations as ops_act
+from paddle_tpu.ops import beam as ops_beam
 from paddle_tpu.ops import conv as ops_conv
 from paddle_tpu.ops import loss as ops_loss
 from paddle_tpu.ops import norm as ops_norm
@@ -823,6 +824,40 @@ def classification_cost(input, label, name: Optional[str] = None):
 def cross_entropy_cost(input, label, name: Optional[str] = None):
     name = name or auto_name("cross_entropy")
     return classification_cost(input, label, name=name)
+
+
+def cross_entropy_over_beam(step_scores, parents, gold_scores, gold_slot,
+                            valid_mask=None, name: Optional[str] = None):
+    """Globally-normalized beam-training objective (reference:
+    cross_entropy_over_beam / CrossEntropyOverBeam.cpp — softmax over all
+    expanded beam paths with the gold path as an extra slot when it fell
+    off the beam, loss = −log p(gold)).
+
+    Fixed-width surface over the [B, S, K] beam lattice produced by
+    ops/beam.py-style search (the reference's dynamic BeamInput triples
+    collapse to dense tensors + masks on TPU):
+    ``step_scores`` [B, S·K] or [B, S, K] candidate scores,
+    ``parents`` same shape (int), ``gold_scores`` [B, S],
+    ``gold_slot`` [B] (−1 when the gold path left the beam),
+    ``valid_mask`` optional [B, K]. Emits the per-sequence loss."""
+    name = name or auto_name("cross_entropy_over_beam")
+    inputs = [step_scores, parents, gold_scores, gold_slot]
+    if valid_mask is not None:
+        inputs.append(valid_mask)
+
+    def per_example(params, parents_v, ctx):
+        sc, par, gsc, gslot = (v.array for v in parents_v[:4])
+        vm = parents_v[4].array.astype(bool) if valid_mask is not None \
+            else None
+        if sc.ndim == 2:                   # flat [B, S*K] feed layout
+            S = gsc.shape[1]
+            sc = sc.reshape(sc.shape[0], S, -1)
+            par = par.reshape(par.shape[0], S, -1)
+        return ops_beam.cross_entropy_over_beam(
+            sc, par.astype(jnp.int32), gsc,
+            gslot.reshape(gslot.shape[0]).astype(jnp.int32), vm)
+
+    return _cost_layer(name, "cross_entropy_over_beam", inputs, per_example)
 
 
 def square_error_cost(input, label, name: Optional[str] = None):

@@ -96,6 +96,63 @@ def beam_search(step_fn: Callable, init_state, batch: int, beam_size: int,
         jnp.take_along_axis(final, order, axis=1)
 
 
+def cross_entropy_over_beam(step_scores: jax.Array, parents: jax.Array,
+                            gold_scores: jax.Array, gold_slot: jax.Array,
+                            valid_mask: jax.Array = None) -> jax.Array:
+    """Globally-normalized beam-training loss, fixed-width.
+
+    The TPU-native form of the reference's cross_entropy_over_beam
+    (paddle/gserver/layers/CrossEntropyOverBeam.cpp:158-162 forward,
+    globallyNormalizedScore): every complete path in the final beam gets a
+    total score — the sum of its selected candidates' scores along its
+    ancestry chain — a softmax normalizes over all paths, and the loss is
+    −log p(gold). When the gold sequence fell off the beam during search
+    its independently-scored path joins as one extra softmax slot
+    (CrossEntropyOverBeam.cpp:57-59 goldAsExtraPath). The reference walks
+    dynamic -1-terminated candidate lists on the host; here the beam is
+    the static [B, S, K] lattice of ops/beam.py and dropped slots are
+    masked, so the whole objective (and its gradient) is one jit-able
+    expression.
+
+    Args:
+      step_scores: [B, S, K] score of the candidate occupying beam slot k
+        at expansion step s (model outputs — differentiated through).
+      parents: [B, S, K] int32 — the slot at step s-1 each candidate
+        extends (step 0 entries ignored).
+      gold_scores: [B, S] per-step scores of the gold prefix
+        (differentiated through; used when the gold path left the beam).
+      gold_slot: [B] int32 — the gold path's slot in the FINAL beam, or
+        -1 if it fell off the beam.
+      valid_mask: optional [B, K] bool — final slots holding real paths
+        (default: all valid).
+    Returns: [B] per-sequence loss.
+    """
+    B, S, K = step_scores.shape
+    f32 = jnp.float32
+
+    def accumulate(carry, xs):
+        sc, par = xs                                     # [B, K] each
+        carry = sc.astype(f32) + jnp.take_along_axis(carry, par, axis=1)
+        return carry, None
+
+    # step 0 has no parent: seed with zeros and fold step 0's scores in
+    # via a parent gather against a zero carry (any parent index works)
+    path, _ = jax.lax.scan(
+        accumulate, jnp.zeros((B, K), f32),
+        (jnp.moveaxis(step_scores, 1, 0), jnp.moveaxis(parents, 1, 0)))
+    if valid_mask is not None:
+        path = jnp.where(valid_mask, path, NEG_INF)
+    gold_total = jnp.sum(gold_scores.astype(f32), axis=1)     # [B]
+    in_beam = gold_slot >= 0                                  # [B]
+    # softmax slots: K beam paths + 1 extra that only exists (finite)
+    # when the gold path fell off the beam
+    extra = jnp.where(in_beam, NEG_INF, gold_total)           # [B]
+    logits = jnp.concatenate([path, extra[:, None]], axis=1)  # [B, K+1]
+    slot = jnp.where(in_beam, jnp.maximum(gold_slot, 0), K)
+    target = jnp.take_along_axis(logits, slot[:, None], axis=1)[:, 0]
+    return jax.nn.logsumexp(logits, axis=1) - target
+
+
 def greedy_search(step_fn: Callable, init_state, batch: int, vocab: int,
                   bos_id: int, eos_id: int, max_len: int):
     """Greedy decode = beam_size 1 (reference: generateSequence with

@@ -257,3 +257,114 @@ class TestBeamSearchLayer:
             for k in range(3):
                 if lens[b, k] < 5:
                     assert tok[b, k, lens[b, k] - 1] == 0
+
+
+class TestCrossEntropyOverBeam:
+    """Globally-normalized beam training objective (reference:
+    CrossEntropyOverBeam.cpp) — fixed-width lattice formulation."""
+
+    def _manual(self, step_scores, parents, gold_scores, gold_slot,
+                valid=None):
+        """Path enumeration with plain numpy: follow each final slot's
+        ancestry, sum selected scores, softmax over paths (+ gold extra
+        when fallen off), return -log p(gold)."""
+        B, S, K = step_scores.shape
+        out = np.zeros((B,), np.float64)
+        for b in range(B):
+            totals = []
+            for k in range(K):
+                if valid is not None and not valid[b, k]:
+                    continue
+                tot, slot = 0.0, k
+                for s in range(S - 1, -1, -1):
+                    tot += step_scores[b, s, slot]
+                    slot = parents[b, s, slot]
+                totals.append((k, tot))
+            logits = [t for _, t in totals]
+            if gold_slot[b] >= 0:
+                tgt = [i for i, (k, _) in enumerate(totals)
+                       if k == gold_slot[b]][0]
+            else:
+                logits.append(gold_scores[b].sum())
+                tgt = len(logits) - 1
+            z = np.asarray(logits, np.float64)
+            z = z - z.max()
+            p = np.exp(z) / np.exp(z).sum()
+            out[b] = -np.log(p[tgt])
+        return out
+
+    def _case(self, rng, B=3, S=4, K=3, fall_off=(False, True, False)):
+        step_scores = rng.randn(B, S, K).astype(np.float32)
+        parents = rng.randint(0, K, (B, S, K)).astype(np.int32)
+        gold_scores = rng.randn(B, S).astype(np.float32)
+        gold_slot = np.asarray(
+            [-1 if f else rng.randint(0, K) for f in fall_off], np.int32)
+        return step_scores, parents, gold_scores, gold_slot
+
+    def test_matches_path_enumeration(self, rng):
+        args = self._case(rng)
+        want = self._manual(*[np.asarray(a, np.float64) if a.dtype.kind == "f"
+                              else a for a in args])
+        got = ops_beam.cross_entropy_over_beam(
+            *[jnp.asarray(a) for a in args])
+        np.testing.assert_allclose(np.asarray(got, np.float64), want,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_valid_mask_drops_slots(self, rng):
+        step_scores, parents, gold_scores, gold_slot = self._case(
+            rng, fall_off=(True, True, False))
+        valid = np.ones((3, 3), bool)
+        valid[0, 2] = valid[1, 0] = False
+        # keep gold_slot consistent with validity
+        gold_slot[2] = 1
+        want = self._manual(step_scores.astype(np.float64), parents,
+                            gold_scores.astype(np.float64), gold_slot, valid)
+        got = ops_beam.cross_entropy_over_beam(
+            jnp.asarray(step_scores), jnp.asarray(parents),
+            jnp.asarray(gold_scores), jnp.asarray(gold_slot),
+            jnp.asarray(valid))
+        np.testing.assert_allclose(np.asarray(got, np.float64), want,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_numeric_grad(self, rng):
+        from tests.op_test_util import check_grad
+        step_scores, parents, gold_scores, gold_slot = self._case(
+            rng, B=2, S=3, K=2, fall_off=(False, True))
+
+        def fn(sc, gsc):
+            return ops_beam.cross_entropy_over_beam(
+                sc, jnp.asarray(parents), gsc, jnp.asarray(gold_slot))
+
+        check_grad(fn, [step_scores, gold_scores], wrt=0)
+        check_grad(fn, [step_scores, gold_scores], wrt=1)
+
+    def test_layer_surface(self, rng):
+        """Flat-feed layer form: the quick path from data layers."""
+        import paddle_tpu as paddle
+        from paddle_tpu import layer
+        from paddle_tpu.topology import Topology, Value
+        from paddle_tpu.utils.rng import KeySource
+        dt = paddle.data_type
+        B, S, K = 2, 3, 2
+        sc = layer.data("sc", dt.dense_vector(S * K))
+        par = layer.data("par", dt.dense_vector(S * K))
+        gsc = layer.data("gsc", dt.dense_vector(S))
+        gslot = layer.data("gslot", dt.integer_value(K + 1))
+        cost = layer.cross_entropy_over_beam(sc, par, gsc, gslot,
+                                             name="beam_ce")
+        topo = Topology(cost)
+        params = paddle.parameters.create(cost, KeySource(0))
+        fwd = topo.compile()
+        step_scores, parents, gold_scores, gold_slot = self._case(
+            rng, B=B, S=S, K=K, fall_off=(False, True))
+        outs, _ = fwd(params.values, params.state, {
+            "sc": Value(jnp.asarray(step_scores.reshape(B, S * K))),
+            "par": Value(jnp.asarray(parents.reshape(B, S * K))),
+            "gsc": Value(jnp.asarray(gold_scores)),
+            "gslot": Value(jnp.asarray(gold_slot)),
+        })
+        want = self._manual(step_scores.astype(np.float64), parents,
+                            gold_scores.astype(np.float64), gold_slot)
+        np.testing.assert_allclose(
+            np.asarray(outs["beam_ce"].array, np.float64), want,
+            rtol=1e-5, atol=1e-5)
