@@ -30,6 +30,10 @@ class MoEConfig:
     num_experts: int
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    top_k: int = 1                  # 1 = Switch; 2 = GShard-style top-2
+    normalize_gates: bool = True    # renormalize the k selected gates to
+                                    # sum to 1 (GShard convention; ignored
+                                    # at top_k=1 where Switch keeps raw p)
 
 
 def init_params(key: jax.Array, cfg: MoEConfig):
@@ -57,31 +61,50 @@ def param_shardings(cfg: MoEConfig, mesh: Mesh):
 
 def moe_ffn(params, x: jax.Array, cfg: MoEConfig,
             mesh: Optional[Mesh] = None) -> Tuple[jax.Array, jax.Array]:
-    """Top-1 (Switch) MoE feed-forward.
+    """Top-k MoE feed-forward (k=1: Switch; k=2: GShard-style top-2).
 
     x: [N, D] tokens (flatten batch*seq first) → (out [N, D], aux_loss).
     With a mesh carrying an ``expert`` axis, einsum operands get sharding
     constraints so dispatch/combine become all-to-alls over ICI.
+
+    One dispatch path serves every k: choice c of every token claims
+    capacity AFTER all choices < c (first choices never lose their slot
+    to second choices — the GShard priority rule), the [N, E, cap]
+    dispatch one-hot sums over choices, and the combine tensor carries
+    the per-choice gate weights, so the expert einsums are identical to
+    the Switch path.
     """
     N, D = x.shape
-    E = cfg.num_experts
-    cap = max(1, int(cfg.capacity_factor * N / E))
+    E, k = cfg.num_experts, cfg.top_k
+    if not 1 <= k <= E:
+        raise ValueError(f"top_k={k} must be in [1, num_experts={E}]")
+    cap = max(1, int(cfg.capacity_factor * k * N / E))
 
     logits = jnp.einsum("nd,de->ne", x.astype(jnp.float32), params["gate"])
     probs = jax.nn.softmax(logits, axis=-1)                 # [N, E]
-    expert = jnp.argmax(probs, axis=-1)                     # [N]
-    gate_val = jnp.max(probs, axis=-1)
+    gate_k, expert_k = jax.lax.top_k(probs, k)              # [N, k]
+    if k > 1 and cfg.normalize_gates:
+        gate_k = gate_k / jnp.maximum(
+            jnp.sum(gate_k, axis=-1, keepdims=True), 1e-9)
 
-    # position of each token within its expert's capacity buffer
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)     # [N, E]
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1           # [N, E]
-    pos_in_expert = jnp.sum(pos * onehot, axis=1)           # [N]
+    # capacity accounting over (choice-major, token) order: flatten the
+    # [k, N] assignment grid so cumsum gives all first choices priority
+    # over any second choice, etc.
+    oh_k = jax.nn.one_hot(expert_k.T.reshape(k * N), E,
+                          dtype=jnp.int32)                  # [k*N, E]
+    pos = jnp.cumsum(oh_k, axis=0) * oh_k - 1               # [k*N, E]
+    pos_in_expert = jnp.sum(pos * oh_k, axis=1)             # [k*N]
     keep = pos_in_expert < cap
 
-    # dispatch tensor [N, E, cap]: one-hot of (expert, slot)
-    disp = (onehot.astype(jnp.float32)[:, :, None] *
-            jax.nn.one_hot(jnp.clip(pos_in_expert, 0, cap - 1), cap)[:, None, :])
-    disp = jnp.where(keep[:, None, None], disp, 0.0)
+    # per-choice dispatch one-hots [k*N, E, cap] → summed over choices to
+    # the token-level dispatch [N, E, cap] (slots are disjoint, so the
+    # sum stays one-hot); combine carries gate weights on the same slots
+    slot_oh = jax.nn.one_hot(jnp.clip(pos_in_expert, 0, cap - 1), cap)
+    disp_k = oh_k.astype(jnp.float32)[:, :, None] * slot_oh[:, None, :]
+    disp_k = jnp.where(keep[:, None, None], disp_k, 0.0)
+    disp_k = disp_k.reshape(k, N, E, cap)
+    disp = jnp.sum(disp_k, axis=0)                          # [N, E, cap]
+    combine = jnp.einsum("knec,nk->nec", disp_k, gate_k)
 
     def constrain(v, spec):
         if mesh is None or place.AXIS_EXPERT not in mesh.axis_names:
@@ -95,11 +118,12 @@ def moe_ffn(params, x: jax.Array, cfg: MoEConfig,
     h = jax.nn.gelu(h)
     ye = jnp.einsum("ecf,efd->ecd", h, params["w_out"])
     ye = constrain(ye, P(place.AXIS_EXPERT, None, None))
-    out = jnp.einsum("nec,ecd->nd", disp, ye)
-    out = out * gate_val[:, None]                           # Switch scaling
+    out = jnp.einsum("nec,ecd->nd", combine, ye)            # gate-weighted
 
-    # load-balance aux loss (Switch eq. 4): E * Σ_e frac_tokens_e * mean_prob_e
-    frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    # load-balance aux loss (Switch eq. 4 / GShard l_aux): E * Σ_e
+    # frac_first_choice_e * mean_prob_e — first choices drive balance
+    frac = jnp.mean(jax.nn.one_hot(expert_k[:, 0], E, dtype=jnp.float32),
+                    axis=0)
     mean_p = jnp.mean(probs, axis=0)
     aux = cfg.aux_loss_weight * E * jnp.sum(frac * mean_p)
     return out.astype(x.dtype), aux
