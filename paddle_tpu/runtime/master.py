@@ -10,6 +10,15 @@ TPU-native design: trainers are stateless task consumers (any chip-holder can
 die and its chunk is re-dispatched), the state store is a JSON snapshot file
 (the etcd slot — swap in any kv store), and the wire protocol is
 newline-delimited JSON over TCP for multi-host, or direct calls in-process.
+
+High availability (go/master/etcd_client.go leader election +
+service.go:99,166 state recovery): the MASTER itself may die. A standby
+``HAMaster`` campaigns on a file-based leader lock (the etcd election
+slot); on takeover it restores the task queues from the snapshot —
+in-flight leases deliberately requeue, their trainers may be gone — and
+publishes its address+term in the lock file. ``MasterClient`` given a
+``discovery_path`` re-reads the lock on connection failure and retries
+against the new leader (lease tokens keep duplicate/stale reports safe).
 """
 
 import dataclasses
@@ -59,10 +68,14 @@ class MasterService:
     def __init__(self, lease_seconds: float = 60.0, failure_max: int = 3,
                  num_passes: Optional[int] = None,
                  snapshot_path: Optional[str] = None,
-                 time_fn=time.monotonic):
+                 time_fn=time.monotonic,
+                 snapshot_interval: float = 0.05):
         """num_passes: stop refilling after this many completed passes
         (None = refill forever; the reference's pass barriers are
-        WaitPassStart/Finish, proto/ParameterService.proto:89-95)."""
+        WaitPassStart/Finish, proto/ParameterService.proto:89-95).
+        Snapshots are written by a debounced background thread at most
+        every ``snapshot_interval`` seconds — queue mutations mark state
+        dirty instead of serializing the whole queue per RPC."""
         self._lock = threading.Lock()
         self._todo: List[Task] = []
         self._pending: Dict[int, tuple] = {}     # id -> (task, deadline)
@@ -75,8 +88,19 @@ class MasterService:
         self.num_passes = num_passes
         self._epoch = 0
         self._lease_counter = 0
+        # snapshot plumbing: _version counts mutations (under _lock);
+        # _snap_lock + _snap_written make concurrent writers safe and
+        # monotonic (an older capture never overwrites a newer file)
+        self._version = 0
+        self._snap_written = -1
+        self._snap_lock = threading.Lock()
+        self._dirty = threading.Event()
+        self.snapshot_interval = snapshot_interval
         if snapshot_path and os.path.exists(snapshot_path):
             self._restore()
+        if snapshot_path:
+            threading.Thread(target=self._snapshot_loop,
+                             daemon=True).start()
 
     # -- dataset -----------------------------------------------------------
     def set_dataset(self, paths: Sequence[str], chunks_per_task: int = 1):
@@ -100,6 +124,7 @@ class MasterService:
             self._done.clear()
             self._discarded.clear()
             self._epoch = 0
+            self._version += 1
         self._snapshot()
         log.info("master: dataset set, %d tasks", len(tasks))
 
@@ -109,15 +134,24 @@ class MasterService:
         retry after pending tasks finish, or treat the pass as over when
         num_pending()==0)."""
         with self._lock:
-            self._requeue_expired_locked()
+            changed = self._requeue_expired_locked()
             if not self._todo:
-                return None
-            task = self._todo.pop(0)
-            self._lease_counter += 1
-            task.lease = self._lease_counter
-            self._pending[task.task_id] = (task,
-                                           self._time() + self.lease_seconds)
-            return task
+                task = None
+            else:
+                task = self._todo.pop(0)
+                self._lease_counter += 1
+                task.lease = self._lease_counter
+                self._pending[task.task_id] = (
+                    task, self._time() + self.lease_seconds)
+                changed = True
+            if changed:
+                self._version += 1
+        if changed:
+            # mark dirty (service.go snapshots queue transitions to etcd)
+            # so a standby master can adopt fresh state on takeover;
+            # expiry-only mutations count too
+            self._dirty.set()
+        return task
 
     def report_done(self, task_id: int, lease: Optional[int] = None) -> bool:
         with self._lock:
@@ -127,7 +161,9 @@ class MasterService:
             self._pending.pop(task_id)
             self._done.append(ent[0])
             self._maybe_finish_pass_locked()
-            return True
+            self._version += 1
+        self._dirty.set()
+        return True
 
     def report_failed(self, task_id: int, lease: Optional[int] = None):
         """Failed lease: requeue unless over the failure cap
@@ -146,8 +182,10 @@ class MasterService:
                 self._maybe_finish_pass_locked()
             else:
                 self._todo.append(task)
+            self._version += 1
+        self._dirty.set()
 
-    def _requeue_expired_locked(self):
+    def _requeue_expired_locked(self) -> bool:
         now = self._time()
         expired = [tid for tid, (_, dl) in self._pending.items() if dl < now]
         for tid in expired:
@@ -159,6 +197,7 @@ class MasterService:
             else:
                 log.info("master: lease expired, requeueing task %d", tid)
                 self._todo.append(task)
+        return bool(expired)
 
     def _maybe_finish_pass_locked(self):
         if not self._todo and not self._pending:
@@ -180,8 +219,13 @@ class MasterService:
 
     def num_pending(self):
         with self._lock:
-            self._requeue_expired_locked()
-            return len(self._pending)
+            changed = self._requeue_expired_locked()
+            if changed:
+                self._version += 1
+            n = len(self._pending)
+        if changed:
+            self._dirty.set()
+        return n
 
     def epoch(self):
         with self._lock:
@@ -192,8 +236,10 @@ class MasterService:
         if not self.snapshot_path:
             return
         with self._lock:
+            version = self._version
             state = {
                 "epoch": self._epoch,
+                "lease_counter": self._lease_counter,
                 "todo": [t.to_dict() for t in self._todo],
                 # pending leases are deliberately snapshotted as todo: after
                 # a master restart their trainers may be gone (service.go
@@ -202,23 +248,50 @@ class MasterService:
                 "done": [t.to_dict() for t in self._done],
                 "discarded": [t.to_dict() for t in self._discarded],
             }
-        tmp = self.snapshot_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(state, f)
-        os.replace(tmp, self.snapshot_path)
+        with self._snap_lock:
+            # concurrent captures write in version order only — an older
+            # capture must never overwrite a newer snapshot file
+            if version <= self._snap_written:
+                return
+            tmp = (f"{self.snapshot_path}.tmp.{os.getpid()}."
+                   f"{threading.get_ident()}")
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, self.snapshot_path)
+            self._snap_written = version
 
     def snapshot(self):
+        """Synchronous flush (set_dataset and tests use this)."""
         self._snapshot()
+
+    def _snapshot_loop(self):
+        """Debounced writer: wakes on dirty state, writes at most every
+        ``snapshot_interval`` seconds regardless of RPC rate."""
+        while True:
+            self._dirty.wait()
+            self._dirty.clear()
+            try:
+                self._snapshot()
+            except OSError as e:
+                log.warning("master: snapshot write failed: %s", e)
+            time.sleep(self.snapshot_interval)
 
     def _restore(self):
         with open(self.snapshot_path) as f:
             state = json.load(f)
-        self._epoch = state["epoch"]
-        self._todo = ([Task.from_dict(d) for d in state["todo"]] +
-                      [Task.from_dict(d) for d in state["pending"]])
-        self._done = [Task.from_dict(d) for d in state["done"]]
-        self._discarded = [Task.from_dict(d)
-                           for d in state.get("discarded", [])]
+        with self._lock:
+            self._epoch = state["epoch"]
+            # persisted lease counter: a failed-over master must not
+            # reissue tokens that stale pre-failover reports still hold
+            self._lease_counter = max(self._lease_counter,
+                                      state.get("lease_counter", 0))
+            self._todo = ([Task.from_dict(d) for d in state["todo"]] +
+                          [Task.from_dict(d) for d in state["pending"]])
+            self._pending = {}
+            self._done = [Task.from_dict(d) for d in state["done"]]
+            self._discarded = [Task.from_dict(d)
+                               for d in state.get("discarded", [])]
+            self._version += 1
         log.info("master: restored %d todo / %d done (epoch %d)",
                  len(self._todo), len(self._done), self._epoch)
 
@@ -274,18 +347,233 @@ class MasterServer:
         self._srv.server_close()
 
 
+# ---------------------------------------------------------------------------
+# leader election (the etcd_client.go slot) + HA master
+# ---------------------------------------------------------------------------
+
+class LeaderLock:
+    """Directory-based leader lease: the holder heartbeats ``info.json``
+    inside the lock DIRECTORY; a candidate takes over only when the
+    heartbeat is stale (holder dead). The info file doubles as service
+    discovery: the leader publishes ``{"host", "port", "term"}`` there.
+
+    Atomicity (the split-brain guard): acquisition is ``os.mkdir`` —
+    atomic, one winner. Takeover of a stale lock first ``os.rename``s the
+    dead directory aside; rename is atomic on POSIX, so of N concurrent
+    candidates exactly one succeeds and the rest see ENOENT and back off
+    — nobody can delete a lock a new winner just created (the unlink+
+    create scheme had exactly that hole). (Reference:
+    go/master/etcd_client.go campaign/lock.)"""
+
+    def __init__(self, path: str, stale_after: float = 3.0,
+                 heartbeat_interval: float = 0.5):
+        self.path = path
+        self.stale_after = stale_after
+        self.heartbeat_interval = heartbeat_interval
+        self.term = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    @property
+    def info_path(self):
+        return os.path.join(self.path, "info.json")
+
+    def _heartbeat_age(self) -> Optional[float]:
+        """Seconds since the holder's last heartbeat; None if no lock.
+        A freshly mkdir'd lock whose info.json isn't published yet ages
+        from the directory mtime, so a winner mid-publish is 'live'."""
+        for p in (self.info_path, self.path):
+            try:
+                return time.time() - os.path.getmtime(p)
+            except OSError:
+                continue
+        return None
+
+    def try_acquire(self) -> bool:
+        """One acquisition attempt. On success the caller OWNS the lock
+        directory exclusively but is not yet discoverable — finish setup,
+        then call ``publish(info)``."""
+        import shutil
+
+        age = self._heartbeat_age()
+        if age is not None and age < self.stale_after:
+            return False                       # live holder
+        if age is not None:                    # stale: steal atomically
+            dead = (f"{self.path}.dead.{os.getpid()}."
+                    f"{time.monotonic_ns()}")
+            try:
+                os.rename(self.path, dead)
+            except OSError:
+                # another candidate already renamed it aside; fall through
+                # to the mkdir race (the rename winner has no privilege —
+                # mkdir picks the single next leader)
+                pass
+            else:
+                shutil.rmtree(dead, ignore_errors=True)
+        try:
+            os.mkdir(self.path)
+        except FileExistsError:
+            return False                       # lost the race
+        # term continuity lives in a sidecar file that survives lock
+        # generations (whoever wins mkdir increments it; only one leader
+        # exists at a time, so read-increment-write is unracy here)
+        term_path = self.path + ".term"
+        prev_term = 0
+        try:
+            with open(term_path) as f:
+                prev_term = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            pass
+        self.term = prev_term + 1
+        tmp = f"{term_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(str(self.term))
+        os.replace(tmp, term_path)
+        return True
+
+    def publish(self, info: dict):
+        """Make this leader discoverable and start heartbeating. Call
+        only after ``try_acquire`` returned True and the service is
+        ready to serve."""
+        tmp = f"{self.info_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({**info, "term": self.term}, f)
+        os.replace(tmp, self.info_path)
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._beat, daemon=True)
+        self._thread.start()
+
+    def _beat(self):
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                os.utime(self.info_path)
+            except OSError:
+                pass
+
+    def release(self):
+        import shutil
+
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        shutil.rmtree(self.path, ignore_errors=True)
+
+
+class HAMaster:
+    """A master replica: standby until it wins the leader lock, then
+    serve the task queues restored from the snapshot (in-flight leases
+    requeue — their trainers may be gone, service.go recover semantics).
+
+    Run one per replica host. ``dataset`` is only installed by the FIRST
+    leader (no snapshot yet); every later leader adopts snapshot state.
+    """
+
+    def __init__(self, lock_path: str, snapshot_path: str,
+                 host: str = "127.0.0.1", port: int = 0,
+                 stale_after: float = 3.0, heartbeat_interval: float = 0.5,
+                 lease_seconds: float = 60.0, failure_max: int = 3,
+                 num_passes: Optional[int] = None,
+                 dataset: Optional[Sequence[str]] = None,
+                 chunks_per_task: int = 1):
+        self.lock = LeaderLock(lock_path, stale_after, heartbeat_interval)
+        self.snapshot_path = snapshot_path
+        self.host, self.port = host, port
+        self.lease_seconds = lease_seconds
+        self.failure_max = failure_max
+        self.num_passes = num_passes
+        self.dataset = dataset
+        self.chunks_per_task = chunks_per_task
+        self.service: Optional[MasterService] = None
+        self.server: Optional[MasterServer] = None
+
+    def campaign(self, poll_interval: float = 0.2,
+                 timeout: Optional[float] = None) -> bool:
+        """Block until this replica becomes leader (True) or timeout
+        (False). Ordering matters: the lock is won FIRST, then state is
+        restored from the snapshot, then the server starts, and only
+        then is the address published — clients can never reach a
+        leader whose queues are stale or mid-restore."""
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            if self.lock.try_acquire():
+                break
+            if deadline is not None and time.time() > deadline:
+                return False
+            time.sleep(poll_interval)
+        # exclusive owner now: build state before becoming discoverable
+        # (the MasterService ctor restores the previous leader's snapshot)
+        self.service = MasterService(self.lease_seconds, self.failure_max,
+                                     self.num_passes, self.snapshot_path)
+        if (not os.path.exists(self.snapshot_path)
+                and self.dataset is not None):
+            self.service.set_dataset(self.dataset, self.chunks_per_task)
+        self.server = MasterServer(self.service, self.host, self.port)
+        self.lock.publish({"host": self.server.addr[0],
+                           "port": self.server.addr[1]})
+        log.info("master: leader term %d at %s:%d", self.lock.term,
+                 self.server.addr[0], self.server.addr[1])
+        return True
+
+    def shutdown(self):
+        if self.server:
+            self.server.shutdown()
+        self.lock.release()
+
+
+def discover_master(discovery_path: str) -> Optional[tuple]:
+    """Resolve the current leader's (host, port) from the lock
+    directory's published info."""
+    try:
+        with open(os.path.join(discovery_path, "info.json")) as f:
+            d = json.load(f)
+        return (d["host"], d["port"])
+    except (OSError, ValueError, KeyError):
+        return None
+
+
 class MasterClient:
     """Client for trainers. ``addr=None`` talks to an in-process service
     (reference: python/paddle/v2/master/client.py set_dataset/next_record
-    over the C binding; here JSON/TCP or direct calls)."""
+    over the C binding; here JSON/TCP or direct calls). With
+    ``discovery_path`` the client resolves the leader from the HA lock
+    file and transparently re-resolves + retries on connection failure
+    (master failover; lease tokens make replayed reports safe)."""
 
     def __init__(self, service: Optional[MasterService] = None,
-                 addr: Optional[tuple] = None):
-        assert (service is None) != (addr is None), \
-            "pass exactly one of service/addr"
+                 addr: Optional[tuple] = None,
+                 discovery_path: Optional[str] = None,
+                 failover_timeout: float = 30.0):
+        assert sum(x is not None for x in (service, addr,
+                                           discovery_path)) == 1, \
+            "pass exactly one of service/addr/discovery_path"
         self._svc = service
         self._addr = addr
+        self._discovery = discovery_path
+        self._failover_timeout = failover_timeout
         self._sock = None
+
+    def _resolve(self):
+        if self._discovery is None:
+            return self._addr
+        return discover_master(self._discovery)
+
+    def _rpc_once(self, method, **kw):
+        if self._sock is None:
+            addr = self._resolve()
+            if addr is None:
+                raise ConnectionError("no master leader published")
+            self._sock = socket.create_connection(addr, timeout=10)
+            self._file = self._sock.makefile("rwb")
+        self._file.write((json.dumps({"method": method, **kw}) + "\n")
+                         .encode())
+        self._file.flush()
+        line = self._file.readline()
+        if not line:
+            raise ConnectionError("master closed the connection")
+        resp = json.loads(line)
+        if "error" in resp:
+            raise RuntimeError(f"master rpc error: {resp['error']}")
+        return resp
 
     def _rpc(self, method, **kw):
         if self._svc is not None:
@@ -302,16 +590,18 @@ class MasterClient:
                 return {"todo": self._svc.num_todo(),
                         "pending": self._svc.num_pending(),
                         "epoch": self._svc.epoch()}
-        if self._sock is None:
-            self._sock = socket.create_connection(self._addr)
-            self._file = self._sock.makefile("rwb")
-        self._file.write((json.dumps({"method": method, **kw}) + "\n")
-                         .encode())
-        self._file.flush()
-        resp = json.loads(self._file.readline())
-        if "error" in resp:
-            raise RuntimeError(f"master rpc error: {resp['error']}")
-        return resp
+        deadline = time.time() + self._failover_timeout
+        while True:
+            try:
+                return self._rpc_once(method, **kw)
+            # ValueError: a leader SIGKILLed mid-response leaves a partial
+            # line — a decode error is a failover signal, not a bug
+            except (ConnectionError, OSError, ValueError) as e:
+                self.close()
+                if self._discovery is None or time.time() > deadline:
+                    raise
+                log.info("master client: %s; re-resolving leader", e)
+                time.sleep(0.2)
 
     def get_task(self) -> Optional[Task]:
         d = self._rpc("get_task")["task"]

@@ -168,3 +168,71 @@ class TestMasterTCP:
             c2.close()
         finally:
             server.shutdown()
+
+
+class TestLeaderLock:
+    def test_single_winner_fresh(self, tmp_path):
+        from paddle_tpu.runtime.master import LeaderLock
+        path = str(tmp_path / "lock")
+        a = LeaderLock(path, stale_after=5.0)
+        b = LeaderLock(path, stale_after=5.0)
+        assert a.try_acquire()
+        assert not b.try_acquire()        # live holder
+        a.publish({"host": "h", "port": 1})
+        assert not b.try_acquire()
+        a.release()
+
+    def test_stale_takeover_exactly_one_winner(self, tmp_path):
+        """Concurrent candidates racing for a STALE lock: the atomic
+        rename-aside guarantees exactly one winner (the split-brain
+        regression: unlink+create let a loser delete the new winner's
+        lock)."""
+        import threading
+        from paddle_tpu.runtime.master import LeaderLock
+        path = str(tmp_path / "lock")
+        dead = LeaderLock(path, stale_after=0.05)
+        assert dead.try_acquire()
+        dead.publish({"host": "h", "port": 1})
+        dead._stop.set()                  # holder "dies": heartbeat stops
+        dead._thread.join()
+        import time
+        time.sleep(0.1)                   # lease goes stale
+
+        locks = [LeaderLock(path, stale_after=0.05) for _ in range(8)]
+        results = [None] * 8
+        barrier = threading.Barrier(8)
+
+        def campaign(i):
+            barrier.wait()
+            results[i] = locks[i].try_acquire()
+
+        ts = [threading.Thread(target=campaign, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert sum(results) == 1, results
+        winner = locks[results.index(True)]
+        assert winner.term == dead.term + 1
+        winner.publish({"host": "h", "port": 2})
+        # and the lock the winner holds is REAL (no loser deleted it)
+        assert not LeaderLock(path, stale_after=5.0).try_acquire()
+
+    def test_lease_counter_survives_failover(self, tmp_path):
+        """Snapshot carries the lease counter so a new leader never
+        reissues tokens stale reports still hold."""
+        from paddle_tpu.runtime import recordio
+        from paddle_tpu.runtime.master import MasterService
+        path = str(tmp_path / "d.rio")
+        with recordio.Writer(path, records_per_chunk=2) as w:
+            for i in range(8):
+                w.write(b"x%d" % i)
+        snap = str(tmp_path / "snap.json")
+        svc = MasterService(lease_seconds=60, snapshot_path=snap)
+        svc.set_dataset([path])
+        t1 = svc.get_task()
+        t2 = svc.get_task()
+        svc.snapshot()
+        svc2 = MasterService(lease_seconds=60, snapshot_path=snap)
+        t3 = svc2.get_task()
+        assert t3.lease > max(t1.lease, t2.lease)
