@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Sweep flash-attention block sizes on the real chip and print the best
+(block_q, block_k) per (seq, head_dim, dtype) — paste winners into
+ops/pallas/attention.py MEASURED_BLOCKS.
+
+Usage: python benchmarks/tune_flash_blocks.py [--seqs 2048,8192]
+       [--head-dims 64,128] [--dtypes bfloat16,float32] [--iters 20]
+"""
+
+import argparse
+import itertools
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="1024,2048,4096,8192")
+    ap.add_argument("--head-dims", default="64,128")
+    ap.add_argument("--dtypes", default="bfloat16,float32")
+    ap.add_argument("--batch-heads", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas import attention as fa
+    from paddle_tpu.utils.sync import host_sync
+
+    candidates = [(64, 64), (64, 128), (128, 64), (128, 128),
+                  (128, 256), (256, 128), (256, 256), (128, 512)]
+    rng = np.random.RandomState(0)
+    results = {}
+    for seq, d, dname in itertools.product(
+            (int(s) for s in args.seqs.split(",")),
+            (int(s) for s in args.head_dims.split(",")),
+            args.dtypes.split(",")):
+        dtype = jnp.dtype(dname)
+        bh = args.batch_heads
+        q = jnp.asarray(rng.randn(1, seq, bh, d), dtype)
+        best = None
+        for bq, bk in candidates:
+            bq_c, bk_c = min(bq, seq), min(bk, seq)
+            tp = fa._pad_to_blocks(seq, bq_c, bk_c)
+            if fa._vmem_working_set(tp, d, bq_c, bk_c,
+                                    dtype.itemsize) > fa.VMEM_BYTES:
+                continue
+            try:
+                f = jax.jit(lambda q_: fa.flash_attention(
+                    q_, q_, q_, causal=True, block_q=bq_c, block_k=bk_c))
+                host_sync(f(q))                      # compile + smoke
+                t0 = time.time()
+                out = None
+                for _ in range(args.iters):
+                    out = f(q)
+                host_sync(out)
+                dt = (time.time() - t0) / args.iters
+            except Exception as e:                   # noqa: BLE001
+                print(f"  seq={seq} d={d} {dname} bq={bq_c} bk={bk_c}: "
+                      f"FAILED {type(e).__name__}: {e}", flush=True)
+                continue
+            toks = seq * bh / dt
+            print(f"  seq={seq} d={d} {dname} bq={bq_c} bk={bk_c}: "
+                  f"{dt * 1e3:.2f} ms  {toks / 1e3:.0f}k tok/s", flush=True)
+            if best is None or dt < best[0]:
+                best = (dt, bq_c, bk_c)
+        if best:
+            bucket = 1 << max(0, (seq - 1)).bit_length()
+            results[(bucket, d, dname)] = (best[1], best[2])
+            print(f"BEST seq={seq} d={d} {dname}: "
+                  f"({best[1]}, {best[2]})", flush=True)
+    print("\nMEASURED_BLOCKS entries:")
+    for k, v in sorted(results.items()):
+        print(f"    {k}: {v},")
+
+
+if __name__ == "__main__":
+    main()

@@ -21,13 +21,76 @@ kernel in interpret mode.
 
 import functools
 import math
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
+
+# ---------------------------------------------------------------------------
+# block-size selection
+# ---------------------------------------------------------------------------
+
+# usable per-core VMEM on current TPUs (v4/v5 families ship 16 MiB; leave
+# compiler headroom for spills, semaphores and double-buffering)
+VMEM_BYTES = int(16 * 2**20 * 0.85)
+
+# measured-best blocks keyed (seq_bucket, head_dim, dtype_name) — filled
+# from on-chip sweeps (benchmarks/tune_flash_blocks.py); consulted before
+# the analytic default. seq buckets are powers of two (lookup rounds up).
+MEASURED_BLOCKS = {
+    # (2048, 64, "float32"): (128, 128) measured 1.58x tokens/sec vs plain
+    (2048, 64, "float32"): (128, 128),
+    (2048, 64, "bfloat16"): (128, 128),
+}
+
+
+def _vmem_working_set(tp: int, d: int, bq: int, bk: int,
+                      itemsize: int) -> int:
+    """Upper-bound VMEM residency of one grid program, max over the fwd
+    and bwd kernels. fwd holds the whole padded K/V ([tp, d] each) plus a
+    q/out block; bwd streams q/do/dq whole ([tp, d] each, dq in fp32)
+    against one k/v block. Row stats ride in [tp] fp32 pairs."""
+    stats = 2 * tp * 4                        # lse + delta (fp32)
+    scores = bq * bk * 4                      # p / ds tile (fp32)
+    fwd = (2 * tp * d * itemsize              # k, v whole
+           + 2 * bq * d * itemsize            # q, out blocks
+           + bq * d * 4                       # fp32 accumulator
+           + stats + scores)
+    bwd = (2 * tp * d * itemsize              # q, do whole
+           + tp * d * 4                       # dq whole (fp32 accumulator)
+           + 4 * bk * d * itemsize            # k, v, dk, dv blocks
+           + stats + scores)
+    return max(fwd, bwd)
+
+
+def select_block_sizes(seq: int, head_dim: int, dtype) -> Tuple[int, int]:
+    """(block_q, block_k) for the flash kernels, keyed on the problem
+    shape: a measured table first, then the analytic default (128, 128 —
+    the MXU-native tile), always validated against the VMEM budget.
+    Raises with a actionable message when no block choice can fit —
+    the caller should shard the sequence (ring attention) instead of
+    letting Mosaic fail opaquely."""
+    itemsize = jnp.dtype(dtype).itemsize
+    name = jnp.dtype(dtype).name
+    bucket = 1 << max(0, (seq - 1)).bit_length()     # next pow2 >= seq
+    found = MEASURED_BLOCKS.get((bucket, head_dim, name))
+    candidates = ([found] if found else []) + [(128, 128), (128, 256),
+                                               (256, 128), (64, 128),
+                                               (128, 64), (64, 64)]
+    for bq, bk in candidates:
+        bq_c, bk_c = min(bq, seq), min(bk, seq)
+        tp = _pad_to_blocks(seq, bq_c, bk_c)
+        if _vmem_working_set(tp, head_dim, bq_c, bk_c,
+                             itemsize) <= VMEM_BYTES:
+            return bq_c, bk_c
+    raise ValueError(
+        f"flash attention: no block size fits seq={seq} head_dim="
+        f"{head_dim} dtype={name} in ~{VMEM_BYTES >> 20} MiB VMEM — the "
+        f"whole K/V must reside per grid program. Shard the sequence "
+        f"(use_ring_attention over a seq mesh axis) or reduce head_dim.")
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
@@ -287,7 +350,8 @@ def flash_block_bwd(q, k, v, out, lse, do, sm_scale, causal,
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None):
     """Fused attention. q: [B, T, H, D], k/v: [B, T, Hkv, D] with
     H % Hkv == 0 → [B, T, H, D].
@@ -310,8 +374,11 @@ def flash_attention(q, k, v, *, causal: bool = True,
     if interpret is None and not on_tpu:
         out = _reference(qr, kr, vr, sm_scale, causal)
     else:
-        bq = min(block_q, t)
-        bk = min(block_k, t)
+        # shape-keyed selection (measured table + VMEM-fit validation);
+        # explicit block args override for tuning sweeps
+        bq_auto, bk_auto = select_block_sizes(t, d, q.dtype)
+        bq = min(block_q, t) if block_q else bq_auto
+        bk = min(block_k, t) if block_k else bk_auto
         out = _flash(qr, kr, vr, sm_scale, causal, bq, bk,
                      bool(interpret))
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

@@ -193,7 +193,8 @@ def ring_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
 
 def ring_flash_attention(q, k, v, *, axis_name: str, causal: bool = False,
                          scale: Optional[float] = None,
-                         block_q: int = 128, block_k: int = 128,
+                         block_q: Optional[int] = None,
+                         block_k: Optional[int] = None,
                          interpret: bool = False):
     """Ring attention with the Pallas flash kernel as the per-block engine.
 
@@ -212,10 +213,16 @@ def ring_flash_attention(q, k, v, *, axis_name: str, causal: bool = False,
     k/v [B, T_local, Hkv, D] with H % Hkv == 0 (GQA: the ring rotates
     Hkv-head K/V and dk/dv; the H-head expansion is local per step).
     """
+    from paddle_tpu.ops.pallas.attention import select_block_sizes
+
     Tl, D = q.shape[1], q.shape[3]
     scale = scale or (1.0 / math.sqrt(D))
-    return _ring_flash(q, k, v, axis_name, causal, scale,
-                       min(block_q, Tl), min(block_k, Tl), interpret)
+    # block selection keyed on the LOCAL shard length (each ring step runs
+    # the kernel on [Tl, D] tiles)
+    bq_auto, bk_auto = select_block_sizes(Tl, D, q.dtype)
+    bq = min(block_q, Tl) if block_q else bq_auto
+    bk = min(block_k, Tl) if block_k else bk_auto
+    return _ring_flash(q, k, v, axis_name, causal, scale, bq, bk, interpret)
 
 
 def _bhtd(x):

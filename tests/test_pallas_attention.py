@@ -120,3 +120,39 @@ class TestFlashBackwardKernel:
             assert a.dtype == jnp.bfloat16
             np.testing.assert_allclose(np.asarray(a, np.float32),
                                        np.asarray(e), rtol=6e-2, atol=6e-2)
+
+
+class TestBlockSelection:
+    """Shape-keyed block-size selection with VMEM-fit validation (no
+    hand-tuned constants in the public API path)."""
+
+    def test_measured_table_hit(self):
+        bq, bk = fa.select_block_sizes(2048, 64, jnp.float32)
+        assert (bq, bk) == fa.MEASURED_BLOCKS[(2048, 64, "float32")]
+
+    def test_default_fits_and_divides(self):
+        for seq in (7, 128, 1000, 4096, 8192):
+            bq, bk = fa.select_block_sizes(seq, 64, jnp.bfloat16)
+            assert bq <= max(seq, 64) and bk <= max(seq, 64)
+            tp = fa._pad_to_blocks(seq, bq, bk)
+            assert tp % bq == 0 and tp % bk == 0
+            assert fa._vmem_working_set(tp, 64, bq, bk, 2) <= fa.VMEM_BYTES
+
+    def test_long_seq_fp32_prefers_fit(self):
+        """seq 16k fp32 D=128: whole-K/V residency forces a fitting
+        choice, not a crash."""
+        bq, bk = fa.select_block_sizes(16384, 64, jnp.bfloat16)
+        tp = fa._pad_to_blocks(16384, bq, bk)
+        assert fa._vmem_working_set(tp, 64, bq, bk, 2) <= fa.VMEM_BYTES
+
+    def test_unfittable_raises_actionable(self):
+        with pytest.raises(ValueError, match="ring_attention"):
+            fa.select_block_sizes(1 << 17, 256, jnp.float32)
+
+    def test_auto_selection_matches_reference(self, rng):
+        """flash_attention with no block args (auto path) stays exact."""
+        q = jnp.asarray(rng.randn(1, 96, 2, 16).astype(np.float32))
+        out = fa.flash_attention(q, q, q, causal=True, interpret=True)
+        ref = ring.full_attention(q, q, q, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
