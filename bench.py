@@ -52,6 +52,11 @@ RUNS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "benchmarks", "runs")
 # read once; build_train_step and every emitted record use this same value
 STEM_S2D = os.environ.get("BENCH_S2D", "1") == "1"
+# streaming-BN convs (Pallas conv emits batch stats from its epilogue).
+# Default OFF until an on-chip session validates lowering + wins
+# (benchmarks/on_chip_queue.sh flips it for the measured comparison);
+# interpret-mode tests cannot catch Mosaic lowering violations.
+FUSED_BN = os.environ.get("BENCH_FUSED_BN", "0") == "1"
 
 
 def log(*a):
@@ -127,7 +132,7 @@ def emit(value, error=None, **extra):
     rec = {"metric": "resnet50_train_images_per_sec_per_chip",
            "value": round(value, 1), "unit": "images/sec",
            "vs_baseline": round(value / NORTH_STAR, 4),
-           "stem_space_to_depth": STEM_S2D}
+           "stem_space_to_depth": STEM_S2D, "fused_bn": FUSED_BN}
     rec.update(extra)
     if error:
         rec["error"] = error
@@ -279,7 +284,8 @@ def build_train_step():
     img = layer.data("image", paddle.data_type.dense_vector(3 * 224 * 224))
     lbl = layer.data("label", paddle.data_type.integer_value(1000))
     out = resnet.resnet_imagenet(
-        img, depth=50, class_num=1000, stem_space_to_depth=STEM_S2D)
+        img, depth=50, class_num=1000, stem_space_to_depth=STEM_S2D,
+        fused_bn=FUSED_BN)
     cost = layer.classification_cost(out, lbl, name="cost")
     topo = Topology(cost)
     params = paddle.parameters.create(cost, KeySource(42))

@@ -3,7 +3,8 @@
 # (see BENCHMARKS.md notes on multi-hour tunnel outages).
 # Usage: bash benchmarks/on_chip_queue.sh   — each step is independently
 # timed out, appends raw artifacts to benchmarks/runs/, and a failed step
-# doesn't stop the rest.
+# doesn't stop the rest. Ordered most-valuable-first so a tunnel that
+# dies mid-queue still leaves the round's key evidence.
 set -u
 cd "$(dirname "$0")/.."
 STAMP=$(date +%F_%H%M)
@@ -18,19 +19,59 @@ print('probe ok', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" \
 
 probe
 
-echo "== resnet50 sanity (s2d default)"
-timeout 1200 python bench.py > "$RUNS/${STAMP}_resnet50_sanity.json" 2>/tmp/q1.log \
-    && cat "$RUNS/${STAMP}_resnet50_sanity.json"
+echo "== [1] fused-BN kernel smoke (Mosaic lowering check, real shapes)"
+timeout 900 python - <<'EOF' 2>&1 | tail -5
+import numpy as np, jax, jax.numpy as jnp
+from paddle_tpu.ops.pallas import conv_bn as fused
+from paddle_tpu.ops import conv as ops_conv
+rng = np.random.RandomState(0)
+x = jnp.asarray(rng.randn(2*56*56, 64).astype(np.float32))
+w = jnp.asarray(rng.randn(64, 256).astype(np.float32) * 0.1)
+y, s1, s2 = jax.jit(lambda a, b: fused.matmul_bn_stats(a, b))(x, w)
+ref = np.asarray(x) @ np.asarray(w)
+print("matmul_bn_stats max err:", np.abs(np.asarray(y) - ref).max(),
+      "stats err:", np.abs(np.asarray(s1) - ref.sum(0)).max())
+x3 = jnp.asarray(rng.randn(2, 56, 56, 64).astype(np.bfloat16))
+w3 = jnp.asarray((rng.randn(3, 3, 64, 64) * 0.1).astype(np.bfloat16))
+y3, a1, a2 = jax.jit(lambda a, b: fused.conv3x3_bn_stats(a, b))(x3, w3)
+ref3 = np.asarray(ops_conv.conv2d(x3, w3, stride=1, padding="SAME"),
+                  np.float32)
+print("conv3x3_bn_stats max err:",
+      np.abs(np.asarray(y3, np.float32) - ref3).max())
+print("SMOKE OK")
+EOF
 
-echo "== transformer seq=8192 (flash fits, plain OOMs)"
+echo "== [2] resnet50 unfused vs fused-BN (the streaming-BN experiment)"
+BENCH_FUSED_BN=0 timeout 1500 python bench.py \
+    > "$RUNS/${STAMP}_resnet50_unfused.json" 2>/tmp/q_unfused.log \
+    && cat "$RUNS/${STAMP}_resnet50_unfused.json"
+BENCH_FUSED_BN=1 timeout 1500 python bench.py \
+    > "$RUNS/${STAMP}_resnet50_fusedbn.json" 2>/tmp/q_fused.log \
+    && cat "$RUNS/${STAMP}_resnet50_fusedbn.json"
+
+echo "== [3] transformer seq=8192 (flash fits, plain OOMs)"
 timeout 1800 python benchmarks/transformer_bench.py --seq 8192 --batch 2 \
     > "$RUNS/${STAMP}_transformer_seq8192.jsonl" 2>/tmp/q2.log \
     && cat "$RUNS/${STAMP}_transformer_seq8192.jsonl"
 
-echo "== transformer seq=4096"
+echo "== [4] transformer seq=16384 (if it fits)"
+timeout 1800 python benchmarks/transformer_bench.py --seq 16384 --batch 1 \
+    > "$RUNS/${STAMP}_transformer_seq16384.jsonl" 2>/tmp/q16.log \
+    && cat "$RUNS/${STAMP}_transformer_seq16384.jsonl"
+
+echo "== [5] vgg19 sweep bs 64/128/256 (BASELINE.md parity rows)"
+timeout 3000 python benchmarks/run_all.py --suite vgg19 --merge \
+    > "$RUNS/${STAMP}_vgg_sweep.log" 2>&1 \
+    && tail -6 "$RUNS/${STAMP}_vgg_sweep.log"
+
+echo "== [6] transformer seq=4096"
 timeout 1500 python benchmarks/transformer_bench.py --seq 4096 --batch 4 \
     > "$RUNS/${STAMP}_transformer_seq4096.jsonl" 2>/tmp/q3.log \
     && cat "$RUNS/${STAMP}_transformer_seq4096.jsonl"
 
-echo "done; update benchmarks/analysis.md with any new numbers and"
-echo "regenerate BENCHMARKS.md via: python benchmarks/run_all.py --from-json"
+echo "== [7] flash block-size tuning sweep"
+timeout 2400 python benchmarks/tune_flash_blocks.py \
+    > "$RUNS/${STAMP}_flash_blocks.log" 2>&1 \
+    && tail -20 "$RUNS/${STAMP}_flash_blocks.log"
+
+echo "done; update BENCHMARKS.md + MEASURED_BLOCKS with any new numbers"

@@ -42,6 +42,8 @@ SWEEP = [
     ("smallnet", {"BENCH_BATCH": "256"}, 33.113, K40),
     ("smallnet", {"BENCH_BATCH": "512"}, 63.039, K40),
     ("vgg19", {"BENCH_BATCH": "64"}, 64000 / 27.69, "2xXeon6148 MKL-DNN"),
+    ("vgg19", {"BENCH_BATCH": "128"}, 128000 / 28.8, "2xXeon6148 MKL-DNN"),
+    ("vgg19", {"BENCH_BATCH": "256"}, 256000 / 29.27, "2xXeon6148 MKL-DNN"),
     ("resnet50", {"BENCH_BATCH": "128"}, None, "north star 4000 img/s"),
     ("resnet50", {"BENCH_BATCH": "256"}, None, "north star 4000 img/s"),
     ("lstm", {"BENCH_BATCH": "64", "BENCH_HIDDEN": "256"}, 83.0, K40),
@@ -148,6 +150,10 @@ def main():
     ap.add_argument("--timed", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--out", default=os.path.join(REPO, "BENCHMARKS.md"))
+    ap.add_argument("--merge", action="store_true",
+                    help="keep existing results.json points; replace only "
+                         "the ones re-measured in this run (safe partial "
+                         "sweeps, e.g. --suite vgg19 --merge)")
     ap.add_argument("--from-json", action="store_true",
                     help="rewrite the .md from benchmarks/results.json "
                          "without re-measuring")
@@ -167,6 +173,13 @@ def main():
 
     results = {"platform": os.environ.get("BENCH_PLATFORM", "default"),
                "device": "?", "points": []}
+    if args.merge and os.path.exists(json_path):
+        with open(json_path) as f:
+            results = json.load(f)
+        # points re-measured in this run replace their old records
+        results["points"] = [
+            p for p in results["points"]
+            if not (args.suite is None or p["suite"] == args.suite)]
     for suite, env_over, baseline_ms, note in SWEEP:
         if args.suite and suite != args.suite:
             continue

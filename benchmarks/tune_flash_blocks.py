@@ -9,10 +9,12 @@ Usage: python benchmarks/tune_flash_blocks.py [--seqs 2048,8192]
 
 import argparse
 import itertools
+import os
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def main():

@@ -415,6 +415,87 @@ def batch_norm(input, act=None, name: Optional[str] = None, num_channels=None,
     return lo
 
 
+def img_conv_bn(input, filter_size, num_filters: int,
+                num_channels: Optional[int] = None, stride=1,
+                padding="SAME", act=None, name: Optional[str] = None,
+                param_attr=None, bn_param_attr=None, bn_bias_attr=None,
+                moving_average_fraction=0.9, epsilon=1e-5, img_size=None,
+                conv_name: Optional[str] = None,
+                bn_name: Optional[str] = None):
+    """Fused conv→batch-norm block (streaming-BN: the Pallas conv kernel
+    emits the batch statistics from its own epilogue, removing the
+    stats-reduce pass over the activation — ops/pallas/conv_bn.py; the
+    capability slot of the reference's CudnnBatchNormLayer fused with
+    ExpandConvLayer). Falls back to XLA conv + jnp stats off-TPU or on
+    unsupported shapes, so numerics are identical everywhere. No conv
+    bias (BN's beta subsumes it — the reference's conv_bn_layer does the
+    same, benchmark/paddle/image/resnet.py:13)."""
+    from paddle_tpu.ops.pallas import conv_bn as ops_fused
+
+    name = name or auto_name("img_conv_bn")
+    # conv_name / bn_name control PARAMETER naming so a fused layer can
+    # share checkpoints with an img_conv + batch_norm pair
+    conv_name = conv_name or name
+    bn_name = bn_name or name
+    act_name = act_mod.resolve(act)
+    k = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    cin = num_channels or getattr(input, "_out_channels", None)
+    enforce.enforce(cin is not None,
+                    f"img_conv_bn {name}: num_channels required")
+    a = _param_attr(param_attr or ParamAttr(initializer="msra"),
+                    f"{conv_name}.w")
+    wspec = ParamSpec(a.name, (k[0], k[1], cin, num_filters), attr=a,
+                      fan_in=k[0] * k[1] * cin)
+    ga = _param_attr(bn_param_attr if isinstance(bn_param_attr, ParamAttr)
+                     else ParamAttr(initializer="constant",
+                                    initial_value=1.0), f"{bn_name}.gamma")
+    ba = _param_attr(bn_bias_attr if isinstance(bn_bias_attr, ParamAttr)
+                     else ParamAttr(initializer="constant",
+                                    initial_value=0.0), f"{bn_name}.beta")
+    gamma = ParamSpec(ga.name, (num_filters,), attr=ga)
+    beta = ParamSpec(ba.name, (num_filters,), attr=ba)
+    mean_s = ParamSpec(f"{bn_name}.mean", (num_filters,),
+                       attr=ParamAttr(initializer="constant",
+                                      initial_value=0.0))
+    var_s = ParamSpec(f"{bn_name}.var", (num_filters,),
+                      attr=ParamAttr(initializer="constant",
+                                     initial_value=1.0))
+    ih, iw = _infer_img_shape(input, cin, img_size)
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pad_for_dim = "SAME" if padding == "SAME" else padding
+    oh = _conv_out_dim(ih, k[0], s[0], pad_for_dim)
+    ow = _conv_out_dim(iw, k[1], s[1], pad_for_dim)
+
+    def fwd(params, parents, ctx):
+        x = _to_nhwc(parents[0].array, cin, ih, iw)
+        rm = ctx.state_in[mean_s.name]
+        rv = ctx.state_in[var_s.name]
+        if ctx.is_training:
+            y, nm, nv = ops_fused.conv_bn_train(
+                x, params[wspec.name], params[gamma.name],
+                params[beta.name], rm, rv, stride=stride, padding=padding,
+                momentum=moving_average_fraction, eps=epsilon)
+            ctx.state_out[mean_s.name] = nm
+            ctx.state_out[var_s.name] = nv
+        else:
+            y = ops_fused.conv_bn_infer(
+                x, params[wspec.name], params[gamma.name],
+                params[beta.name], rm, rv, stride=stride, padding=padding,
+                eps=epsilon)
+            ctx.state_out[mean_s.name] = rm
+            ctx.state_out[var_s.name] = rv
+        return _apply_act(Value(y), act_name)
+
+    lo = LayerOutput(name, "img_conv_bn", [input], fwd,
+                     [wspec, gamma, beta],
+                     size=oh * ow * num_filters if oh and ow else None,
+                     activation=act_name, state_specs=[mean_s, var_s])
+    lo._out_channels = num_filters
+    lo._img_shape = (oh, ow)
+    return lo
+
+
 # ---------------------------------------------------------------------------
 # regularisation / elementwise composition
 # ---------------------------------------------------------------------------

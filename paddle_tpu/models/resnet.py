@@ -10,8 +10,22 @@ from paddle_tpu import activation, layer, pooling
 
 
 def conv_bn_layer(input, ch_out, filter_size, stride, padding, active_type,
-                  ch_in=None, name=None):
-    """(reference: resnet.py conv_bn_layer)"""
+                  ch_in=None, name=None, fused=False):
+    """(reference: resnet.py conv_bn_layer). ``fused=True`` runs the
+    streaming-BN path: one Pallas kernel computes the conv AND its batch
+    statistics (ops/pallas/conv_bn.py), eliminating the stats-reduce
+    read of the activation on every BN'd conv."""
+    if fused:
+        # explicit integer padding (NOT "SAME": XLA pads SAME
+        # asymmetrically at stride 2, which would silently change
+        # stride-2 numerics vs the unfused path); param names mirror the
+        # unfused pair so checkpoints are interchangeable between paths
+        return layer.img_conv_bn(
+            input, filter_size=filter_size, num_filters=ch_out,
+            num_channels=ch_in, stride=stride, padding=padding,
+            act=active_type, name=f"{name}_fused" if name else None,
+            conv_name=f"{name}_conv" if name else None,
+            bn_name=f"{name}_bn" if name else None)
     tmp = layer.img_conv(input, filter_size=filter_size, num_filters=ch_out,
                          num_channels=ch_in, stride=stride, padding=padding,
                          act=None, bias_attr=False,
@@ -20,33 +34,35 @@ def conv_bn_layer(input, ch_out, filter_size, stride, padding, active_type,
                             name=f"{name}_bn" if name else None)
 
 
-def shortcut(input, ch_in, ch_out, stride, name=None):
+def shortcut(input, ch_in, ch_out, stride, name=None, fused=False):
     if ch_in != ch_out or stride != 1:
         return conv_bn_layer(input, ch_out, 1, stride, 0, None,
-                             name=f"{name}_proj" if name else None)
+                             name=f"{name}_proj" if name else None,
+                             fused=fused)
     return input
 
 
-def bottleneck_block(input, ch_in, ch_out, stride, name=None):
+def bottleneck_block(input, ch_in, ch_out, stride, name=None, fused=False):
     """1x1 -> 3x3 -> 1x1(x4) with identity/projection shortcut
     (reference: resnet.py bottleneck_block)."""
-    short = shortcut(input, ch_in, ch_out * 4, stride, name=name)
+    short = shortcut(input, ch_in, ch_out * 4, stride, name=name,
+                     fused=fused)
     conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, activation.Relu(),
-                          name=f"{name}_a" if name else None)
+                          name=f"{name}_a" if name else None, fused=fused)
     conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, activation.Relu(),
-                          name=f"{name}_b" if name else None)
+                          name=f"{name}_b" if name else None, fused=fused)
     conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, None,
-                          name=f"{name}_c" if name else None)
+                          name=f"{name}_c" if name else None, fused=fused)
     return layer.addto([conv3, short], act=activation.Relu(),
                        name=f"{name}_add" if name else None)
 
 
-def basic_block(input, ch_in, ch_out, stride, name=None):
-    short = shortcut(input, ch_in, ch_out, stride, name=name)
+def basic_block(input, ch_in, ch_out, stride, name=None, fused=False):
+    short = shortcut(input, ch_in, ch_out, stride, name=name, fused=fused)
     conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, activation.Relu(),
-                          name=f"{name}_a" if name else None)
+                          name=f"{name}_a" if name else None, fused=fused)
     conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, None,
-                          name=f"{name}_b" if name else None)
+                          name=f"{name}_b" if name else None, fused=fused)
     return layer.addto([conv2, short], act=activation.Relu(),
                        name=f"{name}_add" if name else None)
 
@@ -61,11 +77,14 @@ _DEPTH_CFG = {
 
 
 def resnet_imagenet(input, depth=50, class_num=1000, img_size=224,
-                    stem_space_to_depth=False):
+                    stem_space_to_depth=False, fused_bn=False):
     """(reference: resnet.py:6 — 3x224x224, 1000 classes).
     stem_space_to_depth: compute the 7x7/s2 stem as a stride-1 conv over
     space-to-depth input (numerically identical; lane-utilisation lever,
-    see layer.space_to_depth_conv)."""
+    see layer.space_to_depth_conv).
+    fused_bn: streaming-BN convs — the conv kernel emits batch stats from
+    its epilogue (ops/pallas/conv_bn.py), cutting one full activation
+    read per BN'd conv (the stem keeps the unfused path)."""
     kind, counts = _DEPTH_CFG[depth]
     block = bottleneck_block if kind == "bottleneck" else basic_block
     expansion = 4 if kind == "bottleneck" else 1
@@ -88,7 +107,7 @@ def resnet_imagenet(input, depth=50, class_num=1000, img_size=224,
         for i in range(n):
             stride = 2 if (i == 0 and stage > 0) else 1
             tmp = block(tmp, ch_in, ch_out, stride,
-                        name=f"res{stage+2}_{i}")
+                        name=f"res{stage+2}_{i}", fused=fused_bn)
             ch_in = ch_out * expansion
     pool = layer.img_pool(tmp, pool_size=7, stride=1,
                           pool_type=pooling.Avg(), name="res_gap")

@@ -374,11 +374,16 @@ def flash_attention(q, k, v, *, causal: bool = True,
     if interpret is None and not on_tpu:
         out = _reference(qr, kr, vr, sm_scale, causal)
     else:
-        # shape-keyed selection (measured table + VMEM-fit validation);
-        # explicit block args override for tuning sweeps
-        bq_auto, bk_auto = select_block_sizes(t, d, q.dtype)
-        bq = min(block_q, t) if block_q else bq_auto
-        bk = min(block_k, t) if block_k else bk_auto
+        # shape-keyed selection (measured table + VMEM-fit validation)
+        # only when the caller didn't pin blocks — explicit args must
+        # keep working on shapes the analytic model would reject
+        # (tuning sweeps, CPU interpret runs)
+        if block_q and block_k:
+            bq, bk = min(block_q, t), min(block_k, t)
+        else:
+            bq_auto, bk_auto = select_block_sizes(t, d, q.dtype)
+            bq = min(block_q, t) if block_q else bq_auto
+            bk = min(block_k, t) if block_k else bk_auto
         out = _flash(qr, kr, vr, sm_scale, causal, bq, bk,
                      bool(interpret))
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

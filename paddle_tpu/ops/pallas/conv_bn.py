@@ -1,0 +1,285 @@
+"""Streaming conv + batch-norm statistics — Pallas kernels.
+
+The ResNet-50 train step is HBM-bandwidth-bound (BENCHMARKS.md roofline:
+~99% of peak, 74.9 GB/step); the reducible traffic is whole-activation
+passes. Standard BN reads the conv output once just to reduce per-channel
+Σy and Σy² before the normalize pass re-reads it. These kernels emit the
+statistics from the convolution's OWN epilogue — the fp32 accumulator tile
+is reduced in-register before it is cast and written — eliminating the
+stats pass over every BN'd activation (capability slot of the reference's
+fused CudnnBatchNormLayer, paddle/gserver/layers/CudnnBatchNormLayer.cpp;
+hand-fused conv epilogues, paddle/cuda/src/hl_cuda_cnn.cu).
+
+Two kernels cover ResNet's conv menu:
+- ``matmul_bn_stats`` — 1×1 convs (any stride, via pre-slice) as a GEMM
+  over [M, C] with a per-channel Σ/Σ² epilogue. In bottleneck ResNet the
+  1×1 convs carry 2 of every 3 BN'd activations.
+- ``conv3x3_bn_stats`` — 3×3 stride-1 SAME convs as 9 shifted GEMMs
+  accumulated in VMEM (whole padded image resident per batch element),
+  same epilogue.
+Everything else (the 7×7/s2d stem) falls back to XLA conv + jnp reduce.
+
+``conv_bn_train`` is the fused train-mode op with a closed-form VJP: the
+cotangent w.r.t. the conv output is exactly the batch-norm dx formula
+(two passes over dy/y), after which the conv backward itself is delegated
+to XLA's conv VJP (its MXU conv backward is already optimal — the win
+here is forward-traffic only).
+"""
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+
+def _on_tpu():
+    return jax.devices()[0].platform == "tpu"
+
+
+# tests monkeypatch this to drive the Pallas kernels in interpret mode
+# through the full layer/model stack on CPU
+FORCE_INTERPRET = False
+
+
+# ---------------------------------------------------------------------------
+# GEMM + stats (1x1 convs)
+# ---------------------------------------------------------------------------
+
+def _mm_stats_kernel(x_ref, w_ref, y_ref, stats_ref, *, bm, bk, m_total):
+    mi = pl.program_id(0)
+    ki = pl.program_id(1)
+
+    @pl.when((mi == 0) & (ki == 0))
+    def _init():
+        stats_ref[...] = jnp.zeros_like(stats_ref)
+
+    x = x_ref[...].astype(jnp.float32)              # [bm, C]
+    w = w_ref[...].astype(jnp.float32)              # [C, bk]
+    acc = x @ w                                     # fp32 on the MXU
+    y_ref[...] = acc.astype(y_ref.dtype)
+    # epilogue: per-channel sums of the UNROUNDED accumulator; padded
+    # rows (beyond m_total) are masked out of the statistics
+    rows = mi * bm + lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+    valid = (rows < m_total).astype(jnp.float32)
+    accv = acc * valid
+    stats_ref[0, pl.ds(ki * bk, bk)] += jnp.sum(accv, axis=0)
+    stats_ref[1, pl.ds(ki * bk, bk)] += jnp.sum(accv * acc, axis=0)
+
+
+def matmul_bn_stats(x2: jax.Array, w2: jax.Array, *, out_dtype=None,
+                    block_m: int = 256, block_k: int = 128,
+                    interpret: bool = False):
+    """y = x2 @ w2 with per-output-channel (Σy, Σy²) from the epilogue.
+
+    x2: [M, C]; w2: [C, K] → (y [M, K], sum [K], sumsq [K]); sums are over
+    the fp32 accumulator (pre-cast), masked to the true M rows."""
+    m, c = x2.shape
+    k = w2.shape[1]
+    out_dtype = out_dtype or x2.dtype
+    bm = min(block_m, max(8, m))
+    bk = min(block_k, k)
+    mp = -(-m // bm) * bm
+    kp = -(-k // bk) * bk
+    if mp != m:
+        x2 = jnp.pad(x2, ((0, mp - m), (0, 0)))
+    if kp != k:
+        w2 = jnp.pad(w2, ((0, 0), (0, kp - k)))
+    grid = (mp // bm, kp // bk)
+    kernel = functools.partial(_mm_stats_kernel, bm=bm, bk=bk, m_total=m)
+    y, stats = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, c), lambda mi, ki: (mi, 0)),
+            pl.BlockSpec((c, bk), lambda mi, ki: (0, ki)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bk), lambda mi, ki: (mi, ki)),
+            # whole-array stats block: revisited by every grid step, so
+            # the += accumulation is safe on the sequential TPU grid
+            pl.BlockSpec((2, kp), lambda mi, ki: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, kp), out_dtype),
+            jax.ShapeDtypeStruct((2, kp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, w2)
+    return y[:m, :k], stats[0, :k], stats[1, :k]
+
+
+# ---------------------------------------------------------------------------
+# 3x3 stride-1 SAME conv + stats
+# ---------------------------------------------------------------------------
+
+def _conv3_stats_kernel(x_ref, w_ref, y_ref, stats_ref, *, bh, wdim, kdim):
+    ni = pl.program_id(0)
+    hi = pl.program_id(1)
+
+    @pl.when((ni == 0) & (hi == 0))
+    def _init():
+        stats_ref[...] = jnp.zeros_like(stats_ref)
+
+    h0 = hi * bh
+    acc = jnp.zeros((bh * wdim, kdim), jnp.float32)
+    for dy in range(3):
+        for dx in range(3):
+            xs = x_ref[0, pl.ds(h0 + dy, bh), pl.ds(dx, wdim), :]
+            xs = xs.reshape(bh * wdim, xs.shape[-1]).astype(jnp.float32)
+            acc += xs @ w_ref[dy, dx].astype(jnp.float32)
+    y_ref[0] = acc.reshape(bh, wdim, kdim).astype(y_ref.dtype)
+    stats_ref[0] += jnp.sum(acc, axis=0)
+    stats_ref[1] += jnp.sum(acc * acc, axis=0)
+
+
+def conv3x3_bn_stats(x: jax.Array, w: jax.Array, *, out_dtype=None,
+                     block_h: Optional[int] = None,
+                     interpret: bool = False):
+    """3×3 stride-1 SAME conv with the stats epilogue.
+
+    x: [N, H, W, C]; w: [3, 3, C, K] → (y [N, H, W, K], sum [K],
+    sumsq [K]). The whole zero-padded image of one batch element is VMEM-
+    resident per grid step (ResNet's 3×3 shapes top out at ~0.5 MB)."""
+    n, h, wd, c = x.shape
+    k = w.shape[-1]
+    out_dtype = out_dtype or x.dtype
+    if block_h is None:
+        # largest divisor of H keeping the accumulator tile under ~1 MiB
+        budget = (1 << 20) // max(1, wd * k * 4)
+        block_h = max(d for d in range(1, h + 1)
+                      if h % d == 0 and d <= max(1, budget))
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    grid = (n, h // block_h)
+    kernel = functools.partial(_conv3_stats_kernel, bh=block_h, wdim=wd,
+                               kdim=k)
+    y, stats = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, h + 2, wd + 2, c), lambda ni, hi: (ni, 0, 0, 0)),
+            pl.BlockSpec((3, 3, c, k), lambda ni, hi: (0, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_h, wd, k), lambda ni, hi: (ni, hi, 0, 0)),
+            pl.BlockSpec((2, k), lambda ni, hi: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h, wd, k), out_dtype),
+            jax.ShapeDtypeStruct((2, k), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xp, w)
+    return y, stats[0], stats[1]
+
+
+# ---------------------------------------------------------------------------
+# dispatch + fused train op
+# ---------------------------------------------------------------------------
+
+def conv_bn_stats(x, w, *, stride=1, padding="SAME",
+                  interpret: Optional[bool] = None):
+    """(conv(x, w), Σy, Σy²) with the stats from the conv epilogue when a
+    streaming kernel covers the shape; XLA conv + jnp reduce otherwise.
+    Returns (y, sum, sumsq) — sums per output channel over N·H·W."""
+    from paddle_tpu.ops import conv as ops_conv
+
+    kh, kw = w.shape[0], w.shape[1]
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    if interpret is None and FORCE_INTERPRET:
+        interpret = True
+    use_kernel = interpret if interpret is not None else _on_tpu()
+    same = padding == "SAME" or padding == ((1, 1), (1, 1)) or padding == 1
+    if use_kernel and kh == 1 and kw == 1:
+        xs = x[:, ::s[0], ::s[1], :]
+        n, ho, wo, c = xs.shape
+        y2, s1, s2 = matmul_bn_stats(
+            xs.reshape(n * ho * wo, c), w.reshape(c, -1),
+            interpret=bool(interpret))
+        return y2.reshape(n, ho, wo, -1), s1, s2
+    if use_kernel and kh == 3 and kw == 3 and s == (1, 1) and same:
+        return conv3x3_bn_stats(x, w, interpret=bool(interpret))
+    y = ops_conv.conv2d(x, w, stride=stride, padding=padding)
+    yf = y.astype(jnp.float32)
+    axes = tuple(range(y.ndim - 1))
+    return y, jnp.sum(yf, axis=axes), jnp.sum(yf * yf, axis=axes)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _conv_bn(x, w, gamma, beta, stride, padding, eps, interpret):
+    return _conv_bn_fwd(x, w, gamma, beta, stride, padding, eps,
+                        interpret)[0]
+
+
+def _conv_bn_fwd(x, w, gamma, beta, stride, padding, eps, interpret):
+    y, s1, s2 = conv_bn_stats(x, w, stride=stride, padding=padding,
+                              interpret=interpret)
+    count = y.size // y.shape[-1]
+    mean = s1 / count
+    var = jnp.maximum(s2 / count - jnp.square(mean), 0.0)
+    inv = lax.rsqrt(var + eps)
+    g32 = gamma.astype(jnp.float32)
+    scale = (g32 * inv).astype(y.dtype)
+    shift = (beta.astype(jnp.float32) - mean * g32 * inv).astype(y.dtype)
+    out = y * scale + shift
+    # mean/var feed running stats only — gradient-stopped by construction
+    # (the VJP ignores their cotangents)
+    return ((out, lax.stop_gradient(mean), lax.stop_gradient(var)),
+            (x, w, y, mean, inv, gamma))
+
+
+def _conv_bn_bwd(stride, padding, eps, interpret, res, cts):
+    from paddle_tpu.ops import conv as ops_conv
+
+    x, w, y, mean, inv, gamma = res
+    dout = cts[0].astype(jnp.float32)
+    n = y.size // y.shape[-1]
+    axes = tuple(range(y.ndim - 1))
+    # the cotangent w.r.t. the conv output is EXACTLY the batch-norm dx
+    # identity (ops/norm.py _bn_apply_bwd with x := y): two passes —
+    # one fused reduction (Σdy, Σdy·ŷ), one elementwise
+    sum_dy = jnp.sum(dout, axis=axes)
+    yhat = (y.astype(jnp.float32) - mean) * inv
+    sum_dy_yhat = jnp.sum(dout * yhat, axis=axes)
+    sc = gamma.astype(jnp.float32) * inv / n
+    g = (sc * (n * dout - sum_dy - yhat * sum_dy_yhat)).astype(y.dtype)
+    # delegate the conv backward to XLA's conv VJP (MXU-optimal already)
+    _, conv_vjp = jax.vjp(
+        lambda x_, w_: ops_conv.conv2d(x_, w_, stride=stride,
+                                       padding=padding), x, w)
+    dx, dw = conv_vjp(g)
+    return (dx, dw, sum_dy_yhat.astype(gamma.dtype),
+            sum_dy.astype(gamma.dtype))
+
+
+_conv_bn.defvjp(_conv_bn_fwd, _conv_bn_bwd)
+
+
+def conv_bn_train(x, w, gamma, beta, running_mean, running_var, *,
+                  stride=1, padding="SAME", momentum=0.9, eps=1e-5,
+                  interpret: Optional[bool] = None
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused conv→BN training step: one kernel produces the conv output
+    AND its batch statistics, the normalize is a per-channel affine, and
+    the backward is the closed-form two-pass BN VJP + XLA's conv VJP.
+    Returns (out, new_running_mean, new_running_var)."""
+    out, mean, var = _conv_bn(x, w, gamma, beta, stride, padding, eps,
+                              interpret)
+    new_mean = momentum * running_mean + (1 - momentum) * mean
+    new_var = momentum * running_var + (1 - momentum) * var
+    return (out, new_mean.astype(running_mean.dtype),
+            new_var.astype(running_var.dtype))
+
+
+def conv_bn_infer(x, w, gamma, beta, running_mean, running_var, *,
+                  stride=1, padding="SAME", eps=1e-5):
+    """Inference path: plain conv + folded-affine BN (no stats needed)."""
+    from paddle_tpu.ops import conv as ops_conv
+    from paddle_tpu.ops import norm as ops_norm
+
+    y = ops_conv.conv2d(x, w, stride=stride, padding=padding)
+    return ops_norm.batch_norm_infer(y, gamma, beta, running_mean,
+                                     running_var, eps=eps)

@@ -217,11 +217,14 @@ def ring_flash_attention(q, k, v, *, axis_name: str, causal: bool = False,
 
     Tl, D = q.shape[1], q.shape[3]
     scale = scale or (1.0 / math.sqrt(D))
-    # block selection keyed on the LOCAL shard length (each ring step runs
-    # the kernel on [Tl, D] tiles)
-    bq_auto, bk_auto = select_block_sizes(Tl, D, q.dtype)
-    bq = min(block_q, Tl) if block_q else bq_auto
-    bk = min(block_k, Tl) if block_k else bk_auto
+    if block_q and block_k:
+        bq, bk = min(block_q, Tl), min(block_k, Tl)
+    else:
+        # block selection keyed on the LOCAL shard length (each ring step
+        # runs the kernel on [Tl, D] tiles)
+        bq_auto, bk_auto = select_block_sizes(Tl, D, q.dtype)
+        bq = min(block_q, Tl) if block_q else bq_auto
+        bk = min(block_k, Tl) if block_k else bk_auto
     return _ring_flash(q, k, v, axis_name, causal, scale, bq, bk, interpret)
 
 

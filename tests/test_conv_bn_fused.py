@@ -1,0 +1,268 @@
+"""Streaming conv+BN-stats kernels (interpret mode on CPU; the same code
+path drives Mosaic on TPU) vs the unfused conv2d + batch_norm_train
+composition."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import conv as ops_conv
+from paddle_tpu.ops import norm as ops_norm
+from paddle_tpu.ops.pallas import conv_bn as fused
+
+
+class TestStatsKernels:
+    def test_matmul_stats_ragged_shapes(self, rng):
+        """M, K not multiples of the blocks: padded rows/cols must not
+        leak into y or the statistics."""
+        m, c, k = 70, 24, 40          # bm=256->padded, bk=128->padded
+        x = jnp.asarray(rng.randn(m, c).astype(np.float32))
+        w = jnp.asarray(rng.randn(c, k).astype(np.float32))
+        y, s1, s2 = fused.matmul_bn_stats(x, w, interpret=True)
+        want = np.asarray(x) @ np.asarray(w)
+        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(s1), want.sum(0), rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(s2), (want ** 2).sum(0),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_conv3x3_stats_matches_lax(self, rng):
+        n, h, w_, c, k = 2, 8, 8, 16, 32
+        x = jnp.asarray(rng.randn(n, h, w_, c).astype(np.float32))
+        w = jnp.asarray(rng.randn(3, 3, c, k).astype(np.float32) * 0.1)
+        y, s1, s2 = fused.conv3x3_bn_stats(x, w, interpret=True)
+        want = np.asarray(ops_conv.conv2d(x, w, stride=1, padding="SAME"))
+        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(s1), want.sum((0, 1, 2)),
+                                   rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(s2),
+                                   (want ** 2).sum((0, 1, 2)),
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_conv1x1_stride2_dispatch(self, rng):
+        x = jnp.asarray(rng.randn(2, 8, 8, 6).astype(np.float32))
+        w = jnp.asarray(rng.randn(1, 1, 6, 10).astype(np.float32))
+        y, s1, s2 = fused.conv_bn_stats(x, w, stride=2, padding="SAME",
+                                        interpret=True)
+        want = np.asarray(ops_conv.conv2d(x, w, stride=2, padding="SAME"))
+        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(s1), want.sum((0, 1, 2)),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestFusedConvBN:
+    def _compose_ref(self, x, w, gamma, beta, rm, rv, stride):
+        y = ops_conv.conv2d(x, w, stride=stride, padding="SAME")
+        return ops_norm.batch_norm_train(y, gamma, beta, rm, rv,
+                                        momentum=0.9, eps=1e-5)
+
+    @pytest.mark.parametrize("ksize,stride", [(1, 1), (1, 2), (3, 1)])
+    def test_forward_matches_composition(self, rng, ksize, stride):
+        n, h, w_, c, k = 2, 8, 8, 8, 16
+        x = jnp.asarray(rng.randn(n, h, w_, c).astype(np.float32))
+        w = jnp.asarray(
+            rng.randn(ksize, ksize, c, k).astype(np.float32) * 0.2)
+        gamma = jnp.asarray(rng.rand(k).astype(np.float32) + 0.5)
+        beta = jnp.asarray(rng.randn(k).astype(np.float32) * 0.1)
+        rm = jnp.zeros((k,), jnp.float32)
+        rv = jnp.ones((k,), jnp.float32)
+        out, nm, nv = fused.conv_bn_train(
+            x, w, gamma, beta, rm, rv, stride=stride, momentum=0.9,
+            eps=1e-5, interpret=True)
+        ref, rnm, rnv = self._compose_ref(x, w, gamma, beta, rm, rv,
+                                          stride)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(nm), np.asarray(rnm),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(nv), np.asarray(rnv),
+                                   rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("ksize,stride", [(1, 1), (3, 1)])
+    def test_grads_match_composition(self, rng, ksize, stride):
+        n, h, w_, c, k = 2, 6, 6, 4, 8
+        x = rng.randn(n, h, w_, c).astype(np.float32)
+        w = rng.randn(ksize, ksize, c, k).astype(np.float32) * 0.2
+        gamma = rng.rand(k).astype(np.float32) + 0.5
+        beta = rng.randn(k).astype(np.float32) * 0.1
+        rm = jnp.zeros((k,), jnp.float32)
+        rv = jnp.ones((k,), jnp.float32)
+        tgt = rng.randn(n, h // stride, w_ // stride, k).astype(np.float32)
+
+        def loss_fused(x_, w_, g_, b_):
+            out, _, _ = fused.conv_bn_train(
+                jnp.asarray(x_), jnp.asarray(w_), jnp.asarray(g_),
+                jnp.asarray(b_), rm, rv, stride=stride, interpret=True)
+            return jnp.mean((out - tgt) ** 2)
+
+        def loss_ref(x_, w_, g_, b_):
+            out, _, _ = self._compose_ref(
+                jnp.asarray(x_), jnp.asarray(w_), jnp.asarray(g_),
+                jnp.asarray(b_), rm, rv, stride)
+            return jnp.mean((out - tgt) ** 2)
+
+        gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, w, gamma, beta)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, w, gamma, beta)
+        for name, a, b in zip("xwgb", gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4,
+                                       err_msg=f"d{name}")
+
+    def test_infer_path_matches_bn_infer(self, rng):
+        n, h, w_, c, k = 2, 6, 6, 4, 8
+        x = jnp.asarray(rng.randn(n, h, w_, c).astype(np.float32))
+        w = jnp.asarray(rng.randn(1, 1, c, k).astype(np.float32))
+        gamma = jnp.ones((k,), jnp.float32)
+        beta = jnp.zeros((k,), jnp.float32)
+        rm = jnp.asarray(rng.randn(k).astype(np.float32) * 0.1)
+        rv = jnp.asarray(rng.rand(k).astype(np.float32) + 0.5)
+        got = fused.conv_bn_infer(x, w, gamma, beta, rm, rv)
+        y = ops_conv.conv2d(x, w, stride=1, padding="SAME")
+        want = ops_norm.batch_norm_infer(y, gamma, beta, rm, rv)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestFusedLayerAndModel:
+    def test_layer_matches_unfused_composition(self, rng):
+        """layer.img_conv_bn with weights copied from an img_conv +
+        batch_norm pair must produce identical training outputs."""
+        import paddle_tpu as paddle
+        from paddle_tpu import layer
+        from paddle_tpu.topology import Topology, Value
+        from paddle_tpu.utils.rng import KeySource
+        dt = paddle.data_type
+
+        def build(fused):
+            x = layer.data("x", dt.dense_vector(8 * 8 * 6))
+            if fused:
+                out = layer.img_conv_bn(x, 3, 12, num_channels=6,
+                                        stride=1, padding="SAME",
+                                        act=paddle.activation.Relu(),
+                                        name="f", img_size=8)
+            else:
+                c = layer.img_conv(x, 3, 12, num_channels=6, stride=1,
+                                   padding=1, act=None, bias_attr=False,
+                                   name="c", img_size=8)
+                out = layer.batch_norm(c, act=paddle.activation.Relu(),
+                                       name="b")
+            topo = Topology(out)
+            params = paddle.parameters.create(out, KeySource(3))
+            return out.name, topo.compile(), params
+
+        fname, ffwd, fparams = build(True)
+        uname, ufwd, uparams = build(False)
+        # identical weights across the two graphs
+        fparams.values["f.w"] = uparams.values["c.w"]
+        fparams.values["f.gamma"] = uparams.values["b.gamma"]
+        fparams.values["f.beta"] = uparams.values["b.beta"]
+        xv = rng.randn(4, 8 * 8 * 6).astype(np.float32)
+        fo, fstate = ffwd(fparams.values, fparams.state,
+                          {"x": Value(jnp.asarray(xv))}, is_training=True)
+        uo, ustate = ufwd(uparams.values, uparams.state,
+                          {"x": Value(jnp.asarray(xv))}, is_training=True)
+        np.testing.assert_allclose(np.asarray(fo[fname].array),
+                                   np.asarray(uo[uname].array),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(fstate["f.mean"]),
+                                   np.asarray(ustate["b.mean"]),
+                                   rtol=1e-4, atol=1e-5)
+        # inference path consistent too
+        fo2, _ = ffwd(fparams.values, fstate, {"x": Value(jnp.asarray(xv))},
+                      is_training=False)
+        uo2, _ = ufwd(uparams.values, ustate, {"x": Value(jnp.asarray(xv))},
+                      is_training=False)
+        np.testing.assert_allclose(np.asarray(fo2[fname].array),
+                                   np.asarray(uo2[uname].array),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_fused_resnet_trains_through_kernels(self, rng, monkeypatch):
+        """resnet_cifar10 basic blocks with fused_bn, kernels forced to
+        interpret mode — the full model trains through the Pallas path."""
+        import paddle_tpu as paddle
+        from paddle_tpu import layer
+        from paddle_tpu.models import resnet
+        from paddle_tpu.topology import Topology, Value
+        from paddle_tpu.utils.rng import KeySource
+        monkeypatch.setattr(fused, "FORCE_INTERPRET", True)
+        dt = paddle.data_type
+
+        x = layer.data("img", dt.dense_vector(3 * 8 * 8))
+        lbl = layer.data("lbl", dt.integer_value(4))
+        c1 = resnet.conv_bn_layer(x, 8, 3, 1, 1, None, ch_in=3,
+                                  name="t_c1", fused=True)
+        b1 = resnet.basic_block(c1, 8, 8, 1, name="t_b1", fused=True)
+        pool = layer.img_pool(b1, pool_size=8, stride=1,
+                              pool_type=paddle.pooling.Avg())
+        sm = layer.fc(pool, 4, act=paddle.activation.Softmax(), name="sm")
+        cost = layer.classification_cost(sm, lbl, name="cost")
+        topo = Topology(cost)
+        params = paddle.parameters.create(cost, KeySource(0))
+        fwd = topo.compile()
+        opt = paddle.optimizer.Momentum(momentum=0.9, learning_rate=0.05)
+        o = opt.init_state(params.values)
+        xv = jnp.asarray(rng.randn(16, 3 * 8 * 8).astype(np.float32))
+        yv = jnp.asarray(rng.randint(0, 4, 16).astype(np.int32))
+
+        def step(p, o, s):
+            def loss_fn(p):
+                outs, ns = fwd(p, s, {"img": Value(xv), "lbl": Value(yv)},
+                               is_training=True)
+                return jnp.mean(outs["cost"].array.astype(jnp.float32)), ns
+            (l, ns), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
+            np_, no_ = opt.update(jnp.asarray(0, jnp.int32), g, p, o)
+            return l, np_, no_, ns
+
+        p, s = params.values, params.state
+        losses = []
+        for _ in range(8):
+            l, p, o, s = step(p, o, s)
+            losses.append(float(l))
+        assert losses[-1] < losses[0], losses
+        assert np.isfinite(losses).all()
+
+
+class TestFusedUnfusedInterchange:
+    """Checkpoint compatibility + stride-2 numerics: the fused and
+    unfused conv_bn_layer paths share parameter NAMES and must agree
+    numerically for every ResNet conv shape, including the stride-2
+    3x3 basic-block transition (asymmetric-SAME regression: the fused
+    path must use the same explicit padding as the unfused one)."""
+
+    @pytest.mark.parametrize("ksize,stride,pad", [(3, 2, 1), (3, 1, 1),
+                                                  (1, 2, 0), (7, 2, 3)])
+    def test_paths_share_names_and_numerics(self, rng, ksize, stride, pad):
+        import paddle_tpu as paddle
+        from paddle_tpu import layer
+        from paddle_tpu.models import resnet
+        from paddle_tpu.topology import Topology, Value
+        from paddle_tpu.utils.rng import KeySource
+        dt = paddle.data_type
+
+        def build(fused_flag):
+            x = layer.data("x", dt.dense_vector(8 * 8 * 6))
+            out = resnet.conv_bn_layer(
+                x, 12, ksize, stride, pad, paddle.activation.Relu(),
+                ch_in=6, name="cb", fused=fused_flag)
+            topo = Topology(out)
+            params = paddle.parameters.create(out, KeySource(11))
+            return out.name, topo.compile(), params
+
+        fname, ffwd, fparams = build(True)
+        uname, ufwd, uparams = build(False)
+        # identical NAMES -> values carry over verbatim (checkpoint
+        # interchange between the two paths)
+        assert set(fparams.values) == set(uparams.values)
+        assert set(fparams.state) == set(uparams.state)
+        xv = rng.randn(3, 8 * 8 * 6).astype(np.float32)
+        fo, _ = ffwd(uparams.values, uparams.state,
+                     {"x": Value(jnp.asarray(xv))}, is_training=True)
+        uo, _ = ufwd(uparams.values, uparams.state,
+                     {"x": Value(jnp.asarray(xv))}, is_training=True)
+        np.testing.assert_allclose(np.asarray(fo[fname].array),
+                                   np.asarray(uo[uname].array),
+                                   rtol=2e-4, atol=2e-4)

@@ -139,11 +139,14 @@ class TestBlockSelection:
             assert fa._vmem_working_set(tp, 64, bq, bk, 2) <= fa.VMEM_BYTES
 
     def test_long_seq_fp32_prefers_fit(self):
-        """seq 16k fp32 D=128: whole-K/V residency forces a fitting
-        choice, not a crash."""
-        bq, bk = fa.select_block_sizes(16384, 64, jnp.bfloat16)
-        tp = fa._pad_to_blocks(16384, bq, bk)
-        assert fa._vmem_working_set(tp, 64, bq, bk, 2) <= fa.VMEM_BYTES
+        """seq 16k, D=64: whole-K/V residency must still yield a fitting
+        choice in BOTH dtypes, not a crash (fp32 is the stressful one:
+        K/V alone are 2·16k·64·4 = 8 MiB)."""
+        for dtype, isz in ((jnp.bfloat16, 2), (jnp.float32, 4)):
+            bq, bk = fa.select_block_sizes(16384, 64, dtype)
+            tp = fa._pad_to_blocks(16384, bq, bk)
+            assert fa._vmem_working_set(tp, 64, bq, bk,
+                                        isz) <= fa.VMEM_BYTES, dtype
 
     def test_unfittable_raises_actionable(self):
         with pytest.raises(ValueError, match="ring_attention"):
