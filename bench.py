@@ -53,10 +53,13 @@ RUNS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # read once; build_train_step and every emitted record use this same value
 STEM_S2D = os.environ.get("BENCH_S2D", "1") == "1"
 # streaming-BN convs (Pallas conv emits batch stats from its epilogue).
-# Default OFF until an on-chip session validates lowering + wins
-# (benchmarks/on_chip_queue.sh flips it for the measured comparison);
-# interpret-mode tests cannot catch Mosaic lowering violations.
-FUSED_BN = os.environ.get("BENCH_FUSED_BN", "0") == "1"
+# "0" = off, "1" = fused, "int8" = fused + int8 backward-activation stash
+# (benchmarks/traffic_model.py quantifies both levers). Default OFF until
+# an on-chip session validates lowering + wins (benchmarks/
+# on_chip_queue.sh runs the A/B); interpret-mode tests cannot catch
+# Mosaic lowering violations.
+_FB = os.environ.get("BENCH_FUSED_BN", "0")
+FUSED_BN = "int8" if _FB == "int8" else _FB == "1"
 
 
 def log(*a):

@@ -4,13 +4,17 @@ dump (reference: v1_api_demo/model_zoo/resnet/classify.py extracts
 activations of a chosen layer from a trained model;
 model_zoo/embedding/extract_para.py dumps an embedding matrix to text).
 
-Trains a small CIFAR ResNet for a few batches, saves it, then in the
-same process: (1) re-loads the parameters from the tar, (2) runs
-inference pruned to an INTERMEDIATE layer (feature extraction — any
-layer's output is addressable by name), (3) dumps a parameter matrix to
-a text file in the extract_para format (rows of space-separated floats).
+Loads the checked-in PRETRAINED zoo artifact (demos/model_zoo/
+pretrained/resnet_cifar8.tar.gz, held-out accuracy recorded in
+PRETRAINED.md — produced by train_pretrained.py; the reference shipped
+downloadable trained models the same way), then: (1) re-saves/reloads
+through the tar round-trip, (2) runs inference pruned to an
+INTERMEDIATE layer (feature extraction — any layer's output is
+addressable by name), (3) dumps a parameter matrix to a text file in
+the extract_para format (rows of space-separated floats).
+``--retrain`` ignores the artifact and trains from scratch instead.
 
-Run: python demos/model_zoo/extract.py [--passes N] [--out-dir DIR]
+Run: python demos/model_zoo/extract.py [--retrain] [--out-dir DIR]
 """
 
 import argparse
@@ -34,8 +38,15 @@ def build():
     return img, out, cost
 
 
+PRETRAINED = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "pretrained", "resnet_cifar8.tar.gz")
+
+
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--retrain", action="store_true",
+                    help="train from scratch instead of loading the "
+                         "checked-in pretrained artifact")
     ap.add_argument("--passes", type=int, default=1)
     ap.add_argument("--batches", type=int, default=4)
     ap.add_argument("--out-dir", default="/tmp/paddle_tpu_model_zoo")
@@ -46,15 +57,24 @@ def main():
 
     paddle.init(seed=5, platform=args.platform)
     img, out, cost = build()
-    params = paddle.parameters.create(cost)
-    trainer = paddle.trainer.SGD(
-        cost=cost, parameters=params,
-        update_equation=paddle.optimizer.Momentum(learning_rate=0.01,
-                                                  momentum=0.9))
-    reader = paddle.reader.firstn(paddle.dataset.cifar.train10(),
-                                  32 * args.batches)
-    trainer.train(reader=paddle.batch(reader, 32),
-                  num_passes=args.passes)
+    if not args.retrain and os.path.exists(PRETRAINED):
+        import gzip
+        import io
+        with gzip.open(PRETRAINED, "rb") as f:
+            params = paddle.parameters.Parameters.from_tar(
+                io.BytesIO(f.read()))
+        print(f"loaded pretrained zoo artifact {PRETRAINED}")
+    else:
+        params = paddle.parameters.create(cost)
+        trainer = paddle.trainer.SGD(
+            cost=cost, parameters=params,
+            update_equation=paddle.optimizer.Momentum(learning_rate=0.01,
+                                                      momentum=0.9))
+        reader = paddle.reader.firstn(paddle.dataset.cifar.train10(),
+                                      32 * args.batches)
+        trainer.train(reader=paddle.batch(reader, 32),
+                      num_passes=args.passes)
+        params = trainer.parameters
 
     model_path = os.path.join(args.out_dir, "resnet_cifar.tar")
     with open(model_path, "wb") as f:

@@ -19,13 +19,15 @@ def conv_bn_layer(input, ch_out, filter_size, stride, padding, active_type,
         # explicit integer padding (NOT "SAME": XLA pads SAME
         # asymmetrically at stride 2, which would silently change
         # stride-2 numerics vs the unfused path); param names mirror the
-        # unfused pair so checkpoints are interchangeable between paths
+        # unfused pair so checkpoints are interchangeable between paths.
+        # fused="int8" additionally stashes backward activations int8.
         return layer.img_conv_bn(
             input, filter_size=filter_size, num_filters=ch_out,
             num_channels=ch_in, stride=stride, padding=padding,
             act=active_type, name=f"{name}_fused" if name else None,
             conv_name=f"{name}_conv" if name else None,
-            bn_name=f"{name}_bn" if name else None)
+            bn_name=f"{name}_bn" if name else None,
+            save8=(fused == "int8"))
     tmp = layer.img_conv(input, filter_size=filter_size, num_filters=ch_out,
                          num_channels=ch_in, stride=stride, padding=padding,
                          act=None, bias_attr=False,

@@ -266,3 +266,44 @@ class TestFusedUnfusedInterchange:
         np.testing.assert_allclose(np.asarray(fo[fname].array),
                                    np.asarray(uo[uname].array),
                                    rtol=2e-4, atol=2e-4)
+
+
+class TestInt8Stash:
+    """save8: backward activations stashed per-channel int8 — gradients
+    must track the exact path within the ~0.4% stash rounding noise, and
+    the forward must be bit-identical (only backward READS change)."""
+
+    def test_forward_identical_grads_close(self, rng):
+        n, h, w_, c, k = 2, 6, 6, 8, 16
+        # positive-mean inputs + one constant-heavy filter make channel 0
+        # mean-dominated (|mean| >> std) — the case raw-y quantization
+        # would corrupt through the 1/std amplification; the centered
+        # stash must stay accurate here
+        x = (np.abs(rng.randn(n, h, w_, c)) + 1.0).astype(np.float32)
+        w = rng.randn(3, 3, c, k).astype(np.float32) * 0.2
+        w[:, :, :, 0] = 0.5 + rng.randn(3, 3, c) * 0.01
+        gamma = rng.rand(k).astype(np.float32) + 0.5
+        beta = rng.randn(k).astype(np.float32) * 0.1
+        rm = jnp.zeros((k,), jnp.float32)
+        rv = jnp.ones((k,), jnp.float32)
+        tgt = rng.randn(n, h, w_, k).astype(np.float32)
+
+        def run(save8):
+            def loss(x_, w_, g_, b_):
+                out, _, _ = fused.conv_bn_train(
+                    jnp.asarray(x_), jnp.asarray(w_), jnp.asarray(g_),
+                    jnp.asarray(b_), rm, rv, stride=1, interpret=True,
+                    save8=save8)
+                return jnp.mean((out - tgt) ** 2), out
+            (l, out), grads = jax.value_and_grad(
+                loss, argnums=(0, 1, 2, 3), has_aux=True)(x, w, gamma,
+                                                          beta)
+            return out, grads
+
+        out_f, g_f = run(False)
+        out_q, g_q = run(True)
+        np.testing.assert_array_equal(np.asarray(out_f), np.asarray(out_q))
+        for name, a, b in zip("xwgb", g_q, g_f):
+            denom = np.abs(np.asarray(b)).max() + 1e-8
+            rel = np.abs(np.asarray(a) - np.asarray(b)).max() / denom
+            assert rel < 0.03, (name, rel)
