@@ -58,8 +58,10 @@ STEM_S2D = os.environ.get("BENCH_S2D", "1") == "1"
 # an on-chip session validates lowering + wins (benchmarks/
 # on_chip_queue.sh runs the A/B); interpret-mode tests cannot catch
 # Mosaic lowering violations.
-_FB = os.environ.get("BENCH_FUSED_BN", "0")
-FUSED_BN = "int8" if _FB == "int8" else _FB == "1"
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "benchmarks", "configs"))
+from _synth import parse_fused_bn  # noqa: E402  (shared tri-state parse)
+FUSED_BN = parse_fused_bn()
 
 
 def log(*a):

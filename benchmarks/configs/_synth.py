@@ -32,3 +32,11 @@ def text_reader(vocab, seq_len, classes=2, n=4096, seed=0):
                    int(rng.randint(classes)))
 
     return reader
+
+
+def parse_fused_bn(default="0"):
+    """Tri-state BENCH_FUSED_BN: False | True | "int8" (shared by the
+    standalone configs and bench.py so the two can't drift)."""
+    import os
+    v = os.environ.get("BENCH_FUSED_BN", default)
+    return "int8" if v == "int8" else v == "1"

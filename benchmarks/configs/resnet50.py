@@ -5,7 +5,7 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _synth import env_int, image_reader
+from _synth import env_int, image_reader, parse_fused_bn
 
 import paddle_tpu as paddle
 from paddle_tpu import layer
@@ -17,6 +17,7 @@ img = layer.data("image", paddle.data_type.dense_vector(dim))
 lbl = layer.data("label", paddle.data_type.integer_value(1000))
 out = resnet.resnet_imagenet(
     img, depth=50, class_num=1000,
-    stem_space_to_depth=os.environ.get("BENCH_S2D", "1") == "1")
+    stem_space_to_depth=os.environ.get("BENCH_S2D", "1") == "1",
+    fused_bn=parse_fused_bn())
 cost = layer.classification_cost(out, lbl, name="cost")
 optimizer = paddle.optimizer.Momentum(momentum=0.9, learning_rate=0.01)

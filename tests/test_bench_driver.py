@@ -1,0 +1,111 @@
+"""Unit tests for the bench.py gate driver: last-verified selection,
+retry/backoff decisions, and run-artifact recording — hermetic (no
+backend touched; process-exiting paths stubbed)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench(tmp_path, monkeypatch):
+    """Fresh bench module instance with RUNS_DIR pointed at tmp."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.RUNS_DIR = str(tmp_path / "runs")
+    os.makedirs(mod.RUNS_DIR, exist_ok=True)
+    return mod
+
+
+def _write(mod, name, recs):
+    with open(os.path.join(mod.RUNS_DIR, name), "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+METRIC = "resnet50_train_images_per_sec_per_chip"
+
+
+class TestLastVerified:
+    def test_picks_best_within_session_window(self, bench):
+        _write(bench, "a.json", [{"metric": METRIC, "value": 2400.0}])
+        _write(bench, "b.json", [{"metric": METRIC, "value": 2537.3}])
+        v, ts, fname = bench.last_verified()
+        assert v == 2537.3 and fname == "b.json"
+
+    def test_skips_cpu_and_stalled_and_other_metrics(self, bench):
+        _write(bench, "a.jsonl", [
+            {"metric": METRIC, "value": 9000.0, "platform": "cpu"},
+            {"metric": METRIC, "value": 8000.0, "stalled_stage": "steps"},
+            {"metric": "other_metric", "value": 7000.0},
+            {"metric": METRIC, "value": 2000.0, "platform": "tpu"},
+        ])
+        v, _, _ = bench.last_verified()
+        assert v == 2000.0
+
+    def test_none_when_no_evidence(self, bench):
+        assert bench.last_verified() is None
+
+    def test_reads_jsonl_written_by_record_run(self, bench, monkeypatch):
+        monkeypatch.delenv("BENCH_PLATFORM", raising=False)
+        bench.record_run({"metric": METRIC, "value": 2600.0})
+        v, ts, fname = bench.last_verified()
+        assert v == 2600.0 and fname.endswith(".jsonl")
+        assert ts.startswith("20")            # ISO timestamp recorded
+
+
+class TestRetrySchedule:
+    def _run(self, bench, monkeypatch, attempt, elapsed_min):
+        """Drive retry_or_fail with stubbed exit paths; returns
+        ('retry', sleep_s) or ('fail', record)."""
+        calls = {}
+
+        def fake_emit(value, error=None, **extra):
+            calls["emit"] = (value, error, extra)
+            raise SystemExit
+
+        def fake_execv(*a):
+            calls["execv"] = True
+            raise SystemExit
+
+        slept = []
+        monkeypatch.setattr(bench, "emit", fake_emit)
+        monkeypatch.setattr(bench.os, "execv", fake_execv)
+        monkeypatch.setattr(bench.time, "sleep",
+                            lambda s: slept.append(s))
+        monkeypatch.setenv(bench.ATTEMPT_ENV, str(attempt))
+        monkeypatch.setenv(
+            bench.START_ENV,
+            repr(bench.time.time() - elapsed_min * 60))
+
+        class Dog:
+            def stage(self, *a, **k):
+                pass
+
+        with pytest.raises(SystemExit):
+            bench.retry_or_fail(Dog(), "probe hung")
+        if "execv" in calls:
+            return "retry", (slept[0] if slept else 0)
+        return "fail", calls["emit"]
+
+    def test_first_attempts_retry_with_backoff(self, bench, monkeypatch):
+        kind, sleep_s = self._run(bench, monkeypatch, attempt=1,
+                                  elapsed_min=1)
+        assert kind == "retry" and sleep_s == bench.BACKOFF[1]
+
+    def test_attempt_cap_fails(self, bench, monkeypatch):
+        kind, (value, error, extra) = self._run(
+            bench, monkeypatch, attempt=bench.MAX_ATTEMPTS, elapsed_min=5)
+        assert kind == "fail" and value == 0.0
+        assert "probe hung" in error
+
+    def test_wall_budget_exhaustion_fails(self, bench, monkeypatch):
+        kind, _ = self._run(bench, monkeypatch, attempt=2,
+                            elapsed_min=bench.WALL_BUDGET / 60 + 1)
+        assert kind == "fail"

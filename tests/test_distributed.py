@@ -337,3 +337,70 @@ class TestMasterFailover:
                 if p.poll() is None:
                     p.send_signal(signal.SIGKILL)
                 p.wait(timeout=10)
+
+
+TRANSFORMER_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    import paddle_tpu.distributed as dist
+    dist.init()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.models import transformer
+
+    # hybrid mesh: dcn axis across the 2 processes, data x seq within —
+    # a REAL model train step over the cluster (not just a psum):
+    # ring-attention CP over seq, DP over data, grads psum'd over dcn
+    mesh = dist.hybrid_mesh((2, 2), ("data", "seq"))
+    assert dict(mesh.shape) == {{"dcn": 2, "data": 2, "seq": 2}}
+
+    cfg = transformer.TransformerConfig(
+        vocab=64, d_model=16, n_heads=2, n_layers=2, d_ff=32, max_len=16,
+        dtype=jnp.float32, use_ring_attention=True)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 64, (8, 16)).astype(np.int32))
+    tgt = jnp.asarray(rng.randint(0, 64, (8, 16)).astype(np.int32))
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    data_sh = NamedSharding(mesh, P(("dcn", "data"), None))
+    toks = jax.device_put(toks, data_sh)
+    tgt = jax.device_put(tgt, data_sh)
+    params = jax.device_put(params, NamedSharding(mesh, P()))
+
+    @jax.jit
+    def train_step(p, tk, tg):
+        loss, g = jax.value_and_grad(transformer.lm_loss)(
+            p, tk, tg, cfg, mesh=mesh)
+        return loss, jax.tree_util.tree_map(lambda w, gr: w - 0.1 * gr,
+                                            p, g)
+
+    l1, params = train_step(params, toks, tgt)
+    l2, _ = train_step(params, toks, tgt)
+    assert float(l2) < float(l1), (float(l1), float(l2))
+    out_dir = os.environ["TEST_OUT_DIR"]
+    rank = jax.process_index()
+    with open(os.path.join(out_dir, f"tok_{{rank}}"), "w") as fh:
+        fh.write(f"{{float(l1):.6f}} {{float(l2):.6f}}")
+    print("transformer worker", rank, "OK", flush=True)
+""")
+
+
+@pytest.mark.slow
+class TestMultiProcessTransformer:
+    def test_two_process_transformer_train_step(self, tmp_path):
+        """A full transformer LM train step (ring-attention CP x DP)
+        spanning 2 processes x 4 virtual devices on a hybrid dcn mesh —
+        the multi-host training capability, not just a collective."""
+        from paddle_tpu.runtime import launch
+
+        worker = tmp_path / "tworker.py"
+        worker.write_text(TRANSFORMER_WORKER.format(repo=REPO))
+        rcs = launch.launch_local(
+            2, [str(worker)], devices_per_proc=4,
+            env_extra={"TEST_OUT_DIR": str(tmp_path)}, timeout=420)
+        assert rcs == [0, 0], rcs
+        # both processes observed the SAME (replicated) losses
+        bodies = {(tmp_path / f"tok_{r}").read_text() for r in range(2)}
+        assert len(bodies) == 1, bodies
