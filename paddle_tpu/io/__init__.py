@@ -6,3 +6,5 @@ from paddle_tpu.io.checkpoint import (load_checkpoint, save_checkpoint,
                                       latest_checkpoint)
 from paddle_tpu.io.merged import (save_inference_model, load_inference_model,
                                   MergedModel)
+from paddle_tpu.io.lm_serving import (save_lm_artifact, load_lm_artifact,
+                                      LMServer)

@@ -1,0 +1,184 @@
+"""LM serving artifact — the functional-transformer counterpart of
+io/merged.py (reference slot: paddle/capi + MergeModel's one-file
+deployment, and the SWIG SequenceGenerator serving surface,
+paddle/api/PaddleAPI.h:1025).
+
+One tar holds the parameter pytree, the TransformerConfig, and TWO AOT
+StableHLO modules (jax.export):
+- ``prefill``: [B, Tp] prompt → (last-position logits, KV cache)
+- ``decode``:  one incremental token step against the cache
+A loading process needs paddle_tpu for the tar/np plumbing only — no
+model code, no tracing, no recompilation on the same platform; greedy
+or temperature sampling happens host-side between compiled calls.
+"""
+
+import dataclasses
+import io as _io
+import json
+import tarfile
+from typing import Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.io.checkpoint import _flatten          # shared pytree walk
+from paddle_tpu.io.merged import _add_member as _add   # shared tar append
+
+FORMAT_VERSION = 1
+
+
+def _unflatten(flat):
+    """Rebuild the nested pytree from checkpoint-style '/'-joined paths
+    WITHOUT a template (the loader has no model code): dict nodes whose
+    keys are all '__i' were list/tuple nodes in _flatten's encoding."""
+    tree = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+
+    def fix(node):
+        if isinstance(node, dict):
+            node = {k: fix(v) for k, v in node.items()}
+            if node and all(k.startswith("__") for k in node):
+                return [node[f"__{i}"] for i in range(len(node))]
+        return node
+
+    return fix(tree)
+
+
+def _cfg_to_dict(cfg):
+    import jax.numpy as jnp
+    d = dataclasses.asdict(cfg)
+    d["dtype"] = jnp.dtype(cfg.dtype).name
+    return d
+
+
+def _cfg_from_dict(d):
+    import jax.numpy as jnp
+    from paddle_tpu.models.transformer import TransformerConfig
+    d = dict(d)
+    d["dtype"] = jnp.dtype(d["dtype"])
+    return TransformerConfig(**d)
+
+
+def save_lm_artifact(path: str, params, cfg, *, batch: int,
+                     prompt_len: int, cache_len: int,
+                     platforms: Optional[Sequence[str]] = None) -> None:
+    """Export the serving pair at fixed shapes and pack the artifact.
+
+    batch/prompt_len/cache_len fix the exported shapes (AOT modules are
+    shape-specialized; export several artifacts for several shapes).
+    ``platforms`` e.g. ["tpu", "cpu"] widens where the module may run.
+    """
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import transformer
+
+    if cache_len > cfg.max_len:
+        raise ValueError(f"cache_len {cache_len} exceeds cfg.max_len "
+                         f"{cfg.max_len}")
+
+    def prefill_fn(p, tokens):
+        return transformer.prefill(p, tokens, cfg, cache_len)
+
+    def decode_fn(p, cache, tokens, pos):
+        return transformer.decode_step(p, cache, tokens, pos, cfg)
+
+    kw = {"platforms": list(platforms)} if platforms else {}
+    p_shapes = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(
+            np.shape(a),
+            a.dtype if hasattr(a, "dtype") else np.asarray(a).dtype),
+        params)
+    toks = jax.ShapeDtypeStruct((batch, prompt_len), jnp.int32)
+    exp_prefill = jax.export.export(jax.jit(prefill_fn), **kw)(
+        p_shapes, toks)
+    cache_shapes = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+        transformer.init_cache(cfg, batch, cache_len))
+    exp_decode = jax.export.export(jax.jit(decode_fn), **kw)(
+        p_shapes, cache_shapes,
+        jax.ShapeDtypeStruct((batch,), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.int32))
+
+    meta = {"format_version": FORMAT_VERSION, "batch": batch,
+            "prompt_len": prompt_len, "cache_len": cache_len,
+            "config": _cfg_to_dict(cfg)}
+    flat = _flatten(params)
+    buf = _io.BytesIO()
+    np.savez(buf, **flat)
+    with tarfile.open(path, "w") as tar:
+        _add(tar, "meta.json", json.dumps(meta).encode())
+        _add(tar, "params.npz", buf.getvalue())
+        _add(tar, "prefill.bin", exp_prefill.serialize())
+        _add(tar, "decode.bin", exp_decode.serialize())
+
+
+class LMServer:
+    """Loaded artifact: compiled prefill + decode, host-side sampling.
+
+    ``generate(prompt, max_new)`` mirrors models/transformer.generate
+    greedy/temperature semantics but never traces or imports the model.
+    """
+
+    def __init__(self, meta, params, prefill_bin, decode_bin):
+        import jax
+        self.meta = meta
+        self.cfg = _cfg_from_dict(meta["config"])
+        self.params = params
+        self._prefill = jax.export.deserialize(prefill_bin)
+        self._decode = jax.export.deserialize(decode_bin)
+
+    def generate(self, prompt: np.ndarray, max_new: int,
+                 temperature: float = 0.0,
+                 seed: Optional[int] = None) -> np.ndarray:
+        import jax.numpy as jnp
+        if max_new < 1:
+            raise ValueError(f"generate: max_new must be >= 1, "
+                             f"got {max_new}")
+        b, tp = prompt.shape
+        if b != self.meta["batch"] or tp != self.meta["prompt_len"]:
+            raise ValueError(
+                f"artifact exported for batch={self.meta['batch']} "
+                f"prompt_len={self.meta['prompt_len']}, got {prompt.shape}")
+        if tp + max_new > self.meta["cache_len"]:
+            raise ValueError(f"{tp + max_new} positions exceed the "
+                             f"exported cache_len {self.meta['cache_len']}")
+        rng = np.random.RandomState(seed or 0)
+
+        def sample(logits):
+            if temperature <= 0:
+                return logits.argmax(-1).astype(np.int32)
+            z = np.asarray(logits, np.float64) / temperature
+            z = z - z.max(-1, keepdims=True)
+            p = np.exp(z) / np.exp(z).sum(-1, keepdims=True)
+            return np.asarray([rng.choice(p.shape[-1], p=row)
+                               for row in p], np.int32)
+
+        logits, cache = self._prefill.call(
+            self.params, jnp.asarray(prompt, jnp.int32))
+        toks = [sample(np.asarray(logits))]
+        for i in range(max_new - 1):
+            logits, cache = self._decode.call(
+                self.params, cache, jnp.asarray(toks[-1], jnp.int32),
+                jnp.asarray(tp + i, jnp.int32))
+            toks.append(sample(np.asarray(logits)))
+        return np.concatenate([prompt,
+                               np.stack(toks, axis=1)], axis=1)
+
+
+def load_lm_artifact(path: str) -> LMServer:
+    with tarfile.open(path, "r") as tar:
+        members = {m.name: tar.extractfile(m).read()
+                   for m in tar.getmembers()}
+    meta = json.loads(members["meta.json"])
+    if meta["format_version"] > FORMAT_VERSION:
+        raise ValueError(f"artifact format {meta['format_version']} newer "
+                         f"than this loader ({FORMAT_VERSION})")
+    with np.load(_io.BytesIO(members["params.npz"]),
+                 allow_pickle=False) as z:
+        params = _unflatten({k: z[k] for k in z.files})
+    return LMServer(meta, params, members["prefill.bin"],
+                    members["decode.bin"])
