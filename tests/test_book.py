@@ -230,3 +230,35 @@ class TestLearnToRank:
             opt=paddle.optimizer.Adam(learning_rate=1e-3))
         per_pass = np.asarray(costs).reshape(passes, -1).mean(axis=1)
         assert per_pass[-1] < per_pass[0], per_pass
+
+
+class TestQuickStartText:
+    def test_sparse_sequence_bow_trains(self, rng):
+        """The quick_start sparse text config (reference:
+        v1_api_demo/quick_start/trainer_config.bow.py over
+        sparse_binary_vector_sequence) — e2e through the demo's builder."""
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "qs_text", os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "demos", "quick_start", "train_text.py"))
+        qs = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(qs)
+
+        import paddle_tpu as paddle
+        _, cost = qs.build("bow")
+        params = paddle.parameters.create(cost)
+        trainer = paddle.trainer.SGD(
+            cost=cost, parameters=params,
+            update_equation=paddle.optimizer.Adam(learning_rate=2e-3))
+        word_idx = {f"w{i}": i for i in range(qs.VOCAB - 1)}
+        word_idx["<unk>"] = qs.VOCAB - 1
+        reader = qs.to_sparse_seq(paddle.dataset.imdb.train(word_idx))
+        losses = []
+        trainer.train(
+            reader=paddle.batch(paddle.reader.firstn(reader, 256), 64),
+            num_passes=2,
+            event_handler=lambda e: losses.append(e.cost)
+            if isinstance(e, paddle.event.EndIteration) else None)
+        assert losses[-1] < losses[0], (losses[0], losses[-1])
