@@ -41,7 +41,16 @@ class TransformerConfig:
                                        # learned absolute positions
     rope_theta: float = 10000.0
     use_ring_attention: bool = False   # shard_map CP over the seq axis
+    cp_mode: str = "ring"              # "ring" (K/V rotate over ICI) or
+                                       # "alltoall" (Ulysses head-scatter;
+                                       # needs seq-axis | n_heads)
     use_flash_attention: bool = False  # Pallas fused attention (TPU)
+
+    def __post_init__(self):
+        if self.cp_mode not in ("ring", "alltoall"):
+            raise ValueError(
+                f"cp_mode must be 'ring' or 'alltoall', got "
+                f"{self.cp_mode!r}")
 
     @property
     def head_dim(self):
@@ -228,11 +237,19 @@ def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head,
         # rotates the small tensors over ICI and broadcasts to the q-head
         # layout locally per step; the jnp engines group in the einsum
         if seq_sharded and cfg.use_ring_attention:
-            # flash blocks inside the ring when the batch is packed —
-            # O(T/P·D) per chip with no score tensor even per ring step
-            attn = ring.ring_attention_spmd(
-                q, k, v, mesh, causal=True, lengths=lengths,
-                use_flash=cfg.use_flash_attention and lengths is None)
+            if cfg.cp_mode == "alltoall":
+                # Ulysses layout: two all-to-alls reshuffle seq<->heads,
+                # attention runs fully local per head group
+                attn = ring.alltoall_attention_spmd(
+                    q, k, v, mesh, causal=True, lengths=lengths,
+                    use_flash=cfg.use_flash_attention and lengths is None)
+            else:
+                # flash blocks inside the ring when the batch is packed —
+                # O(T/P·D) per chip with no score tensor even per ring
+                # step
+                attn = ring.ring_attention_spmd(
+                    q, k, v, mesh, causal=True, lengths=lengths,
+                    use_flash=cfg.use_flash_attention and lengths is None)
         elif cfg.use_flash_attention and lengths is None:
             from paddle_tpu.ops.pallas import flash_attention
             attn = flash_attention(q, k, v, causal=True)

@@ -161,13 +161,8 @@ def ring_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
     qkv_spec = P(batch_axis, seq_axis, tp, None)
     len_spec = P(batch_axis)
     if use_flash and lengths is not None:
-        raise ValueError("ring flash attention supports packed equal-length "
-                         "sequences only; pass lengths=None or use the "
-                         "jnp engine (use_flash=False)")
-    if interpret is None:
-        # off-TPU the Mosaic lowering doesn't exist; interpret mode keeps
-        # the same code path (tests, CPU dryruns) at reduced speed
-        interpret = jax.devices()[0].platform != "tpu"
+        raise ValueError(_FLASH_RAGGED_MSG)
+    interpret = _default_interpret(interpret)
     fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
                            scale=scale)
 
@@ -187,6 +182,99 @@ def ring_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
     def wrapped(q_, k_, v_, len_):
         return fn(q_, k_, v_, lengths=len_)
     return shard_map(wrapped, mesh=mesh,
+                     in_specs=(qkv_spec, qkv_spec, qkv_spec, len_spec),
+                     out_specs=qkv_spec, check_vma=False)(q, k, v, lengths)
+
+
+def _default_interpret(interpret):
+    """Off-TPU the Mosaic lowering doesn't exist; interpret mode keeps
+    the same kernel code path (tests, CPU dryruns) at reduced speed."""
+    if interpret is None:
+        return jax.devices()[0].platform != "tpu"
+    return interpret
+
+
+_FLASH_RAGGED_MSG = ("flash attention in context parallelism supports "
+                     "packed equal-length sequences only; pass "
+                     "lengths=None or use the jnp engine "
+                     "(use_flash=False)")
+
+
+def alltoall_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
+                            lengths: Optional[jax.Array] = None,
+                            batch_axis: str = place.AXIS_DATA,
+                            seq_axis: str = place.AXIS_SEQ,
+                            head_axis: str = place.AXIS_MODEL,
+                            scale: Optional[float] = None,
+                            use_flash: bool = False,
+                            interpret: Optional[bool] = None):
+    """All-to-all (Ulysses-style) sequence parallelism — the other
+    context-parallel layout: instead of rotating K/V around a ring, one
+    all-to-all RESHUFFLES [B, T/P, H, D] (sequence-sharded) into
+    [B, T, H/P, D] (head-sharded), attention runs fully local per head
+    group, and a second all-to-all restores sequence sharding. Two
+    collectives total per attention vs P−1 ring hops — better when
+    H ≥ P and the interconnect favors large all-to-alls; ring wins when
+    heads are scarce or memory for the full-T K/V slice is tight.
+    Autodiff transposes the all-to-alls, so no custom VJP is needed.
+
+    q [B, T, H, D]; k/v may carry Hkv ≤ H heads (GQA) — all three are
+    head-scattered, so the seq-axis size (times any head-axis TP shard)
+    must divide BOTH H and Hkv. When the mesh carries a >1 ``head_axis``
+    that divides the head counts, heads are ALSO tensor-parallel over it
+    (as in ring_attention_spmd — each model shard scatters only its own
+    heads). ``use_flash`` runs the local attention with the Pallas flash
+    kernel (packed equal-length only); ragged ``lengths`` use the jnp
+    engine.
+    """
+    from jax import shard_map
+
+    P_ = mesh.shape[seq_axis]
+    H, Hkv = q.shape[2], k.shape[2]
+    tp_sz = (mesh.shape[head_axis]
+             if head_axis in mesh.axis_names else 1)
+    tp = (head_axis if tp_sz > 1 and H % (tp_sz * P_) == 0
+          and Hkv % (tp_sz * P_) == 0 else None)
+    denom = (tp_sz if tp else 1) * P_
+    if H % denom or Hkv % denom:
+        raise ValueError(
+            f"alltoall attention: seq axis size {P_} must divide both "
+            f"n_heads={H} and kv_heads={Hkv}; use ring attention for "
+            f"head counts that don't split")
+    if use_flash and lengths is not None:
+        raise ValueError(_FLASH_RAGGED_MSG)
+    interpret = _default_interpret(interpret)
+
+    qkv_spec = P(batch_axis, seq_axis, tp, None)
+    len_spec = P(batch_axis)
+
+    def local(q_, k_, v_, len_):
+        # [B, T/P, H, D] -> all_to_all -> [B, T, H/P, D]: split the head
+        # axis across the group, concatenate the sequence shards
+        def scatter(t):
+            return jax.lax.all_to_all(t, seq_axis, split_axis=2,
+                                      concat_axis=1, tiled=True)
+
+        def gather(t):
+            return jax.lax.all_to_all(t, seq_axis, split_axis=1,
+                                      concat_axis=2, tiled=True)
+
+        qg, kg, vg = scatter(q_), scatter(k_), scatter(v_)
+        if use_flash:
+            from paddle_tpu.ops.pallas import flash_attention
+            out = flash_attention(qg, kg, vg, causal=causal,
+                                  sm_scale=scale, interpret=interpret)
+        else:
+            out = full_attention(qg, kg, vg, causal=causal, lengths=len_,
+                                 scale=scale)
+        return gather(out)
+
+    if lengths is None:
+        return shard_map(
+            lambda a, b, c: local(a, b, c, None), mesh=mesh,
+            in_specs=(qkv_spec,) * 3, out_specs=qkv_spec,
+            check_vma=False)(q, k, v)
+    return shard_map(local, mesh=mesh,
                      in_specs=(qkv_spec, qkv_spec, qkv_spec, len_spec),
                      out_specs=qkv_spec, check_vma=False)(q, k, v, lengths)
 
