@@ -53,8 +53,9 @@ RUNS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # read once; build_train_step and every emitted record use this same value
 STEM_S2D = os.environ.get("BENCH_S2D", "1") == "1"
 # streaming-BN convs (Pallas conv emits batch stats from its epilogue).
-# "0" = off, "1" = fused, "int8" = fused + int8 backward-activation stash
-# (benchmarks/traffic_model.py quantifies both levers). Default OFF until
+# "0" off | "1" fused fwd stats | "int8" + int8 backward stash | "full"
+# + Pallas backward kernels (benchmarks/traffic_model.py quantifies every
+# lever). Default OFF until
 # an on-chip session validates lowering + wins (benchmarks/
 # on_chip_queue.sh runs the A/B); interpret-mode tests cannot catch
 # Mosaic lowering violations.

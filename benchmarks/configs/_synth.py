@@ -35,8 +35,9 @@ def text_reader(vocab, seq_len, classes=2, n=4096, seed=0):
 
 
 def parse_fused_bn(default="0"):
-    """Tri-state BENCH_FUSED_BN: False | True | "int8" (shared by the
-    standalone configs and bench.py so the two can't drift)."""
+    """BENCH_FUSED_BN modes: "0" off | "1" fused fwd stats | "int8"
+    + int8 backward stash | "full" + Pallas backward kernels (shared by
+    the standalone configs and bench.py so the two can't drift)."""
     import os
     v = os.environ.get("BENCH_FUSED_BN", default)
-    return "int8" if v == "int8" else v == "1"
+    return v if v in ("int8", "full") else v == "1"

@@ -51,6 +51,9 @@ BENCH_FUSED_BN=1 timeout 1500 python bench.py \
 BENCH_FUSED_BN=int8 timeout 1500 python bench.py \
     > "$RUNS/${STAMP}_resnet50_fusedbn_int8.json" 2>/tmp/q_int8.log \
     && cat "$RUNS/${STAMP}_resnet50_fusedbn_int8.json"
+BENCH_FUSED_BN=full timeout 1500 python bench.py \
+    > "$RUNS/${STAMP}_resnet50_fusedbn_full.json" 2>/tmp/q_full.log \
+    && cat "$RUNS/${STAMP}_resnet50_fusedbn_full.json"
 
 echo "== [3] transformer seq=8192 (flash fits, plain OOMs)"
 timeout 1800 python benchmarks/transformer_bench.py --seq 8192 --batch 2 \

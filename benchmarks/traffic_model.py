@@ -53,7 +53,8 @@ def resnet50_convs(img=224):
     return convs
 
 
-def account(batch, fused_bn=False, stash8=False, act_bytes=BF16):
+def account(batch, fused_bn=False, stash8=False, fused_bwd=False,
+            act_bytes=BF16):
     """stash8: backward-saved activations (x for dw, y's centered copy
     for the BN backward) stored int8 — their backward READS halve, at
     the cost of one extra int8 write per stash in forward."""
@@ -83,11 +84,17 @@ def account(batch, fused_bn=False, stash8=False, act_bytes=BF16):
         if stash8:
             # extra int8 writes of the two stashes
             detail["stash_io"] += x8 + y8
-        # backward BN: reduction pass reads (y-stash, dy); elementwise
-        # pass reads (y-stash, dy) writes g — the y reads ride the stash
-        detail["bn_bwd"] += 2 * y8 + 2 * y + y
-        # backward conv: dw reads (x-stash, g); dx reads g (+w), writes dx
-        detail["conv_bwd"] += (x8 + y) + (y + x)
+        if fused_bwd:
+            # g recomputed in-register inside the dx/dw kernels: no g
+            # write/read at all; each kernel reads (z-stash, dy) itself
+            detail["bn_bwd"] += y8 + y              # reduction pass only
+            detail["conv_bwd"] += (x8 + y8 + y) + (y8 + y + x)
+        else:
+            # backward BN: reduction pass reads (y-stash, dy);
+            # elementwise pass reads (y-stash, dy) writes g
+            detail["bn_bwd"] += 2 * y8 + 2 * y + y
+            # backward conv: dw reads (x-stash, g); dx reads g, writes dx
+            detail["conv_bwd"] += (x8 + y) + (y + x)
         detail["weights"] += w_elems * BF16 * 2           # fwd + bwd read
     detail["weights"] += n_params * (F32 * 3)             # grad + opt
     total = sum(detail.values())
@@ -101,7 +108,9 @@ def main():
     measured = 74.9e9                                     # BENCHMARKS.md
     scenarios = [("unfused", dict(fused_bn=False)),
                  ("fused (streaming BN)", dict(fused_bn=True)),
-                 ("fused + int8 stash", dict(fused_bn=True, stash8=True))]
+                 ("fused + int8 stash", dict(fused_bn=True, stash8=True)),
+                 ("full (+ fused backward)",
+                  dict(fused_bn=True, stash8=True, fused_bwd=True))]
     totals = {}
     for name, kw in scenarios:
         total, detail, _ = account(args.batch, **kw)

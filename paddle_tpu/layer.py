@@ -421,7 +421,8 @@ def img_conv_bn(input, filter_size, num_filters: int,
                 param_attr=None, bn_param_attr=None, bn_bias_attr=None,
                 moving_average_fraction=0.9, epsilon=1e-5, img_size=None,
                 conv_name: Optional[str] = None,
-                bn_name: Optional[str] = None, save8: bool = False):
+                bn_name: Optional[str] = None, save8: bool = False,
+                fused_bwd: bool = False):
     """Fused conv→batch-norm block (streaming-BN: the Pallas conv kernel
     emits the batch statistics from its own epilogue, removing the
     stats-reduce pass over the activation — ops/pallas/conv_bn.py; the
@@ -476,7 +477,7 @@ def img_conv_bn(input, filter_size, num_filters: int,
                 x, params[wspec.name], params[gamma.name],
                 params[beta.name], rm, rv, stride=stride, padding=padding,
                 momentum=moving_average_fraction, eps=epsilon,
-                save8=save8)
+                save8=save8, fused_bwd=fused_bwd)
             ctx.state_out[mean_s.name] = nm
             ctx.state_out[var_s.name] = nv
         else:

@@ -20,14 +20,17 @@ def conv_bn_layer(input, ch_out, filter_size, stride, padding, active_type,
         # asymmetrically at stride 2, which would silently change
         # stride-2 numerics vs the unfused path); param names mirror the
         # unfused pair so checkpoints are interchangeable between paths.
-        # fused="int8" additionally stashes backward activations int8.
+        # fused="int8" additionally stashes backward activations int8;
+        # fused="full" = int8 stash + Pallas backward kernels (the g
+        # stage recomputed in-register, no g tensor in HBM).
         return layer.img_conv_bn(
             input, filter_size=filter_size, num_filters=ch_out,
             num_channels=ch_in, stride=stride, padding=padding,
             act=active_type, name=f"{name}_fused" if name else None,
             conv_name=f"{name}_conv" if name else None,
             bn_name=f"{name}_bn" if name else None,
-            save8=(fused == "int8"))
+            save8=(fused in ("int8", "full")),
+            fused_bwd=(fused == "full"))
     tmp = layer.img_conv(input, filter_size=filter_size, num_filters=ch_out,
                          num_channels=ch_in, stride=stride, padding=padding,
                          act=None, bias_attr=False,

@@ -40,6 +40,18 @@ def _on_tpu():
     return jax.devices()[0].platform == "tpu"
 
 
+def _dispatch(stride, padding, interpret):
+    """Shared forward/backward kernel gating: normalized stride, SAME-ness
+    and whether the Pallas path runs (identical conditions both ways)."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    same = (padding == "SAME" or padding == ((1, 1), (1, 1))
+            or padding == 1)
+    if interpret is None and FORCE_INTERPRET:
+        interpret = True
+    use_kernel = interpret if interpret is not None else _on_tpu()
+    return s, same, use_kernel, interpret
+
+
 # tests monkeypatch this to drive the Pallas kernels in interpret mode
 # through the full layer/model stack on CPU
 FORCE_INTERPRET = False
@@ -177,6 +189,220 @@ def conv3x3_bn_stats(x: jax.Array, w: jax.Array, *, out_dtype=None,
 
 
 # ---------------------------------------------------------------------------
+# fused BACKWARD kernels (1x1 path): the BN-backward elementwise stage
+# g = γ·inv/n · (n·dy − A − ẑ·inv·B) is recomputed IN-REGISTER inside the
+# conv-backward GEMMs, so the g tensor is never written to or read from
+# HBM (the write + two reads the unfused backward pays). ẑ is the
+# centered conv output — exactly what save8 stashes.
+# ---------------------------------------------------------------------------
+
+# per-channel backward constants ride in ONE (8, K) block — single rows
+# like (1, K) are exactly the block shape this chip's Mosaic tiling
+# rejects (see ops/pallas/attention.py lse layout note); 8 rows match
+# the sublane tile. Row layout: 0=γ·inv/n, 1=inv·B, 2=A=Σdy, 3=z scale.
+_CHAN_ROWS = 8
+
+
+def _pack_chan(coef, inv_b, a_sum, z_scale):
+    k = coef.shape[0]
+    chan = jnp.zeros((_CHAN_ROWS, k), jnp.float32)
+    return chan.at[0].set(coef).at[1].set(inv_b).at[2].set(a_sum)                .at[3].set(z_scale)
+
+
+def _g_tile(z_raw, dy, chan, n):
+    """g for one [bm, K] tile, fp32. z_raw is the centered conv output —
+    int8 stash (dequantized in-register via chan row 3) or full-width."""
+    z = z_raw.astype(jnp.float32)
+    if z_raw.dtype == jnp.int8:
+        z = z * chan[3]
+    return chan[0] * (n * dy - chan[2] - z * chan[1])
+
+
+def _mm_bwd_dx_kernel(z_ref, dy_ref, wt_ref, chan_ref, dx_ref, *,
+                      n_total):
+    dy = dy_ref[...].astype(jnp.float32)
+    g = _g_tile(z_ref[...], dy, chan_ref[...], n_total)
+    dx_ref[...] = (g @ wt_ref[...].astype(jnp.float32)).astype(
+        dx_ref.dtype)
+
+
+def _mm_bwd_dw_kernel(x_ref, z_ref, dy_ref, chan_ref, xs_ref, dw_ref, *,
+                      n_total):
+    mi = pl.program_id(0)
+
+    @pl.when(mi == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+
+    dy = dy_ref[...].astype(jnp.float32)
+    g = _g_tile(z_ref[...], dy, chan_ref[...], n_total)
+    x = x_ref[...].astype(jnp.float32)
+    if x_ref.dtype == jnp.int8:
+        x = x * xs_ref[0]                    # in-register dequant
+    dw_ref[...] += x.T @ g
+
+
+def matmul_bn_bwd(x2, z2, dy2, w2, gamma, inv, a_sum, b_sum, *,
+                  x_scale=None, z_scale=None, out_dtype=None,
+                  block_m: int = 256, interpret: bool = False):
+    """Fused backward for the 1x1 path: given the centered conv output
+    z2 [M, K] (full-width, or the int8 stash with per-channel z_scale),
+    upstream dy2 [M, K], and the per-channel BN reduction results
+    A = Σdy, B = Σdy·ẑ (ẑ = z·inv), returns (dx [M, C], dw [C, K]) with
+    g recomputed per tile — no g tensor in HBM. x2 may likewise be the
+    int8 stash (pass x_scale); dequantization happens IN-REGISTER so
+    the kernels genuinely read 1 byte/element."""
+    m, c = x2.shape
+    k = w2.shape[1]
+    n_total = float(m)
+    out_dtype = out_dtype or (x2.dtype if x2.dtype != jnp.int8
+                              else jnp.float32)
+    coef = gamma.astype(jnp.float32) * inv / n_total
+    inv_b = inv * b_sum.astype(jnp.float32)
+    a_row = a_sum.astype(jnp.float32)
+    zs = (z_scale.astype(jnp.float32) if z_scale is not None
+          else jnp.ones((k,), jnp.float32))
+    chan = _pack_chan(coef, inv_b, a_row, zs)
+    xs_row = jnp.zeros((_CHAN_ROWS, c), jnp.float32).at[0].set(
+        x_scale.astype(jnp.float32) if x_scale is not None
+        else jnp.ones((c,), jnp.float32))
+    bm = min(block_m, max(8, m))
+    mp = -(-m // bm) * bm
+    if mp != m:
+        pad = ((0, mp - m), (0, 0))
+        x2, z2, dy2 = (jnp.pad(t, pad) for t in (x2, z2, dy2))
+        # zero-padded rows would get g = coef·(−A) ≠ 0 (the −A constant
+        # term survives); pad dy with A/n instead so g_pad ≡ 0 exactly
+        # (z_pad = 0): then padded dx rows are sliced off and padded x
+        # rows (zeros) contribute nothing to dw either way
+        fill = a_row[None, :] / n_total               # [1, K]
+        dy2 = dy2.at[m:, :].set(jnp.broadcast_to(fill, (mp - m, k)))
+    grid = (mp // bm,)
+    # dx: g @ w^T
+    dx = pl.pallas_call(
+        functools.partial(_mm_bwd_dx_kernel, n_total=n_total),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda mi: (mi, 0)),      # z
+            pl.BlockSpec((bm, k), lambda mi: (mi, 0)),      # dy
+            pl.BlockSpec((k, c), lambda mi: (0, 0)),        # w^T
+            pl.BlockSpec((_CHAN_ROWS, k), lambda mi: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, c), lambda mi: (mi, 0)),
+        out_shape=jax.ShapeDtypeStruct((mp, c), out_dtype),
+        interpret=interpret,
+    )(z2, dy2, jnp.swapaxes(w2, 0, 1), chan)
+    # dw: x^T @ g accumulated across the m grid (sequential revisits)
+    dw = pl.pallas_call(
+        functools.partial(_mm_bwd_dw_kernel, n_total=n_total),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, c), lambda mi: (mi, 0)),      # x
+            pl.BlockSpec((bm, k), lambda mi: (mi, 0)),      # z
+            pl.BlockSpec((bm, k), lambda mi: (mi, 0)),      # dy
+            pl.BlockSpec((_CHAN_ROWS, k), lambda mi: (0, 0)),
+            pl.BlockSpec((_CHAN_ROWS, c), lambda mi: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((c, k), lambda mi: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((c, k), jnp.float32),
+        interpret=interpret,
+    )(x2, z2, dy2, chan, xs_row)
+    return dx[:m], dw
+
+
+def _conv3_bwd_dx_kernel(z_ref, dy_ref, wr_ref, chan_ref, dx_ref, *,
+                         n_total):
+    dy = dy_ref[0].astype(jnp.float32)               # [H, W, K]
+    g = _g_tile(z_ref[0], dy, chan_ref[...], n_total)
+    gp = jnp.pad(g, ((1, 1), (1, 1), (0, 0)))
+    h, w, k = dy.shape
+    c = wr_ref.shape[-1]
+    acc = jnp.zeros((h * w, c), jnp.float32)
+    for dyy in range(3):
+        for dxx in range(3):
+            gs = gp[dyy:dyy + h, dxx:dxx + w].reshape(h * w, k)
+            acc += gs @ wr_ref[dyy, dxx].astype(jnp.float32)
+    dx_ref[0] = acc.reshape(h, w, c).astype(dx_ref.dtype)
+
+
+def _conv3_bwd_dw_kernel(x_ref, z_ref, dy_ref, chan_ref, xs_ref, dw_ref,
+                         *, n_total):
+    ni = pl.program_id(0)
+
+    @pl.when(ni == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+
+    dy = dy_ref[0].astype(jnp.float32)               # [H, W, K]
+    g = _g_tile(z_ref[0], dy, chan_ref[...], n_total)
+    h, w, k = dy.shape
+    gf = g.reshape(h * w, k)
+    for dyy in range(3):
+        for dxx in range(3):
+            xs = x_ref[0, pl.ds(dyy, h), pl.ds(dxx, w), :]
+            xs = xs.reshape(h * w, xs.shape[-1]).astype(jnp.float32)
+            if x_ref.dtype == jnp.int8:
+                xs = xs * xs_ref[0]          # in-register dequant
+            dw_ref[dyy, dxx] += xs.T @ gf
+
+
+def conv3x3_bn_bwd(x, z, dy, w, gamma, inv, a_sum, b_sum, *,
+                   x_scale=None, z_scale=None, out_dtype=None,
+                   interpret: bool = False):
+    """Fused backward for the 3×3 stride-1 SAME path: g recomputed
+    in-register per batch element from the centered output z and dy;
+    dx = conv(g, w rotated), dw = Σ x⊗g — no g tensor in HBM.
+    x [N,H,W,C] and z [N,H,W,K] may be the int8 stashes (pass the
+    per-channel scales; dequant happens in-register)."""
+    n_, h, wd, c = x.shape
+    k = w.shape[-1]
+    n_total = float(n_ * h * wd)
+    out_dtype = out_dtype or (x.dtype if x.dtype != jnp.int8
+                              else jnp.float32)
+    chan = _pack_chan(
+        gamma.astype(jnp.float32) * inv / n_total,
+        inv * b_sum.astype(jnp.float32),
+        a_sum.astype(jnp.float32),
+        z_scale.astype(jnp.float32) if z_scale is not None
+        else jnp.ones((k,), jnp.float32))
+    xs_row = jnp.zeros((_CHAN_ROWS, c), jnp.float32).at[0].set(
+        x_scale.astype(jnp.float32) if x_scale is not None
+        else jnp.ones((c,), jnp.float32))
+    # rotated filters: dx's conv uses w[2-dy, 2-dx] with in/out swapped
+    wr = jnp.flip(jnp.flip(w, 0), 1).transpose(0, 1, 3, 2)  # [3,3,K,C]
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    grid = (n_,)
+    dx = pl.pallas_call(
+        functools.partial(_conv3_bwd_dx_kernel, n_total=n_total),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, h, wd, k), lambda ni: (ni, 0, 0, 0)),
+            pl.BlockSpec((1, h, wd, k), lambda ni: (ni, 0, 0, 0)),
+            pl.BlockSpec((3, 3, k, c), lambda ni: (0, 0, 0, 0)),
+            pl.BlockSpec((_CHAN_ROWS, k), lambda ni: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, wd, c), lambda ni: (ni, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_, h, wd, c), out_dtype),
+        interpret=interpret,
+    )(z, dy, wr, chan)
+    dw = pl.pallas_call(
+        functools.partial(_conv3_bwd_dw_kernel, n_total=n_total),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, h + 2, wd + 2, c), lambda ni: (ni, 0, 0, 0)),
+            pl.BlockSpec((1, h, wd, k), lambda ni: (ni, 0, 0, 0)),
+            pl.BlockSpec((1, h, wd, k), lambda ni: (ni, 0, 0, 0)),
+            pl.BlockSpec((_CHAN_ROWS, k), lambda ni: (0, 0)),
+            pl.BlockSpec((_CHAN_ROWS, c), lambda ni: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((3, 3, c, k), lambda ni: (0, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((3, 3, c, k), jnp.float32),
+        interpret=interpret,
+    )(xp, z, dy, chan, xs_row)
+    return dx, dw
+
+
+# ---------------------------------------------------------------------------
 # dispatch + fused train op
 # ---------------------------------------------------------------------------
 
@@ -188,11 +414,7 @@ def conv_bn_stats(x, w, *, stride=1, padding="SAME",
     from paddle_tpu.ops import conv as ops_conv
 
     kh, kw = w.shape[0], w.shape[1]
-    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
-    if interpret is None and FORCE_INTERPRET:
-        interpret = True
-    use_kernel = interpret if interpret is not None else _on_tpu()
-    same = padding == "SAME" or padding == ((1, 1), (1, 1)) or padding == 1
+    s, same, use_kernel, interpret = _dispatch(stride, padding, interpret)
     if use_kernel and kh == 1 and kw == 1:
         xs = x[:, ::s[0], ::s[1], :]
         n, ho, wo, c = xs.shape
@@ -226,14 +448,15 @@ def _dequant8(q, scale, dtype):
     return (q.astype(jnp.float32) * scale).astype(dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _conv_bn(x, w, gamma, beta, stride, padding, eps, interpret, save8):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _conv_bn(x, w, gamma, beta, stride, padding, eps, interpret, save8,
+             fused_bwd):
     return _conv_bn_fwd(x, w, gamma, beta, stride, padding, eps,
-                        interpret, save8)[0]
+                        interpret, save8, fused_bwd)[0]
 
 
 def _conv_bn_fwd(x, w, gamma, beta, stride, padding, eps, interpret,
-                 save8):
+                 save8, fused_bwd):
     y, s1, s2 = conv_bn_stats(x, w, stride=stride, padding=padding,
                               interpret=interpret)
     count = y.size // y.shape[-1]
@@ -265,34 +488,90 @@ def _conv_bn_fwd(x, w, gamma, beta, stride, padding, eps, interpret,
              w, mean, inv, gamma))
 
 
-def _conv_bn_bwd(stride, padding, eps, interpret, save8, res, cts):
+def _conv_bn_bwd(stride, padding, eps, interpret, save8, fused_bwd, res,
+                 cts):
     from paddle_tpu.ops import conv as ops_conv
 
     x, y, stash_x, stash_y, w, mean, inv, gamma = res
     if save8:
         (qx, sx), xtok = stash_x
-        x = _dequant8(qx, sx, xtok.dtype)
         qz, sz = stash_y
+        # the f32 view fuses into the reductions below (no materialized
+        # dequant copy); the fused kernels read the raw int8 stashes
         centered = qz.astype(jnp.float32) * sz     # = y - mean (stashed)
+        x_full = None                              # dequantize lazily
     else:
+        qx = sx = qz = sz = None
         centered = y.astype(jnp.float32) - mean
+        x_full = x
     dout = cts[0].astype(jnp.float32)
     n = centered.size // centered.shape[-1]
     axes = tuple(range(centered.ndim - 1))
     # the cotangent w.r.t. the conv output is EXACTLY the batch-norm dx
     # identity (ops/norm.py _bn_apply_bwd with x := y): two passes —
-    # one fused reduction (Σdy, Σdy·ŷ), one elementwise
+    # one fused reduction (Σdy, Σdy·ŷ) and the elementwise g stage
     sum_dy = jnp.sum(dout, axis=axes)
     yhat = centered * inv
     sum_dy_yhat = jnp.sum(dout * yhat, axis=axes)
-    sc = gamma.astype(jnp.float32) * inv / n
-    g = (sc * (n * dout - sum_dy - yhat * sum_dy_yhat)).astype(
-        cts[0].dtype)
-    # delegate the conv backward to XLA's conv VJP (MXU-optimal already)
-    _, conv_vjp = jax.vjp(
-        lambda x_, w_: ops_conv.conv2d(x_, w_, stride=stride,
-                                       padding=padding), x, w)
-    dx, dw = conv_vjp(g)
+
+    kh, kw = w.shape[0], w.shape[1]
+    s, same, kernel_ok, interpret = _dispatch(stride, padding, interpret)
+    use_kernel = fused_bwd and kernel_ok
+    out_dt = cts[0].dtype
+    # the dx cotangent must carry the PRIMAL x dtype exactly
+    x_dt = xtok.dtype if save8 else x.dtype
+    if use_kernel and kh == 1 and kw == 1:
+        # g recomputed inside the dx/dw GEMM kernels — never hits HBM;
+        # with save8 the kernels read the raw int8 stashes directly
+        c = x.shape[-1] if not save8 else qx.shape[-1]
+        k = w.shape[-1]
+        if save8:
+            x_in = qx[:, ::s[0], ::s[1], :]
+            z_in, dy_in = qz, dout.astype(out_dt)
+            xsc, zsc = sx, sz
+        else:
+            x_in = x_full[:, ::s[0], ::s[1], :]
+            z_in = centered.astype(out_dt)
+            dy_in, xsc, zsc = dout.astype(out_dt), None, None
+        nb, ho, wo = x_in.shape[:3]
+        dxs, dw2 = matmul_bn_bwd(
+            x_in.reshape(nb * ho * wo, c),
+            z_in.reshape(nb * ho * wo, k),
+            dy_in.reshape(nb * ho * wo, k),
+            w.reshape(c, k), gamma, inv, sum_dy, sum_dy_yhat,
+            x_scale=xsc, z_scale=zsc, out_dtype=x_dt,
+            interpret=bool(interpret))
+        dxs = dxs.reshape(nb, ho, wo, c)
+        full_shape = qx.shape if save8 else x_full.shape
+        if s != (1, 1):
+            dx = jnp.zeros(full_shape, x_dt).at[
+                :, ::s[0], ::s[1], :].set(dxs.astype(x_dt))
+        else:
+            dx = dxs.astype(x_dt)
+        dw = dw2.reshape(w.shape).astype(w.dtype)
+    elif use_kernel and kh == 3 and kw == 3 and s == (1, 1) and same:
+        if save8:
+            dx, dw3 = conv3x3_bn_bwd(
+                qx, qz, dout.astype(out_dt), w, gamma, inv, sum_dy,
+                sum_dy_yhat, x_scale=sx, z_scale=sz, out_dtype=x_dt,
+                interpret=bool(interpret))
+        else:
+            dx, dw3 = conv3x3_bn_bwd(
+                x_full, centered.astype(out_dt), dout.astype(out_dt), w,
+                gamma, inv, sum_dy, sum_dy_yhat, out_dtype=x_dt,
+                interpret=bool(interpret))
+        dw = dw3.astype(w.dtype)
+    else:
+        if save8 and x_full is None:
+            x_full = _dequant8(qx, sx, xtok.dtype)
+        sc = gamma.astype(jnp.float32) * inv / n
+        g = (sc * (n * dout - sum_dy - yhat * sum_dy_yhat)).astype(
+            out_dt)
+        # delegate the conv backward to XLA's conv VJP
+        _, conv_vjp = jax.vjp(
+            lambda x_, w_: ops_conv.conv2d(x_, w_, stride=stride,
+                                           padding=padding), x_full, w)
+        dx, dw = conv_vjp(g)
     return (dx, dw, sum_dy_yhat.astype(gamma.dtype),
             sum_dy.astype(gamma.dtype))
 
@@ -302,7 +581,8 @@ _conv_bn.defvjp(_conv_bn_fwd, _conv_bn_bwd)
 
 def conv_bn_train(x, w, gamma, beta, running_mean, running_var, *,
                   stride=1, padding="SAME", momentum=0.9, eps=1e-5,
-                  interpret: Optional[bool] = None, save8: bool = False
+                  interpret: Optional[bool] = None, save8: bool = False,
+                  fused_bwd: bool = False
                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Fused conv→BN training step: one kernel produces the conv output
     AND its batch statistics, the normalize is a per-channel affine, and
@@ -310,9 +590,13 @@ def conv_bn_train(x, w, gamma, beta, running_mean, running_var, *,
     ``save8`` stashes the backward's saved activations (x, y) as
     per-channel int8 — halves their backward read traffic and residual
     memory for ~0.4% stash rounding noise (forward values untouched).
+    ``fused_bwd`` recomputes the BN-backward g stage INSIDE Pallas
+    conv-backward kernels (1x1 GEMM pair / 3x3 shifted-GEMM pair) so g
+    never exists in HBM — pairs naturally with save8 (the kernels read
+    the centered int8 stash directly).
     Returns (out, new_running_mean, new_running_var)."""
     out, mean, var = _conv_bn(x, w, gamma, beta, stride, padding, eps,
-                              interpret, save8)
+                              interpret, save8, fused_bwd)
     new_mean = momentum * running_mean + (1 - momentum) * mean
     new_var = momentum * running_var + (1 - momentum) * var
     return (out, new_mean.astype(running_mean.dtype),

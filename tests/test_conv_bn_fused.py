@@ -307,3 +307,134 @@ class TestInt8Stash:
             denom = np.abs(np.asarray(b)).max() + 1e-8
             rel = np.abs(np.asarray(a) - np.asarray(b)).max() / denom
             assert rel < 0.03, (name, rel)
+
+
+class TestFusedBackwardKernels:
+    """fused_bwd: the BN-backward g stage recomputed inside Pallas
+    conv-backward kernels — gradients must match the XLA-VJP path."""
+
+    @pytest.mark.parametrize("ksize,stride", [(1, 1), (1, 2), (3, 1)])
+    def test_grads_match_unfused_backward(self, rng, ksize, stride):
+        n, h, w_, c, k = 2, 8, 8, 8, 16
+        x = rng.randn(n, h, w_, c).astype(np.float32)
+        w = rng.randn(ksize, ksize, c, k).astype(np.float32) * 0.2
+        gamma = rng.rand(k).astype(np.float32) + 0.5
+        beta = rng.randn(k).astype(np.float32) * 0.1
+        rm = jnp.zeros((k,), jnp.float32)
+        rv = jnp.ones((k,), jnp.float32)
+        tgt = rng.randn(n, h // stride, w_ // stride, k).astype(np.float32)
+
+        def loss(fused_bwd):
+            def f(x_, w_, g_, b_):
+                out, _, _ = fused.conv_bn_train(
+                    jnp.asarray(x_), jnp.asarray(w_), jnp.asarray(g_),
+                    jnp.asarray(b_), rm, rv, stride=stride,
+                    interpret=True, fused_bwd=fused_bwd)
+                return jnp.mean((out - tgt) ** 2)
+            return f
+
+        g_fk = jax.grad(loss(True), argnums=(0, 1, 2, 3))(x, w, gamma,
+                                                          beta)
+        g_ref = jax.grad(loss(False), argnums=(0, 1, 2, 3))(x, w, gamma,
+                                                            beta)
+        for name, a, b in zip("xwgb", g_fk, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4,
+                                       err_msg=f"d{name}")
+
+    def test_composes_with_save8(self, rng):
+        """The intended pairing: int8 stash feeds the backward kernels."""
+        n, h, w_, c, k = 2, 6, 6, 4, 8
+        x = rng.randn(n, h, w_, c).astype(np.float32)
+        w = rng.randn(3, 3, c, k).astype(np.float32) * 0.2
+        gamma = rng.rand(k).astype(np.float32) + 0.5
+        beta = rng.randn(k).astype(np.float32) * 0.1
+        rm = jnp.zeros((k,), jnp.float32)
+        rv = jnp.ones((k,), jnp.float32)
+        tgt = rng.randn(n, h, w_, k).astype(np.float32)
+
+        def loss(save8, fused_bwd):
+            def f(x_, w_, g_, b_):
+                out, _, _ = fused.conv_bn_train(
+                    jnp.asarray(x_), jnp.asarray(w_), jnp.asarray(g_),
+                    jnp.asarray(b_), rm, rv, stride=1, interpret=True,
+                    save8=save8, fused_bwd=fused_bwd)
+                return jnp.mean((out - tgt) ** 2)
+            return f
+
+        g_all = jax.grad(loss(True, True), argnums=(0, 1, 2, 3))(
+            x, w, gamma, beta)
+        g_ref = jax.grad(loss(False, False), argnums=(0, 1, 2, 3))(
+            x, w, gamma, beta)
+        for name, a, b in zip("xwgb", g_all, g_ref):
+            denom = np.abs(np.asarray(b)).max() + 1e-8
+            rel = np.abs(np.asarray(a) - np.asarray(b)).max() / denom
+            assert rel < 0.03, (name, rel)
+
+    def test_mm_bwd_padded_rows_inert(self, rng):
+        """M not a block multiple: the dy-fill trick must keep padded
+        rows out of dx and dw exactly."""
+        m, c, k = 70, 8, 16
+        x2 = jnp.asarray(rng.randn(m, c).astype(np.float32))
+        z2 = jnp.asarray(rng.randn(m, k).astype(np.float32))
+        dy2 = jnp.asarray(rng.randn(m, k).astype(np.float32))
+        w2 = jnp.asarray(rng.randn(c, k).astype(np.float32))
+        gamma = jnp.asarray(rng.rand(k).astype(np.float32) + 0.5)
+        inv = jnp.asarray(rng.rand(k).astype(np.float32) + 0.5)
+        a_sum = jnp.sum(dy2, axis=0)
+        b_sum = jnp.sum(dy2 * z2 * inv, axis=0)
+        # block_m=64 < m so the padding branch (A/n dy-fill) really runs
+        dx, dw = fused.matmul_bn_bwd(x2, z2, dy2, w2, gamma, inv, a_sum,
+                                     b_sum, block_m=64, interpret=True)
+        # reference g + plain matmuls
+        nf = float(m)
+        g = (gamma * inv / nf) * (nf * dy2 - a_sum - z2 * inv * b_sum)
+        np.testing.assert_allclose(np.asarray(dx), np.asarray(g @ w2.T),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dw), np.asarray(x2.T @ g),
+                                   rtol=1e-4, atol=1e-3)
+
+
+def test_fused_full_mode_resnet_trains(rng, monkeypatch):
+    """fused='full' through the model stack: stats epilogue + int8 stash
+    + Pallas backward kernels, all in interpret mode."""
+    import paddle_tpu as paddle
+    from paddle_tpu import layer
+    from paddle_tpu.models import resnet
+    from paddle_tpu.topology import Topology, Value
+    from paddle_tpu.utils.rng import KeySource
+    monkeypatch.setattr(fused, "FORCE_INTERPRET", True)
+    dt = paddle.data_type
+
+    x = layer.data("img", dt.dense_vector(3 * 8 * 8))
+    lbl = layer.data("lbl", dt.integer_value(4))
+    c1 = resnet.conv_bn_layer(x, 8, 3, 1, 1, None, ch_in=3,
+                              name="ff_c1", fused="full")
+    b1 = resnet.bottleneck_block(c1, 8, 4, 1, name="ff_b1", fused="full")
+    pool = layer.img_pool(b1, pool_size=8, stride=1,
+                          pool_type=paddle.pooling.Avg())
+    sm = layer.fc(pool, 4, act=paddle.activation.Softmax(), name="ff_sm")
+    cost = layer.classification_cost(sm, lbl, name="ff_cost")
+    topo = Topology(cost)
+    params = paddle.parameters.create(cost, KeySource(0))
+    fwd = topo.compile()
+    opt = paddle.optimizer.Momentum(momentum=0.9, learning_rate=0.05)
+    o = opt.init_state(params.values)
+    xv = jnp.asarray(rng.randn(8, 3 * 8 * 8).astype(np.float32))
+    yv = jnp.asarray(rng.randint(0, 4, 8).astype(np.int32))
+
+    def step(p, o, s):
+        def loss_fn(p):
+            outs, ns = fwd(p, s, {"img": Value(xv), "lbl": Value(yv)},
+                           is_training=True)
+            return jnp.mean(outs["ff_cost"].array.astype(jnp.float32)), ns
+        (l, ns), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        np_, no_ = opt.update(jnp.asarray(0, jnp.int32), g, p, o)
+        return l, np_, no_, ns
+
+    p, s = params.values, params.state
+    losses = []
+    for _ in range(6):
+        l, p, o, s = step(p, o, s)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] and np.isfinite(losses).all(), losses
