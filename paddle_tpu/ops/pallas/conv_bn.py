@@ -413,6 +413,14 @@ def conv_bn_stats(x, w, *, stride=1, padding="SAME",
     Returns (y, sum, sumsq) — sums per output channel over N·H·W."""
     from paddle_tpu.ops import conv as ops_conv
 
+    from paddle_tpu.core import dtypes
+
+    # honor the global MXU compute-dtype policy exactly like
+    # ops_conv.conv2d does — the fused and unfused paths must emit the
+    # SAME dtype or the custom-VJP cotangents mismatch downstream
+    cdt = dtypes.compute_dtype()
+    x = x.astype(cdt)
+    w = w.astype(cdt)
     kh, kw = w.shape[0], w.shape[1]
     s, same, use_kernel, interpret = _dispatch(stride, padding, interpret)
     if use_kernel and kh == 1 and kw == 1:

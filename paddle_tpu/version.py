@@ -1,6 +1,6 @@
 """Version info (reference: paddle/utils/Version.cpp, cmake version stamping)."""
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 major = 0
 minor = 1

@@ -438,3 +438,39 @@ def test_fused_full_mode_resnet_trains(rng, monkeypatch):
         l, p, o, s = step(p, o, s)
         losses.append(float(l))
     assert losses[-1] < losses[0] and np.isfinite(losses).all(), losses
+
+
+def test_fused_honors_compute_dtype_policy(rng, monkeypatch):
+    """Under the real bf16 MXU policy (conftest forces fp32 for test
+    numerics) the fused path must emit the SAME dtype as ops_conv.conv2d
+    — a mismatch breaks the custom-VJP cotangent chain in full models
+    (regression: benchmarks/fused_bn_quality.py caught fp32 fused output
+    meeting a bf16 conv_vjp)."""
+    from paddle_tpu.utils.flags import GLOBAL_FLAGS
+    monkeypatch.setattr(fused, "FORCE_INTERPRET", True)
+    old = GLOBAL_FLAGS.get("compute_dtype", "float32")
+    GLOBAL_FLAGS.set_if_known("compute_dtype", "bfloat16")
+    try:
+        x = jnp.asarray(rng.randn(2, 8, 8, 4).astype(np.float32))
+        w = jnp.asarray(rng.randn(3, 3, 4, 8).astype(np.float32) * 0.2)
+        gamma = jnp.ones((8,), jnp.float32)
+        beta = jnp.zeros((8,), jnp.float32)
+        rm = jnp.zeros((8,), jnp.float32)
+        rv = jnp.ones((8,), jnp.float32)
+        out, _, _ = fused.conv_bn_train(x, w, gamma, beta, rm, rv,
+                                        stride=1)
+        ref = ops_conv.conv2d(x, w, stride=1, padding="SAME")
+        assert out.dtype == ref.dtype == jnp.bfloat16
+
+        # and the backward chain composes with a bf16 conv_vjp
+        def loss(x_):
+            o, _, _ = fused.conv_bn_train(x_, w, gamma, beta, rm, rv,
+                                          stride=1, save8=True,
+                                          fused_bwd=True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        g = jax.grad(loss)(x)
+        assert g.dtype == x.dtype and bool(jnp.isfinite(
+            g.astype(jnp.float32)).all())
+    finally:
+        GLOBAL_FLAGS.set_if_known("compute_dtype", old)
