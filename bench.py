@@ -26,6 +26,7 @@ error, never a bare 0.0.
 import glob
 import json
 import os
+import signal
 import sys
 import threading
 import time
@@ -44,8 +45,10 @@ STEP_TIMEOUT = float(os.environ.get("BENCH_STEP_TIMEOUT", 600))
 ATTEMPT_ENV = "PADDLE_TPU_BENCH_ATTEMPT"
 START_ENV = "PADDLE_TPU_BENCH_START"
 MAX_ATTEMPTS = int(os.environ.get("BENCH_MAX_ATTEMPTS", 5))
-# total wall-clock across all attempts incl. backoff sleeps (seconds)
-WALL_BUDGET = float(os.environ.get("BENCH_WALL_BUDGET", 7200))
+# total wall-clock across all attempts incl. backoff sleeps (seconds);
+# the driver's own timeout may be shorter — the SIGTERM trap below makes
+# sure the one JSON line still gets emitted if we're killed mid-schedule
+WALL_BUDGET = float(os.environ.get("BENCH_WALL_BUDGET", 3600))
 # sleep before re-exec attempt N+1 (index by attempt number, 1-based)
 BACKOFF = (0, 300, 600, 900, 1200)
 RUNS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -343,7 +346,16 @@ def bench_batch(dog, step_fn, carry, batch, warmup=3, iters=20):
     return ips, (p, o, s)
 
 
+def _term_handler(signum, frame):
+    """The driver timing us out must still receive the one JSON line —
+    a killed process with empty stdout erases the round's evidence."""
+    emit(0.0, error=f"killed by signal {signum} (driver timeout) during "
+         f"the retry schedule")
+
+
 def main():
+    signal.signal(signal.SIGTERM, _term_handler)
+    signal.signal(signal.SIGINT, _term_handler)
     dog = Watchdog()
     init_backend(dog)
     dog.stage("build", 300)
