@@ -474,3 +474,36 @@ def test_fused_honors_compute_dtype_policy(rng, monkeypatch):
             g.astype(jnp.float32)).all())
     finally:
         GLOBAL_FLAGS.set_if_known("compute_dtype", old)
+
+
+def test_fused_composes_with_dp_sharding(rng, monkeypatch):
+    """The fused conv+BN custom-VJP op must stay correct when its inputs
+    are GSPMD-sharded over the data axis (the multi-chip DP path; XLA
+    may gather around the pallas_call — correctness first, the bench
+    runs single-chip)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.core import place
+    monkeypatch.setattr(fused, "FORCE_INTERPRET", True)
+    mesh = place.make_mesh((8,), (place.AXIS_DATA,))
+    x_host = jnp.asarray(rng.randn(16, 8, 8, 8).astype(np.float32))
+    x = jax.device_put(x_host, NamedSharding(
+        mesh, P(place.AXIS_DATA, None, None, None)))
+    w = jnp.asarray(rng.randn(3, 3, 8, 16).astype(np.float32) * 0.2)
+    gamma = jnp.ones((16,), jnp.float32)
+    beta = jnp.zeros((16,), jnp.float32)
+    rm = jnp.zeros((16,), jnp.float32)
+    rv = jnp.ones((16,), jnp.float32)
+
+    @jax.jit
+    def step(x, w):
+        def loss(w_):
+            out, _, _ = fused.conv_bn_train(
+                x, w_, gamma, beta, rm, rv, stride=1, save8=True,
+                fused_bwd=True)
+            return jnp.mean(out.astype(jnp.float32) ** 2)
+        return jax.value_and_grad(loss)(w)
+
+    l_sh, g_sh = step(x, w)
+    l_1d, g_1d = step(jax.device_put(x_host, jax.devices()[0]), w)
+    np.testing.assert_allclose(float(l_sh), float(l_1d), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(g_sh), np.asarray(g_1d))
