@@ -75,7 +75,12 @@ timeout 1500 python benchmarks/transformer_bench.py --seq 4096 --batch 4 \
     > "$RUNS/${STAMP}_transformer_seq4096.jsonl" 2>/tmp/q3.log \
     && cat "$RUNS/${STAMP}_transformer_seq4096.jsonl"
 
-echo "== [7] flash block-size tuning sweep"
+echo "== [7] serving decode throughput: MHA vs GQA KV cache"
+timeout 1200 python benchmarks/transformer_bench.py --decode --batch 8 \
+    --gen 512 > "$RUNS/${STAMP}_decode_gqa.jsonl" 2>/tmp/q_dec.log \
+    && cat "$RUNS/${STAMP}_decode_gqa.jsonl"
+
+echo "== [8] flash block-size tuning sweep"
 timeout 2400 python benchmarks/tune_flash_blocks.py \
     > "$RUNS/${STAMP}_flash_blocks.log" 2>&1 \
     && tail -20 "$RUNS/${STAMP}_flash_blocks.log"

@@ -1,9 +1,16 @@
 #!/usr/bin/env python
-"""Transformer LM training throughput (tokens/sec) on one chip, with and
-without the Pallas flash-attention kernel — the modern long-context
-headline next to the BASELINE.md image/RNN tables.
+"""Transformer LM throughput on one chip.
+
+Training mode (default): tokens/sec with and without the Pallas
+flash-attention kernel — the modern long-context headline next to the
+BASELINE.md image/RNN tables.
+
+Decode mode (--decode): autoregressive serving throughput
+(generated tokens/sec through prefill + the compiled single-token scan),
+MHA vs GQA (n_kv_heads) — the KV-cache bandwidth lever measured.
 
 Usage: python benchmarks/transformer_bench.py [--seq 2048] [--batch 8]
+       python benchmarks/transformer_bench.py --decode [--gen 256]
 Prints one JSON line per variant.
 """
 
@@ -27,6 +34,11 @@ def main():
     ap.add_argument("--vocab", type=int, default=32000)
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--platform", default=None)
+    ap.add_argument("--decode", action="store_true",
+                    help="serving decode throughput (MHA vs GQA) instead "
+                         "of training")
+    ap.add_argument("--gen", type=int, default=256,
+                    help="tokens to generate per decode measurement")
     args = ap.parse_args()
 
     import jax
@@ -37,6 +49,9 @@ def main():
     from paddle_tpu.models import transformer as tfm
 
     rng = np.random.RandomState(0)
+    if args.decode:
+        _run_decode(args, tfm, jax, jnp, rng)
+        return
     tokens = jnp.asarray(rng.randint(0, args.vocab,
                                      (args.batch, args.seq)), jnp.int32)
 
@@ -97,6 +112,50 @@ def _run_variant(args, tfm, jax, jnp, tokens, use_flash):
         "compile_s": round(compile_s, 1),
         "loss": round(float(loss), 4)}), flush=True)
     del p, o, params, opt_state
+
+
+def _run_decode(args, tfm, jax, jnp, rng):
+    """Serving decode: tokens/sec through prefill + the compiled
+    single-token scan, MHA vs GQA cache layouts."""
+    import time as _t
+
+    from paddle_tpu.utils.sync import host_sync
+
+    heads = args.d_model // 64
+    prompt_len = min(64, args.seq)
+    for n_kv in (0, max(1, heads // 4)):          # MHA, then GQA H/4
+        cfg = tfm.TransformerConfig(
+            vocab=args.vocab, d_model=args.d_model, n_layers=args.layers,
+            n_heads=heads, n_kv_heads=n_kv, d_ff=4 * args.d_model,
+            max_len=prompt_len + args.gen)
+        params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.asarray(rng.randint(0, args.vocab,
+                                         (args.batch, prompt_len)),
+                             jnp.int32)
+        gen = jax.jit(lambda p, pr: tfm.generate(
+            p, pr, cfg, max_new=args.gen))
+        t0 = _t.time()
+        host_sync(gen(params, prompt))
+        compile_s = _t.time() - t0
+        t0 = _t.time()
+        reps = max(1, args.iters // 5)
+        out = None
+        for _ in range(reps):
+            out = gen(params, prompt)
+        host_sync(out)
+        dt = (_t.time() - t0) / reps
+        tps = args.batch * args.gen / dt
+        kv_mb = (cfg.n_layers * args.batch * (prompt_len + args.gen)
+                 * cfg.kv_heads * cfg.head_dim * 2 * 2) / 2**20
+        print(json.dumps({
+            "metric": "transformer_decode_tokens_per_sec",
+            "n_kv_heads": cfg.kv_heads, "n_heads": heads,
+            "batch": args.batch, "gen": args.gen,
+            "prompt_len": prompt_len, "d_model": args.d_model,
+            "layers": args.layers, "kv_cache_mb": round(kv_mb, 1),
+            "value": round(tps, 1),
+            "ms_per_token": round(dt * 1e3 / args.gen, 3),
+            "compile_s": round(compile_s, 1)}), flush=True)
 
 
 if __name__ == "__main__":
