@@ -64,8 +64,14 @@ STEM_S2D = os.environ.get("BENCH_S2D", "1") == "1"
 # Mosaic lowering violations.
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "benchmarks", "configs"))
-from _synth import parse_fused_bn  # noqa: E402  (shared tri-state parse)
-FUSED_BN = parse_fused_bn()
+try:
+    from _synth import parse_fused_bn  # noqa: E402 (shared tri-state parse)
+    FUSED_BN = parse_fused_bn()
+except Exception:  # noqa: BLE001 — an import crash here would erase the
+    # one-JSON-line contract before any watchdog exists; fall back to the
+    # same parse inline
+    _FB = os.environ.get("BENCH_FUSED_BN", "0")
+    FUSED_BN = _FB if _FB in ("int8", "full") else _FB == "1"
 
 
 def log(*a):
@@ -348,7 +354,19 @@ def bench_batch(dog, step_fn, carry, batch, warmup=3, iters=20):
 
 def _term_handler(signum, frame):
     """The driver timing us out must still receive the one JSON line —
-    a killed process with empty stdout erases the round's evidence."""
+    a killed process with empty stdout erases the round's evidence.
+    Re-entrancy: if an emit() is already in flight (the handler may have
+    interrupted it on this very thread, or the watchdog thread may hold
+    the lock mid-print), DON'T emit again — returning lets the in-flight
+    emit finish and exit; emitting here would deadlock on the
+    non-reentrant lock or truncate the real record."""
+    if not _emit_lock.acquire(blocking=False):
+        return
+    try:
+        if _emitted:
+            os._exit(1)
+    finally:
+        _emit_lock.release()
     emit(0.0, error=f"killed by signal {signum} (driver timeout) during "
          f"the retry schedule")
 

@@ -175,7 +175,17 @@ def main():
                "device": "?", "points": []}
     if args.merge and os.path.exists(json_path):
         with open(json_path) as f:
-            results = json.load(f)
+            old = json.load(f)
+        cur_platform = os.environ.get("BENCH_PLATFORM", "default")
+        if old.get("platform") != cur_platform:
+            # never publish this run's numbers under the OLD platform
+            # label — a CPU smoke merged into a TPU table would lie
+            print(f"--merge refused: existing results are platform="
+                  f"{old.get('platform')!r}, this run is "
+                  f"{cur_platform!r}; measure on the same platform or "
+                  f"drop --merge", file=sys.stderr)
+            raise SystemExit(2)
+        results = old
         # points re-measured in this run replace their old records
         results["points"] = [
             p for p in results["points"]

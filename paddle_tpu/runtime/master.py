@@ -95,6 +95,11 @@ class MasterService:
         self._snap_written = -1
         self._snap_lock = threading.Lock()
         self._dirty = threading.Event()
+        self._stop = threading.Event()
+        # fencing hook: when set (HA mode), snapshots are written only
+        # while this process still holds the leader lock — a deposed
+        # zombie must not clobber the new leader's snapshot
+        self.fence = None
         self.snapshot_interval = snapshot_interval
         if snapshot_path and os.path.exists(snapshot_path):
             self._restore()
@@ -235,6 +240,9 @@ class MasterService:
     def _snapshot(self):
         if not self.snapshot_path:
             return
+        if self.fence is not None and not self.fence():
+            log.warning("master: snapshot skipped — leadership lost")
+            return
         with self._lock:
             version = self._version
             state = {
@@ -266,15 +274,25 @@ class MasterService:
 
     def _snapshot_loop(self):
         """Debounced writer: wakes on dirty state, writes at most every
-        ``snapshot_interval`` seconds regardless of RPC rate."""
-        while True:
-            self._dirty.wait()
+        ``snapshot_interval`` seconds regardless of RPC rate. Exits when
+        close() is called (an immortal daemon thread would pin the
+        service object and keep writing after shutdown)."""
+        while not self._stop.is_set():
+            if not self._dirty.wait(timeout=0.2):
+                continue
             self._dirty.clear()
+            if self._stop.is_set():
+                return
             try:
                 self._snapshot()
             except OSError as e:
                 log.warning("master: snapshot write failed: %s", e)
             time.sleep(self.snapshot_interval)
+
+    def close(self):
+        """Stop the background snapshot writer (idempotent)."""
+        self._stop.set()
+        self._dirty.set()
 
     def _restore(self):
         with open(self.snapshot_path) as f:
@@ -389,6 +407,28 @@ class LeaderLock:
                 continue
         return None
 
+    def _steal_mutex(self):
+        """Serialize the check-rename-mkdir critical section among LOCAL
+        candidates racing for a STALE lock: an O_EXCL sidecar file with
+        its own (short) staleness. Without it, a slow candidate's rename
+        could grab a lock a fast winner just re-created (the TOCTOU the
+        docstring promises away). The window a dead mutex holder blocks
+        others is ``stale_after`` seconds, then the mutex itself is
+        steal-able by age."""
+        mpath = self.path + ".steal"
+        try:
+            mage = time.time() - os.path.getmtime(mpath)
+            if mage > self.stale_after:
+                os.unlink(mpath)            # holder died mid-section
+        except OSError:
+            pass
+        try:
+            fd = os.open(mpath, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+            return mpath
+        except FileExistsError:
+            return None
+
     def try_acquire(self) -> bool:
         """One acquisition attempt. On success the caller OWNS the lock
         directory exclusively but is not yet discoverable — finish setup,
@@ -398,38 +438,45 @@ class LeaderLock:
         age = self._heartbeat_age()
         if age is not None and age < self.stale_after:
             return False                       # live holder
-        if age is not None:                    # stale: steal atomically
-            dead = (f"{self.path}.dead.{os.getpid()}."
-                    f"{time.monotonic_ns()}")
-            try:
-                os.rename(self.path, dead)
-            except OSError:
-                # another candidate already renamed it aside; fall through
-                # to the mkdir race (the rename winner has no privilege —
-                # mkdir picks the single next leader)
-                pass
-            else:
+        mutex = self._steal_mutex()
+        if mutex is None:
+            return False                       # another candidate mid-steal
+        try:
+            age = self._heartbeat_age()        # re-check INSIDE the mutex
+            if age is not None and age < self.stale_after:
+                return False
+            if age is not None:                # stale: move the corpse aside
+                dead = (f"{self.path}.dead.{os.getpid()}."
+                        f"{time.monotonic_ns()}")
+                try:
+                    os.rename(self.path, dead)
+                except OSError:
+                    return False
                 shutil.rmtree(dead, ignore_errors=True)
-        try:
-            os.mkdir(self.path)
-        except FileExistsError:
-            return False                       # lost the race
-        # term continuity lives in a sidecar file that survives lock
-        # generations (whoever wins mkdir increments it; only one leader
-        # exists at a time, so read-increment-write is unracy here)
-        term_path = self.path + ".term"
-        prev_term = 0
-        try:
-            with open(term_path) as f:
-                prev_term = int(f.read().strip() or 0)
-        except (OSError, ValueError):
-            pass
-        self.term = prev_term + 1
-        tmp = f"{term_path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            f.write(str(self.term))
-        os.replace(tmp, term_path)
-        return True
+            try:
+                os.mkdir(self.path)
+            except FileExistsError:
+                return False
+            # term continuity lives in a sidecar file that survives lock
+            # generations; read-increment-write is serialized by the mutex
+            term_path = self.path + ".term"
+            prev_term = 0
+            try:
+                with open(term_path) as f:
+                    prev_term = int(f.read().strip() or 0)
+            except (OSError, ValueError):
+                pass
+            self.term = prev_term + 1
+            tmp = f"{term_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(str(self.term))
+            os.replace(tmp, term_path)
+            return True
+        finally:
+            try:
+                os.unlink(mutex)
+            except OSError:
+                pass
 
     def publish(self, info: dict):
         """Make this leader discoverable and start heartbeating. Call
@@ -443,12 +490,33 @@ class LeaderLock:
         self._thread = threading.Thread(target=self._beat, daemon=True)
         self._thread.start()
 
+    def still_leader(self) -> bool:
+        """Fencing check: does the published lock still carry OUR term?
+        A deposed leader (frozen past stale_after, then resumed) sees a
+        different term here and must stand down."""
+        try:
+            with open(self.info_path) as f:
+                return json.load(f).get("term") == self.term
+        except (OSError, ValueError):
+            return False
+
     def _beat(self):
         while not self._stop.wait(self.heartbeat_interval):
+            # fenced heartbeat: NEVER refresh a lock another leader now
+            # owns — a zombie utime-ing the new leader's info.json would
+            # make the lock look immortally live after that leader dies
+            if not self.still_leader():
+                self._stop.set()
+                return
             try:
                 os.utime(self.info_path)
             except OSError:
                 pass
+
+    @property
+    def deposed(self) -> bool:
+        """True once the heartbeat discovered another leader's term."""
+        return self._stop.is_set() and self._thread is not None
 
     def release(self):
         import shutil
@@ -456,7 +524,10 @@ class LeaderLock:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=2)
-        shutil.rmtree(self.path, ignore_errors=True)
+        # only the CURRENT owner may remove the lock; a deposed zombie
+        # must not delete the live leader's directory
+        if self.still_leader():
+            shutil.rmtree(self.path, ignore_errors=True)
 
 
 class HAMaster:
@@ -510,6 +581,8 @@ class HAMaster:
         self.server = MasterServer(self.service, self.host, self.port)
         self.lock.publish({"host": self.server.addr[0],
                            "port": self.server.addr[1]})
+        # fence snapshot writes on CURRENT leadership from here on
+        self.service.fence = self.lock.still_leader
         log.info("master: leader term %d at %s:%d", self.lock.term,
                  self.server.addr[0], self.server.addr[1])
         return True
@@ -517,6 +590,8 @@ class HAMaster:
     def shutdown(self):
         if self.server:
             self.server.shutdown()
+        if self.service:
+            self.service.close()
         self.lock.release()
 
 

@@ -323,3 +323,61 @@ class TestConcurrency:
         with open(snap) as f:
             state = json.load(f)        # must parse — never corrupt
         assert "todo" in state and "lease_counter" in state
+
+    def test_deposed_leader_stops_heartbeating_and_snapshotting(
+            self, tmp_path):
+        """A leader frozen past stale_after must stand down when it
+        resumes: its heartbeat detects the new term and stops (never
+        refreshing the NEW leader's lock), and its fenced snapshots are
+        refused — the new leader's state survives."""
+        import json as _json
+        import time
+        from paddle_tpu.runtime import recordio
+        from paddle_tpu.runtime.master import LeaderLock, MasterService
+
+        path = str(tmp_path / "d.rio")
+        with recordio.Writer(path, records_per_chunk=2) as w:
+            for i in range(8):
+                w.write(b"x%d" % i)
+        lock_path = str(tmp_path / "lock")
+        snap = str(tmp_path / "snap.json")
+
+        a = LeaderLock(lock_path, stale_after=0.3, heartbeat_interval=0.05)
+        assert a.try_acquire()
+        a.publish({"host": "h", "port": 1})
+        svc_a = MasterService(lease_seconds=60, snapshot_path=snap)
+        svc_a.fence = a.still_leader
+        svc_a.set_dataset([path])
+
+        # "freeze" A: stop its heartbeat so the lease goes stale
+        a._stop.set()
+        a._thread.join()
+        time.sleep(0.5)
+
+        b = LeaderLock(lock_path, stale_after=0.3, heartbeat_interval=0.05)
+        assert b.try_acquire()
+        assert b.term == a.term + 1
+        b.publish({"host": "h", "port": 2})
+
+        # A "resumes": restart its beat thread — it must self-depose
+        import threading
+        a._stop.clear()
+        a._thread = threading.Thread(target=a._beat, daemon=True)
+        a._thread.start()
+        a._thread.join(timeout=2)
+        assert a.deposed
+        assert not a.still_leader() and b.still_leader()
+
+        # A's fenced snapshot refuses to clobber B's state
+        with open(snap) as f:
+            before = f.read()
+        svc_a.get_task()                  # mutate A's (stale) queues
+        svc_a.snapshot()                  # fenced: must be a no-op
+        with open(snap) as f:
+            assert f.read() == before
+        # and A's release must NOT delete B's lock
+        a.release()
+        with open(b.info_path) as f:
+            assert _json.load(f)["term"] == b.term
+        svc_a.close()
+        b.release()
