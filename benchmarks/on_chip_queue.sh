@@ -38,6 +38,27 @@ ref3 = np.asarray(ops_conv.conv2d(x3, w3, stride=1, padding="SAME"),
                   np.float32)
 print("conv3x3_bn_stats max err:",
       np.abs(np.asarray(y3, np.float32) - ref3).max())
+# backward kernels + int8 stash at BOTH extreme ResNet shapes — int8's
+# (32, 128) min tile makes the small-spatial stage (7x7) the risky one
+for (n_, h_, c_, k_) in [(2, 56, 64, 64), (2, 7, 512, 512)]:
+    xq = jnp.asarray(rng.randint(-127, 127, (n_, h_, h_, c_)), jnp.int8)
+    zq = jnp.asarray(rng.randint(-127, 127, (n_, h_, h_, k_)), jnp.int8)
+    dy = jnp.asarray(rng.randn(n_, h_, h_, k_).astype(np.bfloat16))
+    wc = jnp.asarray((rng.randn(3, 3, c_, k_) * 0.05).astype(np.bfloat16))
+    ga = jnp.ones((k_,), jnp.float32); iv = jnp.ones((k_,), jnp.float32)
+    asum = jnp.zeros((k_,), jnp.float32); bsum = jnp.zeros((k_,), jnp.float32)
+    sx = jnp.ones((c_,), jnp.float32); sz = jnp.ones((k_,), jnp.float32)
+    dx, dw = jax.jit(lambda *a: fused.conv3x3_bn_bwd(
+        *a[:8], x_scale=a[8], z_scale=a[9]))(
+        xq, zq, dy, wc, ga, iv, asum, bsum, sx, sz)
+    print(f"conv3x3_bn_bwd int8 {h_}x{h_}x{c_}: dx {dx.shape} finite",
+          bool(jnp.isfinite(dx.astype(jnp.float32)).all()))
+    m_ = n_ * h_ * h_
+    dx2, dw2 = jax.jit(lambda *a: fused.matmul_bn_bwd(
+        *a[:8], x_scale=a[8], z_scale=a[9]))(
+        xq.reshape(m_, c_), zq.reshape(m_, k_), dy.reshape(m_, k_),
+        wc[0, 0], ga, iv, asum, bsum, sx, sz)
+    print(f"matmul_bn_bwd int8 M={m_}: ok")
 print("SMOKE OK")
 EOF
 

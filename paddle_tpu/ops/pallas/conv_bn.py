@@ -200,6 +200,13 @@ def conv3x3_bn_stats(x: jax.Array, w: jax.Array, *, out_dtype=None,
 # like (1, K) are exactly the block shape this chip's Mosaic tiling
 # rejects (see ops/pallas/attention.py lse layout note); 8 rows match
 # the sublane tile. Row layout: 0=γ·inv/n, 1=inv·B, 2=A=Σdy, 3=z scale.
+#
+# int8 tiling caveat (pallas_guide: int8 min tile is (32, 128)): the
+# int8 stash blocks at the 7×7 stages have sublane dims below 32, which
+# Mosaic may pad or reject on real hardware — the on-chip queue's smoke
+# step exercises both extreme shapes before any A/B; if the small-
+# spatial case fails to lower, gate save8's kernel path on H*W ≥ 32
+# (the fallback dequantizes outside, losing only that stage's savings).
 _CHAN_ROWS = 8
 
 
