@@ -204,12 +204,33 @@ class Watchdog:
                      f"(no progress within timeout)")
 
 
+def _write_status(stage, reason, attempt):
+    """Shadow artifact updated at every attempt boundary: even an
+    untrappable SIGKILL mid-schedule leaves a dated record of what the
+    gate was doing and the last verified number."""
+    try:
+        os.makedirs(RUNS_DIR, exist_ok=True)
+        lv = last_verified()
+        rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), "stage": stage,
+               "reason": reason, "attempt": attempt}
+        if lv:
+            rec["last_verified_value"], rec["last_verified_ts"], \
+                rec["last_verified_file"] = lv
+        tmp = os.path.join(RUNS_DIR, "last_bench_status.tmp")
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, os.path.join(RUNS_DIR, "last_bench_status.json"))
+    except OSError:
+        pass
+
+
 def retry_or_fail(dog, reason):
     """Schedule another fresh-process attempt (with backoff) or emit the
     final failure record. Wall-clock across attempts is budget-capped."""
     attempt = int(os.environ.get(ATTEMPT_ENV, 1))
     start = float(os.environ.get(START_ENV, time.time()))
     elapsed = time.time() - start
+    _write_status("backoff", reason, attempt)
     sleep_s = BACKOFF[min(attempt, len(BACKOFF) - 1)]
     if (attempt >= MAX_ATTEMPTS
             or elapsed + sleep_s + INIT_TIMEOUT > WALL_BUDGET):
