@@ -1,0 +1,89 @@
+"""Compute-dtype policy contracts under the REAL (bf16) MXU policy.
+
+tests/conftest.py forces compute_dtype=float32 for numeric comparisons,
+which can hide dtype-chain bugs (one shipped: the fused conv+BN path
+emitted its input dtype and broke against the bf16 conv VJP). These
+tests flip the flag to bfloat16 for their duration and assert the
+dtype CONTRACTS (not numerics) across the op surface."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.utils.flags import GLOBAL_FLAGS
+
+
+@pytest.fixture()
+def bf16_policy():
+    old = GLOBAL_FLAGS.get("compute_dtype", "float32")
+    GLOBAL_FLAGS.set_if_known("compute_dtype", "bfloat16")
+    yield
+    GLOBAL_FLAGS.set_if_known("compute_dtype", old)
+
+
+def test_op_dtype_contracts(rng, bf16_policy):
+    """Each op's DOCUMENTED dtype contract: conv2d emits the compute
+    dtype (activations stay bf16 between ops — ops/conv.py rationale);
+    matmul computes in bf16 but RETURNS the input dtype (fp32
+    accumulation surfaces at full precision — ops/math.py contract);
+    the fused conv+BN path must match conv2d exactly."""
+    from paddle_tpu.ops import conv as ops_conv
+    from paddle_tpu.ops import math as ops_math
+    from paddle_tpu.ops.pallas import conv_bn as fused
+    x = jnp.asarray(rng.randn(2, 8, 8, 4).astype(np.float32))
+    w = jnp.asarray(rng.randn(3, 3, 4, 8).astype(np.float32))
+    assert ops_conv.conv2d(x, w).dtype == jnp.bfloat16
+    a = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+    b = jnp.asarray(rng.randn(8, 3).astype(np.float32))
+    assert ops_math.matmul(a, b).dtype == jnp.float32      # a.dtype
+    y, s1, s2 = fused.conv_bn_stats(x, w, stride=1, padding="SAME")
+    assert y.dtype == ops_conv.conv2d(x, w).dtype
+    assert s1.dtype == jnp.float32
+
+
+def test_layer_model_grads_finite_under_bf16(rng, bf16_policy):
+    """A small conv+BN+fc model must build, run and produce finite fp32
+    master-weight gradients end-to-end under the bf16 policy."""
+    import paddle_tpu as paddle
+    from paddle_tpu import layer
+    from paddle_tpu.topology import Topology, Value
+    from paddle_tpu.utils.rng import KeySource
+    dt = paddle.data_type
+
+    x = layer.data("x", dt.dense_vector(3 * 8 * 8))
+    lbl = layer.data("l", dt.integer_value(3))
+    c = layer.img_conv(x, 3, 8, num_channels=3, act=None, img_size=8,
+                       bias_attr=False, name="bf_c")
+    b = layer.batch_norm(c, act=paddle.activation.Relu(), name="bf_b")
+    pool = layer.img_pool(b, pool_size=8, stride=1,
+                          pool_type=paddle.pooling.Avg())
+    sm = layer.fc(pool, 3, act=paddle.activation.Softmax(), name="bf_s")
+    cost = layer.classification_cost(sm, lbl, name="bf_cost")
+    topo = Topology(cost)
+    params = paddle.parameters.create(cost, KeySource(0))
+    fwd = topo.compile()
+    xv = jnp.asarray(rng.randn(4, 3 * 8 * 8).astype(np.float32))
+    yv = jnp.asarray(rng.randint(0, 3, 4).astype(np.int32))
+
+    def loss(p):
+        outs, _ = fwd(p, params.state, {"x": Value(xv), "l": Value(yv)},
+                      is_training=True)
+        return jnp.mean(outs["bf_cost"].array.astype(jnp.float32))
+
+    g = jax.grad(loss)(params.values)
+    for name, gv in g.items():
+        assert gv.dtype == params.values[name].dtype, name
+        assert bool(jnp.isfinite(gv.astype(jnp.float32)).all()), name
+
+
+def test_transformer_bf16_forward_fp32_logits(rng, bf16_policy):
+    from paddle_tpu.models import transformer
+    cfg = transformer.TransformerConfig(vocab=30, d_model=16, n_heads=2,
+                                        n_layers=1, d_ff=32, max_len=16)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jnp.asarray(rng.randint(0, 30, (2, 8)).astype(np.int32))
+    logits = transformer.forward(params, toks, cfg)
+    # contract: bf16 compute inside, fp32 logits out (loss stability)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.isfinite(logits).all())
