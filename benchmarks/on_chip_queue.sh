@@ -59,7 +59,9 @@ for (n_, h_, c_, k_) in [(2, 56, 64, 64), (2, 7, 512, 512)]:
         xq.reshape(m_, c_), zq.reshape(m_, k_), dy.reshape(m_, k_),
         wc[0, 0], ga, iv, asum, bsum, sx, sz)
     print(f"matmul_bn_bwd int8 M={m_}: ok")
-print("SMOKE OK")
+print("SMOKE OK (if a small-spatial case failed above, set "
+      "paddle_tpu.ops.pallas.conv_bn.MIN_SPATIAL_FOR_KERNEL = 16 or 32 "
+      "and rerun the A/B)")
 EOF
 
 echo "== [2] resnet50 unfused vs fused-BN (the streaming-BN experiment)"

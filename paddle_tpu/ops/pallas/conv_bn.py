@@ -56,6 +56,15 @@ def _dispatch(stride, padding, interpret):
 # through the full layer/model stack on CPU
 FORCE_INTERPRET = False
 
+# Mitigation knob for Mosaic tiling limits on small-spatial stages (the
+# 7x7 blocks; int8 min tile is (32, 128), bf16 (16, 128)): 3x3 kernel
+# paths are taken only when the image W dim is >= this. 0 = always take
+# the kernel (default; flip to 16/32 from the on-chip session if the
+# smoke step shows small-spatial lowering failures — affected layers
+# then fall back to XLA conv + jnp stats, losing only their share of
+# the fused saving).
+MIN_SPATIAL_FOR_KERNEL = 0
+
 
 # ---------------------------------------------------------------------------
 # GEMM + stats (1x1 convs)
@@ -437,7 +446,8 @@ def conv_bn_stats(x, w, *, stride=1, padding="SAME",
             xs.reshape(n * ho * wo, c), w.reshape(c, -1),
             interpret=bool(interpret))
         return y2.reshape(n, ho, wo, -1), s1, s2
-    if use_kernel and kh == 3 and kw == 3 and s == (1, 1) and same:
+    if (use_kernel and kh == 3 and kw == 3 and s == (1, 1) and same
+            and x.shape[2] >= MIN_SPATIAL_FOR_KERNEL):
         return conv3x3_bn_stats(x, w, interpret=bool(interpret))
     y = ops_conv.conv2d(x, w, stride=stride, padding=padding)
     yf = y.astype(jnp.float32)
@@ -564,7 +574,9 @@ def _conv_bn_bwd(stride, padding, eps, interpret, save8, fused_bwd, res,
         else:
             dx = dxs.astype(x_dt)
         dw = dw2.reshape(w.shape).astype(w.dtype)
-    elif use_kernel and kh == 3 and kw == 3 and s == (1, 1) and same:
+    elif (use_kernel and kh == 3 and kw == 3 and s == (1, 1) and same
+          and (qz.shape[2] if save8 else y.shape[2])
+          >= MIN_SPATIAL_FOR_KERNEL):
         if save8:
             dx, dw3 = conv3x3_bn_bwd(
                 qx, qz, dout.astype(out_dt), w, gamma, inv, sum_dy,
