@@ -6,27 +6,35 @@ benchmark/paddle/image/run.sh:9-17, resnet.py topology) — measures steady-
 state train-step time for ResNet-50 (1000 classes, 3x224x224), reporting
 images/sec/chip against the BASELINE.json north star of 4000 images/sec/chip.
 
-Prints exactly ONE JSON line on stdout — always, even when the backend is
-unreachable: a watchdog thread guards every stage (backend init, compile,
-timed steps) and on a stall emits `{"value": 0, ..., "error": ...}` and
-exits, instead of hanging or stack-tracing.
+Prints exactly ONE JSON line on stdout — always.
 
-Tunnel resilience: the backend on this box wedges for long stretches (a
-hung `jax.devices()` or a matmul that never completes). Before committing
-to the full model compile, a small matmul PROBE with a short timeout checks
-the chip actually computes; a wedged attempt is retried in a fresh process
-(re-exec — a second attempt in the same process would just join the stuck
-init) on a backoff schedule of up to BENCH_MAX_ATTEMPTS attempts, capped by
-a BENCH_WALL_BUDGET wall-clock budget. On final failure the JSON carries
-the most recent verified measurement from benchmarks/runs/ as clearly
-labelled `last_verified_value` / `last_verified_ts` fields next to the
-error, never a bare 0.0.
+Architecture (probe-loop orchestrator): the TPU tunnel on this box wedges
+for long stretches — a hung `jax.devices()` or a matmul that never
+returns — and a wedged attempt can only be abandoned, never recovered
+in-process. So the top-level process NEVER imports jax. It loops a cheap
+~100 s matmul-probe subprocess every PROBE_INTERVAL seconds for the whole
+WALL_BUDGET (≈20 chances per hour instead of the old 3 heavyweight
+attempts), and only when a probe confirms the chip actually computes does
+it launch the full bench as a child process. A persistent XLA compilation
+cache (JAX_COMPILATION_CACHE_DIR) means a warm child needs only ~2 min of
+tunnel-up time instead of ~10. If the child dies or the tunnel drops
+mid-bench, the orchestrator just resumes probing with the remaining
+budget. On final failure the JSON carries the most recent verified
+measurement from benchmarks/runs/ as clearly labelled
+`last_verified_value` / `last_verified_ts` fields next to the error,
+never a bare 0.0. SIGTERM at any point (driver timeout) still produces
+the one JSON line.
+
+Every successful record carries `mfu` — model FLOPs utilisation on the
+textbook fwd+bwd count (12.3 GFLOP/image) against the chip's bf16 peak —
+so the gate artifact tracks compute efficiency, not just throughput.
 """
 
 import glob
 import json
 import os
 import signal
+import subprocess
 import sys
 import threading
 import time
@@ -38,37 +46,38 @@ NORTH_STAR = 4000.0  # images/sec/chip (BASELINE.json)
 # ~12.3 GFLOPs/image => ~16k img/s at 100% MXU. Anything above this is a
 # measurement artifact (tunnel sync failure), not throughput.
 PLAUSIBLE_MAX = 20000.0
-INIT_TIMEOUT = float(os.environ.get("BENCH_INIT_TIMEOUT", 420))
+# MFU basis: textbook analytic fwd+bwd FLOPs (not XLA's recompute-inflated
+# count) over the v5e bf16 peak. BENCHMARKS.md documents the basis.
+GFLOP_PER_IMAGE = 12.3
+PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", 197.0))
 PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
+PROBE_INTERVAL = float(os.environ.get("BENCH_PROBE_INTERVAL", 150))
+CHILD_TIMEOUT = float(os.environ.get("BENCH_CHILD_TIMEOUT", 1500))
 COMPILE_TIMEOUT = float(os.environ.get("BENCH_COMPILE_TIMEOUT", 900))
 STEP_TIMEOUT = float(os.environ.get("BENCH_STEP_TIMEOUT", 600))
-ATTEMPT_ENV = "PADDLE_TPU_BENCH_ATTEMPT"
-START_ENV = "PADDLE_TPU_BENCH_START"
-MAX_ATTEMPTS = int(os.environ.get("BENCH_MAX_ATTEMPTS", 5))
-# total wall-clock across all attempts incl. backoff sleeps (seconds);
-# the driver's own timeout may be shorter — the SIGTERM trap below makes
-# sure the one JSON line still gets emitted if we're killed mid-schedule
+# total wall-clock across all probes + bench children (seconds); the
+# driver's own timeout may be shorter — the SIGTERM trap makes sure the
+# one JSON line still gets emitted if we're killed mid-schedule
 WALL_BUDGET = float(os.environ.get("BENCH_WALL_BUDGET", 3600))
-# sleep before re-exec attempt N+1 (index by attempt number, 1-based)
-BACKOFF = (0, 300, 600, 900, 1200)
-RUNS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "benchmarks", "runs")
+# bench children that fail while probes keep passing indicate a
+# deterministic failure (config/code), not tunnel weather — cap them
+MAX_BENCH_ATTEMPTS = int(os.environ.get("BENCH_MAX_ATTEMPTS", 6))
+REPO = os.path.dirname(os.path.abspath(__file__))
+RUNS_DIR = os.path.join(REPO, "benchmarks", "runs")
+CACHE_DIR = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                           os.path.join(REPO, ".jax_cache"))
 # read once; build_train_step and every emitted record use this same value
 STEM_S2D = os.environ.get("BENCH_S2D", "1") == "1"
 # streaming-BN convs (Pallas conv emits batch stats from its epilogue).
 # "0" off | "1" fused fwd stats | "int8" + int8 backward stash | "full"
 # + Pallas backward kernels (benchmarks/traffic_model.py quantifies every
-# lever). Default OFF until
-# an on-chip session validates lowering + wins (benchmarks/
-# on_chip_queue.sh runs the A/B); interpret-mode tests cannot catch
-# Mosaic lowering violations.
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "benchmarks", "configs"))
+# lever). Default set by the on-chip A/B record in BENCHMARKS.md.
+sys.path.insert(0, os.path.join(REPO, "benchmarks", "configs"))
 try:
     from _synth import parse_fused_bn  # noqa: E402 (shared tri-state parse)
     FUSED_BN = parse_fused_bn()
 except Exception:  # noqa: BLE001 — an import crash here would erase the
-    # one-JSON-line contract before any watchdog exists; fall back to the
+    # one-JSON-line contract before any guard exists; fall back to the
     # same parse inline
     _FB = os.environ.get("BENCH_FUSED_BN", "0")
     FUSED_BN = _FB if _FB in ("int8", "full") else _FB == "1"
@@ -86,8 +95,8 @@ def last_verified():
     """Most recent measurement for this metric from benchmarks/runs/.
 
     Returns (value, iso_timestamp, filename) or None. Used to annotate a
-    failure record so a wedged tunnel never erases two rounds of real
-    measurements behind a bare 0.0."""
+    failure record so a wedged tunnel never erases real measurements
+    behind a bare 0.0."""
     best = None
     for path in (glob.glob(os.path.join(RUNS_DIR, "*.json"))
                  + glob.glob(os.path.join(RUNS_DIR, "*.jsonl"))):
@@ -121,6 +130,10 @@ def last_verified():
     return best[:3] if best else None
 
 
+def mfu(ips):
+    return round(ips * GFLOP_PER_IMAGE / (PEAK_TFLOPS * 1e3), 4)
+
+
 def record_run(rec):
     """Append the successful measurement to benchmarks/runs/ so future
     failure records can cite it as last-verified."""
@@ -136,18 +149,22 @@ def record_run(rec):
         log(f"could not record run artifact: {e}")
 
 
+def base_record(value):
+    return {"metric": "resnet50_train_images_per_sec_per_chip",
+            "value": round(value, 1), "unit": "images/sec",
+            "vs_baseline": round(value / NORTH_STAR, 4), "mfu": mfu(value),
+            "stem_space_to_depth": STEM_S2D, "fused_bn": FUSED_BN}
+
+
 def emit(value, error=None, **extra):
     """The one stdout JSON line. Exits the process. First caller wins —
-    the watchdog and the main thread may race at a stage boundary."""
+    a signal handler and the main thread may race at a stage boundary."""
     global _emitted
     with _emit_lock:
         if _emitted:
             os._exit(0)
         _emitted = True
-    rec = {"metric": "resnet50_train_images_per_sec_per_chip",
-           "value": round(value, 1), "unit": "images/sec",
-           "vs_baseline": round(value / NORTH_STAR, 4),
-           "stem_space_to_depth": STEM_S2D, "fused_bn": FUSED_BN}
+    rec = base_record(value)
     rec.update(extra)
     if error:
         rec["error"] = error
@@ -164,18 +181,44 @@ def emit(value, error=None, **extra):
     print(json.dumps(rec), flush=True)
     sys.stdout.flush()
     sys.stderr.flush()
-    # os._exit: a hung backend-init thread or stuck RPC must not block
-    # interpreter shutdown after we have produced the artifact.
+    # os._exit: a hung backend thread must not block interpreter shutdown
+    # after we have produced the artifact.
     os._exit(0 if not error else 1)
 
 
+def _write_status(stage, reason, attempt):
+    """Shadow artifact updated at every attempt boundary: even an
+    untrappable SIGKILL mid-schedule leaves a dated record of what the
+    gate was doing and the last verified number."""
+    try:
+        os.makedirs(RUNS_DIR, exist_ok=True)
+        lv = last_verified()
+        rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), "stage": stage,
+               "reason": reason, "attempt": attempt}
+        if lv:
+            rec["last_verified_value"], rec["last_verified_ts"], \
+                rec["last_verified_file"] = lv
+        tmp = os.path.join(RUNS_DIR, "last_bench_status.tmp")
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, os.path.join(RUNS_DIR, "last_bench_status.json"))
+    except OSError:
+        pass
+
+
+# --------------------------------------------------------------------------
+# child: the actual measurement (runs only after a probe confirmed the chip)
+# --------------------------------------------------------------------------
+
 class Watchdog:
-    """Emits an error artifact and kills the process if a stage stalls."""
+    """Emits an error artifact and kills the process if a stage stalls.
+    Child-only: the orchestrator catches the nonzero exit and keeps
+    probing, so a stall here costs one attempt, not the round."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._stage = "startup"
-        self._deadline = time.time() + INIT_TIMEOUT
+        self._deadline = time.time() + COMPILE_TIMEOUT
         self._best = 0.0
         t = threading.Thread(target=self._watch, daemon=True)
         t.start()
@@ -204,108 +247,28 @@ class Watchdog:
                      f"(no progress within timeout)")
 
 
-def _write_status(stage, reason, attempt):
-    """Shadow artifact updated at every attempt boundary: even an
-    untrappable SIGKILL mid-schedule leaves a dated record of what the
-    gate was doing and the last verified number."""
-    try:
-        os.makedirs(RUNS_DIR, exist_ok=True)
-        lv = last_verified()
-        rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), "stage": stage,
-               "reason": reason, "attempt": attempt}
-        if lv:
-            rec["last_verified_value"], rec["last_verified_ts"], \
-                rec["last_verified_file"] = lv
-        tmp = os.path.join(RUNS_DIR, "last_bench_status.tmp")
-        with open(tmp, "w") as f:
-            json.dump(rec, f)
-        os.replace(tmp, os.path.join(RUNS_DIR, "last_bench_status.json"))
-    except OSError:
-        pass
+def _set_platform():
+    import jax
+    if os.environ.get("BENCH_PLATFORM"):
+        # local testing / driver fallback: the JAX_PLATFORMS env var is
+        # overridden by the site hook, so use the config API
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
 
-def retry_or_fail(dog, reason):
-    """Schedule another fresh-process attempt (with backoff) or emit the
-    final failure record. Wall-clock across attempts is budget-capped."""
-    attempt = int(os.environ.get(ATTEMPT_ENV, 1))
-    start = float(os.environ.get(START_ENV, time.time()))
-    elapsed = time.time() - start
-    _write_status("backoff", reason, attempt)
-    sleep_s = BACKOFF[min(attempt, len(BACKOFF) - 1)]
-    if (attempt >= MAX_ATTEMPTS
-            or elapsed + sleep_s + INIT_TIMEOUT > WALL_BUDGET):
-        emit(0.0, error=f"backend unusable after {attempt} attempt(s) "
-             f"over {elapsed/60:.0f} min: {reason}", attempts=attempt)
-    log(f"attempt {attempt} failed ({reason}); sleeping {sleep_s}s then "
-        f"retrying in a fresh process "
-        f"({elapsed/60:.0f}/{WALL_BUDGET/60:.0f} min used)")
-    # generous watchdog so the sleep itself cannot trip a stall
-    dog.stage(f"backoff-{attempt}", sleep_s + INIT_TIMEOUT)
-    time.sleep(sleep_s)
-    os.environ[ATTEMPT_ENV] = str(attempt + 1)
-    os.environ[START_ENV] = repr(start)
-    sys.stderr.flush()
-    os.execv(sys.executable, [sys.executable] + sys.argv)
-
-
-def _run_with_timeout(fn, timeout):
-    """Run fn in a daemon thread. Returns (ok, result_or_reason). A hung
-    backend call can only be abandoned, not interrupted — the caller must
-    re-exec to get a clean process."""
-    box = {}
-
-    def target():
-        try:
-            box["result"] = fn()
-        except Exception as e:
-            box["error"] = f"{type(e).__name__}: {e}"
-
-    th = threading.Thread(target=target, daemon=True)
-    th.start()
-    th.join(timeout)
-    if th.is_alive():
-        return False, f"hung >{timeout:.0f}s"
-    if "error" in box:
-        return False, box["error"]
-    return True, box.get("result")
-
-
-def init_backend(dog):
-    """jax.devices() + a small matmul probe, both under timeouts. A wedged
+def probe_main():
+    """Subprocess body: exit 0 iff the chip actually computes. A wedged
     tunnel often passes jax.devices() but hangs the first computation, so
-    the probe fails fast before we sink 10+ minutes into the full model
-    compile. Any failure goes through the backoff retry schedule."""
-    os.environ.setdefault(ATTEMPT_ENV, "1")
-    os.environ.setdefault(START_ENV, repr(time.time()))
-    dog.stage("backend-init", INIT_TIMEOUT)
-
-    def get_devices():
-        import jax
-        if os.environ.get("BENCH_PLATFORM"):
-            # local testing / driver fallback: the JAX_PLATFORMS env
-            # var is overridden by the site hook, so use the config API
-            jax.config.update("jax_platforms",
-                              os.environ["BENCH_PLATFORM"])
-        return jax.devices()
-
-    ok, res = _run_with_timeout(get_devices, INIT_TIMEOUT - 10)
-    if not ok:
-        retry_or_fail(dog, f"jax.devices(): {res}")
-    log("devices:", res)
-
-    dog.stage("probe", PROBE_TIMEOUT + 30)
-
-    def probe():
-        import jax.numpy as jnp
-        x = jnp.ones((256, 256), jnp.float32)
-        # host read of a value data-dependent on the matmul: on this
-        # tunnel block_until_ready can return early, a host read cannot
-        return float((x @ x)[0, 0])
-
-    ok, res = _run_with_timeout(probe, PROBE_TIMEOUT)
-    if not ok:
-        retry_or_fail(dog, f"matmul probe: {res}")
-    log(f"probe ok ({res})")
+    the probe does a host read of a matmul-dependent value (on this
+    tunnel block_until_ready can return early, a host read cannot). The
+    orchestrator enforces the timeout; this process just tries."""
+    _set_platform()
+    import jax
+    import jax.numpy as jnp
+    log("probe devices:", jax.devices())
+    x = jnp.ones((256, 256), jnp.float32)
+    v = float((x @ x)[0, 0])
+    log(f"probe matmul ok ({v})")
+    sys.exit(0)
 
 
 def build_train_step():
@@ -373,30 +336,14 @@ def bench_batch(dog, step_fn, carry, batch, warmup=3, iters=20):
     return ips, (p, o, s)
 
 
-def _term_handler(signum, frame):
-    """The driver timing us out must still receive the one JSON line —
-    a killed process with empty stdout erases the round's evidence.
-    Re-entrancy: if an emit() is already in flight (the handler may have
-    interrupted it on this very thread, or the watchdog thread may hold
-    the lock mid-print), DON'T emit again — returning lets the in-flight
-    emit finish and exit; emitting here would deadlock on the
-    non-reentrant lock or truncate the real record."""
-    if not _emit_lock.acquire(blocking=False):
-        return
-    try:
-        if _emitted:
-            os._exit(1)
-    finally:
-        _emit_lock.release()
-    emit(0.0, error=f"killed by signal {signum} (driver timeout) during "
-         f"the retry schedule")
-
-
-def main():
-    signal.signal(signal.SIGTERM, _term_handler)
-    signal.signal(signal.SIGINT, _term_handler)
+def child_main():
+    """Subprocess body: the full measurement. Stdout (the one JSON line)
+    goes to a pipe the orchestrator forwards."""
     dog = Watchdog()
-    init_backend(dog)
+    dog.stage("backend-init", PROBE_TIMEOUT + 60)
+    _set_platform()
+    import jax
+    log("devices:", jax.devices())
     dog.stage("build", 300)
     step_fn, params, opt_state = build_train_step()
     carry = (params.values, opt_state, params.state)
@@ -420,5 +367,163 @@ def main():
     emit(best, error=None if best > 0 else (err or "no batch completed"))
 
 
+# --------------------------------------------------------------------------
+# orchestrator: never imports jax; probes cheaply, escalates on success
+# --------------------------------------------------------------------------
+
+_state = {"probes": 0, "children": 0, "start": time.time()}
+
+
+def _final_fail(reason):
+    elapsed = time.time() - _state["start"]
+    emit(0.0, error=f"backend unusable: {reason} "
+         f"({_state['probes']} probe(s), {_state['children']} bench "
+         f"attempt(s) over {elapsed/60:.0f} min)",
+         probes=_state["probes"], bench_attempts=_state["children"])
+
+
+_current_child = [None]          # in-flight subprocess, for signal cleanup
+
+
+def _orch_term_handler(signum, frame):
+    """The driver timing us out must still receive the one JSON line —
+    a killed process with empty stdout erases the round's evidence. The
+    in-flight probe/bench child is killed first: an orphaned TPU client
+    would wedge the NEXT gate run's probes via the shared remote-compile
+    helper. Re-entrancy: if an emit() is already in flight, returning
+    lets it finish; emitting here would deadlock on the non-reentrant
+    lock."""
+    child = _current_child[0]
+    if child is not None and child.poll() is None:
+        try:
+            child.kill()
+        except OSError:
+            pass
+    if not _emit_lock.acquire(blocking=False):
+        return
+    try:
+        if _emitted:
+            os._exit(1)
+    finally:
+        _emit_lock.release()
+    _final_fail(f"killed by signal {signum} (driver timeout) during "
+                f"the probe schedule")
+
+
+def _run_sub(args, timeout, capture=False):
+    """Run a subprocess with a hard timeout; kill -9 on overrun (a wedged
+    TPU client ignores SIGTERM). Returns (rc, stdout_text). A spawn
+    failure (ENOMEM/EAGAIN) is returned as a failed attempt, never
+    raised — the one-JSON-line contract must survive it."""
+    try:
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)] + args,
+            stdout=subprocess.PIPE if capture else sys.stderr,
+            stderr=sys.stderr, text=True)
+    except OSError as e:
+        log(f"[orch] subprocess spawn failed: {type(e).__name__}: {e}")
+        return -1, ""
+    _current_child[0] = p
+    try:
+        out, _ = p.communicate(timeout=timeout)
+        return p.returncode, out or ""
+    except subprocess.TimeoutExpired:
+        p.kill()
+        try:
+            out, _ = p.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            out = ""
+        return -9, out or ""
+    finally:
+        _current_child[0] = None
+
+
+def orchestrate():
+    signal.signal(signal.SIGTERM, _orch_term_handler)
+    signal.signal(signal.SIGINT, _orch_term_handler)
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+    try:
+        os.makedirs(CACHE_DIR, exist_ok=True)
+    except OSError:
+        pass
+    start = _state["start"]
+    deadline = start + WALL_BUDGET
+    last_reason = "no probe attempted"
+    while True:
+        remaining = deadline - time.time()
+        if remaining < PROBE_TIMEOUT + 30:
+            _final_fail(last_reason)
+        _state["probes"] += 1
+        n = _state["probes"]
+        _write_status("probe", last_reason, n)
+        log(f"[orch] probe {n} "
+            f"({(time.time()-start)/60:.0f}/{WALL_BUDGET/60:.0f} min used)")
+        t0 = time.time()
+        rc, _ = _run_sub(["--probe"], PROBE_TIMEOUT)
+        if rc != 0:
+            last_reason = (f"probe {'hung' if rc == -9 else f'rc={rc}'}"
+                           f" after {time.time()-t0:.0f}s")
+            log(f"[orch] {last_reason}")
+            # wait out the rest of the interval, then try again
+            sleep_s = max(0, PROBE_INTERVAL - (time.time() - t0))
+            if time.time() + sleep_s > deadline - PROBE_TIMEOUT - 30:
+                _final_fail(last_reason)
+            time.sleep(sleep_s)
+            continue
+        log(f"[orch] probe {n} ok in {time.time()-t0:.0f}s — "
+            f"escalating to full bench")
+        _state["children"] += 1
+        _write_status("bench", "probe ok", _state["children"])
+        # a probe-ok window is the scarce resource: a child may overrun
+        # the nominal budget by up to this floor (warm-cache children
+        # finish in ~2-3 min; the SIGTERM trap still guarantees the one
+        # JSON line if the driver cuts in first)
+        child_budget = min(CHILD_TIMEOUT, max(180.0, deadline - time.time()))
+        rc, out = _run_sub(["--child"], child_budget, capture=True)
+        line = next((ln for ln in out.strip().splitlines()
+                     if ln.startswith("{")), "")
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            rec = {}
+        if rec.get("value", 0) > 0:
+            # forward the child's record verbatim (it already appended the
+            # run artifact), annotated with the schedule that produced it.
+            # _emitted flip + print are ONE critical section: a SIGTERM
+            # landing between them must not erase the measurement (the
+            # handler backs off while the lock is held)
+            rec["probes"] = _state["probes"]
+            rec["bench_attempts"] = _state["children"]
+            global _emitted
+            line_out = json.dumps(rec)
+            with _emit_lock:
+                if _emitted:
+                    os._exit(0)
+                _emitted = True
+                print(line_out, flush=True)
+            _write_status("done", "ok", _state["children"])
+            sys.exit(0)
+        last_reason = (rec.get("error")
+                       or f"bench child {'hung' if rc == -9 else f'rc={rc}'}"
+                       f" with no record")
+        log(f"[orch] bench attempt failed: {last_reason}")
+        if _state["children"] >= MAX_BENCH_ATTEMPTS:
+            # a child that keeps failing while probes pass is a
+            # deterministic bug (bad env/config), not tunnel weather —
+            # retrying it for the whole budget would hammer the tunnel
+            _final_fail(f"{_state['children']} bench children failed "
+                        f"(probes pass — deterministic failure): "
+                        f"{last_reason}")
+        # cool down before re-probing so a fast-failing child can't
+        # spin-loop subprocess spawns against the flaky tunnel
+        time.sleep(max(0.0, PROBE_INTERVAL - (time.time() - t0)))
+
+
 if __name__ == "__main__":
-    main()
+    if "--probe" in sys.argv:
+        probe_main()
+    elif "--child" in sys.argv:
+        child_main()
+    else:
+        orchestrate()

@@ -1,0 +1,51 @@
+#!/bin/bash
+# Round-4 queue, part B: the measurements independent of the fused-BN
+# Mosaic debug (which iterates separately). Most-valuable-first.
+set -u
+cd "$(dirname "$0")/.."
+STAMP=$(date +%F_%H%M)
+RUNS=benchmarks/runs
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=2
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
+probe() {
+    timeout 100 python -c "
+import jax, jax.numpy as jnp
+print('probe ok', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" \
+        || { echo "tunnel still down; aborting"; exit 1; }
+}
+
+probe
+
+echo "== [3] transformer seq=8192 (flash fits, plain OOMs)"
+timeout 1800 python benchmarks/transformer_bench.py --seq 8192 --batch 2 \
+    > "$RUNS/${STAMP}_transformer_seq8192.jsonl" 2>/tmp/q2.log \
+    && cat "$RUNS/${STAMP}_transformer_seq8192.jsonl"
+
+echo "== [4] transformer seq=16384 (if it fits)"
+timeout 1800 python benchmarks/transformer_bench.py --seq 16384 --batch 1 \
+    > "$RUNS/${STAMP}_transformer_seq16384.jsonl" 2>/tmp/q16.log \
+    && cat "$RUNS/${STAMP}_transformer_seq16384.jsonl"
+
+echo "== [5] vgg19 sweep bs 64/128/256 (BASELINE.md parity rows)"
+timeout 3000 python benchmarks/run_all.py --suite vgg19 --merge \
+    > "$RUNS/${STAMP}_vgg_sweep.log" 2>&1 \
+    && tail -6 "$RUNS/${STAMP}_vgg_sweep.log"
+
+echo "== [6] transformer seq=4096"
+timeout 1500 python benchmarks/transformer_bench.py --seq 4096 --batch 4 \
+    > "$RUNS/${STAMP}_transformer_seq4096.jsonl" 2>/tmp/q3.log \
+    && cat "$RUNS/${STAMP}_transformer_seq4096.jsonl"
+
+echo "== [7] serving decode throughput: MHA vs GQA KV cache"
+timeout 1200 python benchmarks/transformer_bench.py --decode --batch 8 \
+    --gen 512 > "$RUNS/${STAMP}_decode_gqa.jsonl" 2>/tmp/q_dec.log \
+    && cat "$RUNS/${STAMP}_decode_gqa.jsonl"
+
+echo "== [8] flash block-size tuning sweep"
+timeout 2400 python benchmarks/tune_flash_blocks.py \
+    > "$RUNS/${STAMP}_flash_blocks.log" 2>&1 \
+    && tail -20 "$RUNS/${STAMP}_flash_blocks.log"
+
+echo "done; update BENCHMARKS.md with any new numbers"

@@ -1,0 +1,48 @@
+#!/bin/bash
+# Round-4 queue, part C: the fused conv+BN full-model A/B (the round's
+# centerpiece — kernels are now chip-verified at unit level, this
+# measures the step-level win), plus re-runs of the points that failed
+# under compile-service contention in part B. Run with NOTHING else
+# touching the tunnel: concurrent compiles caused HTTP-500s in part B.
+set -u
+cd "$(dirname "$0")/.."
+STAMP=$(date +%F_%H%M)
+RUNS=benchmarks/runs
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=2
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
+probe() {
+    timeout 100 python -c "
+import jax, jax.numpy as jnp
+print('probe ok', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" \
+        || { echo "tunnel still down; aborting"; exit 1; }
+}
+
+probe
+
+echo "== [1] resnet50 fused-BN A/B: unfused / stats / int8 / full"
+for MODE in 0 1 int8 full; do
+    BENCH_FUSED_BN=$MODE BENCH_WALL_BUDGET=1400 timeout 1500 python bench.py \
+        > "$RUNS/${STAMP}_resnet50_fbn_${MODE}.json" 2>"/tmp/qc_fbn_${MODE}.log"
+    echo "--- mode=$MODE:"; cat "$RUNS/${STAMP}_resnet50_fbn_${MODE}.json"
+done
+
+echo "== [2] transformer seq=16384 flash (contention casualty in part B)"
+timeout 1800 python benchmarks/transformer_bench.py --seq 16384 --batch 1 \
+    > "$RUNS/${STAMP}_transformer_seq16384.jsonl" 2>/tmp/qc_16k.log \
+    && cat "$RUNS/${STAMP}_transformer_seq16384.jsonl"
+
+echo "== [3] transformer seq=4096 plain (contention casualty in part B)"
+timeout 1500 python benchmarks/transformer_bench.py --seq 4096 --batch 4 \
+    --flash off > "$RUNS/${STAMP}_transformer_seq4096_plain.jsonl" \
+    2>/tmp/qc_4kp.log \
+    && cat "$RUNS/${STAMP}_transformer_seq4096_plain.jsonl"
+
+echo "== [4] transformer seq=8192 plain (expect real OOM signature)"
+timeout 1500 python benchmarks/transformer_bench.py --seq 8192 --batch 2 \
+    --flash off > "$RUNS/${STAMP}_transformer_seq8192_plain.jsonl" \
+    2>/tmp/qc_8kp.log \
+    && cat "$RUNS/${STAMP}_transformer_seq8192_plain.jsonl"
+
+echo "done"

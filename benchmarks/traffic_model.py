@@ -54,11 +54,27 @@ def resnet50_convs(img=224):
 
 
 def account(batch, fused_bn=False, stash8=False, fused_bwd=False,
-            act_bytes=BF16):
+            prologue=False, q8_pipe=False, act_bytes=BF16):
     """stash8: backward-saved activations (x for dw, y's centered copy
     for the BN backward) stored int8 — their backward READS halve, at
-    the cost of one extra int8 write per stash in forward."""
+    the cost of one extra int8 write per stash in forward.
+
+    prologue (the block-remat recipe): the BN normalize+ReLU affine is
+    applied in the CONSUMER conv's in-register prologue instead of
+    materializing a normalized copy — the bn_apply read+write pair
+    disappears; each conv reads its producer's RAW output (already
+    counted in conv_io) plus per-channel scale/shift vectors (noise).
+
+    q8_pipe (the fp8-class recipe, int8 on this chip's MXU): activations
+    live in HBM ONLY as centered int8 + per-channel scale, written by the
+    conv's own epilogue under DELAYED scaling (previous step's amax, the
+    standard fp8-training trick that breaks the scale←full-batch-amax
+    dependency); consumer convs dequant+affine+ReLU in the prologue.
+    Forward touches 1 byte/elem each way; the backward is the ``full``
+    fused backward reading the same int8 stashes. dy/dx stay bf16."""
     convs = resnet50_convs()
+    if q8_pipe:
+        prologue = stash8 = fused_bn = fused_bwd = True
     stash_bytes = 1 if stash8 else act_bytes
     detail = {"conv_io": 0.0, "bn_stats": 0.0, "bn_apply": 0.0,
               "bn_bwd": 0.0, "conv_bwd": 0.0, "stash_io": 0.0,
@@ -73,15 +89,22 @@ def account(batch, fused_bn=False, stash8=False, fused_bwd=False,
         x8 = x_elems * stash_bytes
         w_elems = k * k * cin * cout
         n_params += w_elems + 2 * cout
-        # forward conv: read x, write y
-        detail["conv_io"] += x + y
+        if q8_pipe:
+            # forward conv: read producer's int8 stash, write own int8
+            # stash from the epilogue — the bf16 activation never exists
+            detail["conv_io"] += x8 + y8
+        else:
+            # forward conv: read x, write y
+            detail["conv_io"] += x + y
         # forward BN stats pass (deleted by streaming BN)
         if not fused_bn:
             detail["bn_stats"] += y
         # forward BN normalize: read y, write y-normalized (the write is
-        # what the next op reads; counted once)
-        detail["bn_apply"] += 2 * y
-        if stash8:
+        # what the next op reads; counted once). With an affine prologue
+        # the consumer applies it in-register: no traffic at all.
+        if not prologue:
+            detail["bn_apply"] += 2 * y
+        if stash8 and not q8_pipe:
             # extra int8 writes of the two stashes
             detail["stash_io"] += x8 + y8
         if fused_bwd:
@@ -110,7 +133,12 @@ def main():
                  ("fused (streaming BN)", dict(fused_bn=True)),
                  ("fused + int8 stash", dict(fused_bn=True, stash8=True)),
                  ("full (+ fused backward)",
-                  dict(fused_bn=True, stash8=True, fused_bwd=True))]
+                  dict(fused_bn=True, stash8=True, fused_bwd=True)),
+                 ("full + affine prologue (block remat)",
+                  dict(fused_bn=True, stash8=True, fused_bwd=True,
+                       prologue=True)),
+                 ("q8 pipeline (fp8-class, delayed scaling)",
+                  dict(q8_pipe=True))]
     totals = {}
     for name, kw in scenarios:
         total, detail, _ = account(args.batch, **kw)

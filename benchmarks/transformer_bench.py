@@ -39,6 +39,9 @@ def main():
                          "of training")
     ap.add_argument("--gen", type=int, default=256,
                     help="tokens to generate per decode measurement")
+    ap.add_argument("--flash", choices=("both", "on", "off"),
+                    default="both",
+                    help="which attention variants to measure")
     args = ap.parse_args()
 
     import jax
@@ -55,7 +58,9 @@ def main():
     tokens = jnp.asarray(rng.randint(0, args.vocab,
                                      (args.batch, args.seq)), jnp.int32)
 
-    for use_flash in (False, True):
+    variants = {"both": (False, True), "on": (True,),
+                "off": (False,)}[args.flash]
+    for use_flash in variants:
         try:
             _run_variant(args, tfm, jax, jnp, tokens, use_flash)
         except Exception as e:

@@ -146,7 +146,7 @@ class LMServer:
         if tp + max_new > self.meta["cache_len"]:
             raise ValueError(f"{tp + max_new} positions exceed the "
                              f"exported cache_len {self.meta['cache_len']}")
-        rng = np.random.RandomState(seed or 0)
+        rng = np.random.RandomState(0 if seed is None else seed)
 
         def sample(logits):
             if temperature <= 0:

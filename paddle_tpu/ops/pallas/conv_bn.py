@@ -41,15 +41,19 @@ def _on_tpu():
 
 
 def _dispatch(stride, padding, interpret):
-    """Shared forward/backward kernel gating: normalized stride, SAME-ness
-    and whether the Pallas path runs (identical conditions both ways)."""
+    """Shared forward/backward kernel gating: normalized stride, SAME-ness,
+    1x1-eligibility and whether the Pallas path runs (identical conditions
+    both ways). pad0: paddings under which a 1x1 conv is a plain GEMM —
+    a nonzero integer padding changes the output spatial dims, which the
+    GEMM path would silently ignore, so it must fall back to XLA conv."""
     s = (stride, stride) if isinstance(stride, int) else tuple(stride)
     same = (padding == "SAME" or padding == ((1, 1), (1, 1))
             or padding == 1)
+    pad0 = padding in ("SAME", "VALID", 0, (0, 0), ((0, 0), (0, 0)))
     if interpret is None and FORCE_INTERPRET:
         interpret = True
     use_kernel = interpret if interpret is not None else _on_tpu()
-    return s, same, use_kernel, interpret
+    return s, same, pad0, use_kernel, interpret
 
 
 # tests monkeypatch this to drive the Pallas kernels in interpret mode
@@ -70,11 +74,29 @@ MIN_SPATIAL_FOR_KERNEL = 0
 # GEMM + stats (1x1 convs)
 # ---------------------------------------------------------------------------
 
-def _mm_stats_kernel(x_ref, w_ref, y_ref, stats_ref, *, bm, bk, m_total):
-    mi = pl.program_id(0)
-    ki = pl.program_id(1)
+# stats rows ride in an (8, K) block: 8 matches the sublane tile (a
+# (1..2, K) output block is exactly the shape this chip's Mosaic tiling
+# rejects — see the lse layout note in ops/pallas/attention.py) and the
+# row updates are iota-selects, not 1-D row stores. Row 0 = Σy,
+# row 1 = Σy²; rows 2..7 are padding.
+_STATS_ROWS = 8
 
-    @pl.when((mi == 0) & (ki == 0))
+
+def _stats_update(s1, s2, bk):
+    """(8, bk) update tensor holding s1 in row 0 and s2 in row 1."""
+    rows = lax.broadcasted_iota(jnp.int32, (_STATS_ROWS, bk), 0)
+    return (jnp.where(rows == 0, s1[None, :], 0.0)
+            + jnp.where(rows == 1, s2[None, :], 0.0))
+
+
+def _mm_stats_kernel(x_ref, w_ref, y_ref, stats_ref, *, bm, bk, m_total):
+    ki = pl.program_id(0)
+    mi = pl.program_id(1)
+    del ki  # the stats block is selected by the BlockSpec index map —
+    # a dynamic lane-dim slice here is what Mosaic rejects ("cannot
+    # statically prove index in dimension 1 is a multiple of 128")
+
+    @pl.when(mi == 0)
     def _init():
         stats_ref[...] = jnp.zeros_like(stats_ref)
 
@@ -87,8 +109,8 @@ def _mm_stats_kernel(x_ref, w_ref, y_ref, stats_ref, *, bm, bk, m_total):
     rows = mi * bm + lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
     valid = (rows < m_total).astype(jnp.float32)
     accv = acc * valid
-    stats_ref[0, pl.ds(ki * bk, bk)] += jnp.sum(accv, axis=0)
-    stats_ref[1, pl.ds(ki * bk, bk)] += jnp.sum(accv * acc, axis=0)
+    stats_ref[...] += _stats_update(jnp.sum(accv, axis=0),
+                                    jnp.sum(accv * acc, axis=0), bk)
 
 
 def matmul_bn_stats(x2: jax.Array, w2: jax.Array, *, out_dtype=None,
@@ -109,24 +131,26 @@ def matmul_bn_stats(x2: jax.Array, w2: jax.Array, *, out_dtype=None,
         x2 = jnp.pad(x2, ((0, mp - m), (0, 0)))
     if kp != k:
         w2 = jnp.pad(w2, ((0, 0), (0, kp - k)))
-    grid = (mp // bm, kp // bk)
+    # ki is the OUTER grid dim: for each stats block the mi sweep is a
+    # run of consecutive revisits (accumulate in VMEM, one writeback);
+    # the block's lane offset comes from the index map, never a dynamic
+    # in-kernel slice.
+    grid = (kp // bk, mp // bm)
     kernel = functools.partial(_mm_stats_kernel, bm=bm, bk=bk, m_total=m)
     y, stats = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((bm, c), lambda mi, ki: (mi, 0)),
-            pl.BlockSpec((c, bk), lambda mi, ki: (0, ki)),
+            pl.BlockSpec((bm, c), lambda ki, mi: (mi, 0)),
+            pl.BlockSpec((c, bk), lambda ki, mi: (0, ki)),
         ],
         out_specs=[
-            pl.BlockSpec((bm, bk), lambda mi, ki: (mi, ki)),
-            # whole-array stats block: revisited by every grid step, so
-            # the += accumulation is safe on the sequential TPU grid
-            pl.BlockSpec((2, kp), lambda mi, ki: (0, 0)),
+            pl.BlockSpec((bm, bk), lambda ki, mi: (mi, ki)),
+            pl.BlockSpec((_STATS_ROWS, bk), lambda ki, mi: (0, ki)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((mp, kp), out_dtype),
-            jax.ShapeDtypeStruct((2, kp), jnp.float32),
+            jax.ShapeDtypeStruct((_STATS_ROWS, kp), jnp.float32),
         ],
         interpret=interpret,
     )(x2, w2)
@@ -153,8 +177,8 @@ def _conv3_stats_kernel(x_ref, w_ref, y_ref, stats_ref, *, bh, wdim, kdim):
             xs = xs.reshape(bh * wdim, xs.shape[-1]).astype(jnp.float32)
             acc += xs @ w_ref[dy, dx].astype(jnp.float32)
     y_ref[0] = acc.reshape(bh, wdim, kdim).astype(y_ref.dtype)
-    stats_ref[0] += jnp.sum(acc, axis=0)
-    stats_ref[1] += jnp.sum(acc * acc, axis=0)
+    stats_ref[...] += _stats_update(jnp.sum(acc, axis=0),
+                                    jnp.sum(acc * acc, axis=0), kdim)
 
 
 def conv3x3_bn_stats(x: jax.Array, w: jax.Array, *, out_dtype=None,
@@ -186,11 +210,11 @@ def conv3x3_bn_stats(x: jax.Array, w: jax.Array, *, out_dtype=None,
         ],
         out_specs=[
             pl.BlockSpec((1, block_h, wd, k), lambda ni, hi: (ni, hi, 0, 0)),
-            pl.BlockSpec((2, k), lambda ni, hi: (0, 0)),
+            pl.BlockSpec((_STATS_ROWS, k), lambda ni, hi: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n, h, wd, k), out_dtype),
-            jax.ShapeDtypeStruct((2, k), jnp.float32),
+            jax.ShapeDtypeStruct((_STATS_ROWS, k), jnp.float32),
         ],
         interpret=interpret,
     )(xp, w)
@@ -291,7 +315,7 @@ def matmul_bn_bwd(x2, z2, dy2, w2, gamma, inv, a_sum, b_sum, *,
         # term survives); pad dy with A/n instead so g_pad ≡ 0 exactly
         # (z_pad = 0): then padded dx rows are sliced off and padded x
         # rows (zeros) contribute nothing to dw either way
-        fill = a_row[None, :] / n_total               # [1, K]
+        fill = (a_row[None, :] / n_total).astype(dy2.dtype)   # [1, K]
         dy2 = dy2.at[m:, :].set(jnp.broadcast_to(fill, (mp - m, k)))
     grid = (mp // bm,)
     # dx: g @ w^T
@@ -438,8 +462,9 @@ def conv_bn_stats(x, w, *, stride=1, padding="SAME",
     x = x.astype(cdt)
     w = w.astype(cdt)
     kh, kw = w.shape[0], w.shape[1]
-    s, same, use_kernel, interpret = _dispatch(stride, padding, interpret)
-    if use_kernel and kh == 1 and kw == 1:
+    s, same, pad0, use_kernel, interpret = _dispatch(stride, padding,
+                                                     interpret)
+    if use_kernel and kh == 1 and kw == 1 and pad0:
         xs = x[:, ::s[0], ::s[1], :]
         n, ho, wo, c = xs.shape
         y2, s1, s2 = matmul_bn_stats(
@@ -540,12 +565,13 @@ def _conv_bn_bwd(stride, padding, eps, interpret, save8, fused_bwd, res,
     sum_dy_yhat = jnp.sum(dout * yhat, axis=axes)
 
     kh, kw = w.shape[0], w.shape[1]
-    s, same, kernel_ok, interpret = _dispatch(stride, padding, interpret)
+    s, same, pad0, kernel_ok, interpret = _dispatch(stride, padding,
+                                                    interpret)
     use_kernel = fused_bwd and kernel_ok
     out_dt = cts[0].dtype
     # the dx cotangent must carry the PRIMAL x dtype exactly
     x_dt = xtok.dtype if save8 else x.dtype
-    if use_kernel and kh == 1 and kw == 1:
+    if use_kernel and kh == 1 and kw == 1 and pad0:
         # g recomputed inside the dx/dw GEMM kernels — never hits HBM;
         # with save8 the kernels read the raw int8 stashes directly
         c = x.shape[-1] if not save8 else qx.shape[-1]

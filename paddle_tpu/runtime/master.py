@@ -101,6 +101,11 @@ class MasterService:
         # zombie must not clobber the new leader's snapshot
         self.fence = None
         self.snapshot_interval = snapshot_interval
+        # save-model election state: (holder trainer_id, grant expiry).
+        # Deliberately NOT snapshotted — after failover re-electing a
+        # saver is harmless (worst case one extra checkpoint), whereas a
+        # restored stale grant could block saves for a full window.
+        self._save_grant = (None, 0.0)
         if snapshot_path and os.path.exists(snapshot_path):
             self._restore()
         if snapshot_path:
@@ -216,6 +221,24 @@ class MasterService:
             self._todo = finished
             for t in self._todo:
                 t.fail_count = 0
+
+    # -- save-model election ----------------------------------------------
+    def request_save_model(self, trainer_id: str,
+                           block_dur: float = 60.0) -> bool:
+        """Elect ONE trainer to save the model: the first asker within a
+        ``block_dur`` window gets True, everyone else False until the
+        window expires (reference: go/master/service.go RequestSaveModel
+        / python/paddle/v2/master/client.py:24 request_save_model — the
+        mechanism that stops N data-parallel trainers writing N identical
+        checkpoints). Re-asking while holding the grant is idempotent, so
+        a saver that retries its RPC keeps its election."""
+        with self._lock:
+            now = self._time()
+            holder, expiry = self._save_grant
+            if holder is not None and now < expiry and holder != trainer_id:
+                return False
+            self._save_grant = (trainer_id, now + block_dur)
+            return True
 
     # -- introspection -----------------------------------------------------
     def num_todo(self):
@@ -338,6 +361,9 @@ class _Handler(socketserver.StreamRequestHandler):
                     resp = {"todo": svc.num_todo(),
                             "pending": svc.num_pending(),
                             "epoch": svc.epoch()}
+                elif method == "request_save_model":
+                    resp = {"ok": svc.request_save_model(
+                        req["trainer_id"], req.get("block_dur", 60.0))}
                 else:
                     resp = {"error": f"unknown method {method}"}
             except Exception as e:                   # noqa: BLE001
@@ -381,7 +407,17 @@ class LeaderLock:
     candidates exactly one succeeds and the rest see ENOENT and back off
     — nobody can delete a lock a new winner just created (the unlink+
     create scheme had exactly that hole). (Reference:
-    go/master/etcd_client.go campaign/lock.)"""
+    go/master/etcd_client.go campaign/lock.)
+
+    Clock assumption: staleness compares the info file's mtime (stamped
+    by the FILESYSTEM) against the candidate's ``time.time()``. On one
+    host (the launch.py topology) both come from the same clock and the
+    comparison is exact. On a shared filesystem with replicas on
+    different hosts, clock skew between the fs server and a candidate
+    shifts the perceived age by the skew — keep ``stale_after`` well
+    above the worst-case skew (or run candidates on one host). Term
+    fencing bounds the damage of a premature takeover to one heartbeat
+    interval either way."""
 
     def __init__(self, path: str, stale_after: float = 3.0,
                  heartbeat_interval: float = 0.5):
@@ -665,6 +701,9 @@ class MasterClient:
                 return {"todo": self._svc.num_todo(),
                         "pending": self._svc.num_pending(),
                         "epoch": self._svc.epoch()}
+            if method == "request_save_model":
+                return {"ok": self._svc.request_save_model(
+                    kw["trainer_id"], kw.get("block_dur", 60.0))}
         deadline = time.time() + self._failover_timeout
         while True:
             try:
@@ -690,6 +729,14 @@ class MasterClient:
 
     def status(self):
         return self._rpc("status")
+
+    def request_save_model(self, trainer_id: str,
+                           block_dur: float = 60.0) -> bool:
+        """True iff THIS trainer is elected to save the model for the
+        next ``block_dur`` window (python/paddle/v2/master/client.py:24).
+        Typical use: ``if client.request_save_model(my_id): save()``."""
+        return bool(self._rpc("request_save_model", trainer_id=trainer_id,
+                              block_dur=block_dur)["ok"])
 
     def close(self):
         if self._sock is not None:

@@ -249,18 +249,51 @@ class SGD:
             if ckpt is not None:
                 ckpt.close()
 
+    def _prefetch_feeds(self, reader, feeder):
+        """One-batch-lookahead feed pipeline: batch N+1 is fed and its
+        (asynchronous) host→device transfer dispatched BEFORE batch N is
+        yielded, so the transfer rides under batch N's step instead of
+        serializing after the step's host sync (the reference's data
+        providers double-buffer into the trainer the same way —
+        PyDataProvider2.cpp:195 async pool). jax.device_put returns
+        immediately with the copy in flight; the step that consumes the
+        buffer joins it on-device."""
+        prev = None
+        it = iter(reader())
+        while True:
+            try:
+                data_batch = next(it)
+                # feed() already dispatches the H2D copies (jnp.asarray
+                # is asynchronous); the sharded put is likewise async
+                feeds = feeder.feed(data_batch)
+                if self.parallel is not None:
+                    feeds = jax.device_put(
+                        feeds, self.parallel.feed_shardings(feeds))
+            except StopIteration:
+                break
+            except Exception:
+                # batch N is already fed; train it before surfacing
+                # batch N+1's failure, or the crash would both lose N
+                # and point at the wrong batch index
+                if prev is not None:
+                    yield prev
+                    prev = None
+                raise
+            if prev is not None:
+                yield prev
+            prev = feeds
+        if prev is not None:
+            yield prev
+
     def _train_passes(self, reader, num_passes, event_handler, feeder, ks,
                       log_period, ckpt, period):
         for pass_id in range(num_passes):
             event_handler(events.BeginPass(pass_id))
             self.evaluators.reset()
-            for batch_id, data_batch in enumerate(reader()):
+            for batch_id, feeds in enumerate(
+                    self._prefetch_feeds(reader, feeder)):
                 event_handler(events.BeginIteration(pass_id, batch_id))
                 with stat.timer_scope("train_step"):
-                    feeds = feeder.feed(data_batch)
-                    if self.parallel is not None:
-                        feeds = jax.device_put(
-                            feeds, self.parallel.feed_shardings(feeds))
                     dropout_key = ks.step("dropout", self._step)
                     (loss, self.parameters.values, self.opt_state,
                      self.parameters.state, outs) = self._pick_train_step(

@@ -1,10 +1,11 @@
-"""Version info (reference: paddle/utils/Version.cpp, cmake version stamping)."""
+"""Version info (reference: paddle/utils/Version.cpp, cmake version stamping).
 
-__version__ = "0.3.0"
+major/minor/patch are derived from __version__ so the two can never drift.
+"""
 
-major = 0
-minor = 1
-patch = 0
+__version__ = "0.4.0"
+
+major, minor, patch = (int(p) for p in __version__.split("."))
 rc = 0
 istaged = False
 with_tpu = True

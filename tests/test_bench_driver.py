@@ -1,6 +1,7 @@
 """Unit tests for the bench.py gate driver: last-verified selection,
-retry/backoff decisions, and run-artifact recording — hermetic (no
-backend touched; process-exiting paths stubbed)."""
+probe-loop orchestration decisions, MFU annotation, and run-artifact
+recording — hermetic (no backend touched; process-exiting paths stubbed,
+subprocesses faked, clock virtualised)."""
 
 import importlib.util
 import json
@@ -19,6 +20,7 @@ def bench(tmp_path, monkeypatch):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     mod.RUNS_DIR = str(tmp_path / "runs")
+    mod.CACHE_DIR = str(tmp_path / "cache")
     os.makedirs(mod.RUNS_DIR, exist_ok=True)
     return mod
 
@@ -60,52 +62,125 @@ class TestLastVerified:
         assert ts.startswith("20")            # ISO timestamp recorded
 
 
-class TestRetrySchedule:
-    def _run(self, bench, monkeypatch, attempt, elapsed_min):
-        """Drive retry_or_fail with stubbed exit paths; returns
-        ('retry', sleep_s) or ('fail', record)."""
-        calls = {}
+class TestMfu:
+    def test_basis(self, bench):
+        # 4000 img/s * 12.3 GFLOP/img over 197 TFLOP/s peak ~ 25%
+        assert bench.mfu(4000.0) == pytest.approx(0.2497, abs=1e-3)
+
+    def test_in_record(self, bench):
+        rec = bench.base_record(2537.3)
+        assert rec["mfu"] == pytest.approx(
+            2537.3 * bench.GFLOP_PER_IMAGE / (bench.PEAK_TFLOPS * 1e3),
+            abs=1e-4)
+        assert rec["vs_baseline"] == pytest.approx(2537.3 / 4000.0,
+                                                   abs=1e-4)
+
+
+class TestOrchestrator:
+    """Drives orchestrate() with faked subprocess results and a virtual
+    clock: every fake probe/child consumes 30 s, sleeps advance the
+    clock instantly."""
+
+    def _drive(self, bench, monkeypatch, capsys, script, budget=3600):
+        clock = {"t": 1_000_000.0}
+        monkeypatch.setattr(bench.time, "time", lambda: clock["t"])
+        monkeypatch.setattr(bench.time, "sleep",
+                            lambda s: clock.update(t=clock["t"] + s))
+        monkeypatch.setattr(bench, "WALL_BUDGET", float(budget))
+        bench._state.update(probes=0, children=0, start=clock["t"])
+        it = iter(script)
+        seen = []
+
+        def fake_run_sub(args, timeout, capture=False):
+            clock["t"] += 30
+            kind = "probe" if "--probe" in args else "child"
+            seen.append(kind)
+            try:
+                want, rc, out = next(it)
+            except StopIteration:
+                want, rc, out = "probe", -9, ""
+            assert want == kind, f"expected {want} subprocess, got {kind}"
+            return rc, out
+
+        monkeypatch.setattr(bench, "_run_sub", fake_run_sub)
+        emitted = {}
 
         def fake_emit(value, error=None, **extra):
-            calls["emit"] = (value, error, extra)
-            raise SystemExit
+            emitted.update(value=value, error=error, **extra)
+            raise SystemExit(1 if error else 0)
 
-        def fake_execv(*a):
-            calls["execv"] = True
-            raise SystemExit
-
-        slept = []
         monkeypatch.setattr(bench, "emit", fake_emit)
-        monkeypatch.setattr(bench.os, "execv", fake_execv)
-        monkeypatch.setattr(bench.time, "sleep",
-                            lambda s: slept.append(s))
-        monkeypatch.setenv(bench.ATTEMPT_ENV, str(attempt))
-        monkeypatch.setenv(
-            bench.START_ENV,
-            repr(bench.time.time() - elapsed_min * 60))
-
-        class Dog:
-            def stage(self, *a, **k):
-                pass
-
+        monkeypatch.setattr(bench.signal, "signal", lambda *a: None)
         with pytest.raises(SystemExit):
-            bench.retry_or_fail(Dog(), "probe hung")
-        if "execv" in calls:
-            return "retry", (slept[0] if slept else 0)
-        return "fail", calls["emit"]
+            bench.orchestrate()
+        return emitted, capsys.readouterr().out, seen
 
-    def test_first_attempts_retry_with_backoff(self, bench, monkeypatch):
-        kind, sleep_s = self._run(bench, monkeypatch, attempt=1,
-                                  elapsed_min=1)
-        assert kind == "retry" and sleep_s == bench.BACKOFF[1]
+    def test_probe_failures_exhaust_budget(self, bench, monkeypatch,
+                                           capsys):
+        emitted, out, seen = self._drive(
+            bench, monkeypatch, capsys,
+            script=[("probe", -9, "")] * 50, budget=1200)
+        assert emitted["value"] == 0.0
+        assert "probe hung" in emitted["error"]
+        # cheap probes: several attempts fit in the budget (the old
+        # design got ~1 heavyweight attempt in 20 min)
+        assert emitted["probes"] >= 4
+        assert "child" not in seen
 
-    def test_attempt_cap_fails(self, bench, monkeypatch):
-        kind, (value, error, extra) = self._run(
-            bench, monkeypatch, attempt=bench.MAX_ATTEMPTS, elapsed_min=5)
-        assert kind == "fail" and value == 0.0
-        assert "probe hung" in error
+    def test_probe_success_escalates_and_forwards_record(
+            self, bench, monkeypatch, capsys):
+        child_line = json.dumps({"metric": METRIC, "value": 3200.0,
+                                 "unit": "images/sec", "mfu": 0.2})
+        emitted, out, seen = self._drive(
+            bench, monkeypatch, capsys,
+            script=[("probe", -9, ""), ("probe", 0, ""),
+                    ("child", 0, child_line + "\n")])
+        assert not emitted                     # no failure emit
+        rec = json.loads(out.strip())
+        assert rec["value"] == 3200.0
+        assert rec["probes"] == 2 and rec["bench_attempts"] == 1
+        assert seen == ["probe", "probe", "child"]
 
-    def test_wall_budget_exhaustion_fails(self, bench, monkeypatch):
-        kind, _ = self._run(bench, monkeypatch, attempt=2,
-                            elapsed_min=bench.WALL_BUDGET / 60 + 1)
-        assert kind == "fail"
+    def test_failed_child_resumes_probing(self, bench, monkeypatch,
+                                          capsys):
+        good = json.dumps({"metric": METRIC, "value": 2600.0})
+        emitted, out, seen = self._drive(
+            bench, monkeypatch, capsys,
+            script=[("probe", 0, ""), ("child", -9, ""),
+                    ("probe", 0, ""), ("child", 0, good + "\n")])
+        rec = json.loads(out.strip())
+        assert rec["value"] == 2600.0 and rec["bench_attempts"] == 2
+
+    def test_child_zero_value_record_is_a_failure(self, bench,
+                                                  monkeypatch, capsys):
+        zero = json.dumps({"metric": METRIC, "value": 0.0,
+                           "error": "stalled in stage 'compile'"})
+        emitted, out, seen = self._drive(
+            bench, monkeypatch, capsys,
+            script=[("probe", 0, ""), ("child", 1, zero + "\n")],
+            budget=200)
+        assert emitted["value"] == 0.0
+        assert "stalled" in emitted["error"]
+
+    def test_deterministic_child_failure_capped(self, bench, monkeypatch,
+                                                capsys):
+        """Children failing while probes pass = a code/config bug, not
+        tunnel weather: stop after MAX_BENCH_ATTEMPTS instead of
+        hammering the tunnel for the whole budget."""
+        bad = json.dumps({"metric": METRIC, "value": 0.0,
+                          "error": "ValueError: bad batch size"})
+        script = [("probe", 0, ""), ("child", 1, bad + "\n")] * 10
+        emitted, out, seen = self._drive(bench, monkeypatch, capsys,
+                                         script=script, budget=36000)
+        assert emitted["value"] == 0.0
+        assert "deterministic" in emitted["error"]
+        assert seen.count("child") == bench.MAX_BENCH_ATTEMPTS
+
+    def test_status_shadow_artifact_written(self, bench, monkeypatch,
+                                            capsys):
+        self._drive(bench, monkeypatch, capsys,
+                    script=[("probe", -9, "")] * 50, budget=900)
+        path = os.path.join(bench.RUNS_DIR, "last_bench_status.json")
+        with open(path) as f:
+            rec = json.load(f)
+        assert rec["stage"] == "probe"

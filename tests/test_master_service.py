@@ -110,6 +110,60 @@ class TestMasterService:
         assert sorted(recs) == list(range(100))
 
 
+class TestSaveModelElection:
+    """go/master/service.go RequestSaveModel semantics: exactly one
+    trainer is elected to save per window (the reference's guard against
+    N data-parallel trainers writing N identical checkpoints,
+    python/paddle/v2/master/client.py:24)."""
+
+    def test_one_winner_per_window(self):
+        clock = FakeClock()
+        svc = MasterService(time_fn=clock)
+        grants = [svc.request_save_model(f"trainer-{i}", block_dur=60)
+                  for i in range(8)]
+        assert grants == [True] + [False] * 7
+
+    def test_holder_retry_is_idempotent_and_window_expires(self):
+        clock = FakeClock()
+        svc = MasterService(time_fn=clock)
+        assert svc.request_save_model("a", block_dur=10)
+        assert svc.request_save_model("a", block_dur=10)   # retry keeps it
+        assert not svc.request_save_model("b", block_dur=10)
+        clock.t = 11.0                                     # window over
+        assert svc.request_save_model("b", block_dur=10)
+        assert not svc.request_save_model("a", block_dur=10)
+
+    def test_elected_trainer_over_tcp(self, tmp_path):
+        """N concurrent clients race the RPC; exactly one saver emerges
+        and writes the (single) checkpoint file."""
+        import threading
+        svc = MasterService()
+        server = MasterServer(svc, port=0)
+        try:
+            wins = []
+            lock = threading.Lock()
+
+            def trainer(i):
+                c = MasterClient(addr=server.addr)
+                if c.request_save_model(f"t{i}", block_dur=60):
+                    path = tmp_path / f"model-t{i}.ckpt"
+                    path.write_text("params")
+                    with lock:
+                        wins.append(i)
+                c.close()
+
+            threads = [threading.Thread(target=trainer, args=(i,))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(wins) == 1
+            assert len(list(tmp_path.glob("model-*.ckpt"))) == 1
+        finally:
+            server.shutdown()
+
+
 class TestChunkGrouping:
     def test_chunks_per_task_groups_without_id_collisions(self, rio):
         svc = MasterService(num_passes=1)

@@ -104,6 +104,42 @@ def test_params_tar_roundtrip(trained, tmp_path):
     np.testing.assert_allclose(p2["pred.w"], params["pred.w"])
 
 
+class TestPrefetchFeeds:
+    """The feed pipeline must run one batch AHEAD of consumption so the
+    H2D transfer overlaps the in-flight step (the reference's
+    double-buffering data providers, PyDataProvider2.cpp:195)."""
+
+    def test_one_batch_lookahead_order(self):
+        from paddle_tpu import trainer as trainer_mod
+
+        log = []
+
+        class SpyFeeder:
+            def feed(self, b):
+                log.append(("feed", b))
+                return {"x": b}
+
+        sgd = object.__new__(trainer_mod.SGD)
+        sgd.parallel = None
+        for got in sgd._prefetch_feeds(lambda: iter(range(3)),
+                                       SpyFeeder()):
+            log.append(("consume", got["x"]))
+        # feed(N+1) is dispatched before batch N is consumed
+        assert log == [("feed", 0), ("feed", 1), ("consume", 0),
+                       ("feed", 2), ("consume", 1), ("consume", 2)]
+
+    def test_empty_reader_yields_nothing(self):
+        from paddle_tpu import trainer as trainer_mod
+
+        class F:
+            def feed(self, b):           # pragma: no cover
+                raise AssertionError("must not be called")
+
+        sgd = object.__new__(trainer_mod.SGD)
+        sgd.parallel = None
+        assert list(sgd._prefetch_feeds(lambda: iter([]), F())) == []
+
+
 class TestGradAccum:
     def _train(self, accum, batches=6, batch=32):
         import paddle_tpu as paddle
