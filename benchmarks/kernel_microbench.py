@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Per-kernel A/B: Pallas conv_bn kernels vs the XLA ops they replace,
+at the exact ResNet-50 shapes (bs from --batch). Answers WHERE the
+step-level fused-BN regression comes from — the step A/B showed
+fused modes slower than unfused despite moving fewer bytes, so at least
+one kernel must be far off the XLA conv's throughput.
+
+For each shape the XLA side computes conv + the stats reduction it
+would need anyway (sum/sumsq over y) so both sides do equivalent work.
+Prints one JSON line per (shape, impl) with ms and effective GB/s.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def bench(fn, *args, iters=30, warmup=5):
+    """host_sync, not block_until_ready: on this tunnel the latter can
+    return early (see bench.py probe note), yielding impossible TB/s
+    numbers. A host read of a value data-dependent on the last iteration
+    cannot."""
+    from paddle_tpu.utils.sync import host_sync
+    for _ in range(warmup):
+        out = fn(*args)
+    host_sync(out)
+    t0 = time.time()
+    for _ in range(iters):
+        out = fn(*args)
+    host_sync(out)
+    return (time.time() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    from paddle_tpu.ops import conv as ops_conv
+    from paddle_tpu.ops.pallas import conv_bn as fused
+
+    rng = np.random.RandomState(0)
+    b = args.batch
+
+    # ResNet-50's 1x1 menu: (H, Cin, Cout, stride tag is irrelevant to
+    # the GEMM — M absorbs it)
+    one_by_one = [(56, 64, 64), (56, 64, 256), (56, 256, 64),
+                  (28, 128, 512), (28, 512, 128), (14, 256, 1024),
+                  (14, 1024, 256), (7, 512, 2048), (7, 2048, 512)]
+    three_by_three = [(56, 64), (28, 128), (14, 256), (7, 512)]
+
+    for h, cin, cout in one_by_one:
+        m = b * h * h
+        x = jnp.asarray(rng.randn(m, cin).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+        w = jnp.asarray((rng.randn(cin, cout) * 0.05).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+
+        def xla_side(a, b_):
+            y = (a @ b_).astype(jnp.bfloat16)
+            yf = y.astype(jnp.float32)
+            return y, jnp.sum(yf, 0), jnp.sum(yf * yf, 0)
+
+        t_x = bench(jax.jit(xla_side), x, w)
+        t_p = bench(jax.jit(lambda a, b_: fused.matmul_bn_stats(a, b_)),
+                    x, w)
+        gb = (m * cin + m * cout + cin * cout) * 2 / 1e9
+        print(json.dumps({
+            "kernel": "1x1", "H": h, "Cin": cin, "Cout": cout, "M": m,
+            "xla_ms": round(t_x * 1e3, 3), "pallas_ms": round(t_p * 1e3, 3),
+            "ratio": round(t_p / t_x, 2),
+            "pallas_gbps": round(gb / t_p, 1)}), flush=True)
+
+    for h, c in three_by_three:
+        x = jnp.asarray(rng.randn(b, h, h, c).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+        w = jnp.asarray((rng.randn(3, 3, c, c) * 0.05).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+
+        def xla_side(a, b_):
+            y = ops_conv.conv2d(a, b_, stride=1, padding="SAME")
+            yf = y.astype(jnp.float32)
+            return y, jnp.sum(yf, (0, 1, 2)), jnp.sum(yf * yf, (0, 1, 2))
+
+        t_x = bench(jax.jit(xla_side), x, w)
+        t_p = bench(jax.jit(lambda a, b_: fused.conv3x3_bn_stats(a, b_)),
+                    x, w)
+        gb = (2 * b * h * h * c + 9 * c * c) * 2 / 1e9
+        print(json.dumps({
+            "kernel": "3x3", "H": h, "C": c,
+            "xla_ms": round(t_x * 1e3, 3), "pallas_ms": round(t_p * 1e3, 3),
+            "ratio": round(t_p / t_x, 2),
+            "pallas_gbps": round(gb / t_p, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
