@@ -36,8 +36,10 @@ def text_reader(vocab, seq_len, classes=2, n=4096, seed=0):
 
 def parse_fused_bn(default="0"):
     """BENCH_FUSED_BN modes: "0" off | "1" fused fwd stats | "int8"
-    + int8 backward stash | "full" + Pallas backward kernels (shared by
-    the standalone configs and bench.py so the two can't drift)."""
+    + int8 backward stash | "full" + Pallas backward kernels | "q8"
+    int8-stash pipeline at the XLA level (ops/q8.py — activations in HBM
+    as centered int8, BN/ReLU deferred into conv fusions). Shared by the
+    standalone configs and bench.py so the two can't drift."""
     import os
     v = os.environ.get("BENCH_FUSED_BN", default)
-    return v if v in ("int8", "full") else v == "1"
+    return v if v in ("int8", "full", "q8") else v == "1"

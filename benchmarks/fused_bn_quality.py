@@ -57,13 +57,20 @@ def main():
     xt, yt = make(n_test, 2)
 
     results = {}
-    for mode in (False, True, "int8", "full"):
+    for mode in (False, True, "int8", "full", "q8"):
         x = layer.data("img", paddle.data_type.dense_vector(3 * 16 * 16))
         lbl = layer.data("lbl", paddle.data_type.integer_value(4))
+        # the q8 pipeline needs a dense stem before its entry stash (the
+        # same structure resnet_imagenet uses), and an exit before pooling
         c1 = resnet.conv_bn_layer(x, 16, 3, 1, 1,
                                   paddle.activation.Relu(), ch_in=3,
-                                  name="q_c1", fused=mode)
+                                  name="q_c1",
+                                  fused=False if mode == "q8" else mode)
+        if mode == "q8":
+            c1 = layer.q8_entry(c1, name="q_entry")
         b1 = resnet.basic_block(c1, 16, 16, 1, name="q_b1", fused=mode)
+        if mode == "q8":
+            b1 = layer.q8_exit(b1, name="q_exit")
         pool = layer.img_pool(b1, pool_size=16, stride=1,
                               pool_type=paddle.pooling.Avg())
         sm = layer.fc(pool, 4, act=paddle.activation.Softmax(),

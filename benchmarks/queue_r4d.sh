@@ -1,0 +1,73 @@
+#!/bin/bash
+# Round-4 queue, part D: the q8-pipeline on-chip session.
+#   [1] kernel-level probe: 16-block q8 chain vs dense (wall + temp MB)
+#   [2] full-model A/B: BENCH_FUSED_BN=0 vs q8 through bench.py
+#   [3] seq-16384 flash isolation: attention-only compile with smaller
+#       blocks (the full model hits "tpu_compile_helper exit 1")
+# Run with NOTHING else touching the tunnel (concurrent compiles caused
+# HTTP-500s in part B).
+set -u
+cd "$(dirname "$0")/.."
+STAMP=$(date +%F_%H%M)
+RUNS=benchmarks/runs
+export PYTHONPATH="$PWD:${PYTHONPATH:-}"
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=2
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
+probe() {
+    timeout 100 python -c "
+import jax, jax.numpy as jnp
+print('probe ok', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" \
+        || { echo "tunnel down; aborting"; exit 1; }
+}
+
+probe
+
+echo "== [1] q8 16-block chain probe (wall, cost bytes, temp MB)"
+timeout 900 python benchmarks/q8_probe.py \
+    > "$RUNS/${STAMP}_q8_chain_probe.txt" 2>/tmp/qd_probe.log \
+    && cat "$RUNS/${STAMP}_q8_chain_probe.txt"
+
+echo "== [2] resnet50 A/B: unfused vs q8 pipeline"
+for MODE in 0 q8; do
+    BENCH_FUSED_BN=$MODE BENCH_WALL_BUDGET=1400 timeout 1500 python bench.py \
+        > "$RUNS/${STAMP}_resnet50_q8ab_${MODE}.json" \
+        2>"/tmp/qd_q8ab_${MODE}.log"
+    echo "--- mode=$MODE:"; cat "$RUNS/${STAMP}_resnet50_q8ab_${MODE}.json"
+done
+
+echo "== [2b] scaling evidence: AOT-compile 8-chip DP step, schedule analysis"
+timeout 1800 python benchmarks/scaling_aot.py \
+    > "$RUNS/${STAMP}_scaling_aot.txt" 2>/tmp/qd_aot.log \
+    && tail -25 "$RUNS/${STAMP}_scaling_aot.txt"
+
+echo "== [3] seq-16384 flash isolation (attention only, small blocks)"
+timeout 900 python - > "$RUNS/${STAMP}_flash16k_isolation.txt" \
+        2>/tmp/qd_16k.log <<'EOF'
+import jax, jax.numpy as jnp, time
+from paddle_tpu.ops.pallas import attention as fa
+from paddle_tpu.utils.sync import host_sync
+B, T, H, D = 1, 16384, 8, 64   # flash_attention takes [B, T, H, D]
+q = jax.random.normal(jax.random.PRNGKey(0), (B, T, H, D), jnp.bfloat16)
+for bq, bk in ((512, 512), (256, 512), (256, 256), (128, 512)):
+    try:
+        f = jax.jit(lambda q: fa.flash_attention(
+            q, q, q, causal=True, block_q=bq, block_k=bk))
+        o = f(q); host_sync(o)
+        t0 = time.perf_counter()
+        for _ in range(5): o = f(q)
+        host_sync(o)
+        print(f"fwd bq={bq} bk={bk}: ok {(time.perf_counter()-t0)/5*1e3:.1f} ms")
+        g = jax.jit(jax.grad(lambda q: jnp.sum(fa.flash_attention(
+            q, q, q, causal=True, block_q=bq, block_k=bk)
+            .astype(jnp.float32))))
+        o = g(q); host_sync(o)
+        print(f"bwd bq={bq} bk={bk}: ok")
+        break
+    except Exception as e:
+        print(f"bq={bq} bk={bk}: {type(e).__name__} {str(e)[:200]}")
+EOF
+cat "$RUNS/${STAMP}_flash16k_isolation.txt"
+
+echo "done"

@@ -1,0 +1,242 @@
+"""Multi-chip scaling evidence via AOT compilation for a real TPU topology.
+
+Real multi-chip hardware isn't reachable from this environment (one
+tunneled v5e chip), and the host has ONE CPU core, so a multi-process
+CPU-mesh throughput curve would measure core contention, not scaling.
+What IS available is the real TPU compiler: `jax.experimental.topologies`
+describes a v5e pod slice and `jit(...).lower().compile()` runs the full
+XLA:TPU pipeline — SPMD partitioning, collective insertion, and the
+latency-hiding scheduler — exactly as it would for 8 physical chips.
+
+This tool AOT-compiles the flagship ResNet-50 DP train step (the same
+builder contract as bench.py) over a v5e:2x4 mesh and extracts from the
+optimized, SCHEDULED HLO:
+
+  1. every async collective pair (`all-reduce-start` → `all-reduce-done`)
+     with its tensor bytes;
+  2. how much convolution/fusion work the scheduler placed INSIDE each
+     start→done window — the direct evidence that gradient all-reduces
+     overlap the backward;
+  3. an analytic step-time model: hidden collectives cost max(0,
+     t_comm − t_overlapped_compute); with the measured single-chip step
+     time this yields the DP scaling efficiency the north star asks for.
+
+Reference protocol being matched: the 4-GPU speedup tables in
+/root/reference/benchmark/README.md:72-93 (their evidence was measured
+wall-clock; ours is the compiler's actual schedule + measured single-chip
+step time, the feasible substitute in a 1-chip environment).
+
+Usage:  python benchmarks/scaling_aot.py [--topology v5e:2x4] [--batch-per-chip 128]
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+
+def build_step(batch_per_chip, n_chips, mesh):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import layer
+    from paddle_tpu.models import resnet
+    from paddle_tpu.topology import Topology, Value
+    from paddle_tpu.utils.rng import KeySource
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    img = layer.data("image", paddle.data_type.dense_vector(3 * 224 * 224))
+    lbl = layer.data("label", paddle.data_type.integer_value(1000))
+    out = resnet.resnet_imagenet(img, depth=50, class_num=1000,
+                                 stem_space_to_depth=True)
+    cost = layer.classification_cost(out, lbl, name="cost")
+    topo = Topology(cost)
+    opt = paddle.optimizer.Momentum(momentum=0.9, learning_rate=0.1)
+    opt.bind(topo.param_specs())
+
+    # abstract init: eval_shape traces the initializers without executing,
+    # so no backend is touched until the AOT compile itself
+    def _make():
+        params = paddle.parameters.create(cost, KeySource(42))
+        return params.values, params.state, opt.init_state(params.values)
+
+    values_sds, state_sds, opt_sds = jax.eval_shape(_make)
+    fwd = topo.compile()
+
+    def train_step(p, o, s, images, labels, step):
+        def loss_fn(p):
+            outs, ns = fwd(p, s, {"image": Value(images),
+                                  "label": Value(labels)}, is_training=True)
+            return jnp.mean(outs["cost"].array.astype(jnp.float32)), ns
+
+        (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        np_, no_ = opt.update(step, grads, p, o)
+        return loss, np_, no_, ns
+
+    rep = NamedSharding(mesh, P())
+    dat = NamedSharding(mesh, P("data"))
+    gb = batch_per_chip * n_chips
+    abstract = (values_sds, opt_sds, state_sds,
+                jax.ShapeDtypeStruct((gb, 224, 224, 3), jnp.float32),
+                jax.ShapeDtypeStruct((gb,), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32))
+    shardings = (jax.tree.map(lambda _: rep, abstract[0]),
+                 jax.tree.map(lambda _: rep, abstract[1]),
+                 jax.tree.map(lambda _: rep, abstract[2]), dat, dat, rep)
+    jf = jax.jit(train_step, in_shardings=shardings,
+                 out_shardings=(rep, shardings[0], shardings[1],
+                                shardings[2]))
+    return jf, abstract
+
+
+_SIZE = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "s8": 1,
+         "u8": 1, "pred": 1, "f64": 8}
+
+
+def _shape_bytes(sig: str) -> int:
+    """Bytes of one HLO shape string like 'f32[256,128]{1,0}' or a tuple."""
+    total = 0
+    for m in re.finditer(r"(\w+)\[([\d,]*)\]", sig):
+        dt, dims = m.group(1), m.group(2)
+        if dt not in _SIZE:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _SIZE[dt]
+    return total
+
+
+def analyze_schedule(txt: str):
+    """Parse the scheduled entry computation: async collective windows and
+    the compute placed inside them."""
+    # find the entry computation (largest block marked ENTRY)
+    entry = txt[txt.index("ENTRY"):]
+    lines = entry.splitlines()
+    events = []       # (idx, kind, name, bytes)
+    start_of = {}
+    conv_lines = []
+    for i, ln in enumerate(lines):
+        ln = ln.strip()
+        m = re.match(r"%?([\w.\-]+)\s*=\s*(\([^)]*\)|[\w\[\]{},:\s]+?)\s*"
+                     r"(all-reduce-start|all-reduce-done|all-reduce|"
+                     r"fusion|convolution|custom-call)", ln)
+        if not m:
+            continue
+        name, sig, kind = m.group(1), m.group(2), m.group(3)
+        if kind == "all-reduce-start":
+            # async start's shape is the tuple (operand, result) — the
+            # wire traffic is ONE copy of the gradient, not both halves
+            events.append((i, "start", name, _shape_bytes(sig) // 2))
+            start_of[name] = i
+        elif kind == "all-reduce-done":
+            dep = re.search(r"all-reduce-done\(.*?%?([\w.\-]+)\)", ln)
+            events.append((i, "done", dep.group(1) if dep else name, 0))
+        elif kind == "all-reduce":
+            events.append((i, "sync", name, _shape_bytes(sig)))
+        elif kind in ("fusion", "convolution"):
+            conv_lines.append((i, kind, ln))
+    windows = []
+    for i, k, name, nbytes in events:
+        if k == "done":
+            s = start_of.get(name)
+            if s is not None:
+                sbytes = next(b for (j, kk, n2, b) in events
+                              if j == s and kk == "start")
+                inside = [c for c in conv_lines if s < c[0] < i]
+                windows.append({"start_line": s, "done_line": i,
+                                "bytes": sbytes,
+                                "compute_ops_inside": len(inside),
+                                "conv_ops_inside": sum(
+                                    1 for c in inside
+                                    if "convolution" in c[2])})
+    sync = [(name, b) for (i, k, name, b) in events if k == "sync"]
+    return {"async_windows": windows,
+            "sync_all_reduces": [{"name": n, "bytes": b} for n, b in sync],
+            "total_compute_ops": len(conv_lines)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--topology", default="v5e:2x4")
+    ap.add_argument("--batch-per-chip", type=int, default=128)
+    ap.add_argument("--single-chip-ms", type=float, default=50.3,
+                    help="measured single-chip step ms at this per-chip "
+                    "batch (BENCHMARKS.md resnet50 bs=128: 52.59 unfused, "
+                    "50.3 = 2543.6 img/s best fused-off config)")
+    ap.add_argument("--ici-gbps", type=float, default=45.0,
+                    help="per-link ICI bandwidth GB/s each direction "
+                    "(v5e: 45 GB/s per link)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import jax
+    from jax.experimental import topologies
+    from jax.sharding import Mesh
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name=args.topology)
+    n = len(topo.devices)
+    mesh = Mesh(np.array(topo.devices).reshape(n), ("data",))
+    print(f"topology {args.topology}: {n} devices; "
+          f"DP train step, per-chip batch {args.batch_per_chip}")
+
+    jf, abstract = build_step(args.batch_per_chip, n, mesh)
+    lowered = jf.lower(*abstract)
+    compiled = lowered.compile()
+    txt = compiled.as_text()
+    sched = analyze_schedule(txt)
+
+    grad_bytes = sum(w["bytes"] for w in sched["async_windows"]) + \
+        sum(s["bytes"] for s in sched["sync_all_reduces"])
+    n_async = len(sched["async_windows"])
+    overlapped = [w for w in sched["async_windows"]
+                  if w["compute_ops_inside"] > 0]
+    ops_inside = sum(w["compute_ops_inside"] for w in sched["async_windows"])
+
+    # ring all-reduce on the data axis: 2(N-1)/N * B bytes over the slowest
+    # link; v5e 2x4 mesh rings have full ICI links
+    t_comm_ms = 2 * (n - 1) / n * grad_bytes / (args.ici_gbps * 1e9) * 1e3
+    step_ms = args.single_chip_ms
+    eff_no_overlap = step_ms / (step_ms + t_comm_ms)
+    # scheduler-evidenced overlap: windows with compute inside hide their
+    # wire time under the backward; only un-overlapped windows add latency
+    hidden_frac = (sum(w["bytes"] for w in overlapped) / grad_bytes
+                   if grad_bytes else 0.0)
+    t_exposed = t_comm_ms * (1 - hidden_frac)
+    eff_sched = step_ms / (step_ms + t_exposed)
+
+    result = {
+        "topology": args.topology, "n_chips": n,
+        "batch_per_chip": args.batch_per_chip,
+        "global_batch": args.batch_per_chip * n,
+        "async_all_reduces": n_async,
+        "async_with_compute_inside": len(overlapped),
+        "compute_ops_inside_windows": ops_inside,
+        "sync_all_reduces": len(sched["sync_all_reduces"]),
+        "grad_allreduce_bytes": grad_bytes,
+        "ring_time_ms_at_ici": round(t_comm_ms, 3),
+        "single_chip_step_ms": step_ms,
+        "bytes_hidden_fraction": round(hidden_frac, 4),
+        "dp_efficiency_no_overlap": round(eff_no_overlap, 4),
+        "dp_efficiency_scheduled": round(eff_sched, 4),
+        "total_compute_ops": sched["total_compute_ops"],
+    }
+    print(json.dumps(result, indent=2))
+    out = args.out or os.path.join(
+        REPO, "benchmarks", "runs", "scaling_aot_" +
+        args.topology.replace(":", "_") + ".json")
+    with open(out, "w") as f:
+        json.dump({**result, "windows": sched["async_windows"]}, f, indent=2)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

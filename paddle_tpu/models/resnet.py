@@ -14,7 +14,16 @@ def conv_bn_layer(input, ch_out, filter_size, stride, padding, active_type,
     """(reference: resnet.py conv_bn_layer). ``fused=True`` runs the
     streaming-BN path: one Pallas kernel computes the conv AND its batch
     statistics (ops/pallas/conv_bn.py), eliminating the stats-reduce
-    read of the activation on every BN'd conv."""
+    read of the activation on every BN'd conv. ``fused="q8"`` runs the
+    q8 pipeline (ops/q8.py): activations stored int8 in HBM, BN affine +
+    activation deferred into the consumer's conv fusion."""
+    if fused == "q8":
+        return layer.img_conv_bn_q8(
+            input, filter_size=filter_size, num_filters=ch_out,
+            num_channels=ch_in, stride=stride, padding=padding,
+            act=active_type, name=f"{name}_q8" if name else None,
+            conv_name=f"{name}_conv" if name else None,
+            bn_name=f"{name}_bn" if name else None)
     if fused:
         # explicit integer padding (NOT "SAME": XLA pads SAME
         # asymmetrically at stride 2, which would silently change
@@ -47,6 +56,12 @@ def shortcut(input, ch_in, ch_out, stride, name=None, fused=False):
     return input
 
 
+def _addto(inputs, act, name, fused):
+    if fused == "q8":
+        return layer.addto_q8(inputs, act=act, name=name)
+    return layer.addto(inputs, act=act, name=name)
+
+
 def bottleneck_block(input, ch_in, ch_out, stride, name=None, fused=False):
     """1x1 -> 3x3 -> 1x1(x4) with identity/projection shortcut
     (reference: resnet.py bottleneck_block)."""
@@ -58,8 +73,8 @@ def bottleneck_block(input, ch_in, ch_out, stride, name=None, fused=False):
                           name=f"{name}_b" if name else None, fused=fused)
     conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, None,
                           name=f"{name}_c" if name else None, fused=fused)
-    return layer.addto([conv3, short], act=activation.Relu(),
-                       name=f"{name}_add" if name else None)
+    return _addto([conv3, short], activation.Relu(),
+                  f"{name}_add" if name else None, fused)
 
 
 def basic_block(input, ch_in, ch_out, stride, name=None, fused=False):
@@ -68,8 +83,8 @@ def basic_block(input, ch_in, ch_out, stride, name=None, fused=False):
                           name=f"{name}_a" if name else None, fused=fused)
     conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, None,
                           name=f"{name}_b" if name else None, fused=fused)
-    return layer.addto([conv2, short], act=activation.Relu(),
-                       name=f"{name}_add" if name else None)
+    return _addto([conv2, short], activation.Relu(),
+                  f"{name}_add" if name else None, fused)
 
 
 _DEPTH_CFG = {
@@ -89,7 +104,10 @@ def resnet_imagenet(input, depth=50, class_num=1000, img_size=224,
     see layer.space_to_depth_conv).
     fused_bn: streaming-BN convs — the conv kernel emits batch stats from
     its epilogue (ops/pallas/conv_bn.py), cutting one full activation
-    read per BN'd conv (the stem keeps the unfused path)."""
+    read per BN'd conv (the stem keeps the unfused path). fused_bn="q8"
+    instead runs the q8 pipeline (ops/q8.py): the whole residual trunk
+    keeps activations in HBM as centered int8 with deferred BN/ReLU; the
+    stem and head stay dense."""
     kind, counts = _DEPTH_CFG[depth]
     block = bottleneck_block if kind == "bottleneck" else basic_block
     expansion = 4 if kind == "bottleneck" else 1
@@ -108,12 +126,16 @@ def resnet_imagenet(input, depth=50, class_num=1000, img_size=224,
 
     ch_in = 64
     tmp = pool1
+    if fused_bn == "q8":
+        tmp = layer.q8_entry(tmp, name="res_q8_entry")
     for stage, (n, ch_out) in enumerate(zip(counts, [64, 128, 256, 512])):
         for i in range(n):
             stride = 2 if (i == 0 and stage > 0) else 1
             tmp = block(tmp, ch_in, ch_out, stride,
                         name=f"res{stage+2}_{i}", fused=fused_bn)
             ch_in = ch_out * expansion
+    if fused_bn == "q8":
+        tmp = layer.q8_exit(tmp, name="res_q8_exit")
     pool = layer.img_pool(tmp, pool_size=7, stride=1,
                           pool_type=pooling.Avg(), name="res_gap")
     return layer.fc(pool, class_num, act=activation.Softmax(), name="res_fc")

@@ -1,0 +1,329 @@
+"""q8 training pipeline — activations live in HBM only as centered int8.
+
+The recipe that clears the ResNet north star (BENCHMARKS.md "Path to
+4000"): every activation tensor between conv/BN blocks is stored as
+centered int8 under *delayed scaling* (the previous step's per-channel
+absmax and mean are this step's quantization constants, so the quantize
+is purely elementwise and rides inside XLA's conv output fusion — no
+second pass over the conv output exists). The consumer dequantizes,
+applies the producer's deferred BN affine + activation, all inside its
+own conv *input* fusion. Nothing bf16-sized is ever materialized between
+blocks in either direction.
+
+Round-4's measured lesson drives the form: hand-written Pallas conv
+kernels lose to XLA's conv fusions (190 vs 710 GB/s, BENCHMARKS.md
+"streaming-BN A/B"), so this recipe is expressed entirely at the XLA
+level — `lax.conv_general_dilated` plus elementwise chains the compiler
+provably fuses — and controls only what autodiff *saves*.
+
+Mechanics — the (stash, carrier) pair
+-------------------------------------
+Blocks exchange TWO values per boundary:
+
+- ``q``     int8 [N,H,W,C] — the data path. Consumers read it directly
+            in their prologue fusion; backward re-reads it to recompute.
+- ``yhat``  bf16 [N,H,W,C] — a *ghost carrier*: the dequantized value
+            ``q * s_p + mu_p`` as a traced expression. Forward compute
+            never uses it (XLA DCEs it), but it is the differentiable
+            edge through which cotangents flow producer-ward. This
+            sidesteps JAX's rule that integer inputs carry no tangents,
+            without trusting XLA to duplicate a shared dequant chain
+            into every consumer.
+
+Cotangent convention: a carrier's cotangent is w.r.t. the DEQUANTIZED
+value ŷ ≈ y (the producer's raw conv output), so the producer's backward
+uses it directly as dy. Deferred affines (M, B) are therefore expressed
+on the ŷ basis — ``x = act(ŷ·M + B)`` with ``M = rsqrt(var+eps)·γ`` —
+and each block folds its input stash constants (mu_pi, s_pi) internally.
+
+Each block is one `jax.custom_vjp` whose residuals are exactly the int8
+stashes plus O(C) vectors — the backward recomputes the bf16 operands
+in-register from the stash (straight-through estimator through the
+round; BN batch-stat terms are exact).
+
+Capability slot of the reference's fused cuDNN batch-norm + activation
+epilogues (paddle/gserver/layers/CudnnBatchNormLayer.cpp:21,
+paddle/cuda/src/hl_cuda_cnn.cu) pushed to its TPU endpoint: the modelled
+37.9 GB/step at batch 256 vs 74.9 measured unfused
+(benchmarks/traffic_model.py scenario "q8-pipeline").
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from paddle_tpu.core import dtypes
+from paddle_tpu.ops import conv as ops_conv
+
+QMAX = 112.0  # quantization target for the delayed absmax: ~12% headroom
+              # before the int8 clip saturates on a growing activation
+
+
+def scale_from_amax(amax: jax.Array) -> jax.Array:
+    """Next step's per-channel scale from this step's absmax."""
+    return jnp.maximum(amax, 1e-6) / QMAX
+
+
+def _quantize(z: jax.Array) -> jax.Array:
+    return jnp.clip(jnp.round(z), -127.0, 127.0).astype(jnp.int8)
+
+
+def _dequant(q: jax.Array, mu_p: jax.Array, s_p: jax.Array) -> jax.Array:
+    return q.astype(jnp.float32) * s_p + mu_p
+
+
+def _red(x, like):
+    """Sum a [N,H,W,C] f32 tensor to per-channel, matching `like`'s dtype."""
+    return jnp.sum(x, axis=(0, 1, 2)).astype(like.dtype)
+
+
+def _int_zero(q):
+    """Cotangent for an integer primal input (JAX's float0 convention)."""
+    return np.zeros(q.shape, dtype=jax.dtypes.float0)
+
+
+def _stash(yf, mu_po, s_po):
+    """Center+quantize with the delayed constants; emit stash, carrier,
+    and the absmax that becomes next step's scale."""
+    amax = jnp.max(jnp.abs(yf - mu_po), axis=(0, 1, 2))
+    q = _quantize((yf - mu_po) / s_po)
+    yhat = _dequant(q, mu_po, s_po).astype(dtypes.compute_dtype())
+    return yhat, q, amax
+
+
+# ---------------------------------------------------------------------------
+# entry: dense bf16 -> (q, carrier)
+# ---------------------------------------------------------------------------
+
+@jax.custom_vjp
+def entry_stash(x, mu_p, s_p):
+    """Quantize a dense activation into the q8 pipeline. mu_p/s_p are the
+    delayed (previous-step) per-channel center/scale — state, stop-grad.
+    Returns (yhat, q, mu, amax); mu feeds next step's centering state."""
+    xf = x.astype(jnp.float32)
+    yhat, q, amax = _stash(xf, mu_p, s_p)
+    mu = jnp.mean(xf, axis=(0, 1, 2))
+    return yhat, q, mu, amax
+
+
+def _entry_fwd(x, mu_p, s_p):
+    return entry_stash(x, mu_p, s_p), (mu_p, s_p)
+
+
+def _entry_bwd(res, cots):
+    mu_p, s_p = res
+    g_yhat = cots[0]
+    # straight-through: ŷ ≈ x, so the carrier's cotangent IS the input's
+    return (g_yhat.astype(dtypes.compute_dtype()), jnp.zeros_like(mu_p),
+            jnp.zeros_like(s_p))
+
+
+entry_stash.defvjp(_entry_fwd, _entry_bwd)
+
+
+# ---------------------------------------------------------------------------
+# exit: (q, carrier) -> dense bf16
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def make_exit(relu: bool):
+    """Dequantize out of the pipeline: x = act(ŷ·M + B), reading the int8
+    stash; backward needs only the stash."""
+
+    @jax.custom_vjp
+    def exit_deq(yhat, q, M, B, mu_p, s_p):
+        x = _dequant(q, mu_p, s_p) * M + B
+        if relu:
+            x = jnp.maximum(x, 0.0)
+        return x.astype(dtypes.compute_dtype())
+
+    def fwd(yhat, q, M, B, mu_p, s_p):
+        return exit_deq(yhat, q, M, B, mu_p, s_p), (q, M, B, mu_p, s_p)
+
+    def bwd(res, g):
+        q, M, B, mu_p, s_p = res
+        yd = _dequant(q, mu_p, s_p)
+        gf = g.astype(jnp.float32)
+        if relu:
+            gf = gf * (yd * M + B > 0)
+        return ((gf * M).astype(dtypes.compute_dtype()), _int_zero(q),
+                _red(gf * yd, M), _red(gf, B),
+                jnp.zeros_like(mu_p), jnp.zeros_like(s_p))
+
+    exit_deq.defvjp(fwd, bwd)
+    return exit_deq
+
+
+# ---------------------------------------------------------------------------
+# the conv block: prologue(dequant+affine+act) -> conv -> stats+quantize
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def make_conv_q8(stride: int, padding, relu_in: bool, out_stash: bool):
+    """Build the custom-vjp conv block for a static (stride, padding,
+    input-activation, stash-output?) configuration.
+
+    Signature of the returned fn:
+      (yhat_in, q_in, w, M, B, mu_pi, s_pi, mu_po, s_po)
+        -> (yhat_out, q_out, mu, var, amax)    if out_stash
+        -> (y bf16 dense, mu, var)             otherwise (exit conv)
+
+    yhat_in: ghost carrier of the producer (gradient edge, DCE'd fwd).
+    q_in:    int8 stash — the real data path.
+    M, B:    per-channel prologue affine ON THE ŷ BASIS folding the
+             producer's deferred BN: x = act(ŷ·M + B). Differentiable
+             (grads reach the producer's gamma/beta through them).
+    mu_pi/s_pi: the INPUT stash's delayed center/scale (state, stop-grad).
+    mu_po/s_po: ditto for the output stash (ignored if out_stash=False —
+             pass zeros/ones).
+    mu/var:  this conv's batch stats over its raw output y — the consumer
+             folds them into ITS (M, B); their cotangents carry the exact
+             BN batch-stat backward terms here.
+    """
+
+    def prologue(q_in, M, B, mu_pi, s_pi):
+        x = _dequant(q_in, mu_pi, s_pi) * M + B
+        if relu_in:
+            x = jnp.maximum(x, 0.0)
+        return x.astype(dtypes.compute_dtype())
+
+    def conv(xt, w):
+        return ops_conv.conv2d(xt, w, stride=stride, padding=padding)
+
+    @jax.custom_vjp
+    def block(yhat_in, q_in, w, M, B, mu_pi, s_pi, mu_po, s_po):
+        xt = prologue(q_in, M, B, mu_pi, s_pi)
+        y = conv(xt, w)
+        yf = y.astype(jnp.float32)
+        mu = jnp.mean(yf, axis=(0, 1, 2))
+        var = jnp.mean(jnp.square(yf - mu), axis=(0, 1, 2))
+        if not out_stash:
+            return y, mu, var
+        yhat_out, q_out, amax = _stash(yf, mu_po, s_po)
+        return yhat_out, q_out, mu, var, amax
+
+    def fwd(yhat_in, q_in, w, M, B, mu_pi, s_pi, mu_po, s_po):
+        out = block(yhat_in, q_in, w, M, B, mu_pi, s_pi, mu_po, s_po)
+        if out_stash:
+            y_or_q, mu = out[1], out[2]
+        else:
+            y_or_q, mu = out[0], out[1]
+        return out, (q_in, y_or_q, mu, w, M, B, mu_pi, s_pi, mu_po, s_po)
+
+    def bwd(res, cots):
+        q_in, y_or_q, mu, w, M, B, mu_pi, s_pi, mu_po, s_po = res
+        if out_stash:
+            g_yhat, _gq, g_mu, g_var, _ga = cots
+            # y reconstructed from its own stash (STE through the round)
+            yf = _dequant(y_or_q, mu_po, s_po)
+        else:
+            g_yhat, g_mu, g_var = cots
+            yf = y_or_q.astype(jnp.float32)
+        nhw = float(np.prod(g_yhat.shape[:3]))
+        dy = (g_yhat.astype(jnp.float32)
+              + g_mu / nhw
+              + g_var * 2.0 * (yf - mu) / nhw)
+        dyb = dy.astype(dtypes.compute_dtype())
+        xt = prologue(q_in, M, B, mu_pi, s_pi)
+        _, conv_vjp = jax.vjp(conv, xt, w)
+        dxt, dw = conv_vjp(dyb)
+        dpre = dxt.astype(jnp.float32)
+        yd_in = _dequant(q_in, mu_pi, s_pi)
+        if relu_in:
+            dpre = dpre * (yd_in * M + B > 0)
+        d_yhat_in = (dpre * M).astype(dtypes.compute_dtype())
+        dM = _red(dpre * yd_in, M)
+        dB = _red(dpre, B)
+        return (d_yhat_in, _int_zero(q_in), dw, dM, dB,
+                jnp.zeros_like(mu_pi), jnp.zeros_like(s_pi),
+                jnp.zeros_like(mu_po), jnp.zeros_like(s_po))
+
+    block.defvjp(fwd, bwd)
+    return block
+
+
+# ---------------------------------------------------------------------------
+# residual add: affine both branches, add, stash pre-ReLU
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def make_add_q8(relu_a: bool, relu_b: bool):
+    """Residual-add block. Branch values come in as stashes with their
+    deferred ŷ-basis affines (Ma,Ba / Mb,Bb) and optional deferred ReLUs;
+    the sum is stashed CENTERED PRE-ReLU (consumers defer the output
+    ReLU), so the int8 range isn't halved on the non-negative side.
+
+      (ya, qa, Ma, Ba, mu_pa, s_pa,
+       yb, qb, Mb, Bb, mu_pb, s_pb, mu_po, s_po)
+        -> (yhat_out, q_out, mu, amax)
+    """
+
+    def branch(q, M, B, mu_p, s_p, relu):
+        v = _dequant(q, mu_p, s_p) * M + B
+        if relu:
+            v = jnp.maximum(v, 0.0)
+        return v
+
+    @jax.custom_vjp
+    def block(ya, qa, Ma, Ba, mu_pa, s_pa,
+              yb, qb, Mb, Bb, mu_pb, s_pb, mu_po, s_po):
+        z = (branch(qa, Ma, Ba, mu_pa, s_pa, relu_a)
+             + branch(qb, Mb, Bb, mu_pb, s_pb, relu_b))
+        mu = jnp.mean(z, axis=(0, 1, 2))
+        yhat_out, q_out, amax = _stash(z, mu_po, s_po)
+        return yhat_out, q_out, mu, amax
+
+    def fwd(*args):
+        out = block(*args)
+        (qa, Ma, Ba, mu_pa, s_pa) = args[1:6]
+        (qb, Mb, Bb, mu_pb, s_pb) = args[7:12]
+        return out, (qa, Ma, Ba, mu_pa, s_pa, qb, Mb, Bb, mu_pb, s_pb)
+
+    def bwd(res, cots):
+        qa, Ma, Ba, mu_pa, s_pa, qb, Mb, Bb, mu_pb, s_pb = res
+        g_yhat, _gq, g_mu, _ga = cots
+        nhw = float(np.prod(g_yhat.shape[:3]))
+        dz = g_yhat.astype(jnp.float32) + g_mu / nhw
+
+        def back(q, M, B, mu_p, s_p, relu):
+            g = dz
+            yd = _dequant(q, mu_p, s_p)
+            if relu:
+                g = g * (yd * M + B > 0)
+            return ((g * M).astype(dtypes.compute_dtype()),
+                    _red(g * yd, M), _red(g, B))
+
+        dya, dMa, dBa = back(qa, Ma, Ba, mu_pa, s_pa, relu_a)
+        dyb, dMb, dBb = back(qb, Mb, Bb, mu_pb, s_pb, relu_b)
+        z0 = jnp.zeros_like(Ma)
+        return (dya, _int_zero(qa), dMa, dBa, z0, z0,
+                dyb, _int_zero(qb), dMb, dBb, z0, z0, z0, z0)
+
+    block.defvjp(fwd, bwd)
+    return block
+
+
+# ---------------------------------------------------------------------------
+# per-channel affine folding (plain differentiable vector math)
+# ---------------------------------------------------------------------------
+
+def fold_bn_affine(mu: jax.Array, var: jax.Array, gamma: jax.Array,
+                   beta: jax.Array, eps: float = 1e-5
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Fold a producer's deferred batch-norm into one ŷ-basis affine:
+        bn(ŷ) = (ŷ − mu)·r·γ + β = ŷ·(r·γ) + (β − mu·r·γ).
+    mu/var are the producer's current batch stats; gamma/beta its BN
+    parameters (grads flow through all four)."""
+    r = lax.rsqrt(var + eps)
+    M = r * gamma
+    B = beta - mu * r * gamma
+    return M, B
+
+
+def fold_identity(like: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Unit affine for a stash with no deferred BN (add outputs / entry)."""
+    return jnp.ones_like(like), jnp.zeros_like(like)

@@ -39,10 +39,12 @@ class Value:
     sub_lengths: Optional[jax.Array] = None      # level-2 LoD
     weights: Optional[jax.Array] = None          # sparse nonzero values
     pre_act: Optional[jax.Array] = None          # logits before the activation
+    aux: Optional[dict] = None                   # recipe side-channel (e.g.
+                                                 # q8 stash + batch stats)
 
     def tree_flatten(self):
         return (self.array, self.lengths, self.sub_lengths, self.weights,
-                self.pre_act), None
+                self.pre_act, self.aux), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -57,6 +59,9 @@ class Value:
         return self.weights is not None
 
     def with_array(self, array, pre_act=None) -> "Value":
+        # aux is deliberately NOT carried: it describes the q8 stash of
+        # THIS array; any transformed array no longer matches the stash,
+        # and consumers must re-enter the pipeline via layer.q8_entry
         return Value(array, self.lengths, self.sub_lengths, self.weights,
                      pre_act)
 
