@@ -1,0 +1,91 @@
+"""Reader-fed train throughput — does the host feed path throttle?
+
+bench.py measures with device-resident synthetic tensors; the reference
+trained from host-side data providers with an async double-buffer
+(paddle/gserver/dataproviders/PyDataProvider2.cpp:195). Our equivalent
+is the trainer's one-batch-lookahead feed pipeline (trainer.py
+_prefetch_feeds): batch N+1's host->device transfer rides under batch
+N's in-flight step. This bench runs the SAME ResNet-50 config through
+trainer.SGD with a host numpy reader and reports steady-state img/s to
+compare against the device-resident number — the delta is the feed
+path's cost.
+
+Run:  python benchmarks/feed_bench.py [--batch 128] [--steps 20]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=4)
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--depth", type=int, default=50)
+    args = ap.parse_args()
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import layer
+    from paddle_tpu.models import resnet
+    from paddle_tpu.utils.rng import KeySource
+
+    img = layer.data("image", paddle.data_type.dense_vector(3 * 224 * 224))
+    lbl = layer.data("label", paddle.data_type.integer_value(1000))
+    out = resnet.resnet_imagenet(img, depth=args.depth, class_num=1000,
+                                 stem_space_to_depth=True)
+    cost = layer.classification_cost(out, lbl, name="cost")
+    params = paddle.parameters.create(cost, KeySource(42))
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(momentum=0.9,
+                                                  learning_rate=0.1))
+
+    rng = np.random.RandomState(0)
+    n_batches = args.warmup + args.steps
+
+    def reader():
+        # host-side NHWC float batches, generated per item like a real
+        # decoded-image pipeline would deliver
+        for _ in range(n_batches * args.batch):
+            yield (rng.rand(224, 224, 3).astype(np.float32),
+                   int(rng.randint(1000)))
+
+    times = []
+    t_last = [None]
+
+    def handler(ev):
+        if isinstance(ev, paddle.event.EndIteration):
+            now = time.perf_counter()
+            if t_last[0] is not None:
+                times.append(now - t_last[0])
+            t_last[0] = now
+
+    t0 = time.time()
+    trainer.train(reader=paddle.batch(reader, args.batch), num_passes=1,
+                  event_handler=handler)
+    wall = time.time() - t0
+    steady = times[args.warmup:]
+    ms = float(np.median(steady) * 1e3) if steady else None
+    rec = {"metric": "resnet50_reader_fed_images_per_sec",
+           "value": round(args.batch / (ms / 1e3), 1) if steady else 0.0,
+           "unit": "images/sec",
+           "ms_per_batch": round(ms, 2) if ms is not None else None,
+           "batch": args.batch, "steps_timed": len(steady),
+           "total_wall_s": round(wall, 1),
+           "feed": "host numpy reader + one-batch-lookahead prefetch"}
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,8 +17,11 @@ evidence that only int8 stashes persist between blocks).
 Run:  python benchmarks/q8_probe.py [L] [N H W C]
 """
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -54,7 +57,7 @@ def q8_chain(x, ws, gs, bs, st):
     M, B = q8.fold_identity(mus[0])
     relu_in = False
     for i in range(L):
-        blk = q8.make_conv_q8(1, 1, relu_in, True)
+        blk = q8.make_conv_q8(1, 1, relu_in)
         yh, q, mu, var, amax = blk(yh, q, ws[i], M, B, mus[i], svs[i],
                                    mus[i + 1], svs[i + 1])
         new_mu.append(mu)

@@ -1,0 +1,8 @@
+"""``python -m paddle_tpu <job> --config=...`` — the trainer CLI
+(reference: the `paddle` wrapper script, scripts/submit_local.sh.in)."""
+
+import sys
+
+from paddle_tpu.cli import main
+
+sys.exit(main())

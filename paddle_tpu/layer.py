@@ -642,7 +642,7 @@ def img_conv_bn_q8(input, filter_size, num_filters: int,
                 ctx.state_out[spec.name] = ctx.state_in[spec.name]
             return _apply_act(Value(y), act_name)
         M, B, relu_in = _q8_parent_fold(parent_info, params, v.aux, ops_q8)
-        blk = ops_q8.make_conv_q8(stride, padding, relu_in, True)
+        blk = ops_q8.make_conv_q8(stride, padding, relu_in)
         yhat, q, mu, var, amax = blk(
             v.array, v.aux["q"], params[wspec.name], M, B,
             ctx.state_in[f"{parent_name}.q_mean"],
@@ -679,6 +679,7 @@ def addto_q8(input: Sequence[LayerOutput], act=None,
     inputs = list(input)
     enforce.enforce(len(inputs) == 2, "addto_q8 takes exactly two inputs")
     cin = getattr(inputs[0], "_out_channels", None)
+    enforce.enforce(cin is not None, f"addto_q8 {name}: unknown channels")
     p_names = [p.name for p in inputs]
     p_infos = [_q8_info(p) for p in inputs]
     qmean_s, qscale_s = _q8_state_specs(name, cin)

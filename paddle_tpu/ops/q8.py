@@ -163,14 +163,13 @@ def make_exit(relu: bool):
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def make_conv_q8(stride: int, padding, relu_in: bool, out_stash: bool):
+def make_conv_q8(stride: int, padding, relu_in: bool):
     """Build the custom-vjp conv block for a static (stride, padding,
-    input-activation, stash-output?) configuration.
+    input-activation) configuration.
 
     Signature of the returned fn:
       (yhat_in, q_in, w, M, B, mu_pi, s_pi, mu_po, s_po)
-        -> (yhat_out, q_out, mu, var, amax)    if out_stash
-        -> (y bf16 dense, mu, var)             otherwise (exit conv)
+        -> (yhat_out, q_out, mu, var, amax)
 
     yhat_in: ghost carrier of the producer (gradient edge, DCE'd fwd).
     q_in:    int8 stash — the real data path.
@@ -178,8 +177,7 @@ def make_conv_q8(stride: int, padding, relu_in: bool, out_stash: bool):
              producer's deferred BN: x = act(ŷ·M + B). Differentiable
              (grads reach the producer's gamma/beta through them).
     mu_pi/s_pi: the INPUT stash's delayed center/scale (state, stop-grad).
-    mu_po/s_po: ditto for the output stash (ignored if out_stash=False —
-             pass zeros/ones).
+    mu_po/s_po: ditto for the output stash.
     mu/var:  this conv's batch stats over its raw output y — the consumer
              folds them into ITS (M, B); their cotangents carry the exact
              BN batch-stat backward terms here.
@@ -201,28 +199,19 @@ def make_conv_q8(stride: int, padding, relu_in: bool, out_stash: bool):
         yf = y.astype(jnp.float32)
         mu = jnp.mean(yf, axis=(0, 1, 2))
         var = jnp.mean(jnp.square(yf - mu), axis=(0, 1, 2))
-        if not out_stash:
-            return y, mu, var
         yhat_out, q_out, amax = _stash(yf, mu_po, s_po)
         return yhat_out, q_out, mu, var, amax
 
     def fwd(yhat_in, q_in, w, M, B, mu_pi, s_pi, mu_po, s_po):
         out = block(yhat_in, q_in, w, M, B, mu_pi, s_pi, mu_po, s_po)
-        if out_stash:
-            y_or_q, mu = out[1], out[2]
-        else:
-            y_or_q, mu = out[0], out[1]
-        return out, (q_in, y_or_q, mu, w, M, B, mu_pi, s_pi, mu_po, s_po)
+        q_out, mu = out[1], out[2]
+        return out, (q_in, q_out, mu, w, M, B, mu_pi, s_pi, mu_po, s_po)
 
     def bwd(res, cots):
-        q_in, y_or_q, mu, w, M, B, mu_pi, s_pi, mu_po, s_po = res
-        if out_stash:
-            g_yhat, _gq, g_mu, g_var, _ga = cots
-            # y reconstructed from its own stash (STE through the round)
-            yf = _dequant(y_or_q, mu_po, s_po)
-        else:
-            g_yhat, g_mu, g_var = cots
-            yf = y_or_q.astype(jnp.float32)
+        q_in, q_out, mu, w, M, B, mu_pi, s_pi, mu_po, s_po = res
+        g_yhat, _gq, g_mu, g_var, _ga = cots
+        # y reconstructed from its own stash (STE through the round)
+        yf = _dequant(q_out, mu_po, s_po)
         nhw = float(np.prod(g_yhat.shape[:3]))
         dy = (g_yhat.astype(jnp.float32)
               + g_mu / nhw

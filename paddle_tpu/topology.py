@@ -169,6 +169,26 @@ class Topology:
         enforce.enforce(len(names) == len(set(names)),
                         "duplicate layer names: %s" % names)
         self.data_layers = [l for l in self.layers if l.is_data]
+        # q8 producers defer their BN affine + activation to the consumer;
+        # a q8-unaware consumer would silently train on the raw pre-BN
+        # carrier while eval applies the full BN+act — catch at build time
+        _q8_aware = {"img_conv_bn_q8", "addto_q8", "q8_exit"}
+        for l in self.layers:
+            if getattr(l, "_q8", None) is None:
+                continue
+            for o in self.outputs:
+                enforce.enforce(
+                    o is not l,
+                    f"q8 layer {l.name!r} cannot be a graph output — its "
+                    f"BN/activation are deferred; insert layer.q8_exit")
+        for l in self.layers:
+            for p in l.parents:
+                enforce.enforce(
+                    getattr(p, "_q8", None) is None
+                    or l.layer_type in _q8_aware,
+                    f"layer {l.name!r} ({l.layer_type}) consumes q8 "
+                    f"producer {p.name!r} but is not q8-aware — insert "
+                    f"layer.q8_exit between them")
 
     # -- specs -------------------------------------------------------------
     def param_specs(self) -> List[ParamSpec]:

@@ -34,11 +34,11 @@ def _dense_two_layer(x, w1, g1, b1, w2, g2, b2, eps=1e-5):
 
 def _q8_two_layer(x, w1, g1, b1, w2, g2, b2, st):
     yh, q, mu_x, amax_x = q8.entry_stash(x, st["e_mu"], st["e_s"])
-    conv1 = q8.make_conv_q8(1, 1, False, True)
+    conv1 = q8.make_conv_q8(1, 1, False)
     M0, B0 = q8.fold_identity(st["e_mu"])
     yh1, q1, mu1, v1, a1 = conv1(yh, q, w1, M0, B0, st["e_mu"], st["e_s"],
                                  st["c1_mu"], st["c1_s"])
-    conv2 = q8.make_conv_q8(1, 1, True, True)
+    conv2 = q8.make_conv_q8(1, 1, True)
     M1, B1 = q8.fold_bn_affine(mu1, v1, g1, b1)
     yh2, q2, mu2, v2, a2 = conv2(yh1, q1, w2, M1, B1, st["c1_mu"],
                                  st["c1_s"], st["c2_mu"], st["c2_s"])
