@@ -70,9 +70,11 @@ for bq, bk in ((512, 512), (256, 512), (256, 256), (128, 512)):
 EOF
 cat "$RUNS/${STAMP}_flash16k_isolation.txt"
 
-echo "== [4] reader-fed feed-path bench (host reader + prefetch vs synthetic)"
-timeout 1200 python benchmarks/feed_bench.py --batch 128 \
-    > "$RUNS/${STAMP}_feed_bench.json" 2>/tmp/qd_feed.log \
-    && cat "$RUNS/${STAMP}_feed_bench.json"
+echo "== [4] reader-fed feed-path bench (host python vs native C++ assembly)"
+for SRC in host native; do
+    timeout 1200 python benchmarks/feed_bench.py --batch 128 --source $SRC \
+        > "$RUNS/${STAMP}_feed_bench_${SRC}.json" 2>"/tmp/qd_feed_${SRC}.log" \
+        && cat "$RUNS/${STAMP}_feed_bench_${SRC}.json"
+done
 
 echo "done"

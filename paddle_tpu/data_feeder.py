@@ -28,6 +28,7 @@ from paddle_tpu.core.ragged import (DEFAULT_BUCKETS, SequenceBatch,
                                     bucket_length, sub_lengths_matrix)
 from paddle_tpu.data_type import InputType, Kind, SeqLevel
 from paddle_tpu.topology import Value
+from paddle_tpu.utils import enforce
 
 
 class DataFeeder:
@@ -43,8 +44,50 @@ class DataFeeder:
     def __call__(self, batch: Sequence) -> Dict[str, Value]:
         return self.feed(batch)
 
+    def _is_prebatched(self, batch) -> bool:
+        """True for a tuple of whole-column ndarrays (one per slot, same
+        leading batch dim, dense slots with an explicit batch axis) —
+        distinguishable from a tuple of per-sample arrays, which fails
+        the slot-count or ndim conditions."""
+        if not (isinstance(batch, tuple) and batch
+                and len(batch) == len(self.data_types)
+                and all(isinstance(c, np.ndarray) for c in batch)):
+            return False
+        lead = set()
+        for name, itype in self.data_types.items():
+            idx = self.feeding[name]
+            if idx >= len(batch):
+                return False
+            c = batch[idx]
+            need = 2 if itype.kind == Kind.DENSE and itype.dim > 1 else 1
+            if c.ndim < need:
+                return False
+            lead.add(c.shape[0])
+        return len(lead) == 1
+
     def feed(self, batch: Sequence) -> Dict[str, Value]:
         feeds = {}
+        if self._is_prebatched(batch):
+            # pre-batched column arrays (the native batch-assembly path,
+            # runtime/loader.dense_batch_reader): one ndarray per slot,
+            # consistent leading batch dim, dense columns carrying an
+            # explicit batch axis — skip per-sample assembly entirely.
+            # (A tuple of per-sample arrays fails the slot-count or ndim
+            # checks and falls through to the per-sample path.)
+            for name, itype in self.data_types.items():
+                col = batch[self.feeding[name]]
+                enforce.enforce(
+                    itype.kind in (Kind.DENSE, Kind.INDEX)
+                    and itype.seq == SeqLevel.NO_SEQUENCE,
+                    f"pre-batched feed supports dense/index slots only "
+                    f"(slot {name!r})")
+                if itype.kind == Kind.INDEX:
+                    arr = np.ascontiguousarray(col, dtype=np.int32).reshape(-1)
+                    self._check_index_range(arr, itype.dim, name)
+                else:
+                    arr = np.ascontiguousarray(col, dtype=np.float32)
+                feeds[name] = Value(jnp.asarray(arr))
+            return feeds
         for name, itype in self.data_types.items():
             col = [sample[self.feeding[name]] for sample in batch]
             feeds[name] = self._convert(col, itype, name)

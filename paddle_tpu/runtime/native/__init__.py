@@ -51,6 +51,9 @@ def _bind(lib):
                                   c.c_long, c.c_int, c.c_long]
     lib.loader_next.restype = c.c_longlong
     lib.loader_next.argtypes = [c.c_void_p, c.POINTER(c.POINTER(c.c_uint8))]
+    lib.loader_next_batch.restype = c.c_longlong
+    lib.loader_next_batch.argtypes = [c.c_void_p, c.POINTER(c.c_uint8),
+                                      c.c_long, c.c_longlong]
     lib.loader_destroy.restype = None
     lib.loader_destroy.argtypes = [c.c_void_p]
     return lib

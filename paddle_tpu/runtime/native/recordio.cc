@@ -246,6 +246,37 @@ long long loader_next(void* handle, uint8_t** rec) {
   return static_cast<long long>(r.size());
 }
 
+// Pops up to `batch` fixed-size records straight into the caller's buffer
+// (a [batch, rec_bytes] matrix) — the native batch-assembly path: no
+// per-record malloc, no per-record language crossing. Returns the number
+// of records copied (0 = drained), -100 on a record whose size !=
+// rec_bytes (distinct from the chunk-reader's -1..-4 I/O codes), or the
+// loader's error code. Short counts happen only at end-of-data.
+long long loader_next_batch(void* handle, uint8_t* out, long batch,
+                            long long rec_bytes) {
+  Loader* L = static_cast<Loader*>(handle);
+  long n = 0;
+  while (n < batch) {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_pop.wait(lk, [&] {
+      return !L->queue.empty() || L->active_readers.load() == 0 ||
+             L->error.load() != 0;
+    });
+    if (L->queue.empty()) {
+      if (L->error.load() != 0) return L->error.load();
+      break;  // drained: return the short tail
+    }
+    std::vector<uint8_t> r = std::move(L->queue.front());
+    L->queue.pop_front();
+    L->cv_push.notify_one();
+    lk.unlock();
+    if (static_cast<long long>(r.size()) != rec_bytes) return -100;
+    memcpy(out + static_cast<size_t>(n) * rec_bytes, r.data(), r.size());
+    n++;
+  }
+  return n;
+}
+
 void loader_destroy(void* handle) {
   Loader* L = static_cast<Loader*>(handle);
   L->stop.store(true);

@@ -24,9 +24,11 @@ class Writer:
     chunk (the master's task-dispatch unit). Framing + CRC run in the native
     codec when built."""
 
-    def __init__(self, path: str, records_per_chunk: int = 1024):
+    def __init__(self, path: str, records_per_chunk: int = 1024,
+                 raw: bool = False):
         self.path = path
         self.records_per_chunk = records_per_chunk
+        self.raw = raw
         self._lib = native.get()
         self._buf: List[bytes] = []
         self._count = 0
@@ -37,8 +39,11 @@ class Writer:
             self._out = open(path, "wb")
 
     def write(self, record) -> None:
-        """Append one record (any picklable object, including raw bytes)."""
-        self._buf.append(pickle.dumps(record, protocol=4))
+        """Append one record (any picklable object; with raw=True the
+        record must be bytes and is framed verbatim — the fixed-layout
+        fast path the native batch loader consumes)."""
+        self._buf.append(bytes(record) if self.raw
+                         else pickle.dumps(record, protocol=4))
         self._count += 1
         if len(self._buf) >= self.records_per_chunk:
             self._flush()
@@ -76,9 +81,11 @@ class Writer:
         self.close()
 
 
-def write_records(path: str, records: Iterable, chunk_records: int = 1024):
-    """Write records (pickled) into chunks of chunk_records each."""
-    with Writer(path, records_per_chunk=chunk_records) as w:
+def write_records(path: str, records: Iterable, chunk_records: int = 1024,
+                  raw: bool = False):
+    """Write records (pickled, or verbatim bytes with raw=True) into
+    chunks of chunk_records each."""
+    with Writer(path, records_per_chunk=chunk_records, raw=raw) as w:
         for rec in records:
             w.write(rec)
     return w.close()
@@ -115,16 +122,17 @@ def chunk_offsets(path: str) -> List[Tuple[int, int]]:
     return out
 
 
-def _iter_payload(payload: bytes, n: int) -> Iterator:
+def _iter_payload(payload: bytes, n: int, raw: bool = False) -> Iterator:
     pos = 0
     for _ in range(n):
         (rlen,) = struct.unpack_from("<I", payload, pos)
         pos += 4
-        yield pickle.loads(payload[pos:pos + rlen])
+        rec = payload[pos:pos + rlen]
+        yield rec if raw else pickle.loads(rec)
         pos += rlen
 
 
-def read_chunk(path: str, offset: int) -> Iterator:
+def read_chunk(path: str, offset: int, raw: bool = False) -> Iterator:
     lib = native.get()
     if lib is not None:
         buf = ctypes.POINTER(ctypes.c_uint8)()
@@ -138,7 +146,7 @@ def read_chunk(path: str, offset: int) -> Iterator:
             payload = ctypes.string_at(buf, plen)
         finally:
             lib.rio_free(buf)
-        yield from _iter_payload(payload, nrec.value)
+        yield from _iter_payload(payload, nrec.value, raw)
         return
     with open(path, "rb") as f:
         f.seek(offset)
@@ -149,7 +157,7 @@ def read_chunk(path: str, offset: int) -> Iterator:
         payload = f.read(plen)
         if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
             raise IOError(f"chunk crc mismatch at {offset} in {path}")
-        yield from _iter_payload(payload, n)
+        yield from _iter_payload(payload, n, raw)
 
 
 def read_records(path: str) -> Iterator:

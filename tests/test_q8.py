@@ -295,3 +295,89 @@ class TestLayerGraph:
         loss2 = jax.jit(step2)(p, o, s, jnp.asarray(0, jnp.int32),
                                im_sh, lb_sh)
         np.testing.assert_allclose(float(loss1), float(loss2), rtol=2e-2)
+
+
+class TestBottleneckTwin:
+    """Bottleneck blocks (1x1/3x3/1x1 + projection shortcut, the
+    ResNet-50 structure) through the q8 pipeline must track a dense twin
+    built from the SAME parameter values — this exercises the stride-2
+    projection path and the conv3+shortcut addto folding."""
+
+    def _graphs(self):
+        from paddle_tpu.models import resnet
+
+        graphs = {}
+        for mode in (False, "q8"):
+            img = layer.data("image", paddle.data_type.dense_vector(8 * 8 * 8))
+            stem = resnet.conv_bn_layer(img, 8, 3, 1, 1,
+                                        activation.Relu(), ch_in=8,
+                                        name="tw_stem")
+            body = stem
+            if mode == "q8":
+                body = layer.q8_entry(body, name="tw_entry")
+            # stride-2 bottleneck with projection, then identity bottleneck
+            body = resnet.bottleneck_block(body, 8, 4, 2, name="tw_b0",
+                                           fused=mode)
+            body = resnet.bottleneck_block(body, 16, 4, 1, name="tw_b1",
+                                           fused=mode)
+            if mode == "q8":
+                body = layer.q8_exit(body, name="tw_exit")
+            graphs[mode] = Topology(body)
+        return graphs
+
+    def test_forward_tracks_dense_twin(self):
+        graphs = self._graphs()
+        params = paddle.parameters.create(
+            graphs["q8"].outputs[0], KeySource(11))
+        # dense twin shares every parameter name
+        dense_names = {s.name for s in graphs[False].param_specs()}
+        assert dense_names <= set(params.values.keys())
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(8, 8, 8, 8).astype(np.float32))
+        q8_fwd = graphs["q8"].compile()
+        dense_fwd = graphs[False].compile()
+
+        def run_q8(state):
+            outs, ns = q8_fwd(params.values, state, {"image": Value(x)},
+                              is_training=True)
+            return outs[graphs["q8"].outputs[0].name].array, ns
+
+        # calibration step, then the comparison step
+        _, st = run_q8(params.state)
+        out_q8, _ = run_q8(st)
+
+        dense_state = {s.name: params.state[s.name]
+                       for s in graphs[False].state_specs()}
+        out_dense, _ = dense_fwd(params.values, dense_state,
+                                 {"image": Value(x)}, is_training=True)
+        out_dense = out_dense[graphs[False].outputs[0].name].array
+
+        diff = jnp.abs(out_q8.astype(jnp.float32)
+                       - out_dense.astype(jnp.float32))
+        mag = jnp.abs(out_dense.astype(jnp.float32))
+        mean_rel = float(diff.mean() / (mag.mean() + 1e-9))
+        max_rel = float(diff.max() / (mag.max() + 1e-9))
+        # int8 noise accumulates over 7 quantized layers at toy widths
+        # (C=4); routing exactness is separately proven by the
+        # exact-quantizer tests, so these bounds only police gross breaks
+        assert mean_rel < 0.05, f"bottleneck q8 mean rel err {mean_rel}"
+        assert max_rel < 0.25, f"bottleneck q8 max rel err {max_rel}"
+
+    def test_non_q8_consumer_rejected(self):
+        """The Topology build guard: a q8 producer feeding a q8-unaware
+        layer must fail loudly at build time."""
+        from paddle_tpu.models import resnet
+        from paddle_tpu.utils import enforce as enf
+
+        img = layer.data("image", paddle.data_type.dense_vector(8 * 8 * 8))
+        stem = resnet.conv_bn_layer(img, 8, 3, 1, 1, activation.Relu(),
+                                    ch_in=8, name="tg_stem")
+        ent = layer.q8_entry(stem, name="tg_entry")
+        c1 = layer.img_conv_bn_q8(ent, 3, 8, num_channels=8, stride=1,
+                                  padding=1, act=activation.Relu(),
+                                  name="tg_c1")
+        pool = layer.img_pool(c1, pool_size=4, stride=4)  # q8-unaware!
+        with pytest.raises(Exception) as ei:
+            Topology(pool)
+        assert "q8" in str(ei.value)
