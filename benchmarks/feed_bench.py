@@ -71,7 +71,12 @@ def main():
                        int(rng.randint(1000)))
 
         t_w = time.time()
-        rl.write_dense(tmp.name, samples(), dim, chunk_records=args.batch)
+        try:
+            rl.write_dense(tmp.name, samples(), dim,
+                           chunk_records=args.batch)
+        except BaseException:
+            os.unlink(tmp.name)            # don't leak GBs on a failed write
+            raise
         print(f"# wrote {n} raw records in {time.time()-t_w:.1f}s",
               flush=True)
         base_reader = rl.dense_batch_reader(tmp.name, dim, args.batch,

@@ -70,6 +70,12 @@ for bq, bk in ((512, 512), (256, 512), (256, 256), (128, 512)):
 EOF
 cat "$RUNS/${STAMP}_flash16k_isolation.txt"
 
+echo "== [3b] GPT-medium-class LM point (d_model 1024 x 16L, flash, seq 2048)"
+timeout 1500 python benchmarks/transformer_bench.py --seq 2048 --batch 8 \
+    --d-model 1024 --layers 16 --flash on \
+    > "$RUNS/${STAMP}_transformer_1024x16.jsonl" 2>/tmp/qd_big.log \
+    && cat "$RUNS/${STAMP}_transformer_1024x16.jsonl"
+
 echo "== [4] reader-fed feed-path bench (host python vs native C++ assembly)"
 for SRC in host native; do
     timeout 1200 python benchmarks/feed_bench.py --batch 128 --source $SRC \

@@ -143,7 +143,14 @@ class DenseBatchLoader:
     gserver/dataproviders/PyDataProvider2.cpp:195 async pool).
     Falls back to the Python chunk reader when the native lib is
     unavailable. Yields np.uint8 arrays [n, record_bytes]; the tail
-    batch is short unless drop_last."""
+    batch is short unless drop_last.
+
+    shuffle=True shuffles CHUNK order only — record grouping within a
+    batch recurs across epochs (and is fixed when chunk_records ==
+    batch_size). Write files with chunk_records >> batch_size (and >1
+    reader thread) for cross-epoch batch diversity, or pre-shuffle
+    records at write time; sample-level reshuffling is only available on
+    the per-sample reader path."""
 
     def __init__(self, path: str, record_bytes: int, batch_size: int,
                  shuffle: bool = False, seed: Optional[int] = 0,

@@ -42,8 +42,15 @@ class Writer:
         """Append one record (any picklable object; with raw=True the
         record must be bytes and is framed verbatim — the fixed-layout
         fast path the native batch loader consumes)."""
-        self._buf.append(bytes(record) if self.raw
-                         else pickle.dumps(record, protocol=4))
+        if self.raw:
+            if not isinstance(record, (bytes, bytearray, memoryview)):
+                raise TypeError(
+                    f"raw=True writer takes bytes-like records, got "
+                    f"{type(record).__name__} (bytes(int) would silently "
+                    f"write zeros)")
+            self._buf.append(bytes(record))
+        else:
+            self._buf.append(pickle.dumps(record, protocol=4))
         self._count += 1
         if len(self._buf) >= self.records_per_chunk:
             self._flush()

@@ -336,8 +336,14 @@ class SGD:
             loss, outs = self._eval_step(self.parameters.values,
                                          self.parameters.state, feeds)
             self.evaluators.add_batch(outs)
-            total += float(loss) * len(data_batch)
-            n += len(data_batch)
+            # record count: pre-batched column tuples carry it in the
+            # leading axis; sample lists in their length
+            if isinstance(data_batch, tuple):
+                bs = int(next(iter(feeds.values())).array.shape[0])
+            else:
+                bs = len(data_batch)
+            total += float(loss) * bs
+            n += bs
         return events.TestResult(self.evaluators,
                                  cost=total / max(n, 1))
 
