@@ -80,7 +80,7 @@ except Exception:  # noqa: BLE001 — an import crash here would erase the
     # one-JSON-line contract before any guard exists; fall back to the
     # same parse inline
     _FB = os.environ.get("BENCH_FUSED_BN", "0")
-    FUSED_BN = _FB if _FB in ("int8", "full", "q8") else _FB == "1"
+    FUSED_BN = _FB if _FB in ("int8", "full", "q8", "defer") else _FB == "1"
 
 
 def log(*a):

@@ -57,7 +57,7 @@ def main():
     xt, yt = make(n_test, 2)
 
     results = {}
-    for mode in (False, True, "int8", "full", "q8"):
+    for mode in (False, True, "int8", "full", "q8", "defer"):
         x = layer.data("img", paddle.data_type.dense_vector(3 * 16 * 16))
         lbl = layer.data("lbl", paddle.data_type.integer_value(4))
         # the q8 pipeline needs a dense stem before its entry stash (the
@@ -65,11 +65,13 @@ def main():
         c1 = resnet.conv_bn_layer(x, 16, 3, 1, 1,
                                   paddle.activation.Relu(), ch_in=3,
                                   name="q_c1",
-                                  fused=False if mode == "q8" else mode)
-        if mode == "q8":
-            c1 = layer.q8_entry(c1, name="q_entry")
+                                  fused=False if mode in ("q8", "defer") else mode)
+        if mode in ("q8", "defer"):
+            c1 = layer.q8_entry(c1, name="q_entry",
+                                stash="bf16" if mode == "defer"
+                                else "int8")
         b1 = resnet.basic_block(c1, 16, 16, 1, name="q_b1", fused=mode)
-        if mode == "q8":
+        if mode in ("q8", "defer"):
             b1 = layer.q8_exit(b1, name="q_exit")
         pool = layer.img_pool(b1, pool_size=16, stride=1,
                               pool_type=paddle.pooling.Avg())

@@ -29,8 +29,8 @@ timeout 900 python benchmarks/q8_probe.py \
     > "$RUNS/${STAMP}_q8_chain_probe.txt" 2>/tmp/qd_probe.log \
     && cat "$RUNS/${STAMP}_q8_chain_probe.txt"
 
-echo "== [2] resnet50 A/B: unfused vs q8 pipeline"
-for MODE in 0 q8; do
+echo "== [2] resnet50 A/B: unfused vs defer (bf16 stash) vs q8 (int8 stash)"
+for MODE in 0 defer q8; do
     BENCH_FUSED_BN=$MODE BENCH_WALL_BUDGET=1400 timeout 1500 python bench.py \
         > "$RUNS/${STAMP}_resnet50_q8ab_${MODE}.json" \
         2>"/tmp/qd_q8ab_${MODE}.log"

@@ -75,7 +75,8 @@ def main():
 
     rows = []
     for n in (1, 2, 4, 8):
-        out = tempfile.mktemp(suffix=f"_scal{n}.json")
+        fd, out = tempfile.mkstemp(suffix=f"_scal{n}.json")
+        os.close(fd)
         rcs = launch.launch_local(
             n, [os.path.abspath(__file__), "--worker"],
             devices_per_proc=1, env_extra={"SCALING_OUT": out},

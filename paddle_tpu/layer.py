@@ -540,11 +540,14 @@ def _q8_info(lo: LayerOutput):
     return info
 
 
-def q8_entry(input, name: Optional[str] = None, num_channels=None):
+def q8_entry(input, name: Optional[str] = None, num_channels=None,
+             stash: str = "int8"):
     """Quantize a dense activation into the q8 pipeline (ops/q8.py): from
     here until q8_exit, activations exist in HBM only as centered int8
-    under delayed scaling. Training-mode only; in eval the pipeline runs
-    the exact dense math."""
+    under delayed scaling (stash="bf16" keeps the same deferral/remat
+    machinery with a near-lossless bf16 stash — the "defer" recipe).
+    Training-mode only; in eval the pipeline runs the exact dense
+    math."""
     from paddle_tpu.ops import q8 as ops_q8
 
     name = name or auto_name("q8_entry")
@@ -558,7 +561,7 @@ def q8_entry(input, name: Optional[str] = None, num_channels=None):
             ctx.state_out[mean_s.name] = ctx.state_in[mean_s.name]
             ctx.state_out[scale_s.name] = ctx.state_in[scale_s.name]
             return v
-        yhat, q, mu, amax = ops_q8.entry_stash(
+        yhat, q, mu, amax = ops_q8.make_entry(stash)(
             v.array, ctx.state_in[mean_s.name], ctx.state_in[scale_s.name])
         ctx.state_out[mean_s.name] = mu
         ctx.state_out[scale_s.name] = ops_q8.scale_from_amax(amax)
@@ -578,7 +581,7 @@ def img_conv_bn_q8(input, filter_size, num_filters: int,
                    param_attr=None, bn_param_attr=None, bn_bias_attr=None,
                    moving_average_fraction=0.9, epsilon=1e-5,
                    conv_name: Optional[str] = None,
-                   bn_name: Optional[str] = None):
+                   bn_name: Optional[str] = None, stash: str = "int8"):
     """Conv→BN block on the q8 pipeline (ops/q8.py): reads the producer's
     int8 stash (dequant + producer-BN affine + producer activation fused
     into this conv's input fusion), writes its own int8 stash (center +
@@ -642,7 +645,7 @@ def img_conv_bn_q8(input, filter_size, num_filters: int,
                 ctx.state_out[spec.name] = ctx.state_in[spec.name]
             return _apply_act(Value(y), act_name)
         M, B, relu_in = _q8_parent_fold(parent_info, params, v.aux, ops_q8)
-        blk = ops_q8.make_conv_q8(stride, padding, relu_in)
+        blk = ops_q8.make_conv_q8(stride, padding, relu_in, stash)
         yhat, q, mu, var, amax = blk(
             v.array, v.aux["q"], params[wspec.name], M, B,
             ctx.state_in[f"{parent_name}.q_mean"],
@@ -668,7 +671,7 @@ def img_conv_bn_q8(input, filter_size, num_filters: int,
 
 
 def addto_q8(input: Sequence[LayerOutput], act=None,
-             name: Optional[str] = None):
+             name: Optional[str] = None, stash: str = "int8"):
     """Residual add on the q8 pipeline: applies both producers' deferred
     BN affines/activations, adds, and stashes the sum centered PRE-act;
     this layer's own activation is deferred to its consumers."""
@@ -692,7 +695,7 @@ def addto_q8(input: Sequence[LayerOutput], act=None,
             return _apply_act(Value(va.array + vb.array), act_name)
         Ma, Ba, relu_a = _q8_parent_fold(p_infos[0], params, va.aux, ops_q8)
         Mb, Bb, relu_b = _q8_parent_fold(p_infos[1], params, vb.aux, ops_q8)
-        blk = ops_q8.make_add_q8(relu_a, relu_b)
+        blk = ops_q8.make_add_q8(relu_a, relu_b, stash)
         yhat, q, mu, amax = blk(
             va.array, va.aux["q"], Ma, Ba,
             ctx.state_in[f"{p_names[0]}.q_mean"],

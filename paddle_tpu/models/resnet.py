@@ -16,14 +16,17 @@ def conv_bn_layer(input, ch_out, filter_size, stride, padding, active_type,
     statistics (ops/pallas/conv_bn.py), eliminating the stats-reduce
     read of the activation on every BN'd conv. ``fused="q8"`` runs the
     q8 pipeline (ops/q8.py): activations stored int8 in HBM, BN affine +
-    activation deferred into the consumer's conv fusion."""
-    if fused == "q8":
+    activation deferred into the consumer's conv fusion. ``fused="defer"``
+    is the same deferral machinery with a near-lossless bf16 stash (the
+    affine-prologue block-remat recipe)."""
+    if fused in ("q8", "defer"):
         return layer.img_conv_bn_q8(
             input, filter_size=filter_size, num_filters=ch_out,
             num_channels=ch_in, stride=stride, padding=padding,
             act=active_type, name=f"{name}_q8" if name else None,
             conv_name=f"{name}_conv" if name else None,
-            bn_name=f"{name}_bn" if name else None)
+            bn_name=f"{name}_bn" if name else None,
+            stash="bf16" if fused == "defer" else "int8")
     if fused:
         # explicit integer padding (NOT "SAME": XLA pads SAME
         # asymmetrically at stride 2, which would silently change
@@ -57,8 +60,9 @@ def shortcut(input, ch_in, ch_out, stride, name=None, fused=False):
 
 
 def _addto(inputs, act, name, fused):
-    if fused == "q8":
-        return layer.addto_q8(inputs, act=act, name=name)
+    if fused in ("q8", "defer"):
+        return layer.addto_q8(inputs, act=act, name=name,
+                              stash="bf16" if fused == "defer" else "int8")
     return layer.addto(inputs, act=act, name=name)
 
 
@@ -126,15 +130,17 @@ def resnet_imagenet(input, depth=50, class_num=1000, img_size=224,
 
     ch_in = 64
     tmp = pool1
-    if fused_bn == "q8":
-        tmp = layer.q8_entry(tmp, name="res_q8_entry")
+    if fused_bn in ("q8", "defer"):
+        tmp = layer.q8_entry(tmp, name="res_q8_entry",
+                             stash="bf16" if fused_bn == "defer"
+                             else "int8")
     for stage, (n, ch_out) in enumerate(zip(counts, [64, 128, 256, 512])):
         for i in range(n):
             stride = 2 if (i == 0 and stage > 0) else 1
             tmp = block(tmp, ch_in, ch_out, stride,
                         name=f"res{stage+2}_{i}", fused=fused_bn)
             ch_in = ch_out * expansion
-    if fused_bn == "q8":
+    if fused_bn in ("q8", "defer"):
         tmp = layer.q8_exit(tmp, name="res_q8_exit")
     pool = layer.img_pool(tmp, pool_size=7, stride=1,
                           pool_type=pooling.Avg(), name="res_gap")

@@ -68,7 +68,18 @@ def scale_from_amax(amax: jax.Array) -> jax.Array:
     return jnp.maximum(amax, 1e-6) / QMAX
 
 
-def _quantize(z: jax.Array) -> jax.Array:
+_STASHES = ("int8", "bf16")
+
+
+def _quantize(z: jax.Array, stash: str = "int8") -> jax.Array:
+    if stash not in _STASHES:
+        raise ValueError(f"unknown stash dtype {stash!r}; one of {_STASHES}")
+    if stash == "bf16":
+        # the "defer" recipe: same deferred-BN/activation machinery and
+        # residual discipline, but a bf16 stash — bf16-rounding noise only (~0.4% rel),
+        # 2 bytes/elt instead of 1 (BENCHMARKS.md "affine-prologue block
+        # remat", modelled 48.5 GB/step)
+        return z.astype(jnp.bfloat16)
     return jnp.clip(jnp.round(z), -127.0, 127.0).astype(jnp.int8)
 
 
@@ -86,11 +97,19 @@ def _int_zero(q):
     return np.zeros(q.shape, dtype=jax.dtypes.float0)
 
 
-def _stash(yf, mu_po, s_po):
+def _stash_zero(q):
+    """Zero cotangent matching the stash dtype: float0 for int8 stashes,
+    a real zero array for bf16 ("defer") stashes."""
+    if jnp.issubdtype(q.dtype, jnp.integer):
+        return _int_zero(q)
+    return jnp.zeros_like(q)
+
+
+def _stash(yf, mu_po, s_po, stash: str = "int8"):
     """Center+quantize with the delayed constants; emit stash, carrier,
     and the absmax that becomes next step's scale."""
     amax = jnp.max(jnp.abs(yf - mu_po), axis=(0, 1, 2))
-    q = _quantize((yf - mu_po) / s_po)
+    q = _quantize((yf - mu_po) / s_po, stash)
     yhat = _dequant(q, mu_po, s_po).astype(dtypes.compute_dtype())
     return yhat, q, amax
 
@@ -99,30 +118,38 @@ def _stash(yf, mu_po, s_po):
 # entry: dense bf16 -> (q, carrier)
 # ---------------------------------------------------------------------------
 
-@jax.custom_vjp
+@functools.lru_cache(maxsize=None)
+def make_entry(stash: str = "int8"):
+    if stash not in _STASHES:
+        raise ValueError(f"unknown stash dtype {stash!r}; one of {_STASHES}")
+    @jax.custom_vjp
+    def entry_stash(x, mu_p, s_p):
+        """Quantize a dense activation into the pipeline. mu_p/s_p are
+        the delayed (previous-step) per-channel center/scale — state,
+        stop-grad. Returns (yhat, q, mu, amax); mu feeds next step's
+        centering state."""
+        xf = x.astype(jnp.float32)
+        yhat, q, amax = _stash(xf, mu_p, s_p, stash)
+        mu = jnp.mean(xf, axis=(0, 1, 2))
+        return yhat, q, mu, amax
+
+    def fwd(x, mu_p, s_p):
+        return entry_stash(x, mu_p, s_p), (mu_p, s_p)
+
+    def bwd(res, cots):
+        mu_p, s_p = res
+        g_yhat = cots[0]
+        # straight-through: ŷ ≈ x, the carrier's cotangent IS the input's
+        return (g_yhat.astype(dtypes.compute_dtype()),
+                jnp.zeros_like(mu_p), jnp.zeros_like(s_p))
+
+    entry_stash.defvjp(fwd, bwd)
+    return entry_stash
+
+
 def entry_stash(x, mu_p, s_p):
-    """Quantize a dense activation into the q8 pipeline. mu_p/s_p are the
-    delayed (previous-step) per-channel center/scale — state, stop-grad.
-    Returns (yhat, q, mu, amax); mu feeds next step's centering state."""
-    xf = x.astype(jnp.float32)
-    yhat, q, amax = _stash(xf, mu_p, s_p)
-    mu = jnp.mean(xf, axis=(0, 1, 2))
-    return yhat, q, mu, amax
-
-
-def _entry_fwd(x, mu_p, s_p):
-    return entry_stash(x, mu_p, s_p), (mu_p, s_p)
-
-
-def _entry_bwd(res, cots):
-    mu_p, s_p = res
-    g_yhat = cots[0]
-    # straight-through: ŷ ≈ x, so the carrier's cotangent IS the input's
-    return (g_yhat.astype(dtypes.compute_dtype()), jnp.zeros_like(mu_p),
-            jnp.zeros_like(s_p))
-
-
-entry_stash.defvjp(_entry_fwd, _entry_bwd)
+    """Backward-compatible int8 entry (see make_entry)."""
+    return make_entry("int8")(x, mu_p, s_p)
 
 
 # ---------------------------------------------------------------------------
@@ -150,7 +177,7 @@ def make_exit(relu: bool):
         gf = g.astype(jnp.float32)
         if relu:
             gf = gf * (yd * M + B > 0)
-        return ((gf * M).astype(dtypes.compute_dtype()), _int_zero(q),
+        return ((gf * M).astype(dtypes.compute_dtype()), _stash_zero(q),
                 _red(gf * yd, M), _red(gf, B),
                 jnp.zeros_like(mu_p), jnp.zeros_like(s_p))
 
@@ -163,7 +190,10 @@ def make_exit(relu: bool):
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def make_conv_q8(stride: int, padding, relu_in: bool):
+def make_conv_q8(stride: int, padding, relu_in: bool,
+                 stash: str = "int8"):
+    if stash not in _STASHES:
+        raise ValueError(f"unknown stash dtype {stash!r}; one of {_STASHES}")
     """Build the custom-vjp conv block for a static (stride, padding,
     input-activation) configuration.
 
@@ -199,7 +229,7 @@ def make_conv_q8(stride: int, padding, relu_in: bool):
         yf = y.astype(jnp.float32)
         mu = jnp.mean(yf, axis=(0, 1, 2))
         var = jnp.mean(jnp.square(yf - mu), axis=(0, 1, 2))
-        yhat_out, q_out, amax = _stash(yf, mu_po, s_po)
+        yhat_out, q_out, amax = _stash(yf, mu_po, s_po, stash)
         return yhat_out, q_out, mu, var, amax
 
     def fwd(yhat_in, q_in, w, M, B, mu_pi, s_pi, mu_po, s_po):
@@ -227,7 +257,7 @@ def make_conv_q8(stride: int, padding, relu_in: bool):
         d_yhat_in = (dpre * M).astype(dtypes.compute_dtype())
         dM = _red(dpre * yd_in, M)
         dB = _red(dpre, B)
-        return (d_yhat_in, _int_zero(q_in), dw, dM, dB,
+        return (d_yhat_in, _stash_zero(q_in), dw, dM, dB,
                 jnp.zeros_like(mu_pi), jnp.zeros_like(s_pi),
                 jnp.zeros_like(mu_po), jnp.zeros_like(s_po))
 
@@ -240,7 +270,9 @@ def make_conv_q8(stride: int, padding, relu_in: bool):
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def make_add_q8(relu_a: bool, relu_b: bool):
+def make_add_q8(relu_a: bool, relu_b: bool, stash: str = "int8"):
+    if stash not in _STASHES:
+        raise ValueError(f"unknown stash dtype {stash!r}; one of {_STASHES}")
     """Residual-add block. Branch values come in as stashes with their
     deferred ŷ-basis affines (Ma,Ba / Mb,Bb) and optional deferred ReLUs;
     the sum is stashed CENTERED PRE-ReLU (consumers defer the output
@@ -263,7 +295,7 @@ def make_add_q8(relu_a: bool, relu_b: bool):
         z = (branch(qa, Ma, Ba, mu_pa, s_pa, relu_a)
              + branch(qb, Mb, Bb, mu_pb, s_pb, relu_b))
         mu = jnp.mean(z, axis=(0, 1, 2))
-        yhat_out, q_out, amax = _stash(z, mu_po, s_po)
+        yhat_out, q_out, amax = _stash(z, mu_po, s_po, stash)
         return yhat_out, q_out, mu, amax
 
     def fwd(*args):
@@ -289,8 +321,8 @@ def make_add_q8(relu_a: bool, relu_b: bool):
         dya, dMa, dBa = back(qa, Ma, Ba, mu_pa, s_pa, relu_a)
         dyb, dMb, dBb = back(qb, Mb, Bb, mu_pb, s_pb, relu_b)
         z0 = jnp.zeros_like(Ma)
-        return (dya, _int_zero(qa), dMa, dBa, z0, z0,
-                dyb, _int_zero(qb), dMb, dBb, z0, z0, z0, z0)
+        return (dya, _stash_zero(qa), dMa, dBa, z0, z0,
+                dyb, _stash_zero(qb), dMb, dBb, z0, z0, z0, z0)
 
     block.defvjp(fwd, bwd)
     return block
