@@ -65,13 +65,12 @@ def main():
         c1 = resnet.conv_bn_layer(x, 16, 3, 1, 1,
                                   paddle.activation.Relu(), ch_in=3,
                                   name="q_c1",
-                                  fused=False if mode in ("q8", "defer") else mode)
-        if mode in ("q8", "defer"):
+                                  fused=False if resnet._stash_for(mode) else mode)
+        if resnet._stash_for(mode):
             c1 = layer.q8_entry(c1, name="q_entry",
-                                stash="bf16" if mode == "defer"
-                                else "int8")
+                                stash=resnet._stash_for(mode))
         b1 = resnet.basic_block(c1, 16, 16, 1, name="q_b1", fused=mode)
-        if mode in ("q8", "defer"):
+        if resnet._stash_for(mode):
             b1 = layer.q8_exit(b1, name="q_exit")
         pool = layer.img_pool(b1, pool_size=16, stride=1,
                               pool_type=paddle.pooling.Avg())

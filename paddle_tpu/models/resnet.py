@@ -9,6 +9,11 @@ bf16 matmul/conv compute per the global dtype policy.
 from paddle_tpu import activation, layer, pooling
 
 
+def _stash_for(fused):
+    """Stash dtype for the deferral recipes; None = not a deferral mode."""
+    return {"q8": "int8", "defer": "bf16"}.get(fused)
+
+
 def conv_bn_layer(input, ch_out, filter_size, stride, padding, active_type,
                   ch_in=None, name=None, fused=False):
     """(reference: resnet.py conv_bn_layer). ``fused=True`` runs the
@@ -19,14 +24,14 @@ def conv_bn_layer(input, ch_out, filter_size, stride, padding, active_type,
     activation deferred into the consumer's conv fusion. ``fused="defer"``
     is the same deferral machinery with a near-lossless bf16 stash (the
     affine-prologue block-remat recipe)."""
-    if fused in ("q8", "defer"):
+    if _stash_for(fused):
         return layer.img_conv_bn_q8(
             input, filter_size=filter_size, num_filters=ch_out,
             num_channels=ch_in, stride=stride, padding=padding,
             act=active_type, name=f"{name}_q8" if name else None,
             conv_name=f"{name}_conv" if name else None,
             bn_name=f"{name}_bn" if name else None,
-            stash="bf16" if fused == "defer" else "int8")
+            stash=_stash_for(fused))
     if fused:
         # explicit integer padding (NOT "SAME": XLA pads SAME
         # asymmetrically at stride 2, which would silently change
@@ -60,9 +65,9 @@ def shortcut(input, ch_in, ch_out, stride, name=None, fused=False):
 
 
 def _addto(inputs, act, name, fused):
-    if fused in ("q8", "defer"):
+    if _stash_for(fused):
         return layer.addto_q8(inputs, act=act, name=name,
-                              stash="bf16" if fused == "defer" else "int8")
+                              stash=_stash_for(fused))
     return layer.addto(inputs, act=act, name=name)
 
 
@@ -130,17 +135,16 @@ def resnet_imagenet(input, depth=50, class_num=1000, img_size=224,
 
     ch_in = 64
     tmp = pool1
-    if fused_bn in ("q8", "defer"):
+    if _stash_for(fused_bn):
         tmp = layer.q8_entry(tmp, name="res_q8_entry",
-                             stash="bf16" if fused_bn == "defer"
-                             else "int8")
+                             stash=_stash_for(fused_bn))
     for stage, (n, ch_out) in enumerate(zip(counts, [64, 128, 256, 512])):
         for i in range(n):
             stride = 2 if (i == 0 and stage > 0) else 1
             tmp = block(tmp, ch_in, ch_out, stride,
                         name=f"res{stage+2}_{i}", fused=fused_bn)
             ch_in = ch_out * expansion
-    if fused_bn in ("q8", "defer"):
+    if _stash_for(fused_bn):
         tmp = layer.q8_exit(tmp, name="res_q8_exit")
     pool = layer.img_pool(tmp, pool_size=7, stride=1,
                           pool_type=pooling.Avg(), name="res_gap")

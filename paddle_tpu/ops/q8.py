@@ -71,9 +71,12 @@ def scale_from_amax(amax: jax.Array) -> jax.Array:
 _STASHES = ("int8", "bf16")
 
 
-def _quantize(z: jax.Array, stash: str = "int8") -> jax.Array:
+def _check_stash(stash: str) -> None:
     if stash not in _STASHES:
         raise ValueError(f"unknown stash dtype {stash!r}; one of {_STASHES}")
+
+
+def _quantize(z: jax.Array, stash: str = "int8") -> jax.Array:
     if stash == "bf16":
         # the "defer" recipe: same deferred-BN/activation machinery and
         # residual discipline, but a bf16 stash — bf16-rounding noise only (~0.4% rel),
@@ -120,8 +123,8 @@ def _stash(yf, mu_po, s_po, stash: str = "int8"):
 
 @functools.lru_cache(maxsize=None)
 def make_entry(stash: str = "int8"):
-    if stash not in _STASHES:
-        raise ValueError(f"unknown stash dtype {stash!r}; one of {_STASHES}")
+    _check_stash(stash)
+
     @jax.custom_vjp
     def entry_stash(x, mu_p, s_p):
         """Quantize a dense activation into the pipeline. mu_p/s_p are
@@ -192,8 +195,6 @@ def make_exit(relu: bool):
 @functools.lru_cache(maxsize=None)
 def make_conv_q8(stride: int, padding, relu_in: bool,
                  stash: str = "int8"):
-    if stash not in _STASHES:
-        raise ValueError(f"unknown stash dtype {stash!r}; one of {_STASHES}")
     """Build the custom-vjp conv block for a static (stride, padding,
     input-activation) configuration.
 
@@ -212,6 +213,7 @@ def make_conv_q8(stride: int, padding, relu_in: bool,
              folds them into ITS (M, B); their cotangents carry the exact
              BN batch-stat backward terms here.
     """
+    _check_stash(stash)
 
     def prologue(q_in, M, B, mu_pi, s_pi):
         x = _dequant(q_in, mu_pi, s_pi) * M + B
@@ -271,8 +273,6 @@ def make_conv_q8(stride: int, padding, relu_in: bool,
 
 @functools.lru_cache(maxsize=None)
 def make_add_q8(relu_a: bool, relu_b: bool, stash: str = "int8"):
-    if stash not in _STASHES:
-        raise ValueError(f"unknown stash dtype {stash!r}; one of {_STASHES}")
     """Residual-add block. Branch values come in as stashes with their
     deferred ŷ-basis affines (Ma,Ba / Mb,Bb) and optional deferred ReLUs;
     the sum is stashed CENTERED PRE-ReLU (consumers defer the output
@@ -282,6 +282,7 @@ def make_add_q8(relu_a: bool, relu_b: bool, stash: str = "int8"):
        yb, qb, Mb, Bb, mu_pb, s_pb, mu_po, s_po)
         -> (yhat_out, q_out, mu, amax)
     """
+    _check_stash(stash)
 
     def branch(q, M, B, mu_p, s_p, relu):
         v = _dequant(q, mu_p, s_p) * M + B
