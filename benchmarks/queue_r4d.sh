@@ -76,6 +76,17 @@ timeout 1500 python benchmarks/transformer_bench.py --seq 2048 --batch 8 \
     > "$RUNS/${STAMP}_transformer_1024x16.jsonl" 2>/tmp/qd_big.log \
     && cat "$RUNS/${STAMP}_transformer_1024x16.jsonl"
 
+echo "== [3c] long-context capacity: seq 8192 q8 layer-remat at batch 8"
+echo "        (baseline: no-remat fits only batch 2 — table row exists)"
+timeout 1500 python benchmarks/transformer_bench.py --seq 8192 --batch 8 \
+    --flash on --remat q8 \
+    > "$RUNS/${STAMP}_transformer_8k_remat.jsonl" 2>/tmp/qd_remat.log \
+    && cat "$RUNS/${STAMP}_transformer_8k_remat.jsonl"
+timeout 900 python benchmarks/transformer_bench.py --seq 8192 --batch 8 \
+    --flash on \
+    >> "$RUNS/${STAMP}_transformer_8k_remat.jsonl" 2>>/tmp/qd_remat.log \
+    && tail -1 "$RUNS/${STAMP}_transformer_8k_remat.jsonl"
+
 echo "== [4] reader-fed feed-path bench (host python vs native C++ assembly)"
 for SRC in host native; do
     timeout 1200 python benchmarks/feed_bench.py --batch 128 --source $SRC \

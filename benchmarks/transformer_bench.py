@@ -42,6 +42,11 @@ def main():
     ap.add_argument("--flash", choices=("both", "on", "off"),
                     default="both",
                     help="which attention variants to measure")
+    ap.add_argument("--remat", choices=("none", "bf16", "q8"),
+                    default="none",
+                    help="layer-granular recompute with a (quantized) "
+                    "stash of each block's input (ops/q8.q8_remat) — "
+                    "the long-context capacity lever")
     args = ap.parse_args()
 
     import jax
@@ -78,7 +83,8 @@ def _run_variant(args, tfm, jax, jnp, tokens, use_flash):
     cfg = tfm.TransformerConfig(
         vocab=args.vocab, d_model=args.d_model, n_layers=args.layers,
         n_heads=args.d_model // 64, d_ff=4 * args.d_model,
-        max_len=args.seq, use_flash_attention=use_flash)
+        max_len=args.seq, use_flash_attention=use_flash,
+        remat=args.remat)
     params = tfm.init_params(jax.random.PRNGKey(0), cfg)
     # the framework optimizer serves the transformer's nested pytree
     # directly via tree_update (same per-array Adam rule as the v2 path)
@@ -109,7 +115,7 @@ def _run_variant(args, tfm, jax, jnp, tokens, use_flash):
     toks_per_s = args.batch * args.seq / dt
     print(json.dumps({
         "metric": "transformer_lm_tokens_per_sec",
-        "flash_attention": use_flash,
+        "flash_attention": use_flash, "remat": args.remat,
         "seq": args.seq, "batch": args.batch,
         "d_model": args.d_model, "layers": args.layers,
         "ms_per_step": round(dt * 1e3, 2),

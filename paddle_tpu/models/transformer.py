@@ -45,12 +45,23 @@ class TransformerConfig:
                                        # "alltoall" (Ulysses head-scatter;
                                        # needs seq-axis | n_heads)
     use_flash_attention: bool = False  # Pallas fused attention (TPU)
+    remat: str = "none"                # "none" | "bf16" | "q8": layer-
+                                       # granular recompute; autodiff
+                                       # saves only one (quantized) copy
+                                       # of each block's input instead of
+                                       # every intermediate — the
+                                       # long-context capacity lever
+                                       # (ops/q8.q8_remat)
 
     def __post_init__(self):
         if self.cp_mode not in ("ring", "alltoall"):
             raise ValueError(
                 f"cp_mode must be 'ring' or 'alltoall', got "
                 f"{self.cp_mode!r}")
+        if self.remat not in ("none", "bf16", "q8"):
+            raise ValueError(
+                f"remat must be 'none', 'bf16' or 'q8', got "
+                f"{self.remat!r}")
 
     @property
     def head_dim(self):
@@ -266,7 +277,17 @@ def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head,
                                 w["mlp_out"].astype(ff.dtype)), k2)
         return constrain(x), kv
 
-    x, kvs = jax.lax.scan(block, x, (params["blocks"], layer_keys))
+    if cfg.remat != "none" and not return_kv:
+        # layer-granular recompute: backward rebuilds each block from a
+        # (quantized) copy of its input; the scan then saves one stash
+        # per layer instead of every intermediate (ops/q8.q8_remat).
+        # KV-returning calls are serving-only (no backward) — skip there.
+        from paddle_tpu.ops import q8 as ops_q8
+        inner = ops_q8.q8_remat(
+            block, stash="int8" if cfg.remat == "q8" else "bf16")
+        x, kvs = jax.lax.scan(inner, x, (params["blocks"], layer_keys))
+    else:
+        x, kvs = jax.lax.scan(block, x, (params["blocks"], layer_keys))
     if head == "last":
         # serving prefill: only the final position feeds the vocab head —
         # skips the O(T·vocab) logits tensor a full head would materialize

@@ -330,6 +330,57 @@ def make_add_q8(relu_a: bool, relu_b: bool, stash: str = "int8"):
 
 
 # ---------------------------------------------------------------------------
+# generic layer-granular remat with a quantized stash (transformer slot)
+# ---------------------------------------------------------------------------
+
+def q8_remat(fn, stash: str = "int8"):
+    """Wrap ``fn(x, args) -> out`` so autodiff saves only a quantized
+    copy of ``x`` (plus ``args``) and recomputes the block in backward.
+
+    The conv pipeline above defers elementwise epilogues into per-channel
+    affines; transformer blocks contain layer-norms (per-token, not
+    foldable per-channel), so the right granularity there is the whole
+    block: FORWARD USES THE EXACT ``x`` (zero forward error), backward
+    rebuilds the block's vjp at ``x̃ = dequant(stash)``. With
+    stash="int8" (per-tensor scale from the CURRENT absmax — no state
+    needed since the scan carry is materialized anyway) residuals shrink
+    from every block intermediate to one int8 tensor per block;
+    stash="bf16" is classic block remat. ``args`` may be any pytree
+    (weights, PRNG keys); integer leaves get float0 cotangents.
+
+    Reference capability slot: activation memory management of
+    paddle/memory + the recompute knobs of RecurrentGradientMachine —
+    pushed to the long-context endpoint (fit 4-8x longer sequences)."""
+    _check_stash(stash)
+
+    @jax.custom_vjp
+    def wrapped(x, args):
+        return fn(x, args)
+
+    def fwd(x, args):
+        if stash == "bf16":
+            q = x.astype(jnp.bfloat16)
+            scale = jnp.ones((), jnp.float32)
+        else:
+            amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+            scale = jnp.maximum(amax, 1e-6) / 127.0
+            q = _quantize(x.astype(jnp.float32) / scale)
+        # zero-size token carries x's dtype into bwd (residual pytrees
+        # hold arrays only)
+        token = jnp.zeros((0,), x.dtype)
+        return fn(x, args), (q, scale, token, args)
+
+    def bwd(res, g):
+        q, scale, token, args = res
+        xt = (q.astype(jnp.float32) * scale).astype(token.dtype)
+        _, vjp = jax.vjp(fn, xt, args)
+        return vjp(g)
+
+    wrapped.defvjp(fwd, bwd)
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
 # per-channel affine folding (plain differentiable vector math)
 # ---------------------------------------------------------------------------
 

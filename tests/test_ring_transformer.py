@@ -712,3 +712,74 @@ class TestAllToAllAttention:
         import pytest as pt
         with pt.raises(ValueError, match="cp_mode"):
             dc.replace(CFG, cp_mode="ulysses")
+
+
+class TestLayerRemat:
+    """cfg.remat: layer-granular recompute with a (quantized) stash of
+    each block's input (ops/q8.q8_remat) — the long-context capacity
+    lever. Forward must be EXACT (the stash is backward-only); grads
+    match to stash tolerance; the fwd+bwd temp footprint shrinks."""
+
+    def _setup(self, max_len=64, T=32):
+        cfg = transformer.TransformerConfig(
+            vocab=64, d_model=32, n_heads=4, n_layers=3, d_ff=64,
+            max_len=max_len, dtype=jnp.float32)
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.RandomState(0)
+        toks = jnp.asarray(rng.randint(0, 64, (2, T)).astype(np.int32))
+        tgt = jnp.asarray(rng.randint(0, 64, (2, T)).astype(np.int32))
+        return cfg, params, toks, tgt
+
+    @pytest.mark.parametrize("mode,tol", [("bf16", 0.02), ("q8", 0.08)])
+    def test_forward_exact_grads_close(self, mode, tol):
+        cfg, params, toks, tgt = self._setup()
+        ref_l, ref_g = jax.value_and_grad(transformer.lm_loss)(
+            params, toks, tgt, cfg)
+        rcfg = dataclasses.replace(cfg, remat=mode)
+        loss, g = jax.value_and_grad(transformer.lm_loss)(
+            params, toks, tgt, rcfg)
+        assert float(loss) == float(ref_l), "remat changed the forward"
+        worst = max(jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+            lambda a, b: float(jnp.abs(a - b).max()
+                               / (jnp.abs(b).max() + 1e-9)), g, ref_g)))
+        assert worst < tol, f"remat={mode} worst grad rel err {worst}"
+
+    def test_temp_memory_shrinks(self):
+        cfg, params, _, _ = self._setup(max_len=512, T=512)
+        rng = np.random.RandomState(1)
+        toks = jnp.asarray(rng.randint(0, 64, (2, 512)).astype(np.int32))
+
+        def temp(mode):
+            c = dataclasses.replace(cfg, remat=mode)
+            f = jax.jit(lambda p, t, g: jax.value_and_grad(
+                transformer.lm_loss)(p, t, g, c))
+            return f.lower(params, toks,
+                           toks).compile().memory_analysis().temp_size_in_bytes
+
+        none, q8r = temp("none"), temp("q8")
+        assert q8r < 0.5 * none, (none, q8r)
+
+    def test_composes_with_ring_flash(self):
+        """remat=q8 under ring-CP + flash on the seq mesh trains."""
+        mesh = place.make_mesh((1, 8, 1), (place.AXIS_DATA, place.AXIS_SEQ,
+                                           place.AXIS_MODEL))
+        cfg = transformer.TransformerConfig(
+            vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+            max_len=64, dtype=jnp.float32, use_ring_attention=True,
+            use_flash_attention=True, remat="q8")
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        sharded = jax.tree_util.tree_map(
+            jax.device_put, params, transformer.param_shardings(cfg, mesh))
+        rng = np.random.RandomState(0)
+        toks = jnp.asarray(rng.randint(0, 64, (1, 64)).astype(np.int32))
+
+        @jax.jit
+        def step(p, tk):
+            loss, g = jax.value_and_grad(transformer.lm_loss)(
+                p, tk, tk, cfg, mesh=mesh)
+            return loss, jax.tree_util.tree_map(
+                lambda w, gr: w - 0.1 * gr, p, g)
+
+        l1, p2 = step(sharded, toks)
+        l2, _ = step(p2, toks)
+        assert float(l2) < float(l1)
