@@ -28,6 +28,13 @@ the one JSON line.
 Every successful record carries `mfu` — model FLOPs utilisation on the
 textbook fwd+bwd count (12.3 GFLOP/image) against the chip's bf16 peak —
 so the gate artifact tracks compute efficiency, not just throughput.
+
+Recipe schedule: with BENCH_FUSED_BN unset, leftover budget measures the
+stash recipes too (BENCH_TRY_MODES, default "q8,defer") and the emitted
+record is the BEST mode, tagged `modes_measured` — the gate reports the
+framework's best configuration even when the on-chip A/B queue never got
+tunnel time. A failing extra mode is dropped; a budget/driver timeout
+with a measurement in hand emits that measurement, never a failure.
 """
 
 import glob
@@ -371,10 +378,33 @@ def child_main():
 # orchestrator: never imports jax; probes cheaply, escalates on success
 # --------------------------------------------------------------------------
 
-_state = {"probes": 0, "children": 0, "start": time.time()}
+_state = {"probes": 0, "children": 0, "start": time.time(),
+          "best": None, "measured": {}}
+
+
+def _emit_best():
+    """Emit the best successful record measured so far (one critical
+    section with the _emitted flip, like the success path). No-op when
+    nothing succeeded yet."""
+    rec = _state["best"]
+    if not rec:
+        return
+    global _emitted
+    rec = dict(rec, probes=_state["probes"],
+               bench_attempts=_state["children"],
+               modes_measured=_state["measured"])
+    line_out = json.dumps(rec)
+    with _emit_lock:
+        if _emitted:
+            os._exit(0)
+        _emitted = True
+        print(line_out, flush=True)
+    _write_status("done", "ok", _state["children"])
+    sys.exit(0)
 
 
 def _final_fail(reason):
+    _emit_best()                      # a real measurement beats a failure
     elapsed = time.time() - _state["start"]
     emit(0.0, error=f"backend unusable: {reason} "
          f"({_state['probes']} probe(s), {_state['children']} bench "
@@ -410,7 +440,7 @@ def _orch_term_handler(signum, frame):
                 f"the probe schedule")
 
 
-def _run_sub(args, timeout, capture=False):
+def _run_sub(args, timeout, capture=False, env_extra=None):
     """Run a subprocess with a hard timeout; kill -9 on overrun (a wedged
     TPU client ignores SIGTERM). Returns (rc, stdout_text). A spawn
     failure (ENOMEM/EAGAIN) is returned as a failed attempt, never
@@ -419,7 +449,8 @@ def _run_sub(args, timeout, capture=False):
         p = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)] + args,
             stdout=subprocess.PIPE if capture else sys.stderr,
-            stderr=sys.stderr, text=True)
+            stderr=sys.stderr, text=True,
+            env=dict(os.environ, **(env_extra or {})))
     except OSError as e:
         log(f"[orch] subprocess spawn failed: {type(e).__name__}: {e}")
         return -1, ""
@@ -450,6 +481,18 @@ def orchestrate():
     start = _state["start"]
     deadline = start + WALL_BUDGET
     last_reason = "no probe attempted"
+    # recipe schedule: the configured mode first; when BENCH_FUSED_BN was
+    # left at its default, spend leftover budget measuring the stash
+    # recipes too and emit the BEST record (tagged with every mode
+    # measured) — the gate reports the framework's best configuration
+    # even when the on-chip A/B queue never got tunnel time
+    if os.environ.get("BENCH_FUSED_BN") is None:
+        extra = os.environ.get("BENCH_TRY_MODES", "q8,defer")
+    else:
+        extra = os.environ.get("BENCH_TRY_MODES", "")
+    pending = [FUSED_BN if isinstance(FUSED_BN, str)
+               else ("1" if FUSED_BN else "0")]
+    pending += [m for m in extra.split(",") if m and m not in pending]
     while True:
         remaining = deadline - time.time()
         if remaining < PROBE_TIMEOUT + 30:
@@ -471,16 +514,19 @@ def orchestrate():
                 _final_fail(last_reason)
             time.sleep(sleep_s)
             continue
+        mode = pending[0]
         log(f"[orch] probe {n} ok in {time.time()-t0:.0f}s — "
-            f"escalating to full bench")
+            f"escalating to full bench (mode={mode})")
         _state["children"] += 1
-        _write_status("bench", "probe ok", _state["children"])
+        _write_status("bench", f"probe ok, mode={mode}",
+                      _state["children"])
         # a probe-ok window is the scarce resource: a child may overrun
         # the nominal budget by up to this floor (warm-cache children
         # finish in ~2-3 min; the SIGTERM trap still guarantees the one
         # JSON line if the driver cuts in first)
         child_budget = min(CHILD_TIMEOUT, max(180.0, deadline - time.time()))
-        rc, out = _run_sub(["--child"], child_budget, capture=True)
+        rc, out = _run_sub(["--child"], child_budget, capture=True,
+                           env_extra={"BENCH_FUSED_BN": mode})
         line = next((ln for ln in out.strip().splitlines()
                      if ln.startswith("{")), "")
         try:
@@ -488,33 +534,39 @@ def orchestrate():
         except ValueError:
             rec = {}
         if rec.get("value", 0) > 0:
-            # forward the child's record verbatim (it already appended the
-            # run artifact), annotated with the schedule that produced it.
-            # _emitted flip + print are ONE critical section: a SIGTERM
-            # landing between them must not erase the measurement (the
-            # handler backs off while the lock is held)
-            rec["probes"] = _state["probes"]
-            rec["bench_attempts"] = _state["children"]
-            global _emitted
-            line_out = json.dumps(rec)
-            with _emit_lock:
-                if _emitted:
-                    os._exit(0)
-                _emitted = True
-                print(line_out, flush=True)
-            _write_status("done", "ok", _state["children"])
-            sys.exit(0)
+            _state["measured"][mode] = rec["value"]
+            if (_state["best"] is None
+                    or rec["value"] > _state["best"]["value"]):
+                _state["best"] = rec
+            pending.pop(0)
+            log(f"[orch] mode={mode}: {rec['value']} img/s "
+                f"(measured: {_state['measured']})")
+            if not pending or deadline - time.time() < 240:
+                _emit_best()
+            continue                      # next mode, probe-gated again
         last_reason = (rec.get("error")
                        or f"bench child {'hung' if rc == -9 else f'rc={rc}'}"
                        f" with no record")
-        log(f"[orch] bench attempt failed: {last_reason}")
+        log(f"[orch] bench attempt failed (mode={mode}): {last_reason}")
         if _state["children"] >= MAX_BENCH_ATTEMPTS:
             # a child that keeps failing while probes pass is a
             # deterministic bug (bad env/config), not tunnel weather —
-            # retrying it for the whole budget would hammer the tunnel
+            # retrying it for the whole budget would hammer the tunnel.
+            # With a best-in-hand this emits the measurement instead.
             _final_fail(f"{_state['children']} bench children failed "
                         f"(probes pass — deterministic failure): "
                         f"{last_reason}")
+        if _state["best"] is not None and rec.get("error"):
+            # only extra modes can still be pending once something
+            # succeeded (successes pop the head). A child that RAN and
+            # reported an error is deterministic — drop the extra; a
+            # hang/kill with no record (rc=-9) is tunnel weather and the
+            # mode keeps its probe-gated retries while budget lasts
+            log(f"[orch] dropping failing extra mode {mode}: "
+                f"{rec['error']}")
+            pending.pop(0)
+            if not pending:
+                _emit_best()
         # cool down before re-probing so a fast-failing child can't
         # spin-loop subprocess spawns against the flaky tunnel
         time.sleep(max(0.0, PROBE_INTERVAL - (time.time() - t0)))

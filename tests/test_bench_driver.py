@@ -81,25 +81,31 @@ class TestOrchestrator:
     clock: every fake probe/child consumes 30 s, sleeps advance the
     clock instantly."""
 
-    def _drive(self, bench, monkeypatch, capsys, script, budget=3600):
+    def _drive(self, bench, monkeypatch, capsys, script, budget=3600,
+               try_modes=""):
         clock = {"t": 1_000_000.0}
         monkeypatch.setattr(bench.time, "time", lambda: clock["t"])
         monkeypatch.setattr(bench.time, "sleep",
                             lambda s: clock.update(t=clock["t"] + s))
         monkeypatch.setattr(bench, "WALL_BUDGET", float(budget))
-        bench._state.update(probes=0, children=0, start=clock["t"])
+        # pin the recipe schedule: legacy tests exercise single-mode
+        # behavior; multi-mode tests opt in via try_modes
+        monkeypatch.setenv("BENCH_TRY_MODES", try_modes)
+        bench._state.update(probes=0, children=0, start=clock["t"],
+                            best=None, measured={})
         it = iter(script)
         seen = []
 
-        def fake_run_sub(args, timeout, capture=False):
+        def fake_run_sub(args, timeout, capture=False, env_extra=None):
             clock["t"] += 30
             kind = "probe" if "--probe" in args else "child"
-            seen.append(kind)
+            seen.append(kind if env_extra is None
+                        else f"{kind}:{env_extra.get('BENCH_FUSED_BN')}")
             try:
                 want, rc, out = next(it)
             except StopIteration:
                 want, rc, out = "probe", -9, ""
-            assert want == kind, f"expected {want} subprocess, got {kind}"
+            assert want == kind.split(":")[0] == seen[-1].split(":")[0]                 and want == kind, f"expected {want}, got {kind}"
             return rc, out
 
         monkeypatch.setattr(bench, "_run_sub", fake_run_sub)
@@ -139,7 +145,7 @@ class TestOrchestrator:
         rec = json.loads(out.strip())
         assert rec["value"] == 3200.0
         assert rec["probes"] == 2 and rec["bench_attempts"] == 1
-        assert seen == ["probe", "probe", "child"]
+        assert seen == ["probe", "probe", "child:0"]
 
     def test_failed_child_resumes_probing(self, bench, monkeypatch,
                                           capsys):
@@ -174,7 +180,7 @@ class TestOrchestrator:
                                          script=script, budget=36000)
         assert emitted["value"] == 0.0
         assert "deterministic" in emitted["error"]
-        assert seen.count("child") == bench.MAX_BENCH_ATTEMPTS
+        assert sum(k.startswith("child") for k in seen) == bench.MAX_BENCH_ATTEMPTS
 
     def test_status_shadow_artifact_written(self, bench, monkeypatch,
                                             capsys):
@@ -184,3 +190,50 @@ class TestOrchestrator:
         with open(path) as f:
             rec = json.load(f)
         assert rec["stage"] == "probe"
+
+
+class TestMultiModeGate:
+    """When BENCH_FUSED_BN is unset the orchestrator spends leftover
+    budget measuring the stash recipes too and emits the BEST record,
+    tagged with every measured mode."""
+
+    _drive = TestOrchestrator._drive
+
+    def test_best_of_modes_wins(self, bench, monkeypatch, capsys):
+        a = json.dumps({"metric": METRIC, "value": 2500.0, "fused_bn": False})
+        b = json.dumps({"metric": METRIC, "value": 4100.0, "fused_bn": "q8"})
+        emitted, out, seen = self._drive(
+            bench, monkeypatch, capsys, try_modes="q8",
+            script=[("probe", 0, ""), ("child", 0, a + "\n"),
+                    ("probe", 0, ""), ("child", 0, b + "\n")])
+        assert not emitted
+        rec = json.loads(out.strip())
+        assert rec["value"] == 4100.0
+        assert rec["modes_measured"] == {"0": 2500.0, "q8": 4100.0}
+        assert seen == ["probe", "child:0", "probe", "child:q8"]
+
+    def test_failing_extra_mode_is_dropped(self, bench, monkeypatch,
+                                           capsys):
+        a = json.dumps({"metric": METRIC, "value": 2500.0})
+        bad = json.dumps({"metric": METRIC, "value": 0.0,
+                          "error": "Mosaic lowering failed"})
+        emitted, out, seen = self._drive(
+            bench, monkeypatch, capsys, try_modes="q8",
+            script=[("probe", 0, ""), ("child", 0, a + "\n"),
+                    ("probe", 0, ""), ("child", 1, bad + "\n")])
+        assert not emitted
+        rec = json.loads(out.strip())
+        assert rec["value"] == 2500.0
+        assert rec["modes_measured"] == {"0": 2500.0}
+
+    def test_budget_exhausted_emits_best_not_failure(self, bench,
+                                                     monkeypatch, capsys):
+        a = json.dumps({"metric": METRIC, "value": 2500.0})
+        # after the first success, every probe fails until the budget dies
+        emitted, out, seen = self._drive(
+            bench, monkeypatch, capsys, try_modes="q8", budget=900,
+            script=[("probe", 0, ""), ("child", 0, a + "\n")]
+            + [("probe", -9, "")] * 10)
+        assert not emitted                     # best emitted, not failure
+        rec = json.loads(out.strip())
+        assert rec["value"] == 2500.0
