@@ -46,6 +46,14 @@ SWEEP = [
     ("vgg19", {"BENCH_BATCH": "256"}, 256000 / 29.27, "2xXeon6148 MKL-DNN"),
     ("resnet50", {"BENCH_BATCH": "128"}, None, "north star 4000 img/s"),
     ("resnet50", {"BENCH_BATCH": "256"}, None, "north star 4000 img/s"),
+    ("resnet50", {"BENCH_BATCH": "128", "BENCH_FUSED_BN": "defer"}, None,
+     "north star 4000 img/s"),
+    ("resnet50", {"BENCH_BATCH": "256", "BENCH_FUSED_BN": "defer"}, None,
+     "north star 4000 img/s"),
+    ("resnet50", {"BENCH_BATCH": "128", "BENCH_FUSED_BN": "q8"}, None,
+     "north star 4000 img/s"),
+    ("resnet50", {"BENCH_BATCH": "256", "BENCH_FUSED_BN": "q8"}, None,
+     "north star 4000 img/s"),
     ("lstm", {"BENCH_BATCH": "64", "BENCH_HIDDEN": "256"}, 83.0, K40),
     ("lstm", {"BENCH_BATCH": "64", "BENCH_HIDDEN": "512"}, 184.0, K40),
     ("lstm", {"BENCH_BATCH": "64", "BENCH_HIDDEN": "1280"}, 641.0, K40),

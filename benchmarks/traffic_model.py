@@ -54,7 +54,7 @@ def resnet50_convs(img=224):
 
 
 def account(batch, fused_bn=False, stash8=False, fused_bwd=False,
-            prologue=False, q8_pipe=False, act_bytes=BF16):
+            prologue=False, q8_pipe=False, q8_xla=False, act_bytes=BF16):
     """stash8: backward-saved activations (x for dw, y's centered copy
     for the BN backward) stored int8 — their backward READS halve, at
     the cost of one extra int8 write per stash in forward.
@@ -71,10 +71,23 @@ def account(batch, fused_bn=False, stash8=False, fused_bwd=False,
     standard fp8-training trick that breaks the scale←full-batch-amax
     dependency); consumer convs dequant+affine+ReLU in the prologue.
     Forward touches 1 byte/elem each way; the backward is the ``full``
-    fused backward reading the same int8 stashes. dy/dx stay bf16."""
+    fused backward reading the same int8 stashes. dy/dx stay bf16.
+
+    q8_xla (the BUILT variant, ops/q8.py): same int8-only forward; the
+    backward is XLA convs inside per-block custom_vjps. Per block: the
+    cotangent chain dy_total = g_yhat + BN-stat terms (reconstructing y
+    from the out-stash) feeds BOTH backward convs — XLA duplicates the
+    elementwise chain into each conv's operand read (2x(y + y8)); the dw
+    conv re-reads the in-stash to rebuild its operand (x8); the dx conv
+    writes the next block's cotangent with the ReLU mask re-read from
+    the in-stash fused in (x8 + x). Comparable to the q8_pipe ideal —
+    the two differ only in which redundant passes each accounting
+    charges (the Pallas ideal pays a standalone reduction pass; the XLA
+    variant pays duplicated operand chains). Measurement decides."""
     convs = resnet50_convs()
-    if q8_pipe:
-        prologue = stash8 = fused_bn = fused_bwd = True
+    if q8_pipe or q8_xla:
+        prologue = stash8 = fused_bn = True
+        fused_bwd = fused_bwd or q8_pipe
     stash_bytes = 1 if stash8 else act_bytes
     detail = {"conv_io": 0.0, "bn_stats": 0.0, "bn_apply": 0.0,
               "bn_bwd": 0.0, "conv_bwd": 0.0, "stash_io": 0.0,
@@ -89,7 +102,7 @@ def account(batch, fused_bn=False, stash8=False, fused_bwd=False,
         x8 = x_elems * stash_bytes
         w_elems = k * k * cin * cout
         n_params += w_elems + 2 * cout
-        if q8_pipe:
+        if q8_pipe or q8_xla:
             # forward conv: read producer's int8 stash, write own int8
             # stash from the epilogue — the bf16 activation never exists
             detail["conv_io"] += x8 + y8
@@ -104,10 +117,18 @@ def account(batch, fused_bn=False, stash8=False, fused_bwd=False,
         # the consumer applies it in-register: no traffic at all.
         if not prologue:
             detail["bn_apply"] += 2 * y
-        if stash8 and not q8_pipe:
+        if stash8 and not (q8_pipe or q8_xla):
             # extra int8 writes of the two stashes
             detail["stash_io"] += x8 + y8
-        if fused_bwd:
+        if q8_xla:
+            # custom-vjp backward with XLA convs: the dy_total chain
+            # (g_yhat read + out-stash read for the stat terms) is
+            # duplicated into both conv operand reads; dw conv rebuilds
+            # xt from the in-stash; dx conv writes the next cotangent
+            # with the ReLU mask (in-stash) fused into its epilogue
+            detail["bn_bwd"] += 2 * (y + y8)
+            detail["conv_bwd"] += x8 + (x8 + x)
+        elif fused_bwd:
             # g recomputed in-register inside the dx/dw kernels: no g
             # write/read at all; each kernel reads (z-stash, dy) itself
             detail["bn_bwd"] += y8 + y              # reduction pass only
@@ -138,7 +159,9 @@ def main():
                   dict(fused_bn=True, stash8=True, fused_bwd=True,
                        prologue=True)),
                  ("q8 pipeline (fp8-class, delayed scaling)",
-                  dict(q8_pipe=True))]
+                  dict(q8_pipe=True)),
+                 ("q8-xla (ops/q8.py as built: XLA-conv backward)",
+                  dict(q8_xla=True))]
     totals = {}
     for name, kw in scenarios:
         total, detail, _ = account(args.batch, **kw)
