@@ -87,6 +87,12 @@ timeout 900 python benchmarks/transformer_bench.py --seq 8192 --batch 8 \
     >> "$RUNS/${STAMP}_transformer_8k_remat.jsonl" 2>>/tmp/qd_remat.log \
     && tail -1 "$RUNS/${STAMP}_transformer_8k_remat.jsonl"
 
+echo "== [3d] decode with int8 weights (weight-read-bound serving lever)"
+timeout 1200 python benchmarks/transformer_bench.py --decode --batch 8 \
+    --weights-int8 \
+    > "$RUNS/${STAMP}_decode_w8.jsonl" 2>/tmp/qd_w8.log \
+    && cat "$RUNS/${STAMP}_decode_w8.jsonl"
+
 echo "== [4] reader-fed feed-path bench (host python vs native C++ assembly)"
 for SRC in host native; do
     timeout 1200 python benchmarks/feed_bench.py --batch 128 --source $SRC \

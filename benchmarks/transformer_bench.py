@@ -42,6 +42,11 @@ def main():
     ap.add_argument("--flash", choices=("both", "on", "off"),
                     default="both",
                     help="which attention variants to measure")
+    ap.add_argument("--weights-int8", action="store_true",
+                    help="decode with per-output-channel int8 weights "
+                    "(io/lm_serving.quantize_lm_params; dequant fused "
+                    "into the matmul operand reads — decode is "
+                    "weight-read-bound)")
     ap.add_argument("--remat", choices=("none", "bf16", "q8"),
                     default="none",
                     help="layer-granular recompute with a (quantized) "
@@ -57,6 +62,9 @@ def main():
     from paddle_tpu.models import transformer as tfm
 
     rng = np.random.RandomState(0)
+    if args.weights_int8 and not args.decode:
+        ap.error("--weights-int8 only applies to --decode (the training "
+                 "path has its own recipes: --remat / BENCH_FUSED_BN)")
     if args.decode:
         _run_decode(args, tfm, jax, jnp, rng)
         return
@@ -140,11 +148,16 @@ def _run_decode(args, tfm, jax, jnp, rng):
             n_heads=heads, n_kv_heads=n_kv, d_ff=4 * args.d_model,
             max_len=prompt_len + args.gen)
         params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+        if args.weights_int8:
+            # generate() threads {"q8","scale"} weights through the scan
+            # carry and dequantizes per step — hoist-proof int8 reads
+            from paddle_tpu.io import lm_serving
+            params = lm_serving.quantize_lm_params(params)
+        gen = jax.jit(lambda p, pr: tfm.generate(
+            p, pr, cfg, max_new=args.gen))
         prompt = jnp.asarray(rng.randint(0, args.vocab,
                                          (args.batch, prompt_len)),
                              jnp.int32)
-        gen = jax.jit(lambda p, pr: tfm.generate(
-            p, pr, cfg, max_new=args.gen))
         t0 = _t.time()
         host_sync(gen(params, prompt))
         compile_s = _t.time() - t0
@@ -160,6 +173,7 @@ def _run_decode(args, tfm, jax, jnp, rng):
                  * cfg.kv_heads * cfg.head_dim * 2 * 2) / 2**20
         print(json.dumps({
             "metric": "transformer_decode_tokens_per_sec",
+            "weights_int8": args.weights_int8,
             "n_kv_heads": cfg.kv_heads, "n_heads": heads,
             "batch": args.batch, "gen": args.gen,
             "prompt_len": prompt_len, "d_model": args.d_model,

@@ -63,28 +63,72 @@ def _cfg_from_dict(d):
     return TransformerConfig(**d)
 
 
+# the big matmul weights of the transformer pytree, with the axis the
+# consuming einsum CONTRACTS over (the quantization-scale reduce axis):
+# blocks.* are [L, in, out] (contract axis -2); embed [V, D] doubles as
+# the logits projection contracting over D (axis -1), which also makes
+# embedding-row gathers dequantize per row
+_W8_LEAVES = {("blocks", "qkv"): -2, ("blocks", "attn_out"): -2,
+              ("blocks", "mlp_in"): -2, ("blocks", "mlp_out"): -2,
+              ("embed",): -1}
+
+
+def quantize_lm_params(params):
+    """Per-output-channel int8 for the big matmul weights (ops/q8
+    helpers); layer norms, biases, and position tables stay fp32.
+    Returns a pytree whose quantized leaves are {"q8","scale"} nodes —
+    HBM (and artifact) weight bytes halve, and every weight read in the
+    decode step becomes 1 byte/elt with the dequant multiply fused into
+    the matmul operand read (decode is weight-read-bound, so this is the
+    serving-throughput lever)."""
+    from paddle_tpu.ops import q8 as ops_q8
+
+    out = {k: (dict(v) if isinstance(v, dict) else v)
+           for k, v in params.items()}
+    for path, axis in _W8_LEAVES.items():
+        node = out
+        for p in path[:-1]:
+            node = node[p]
+        node[path[-1]] = ops_q8.quantize_weight(node[path[-1]], axis)
+    return out
+
+
 def save_lm_artifact(path: str, params, cfg, *, batch: int,
                      prompt_len: int, cache_len: int,
-                     platforms: Optional[Sequence[str]] = None) -> None:
+                     platforms: Optional[Sequence[str]] = None,
+                     weights_int8: bool = False) -> None:
     """Export the serving pair at fixed shapes and pack the artifact.
 
     batch/prompt_len/cache_len fix the exported shapes (AOT modules are
     shape-specialized; export several artifacts for several shapes).
     ``platforms`` e.g. ["tpu", "cpu"] widens where the module may run.
+    ``weights_int8`` stores the big matmul weights as per-output-channel
+    int8 (see quantize_lm_params) — the exported modules dequantize
+    inline, so the loader and LMServer are unchanged.
     """
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models import transformer
+    from paddle_tpu.ops import q8 as ops_q8
 
     if cache_len > cfg.max_len:
         raise ValueError(f"cache_len {cache_len} exceeds cfg.max_len "
                          f"{cfg.max_len}")
 
+    if weights_int8:
+        params = quantize_lm_params(params)
+
+        def _p(p):
+            return ops_q8.dequantize_tree(p)
+    else:
+        def _p(p):
+            return p
+
     def prefill_fn(p, tokens):
-        return transformer.prefill(p, tokens, cfg, cache_len)
+        return transformer.prefill(_p(p), tokens, cfg, cache_len)
 
     def decode_fn(p, cache, tokens, pos):
-        return transformer.decode_step(p, cache, tokens, pos, cfg)
+        return transformer.decode_step(_p(p), cache, tokens, pos, cfg)
 
     kw = {"platforms": list(platforms)} if platforms else {}
     p_shapes = jax.tree_util.tree_map(
@@ -105,6 +149,7 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
 
     meta = {"format_version": FORMAT_VERSION, "batch": batch,
             "prompt_len": prompt_len, "cache_len": cache_len,
+            "weights_int8": weights_int8,
             "config": _cfg_to_dict(cfg)}
     flat = _flatten(params)
     buf = _io.BytesIO()

@@ -404,7 +404,17 @@ def generate(params, prompt: jax.Array, cfg: TransformerConfig, *,
     ``lax.scan`` replays the compiled single-token step ``max_new`` times
     — the TPU-idiomatic decode loop (no per-step retracing, no growing
     shapes). temperature=0 is greedy argmax; otherwise categorical
-    sampling with ``key``."""
+    sampling with ``key``.
+
+    ``params`` may contain int8-quantized weights
+    (io/lm_serving.quantize_lm_params {"q8","scale"} nodes): they are
+    threaded through the SCAN CARRY and dequantized inside each step, so
+    XLA cannot hoist the dequant out of the loop — every decoded token
+    reads the weights from HBM at 1 byte/elt with the dequant multiply
+    fused into the matmul operand reads (decode is weight-read-bound;
+    a loop-invariant dequant would silently restore 4-byte reads)."""
+    from paddle_tpu.ops import q8 as ops_q8
+
     B, Tp = prompt.shape
     if max_new < 1:
         raise ValueError(f"generate: max_new must be >= 1, got {max_new}")
@@ -414,7 +424,12 @@ def generate(params, prompt: jax.Array, cfg: TransformerConfig, *,
                          f"cfg.max_len={cfg.max_len}")
     if temperature > 0 and key is None:
         raise ValueError("generate: sampling (temperature>0) needs a key")
-    logits, cache = prefill(params, prompt, cfg, cache_len, mesh=mesh)
+    quantized = any(ops_q8.is_quantized_weight(n) for n in
+                    jax.tree_util.tree_leaves(
+                        params, is_leaf=ops_q8.is_quantized_weight))
+    live = ops_q8.dequantize_tree(params) if quantized else params
+    logits, cache = prefill(live, prompt, cfg, cache_len, mesh=mesh)
+    del live
     key = key if key is not None else jax.random.PRNGKey(0)
 
     def sample(logits, k):
@@ -425,15 +440,48 @@ def generate(params, prompt: jax.Array, cfg: TransformerConfig, *,
     key, k0 = jax.random.split(key)
     first = sample(logits, k0).astype(jnp.int32)
 
-    def step(carry, i):
-        cache, tok, key = carry
-        key, ks = jax.random.split(key)
-        logits, cache = decode_step(params, cache, tok, Tp + i, cfg)
-        nxt = sample(logits, ks).astype(jnp.int32)
-        return (cache, nxt, key), tok
+    # one step function serves both paths: quantized weights ride the
+    # carry as `extra` and are rebuilt INSIDE the body behind an
+    # optimization barrier — XLA's while-loop simplifier + LICM would
+    # otherwise hoist the loop-invariant dequant and materialize fp32
+    # weights once, silently restoring 4-byte weight reads per token
+    extra0 = (params,) if quantized else ()
 
-    (_, last, _), toks = jax.lax.scan(
-        step, (cache, first, key), jnp.arange(max_new - 1, dtype=jnp.int32))
+    def step(carry, i):
+        extra, cache, tok, key = carry
+        key, ks = jax.random.split(key)
+        if quantized:
+            # three hoist defenses so the dequant stays inside the loop
+            # (int8 weight reads per token, the point of the feature):
+            # the weights ride the CARRY, sit behind an optimization
+            # BARRIER, and the scales fold in a float zero derived from
+            # the loop counter (loop-variant by data dependence). The
+            # CPU backend deletes barriers and folds the zero, hoisting
+            # anyway (one fp32 materialization per generate call —
+            # amortized over max_new tokens, so never WORSE than fp32
+            # decode); whether TPU keeps the in-loop int8 reads is an
+            # on-chip measurement (queue_r4d [3d]). The exported
+            # LMServer path dequantizes per HOST call and cannot be
+            # hoisted regardless.
+            p8 = jax.lax.optimization_barrier(extra[0])
+            i_eps = i.astype(jnp.float32) * 0.0
+
+            def _leaf(n):
+                if ops_q8.is_quantized_weight(n):
+                    return {"q8": n["q8"], "scale": n["scale"] + i_eps}
+                return n
+
+            p = ops_q8.dequantize_tree(jax.tree_util.tree_map(
+                _leaf, p8, is_leaf=ops_q8.is_quantized_weight))
+        else:
+            p = params
+        logits, cache = decode_step(p, cache, tok, Tp + i, cfg)
+        nxt = sample(logits, ks).astype(jnp.int32)
+        return (extra, cache, nxt, key), tok
+
+    (_, _, last, _), toks = jax.lax.scan(
+        step, (extra0, cache, first, key),
+        jnp.arange(max_new - 1, dtype=jnp.int32))
     generated = jnp.concatenate(
         [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1) \
         if max_new > 1 else first[:, None]

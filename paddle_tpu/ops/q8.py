@@ -330,6 +330,42 @@ def make_add_q8(relu_a: bool, relu_b: bool, stash: str = "int8"):
 
 
 # ---------------------------------------------------------------------------
+# weight quantization (serving): per-output-channel symmetric int8
+# ---------------------------------------------------------------------------
+
+def quantize_weight(w: jax.Array, reduce_axis: int):
+    """Symmetric per-output-channel int8: scales are the absmax over the
+    CONTRACTION axis, so each output channel dequantizes with one
+    multiply that fuses into the consuming matmul's operand read —
+    weights live in HBM at 1 byte/elt. Returns {"q8", "scale"} with
+    scale keeping w's rank (broadcastable)."""
+    wf = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=reduce_axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = _quantize(wf / scale)
+    return {"q8": q, "scale": scale.astype(jnp.float32)}
+
+
+def dequantize_weight(node, dtype=jnp.float32) -> jax.Array:
+    """Inverse of quantize_weight; elementwise, fuses into the consumer."""
+    return (node["q8"].astype(jnp.float32) * node["scale"]).astype(dtype)
+
+
+def is_quantized_weight(node) -> bool:
+    return isinstance(node, dict) and set(node) == {"q8", "scale"}
+
+
+def dequantize_tree(tree, dtype=jnp.float32):
+    """Rebuild a params pytree whose quantized leaves are {"q8","scale"}
+    nodes; every other leaf (and any registered container type) passes
+    through."""
+    return jax.tree_util.tree_map(
+        lambda n: dequantize_weight(n, dtype)
+        if is_quantized_weight(n) else n,
+        tree, is_leaf=is_quantized_weight)
+
+
+# ---------------------------------------------------------------------------
 # generic layer-granular remat with a quantized stash (transformer slot)
 # ---------------------------------------------------------------------------
 

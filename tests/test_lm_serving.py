@@ -38,3 +38,83 @@ def test_artifact_shape_guards(tmp_path, rng):
         srv.generate(np.zeros((2, 4), np.int32), max_new=2)
     with pytest.raises(ValueError, match="cache_len"):
         srv.generate(np.zeros((1, 4), np.int32), max_new=20)
+
+
+def test_weights_int8_artifact(tmp_path, rng):
+    """weights_int8: big matmul weights stored per-output-channel int8,
+    dequantized inline by the exported modules — loader unchanged,
+    artifact smaller, logits within per-channel-int8 tolerance."""
+    params = transformer.init_params(jax.random.PRNGKey(0), CFG)
+    B, Tp, new = 2, 6, 6
+    prompt = rng.randint(0, 40, (B, Tp)).astype(np.int32)
+    p_f = str(tmp_path / "lm_f.tar")
+    p_q = str(tmp_path / "lm_q.tar")
+    lm_serving.save_lm_artifact(p_f, params, CFG, batch=B, prompt_len=Tp,
+                                cache_len=Tp + new)
+    lm_serving.save_lm_artifact(p_q, params, CFG, batch=B, prompt_len=Tp,
+                                cache_len=Tp + new, weights_int8=True)
+    srv_f = lm_serving.load_lm_artifact(p_f)
+    srv_q = lm_serving.load_lm_artifact(p_q)
+
+    def param_bytes(tree):
+        return sum(np.asarray(a).nbytes
+                   for a in jax.tree_util.tree_leaves(tree))
+
+    # the big weights store at 1 byte/elt (+ tiny scales); toy tar sizes
+    # round to 512-byte blocks, so compare the parameter payload itself
+    assert param_bytes(srv_q.params) < 0.5 * param_bytes(srv_f.params)
+    assert srv_q.meta["weights_int8"] is True
+    lg_f, _ = srv_f._prefill.call(srv_f.params,
+                                  jnp.asarray(prompt, jnp.int32))
+    lg_q, _ = srv_q._prefill.call(srv_q.params,
+                                  jnp.asarray(prompt, jnp.int32))
+    lf, lq = np.asarray(lg_f), np.asarray(lg_q)
+    denom = np.abs(lf).max() + 1e-9
+    assert np.abs(lq - lf).max() / denom < 0.05, "int8 weights drifted"
+    # generation runs end-to-end off the quantized artifact
+    out = srv_q.generate(prompt, max_new=new)
+    assert out.shape == (B, Tp + new)
+
+
+def test_quantize_lm_params_structure(rng):
+    """Only the big matmul weights become {"q8","scale"} nodes; per-
+    channel dequantization reconstructs within int8 resolution."""
+    from paddle_tpu.ops import q8 as ops_q8
+    params = transformer.init_params(jax.random.PRNGKey(1), CFG)
+    qp = lm_serving.quantize_lm_params(params)
+    assert ops_q8.is_quantized_weight(qp["embed"])
+    assert ops_q8.is_quantized_weight(qp["blocks"]["qkv"])
+    assert not ops_q8.is_quantized_weight(qp["blocks"]["ln1"])
+    assert qp["blocks"]["qkv"]["q8"].dtype == jnp.int8
+    w = np.asarray(params["blocks"]["qkv"])
+    wq = np.asarray(ops_q8.dequantize_weight(qp["blocks"]["qkv"]))
+    rel = np.abs(wq - w).max() / (np.abs(w).max() + 1e-9)
+    assert rel < 0.01, rel
+    # the original params were not mutated
+    assert not ops_q8.is_quantized_weight(params["blocks"]["qkv"])
+
+
+def test_generate_accepts_quantized_params(rng):
+    """generate() detects {"q8","scale"} weights, threads them through
+    the decode scan carry (hoist-proof int8 reads) and produces tokens
+    close to the fp32 path."""
+    params = transformer.init_params(jax.random.PRNGKey(0), CFG)
+    prompt = jnp.asarray(rng.randint(0, 40, (2, 6)).astype(np.int32))
+    out_f = np.asarray(transformer.generate(params, prompt, CFG,
+                                            max_new=8))
+    qp = lm_serving.quantize_lm_params(params)
+    out_q = np.asarray(transformer.generate(qp, prompt, CFG, max_new=8))
+    assert out_q.shape == out_f.shape
+    # toy-model near-ties flip some greedy picks; most must agree
+    assert (out_f == out_q).mean() > 0.6
+    # the int8 leaves reach the traced decode loop (not pre-dequantized):
+    # the while-loop region of the STABLEHLO carries i8 operands. (What
+    # the backend then does is its own business: the CPU pipeline deletes
+    # barriers and hoists the dequant; the on-chip A/B measures TPU —
+    # the LMServer path dequantizes per host call regardless.)
+    shlo = jax.jit(
+        lambda p, pr: transformer.generate(p, pr, CFG, max_new=8)
+    ).lower(qp, prompt).as_text()
+    import re
+    loops = re.findall(r"stablehlo\.while.*?(?:\n  \}|\Z)", shlo, re.S)
+    assert any("i8" in l for l in loops), "int8 absent from decode loop"
