@@ -23,7 +23,7 @@ import numpy as np
 from paddle_tpu.io.checkpoint import _flatten          # shared pytree walk
 from paddle_tpu.io.merged import _add_member as _add   # shared tar append
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2   # max supported; plain artifacts still save as v1
 
 
 def _unflatten(flat):
@@ -147,10 +147,12 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
         jax.ShapeDtypeStruct((batch,), jnp.int32),
         jax.ShapeDtypeStruct((), jnp.int32))
 
-    meta = {"format_version": FORMAT_VERSION, "batch": batch,
-            "prompt_len": prompt_len, "cache_len": cache_len,
-            "weights_int8": weights_int8,
-            "config": _cfg_to_dict(cfg)}
+    meta = {
+        # quantized artifacts carry nested {"q8","scale"} params — a v2
+        # encoding; plain artifacts stay v1 for older loaders
+        "format_version": 2 if weights_int8 else 1,
+        "batch": batch, "prompt_len": prompt_len, "cache_len": cache_len,
+        "weights_int8": weights_int8, "config": _cfg_to_dict(cfg)}
     flat = _flatten(params)
     buf = _io.BytesIO()
     np.savez(buf, **flat)

@@ -26,7 +26,7 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2   # max supported; plain artifacts still save as v1
 
 
 def _npz_bytes(arrays: Dict[str, np.ndarray]) -> bytes:
@@ -50,18 +50,75 @@ def _add_member(tar, name, data: bytes):
 def _serve_fn(topology):
     """forward(params, state, feeds-of-arrays) -> {output: array}; plain
     containers only, so jax.export can serialize the calling convention.
-    Sequence inputs pass their lengths as a sibling '<name>.lengths' key."""
+    Sequence inputs pass their lengths as a sibling '<name>.lengths' key.
+    Quantized weight entries ({"q8","scale"} nodes, weights_int8
+    artifacts) dequantize at entry — per call on the exported path."""
+    from paddle_tpu.ops import q8 as ops_q8
     from paddle_tpu.topology import Value
 
     fwd = topology.compile()
 
     def serve(params, state, feeds):
+        params = ops_q8.dequantize_tree(params)
         vals = {k: Value(v, lengths=feeds.get(f"{k}.lengths"))
                 for k, v in feeds.items() if not k.endswith(".lengths")}
         outs, _ = fwd(params, state, vals, is_training=False)
         return {k: v.array for k, v in outs.items()}
 
     return serve
+
+
+# npz holds a FLAT name->array dict; quantized entries ride two suffixed
+# keys and are reassembled into {"q8","scale"} nodes at load
+_Q8_KEY, _Q8_SCALE_KEY = "@q8", "@q8scale"
+
+
+def quantize_v2_params(values, min_size: int = 4096):
+    """Per-output-channel int8 for the v2 parameter dict's big matmul/conv
+    weights (name '*.w', ndim >= 2, float, >= min_size elements): the
+    contraction axes are everything but the trailing output-channel axis
+    (fc [in, out]; conv HWIO; embeddings get per-column scales). Biases,
+    BN affines, and small tensors stay fp32."""
+    import numpy as _np
+    from paddle_tpu.ops import q8 as ops_q8
+
+    out = {}
+    for k, v in values.items():
+        a = _np.asarray(v)
+        if (k.endswith(".w") and a.ndim >= 2 and a.size >= min_size
+                and _np.issubdtype(a.dtype, _np.floating)):
+            out[k] = ops_q8.quantize_weight(a, tuple(range(a.ndim - 1)))
+        else:
+            out[k] = v
+    return out
+
+
+def _split_quantized(values):
+    """{name: array-or-node} -> flat npz dict with suffixed q8 keys."""
+    from paddle_tpu.ops import q8 as ops_q8
+
+    flat = {}
+    for k, v in values.items():
+        if ops_q8.is_quantized_weight(v):
+            flat[k + _Q8_KEY] = np.asarray(v["q8"])
+            flat[k + _Q8_SCALE_KEY] = np.asarray(v["scale"])
+        else:
+            flat[k] = np.asarray(v)
+    return flat
+
+
+def _join_quantized(flat):
+    """Inverse of _split_quantized."""
+    values = {}
+    for k, v in flat.items():
+        if k.endswith(_Q8_SCALE_KEY):
+            continue
+        if k.endswith(_Q8_KEY):
+            name = k[: -len(_Q8_KEY)]
+            values[name] = {"q8": v, "scale": flat[name + _Q8_SCALE_KEY]}
+        else:
+            values[k] = v
+    return values
 
 
 def example_feeds(topology, batch_size: int) -> Dict[str, np.ndarray]:
@@ -88,13 +145,18 @@ def example_feeds(topology, batch_size: int) -> Dict[str, np.ndarray]:
 
 def save_inference_model(path: str, output_layer, parameters,
                          export_batch_sizes: Sequence[int] = (),
-                         platforms: Optional[Sequence[str]] = None) -> None:
+                         platforms: Optional[Sequence[str]] = None,
+                         weights_int8: bool = False) -> None:
     """Write the one-file serving artifact.
 
     output_layer: LayerOutput or list; parameters: paddle.parameters
     Parameters (or any object with .values/.state dicts).
     export_batch_sizes: also AOT-export the forward at these fixed batch
     sizes (jax.export) for the zero-model-code deployment path.
+    weights_int8: store the big '*.w' weights per-output-channel int8
+    (quantize_v2_params); the serve path dequantizes at entry, so both
+    the replayed topology and the AOT exports consume the quantized
+    artifact unchanged.
     """
     import jax
     from paddle_tpu.topology import Topology
@@ -109,8 +171,15 @@ def save_inference_model(path: str, output_layer, parameters,
             "were given — the artifact would not be servable; pass "
             "export_batch_sizes=[...] to AOT-export instead")
 
+    values = parameters.values
+    if weights_int8:
+        values = quantize_v2_params(values)
+
     meta = {
-        "format_version": FORMAT_VERSION,
+        # quantized artifacts use the v2 params encoding (@q8 suffixed
+        # npz keys); plain artifacts stay v1 so older loaders keep working
+        "format_version": 2 if weights_int8 else 1,
+        "weights_int8": weights_int8,
         "outputs": [o.name for o in topo.outputs],
         "data_layers": topo.data_names(),
         "data_specs": {l.name: [l.data_spec.dim, l.data_spec.kind.value,
@@ -124,7 +193,8 @@ def save_inference_model(path: str, output_layer, parameters,
         if rebuildable:
             _add_member(tar, "topology.json",
                         json.dumps(topo.to_dict()).encode())
-        _add_member(tar, "params.npz", _npz_bytes(parameters.values))
+        _add_member(tar, "params.npz",
+                    _npz_bytes(_split_quantized(values)))
         _add_member(tar, "state.npz", _npz_bytes(parameters.state))
         if export_batch_sizes:
             serve = jax.jit(_serve_fn(topo))
@@ -134,9 +204,11 @@ def save_inference_model(path: str, output_layer, parameters,
                 if platforms:
                     kw["platforms"] = list(platforms)
                 exp = jax.export.export(serve, **kw)(
-                    {k: jax.ShapeDtypeStruct(np.shape(v),
-                                             np.asarray(v).dtype)
-                     for k, v in parameters.values.items()},
+                    jax.tree_util.tree_map(
+                        lambda v: jax.ShapeDtypeStruct(
+                            np.shape(v),
+                            v.dtype if hasattr(v, "dtype")
+                            else np.asarray(v).dtype), values),
                     {k: jax.ShapeDtypeStruct(np.shape(v),
                                              np.asarray(v).dtype)
                      for k, v in parameters.state.items()},
@@ -215,7 +287,7 @@ def load_inference_model(path: str) -> MergedModel:
     topo = None
     if "topology.json" in members:
         topo = Topology.from_dict(json.loads(members["topology.json"]))
-    params = _npz_load(members["params.npz"])
+    params = _join_quantized(_npz_load(members["params.npz"]))
     state = _npz_load(members["state.npz"])
     exported = {}
     for name, data in members.items():

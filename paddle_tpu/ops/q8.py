@@ -333,9 +333,9 @@ def make_add_q8(relu_a: bool, relu_b: bool, stash: str = "int8"):
 # weight quantization (serving): per-output-channel symmetric int8
 # ---------------------------------------------------------------------------
 
-def quantize_weight(w: jax.Array, reduce_axis: int):
+def quantize_weight(w: jax.Array, reduce_axis):
     """Symmetric per-output-channel int8: scales are the absmax over the
-    CONTRACTION axis, so each output channel dequantizes with one
+    CONTRACTION axis/axes, so each output channel dequantizes with one
     multiply that fuses into the consuming matmul's operand read —
     weights live in HBM at 1 byte/elt. Returns {"q8", "scale"} with
     scale keeping w's rank (broadcastable)."""

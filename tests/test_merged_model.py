@@ -125,3 +125,45 @@ print("fresh-process infer OK")
         exp = np.load(tmp_path / "exp.npy")
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(exp, want, rtol=1e-5, atol=1e-6)
+
+
+def test_weights_int8_artifact(tmp_path):
+    """weights_int8 merged artifact: '*.w' weights stored int8 with
+    per-output-channel scales; both the replayed topology and the AOT
+    export dequantize at entry — outputs within int8 tolerance, params
+    payload shrinks, loader/caller API unchanged."""
+    import tarfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import layer
+    from paddle_tpu.io import merged
+    from paddle_tpu.utils.rng import KeySource
+
+    img = layer.data("px", paddle.data_type.dense_vector(64))
+    h = layer.fc(img, 128, act=paddle.activation.Relu(), name="w8_h")
+    out = layer.fc(h, 10, act=paddle.activation.Softmax(), name="w8_o")
+    params = paddle.parameters.create(out, KeySource(5))
+    x = np.random.RandomState(0).rand(4, 64).astype(np.float32)
+
+    p_f = str(tmp_path / "m_f.tar")
+    p_q = str(tmp_path / "m_q.tar")
+    merged.save_inference_model(p_f, out, params, export_batch_sizes=(4,))
+    merged.save_inference_model(p_q, out, params, export_batch_sizes=(4,),
+                                weights_int8=True)
+
+    def payload(p):
+        with tarfile.open(p) as t:
+            return len(t.extractfile("params.npz").read())
+
+    assert payload(p_q) < 0.5 * payload(p_f)
+    mf = merged.load_inference_model(p_f)
+    mq = merged.load_inference_model(p_q)
+    assert mq.meta["weights_int8"] is True
+    rf = mf.infer({"px": x})["w8_o"]
+    rq = mq.infer({"px": x})["w8_o"]
+    assert np.abs(rf - rq).max() < 0.02
+    ef = np.asarray(mf.call_exported({"px": x})["w8_o"])
+    eq = np.asarray(mq.call_exported({"px": x})["w8_o"])
+    assert np.abs(ef - eq).max() < 0.02
