@@ -24,11 +24,6 @@ print('probe ok', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" \
 
 probe
 
-echo "== [1] q8 16-block chain probe (wall, cost bytes, temp MB)"
-timeout 900 python benchmarks/q8_probe.py \
-    > "$RUNS/${STAMP}_q8_chain_probe.txt" 2>/tmp/qd_probe.log \
-    && cat "$RUNS/${STAMP}_q8_chain_probe.txt"
-
 echo "== [2] resnet50 A/B: unfused vs defer (bf16 stash) vs q8 (int8 stash)"
 for MODE in 0 defer q8; do
     BENCH_FUSED_BN=$MODE BENCH_WALL_BUDGET=1400 timeout 1500 python bench.py \
@@ -36,6 +31,34 @@ for MODE in 0 defer q8; do
         2>"/tmp/qd_q8ab_${MODE}.log"
     echo "--- mode=$MODE:"; cat "$RUNS/${STAMP}_resnet50_q8ab_${MODE}.json"
 done
+
+echo "== [1] q8 16-block chain probe (wall, cost bytes, temp MB)"
+timeout 900 python benchmarks/q8_probe.py \
+    > "$RUNS/${STAMP}_q8_chain_probe.txt" 2>/tmp/qd_probe.log \
+    && cat "$RUNS/${STAMP}_q8_chain_probe.txt"
+
+echo "== [3b] GPT-medium-class LM point (d_model 1024 x 16L, flash, seq 2048)"
+timeout 1500 python benchmarks/transformer_bench.py --seq 2048 --batch 8 \
+    --d-model 1024 --layers 16 --flash on \
+    > "$RUNS/${STAMP}_transformer_1024x16.jsonl" 2>/tmp/qd_big.log \
+    && cat "$RUNS/${STAMP}_transformer_1024x16.jsonl"
+
+echo "== [3c] long-context capacity: seq 8192 q8 layer-remat at batch 8"
+echo "        (baseline: no-remat fits only batch 2 — table row exists)"
+timeout 1500 python benchmarks/transformer_bench.py --seq 8192 --batch 8 \
+    --flash on --remat q8 \
+    > "$RUNS/${STAMP}_transformer_8k_remat.jsonl" 2>/tmp/qd_remat.log \
+    && cat "$RUNS/${STAMP}_transformer_8k_remat.jsonl"
+timeout 900 python benchmarks/transformer_bench.py --seq 8192 --batch 8 \
+    --flash on \
+    >> "$RUNS/${STAMP}_transformer_8k_remat.jsonl" 2>>/tmp/qd_remat.log \
+    && tail -1 "$RUNS/${STAMP}_transformer_8k_remat.jsonl"
+
+echo "== [3d] decode with int8 weights (weight-read-bound serving lever)"
+timeout 1200 python benchmarks/transformer_bench.py --decode --batch 8 \
+    --weights-int8 \
+    > "$RUNS/${STAMP}_decode_w8.jsonl" 2>/tmp/qd_w8.log \
+    && cat "$RUNS/${STAMP}_decode_w8.jsonl"
 
 echo "== [2b] scaling evidence: AOT-compile 8-chip DP step, schedule analysis"
 timeout 1800 python benchmarks/scaling_aot.py \
@@ -69,29 +92,6 @@ for bq, bk in ((512, 512), (256, 512), (256, 256), (128, 512)):
         print(f"bq={bq} bk={bk}: {type(e).__name__} {str(e)[:200]}")
 EOF
 cat "$RUNS/${STAMP}_flash16k_isolation.txt"
-
-echo "== [3b] GPT-medium-class LM point (d_model 1024 x 16L, flash, seq 2048)"
-timeout 1500 python benchmarks/transformer_bench.py --seq 2048 --batch 8 \
-    --d-model 1024 --layers 16 --flash on \
-    > "$RUNS/${STAMP}_transformer_1024x16.jsonl" 2>/tmp/qd_big.log \
-    && cat "$RUNS/${STAMP}_transformer_1024x16.jsonl"
-
-echo "== [3c] long-context capacity: seq 8192 q8 layer-remat at batch 8"
-echo "        (baseline: no-remat fits only batch 2 — table row exists)"
-timeout 1500 python benchmarks/transformer_bench.py --seq 8192 --batch 8 \
-    --flash on --remat q8 \
-    > "$RUNS/${STAMP}_transformer_8k_remat.jsonl" 2>/tmp/qd_remat.log \
-    && cat "$RUNS/${STAMP}_transformer_8k_remat.jsonl"
-timeout 900 python benchmarks/transformer_bench.py --seq 8192 --batch 8 \
-    --flash on \
-    >> "$RUNS/${STAMP}_transformer_8k_remat.jsonl" 2>>/tmp/qd_remat.log \
-    && tail -1 "$RUNS/${STAMP}_transformer_8k_remat.jsonl"
-
-echo "== [3d] decode with int8 weights (weight-read-bound serving lever)"
-timeout 1200 python benchmarks/transformer_bench.py --decode --batch 8 \
-    --weights-int8 \
-    > "$RUNS/${STAMP}_decode_w8.jsonl" 2>/tmp/qd_w8.log \
-    && cat "$RUNS/${STAMP}_decode_w8.jsonl"
 
 echo "== [4] reader-fed feed-path bench (host python vs native C++ assembly)"
 for SRC in host native; do
