@@ -330,6 +330,43 @@ def make_add_q8(relu_a: bool, relu_b: bool, stash: str = "int8"):
 
 
 # ---------------------------------------------------------------------------
+# quantized collectives: int8 payloads over ICI
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def make_ppermute_q8(axis_name: str, perm: tuple):
+    """``lax.ppermute`` with a symmetric per-shard-scalar int8 wire codec
+    in BOTH directions: the forward payload and the backward cotangent
+    each travel as (int8 tensor, fp32 scale) — half the ICI bytes of a
+    bf16 send. Straight-through backward (the round contributes no
+    gradient), so the transpose is the reversed permutation with the
+    same codec. Use for inter-stage pipeline sends and ring-CP K/V
+    rotations (the KV-cache-int8 trick applied to the wire)."""
+
+    inv = tuple((d, s) for s, d in perm)
+
+    def _codec(p):
+        def send(x):
+            xf = x.astype(jnp.float32)
+            amax = jnp.max(jnp.abs(xf))
+            s = jnp.maximum(amax, 1e-8) / 127.0
+            q = _quantize(xf / s)
+            qp = lax.ppermute(q, axis_name, p)
+            sp = lax.ppermute(s, axis_name, p)
+            return (qp.astype(jnp.float32) * sp).astype(x.dtype)
+        return send
+
+    _send, _send_back = _codec(perm), _codec(inv)
+
+    @jax.custom_vjp
+    def pq(x):
+        return _send(x)
+
+    pq.defvjp(lambda x: (_send(x), None), lambda _, g: (_send_back(g),))
+    return pq
+
+
+# ---------------------------------------------------------------------------
 # weight quantization (serving): per-output-channel symmetric int8
 # ---------------------------------------------------------------------------
 

@@ -52,7 +52,8 @@ def _block_attn(q, k, v, mask, scale):
 
 def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
                    lengths: Optional[jax.Array] = None,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   wire_int8: bool = False):
     """Exact attention with K/V rotating around the ``axis_name`` ring.
 
     Call inside shard_map. q: local shard [B, T_local, H, D]; k/v
@@ -91,11 +92,24 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
         l = l * c_old + bl * c_new
         o = (o * c_old[..., None].swapaxes(1, 2) +
              bo * c_new[..., None].swapaxes(1, 2))
-        # rotate K/V to the next device; skip the final dead rotation
+        # rotate K/V to the next device; skip the final dead rotation.
+        # wire_int8: the rotation carries int8 + a per-shard scale
+        # (ops/q8.make_ppermute_q8 — the KV-cache-int8 trick on the
+        # wire; halves ICI bytes per hop, straight-through gradients).
+        # Each hop re-quantizes, compounding <=0.5 LSB rounding per hop
+        # (~sqrt(P) LSB total — bounded by the tolerance test at 8
+        # shards); rotating raw int8 in the carry instead would sever
+        # the gradient path through the integer loop carry, so the
+        # re-quantizing codec is the differentiable design point.
+        if wire_int8:
+            from paddle_tpu.ops import q8 as ops_q8
+            send = ops_q8.make_ppermute_q8(axis_name, tuple(perm))
+        else:
+            def send(t):
+                return jax.lax.ppermute(t, axis_name, perm)
         k_nxt, v_nxt = jax.lax.cond(
             step < nshards - 1,
-            lambda kv: (jax.lax.ppermute(kv[0], axis_name, perm),
-                        jax.lax.ppermute(kv[1], axis_name, perm)),
+            lambda kv: (send(kv[0]), send(kv[1])),
             lambda kv: kv, (k_cur, v_cur))
         return o, new_m, l, k_nxt, v_nxt
 
@@ -141,7 +155,8 @@ def ring_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
                         head_axis: str = place.AXIS_MODEL,
                         scale: Optional[float] = None,
                         use_flash: bool = False,
-                        interpret: Optional[bool] = None):
+                        interpret: Optional[bool] = None,
+                        wire_int8: bool = False):
     """shard_map wrapper: q/k/v [B, T, H, D] with B over ``batch_axis``,
     T over ``seq_axis``, and heads over ``head_axis`` when the mesh has one
     (tensor parallelism: each model-shard attends its own heads — attention
@@ -150,7 +165,9 @@ def ring_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
     collectives then rotate the Hkv-head tensors; head-axis TP applies
     only when it divides BOTH head counts. ``use_flash`` swaps the
     per-block engine for the Pallas flash kernel (packed equal-length
-    sequences only)."""
+    sequences only). ``wire_int8`` sends the rotating K/V as int8 + a
+    per-shard scale (jnp engine only — the flash ring's hand-written VJP
+    stays full precision)."""
     from jax import shard_map
 
     H, Hkv = q.shape[2], k.shape[2]
@@ -163,8 +180,18 @@ def ring_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
     if use_flash and lengths is not None:
         raise ValueError(_FLASH_RAGGED_MSG)
     interpret = _default_interpret(interpret)
+    if wire_int8 and use_flash:
+        raise ValueError("wire_int8 applies to the jnp ring engine only "
+                         "(the flash ring's custom VJP is full precision)")
+    if wire_int8 and lengths is not None:
+        # the per-shard scale is an absmax over the WHOLE rotating shard;
+        # padding K/V beyond lengths would inflate it and collapse the
+        # valid rows' precision — reject rather than silently degrade
+        raise ValueError("wire_int8 supports packed equal-length "
+                         "sequences only (padding would contaminate the "
+                         "wire quantization scale); pass lengths=None")
     fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
-                           scale=scale)
+                           scale=scale, wire_int8=wire_int8)
 
     if lengths is None:
         if use_flash:

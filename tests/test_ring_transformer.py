@@ -783,3 +783,75 @@ class TestLayerRemat:
         l1, p2 = step(sharded, toks)
         l2, _ = step(p2, toks)
         assert float(l2) < float(l1)
+
+
+class TestWireInt8:
+    """int8 wire codecs for the distributed sends (ops/q8
+    make_ppermute_q8): ring-CP K/V rotations and pipeline inter-stage
+    activations travel as int8 + per-shard scales, both directions."""
+
+    def test_ring_attention_wire_int8_close(self, rng):
+        mesh = place.make_mesh((1, 8), (place.AXIS_DATA, place.AXIS_SEQ))
+        B, T, H, D = 2, 32, 2, 8
+        q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)) * 0.5
+        k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)) * 0.5
+        v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+        ref = ring.ring_attention_spmd(q, k, v, mesh, causal=True)
+        got = ring.ring_attention_spmd(q, k, v, mesh, causal=True,
+                                       wire_int8=True)
+        rel = float(jnp.abs(got - ref).max() / (jnp.abs(ref).max() + 1e-9))
+        assert rel < 0.05, f"wire-int8 ring rel err {rel}"
+
+    def test_ring_wire_int8_grads_flow(self, rng):
+        mesh = place.make_mesh((1, 8), (place.AXIS_DATA, place.AXIS_SEQ))
+        B, T, H, D = 1, 16, 2, 4
+        q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+
+        def loss(q_, k_, v_):
+            return jnp.sum(ring.ring_attention_spmd(
+                q_, k_, v_, mesh, causal=True, wire_int8=True) ** 2)
+
+        gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, q, q)
+        for g in (gq, gk, gv):
+            assert jnp.isfinite(g).all()
+            assert float(jnp.abs(g).max()) > 0
+
+    def test_ring_wire_int8_rejects_flash(self, rng):
+        mesh = place.make_mesh((1, 8), (place.AXIS_DATA, place.AXIS_SEQ))
+        q = jnp.zeros((1, 16, 2, 4), jnp.float32)
+        with pytest.raises(ValueError, match="wire_int8"):
+            ring.ring_attention_spmd(q, q, q, mesh, use_flash=True,
+                                     wire_int8=True)
+
+    def test_pipeline_wire_int8_trains(self, rng):
+        from paddle_tpu.parallel import pipeline
+        mesh = place.make_mesh((4,), (place.AXIS_STAGE,))
+        S, D, B, M = 4, 8, 16, 4
+        params = {"w": jnp.asarray(rng.randn(S, D, D).astype(np.float32)
+                                   * 0.3),
+                  "b": jnp.zeros((S, D), jnp.float32)}
+        x = jnp.asarray(rng.randn(B, D).astype(np.float32))
+        y = jnp.asarray(rng.randn(B, D).astype(np.float32) * 0.1)
+
+        def stage_fn(p, h):
+            return jnp.tanh(h @ p["w"] + p["b"])
+
+        ref = pipeline.pipeline_apply(params, x, stage_fn, mesh, M)
+        got = pipeline.pipeline_apply(params, x, stage_fn, mesh, M,
+                                      wire_int8=True)
+        rel = float(jnp.abs(got - ref).max() / (jnp.abs(ref).max() + 1e-9))
+        assert rel < 0.05, f"wire-int8 pipeline rel err {rel}"
+
+        @jax.jit
+        def train_step(p):
+            def loss(p_):
+                out = pipeline.pipeline_apply(p_, x, stage_fn, mesh, M,
+                                              wire_int8=True)
+                return jnp.mean((out - y) ** 2)
+            l, g = jax.value_and_grad(loss)(p)
+            return l, jax.tree_util.tree_map(lambda w, gr: w - 0.2 * gr,
+                                             p, g)
+
+        l1, p2 = train_step(params)
+        l2, _ = train_step(p2)
+        assert float(l2) < float(l1)
