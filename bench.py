@@ -89,7 +89,7 @@ except Exception:  # noqa: BLE001 — an import crash here would erase the
     # one-JSON-line contract before any guard exists; fall back to the
     # same parse inline
     _FB = os.environ.get("BENCH_FUSED_BN", "0")
-    FUSED_BN = _FB if _FB in ("int8", "full", "q8", "defer") else _FB == "1"
+    FUSED_BN = _FB if _FB in ("int8", "full", "q8", "defer", "q8sr") else _FB == "1"
 
 
 def log(*a):
@@ -304,8 +304,11 @@ def build_train_step():
 
     def train_step(p, o, s, images, labels, step):
         def loss_fn(p):
+            # per-step key: only consumed by stochastic recipes (q8sr)
+            dkey = jax.random.fold_in(jax.random.PRNGKey(7), step)
             outs, ns = fwd(p, s, {"image": Value(images),
-                                  "label": Value(labels)}, is_training=True)
+                                  "label": Value(labels)},
+                           is_training=True, dropout_key=dkey)
             return jnp.mean(outs["cost"].array.astype(jnp.float32)), ns
 
         (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
@@ -489,7 +492,7 @@ def orchestrate():
     # measured) — the gate reports the framework's best configuration
     # even when the on-chip A/B queue never got tunnel time
     if os.environ.get("BENCH_FUSED_BN") is None:
-        extra = os.environ.get("BENCH_TRY_MODES", "defer,q8")
+        extra = os.environ.get("BENCH_TRY_MODES", "defer,q8sr")
     else:
         extra = os.environ.get("BENCH_TRY_MODES", "")
     pending = [FUSED_BN if isinstance(FUSED_BN, str)

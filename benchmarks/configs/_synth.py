@@ -42,4 +42,4 @@ def parse_fused_bn(default="0"):
     standalone configs and bench.py so the two can't drift."""
     import os
     v = os.environ.get("BENCH_FUSED_BN", default)
-    return v if v in ("int8", "full", "q8", "defer") else v == "1"
+    return v if v in ("int8", "full", "q8", "defer", "q8sr") else v == "1"

@@ -1,12 +1,14 @@
 #!/usr/bin/env python
-"""Training-quality parity check for the fused conv+BN modes.
+"""Training-quality check for every fused conv+BN recipe.
 
-Throughput levers must not cost convergence: this trains the SAME small
-ResNet (identical init, identical data order) under fused_bn modes
-False / True / "int8" / "full" and reports per-mode final train loss and
-held-out accuracy. The int8 stash perturbs only backward reads (~0.4%
-stash noise bounded in normalized units), so curves should track within
-noise. Run on CPU (kernels in force-interpret mode) or TPU.
+Trains the SAME small ResNet (identical init, identical data order)
+under fused_bn modes False / True / "int8" / "full" / "q8" / "defer" /
+"q8sr" and reports per-mode final train loss and held-out accuracy.
+Parity is ASSERTED for every mode except deterministic "q8", whose
+straight-through stash noise produces a real held-out gap at horizon
+(reported, not asserted — BENCHMARKS.md "Convergence at horizon");
+"q8sr" (unbiased stochastic rounding) restores parity and IS asserted.
+Run on CPU (kernels in force-interpret mode) or TPU.
 
 Run: python benchmarks/fused_bn_quality.py [--steps 60]
 """
@@ -57,7 +59,7 @@ def main():
     xt, yt = make(n_test, 2)
 
     results = {}
-    for mode in (False, True, "int8", "full", "q8", "defer"):
+    for mode in (False, True, "int8", "full", "q8", "defer", "q8sr"):
         x = layer.data("img", paddle.data_type.dense_vector(3 * 16 * 16))
         lbl = layer.data("lbl", paddle.data_type.integer_value(4))
         # the q8 pipeline needs a dense stem before its entry stash (the
@@ -67,8 +69,9 @@ def main():
                                   name="q_c1",
                                   fused=False if resnet._stash_for(mode) else mode)
         if resnet._stash_for(mode):
-            c1 = layer.q8_entry(c1, name="q_entry",
-                                stash=resnet._stash_for(mode))
+            _st, _sr = resnet._stash_for(mode)
+            c1 = layer.q8_entry(c1, name="q_entry", stash=_st,
+                                stochastic=_sr)
         b1 = resnet.basic_block(c1, 16, 16, 1, name="q_b1", fused=mode)
         if resnet._stash_for(mode):
             b1 = layer.q8_exit(b1, name="q_exit")
@@ -84,10 +87,10 @@ def main():
         o = opt.init_state(params.values)
 
         @jax.jit
-        def step(p, o, s, bx, by):
+        def step(p, o, s, bx, by, key):
             def loss_fn(p):
                 outs, ns = fwd(p, s, {"img": Value(bx), "lbl": Value(by)},
-                               is_training=True)
+                               is_training=True, dropout_key=key)
                 return (jnp.mean(outs["q_cost"].array.astype(
                     jnp.float32)), ns)
             (l, ns), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
@@ -101,7 +104,8 @@ def main():
             j = (i * bs) % n_train
             bx = jnp.asarray(xs[j:j + bs])
             by = jnp.asarray(ys[j:j + bs])
-            l, p, o, s = step(p, o, s, bx, by)
+            l, p, o, s = step(p, o, s, bx, by,
+                              jax.random.PRNGKey(1000 + i))
             losses.append(float(l))
         probs, _ = fwd(p, s, {"img": Value(jnp.asarray(xt)),
                               "lbl": Value(jnp.asarray(yt))},
@@ -115,7 +119,7 @@ def main():
 
     base = results["False"]
     for mode, (l0, l1, acc) in results.items():
-        if mode in ("False", "q8"):
+        if mode in ("False", "q8"):  # q8sr IS parity-asserted
             continue
         assert abs(acc - base[2]) < 0.1, (
             f"mode {mode} accuracy {acc} diverged from unfused {base[2]}")
@@ -126,7 +130,8 @@ def main():
     # no-quality-risk throughput arm)
     gap = base[2] - results["q8"][2]
     print(f"q8 accuracy gap vs unfused at {args.steps} steps: {gap:+.3f} "
-          f"(defer gap: {base[2] - results['defer'][2]:+.3f})")
+          f"(q8sr: {base[2] - results['q8sr'][2]:+.3f}, "
+          f"defer: {base[2] - results['defer'][2]:+.3f})")
     print("PARITY OK: non-q8 modes converge with the unfused path")
 
 

@@ -24,8 +24,8 @@ print('probe ok', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" \
 
 probe
 
-echo "== [2] resnet50 A/B: unfused vs defer (bf16 stash) vs q8 (int8 stash)"
-for MODE in 0 defer q8; do
+echo "== [2] resnet50 A/B: unfused / defer (bf16) / q8sr (int8+SR) / q8"
+for MODE in 0 defer q8sr q8; do
     BENCH_FUSED_BN=$MODE BENCH_WALL_BUDGET=1400 timeout 1500 python bench.py \
         > "$RUNS/${STAMP}_resnet50_q8ab_${MODE}.json" \
         2>"/tmp/qd_q8ab_${MODE}.log"

@@ -531,6 +531,22 @@ def _q8_parent_fold(parent_info, params, aux, q8_mod):
     return M, B, relu_in
 
 
+def _q8_key(ctx, name: str, stochastic: bool):
+    """Trailing key tuple for the stochastic-rounding block variants.
+    Typed PRNG keys are unwrapped to raw uint32 so the custom_vjp sees a
+    plain integer array (float0 cotangent)."""
+    if not stochastic:
+        return ()
+    key = ctx.layer_key(name)
+    enforce.enforce(
+        key is not None,
+        f"q8 layer {name!r}: stochastic rounding needs the per-step "
+        f"dropout_key threaded into forward (trainer.SGD provides it)")
+    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        key = jax.random.key_data(key)
+    return (key,)
+
+
 def _q8_info(lo: LayerOutput):
     info = getattr(lo, "_q8", None)
     enforce.enforce(info is not None,
@@ -541,7 +557,7 @@ def _q8_info(lo: LayerOutput):
 
 
 def q8_entry(input, name: Optional[str] = None, num_channels=None,
-             stash: str = "int8"):
+             stash: str = "int8", stochastic: bool = False):
     """Quantize a dense activation into the q8 pipeline (ops/q8.py): from
     here until q8_exit, activations exist in HBM only as centered int8
     under delayed scaling (stash="bf16" keeps the same deferral/remat
@@ -561,8 +577,9 @@ def q8_entry(input, name: Optional[str] = None, num_channels=None,
             ctx.state_out[mean_s.name] = ctx.state_in[mean_s.name]
             ctx.state_out[scale_s.name] = ctx.state_in[scale_s.name]
             return v
-        yhat, q, mu, amax = ops_q8.make_entry(stash)(
-            v.array, ctx.state_in[mean_s.name], ctx.state_in[scale_s.name])
+        yhat, q, mu, amax = ops_q8.make_entry(stash, stochastic)(
+            v.array, ctx.state_in[mean_s.name], ctx.state_in[scale_s.name],
+            *_q8_key(ctx, name, stochastic))
         ctx.state_out[mean_s.name] = mu
         ctx.state_out[scale_s.name] = ops_q8.scale_from_amax(amax)
         return Value(yhat, aux={"q": q, "mu": mu})
@@ -581,7 +598,8 @@ def img_conv_bn_q8(input, filter_size, num_filters: int,
                    param_attr=None, bn_param_attr=None, bn_bias_attr=None,
                    moving_average_fraction=0.9, epsilon=1e-5,
                    conv_name: Optional[str] = None,
-                   bn_name: Optional[str] = None, stash: str = "int8"):
+                   bn_name: Optional[str] = None, stash: str = "int8",
+                   stochastic: bool = False):
     """Conv→BN block on the q8 pipeline (ops/q8.py): reads the producer's
     int8 stash (dequant + producer-BN affine + producer activation fused
     into this conv's input fusion), writes its own int8 stash (center +
@@ -645,12 +663,14 @@ def img_conv_bn_q8(input, filter_size, num_filters: int,
                 ctx.state_out[spec.name] = ctx.state_in[spec.name]
             return _apply_act(Value(y), act_name)
         M, B, relu_in = _q8_parent_fold(parent_info, params, v.aux, ops_q8)
-        blk = ops_q8.make_conv_q8(stride, padding, relu_in, stash)
+        blk = ops_q8.make_conv_q8(stride, padding, relu_in, stash,
+                                  stochastic)
         yhat, q, mu, var, amax = blk(
             v.array, v.aux["q"], params[wspec.name], M, B,
             ctx.state_in[f"{parent_name}.q_mean"],
             ctx.state_in[f"{parent_name}.q_scale"],
-            ctx.state_in[qmean_s.name], ctx.state_in[qscale_s.name])
+            ctx.state_in[qmean_s.name], ctx.state_in[qscale_s.name],
+            *_q8_key(ctx, name, stochastic))
         ctx.state_out[qmean_s.name] = mu
         ctx.state_out[qscale_s.name] = ops_q8.scale_from_amax(amax)
         ctx.state_out[rmean_s.name] = (
@@ -671,7 +691,8 @@ def img_conv_bn_q8(input, filter_size, num_filters: int,
 
 
 def addto_q8(input: Sequence[LayerOutput], act=None,
-             name: Optional[str] = None, stash: str = "int8"):
+             name: Optional[str] = None, stash: str = "int8",
+             stochastic: bool = False):
     """Residual add on the q8 pipeline: applies both producers' deferred
     BN affines/activations, adds, and stashes the sum centered PRE-act;
     this layer's own activation is deferred to its consumers."""
@@ -695,7 +716,7 @@ def addto_q8(input: Sequence[LayerOutput], act=None,
             return _apply_act(Value(va.array + vb.array), act_name)
         Ma, Ba, relu_a = _q8_parent_fold(p_infos[0], params, va.aux, ops_q8)
         Mb, Bb, relu_b = _q8_parent_fold(p_infos[1], params, vb.aux, ops_q8)
-        blk = ops_q8.make_add_q8(relu_a, relu_b, stash)
+        blk = ops_q8.make_add_q8(relu_a, relu_b, stash, stochastic)
         yhat, q, mu, amax = blk(
             va.array, va.aux["q"], Ma, Ba,
             ctx.state_in[f"{p_names[0]}.q_mean"],
@@ -703,7 +724,8 @@ def addto_q8(input: Sequence[LayerOutput], act=None,
             vb.array, vb.aux["q"], Mb, Bb,
             ctx.state_in[f"{p_names[1]}.q_mean"],
             ctx.state_in[f"{p_names[1]}.q_scale"],
-            ctx.state_in[qmean_s.name], ctx.state_in[qscale_s.name])
+            ctx.state_in[qmean_s.name], ctx.state_in[qscale_s.name],
+            *_q8_key(ctx, name, stochastic))
         ctx.state_out[qmean_s.name] = mu
         ctx.state_out[qscale_s.name] = ops_q8.scale_from_amax(amax)
         return Value(yhat, aux={"q": q, "mu": mu})

@@ -10,8 +10,11 @@ from paddle_tpu import activation, layer, pooling
 
 
 def _stash_for(fused):
-    """Stash dtype for the deferral recipes; None = not a deferral mode."""
-    return {"q8": "int8", "defer": "bf16"}.get(fused)
+    """(stash dtype, stochastic rounding) for the deferral recipes;
+    None = not a deferral mode. "q8sr" is q8 with unbiased stochastic
+    rounding (closes the eval co-adaptation gap, BENCHMARKS.md)."""
+    return {"q8": ("int8", False), "defer": ("bf16", False),
+            "q8sr": ("int8", True)}.get(fused)
 
 
 def conv_bn_layer(input, ch_out, filter_size, stride, padding, active_type,
@@ -25,13 +28,14 @@ def conv_bn_layer(input, ch_out, filter_size, stride, padding, active_type,
     is the same deferral machinery with a near-lossless bf16 stash (the
     affine-prologue block-remat recipe)."""
     if _stash_for(fused):
+        stash, sr = _stash_for(fused)
         return layer.img_conv_bn_q8(
             input, filter_size=filter_size, num_filters=ch_out,
             num_channels=ch_in, stride=stride, padding=padding,
             act=active_type, name=f"{name}_q8" if name else None,
             conv_name=f"{name}_conv" if name else None,
             bn_name=f"{name}_bn" if name else None,
-            stash=_stash_for(fused))
+            stash=stash, stochastic=sr)
     if fused:
         # explicit integer padding (NOT "SAME": XLA pads SAME
         # asymmetrically at stride 2, which would silently change
@@ -66,8 +70,9 @@ def shortcut(input, ch_in, ch_out, stride, name=None, fused=False):
 
 def _addto(inputs, act, name, fused):
     if _stash_for(fused):
+        stash, sr = _stash_for(fused)
         return layer.addto_q8(inputs, act=act, name=name,
-                              stash=_stash_for(fused))
+                              stash=stash, stochastic=sr)
     return layer.addto(inputs, act=act, name=name)
 
 
@@ -136,8 +141,9 @@ def resnet_imagenet(input, depth=50, class_num=1000, img_size=224,
     ch_in = 64
     tmp = pool1
     if _stash_for(fused_bn):
+        stash, sr = _stash_for(fused_bn)
         tmp = layer.q8_entry(tmp, name="res_q8_entry",
-                             stash=_stash_for(fused_bn))
+                             stash=stash, stochastic=sr)
     for stage, (n, ch_out) in enumerate(zip(counts, [64, 128, 256, 512])):
         for i in range(n):
             stride = 2 if (i == 0 and stage > 0) else 1

@@ -71,18 +71,31 @@ def scale_from_amax(amax: jax.Array) -> jax.Array:
 _STASHES = ("int8", "bf16")
 
 
-def _check_stash(stash: str) -> None:
+def _check_stash(stash: str, stochastic: bool = False) -> None:
     if stash not in _STASHES:
         raise ValueError(f"unknown stash dtype {stash!r}; one of {_STASHES}")
+    if stochastic and stash != "int8":
+        raise ValueError(
+            "stochastic rounding applies to the int8 stash only (a bf16 "
+            "stash casts, it does not round to a grid)")
 
 
-def _quantize(z: jax.Array, stash: str = "int8") -> jax.Array:
+def _quantize(z: jax.Array, stash: str = "int8",
+              key: "jax.Array" = None) -> jax.Array:
     if stash == "bf16":
         # the "defer" recipe: same deferred-BN/activation machinery and
         # residual discipline, but a bf16 stash — bf16-rounding noise only (~0.4% rel),
         # 2 bytes/elt instead of 1 (BENCHMARKS.md "affine-prologue block
         # remat", modelled 48.5 GB/step)
         return z.astype(jnp.bfloat16)
+    if key is not None:
+        # stochastic rounding: floor(z + U[0,1)) is an UNBIASED rounding
+        # — E[q] == z — which removes the systematic component of the
+        # stash noise the parameters would otherwise co-adapt to (the
+        # 200-step q8 eval gap, BENCHMARKS.md). The uniform draw is
+        # generated inside the fusion (no HBM tensor).
+        u = jax.random.uniform(key, z.shape, jnp.float32)
+        return jnp.clip(jnp.floor(z + u), -127.0, 127.0).astype(jnp.int8)
     return jnp.clip(jnp.round(z), -127.0, 127.0).astype(jnp.int8)
 
 
@@ -108,11 +121,11 @@ def _stash_zero(q):
     return jnp.zeros_like(q)
 
 
-def _stash(yf, mu_po, s_po, stash: str = "int8"):
+def _stash(yf, mu_po, s_po, stash: str = "int8", key=None):
     """Center+quantize with the delayed constants; emit stash, carrier,
     and the absmax that becomes next step's scale."""
     amax = jnp.max(jnp.abs(yf - mu_po), axis=(0, 1, 2))
-    q = _quantize((yf - mu_po) / s_po, stash)
+    q = _quantize((yf - mu_po) / s_po, stash, key)
     yhat = _dequant(q, mu_po, s_po).astype(dtypes.compute_dtype())
     return yhat, q, amax
 
@@ -122,29 +135,29 @@ def _stash(yf, mu_po, s_po, stash: str = "int8"):
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def make_entry(stash: str = "int8"):
-    _check_stash(stash)
+def make_entry(stash: str = "int8", stochastic: bool = False):
+    """Entry stash; with ``stochastic`` the signature gains a trailing
+    PRNG key (raw uint32) and rounding is unbiased."""
+    _check_stash(stash, stochastic)
 
     @jax.custom_vjp
-    def entry_stash(x, mu_p, s_p):
-        """Quantize a dense activation into the pipeline. mu_p/s_p are
-        the delayed (previous-step) per-channel center/scale — state,
-        stop-grad. Returns (yhat, q, mu, amax); mu feeds next step's
-        centering state."""
+    def entry_stash(x, mu_p, s_p, *key):
         xf = x.astype(jnp.float32)
-        yhat, q, amax = _stash(xf, mu_p, s_p, stash)
+        yhat, q, amax = _stash(xf, mu_p, s_p, stash,
+                               key[0] if stochastic else None)
         mu = jnp.mean(xf, axis=(0, 1, 2))
         return yhat, q, mu, amax
 
-    def fwd(x, mu_p, s_p):
-        return entry_stash(x, mu_p, s_p), (mu_p, s_p)
+    def fwd(x, mu_p, s_p, *key):
+        return entry_stash(x, mu_p, s_p, *key), (mu_p, s_p, key)
 
     def bwd(res, cots):
-        mu_p, s_p = res
+        mu_p, s_p, key = res
         g_yhat = cots[0]
         # straight-through: ŷ ≈ x, the carrier's cotangent IS the input's
         return (g_yhat.astype(dtypes.compute_dtype()),
-                jnp.zeros_like(mu_p), jnp.zeros_like(s_p))
+                jnp.zeros_like(mu_p), jnp.zeros_like(s_p),
+                *[_int_zero(k) for k in key])
 
     entry_stash.defvjp(fwd, bwd)
     return entry_stash
@@ -194,7 +207,7 @@ def make_exit(relu: bool):
 
 @functools.lru_cache(maxsize=None)
 def make_conv_q8(stride: int, padding, relu_in: bool,
-                 stash: str = "int8"):
+                 stash: str = "int8", stochastic: bool = False):
     """Build the custom-vjp conv block for a static (stride, padding,
     input-activation) configuration.
 
@@ -213,7 +226,7 @@ def make_conv_q8(stride: int, padding, relu_in: bool,
              folds them into ITS (M, B); their cotangents carry the exact
              BN batch-stat backward terms here.
     """
-    _check_stash(stash)
+    _check_stash(stash, stochastic)
 
     def prologue(q_in, M, B, mu_pi, s_pi):
         x = _dequant(q_in, mu_pi, s_pi) * M + B
@@ -225,22 +238,25 @@ def make_conv_q8(stride: int, padding, relu_in: bool,
         return ops_conv.conv2d(xt, w, stride=stride, padding=padding)
 
     @jax.custom_vjp
-    def block(yhat_in, q_in, w, M, B, mu_pi, s_pi, mu_po, s_po):
+    def block(yhat_in, q_in, w, M, B, mu_pi, s_pi, mu_po, s_po, *key):
         xt = prologue(q_in, M, B, mu_pi, s_pi)
         y = conv(xt, w)
         yf = y.astype(jnp.float32)
         mu = jnp.mean(yf, axis=(0, 1, 2))
         var = jnp.mean(jnp.square(yf - mu), axis=(0, 1, 2))
-        yhat_out, q_out, amax = _stash(yf, mu_po, s_po, stash)
+        yhat_out, q_out, amax = _stash(yf, mu_po, s_po, stash,
+                                       key[0] if stochastic else None)
         return yhat_out, q_out, mu, var, amax
 
-    def fwd(yhat_in, q_in, w, M, B, mu_pi, s_pi, mu_po, s_po):
-        out = block(yhat_in, q_in, w, M, B, mu_pi, s_pi, mu_po, s_po)
+    def fwd(yhat_in, q_in, w, M, B, mu_pi, s_pi, mu_po, s_po, *key):
+        out = block(yhat_in, q_in, w, M, B, mu_pi, s_pi, mu_po, s_po,
+                    *key)
         q_out, mu = out[1], out[2]
-        return out, (q_in, q_out, mu, w, M, B, mu_pi, s_pi, mu_po, s_po)
+        return out, (q_in, q_out, mu, w, M, B, mu_pi, s_pi, mu_po, s_po,
+                     key)
 
     def bwd(res, cots):
-        q_in, q_out, mu, w, M, B, mu_pi, s_pi, mu_po, s_po = res
+        (q_in, q_out, mu, w, M, B, mu_pi, s_pi, mu_po, s_po, key) = res
         g_yhat, _gq, g_mu, g_var, _ga = cots
         # y reconstructed from its own stash (STE through the round)
         yf = _dequant(q_out, mu_po, s_po)
@@ -261,7 +277,8 @@ def make_conv_q8(stride: int, padding, relu_in: bool,
         dB = _red(dpre, B)
         return (d_yhat_in, _stash_zero(q_in), dw, dM, dB,
                 jnp.zeros_like(mu_pi), jnp.zeros_like(s_pi),
-                jnp.zeros_like(mu_po), jnp.zeros_like(s_po))
+                jnp.zeros_like(mu_po), jnp.zeros_like(s_po),
+                *[_int_zero(k) for k in key])
 
     block.defvjp(fwd, bwd)
     return block
@@ -272,7 +289,8 @@ def make_conv_q8(stride: int, padding, relu_in: bool,
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def make_add_q8(relu_a: bool, relu_b: bool, stash: str = "int8"):
+def make_add_q8(relu_a: bool, relu_b: bool, stash: str = "int8",
+                stochastic: bool = False):
     """Residual-add block. Branch values come in as stashes with their
     deferred ŷ-basis affines (Ma,Ba / Mb,Bb) and optional deferred ReLUs;
     the sum is stashed CENTERED PRE-ReLU (consumers defer the output
@@ -282,7 +300,7 @@ def make_add_q8(relu_a: bool, relu_b: bool, stash: str = "int8"):
        yb, qb, Mb, Bb, mu_pb, s_pb, mu_po, s_po)
         -> (yhat_out, q_out, mu, amax)
     """
-    _check_stash(stash)
+    _check_stash(stash, stochastic)
 
     def branch(q, M, B, mu_p, s_p, relu):
         v = _dequant(q, mu_p, s_p) * M + B
@@ -292,21 +310,23 @@ def make_add_q8(relu_a: bool, relu_b: bool, stash: str = "int8"):
 
     @jax.custom_vjp
     def block(ya, qa, Ma, Ba, mu_pa, s_pa,
-              yb, qb, Mb, Bb, mu_pb, s_pb, mu_po, s_po):
+              yb, qb, Mb, Bb, mu_pb, s_pb, mu_po, s_po, *key):
         z = (branch(qa, Ma, Ba, mu_pa, s_pa, relu_a)
              + branch(qb, Mb, Bb, mu_pb, s_pb, relu_b))
         mu = jnp.mean(z, axis=(0, 1, 2))
-        yhat_out, q_out, amax = _stash(z, mu_po, s_po, stash)
+        yhat_out, q_out, amax = _stash(z, mu_po, s_po, stash,
+                                       key[0] if stochastic else None)
         return yhat_out, q_out, mu, amax
 
     def fwd(*args):
         out = block(*args)
         (qa, Ma, Ba, mu_pa, s_pa) = args[1:6]
         (qb, Mb, Bb, mu_pb, s_pb) = args[7:12]
-        return out, (qa, Ma, Ba, mu_pa, s_pa, qb, Mb, Bb, mu_pb, s_pb)
+        return out, (qa, Ma, Ba, mu_pa, s_pa, qb, Mb, Bb, mu_pb, s_pb,
+                     args[14:])
 
     def bwd(res, cots):
-        qa, Ma, Ba, mu_pa, s_pa, qb, Mb, Bb, mu_pb, s_pb = res
+        qa, Ma, Ba, mu_pa, s_pa, qb, Mb, Bb, mu_pb, s_pb, key = res
         g_yhat, _gq, g_mu, _ga = cots
         nhw = float(np.prod(g_yhat.shape[:3]))
         dz = g_yhat.astype(jnp.float32) + g_mu / nhw
@@ -323,7 +343,8 @@ def make_add_q8(relu_a: bool, relu_b: bool, stash: str = "int8"):
         dyb, dMb, dBb = back(qb, Mb, Bb, mu_pb, s_pb, relu_b)
         z0 = jnp.zeros_like(Ma)
         return (dya, _stash_zero(qa), dMa, dBa, z0, z0,
-                dyb, _stash_zero(qb), dMb, dBb, z0, z0, z0, z0)
+                dyb, _stash_zero(qb), dMb, dBb, z0, z0, z0, z0,
+                *[_int_zero(k) for k in key])
 
     block.defvjp(fwd, bwd)
     return block

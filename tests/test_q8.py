@@ -73,15 +73,18 @@ class TestGradientRouting:
 
     @pytest.fixture
     def exact_quantizer(self, monkeypatch):
-        monkeypatch.setattr(q8, "_quantize", lambda z, stash="int8": z)
+        monkeypatch.setattr(q8, "_quantize",
+                            lambda z, stash="int8", key=None: z)
         # the lru_cached block factories captured the real quantizer
         q8.make_conv_q8.cache_clear()
         q8.make_add_q8.cache_clear()
         q8.make_exit.cache_clear()
+        q8.make_entry.cache_clear()
         yield
         q8.make_conv_q8.cache_clear()
         q8.make_add_q8.cache_clear()
         q8.make_exit.cache_clear()
+        q8.make_entry.cache_clear()
 
     def test_forward_matches_dense(self, exact_quantizer):
         x, params, st = _setup()
@@ -524,3 +527,69 @@ class TestComposition:
         want = np.asarray(trainer.parameters.state["qc_b1_a_q8.q_scale"])
         np.testing.assert_array_equal(got, want)
         assert np.abs(got - 1.0).max() > 1e-3   # real trained state
+
+
+class TestStochasticRounding:
+    """q8sr: unbiased (stochastic) rounding on the stash — E[q] == z —
+    the remedy for the deterministic-rounding co-adaptation gap."""
+
+    def test_rounding_is_unbiased(self):
+        import jax
+        z = jnp.full((200, 200), 0.3, jnp.float32)
+        q = q8._quantize(z, "int8", jax.random.PRNGKey(0))
+        m = float(q.astype(jnp.float32).mean())
+        # E[floor(0.3 + U)] = 0.3; deterministic round() would give 0.0
+        assert abs(m - 0.3) < 0.02, m
+        qd = q8._quantize(z, "int8")
+        assert float(qd.astype(jnp.float32).mean()) == 0.0
+
+    def test_trains_through_sgd(self):
+        from paddle_tpu.models import resnet
+        img = layer.data("img", paddle.data_type.dense_vector(3 * 8 * 8))
+        lbl = layer.data("lbl", paddle.data_type.integer_value(4))
+        stem = resnet.conv_bn_layer(img, 8, 3, 1, 1, activation.Relu(),
+                                    ch_in=3, name="sr_stem")
+        ent = layer.q8_entry(stem, name="sr_entry", stochastic=True)
+        b1 = resnet.basic_block(ent, 8, 8, 1, name="sr_b1", fused="q8sr")
+        ex = layer.q8_exit(b1, name="sr_exit")
+        pool = layer.img_pool(ex, pool_size=8, stride=1,
+                              pool_type=paddle.pooling.Avg())
+        sm = layer.fc(pool, 4, act=paddle.activation.Softmax(),
+                      name="sr_sm")
+        cost = layer.classification_cost(sm, lbl, name="sr_cost")
+        params = paddle.parameters.create(cost, KeySource(3))
+        trainer = paddle.trainer.SGD(
+            cost=cost, parameters=params,
+            update_equation=paddle.optimizer.Momentum(momentum=0.9,
+                                                      learning_rate=0.1))
+        rng = np.random.RandomState(0)
+        protos = rng.randn(4, 8, 8, 3).astype(np.float32)
+        ys = rng.randint(0, 4, 32)
+        xs = (protos[ys] + rng.randn(32, 8, 8, 3) * 0.3).astype(np.float32)
+        data = [(xs[i], int(ys[i])) for i in range(32)]
+        costs = []
+        trainer.train(reader=paddle.batch(lambda: iter(data), 16),
+                      num_passes=6,
+                      event_handler=lambda e: costs.append(e.cost)
+                      if isinstance(e, paddle.event.EndIteration) else None)
+        assert all(np.isfinite(costs)) and costs[-1] < costs[0]
+
+    def test_bf16_stochastic_rejected(self):
+        import pytest as _pt
+        with _pt.raises(ValueError, match="int8 stash only"):
+            q8.make_conv_q8(1, 1, False, "bf16", True)
+
+    def test_missing_key_fails_loudly(self):
+        from paddle_tpu.models import resnet
+        img = layer.data("img2", paddle.data_type.dense_vector(3 * 8 * 8))
+        stem = resnet.conv_bn_layer(img, 8, 3, 1, 1, activation.Relu(),
+                                    ch_in=3, name="srk_stem")
+        ent = layer.q8_entry(stem, name="srk_entry", stochastic=True)
+        ex = layer.q8_exit(ent, name="srk_exit")
+        topo = Topology(ex)
+        params = paddle.parameters.create(ex, KeySource(1))
+        fwd = topo.compile()
+        x = jnp.zeros((2, 8, 8, 3), jnp.float32)
+        with pytest.raises(Exception, match="dropout_key"):
+            fwd(params.values, params.state, {"img2": Value(x)},
+                is_training=True)   # no dropout_key
