@@ -1,0 +1,105 @@
+"""Summarize a queue_r4d on-chip session from benchmarks/runs artifacts.
+
+Run after benchmarks/queue_r4d.sh (the tunnel watcher fires it on
+recovery): collects the A/B records, LM points, decode, feed and scaling
+artifacts for a given STAMP prefix (default: the latest *_resnet50_q8ab_*
+stamp found), prints the comparison table, and states the bench-default
+recommendation the A/B supports.
+
+Usage:  python benchmarks/analyze_queue.py [--stamp 2026-07-31_1234]
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+
+RUNS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "runs")
+
+
+def _load_json(path):
+    try:
+        with open(path) as f:
+            txt = f.read().strip()
+        if not txt:
+            return None
+        # .json = one record; .jsonl = last record per line set
+        if path.endswith(".jsonl"):
+            return [json.loads(ln) for ln in txt.splitlines() if ln.strip()]
+        return json.loads(txt)
+    except (OSError, ValueError) as e:
+        return {"error": f"unreadable: {e}"}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stamp", default=None)
+    args = ap.parse_args()
+
+    stamp = args.stamp
+    if stamp is None:
+        cands = sorted(glob.glob(os.path.join(RUNS, "*_resnet50_q8ab_*")))
+        if not cands:
+            print("no *_resnet50_q8ab_* artifacts found — has queue_r4d "
+                  "run? (tunnel watcher log: /tmp/tunnel_watch.log)")
+            return 1
+        stamp = re.match(r"(.*)_resnet50_q8ab_",
+                         os.path.basename(cands[-1])).group(1)
+    print(f"== queue session {stamp}\n")
+
+    print("-- [2] resnet50 recipe A/B (images/sec, mfu)")
+    best = (None, 0.0)
+    for mode in ("0", "defer", "q8sr", "q8"):
+        path = os.path.join(RUNS, f"{stamp}_resnet50_q8ab_{mode}.json")
+        if not os.path.exists(path):
+            print(f"  {mode:6s}: (missing)")
+            continue
+        rec = _load_json(path)
+        if not rec:
+            print(f"  {mode:6s}: (empty)")
+            continue
+        v = rec.get("value", 0)
+        err = rec.get("error")
+        print(f"  {mode:6s}: {v:8.1f} img/s  mfu={rec.get('mfu')}  "
+              f"vs_baseline={rec.get('vs_baseline')}"
+              + (f"  ERROR: {err[:80]}" if err else ""))
+        if v and v > best[1]:
+            best = (mode, v)
+    if best[0]:
+        print(f"  => best mode: {best[0]} at {best[1]:.1f} img/s "
+              f"({best[1]/4000:.2%} of the 4000 north star)")
+        if best[0] != "0":
+            print(f"  => recommend: default BENCH_FUSED_BN={best[0]} "
+                  f"(flip bench.py/_synth default + BENCHMARKS.md note); "
+                  f"check the quality ladder in BENCHMARKS.md first")
+
+    for label, pat, pick in (
+            ("[1] q8 chain probe", f"{stamp}_q8_chain_probe.txt", None),
+            ("[3b] 1024x16 LM", f"{stamp}_transformer_1024x16.jsonl", None),
+            ("[3c] 8k remat capacity", f"{stamp}_transformer_8k_remat.jsonl",
+             None),
+            ("[3d] decode w8", f"{stamp}_decode_w8.jsonl", None),
+            ("[2b] scaling AOT", f"{stamp}_scaling_aot.txt", None),
+            ("[3] 16k isolation", f"{stamp}_flash16k_isolation.txt", None),
+            ("[4] feed host", f"{stamp}_feed_bench_host.json", None),
+            ("[4] feed native", f"{stamp}_feed_bench_native.json", None)):
+        path = os.path.join(RUNS, pat)
+        print(f"\n-- {label}: {pat}")
+        if not os.path.exists(path):
+            print("  (missing)")
+            continue
+        if pat.endswith(".txt"):
+            with open(path) as f:
+                for ln in f.read().strip().splitlines()[-8:]:
+                    print("  " + ln)
+        else:
+            recs = _load_json(path)
+            recs = recs if isinstance(recs, list) else [recs]
+            for r in recs or []:
+                print("  " + json.dumps(r)[:160])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
