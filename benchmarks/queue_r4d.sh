@@ -100,4 +100,7 @@ for SRC in host native; do
         && cat "$RUNS/${STAMP}_feed_bench_${SRC}.json"
 done
 
+echo "== summary"
+python benchmarks/analyze_queue.py --stamp "$STAMP" || true
+
 echo "done"
