@@ -106,6 +106,13 @@ def moe_ffn(params, x: jax.Array, cfg: MoEConfig,
     disp = jnp.sum(disp_k, axis=0)                          # [N, E, cap]
     combine = jnp.einsum("knec,nk->nec", disp_k, gate_k)
 
+    # NOTE (round-4 finding): an int8 wire codec at these sharding
+    # constraints is a NO-OP — compiled HLO shows the dispatch einsum
+    # ("nec,nd->ecd", contracting the token-sharded axis) communicates
+    # via fp32 partial all-reduces BEFORE any constraint-point quantize
+    # runs. Quantized MoE dispatch needs the explicit-collective form
+    # (shard_map + lax.all_to_all on the int8 payload, as the ring and
+    # pipeline wire_int8 codecs do with ppermute) — a future rework.
     def constrain(v, spec):
         if mesh is None or place.AXIS_EXPERT not in mesh.axis_names:
             return v

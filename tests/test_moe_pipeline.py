@@ -383,3 +383,4 @@ class TestTopKMoE:
             jnp.argmax(probs, -1), 4), axis=0))
         # near-uniform: no expert starved below half its fair share
         assert frac.min() > 0.125, frac
+
