@@ -855,3 +855,31 @@ class TestWireInt8:
         l1, p2 = train_step(params)
         l2, _ = train_step(p2)
         assert float(l2) < float(l1)
+
+    def test_int8_actually_crosses_the_wire(self):
+        """HLO-level guard against silent no-op codecs (the failure mode
+        that killed the MoE attempt): the compiled programs must contain
+        collective-permutes on s8 operands."""
+        import re
+        mesh = place.make_mesh((1, 8), (place.AXIS_DATA, place.AXIS_SEQ))
+        q = jnp.zeros((1, 32, 2, 8), jnp.float32)
+        f = jax.jit(lambda q: ring.ring_attention_spmd(
+            q, q, q, mesh, causal=True, wire_int8=True))
+        txt = f.lower(q).compile().as_text()
+        cp_lines = [l for l in txt.splitlines()
+                    if "collective-permute" in l]
+        assert any("s8[" in l for l in cp_lines), \
+            "ring wire_int8: no int8 collective-permute in compiled HLO"
+
+        from paddle_tpu.parallel import pipeline
+        m2 = place.make_mesh((4,), (place.AXIS_STAGE,))
+        params = {"w": jnp.zeros((4, 8, 8), jnp.float32),
+                  "b": jnp.zeros((4, 8), jnp.float32)}
+        x = jnp.zeros((16, 8), jnp.float32)
+        g = jax.jit(lambda p, x: pipeline.pipeline_apply(
+            p, x, lambda pp, h: jnp.tanh(h @ pp["w"] + pp["b"]),
+            m2, 4, wire_int8=True))
+        txt2 = g.lower(params, x).compile().as_text()
+        cp2 = [l for l in txt2.splitlines() if "collective-permute" in l]
+        assert any("s8[" in l for l in cp2), \
+            "pipeline wire_int8: no int8 collective-permute in HLO"
