@@ -354,6 +354,19 @@ def make_add_q8(relu_a: bool, relu_b: bool, stash: str = "int8",
 # quantized collectives: int8 payloads over ICI
 # ---------------------------------------------------------------------------
 
+def ppermute_q8_raw(x: jax.Array, axis_name: str, perm) -> jax.Array:
+    """One quantized hop (int8 payload + per-shard fp32 scale) with NO
+    autodiff wrapper — for use inside hand-written custom_vjp bodies
+    that own their gradient rules (the flash ring)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf))
+    sc = jnp.maximum(amax, 1e-8) / 127.0
+    q = _quantize(xf / sc)
+    qp = lax.ppermute(q, axis_name, perm)
+    sp = lax.ppermute(sc, axis_name, perm)
+    return (qp.astype(jnp.float32) * sp).astype(x.dtype)
+
+
 @functools.lru_cache(maxsize=None)
 def make_ppermute_q8(axis_name: str, perm: tuple):
     """``lax.ppermute`` with a symmetric per-shard-scalar int8 wire codec
@@ -368,13 +381,7 @@ def make_ppermute_q8(axis_name: str, perm: tuple):
 
     def _codec(p):
         def send(x):
-            xf = x.astype(jnp.float32)
-            amax = jnp.max(jnp.abs(xf))
-            s = jnp.maximum(amax, 1e-8) / 127.0
-            q = _quantize(xf / s)
-            qp = lax.ppermute(q, axis_name, p)
-            sp = lax.ppermute(s, axis_name, p)
-            return (qp.astype(jnp.float32) * sp).astype(x.dtype)
+            return ppermute_q8_raw(x, axis_name, p)
         return send
 
     _send, _send_back = _codec(perm), _codec(inv)

@@ -166,8 +166,11 @@ def ring_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
     only when it divides BOTH head counts. ``use_flash`` swaps the
     per-block engine for the Pallas flash kernel (packed equal-length
     sequences only). ``wire_int8`` sends the rotating K/V as int8 + a
-    per-shard scale (jnp engine only — the flash ring's hand-written VJP
-    stays full precision)."""
+    per-shard scale in both engines. Backward precision differs: the
+    flash engine's hand-written VJP keeps its dk/dv accumulators fp32
+    on the wire; the jnp engine's autodiff backward sends cotangents
+    through the same int8 codec per hop (bounded by the grad tolerance
+    test — prefer the flash engine for training at scale)."""
     from jax import shard_map
 
     H, Hkv = q.shape[2], k.shape[2]
@@ -180,9 +183,6 @@ def ring_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
     if use_flash and lengths is not None:
         raise ValueError(_FLASH_RAGGED_MSG)
     interpret = _default_interpret(interpret)
-    if wire_int8 and use_flash:
-        raise ValueError("wire_int8 applies to the jnp ring engine only "
-                         "(the flash ring's custom VJP is full precision)")
     if wire_int8 and lengths is not None:
         # the per-shard scale is an absmax over the WHOLE rotating shard;
         # padding K/V beyond lengths would inflate it and collapse the
@@ -198,7 +198,8 @@ def ring_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
             def wrapped(q_, k_, v_):
                 return ring_flash_attention(
                     q_, k_, v_, axis_name=seq_axis, causal=causal,
-                    scale=scale, interpret=interpret)
+                    scale=scale, interpret=interpret,
+                    wire_int8=wire_int8)
         else:
             def wrapped(q_, k_, v_):
                 return fn(q_, k_, v_, lengths=None)
@@ -310,7 +311,8 @@ def ring_flash_attention(q, k, v, *, axis_name: str, causal: bool = False,
                          scale: Optional[float] = None,
                          block_q: Optional[int] = None,
                          block_k: Optional[int] = None,
-                         interpret: bool = False):
+                         interpret: bool = False,
+                         wire_int8: bool = False):
     """Ring attention with the Pallas flash kernel as the per-block engine.
 
     Same exactness and rotation scheme as ``ring_attention``, but each
@@ -340,7 +342,8 @@ def ring_flash_attention(q, k, v, *, axis_name: str, causal: bool = False,
         bq_auto, bk_auto = select_block_sizes(Tl, D, q.dtype)
         bq = min(block_q, Tl) if block_q else bq_auto
         bk = min(block_k, Tl) if block_k else bk_auto
-    return _ring_flash(q, k, v, axis_name, causal, scale, bq, bk, interpret)
+    return _ring_flash(q, k, v, axis_name, causal, scale, bq, bk,
+                       interpret, wire_int8)
 
 
 def _bhtd(x):
@@ -385,16 +388,32 @@ def _fold(o, lse, ob, lseb):
     return o, m + jnp.log(tot)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _ring_flash(q, k, v, axis_name, causal, scale, block_q, block_k,
-                interpret):
+                interpret, wire_int8=False):
     out, _ = _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q,
-                             block_k, interpret)
+                             block_k, interpret, wire_int8)
     return out
 
 
+def _kv_rot(axis_name, perm, wire_int8):
+    """The K/V hop: full precision, or the int8+scale codec
+    (ops/q8.ppermute_q8_raw). Gradient ACCUMULATORS never use this —
+    re-quantizing a running sum each hop would compound error."""
+    if wire_int8:
+        from paddle_tpu.ops import q8 as ops_q8
+
+        def rot1(x):
+            return ops_q8.ppermute_q8_raw(x, axis_name, perm)
+    else:
+        def rot1(x):
+            return jax.lax.ppermute(x, axis_name, perm)
+    return rot1
+
+
 def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
-                    interpret):
+                    interpret, wire_int8=False):
     from paddle_tpu.ops.pallas.attention import NEG_INF as FNEG
     from paddle_tpu.ops.pallas.attention import flash_block_fwd
 
@@ -416,11 +435,13 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
                              block_q, block_k, interpret)
     o = o.astype(jnp.float32)
 
+    kv_hop = _kv_rot(axis_name, perm, wire_int8)
+
     def body(step, carry):
         o, lse, k_cur, v_cur = carry
         # rotate first: at step j the local block is (my - j) mod n
-        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        k_cur = kv_hop(k_cur)
+        v_cur = kv_hop(v_cur)
         ob, lseb = flash_block_fwd(qr, _expand_groups(k_cur, B, G),
                                    _expand_groups(v_cur, B, G), scale,
                                    False, block_q, block_k, interpret)
@@ -435,14 +456,14 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
 
 
 def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale, block_q,
-                        block_k, interpret):
+                        block_k, interpret, wire_int8=False):
     out, lse = _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q,
-                               block_k, interpret)
+                               block_k, interpret, wire_int8)
     return out, (q, k, v, out, lse)
 
 
 def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k,
-                        interpret, res, do):
+                        interpret, wire_int8, res, do):
     from paddle_tpu.ops.pallas.attention import NEG_INF as FNEG
     from paddle_tpu.ops.pallas.attention import flash_block_bwd
 
@@ -455,6 +476,11 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k,
     perm = [(i, (i + 1) % nshards) for i in range(nshards)]
     qr, outr, dor = _bhtd(q), _bhtd(out), _bhtd(do)
     kr, vr = _bhtd(k), _bhtd(v)
+
+    kv_hop = _kv_rot(axis_name, perm, wire_int8)
+
+    def rot_kv(*xs):
+        return tuple(kv_hop(x) for x in xs)
 
     def rot(*xs):
         return tuple(jax.lax.ppermute(x, axis_name, perm) for x in xs)
@@ -471,8 +497,9 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k,
                                     dor, scale, causal, block_q, block_k,
                                     interpret)
     dq_acc = dq0.astype(jnp.float32)        # [BH, Tl, D], stays local
-    k_cur, v_cur, dk_acc, dv_acc = rot(
-        kr, vr, _group_sum(dk0.astype(jnp.float32), B, G),
+    k_cur, v_cur = rot_kv(kr, vr)
+    dk_acc, dv_acc = rot(
+        _group_sum(dk0.astype(jnp.float32), B, G),
         _group_sum(dv0.astype(jnp.float32), B, G))
 
     def body(step, carry):
@@ -493,7 +520,13 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k,
         dq_acc = dq_acc + dqb.astype(jnp.float32)
         dk_acc = dk_acc + _group_sum(dkb.astype(jnp.float32), B, G)
         dv_acc = dv_acc + _group_sum(dvb.astype(jnp.float32), B, G)
-        k_cur, v_cur, dk_acc, dv_acc = rot(k_cur, v_cur, dk_acc, dv_acc)
+        # the accumulators need all n rotations to arrive home; the K/V
+        # blocks are dead after the last step — skip their final hop
+        # (with wire_int8 it would also burn a quantize + extra sends)
+        k_cur, v_cur = jax.lax.cond(
+            step < nshards - 1, lambda kv: rot_kv(*kv), lambda kv: kv,
+            (k_cur, v_cur))
+        dk_acc, dv_acc = rot(dk_acc, dv_acc)
         return dq_acc, dk_acc, dv_acc, k_cur, v_cur
 
     dq_acc, dk_acc, dv_acc, _, _ = jax.lax.fori_loop(
