@@ -61,6 +61,15 @@ def main():
           f"attention = {err:.2e} ({t_ring:.1f}s)", flush=True)
     assert err < 5e-4, err
 
+    # the int8-wire variant: K/V hops carry int8 + per-shard scales
+    got8 = np.asarray(ring.ring_attention_spmd(
+        q, k, v, mesh, causal=True, use_flash=True, wire_int8=True))
+    err8 = float(np.abs(got8 - want).max() / (np.abs(want).max() + 1e-9))
+    rec["wire_int8_fwd_rel_err"] = err8
+    print(f"# ring x flash x wire-int8 at seq {T}: rel err vs exact = "
+          f"{err8:.2e}", flush=True)
+    assert err8 < 0.05, err8
+
     # 2) 2-layer LM train steps, ring+flash, loss decreases
     cfg = transformer.TransformerConfig(
         vocab=256, d_model=32, n_heads=2, n_layers=2, d_ff=64,
