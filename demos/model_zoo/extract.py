@@ -30,10 +30,16 @@ from paddle_tpu import layer
 from paddle_tpu.models import resnet
 
 
-def build():
+def build(recipe=False):
+    """recipe: resnet fused_bn mode (False dense; "1"->True streaming-BN;
+    "int8"/"full"/"q8"/"defer"/"q8sr") — parameter names interchange
+    across modes, so artifacts stay loadable either way."""
+    if recipe == "1":
+        recipe = True
     img = layer.data("image", paddle.data_type.dense_vector(3 * 32 * 32))
     lbl = layer.data("label", paddle.data_type.integer_value(10))
-    out = resnet.resnet_cifar10(img, depth=8, class_num=10)
+    out = resnet.resnet_cifar10(img, depth=8, class_num=10,
+                                fused_bn=recipe)
     cost = layer.classification_cost(out, lbl, name="cost")
     return img, out, cost
 

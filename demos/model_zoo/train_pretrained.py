@@ -32,6 +32,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--passes", type=int, default=6)
     ap.add_argument("--platform", default=None)
+    ap.add_argument("--recipe", default=False,
+                    help="fused_bn recipe: 1/int8/full/q8/defer/q8sr "
+                    "(default dense)")
     args = ap.parse_args()
     out_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "pretrained")
@@ -39,7 +42,7 @@ def main():
 
     paddle.init(seed=5, platform=args.platform)
     from extract import build                   # same topology as the demo
-    img, out, cost = build()
+    img, out, cost = build(recipe=args.recipe)
     params = paddle.parameters.create(cost)
     trainer = paddle.trainer.SGD(
         cost=cost, parameters=params,

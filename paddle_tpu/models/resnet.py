@@ -157,19 +157,27 @@ def resnet_imagenet(input, depth=50, class_num=1000, img_size=224,
     return layer.fc(pool, class_num, act=activation.Softmax(), name="res_fc")
 
 
-def resnet_cifar10(input, depth=32, class_num=10):
-    """(reference: v1_api_demo/model_zoo resnet cifar variant)"""
+def resnet_cifar10(input, depth=32, class_num=10, fused_bn=False):
+    """(reference: v1_api_demo/model_zoo resnet cifar variant).
+    fused_bn: same recipe surface as resnet_imagenet (False / True /
+    "int8" / "full" / "q8" / "defer" / "q8sr"); the stem stays dense."""
     n = (depth - 2) // 6
     conv1 = conv_bn_layer(input, 16, 3, 1, 1, activation.Relu(), ch_in=3,
                           name="rc_conv1")
     tmp = conv1
+    if _stash_for(fused_bn):
+        stash, sr = _stash_for(fused_bn)
+        tmp = layer.q8_entry(tmp, name="rc_q8_entry", stash=stash,
+                             stochastic=sr)
     ch_in = 16
     for stage, ch_out in enumerate([16, 32, 64]):
         for i in range(n):
             stride = 2 if (i == 0 and stage > 0) else 1
             tmp = basic_block(tmp, ch_in, ch_out, stride,
-                              name=f"rc{stage}_{i}")
+                              name=f"rc{stage}_{i}", fused=fused_bn)
             ch_in = ch_out
+    if _stash_for(fused_bn):
+        tmp = layer.q8_exit(tmp, name="rc_q8_exit")
     pool = layer.img_pool(tmp, pool_size=8, stride=1,
                           pool_type=pooling.Avg(), name="rc_gap")
     return layer.fc(pool, class_num, act=activation.Softmax(), name="rc_fc")
