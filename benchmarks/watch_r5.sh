@@ -1,0 +1,33 @@
+#!/bin/bash
+# Round-5 tunnel watcher: probe the axon tunnel until it computes, then
+# immediately run the queued round-4d on-chip session (the decisive
+# unfused/defer/q8sr/q8 A/B plus the long-context ladder and scaling AOT).
+# Exits when the queue has run (or after the wall budget), so the driver
+# of this script gets notified.
+set -u
+cd "$(dirname "$0")/.."
+LOG=benchmarks/runs/r5_watch.log
+WALL_BUDGET=${WATCH_WALL_BUDGET:-39600}   # 11 h
+START=$(date +%s)
+echo "[watch] start $(date -Is)" >> "$LOG"
+while true; do
+    NOW=$(date +%s)
+    if [ $((NOW - START)) -gt "$WALL_BUDGET" ]; then
+        echo "[watch] wall budget exhausted $(date -Is)" >> "$LOG"
+        exit 2
+    fi
+    T0=$(date +%s)
+    if timeout -k 10 100 python -c "
+import jax, jax.numpy as jnp
+print('probe ok', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" \
+            >> "$LOG" 2>&1; then
+        echo "[watch] tunnel ALIVE $(date -Is) — launching queue_r4d" >> "$LOG"
+        bash benchmarks/queue_r4d.sh > benchmarks/runs/r5_queue.log 2>&1
+        RC=$?
+        echo "[watch] queue_r4d done rc=$RC $(date -Is)" >> "$LOG"
+        exit $RC
+    fi
+    echo "[watch] probe dead $(date -Is) ($((T0 - START))s elapsed)" >> "$LOG"
+    SLEEP=$((150 - ($(date +%s) - T0)))
+    [ "$SLEEP" -gt 0 ] && sleep "$SLEEP"
+done

@@ -38,8 +38,9 @@ class TestLastVerified:
     def test_picks_best_within_session_window(self, bench):
         _write(bench, "a.json", [{"metric": METRIC, "value": 2400.0}])
         _write(bench, "b.json", [{"metric": METRIC, "value": 2537.3}])
-        v, ts, fname = bench.last_verified()
-        assert v == 2537.3 and fname == "b.json"
+        v, ts, fname, mt, src = bench.last_verified()
+        assert v == 2537.3 and fname == "b.json" and mt > 0
+        assert src["value"] == 2537.3
 
     def test_skips_cpu_and_stalled_and_other_metrics(self, bench):
         _write(bench, "a.jsonl", [
@@ -48,8 +49,26 @@ class TestLastVerified:
             {"metric": "other_metric", "value": 7000.0},
             {"metric": METRIC, "value": 2000.0, "platform": "tpu"},
         ])
-        v, _, _ = bench.last_verified()
+        v = bench.last_verified()[0]
         assert v == 2000.0
+
+    def test_skips_implausible_and_stale_records(self, bench):
+        _write(bench, "a.jsonl", [
+            # a tunnel sync artifact (beyond the physical ceiling) and a
+            # previous round's stale re-emission are both non-evidence
+            {"metric": METRIC, "value": 50000.0},
+            {"metric": METRIC, "value": 3000.0, "stale": True},
+            {"metric": METRIC, "value": 2100.0},
+        ])
+        assert bench.last_verified()[0] == 2100.0
+
+    def test_age_uses_record_ts_not_file_mtime(self, bench):
+        import time as _t
+        old = _t.strftime("%Y-%m-%dT%H:%M:%S", _t.localtime(_t.time() - 7200))
+        _write(bench, "a.jsonl", [{"metric": METRIC, "value": 2500.0,
+                                   "ts": old}])
+        mt = bench.last_verified()[3]
+        assert 7100 <= _t.time() - mt <= 7300   # ~2h, not the fresh mtime
 
     def test_none_when_no_evidence(self, bench):
         assert bench.last_verified() is None
@@ -57,7 +76,7 @@ class TestLastVerified:
     def test_reads_jsonl_written_by_record_run(self, bench, monkeypatch):
         monkeypatch.delenv("BENCH_PLATFORM", raising=False)
         bench.record_run({"metric": METRIC, "value": 2600.0})
-        v, ts, fname = bench.last_verified()
+        v, ts, fname = bench.last_verified()[:3]
         assert v == 2600.0 and fname.endswith(".jsonl")
         assert ts.startswith("20")            # ISO timestamp recorded
 
@@ -190,6 +209,70 @@ class TestOrchestrator:
         with open(path) as f:
             rec = json.load(f)
         assert rec["stage"] == "probe"
+
+
+class TestStaleFallback:
+    """A dead backend with verified evidence on disk emits THAT value,
+    labelled stale — never a 0.0 that erases the round (the round-4
+    lesson: four gates of 0.0 with real measurements in shadow files)."""
+
+    def _fail(self, bench, monkeypatch):
+        emitted = {}
+
+        def fake_emit(value, error=None, **extra):
+            emitted.update(value=value, error=error, **extra)
+            raise SystemExit(1 if error else 0)
+
+        monkeypatch.setattr(bench, "emit", fake_emit)
+        bench._state.update(probes=3, children=0, best=None, measured={})
+        with pytest.raises(SystemExit):
+            bench._final_fail("probe hung after 100s")
+        return emitted
+
+    def test_dead_backend_emits_stale_value(self, bench, monkeypatch):
+        _write(bench, "a.json", [{"metric": METRIC, "value": 2548.4}])
+        rec = self._fail(bench, monkeypatch)
+        assert rec["value"] == 2548.4 and rec["error"] is None
+        assert rec["stale"] is True and rec["source_file"] == "a.json"
+        assert rec["stale_minutes"] >= 0
+        assert "backend unusable" in rec["backend_error"]
+
+    def test_stale_record_carries_source_config(self, bench, monkeypatch):
+        """The evidence may have been measured under a different recipe
+        than this process's BENCH_FUSED_BN — the stale record must carry
+        the source's config, not the current env's."""
+        monkeypatch.setattr(bench, "FUSED_BN", "int8")
+        _write(bench, "a.json", [{"metric": METRIC, "value": 2548.4,
+                                  "fused_bn": False, "mfu": 0.1591}])
+        rec = self._fail(bench, monkeypatch)
+        assert rec["value"] == 2548.4
+        assert rec["fused_bn"] is False and rec["mfu"] == 0.1591
+
+    def test_stale_cap_rejects_ancient_evidence(self, bench, monkeypatch):
+        import time as _t
+        old = _t.strftime("%Y-%m-%dT%H:%M:%S",
+                          _t.localtime(_t.time() - 8 * 86400))
+        _write(bench, "a.json", [{"metric": METRIC, "value": 2548.4,
+                                  "ts": old}])
+        rec = self._fail(bench, monkeypatch)   # default cap: 7 days
+        assert rec["value"] == 0.0 and "backend unusable" in rec["error"]
+
+    def test_no_evidence_still_fails_with_zero(self, bench, monkeypatch):
+        rec = self._fail(bench, monkeypatch)
+        assert rec["value"] == 0.0
+        assert "backend unusable" in rec["error"]
+
+    def test_stale_emit_does_not_rerecord(self, bench, monkeypatch,
+                                          capsys):
+        _write(bench, "a.json", [{"metric": METRIC, "value": 2548.4}])
+        monkeypatch.setattr(bench.os, "_exit",
+                            lambda c: (_ for _ in ()).throw(SystemExit(c)))
+        with pytest.raises(SystemExit):
+            bench.emit(2548.4, stale=True, measured_at="2026-07-31")
+        out = capsys.readouterr().out
+        assert json.loads(out)["stale"] is True
+        # nothing appended beyond the pre-existing evidence file
+        assert sorted(os.listdir(bench.RUNS_DIR)) == ["a.json"]
 
 
 class TestMultiModeGate:
