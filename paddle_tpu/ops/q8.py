@@ -153,9 +153,17 @@ def make_entry(stash: str = "int8", stochastic: bool = False):
 
     def bwd(res, cots):
         mu_p, s_p, key = res
-        g_yhat = cots[0]
-        # straight-through: ŷ ≈ x, the carrier's cotangent IS the input's
-        return (g_yhat.astype(dtypes.compute_dtype()),
+        g_yhat, g_mu = cots[0], cots[2]
+        # straight-through: ŷ ≈ x, the carrier's cotangent IS the input's;
+        # plus the mu output's term d(mean(x))/dx = 1/nhw (today's
+        # consumers fold mu with fold_identity and never differentiate
+        # it, so g_mu is zeros — but a future consumer that does gets
+        # correct gradients instead of silently dropped ones). The amax
+        # output is next-step scale STATE, non-differentiated by design
+        # (like BN running stats).
+        nhw = g_yhat.size // g_yhat.shape[-1]
+        g = g_yhat.astype(jnp.float32) + g_mu.astype(jnp.float32) / nhw
+        return (g.astype(dtypes.compute_dtype()),
                 jnp.zeros_like(mu_p), jnp.zeros_like(s_p),
                 *[_int_zero(k) for k in key])
 

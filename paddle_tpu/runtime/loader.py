@@ -202,8 +202,22 @@ class DenseBatchLoader:
                 if n == 0:
                     break
                 if n < self.batch_size:
+                    # short batch = end-of-data OR a deferred mid-batch
+                    # error (the native side returns copied records
+                    # first and re-surfaces the error on the next call);
+                    # poke with batch=0 to distinguish, after yielding
+                    # the records that were already assembled
+                    probe = lib.loader_next_batch(
+                        handle, out.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_uint8)),
+                        0, self.record_bytes)
                     if not self.drop_last:
                         yield out[:n]
+                    if probe < 0:
+                        raise IOError(
+                            f"native batch loader error {probe} on "
+                            f"{self.path} after a partial batch of {n} "
+                            f"(-100 = record size != {self.record_bytes})")
                     break
                 yield out
         finally:

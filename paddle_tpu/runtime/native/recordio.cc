@@ -171,6 +171,9 @@ struct Loader {
   std::atomic<int> active_readers{0};
   std::atomic<bool> stop{false};
   std::atomic<int> error{0};
+  // error deferred by loader_next_batch so a partially-assembled batch
+  // is returned to the caller before the error surfaces
+  std::atomic<long long> pending_error{0};
 
   void reader_loop() {
     FILE* f = fopen(path.c_str(), "rb");
@@ -251,10 +254,16 @@ long long loader_next(void* handle, uint8_t** rec) {
 // per-record malloc, no per-record language crossing. Returns the number
 // of records copied (0 = drained), -100 on a record whose size !=
 // rec_bytes (distinct from the chunk-reader's -1..-4 I/O codes), or the
-// loader's error code. Short counts happen only at end-of-data.
+// loader's error code. An error hit after n>0 records were already
+// copied is DEFERRED: the partial count is returned first and the error
+// surfaces on the next call, so no copied record is ever discarded.
+// Short counts therefore mean end-of-data OR an error about to surface.
+// The mismatched record itself cannot fit the matrix and is dropped.
 long long loader_next_batch(void* handle, uint8_t* out, long batch,
                             long long rec_bytes) {
   Loader* L = static_cast<Loader*>(handle);
+  long long pending = L->pending_error.exchange(0);
+  if (pending != 0) return pending;
   long n = 0;
   while (n < batch) {
     std::unique_lock<std::mutex> lk(L->mu);
@@ -263,14 +272,26 @@ long long loader_next_batch(void* handle, uint8_t* out, long batch,
              L->error.load() != 0;
     });
     if (L->queue.empty()) {
-      if (L->error.load() != 0) return L->error.load();
+      if (L->error.load() != 0) {
+        if (n > 0) {
+          L->pending_error.store(L->error.load());
+          break;
+        }
+        return L->error.load();
+      }
       break;  // drained: return the short tail
     }
     std::vector<uint8_t> r = std::move(L->queue.front());
     L->queue.pop_front();
     L->cv_push.notify_one();
     lk.unlock();
-    if (static_cast<long long>(r.size()) != rec_bytes) return -100;
+    if (static_cast<long long>(r.size()) != rec_bytes) {
+      if (n > 0) {
+        L->pending_error.store(-100);
+        break;
+      }
+      return -100;
+    }
     memcpy(out + static_cast<size_t>(n) * rec_bytes, r.data(), r.size());
     n++;
   }

@@ -140,6 +140,20 @@ class TestGradientRouting:
                         / (jnp.abs(b).max() + 1e-9))
             assert rel < 0.02, f"grad {name} rel err {rel}"
 
+    def test_entry_mu_output_is_differentiable(self):
+        """A consumer that differentiates entry's mu output gets the
+        correct d(mean(x))/dx = 1/nhw term, not a silently dropped
+        cotangent (round-4 advisor finding)."""
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 4, 4, 3),
+                        jnp.float32)
+        mu_p = jnp.zeros(3, jnp.float32)
+        s_p = jnp.ones(3, jnp.float32)
+        fn = lambda x: jnp.sum(  # noqa: E731
+            q8.entry_stash(x, mu_p, s_p)[2])
+        g = jax.grad(fn)(x)
+        nhw = x.size // x.shape[-1]
+        np.testing.assert_allclose(np.asarray(g), 1.0 / nhw, rtol=1e-5)
+
     def test_carrier_is_dead_in_forward(self):
         """The ghost carriers must not appear in the forward compute: the
         optimized HLO materializes exactly one int8 stash per boundary

@@ -161,6 +161,20 @@ class TestDenseBatchLoader:
         with pytest.raises(IOError):
             list(rl.DenseBatchLoader(path, 3, 2))
 
+    def test_partial_batch_survives_mid_batch_error(self, tmp_path):
+        """A mid-batch size mismatch must not discard the records already
+        assembled: they are yielded first, the error surfaces on the next
+        native call (round-4 advisor finding)."""
+        from paddle_tpu.runtime import loader as rl, recordio
+        path = str(tmp_path / "bad2.rio")
+        recordio.write_records(path, [b"abc", b"xyz", b"defgh"], raw=True)
+        got = []
+        with pytest.raises(IOError, match="partial batch of 2"):
+            for b in rl.DenseBatchLoader(path, 3, 4):
+                got.append(b.copy())
+        assert len(got) == 1 and len(got[0]) == 2
+        assert bytes(got[0][0]) + bytes(got[0][1]) in (b"abcxyz", b"xyzabc")
+
     def test_drop_last(self, tmp_path):
         from paddle_tpu.runtime import loader as rl
         path, feats, labels = self._write(tmp_path, n=100)
