@@ -20,6 +20,7 @@ at host 0 (or let TPU metadata auto-configure) instead.
 
 import argparse
 import os
+import shlex
 import socket
 import subprocess
 import sys
@@ -51,6 +52,11 @@ def launch_local(nprocs: int, argv: Sequence[str],
                    PADDLE_LOCAL_CPU_DEVICES=str(devices_per_proc),
                    **(env_extra or {}))
         procs.append(subprocess.Popen([sys.executable, *argv], env=env))
+    return _wait_all(procs, timeout)
+
+
+def _wait_all(procs: Sequence[subprocess.Popen],
+              timeout: float) -> List[int]:
     deadline = time.time() + timeout
     rcs = []
     for p in procs:
@@ -63,21 +69,68 @@ def launch_local(nprocs: int, argv: Sequence[str],
     return rcs
 
 
+def launch_ssh(hosts: Sequence[str], argv: Sequence[str], *,
+               port: int = 6007, workdir: Optional[str] = None,
+               env_extra: Optional[dict] = None,
+               ssh_cmd: Sequence[str] = ("ssh", "-o", "BatchMode=yes"),
+               timeout: float = 86400.0) -> List[int]:
+    """SSH fan-out: one worker process per host, rank = position in
+    ``hosts``, coordinator = ``hosts[0]:port`` (the reference's
+    paddle/scripts/cluster_train/paddle.py slot — but every process is
+    identical here: no pserver role, jax.distributed + GSPMD replace it).
+
+    The PADDLE_* env contract is injected via ``env`` on the remote
+    command line, so nothing needs to be pre-configured on the hosts
+    beyond the code and its interpreter being present (pass ``workdir``
+    to cd into the repo checkout first). Workers must call
+    ``paddle_tpu.distributed.init()``. Returns per-host return codes
+    (ssh propagates the remote exit status)."""
+    envs_common = dict(env_extra or {})
+    procs = []
+    for rank, host in enumerate(hosts):
+        envs = {"PADDLE_COORDINATOR": f"{hosts[0]}:{port}",
+                "PADDLE_NUM_PROCESSES": str(len(hosts)),
+                "PADDLE_PROCESS_ID": str(rank), **envs_common}
+        exports = " ".join(f"{k}={shlex.quote(str(v))}"
+                           for k, v in envs.items())
+        cd = f"cd {shlex.quote(workdir)} && " if workdir else ""
+        remote = (cd + "env " + exports + " "
+                  + " ".join(shlex.quote(a) for a in argv))
+        procs.append(subprocess.Popen([*ssh_cmd, host, remote]))
+    return _wait_all(procs, timeout)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="paddle_tpu.runtime.launch",
-        description="local multi-process launcher (cluster simulation)")
+        description="multi-process launcher: local simulation or ssh "
+        "fan-out across hosts (docs/howto_distributed.md)")
     ap.add_argument("--nprocs", type=int, default=2)
     ap.add_argument("--devices-per-proc", type=int, default=1)
     ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument("--hosts", default=None,
+                    help="comma-separated host list: ssh mode, one "
+                    "worker per host, coordinator on the first")
+    ap.add_argument("--port", type=int, default=6007,
+                    help="coordinator port (ssh mode)")
+    ap.add_argument("--workdir", default=None,
+                    help="remote directory to cd into (ssh mode)")
+    ap.add_argument("--ssh-cmd", default="ssh -o BatchMode=yes",
+                    help="ssh command prefix (ssh mode)")
     ap.add_argument("worker", nargs=argparse.REMAINDER,
                     help="worker script and args")
     args = ap.parse_args(argv)
     if not args.worker:
         ap.error("worker script required")
-    rcs = launch_local(args.nprocs, args.worker,
-                       devices_per_proc=args.devices_per_proc,
-                       timeout=args.timeout)
+    if args.hosts:
+        rcs = launch_ssh(args.hosts.split(","), args.worker,
+                         port=args.port, workdir=args.workdir,
+                         ssh_cmd=tuple(args.ssh_cmd.split()),
+                         timeout=args.timeout)
+    else:
+        rcs = launch_local(args.nprocs, args.worker,
+                           devices_per_proc=args.devices_per_proc,
+                           timeout=args.timeout)
     print(f"launch: workers exited {rcs}")
     return 0 if all(rc == 0 for rc in rcs) else 1
 

@@ -71,6 +71,45 @@ class TestMultiProcessCluster:
             assert body.startswith("8.0"), body
 
 
+class TestSshLaunch:
+    """launch_ssh fans one worker per host over an ssh-like command with
+    the PADDLE_* env contract injected on the remote command line. The
+    ssh binary is substituted with a local shim (drops the host arg,
+    execs the command) so the mechanics are tested without a cluster."""
+
+    def _shim(self, tmp_path):
+        shim = tmp_path / "fakessh"
+        shim.write_text("#!/bin/bash\nshift\nexec bash -c \"$*\"\n")
+        shim.chmod(0o755)
+        return str(shim)
+
+    def test_env_contract_and_ranks(self, tmp_path):
+        from paddle_tpu.runtime import launch
+        worker = tmp_path / "w.py"
+        worker.write_text(
+            "import os\n"
+            "d = os.environ\n"
+            "open(os.path.join(d['OUT'], 'r' + d['PADDLE_PROCESS_ID']),"
+            " 'w').write('|'.join([d['PADDLE_COORDINATOR'],"
+            " d['PADDLE_NUM_PROCESSES'], os.getcwd()]))\n")
+        rcs = launch.launch_ssh(
+            ["hostA", "hostB"], ["python", str(worker)], port=7070,
+            workdir=str(tmp_path), env_extra={"OUT": str(tmp_path)},
+            ssh_cmd=(self._shim(tmp_path),), timeout=60)
+        assert rcs == [0, 0], rcs
+        for rank in range(2):
+            coord, n, cwd = (tmp_path / f"r{rank}").read_text().split("|")
+            assert coord == "hostA:7070" and n == "2"
+            assert cwd == str(tmp_path)       # workdir honored
+
+    def test_remote_failure_propagates(self, tmp_path):
+        from paddle_tpu.runtime import launch
+        rcs = launch.launch_ssh(
+            ["hostA"], ["bash", "-c", "exit 3"],
+            ssh_cmd=(self._shim(tmp_path),), timeout=60)
+        assert rcs == [3]
+
+
 class TestHybridMeshSingleProcess:
     def test_single_slice_falls_back_to_plain_mesh(self):
         from paddle_tpu import distributed
