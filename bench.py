@@ -84,19 +84,29 @@ CACHE_DIR = os.environ.get("JAX_COMPILATION_CACHE_DIR",
                            os.path.join(REPO, ".jax_cache"))
 # read once; build_train_step and every emitted record use this same value
 STEM_S2D = os.environ.get("BENCH_S2D", "1") == "1"
-# streaming-BN convs (Pallas conv emits batch stats from its epilogue).
-# "0" off | "1" fused fwd stats | "int8" + int8 backward stash | "full"
-# + Pallas backward kernels (benchmarks/traffic_model.py quantifies every
-# lever). Default set by the on-chip A/B record in BENCHMARKS.md.
+# fused conv→BN recipe. "0" off | "1" single-op conv→BN (stats in the
+# conv fusion group) | "int8" + int8 backward stash | "q8"/"defer"/
+# "q8sr" the ops/q8.py stash pipeline (benchmarks/traffic_model.py
+# quantifies every lever; "full" was retired with the Pallas kernels
+# and now raises). Default set by the on-chip A/B record, BENCHMARKS.md.
 sys.path.insert(0, os.path.join(REPO, "benchmarks", "configs"))
-try:
+_FB_ERROR = None           # a retired/unknown mode must still produce the
+try:                       # one JSON line (as an error), never a traceback
     from _synth import parse_fused_bn  # noqa: E402 (shared tri-state parse)
     FUSED_BN = parse_fused_bn()
+except ValueError as _e:   # parse_fused_bn rejects retired modes loudly
+    FUSED_BN, _FB_ERROR = False, str(_e)
 except Exception:  # noqa: BLE001 — an import crash here would erase the
     # one-JSON-line contract before any guard exists; fall back to the
     # same parse inline
     _FB = os.environ.get("BENCH_FUSED_BN", "0")
-    FUSED_BN = _FB if _FB in ("int8", "full", "q8", "defer", "q8sr") else _FB == "1"
+    if _FB == "full":
+        FUSED_BN = False
+        _FB_ERROR = ("BENCH_FUSED_BN=full (Pallas conv backward kernels) "
+                     "was retired — use int8 or the q8/defer/q8sr recipes")
+    else:
+        FUSED_BN = _FB if _FB in ("int8", "q8", "defer", "q8sr") \
+            else _FB == "1"
 
 
 def log(*a):
@@ -526,6 +536,8 @@ def _run_sub(args, timeout, capture=False, env_extra=None):
 def orchestrate():
     signal.signal(signal.SIGTERM, _orch_term_handler)
     signal.signal(signal.SIGINT, _orch_term_handler)
+    if _FB_ERROR:
+        emit(0.0, error=_FB_ERROR)
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
     try:

@@ -35,11 +35,19 @@ def text_reader(vocab, seq_len, classes=2, n=4096, seed=0):
 
 
 def parse_fused_bn(default="0"):
-    """BENCH_FUSED_BN modes: "0" off | "1" fused fwd stats | "int8"
-    + int8 backward stash | "full" + Pallas backward kernels | "q8"
-    int8-stash pipeline at the XLA level (ops/q8.py — activations in HBM
-    as centered int8, BN/ReLU deferred into conv fusions). Shared by the
-    standalone configs and bench.py so the two can't drift."""
+    """BENCH_FUSED_BN modes: "0" off | "1" single-op conv→BN (stats in
+    the conv fusion group, ops/conv_bn.py) | "int8" + int8 backward
+    stash | "q8"/"defer"/"q8sr" stash pipeline at the XLA level
+    (ops/q8.py — activations in HBM as centered int8 or bf16, BN/ReLU
+    deferred into conv fusions). The old "full" (Pallas backward
+    kernels) was retired in round 5 after measuring 0.43x of plain XLA.
+    Shared by the standalone configs and bench.py so the two can't
+    drift."""
     import os
     v = os.environ.get("BENCH_FUSED_BN", default)
-    return v if v in ("int8", "full", "q8", "defer", "q8sr") else v == "1"
+    if v == "full":
+        raise ValueError(
+            "BENCH_FUSED_BN=full (Pallas conv backward kernels) was "
+            "retired after measuring 0.43x of plain XLA — use int8 or "
+            "the q8/defer/q8sr recipes")
+    return v if v in ("int8", "q8", "defer", "q8sr") else v == "1"

@@ -59,27 +59,18 @@ def param_shardings(cfg: MoEConfig, mesh: Mesh):
             "w_out": ns(E, None, None)}
 
 
-def moe_ffn(params, x: jax.Array, cfg: MoEConfig,
-            mesh: Optional[Mesh] = None) -> Tuple[jax.Array, jax.Array]:
-    """Top-k MoE feed-forward (k=1: Switch; k=2: GShard-style top-2).
-
-    x: [N, D] tokens (flatten batch*seq first) → (out [N, D], aux_loss).
-    With a mesh carrying an ``expert`` axis, einsum operands get sharding
-    constraints so dispatch/combine become all-to-alls over ICI.
+def _route(params, x: jax.Array, cfg: MoEConfig, cap: int):
+    """Shared gating + capacity accounting: returns (disp [N, E, cap],
+    combine [N, E, cap], frac [E], mean_p [E]).
 
     One dispatch path serves every k: choice c of every token claims
     capacity AFTER all choices < c (first choices never lose their slot
     to second choices — the GShard priority rule), the [N, E, cap]
     dispatch one-hot sums over choices, and the combine tensor carries
     the per-choice gate weights, so the expert einsums are identical to
-    the Switch path.
-    """
-    N, D = x.shape
+    the Switch path."""
+    N, _ = x.shape
     E, k = cfg.num_experts, cfg.top_k
-    if not 1 <= k <= E:
-        raise ValueError(f"top_k={k} must be in [1, num_experts={E}]")
-    cap = max(1, int(cfg.capacity_factor * k * N / E))
-
     logits = jnp.einsum("nd,de->ne", x.astype(jnp.float32), params["gate"])
     probs = jax.nn.softmax(logits, axis=-1)                 # [N, E]
     gate_k, expert_k = jax.lax.top_k(probs, k)              # [N, k]
@@ -106,13 +97,35 @@ def moe_ffn(params, x: jax.Array, cfg: MoEConfig,
     disp = jnp.sum(disp_k, axis=0)                          # [N, E, cap]
     combine = jnp.einsum("knec,nk->nec", disp_k, gate_k)
 
+    # load-balance stats (Switch eq. 4 / GShard l_aux inputs): first
+    # choices drive balance
+    frac = jnp.mean(jax.nn.one_hot(expert_k[:, 0], E, dtype=jnp.float32),
+                    axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    return disp, combine, frac, mean_p
+
+
+def moe_ffn(params, x: jax.Array, cfg: MoEConfig,
+            mesh: Optional[Mesh] = None) -> Tuple[jax.Array, jax.Array]:
+    """Top-k MoE feed-forward (k=1: Switch; k=2: GShard-style top-2).
+
+    x: [N, D] tokens (flatten batch*seq first) → (out [N, D], aux_loss).
+    With a mesh carrying an ``expert`` axis, einsum operands get sharding
+    constraints so dispatch/combine become all-to-alls over ICI.
+    """
+    N, D = x.shape
+    E, k = cfg.num_experts, cfg.top_k
+    if not 1 <= k <= E:
+        raise ValueError(f"top_k={k} must be in [1, num_experts={E}]")
+    cap = max(1, int(cfg.capacity_factor * k * N / E))
+    disp, combine, frac, mean_p = _route(params, x, cfg, cap)
+
     # NOTE (round-4 finding): an int8 wire codec at these sharding
     # constraints is a NO-OP — compiled HLO shows the dispatch einsum
     # ("nec,nd->ecd", contracting the token-sharded axis) communicates
     # via fp32 partial all-reduces BEFORE any constraint-point quantize
-    # runs. Quantized MoE dispatch needs the explicit-collective form
-    # (shard_map + lax.all_to_all on the int8 payload, as the ring and
-    # pipeline wire_int8 codecs do with ppermute) — a future rework.
+    # runs. Quantized MoE dispatch lives in the explicit-collective form
+    # instead: moe_ffn_a2a(..., wire_int8=True) below (round 5).
     def constrain(v, spec):
         if mesh is None or place.AXIS_EXPERT not in mesh.axis_names:
             return v
@@ -128,9 +141,79 @@ def moe_ffn(params, x: jax.Array, cfg: MoEConfig,
     out = jnp.einsum("nec,ecd->nd", combine, ye)            # gate-weighted
 
     # load-balance aux loss (Switch eq. 4 / GShard l_aux): E * Σ_e
-    # frac_first_choice_e * mean_prob_e — first choices drive balance
-    frac = jnp.mean(jax.nn.one_hot(expert_k[:, 0], E, dtype=jnp.float32),
-                    axis=0)
-    mean_p = jnp.mean(probs, axis=0)
+    # frac_first_choice_e * mean_prob_e
     aux = cfg.aux_loss_weight * E * jnp.sum(frac * mean_p)
     return out.astype(x.dtype), aux
+
+
+def moe_ffn_a2a(params, x: jax.Array, cfg: MoEConfig, mesh: Mesh,
+                wire_int8: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """MoE feed-forward in the explicit-collective form: shard_map over
+    the ``expert`` axis with ``lax.all_to_all`` dispatch/combine.
+
+    Tokens are sharded over the expert axis (x: [N, D] global, N/P per
+    shard); capacity is per (expert, source shard) — GShard's layout:
+    cap_s = ceil(cf·k·N_s/E) slots per expert from EACH source shard, so
+    total expert capacity matches the einsum path but a shard cannot
+    borrow another shard's unused slots (documented divergence; drop
+    patterns differ only under imbalance).
+
+    ``wire_int8``: the dispatch AND combine all-to-alls carry int8 +
+    per-destination-block fp32 scales (ops/q8.make_all_to_all_q8) — half
+    the ICI bytes of the bf16 wire, straight-through gradients through
+    the codec. This is the form the round-4 HLO inspection demanded: the
+    quantize runs BEFORE the collective, inside the shard, so s8 is what
+    crosses the wire (asserted in tests/test_moe_pipeline.py).
+    """
+    from jax import shard_map
+
+    ax = place.AXIS_EXPERT
+    if ax not in mesh.axis_names:
+        raise ValueError(f"mesh must carry an {ax!r} axis")
+    pe = mesh.shape[ax]
+    N, D = x.shape
+    E, k = cfg.num_experts, cfg.top_k
+    if not 1 <= k <= E:
+        raise ValueError(f"top_k={k} must be in [1, num_experts={E}]")
+    if E % pe or N % pe:
+        raise ValueError(f"num_experts={E} and N={N} must both be "
+                         f"divisible by the expert axis size {pe}")
+    e_local, n_s = E // pe, N // pe
+    cap_s = max(1, int(math.ceil(cfg.capacity_factor * k * n_s / E)))
+
+    if wire_int8:
+        from paddle_tpu.ops import q8 as ops_q8
+        a2a = ops_q8.make_all_to_all_q8(ax)
+    else:
+        def a2a(v):
+            return jax.lax.all_to_all(v, ax, 0, 0)
+
+    def body(gate, w_in, w_out, xs):
+        # xs: [n_s, D] local tokens; w_in/w_out: [e_local, ...] local
+        disp, combine, frac, mean_p = _route(
+            {"gate": gate}, xs, cfg, cap_s)
+        xe = jnp.einsum("nec,nd->ecd", disp, xs.astype(jnp.float32))
+        # leading axis = destination shard, then its local expert group
+        xe = xe.reshape(pe, e_local, cap_s, D)
+        xe = a2a(xe)                      # → leading axis = source shard
+        xe = xe.transpose(1, 0, 2, 3).reshape(e_local, pe * cap_s, D)
+        h = jax.nn.gelu(jnp.einsum("esd,edf->esf", xe, w_in))
+        ye = jnp.einsum("esf,efd->esd", h, w_out)
+        ye = ye.reshape(e_local, pe, cap_s, D).transpose(1, 0, 2, 3)
+        ye = a2a(ye)                      # back to the source shards
+        ye = ye.reshape(E, cap_s, D)
+        out = jnp.einsum("nec,ecd->nd", combine, ye)
+        # aux loss over GLOBAL balance stats (token means are equal-sized
+        # per shard, so pmean == the einsum path's full-batch mean)
+        frac_g = jax.lax.pmean(frac, ax)
+        mean_p_g = jax.lax.pmean(mean_p, ax)
+        aux = cfg.aux_loss_weight * E * jnp.sum(frac_g * mean_p_g)
+        return out.astype(xs.dtype), aux
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, None), P(ax, None, None), P(ax, None, None),
+                  P(ax, None)),
+        out_specs=(P(ax, None), P()),
+        check_vma=False)
+    return fn(params["gate"], params["w_in"], params["w_out"], x)

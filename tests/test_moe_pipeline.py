@@ -86,6 +86,94 @@ class TestMoE:
         assert hist[-1] < hist[0] * 0.8
 
 
+class TestMoEAllToAll:
+    """moe_ffn_a2a: the explicit shard_map + lax.all_to_all dispatch
+    (tokens sharded over the expert axis, per-source-shard capacity) and
+    its int8 wire codec."""
+
+    CFG = moe.MoEConfig(d_model=8, d_ff=16, num_experts=8,
+                        capacity_factor=8.0)   # ample: no drops anywhere
+
+    def _setup(self, rng, n=32):
+        mesh = place.make_mesh((4,), (place.AXIS_EXPERT,))
+        params = moe.init_params(jax.random.PRNGKey(0), self.CFG)
+        x = jnp.asarray(rng.randn(n, 8).astype(np.float32))
+        return mesh, params, x
+
+    def test_matches_einsum_path(self, rng):
+        """At ample capacity both dispatch layouts route every token, so
+        the explicit-collective path must reproduce the GSPMD einsum
+        path (reduction-order tolerance)."""
+        mesh, params, x = self._setup(rng)
+        ref, aux_ref = moe.moe_ffn(params, x, self.CFG)
+
+        @jax.jit
+        def f(p, xx):
+            return moe.moe_ffn_a2a(p, xx, self.CFG, mesh)
+
+        got, aux = f(params, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-4)
+
+    def test_wire_int8_close_and_s8_on_the_wire(self, rng):
+        """int8 wire: output within stash tolerance of the dense-wire
+        path AND the compiled HLO must show s8 all-to-alls — the check
+        the round-4 GSPMD attempt failed (it shipped fp32)."""
+        mesh, params, x = self._setup(rng)
+
+        @jax.jit
+        def f(p, xx):
+            return moe.moe_ffn_a2a(p, xx, self.CFG, mesh,
+                                   wire_int8=True)
+
+        got, _ = f(params, x)
+        ref, _ = moe.moe_ffn_a2a(params, x, self.CFG, mesh)
+        denom = float(jnp.abs(ref).max()) + 1e-8
+        rel = float(jnp.abs(got - ref).max()) / denom
+        assert rel < 0.05, f"int8 wire rel err {rel}"
+
+        txt = f.lower(params, x).compile().as_text()
+        a2a_lines = [ln for ln in txt.splitlines() if "all-to-all" in ln]
+        s8_a2a = [ln for ln in a2a_lines if "s8[" in ln]
+        # dispatch + combine payloads, forward at minimum
+        assert len(s8_a2a) >= 2, (
+            f"expected >=2 s8 all-to-alls on the wire, found "
+            f"{len(s8_a2a)}")
+        # no f32 PAYLOAD all-to-all may remain — the only allowed f32
+        # on the wire is the [P]=4-element per-block scale vector
+        import re
+        for ln in a2a_lines:
+            for shape in re.findall(r"f32\[([\d,]*)\]", ln):
+                dims = [int(d) for d in shape.split(",") if d]
+                n_elts = int(np.prod(dims)) if dims else 1
+                assert n_elts <= 4, (
+                    f"f32 payload all-to-all survived: {ln.strip()}")
+
+    def test_grads_flow_and_train(self, rng):
+        mesh, params, x = self._setup(rng)
+        y = jnp.asarray(rng.randn(32, 8).astype(np.float32))
+
+        @jax.jit
+        def step(p, xx, yy):
+            def loss(p_):
+                out, aux = moe.moe_ffn_a2a(p_, xx, self.CFG, mesh,
+                                           wire_int8=True)
+                return jnp.mean((out - yy) ** 2) + aux
+            l, g = jax.value_and_grad(loss)(p)
+            return l, jax.tree_util.tree_map(
+                lambda w, gr: w - 0.1 * gr, p, g)
+
+        l1, p2 = step(params, x, y)
+        l2, _ = step(p2, x, y)
+        assert np.isfinite(float(l1)) and float(l2) < float(l1)
+
+    def test_capacity_validation(self, rng):
+        mesh, params, x = self._setup(rng, n=30)   # 30 % 4 != 0
+        with pytest.raises(ValueError, match="divisible"):
+            moe.moe_ffn_a2a(params, x, self.CFG, mesh)
+
+
 class TestPipeline:
     def _stage_fn(self, p, x):
         return jnp.tanh(x @ p["w"] + p["b"])
