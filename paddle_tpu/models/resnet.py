@@ -20,13 +20,20 @@ def _stash_for(fused):
 def conv_bn_layer(input, ch_out, filter_size, stride, padding, active_type,
                   ch_in=None, name=None, fused=False):
     """(reference: resnet.py conv_bn_layer). ``fused=True`` runs the
-    streaming-BN path: one Pallas kernel computes the conv AND its batch
-    statistics (ops/pallas/conv_bn.py), eliminating the stats-reduce
-    read of the activation on every BN'd conv. ``fused="q8"`` runs the
+    single-op conv→BN path (ops/conv_bn.py: stats in the conv's fusion
+    group, closed-form BN VJP); ``fused="int8"`` additionally stashes
+    the backward's saved activations as int8. ``fused="q8"`` runs the
     q8 pipeline (ops/q8.py): activations stored int8 in HBM, BN affine +
     activation deferred into the consumer's conv fusion. ``fused="defer"``
     is the same deferral machinery with a near-lossless bf16 stash (the
-    affine-prologue block-remat recipe)."""
+    affine-prologue block-remat recipe). The round-3 Pallas conv kernels
+    behind the old ``fused="full"`` mode measured 0.43-0.59x of plain
+    XLA and were retired in round 5 (see ops/conv_bn.py docstring)."""
+    if fused == "full":
+        raise ValueError(
+            "fused='full' (Pallas conv backward kernels) was retired "
+            "after the on-chip A/B measured it at 0.43x of plain XLA "
+            "(BENCHMARKS.md); use 'int8' or the q8/defer recipes")
     if _stash_for(fused):
         stash, sr = _stash_for(fused)
         return layer.img_conv_bn_q8(
@@ -41,17 +48,13 @@ def conv_bn_layer(input, ch_out, filter_size, stride, padding, active_type,
         # asymmetrically at stride 2, which would silently change
         # stride-2 numerics vs the unfused path); param names mirror the
         # unfused pair so checkpoints are interchangeable between paths.
-        # fused="int8" additionally stashes backward activations int8;
-        # fused="full" = int8 stash + Pallas backward kernels (the g
-        # stage recomputed in-register, no g tensor in HBM).
         return layer.img_conv_bn(
             input, filter_size=filter_size, num_filters=ch_out,
             num_channels=ch_in, stride=stride, padding=padding,
             act=active_type, name=f"{name}_fused" if name else None,
             conv_name=f"{name}_conv" if name else None,
             bn_name=f"{name}_bn" if name else None,
-            save8=(fused in ("int8", "full")),
-            fused_bwd=(fused == "full"))
+            save8=(fused == "int8"))
     tmp = layer.img_conv(input, filter_size=filter_size, num_filters=ch_out,
                          num_channels=ch_in, stride=stride, padding=padding,
                          act=None, bias_attr=False,
@@ -116,9 +119,9 @@ def resnet_imagenet(input, depth=50, class_num=1000, img_size=224,
     stem_space_to_depth: compute the 7x7/s2 stem as a stride-1 conv over
     space-to-depth input (numerically identical; lane-utilisation lever,
     see layer.space_to_depth_conv).
-    fused_bn: streaming-BN convs — the conv kernel emits batch stats from
-    its epilogue (ops/pallas/conv_bn.py), cutting one full activation
-    read per BN'd conv (the stem keeps the unfused path). fused_bn="q8"
+    fused_bn: single-op conv→BN blocks (ops/conv_bn.py: stats ride the
+    conv's fusion group, closed-form BN VJP; "int8" adds the int8
+    backward stash; the stem keeps the unfused path). fused_bn="q8"
     instead runs the q8 pipeline (ops/q8.py): the whole residual trunk
     keeps activations in HBM as centered int8 with deferred BN/ReLU; the
     stem and head stay dense."""
@@ -157,20 +160,24 @@ def resnet_imagenet(input, depth=50, class_num=1000, img_size=224,
     return layer.fc(pool, class_num, act=activation.Softmax(), name="res_fc")
 
 
-def resnet_cifar10(input, depth=32, class_num=10, fused_bn=False):
+def resnet_cifar10(input, depth=32, class_num=10, fused_bn=False,
+                   width=16):
     """(reference: v1_api_demo/model_zoo resnet cifar variant).
     fused_bn: same recipe surface as resnet_imagenet (False / True /
-    "int8" / "full" / "q8" / "defer" / "q8sr"); the stem stays dense."""
+    "int8" / "q8" / "defer" / "q8sr"); the stem stays dense.
+    width: base channel count (stages run width/2·width/4·width;
+    width=64 gives the 64–256-channel ladder the q8 quality experiments
+    use to probe per-channel scale behavior at ImageNet-class widths)."""
     n = (depth - 2) // 6
-    conv1 = conv_bn_layer(input, 16, 3, 1, 1, activation.Relu(), ch_in=3,
-                          name="rc_conv1")
+    conv1 = conv_bn_layer(input, width, 3, 1, 1, activation.Relu(),
+                          ch_in=3, name="rc_conv1")
     tmp = conv1
     if _stash_for(fused_bn):
         stash, sr = _stash_for(fused_bn)
         tmp = layer.q8_entry(tmp, name="rc_q8_entry", stash=stash,
                              stochastic=sr)
-    ch_in = 16
-    for stage, ch_out in enumerate([16, 32, 64]):
+    ch_in = width
+    for stage, ch_out in enumerate([width, 2 * width, 4 * width]):
         for i in range(n):
             stride = 2 if (i == 0 and stage > 0) else 1
             tmp = basic_block(tmp, ch_in, ch_out, stride,
