@@ -52,6 +52,14 @@ class TransformerConfig:
                                        # every intermediate — the
                                        # long-context capacity lever
                                        # (ops/q8.q8_remat)
+    moe_experts: int = 0               # >0: the FFN is a top-k MoE over
+                                       # this many experts (parallel/moe)
+                                       # sharded on the ``expert`` axis;
+                                       # 0 = dense mlp
+    moe_top_k: int = 1                 # 1 = Switch; 2 = GShard top-2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01       # load-balance loss weight (added
+                                       # to lm_loss per layer)
 
     def __post_init__(self):
         if self.cp_mode not in ("ring", "alltoall"):
@@ -62,6 +70,19 @@ class TransformerConfig:
             raise ValueError(
                 f"remat must be 'none', 'bf16' or 'q8', got "
                 f"{self.remat!r}")
+        if self.moe_experts and self.remat != "none":
+            raise ValueError("moe_experts does not compose with layer "
+                             "remat yet (the MoE block's aux output "
+                             "changes the stash contract)")
+
+    def moe_cfg(self):
+        """The parallel/moe.MoEConfig this FFN runs under."""
+        from paddle_tpu.parallel import moe
+        return moe.MoEConfig(
+            d_model=self.d_model, d_ff=self.d_ff,
+            num_experts=self.moe_experts,
+            capacity_factor=self.moe_capacity_factor,
+            aux_loss_weight=self.moe_aux_weight, top_k=self.moe_top_k)
 
     @property
     def head_dim(self):
@@ -89,6 +110,20 @@ def init_params(key: jax.Array, cfg: TransformerConfig):
         return (jax.random.normal(kk, shape, jnp.float32) * scale).astype(
             jnp.float32)
 
+    if cfg.moe_experts:
+        E = cfg.moe_experts
+        ffn = {
+            "gate": nrm(k[4], (L, D, E), s),
+            "moe_w_in": nrm(k[5], (L, E, D, F), s),
+            "moe_w_out": nrm(k[6], (L, E, F, D), 1.0 / math.sqrt(F) /
+                             math.sqrt(2 * L)),
+        }
+    else:
+        ffn = {
+            "mlp_in": nrm(k[4], (L, D, F), s),
+            "mlp_out": nrm(k[5], (L, F, D), 1.0 / math.sqrt(F) /
+                           math.sqrt(2 * L)),
+        }
     return {
         "embed": nrm(k[0], (V, D), 1.0 / math.sqrt(D)),
         # rope computes positions analytically; keep a 1-row stub so the
@@ -102,9 +137,7 @@ def init_params(key: jax.Array, cfg: TransformerConfig):
             "attn_out": nrm(k[3], (L, D, D), s / math.sqrt(2 * L)),
             "ln2": jnp.ones((L, D), jnp.float32),
             "ln2_b": jnp.zeros((L, D), jnp.float32),
-            "mlp_in": nrm(k[4], (L, D, F), s),
-            "mlp_out": nrm(k[5], (L, F, D), 1.0 / math.sqrt(F) /
-                           math.sqrt(2 * L)),
+            **ffn,
         },
         "ln_f": jnp.ones((D,), jnp.float32),
         "ln_f_b": jnp.zeros((D,), jnp.float32),
@@ -113,12 +146,26 @@ def init_params(key: jax.Array, cfg: TransformerConfig):
 
 def param_shardings(cfg: TransformerConfig, mesh: Mesh):
     """TP layout (scaling-book): qkv/mlp_in column-parallel, attn_out/mlp_out
-    row-parallel over ``model``; embeddings vocab-sharded over ``model``."""
-    M = place.AXIS_MODEL
+    row-parallel over ``model``; embeddings vocab-sharded over ``model``;
+    MoE experts sharded over ``expert``. An axis the mesh doesn't carry
+    degrades to replication, so the same layout serves DP-only,
+    DPxTP and DPxEP meshes."""
+    M = place.AXIS_MODEL if place.AXIS_MODEL in mesh.axis_names else None
 
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
+    if cfg.moe_experts:
+        # FFN is expert-parallel instead of tensor-parallel: experts
+        # shard over the ``expert`` axis, gate replicated
+        E = (place.AXIS_EXPERT if place.AXIS_EXPERT in mesh.axis_names
+             else None)
+        ffn = {"gate": ns(),
+               "moe_w_in": ns(None, E, None, None),
+               "moe_w_out": ns(None, E, None, None)}
+    else:
+        ffn = {"mlp_in": ns(None, None, M),
+               "mlp_out": ns(None, M, None)}
     return {
         "embed": ns(M, None),
         "pos": ns(),
@@ -126,8 +173,7 @@ def param_shardings(cfg: TransformerConfig, mesh: Mesh):
             "ln1": ns(), "ln1_b": ns(), "ln2": ns(), "ln2_b": ns(),
             "qkv": ns(None, None, M),
             "attn_out": ns(None, M, None),
-            "mlp_in": ns(None, None, M),
-            "mlp_out": ns(None, M, None),
+            **ffn,
         },
         "ln_f": ns(), "ln_f_b": ns(),
     }
@@ -168,7 +214,7 @@ def _rope(x, tables):
 def forward(params, tokens: jax.Array, cfg: TransformerConfig, *,
             mesh: Optional[Mesh] = None,
             lengths: Optional[jax.Array] = None,
-            return_kv: bool = False,
+            return_kv: bool = False, return_aux: bool = False,
             dropout_key: Optional[jax.Array] = None):
     """tokens [B, T] int32 → logits [B, T, vocab] (float32).
 
@@ -182,13 +228,16 @@ def forward(params, tokens: jax.Array, cfg: TransformerConfig, *,
     ``dropout_key`` enables inverted dropout at rate ``cfg.dropout``
     (embedding + both residual branches per block); omit it — as eval
     and serving paths do — for deterministic inference.
+    ``return_aux=True`` additionally returns the summed MoE
+    load-balance loss (zero for dense configs) — lm_loss adds it.
     """
     return _forward_impl(params, tokens, cfg, mesh, lengths, return_kv,
-                         head="all", dropout_key=dropout_key)
+                         head="all", dropout_key=dropout_key,
+                         return_aux=return_aux)
 
 
 def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head,
-                  dropout_key=None):
+                  dropout_key=None, return_aux=False):
     B, T = tokens.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     if not 0.0 <= cfg.dropout < 1.0:
@@ -271,11 +320,20 @@ def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head,
                                 w["attn_out"].astype(attn.dtype)), k1)
         x = constrain(x)
         h2 = _layer_norm(x, w["ln2"], w["ln2_b"])
+        if cfg.moe_experts:
+            from paddle_tpu.parallel import moe
+            out, aux = moe.moe_ffn(
+                {"gate": w["gate"], "w_in": w["moe_w_in"],
+                 "w_out": w["moe_w_out"]},
+                h2.reshape(B * T, cfg.d_model), cfg.moe_cfg(), mesh=mesh)
+            x = x + drop(out.reshape(B, T, cfg.d_model).astype(x.dtype),
+                         k2)
+            return constrain(x), (kv, aux)
         ff = jnp.einsum("btd,df->btf", h2, w["mlp_in"].astype(h2.dtype))
         ff = jax.nn.gelu(ff)
         x = x + drop(jnp.einsum("btf,fd->btd", ff,
                                 w["mlp_out"].astype(ff.dtype)), k2)
-        return constrain(x), kv
+        return constrain(x), (kv, jnp.zeros((), jnp.float32))
 
     if cfg.remat != "none" and not return_kv:
         # layer-granular recompute: backward rebuilds each block from a
@@ -285,9 +343,12 @@ def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head,
         from paddle_tpu.ops import q8 as ops_q8
         inner = ops_q8.q8_remat(
             block, stash="int8" if cfg.remat == "q8" else "bf16")
-        x, kvs = jax.lax.scan(inner, x, (params["blocks"], layer_keys))
+        x, (kvs, auxs) = jax.lax.scan(inner, x,
+                                      (params["blocks"], layer_keys))
     else:
-        x, kvs = jax.lax.scan(block, x, (params["blocks"], layer_keys))
+        x, (kvs, auxs) = jax.lax.scan(block, x,
+                                      (params["blocks"], layer_keys))
+    aux_total = jnp.sum(auxs)
     if head == "last":
         # serving prefill: only the final position feeds the vocab head —
         # skips the O(T·vocab) logits tensor a full head would materialize
@@ -295,8 +356,12 @@ def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head,
     x = _layer_norm(x, params["ln_f"], params["ln_f_b"])
     logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
                         params["embed"].astype(jnp.float32))
+    if return_kv and return_aux:
+        return logits, kvs, aux_total
     if return_kv:
         return logits, kvs
+    if return_aux:
+        return logits, aux_total
     return logits
 
 
@@ -304,16 +369,18 @@ def lm_loss(params, tokens, targets, cfg: TransformerConfig, *,
             mesh: Optional[Mesh] = None,
             lengths: Optional[jax.Array] = None,
             dropout_key: Optional[jax.Array] = None) -> jax.Array:
-    """Mean next-token cross-entropy over valid positions."""
-    logits = forward(params, tokens, cfg, mesh=mesh, lengths=lengths,
-                     dropout_key=dropout_key)
+    """Mean next-token cross-entropy over valid positions (+ the MoE
+    load-balance aux loss for moe_experts configs)."""
+    logits, aux = forward(params, tokens, cfg, mesh=mesh, lengths=lengths,
+                          dropout_key=dropout_key, return_aux=True)
     tok_ce = ops_loss.softmax_cross_entropy(logits, targets)
     if lengths is not None:
         mask = (jnp.arange(tokens.shape[1])[None, :] <
                 lengths[:, None]).astype(jnp.float32)
     else:
         mask = jnp.ones_like(tok_ce)
-    return jnp.sum(tok_ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    ce = jnp.sum(tok_ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return ce + aux
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
@@ -382,8 +449,21 @@ def decode_step(params, cache, tokens: jax.Array, pos: jax.Array,
         attn = attn.reshape(B, cfg.d_model).astype(cfg.dtype)
         x = x + attn @ w["attn_out"].astype(attn.dtype)
         h2 = _layer_norm(x, w["ln2"], w["ln2_b"])
-        ff = jax.nn.gelu(h2 @ w["mlp_in"].astype(h2.dtype))
-        x = x + ff @ w["mlp_out"].astype(ff.dtype)
+        if cfg.moe_experts:
+            import dataclasses as _dc
+
+            from paddle_tpu.parallel import moe
+            # decode capacity = full batch (cf = E/k): inference must
+            # not drop tokens the way Switch training capacity does
+            mc = _dc.replace(cfg.moe_cfg(), capacity_factor=float(
+                cfg.moe_experts) / cfg.moe_top_k)
+            out, _ = moe.moe_ffn(
+                {"gate": w["gate"], "w_in": w["moe_w_in"],
+                 "w_out": w["moe_w_out"]}, h2, mc)
+            x = x + out.astype(x.dtype)
+        else:
+            ff = jax.nn.gelu(h2 @ w["mlp_in"].astype(h2.dtype))
+            x = x + ff @ w["mlp_out"].astype(ff.dtype)
         return x, (kc, vc)
 
     x, (kn, vn) = jax.lax.scan(block, x,

@@ -287,6 +287,103 @@ class TestGQAEngines:
                                        err_msg=f"d{name}")
 
 
+class TestMoETransformer:
+    """moe_experts>0: the FFN is an expert-parallel top-k MoE
+    (parallel/moe.moe_ffn) with the load-balance aux loss threaded into
+    lm_loss; the dense path keeps its exact behavior."""
+
+    MOE_CFG = transformer.TransformerConfig(
+        vocab=50, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_len=32,
+        dtype=jnp.float32, moe_experts=4, moe_capacity_factor=4.0)
+
+    def test_single_expert_matches_dense(self, rng):
+        """E=1 with weights copied from the dense mlp must reproduce the
+        dense forward exactly (gate softmax over one expert = 1)."""
+        cfg1 = dataclasses.replace(self.MOE_CFG, moe_experts=1,
+                                   moe_capacity_factor=64.0)
+        dense = transformer.init_params(jax.random.PRNGKey(0), CFG)
+        p1 = transformer.init_params(jax.random.PRNGKey(0), cfg1)
+        p1["embed"] = dense["embed"]
+        p1["pos"] = dense["pos"]
+        p1["ln_f"], p1["ln_f_b"] = dense["ln_f"], dense["ln_f_b"]
+        for k in ("ln1", "ln1_b", "qkv", "attn_out", "ln2", "ln2_b"):
+            p1["blocks"][k] = dense["blocks"][k]
+        p1["blocks"]["moe_w_in"] = dense["blocks"]["mlp_in"][:, None]
+        p1["blocks"]["moe_w_out"] = dense["blocks"]["mlp_out"][:, None]
+        toks = jnp.asarray(rng.randint(0, 50, (2, 16)).astype(np.int32))
+        a = transformer.forward(dense, toks, CFG)
+        b = transformer.forward(p1, toks, cfg1)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_moe_lm_learns_with_aux(self, rng):
+        cfg = self.MOE_CFG
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        logits, aux = transformer.forward(
+            params, jnp.zeros((2, 8), jnp.int32), cfg, return_aux=True)
+        assert float(aux) > 0       # balance loss present
+        B, T = 8, 16
+        start = rng.randint(0, 50, (B, 1))
+        toks = (start + np.arange(T)[None, :]) % 50
+        tgt = (toks + 1) % 50
+        toks = jnp.asarray(toks, jnp.int32)
+        tgt = jnp.asarray(tgt, jnp.int32)
+        step = jax.jit(jax.value_and_grad(
+            lambda p: transformer.lm_loss(p, toks, tgt, cfg)))
+        vals, hist = params, []
+        for _ in range(30):
+            l, g = step(vals)
+            vals = jax.tree_util.tree_map(lambda p, gr: p - 0.1 * gr,
+                                          vals, g)
+            hist.append(float(l))
+        assert hist[-1] < hist[0] * 0.6, (hist[0], hist[-1])
+
+    def test_ep_sharded_train_step(self, rng):
+        """Experts sharded over the expert axis: param_shardings apply
+        and the jitted train step runs under GSPMD."""
+        mesh = place.make_mesh((2, 4),
+                               (place.AXIS_DATA, place.AXIS_EXPERT))
+        cfg = self.MOE_CFG
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        sh = transformer.param_shardings(cfg, mesh)
+        params = jax.tree_util.tree_map(jax.device_put, params, sh)
+        toks = jnp.asarray(rng.randint(0, 50, (4, 16)).astype(np.int32))
+        tgt = jnp.asarray(rng.randint(0, 50, (4, 16)).astype(np.int32))
+
+        @jax.jit
+        def step(p):
+            return jax.value_and_grad(
+                lambda p_: transformer.lm_loss(p_, toks, tgt, cfg,
+                                               mesh=mesh))(p)
+
+        l, g = step(params)
+        assert np.isfinite(float(l))
+        chex = jax.tree_util.tree_structure(g)
+        assert chex == jax.tree_util.tree_structure(params)
+
+    def test_moe_decode_matches_forward(self, rng):
+        """KV-cache decode with the MoE FFN reproduces the full forward
+        (decode capacity = batch, so no token drops at inference)."""
+        cfg = dataclasses.replace(self.MOE_CFG, d_model=16, n_heads=2,
+                                  d_ff=32, max_len=24)
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        B, T = 2, 8
+        toks = jnp.asarray(rng.randint(0, 50, (B, T)).astype(np.int32))
+        want = transformer.forward(params, toks, cfg)
+        cache = transformer.init_cache(cfg, B, 16)
+        for t in range(T):
+            logits, cache = transformer.decode_step(
+                params, cache, toks[:, t], jnp.asarray(t, jnp.int32),
+                cfg)
+            np.testing.assert_allclose(
+                np.asarray(logits), np.asarray(want[:, t]), rtol=2e-4,
+                atol=2e-4)
+
+    def test_moe_rejects_remat(self):
+        with pytest.raises(ValueError, match="remat"):
+            dataclasses.replace(self.MOE_CFG, remat="q8")
+
+
 class TestGenerate:
     CFG = transformer.TransformerConfig(
         vocab=50, d_model=16, n_layers=2, n_heads=2, d_ff=32, max_len=24,
