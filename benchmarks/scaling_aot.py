@@ -41,7 +41,7 @@ sys.path.insert(0, REPO)
 import numpy as np
 
 
-def build_step(batch_per_chip, n_chips, mesh):
+def build_step(batch_per_chip, n_chips, mesh, batch_axes=("data",)):
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -80,7 +80,7 @@ def build_step(batch_per_chip, n_chips, mesh):
         return loss, np_, no_, ns
 
     rep = NamedSharding(mesh, P())
-    dat = NamedSharding(mesh, P("data"))
+    dat = NamedSharding(mesh, P(batch_axes))
     gb = batch_per_chip * n_chips
     abstract = (values_sds, opt_sds, state_sds,
                 jax.ShapeDtypeStruct((gb, 224, 224, 3), jnp.float32),
@@ -135,9 +135,19 @@ def analyze_schedule(txt: str):
     compute_lines = []
     op_re = re.compile(
         r"\s*%([\w.\-]+)\s*=\s*(.*?)\b"
-        r"(all-reduce-start|all-reduce-done|all-reduce|"
-        r"fusion|convolution|custom-call)\(")
+        r"(all-reduce-start|all-reduce-done|all-reduce|reduce-scatter|"
+        r"all-gather|fusion|convolution|custom-call)\(")
+    megascale_send_bytes = 0
+    megascale_sends = 0
     for i, ln in enumerate(lines):
+        # multi-slice modules express the cross-slice (DCN) phase of the
+        # hierarchical all-reduce as megascale-annotated send/recv host
+        # transfers, not HLO collectives — count the send payloads
+        if "megascale_transfer_type" in ln and re.match(r"\s*%send", ln):
+            sig_m = re.match(r"\s*%[\w.\-]+ = (.*?)\bsend\(", ln)
+            if sig_m:
+                megascale_send_bytes += _shape_bytes(sig_m.group(1))
+                megascale_sends += 1
         m = op_re.match(ln)
         if not m:
             continue
@@ -150,8 +160,8 @@ def analyze_schedule(txt: str):
         elif kind == "all-reduce-done":
             dep = re.search(r"all-reduce-done\(.*?%?([\w.\-]+)\)", ln)
             events.append((i, "done", dep.group(1) if dep else name, 0))
-        elif kind == "all-reduce":
-            events.append((i, "sync", name, _shape_bytes(sig)))
+        elif kind in ("all-reduce", "reduce-scatter", "all-gather"):
+            events.append((i, kind, name, _shape_bytes(sig)))
         else:
             compute_lines.append((i, kind, ln))
     windows = []
@@ -168,19 +178,43 @@ def analyze_schedule(txt: str):
                                 "conv_ops_inside": sum(
                                     1 for c in inside
                                     if c[1] == "convolution")})
-    # placement analysis for sync all-reduces in the scheduled stream
+    # placement analysis for sync collectives in the scheduled stream
     comp_idx = [i for (i, _, _) in compute_lines]
     n_lines = max(1, len(lines))
     sync = []
     for (i, k, name, b) in events:
-        if k != "sync":
+        if k not in ("all-reduce", "reduce-scatter", "all-gather"):
             continue
         after = sum(1 for j in comp_idx if j > i)
-        sync.append({"name": name, "bytes": b,
+        group = _parse_group(lines[i])
+        sync.append({"name": name, "op": k, "bytes": b,
                      "pos_frac": round(i / n_lines, 4),
-                     "compute_ops_after": after})
+                     "compute_ops_after": after,
+                     "group_size": len(group) if group else None,
+                     "group_example": group[:16] if group else None})
     return {"async_windows": windows, "sync_all_reduces": sync,
-            "total_compute_ops": len(compute_lines)}
+            "total_compute_ops": len(compute_lines),
+            "megascale_sends": megascale_sends,
+            "megascale_send_bytes": megascale_send_bytes}
+
+
+def _parse_group(ln):
+    """First replica group of a collective line as a device-id list.
+    Two HLO formats: iota `replica_groups=[G,S]<=[N]` (G groups of S,
+    group 0 = 0..S-1 in iota order) and explicit
+    `replica_groups={{0,8},{1,9},...}`."""
+    m = re.search(r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\]"
+                  r"(T\([\d,]+\))?", ln)
+    if m:
+        g, s = int(m.group(1)), int(m.group(2))
+        if m.group(4):
+            # transposed iota: group 0's members stride by G
+            return [i * g for i in range(s)]
+        return list(range(s))
+    m = re.search(r"replica_groups=\{\{([\d,]+)\}", ln)
+    if m:
+        return [int(d) for d in m.group(1).split(",")]
+    return None
 
 
 def main():
@@ -194,7 +228,13 @@ def main():
     ap.add_argument("--ici-gbps", type=float, default=45.0,
                     help="per-link ICI bandwidth GB/s each direction "
                     "(v5e: 45 GB/s per link)")
+    ap.add_argument("--dcn-gbps", type=float, default=12.5,
+                    help="per-host DCN bandwidth GB/s (conservative "
+                    "100 Gbps NIC default) for slice-crossing groups")
     ap.add_argument("--out", default=None)
+    ap.add_argument("--num-slices", type=int, default=1,
+                    help="multi-slice pod: DP spans a hybrid dcn x data "
+                    "mesh; the gradient all-reduce crosses DCN")
     ap.add_argument("--hlo-file", default=None,
                     help="analyze a previously dumped scheduled-HLO text "
                     "instead of recompiling (the deviceless XLA:TPU "
@@ -205,8 +245,9 @@ def main():
     args = ap.parse_args()
 
     if args.hlo_file:
-        n = 8 if "2x4" in args.topology else None
+        n = (8 if "2x4" in args.topology else None)
         assert n, "--hlo-file analysis needs a 2x4-style topology name"
+        n *= args.num_slices
         with open(args.hlo_file) as f:
             txt = f.read()
         print(f"analyzing saved HLO {args.hlo_file} "
@@ -216,14 +257,27 @@ def main():
         from jax.experimental import topologies
         from jax.sharding import Mesh
 
+        kw = {"num_slices": args.num_slices} if args.num_slices > 1 else {}
         topo = topologies.get_topology_desc(platform="tpu",
-                                            topology_name=args.topology)
+                                            topology_name=args.topology,
+                                            **kw)
         n = len(topo.devices)
-        mesh = Mesh(np.array(topo.devices).reshape(n), ("data",))
-        print(f"topology {args.topology}: {n} devices; "
-              f"DP train step, per-chip batch {args.batch_per_chip}")
+        if args.num_slices > 1:
+            # hybrid mesh: slice-crossing axis (DCN) outermost, ICI DP
+            # inner — the distributed.hybrid_mesh layout; the batch
+            # shards over BOTH axes (pure DP across the pod)
+            mesh = Mesh(np.array(topo.devices).reshape(
+                args.num_slices, n // args.num_slices), ("dcn", "data"))
+            batch_axes = ("dcn", "data")
+        else:
+            mesh = Mesh(np.array(topo.devices).reshape(n), ("data",))
+            batch_axes = ("data",)
+        print(f"topology {args.topology} x{args.num_slices} slices: {n} "
+              f"devices; DP train step, per-chip batch "
+              f"{args.batch_per_chip}")
 
-        jf, abstract = build_step(args.batch_per_chip, n, mesh)
+        jf, abstract = build_step(args.batch_per_chip, n, mesh,
+                                  batch_axes=batch_axes)
         lowered = jf.lower(*abstract)
         compiled = lowered.compile()
         txt = compiled.as_text()
@@ -232,22 +286,48 @@ def main():
                 f.write(txt)
     sched = analyze_schedule(txt)
 
-    grad_bytes = sum(w["bytes"] for w in sched["async_windows"]) + \
-        sum(s["bytes"] for s in sched["sync_all_reduces"])
     n_async = len(sched["async_windows"])
     overlapped = [w for w in sched["async_windows"]
                   if w["compute_ops_inside"] > 0]
     ops_inside = sum(w["compute_ops_inside"] for w in sched["async_windows"])
+    n_per_slice = n // max(1, args.num_slices)
 
-    # ring all-reduce on the data axis: 2(N-1)/N * B bytes over the slowest
-    # link; v5e 2x4 mesh rings have full ICI links
-    def ring_ms(nbytes):
-        return 2 * (n - 1) / n * nbytes / (args.ici_gbps * 1e9) * 1e3
+    def wire_ms(c):
+        """Ring-model wire time of one collective, over the link class
+        its replica group actually rides (a group crossing a slice
+        boundary goes over DCN). Result-shape bytes B:
+        all-reduce 2(g-1)/g·B; all-gather (g-1)/g·B;
+        reduce-scatter (g-1)·B (the result is the 1/g shard)."""
+        group = c.get("group_example") or list(range(n))
+        g = c.get("group_size") or n
+        dcn = len({d // n_per_slice for d in group}) > 1
+        bw = (args.dcn_gbps if dcn else args.ici_gbps) * 1e9
+        b = c["bytes"]
+        factor = {"all-reduce": 2 * (g - 1) / g,
+                  "all-gather": (g - 1) / g,
+                  "reduce-scatter": float(g - 1)}[c.get("op",
+                                                        "all-reduce")]
+        return factor * b / bw * 1e3, dcn
 
-    t_comm_ms = ring_ms(grad_bytes)
+    grad_bytes = sum(w["bytes"] for w in sched["async_windows"]) + \
+        sum(s["bytes"] for s in sched["sync_all_reduces"])
+    t_comm_ms, t_dcn_ms = 0.0, 0.0
+    for s_ in sched["sync_all_reduces"]:
+        t, dcn = wire_ms(s_)
+        t_comm_ms += t
+        t_dcn_ms += t if dcn else 0.0
+    # megascale DCN phase (multi-slice): the send payloads, one-way
+    ms_bytes = sched.get("megascale_send_bytes", 0)
+    if ms_bytes:
+        t = ms_bytes / (args.dcn_gbps * 1e9) * 1e3
+        t_comm_ms += t
+        t_dcn_ms += t
+    for w in sched["async_windows"]:
+        t_comm_ms += 2 * (n - 1) / n * w["bytes"] / (args.ici_gbps
+                                                     * 1e9) * 1e3
     step_ms = args.single_chip_ms
-    # pessimistic bound: every gradient all-reduce fully serializes after
-    # the compute (zero overlap)
+    # pessimistic bound: every collective fully serializes after the
+    # compute (zero overlap)
     eff_no_overlap = step_ms / (step_ms + t_comm_ms)
     # optimistic bound: communication fully hidden behind compute
     eff_full_overlap = step_ms / max(step_ms, t_comm_ms)
@@ -260,34 +340,51 @@ def main():
         ms_per_op = step_ms / total_ops
         t_exposed = 0.0
         for w in sched["async_windows"]:
-            t_cover = w["compute_ops_inside"] * ms_per_op
-            t_exposed += max(0.0, ring_ms(w["bytes"]) - t_cover)
+            t_wire = 2 * (n - 1) / n * w["bytes"] / (args.ici_gbps
+                                                     * 1e9) * 1e3
+            t_exposed += max(0.0, t_wire - w["compute_ops_inside"]
+                             * ms_per_op)
         for s_ in sched["sync_all_reduces"]:
-            t_exposed += ring_ms(s_["bytes"])
+            t_exposed += wire_ms(s_)[0]
         hidden_frac = 1.0 - t_exposed / t_comm_ms if t_comm_ms else 0.0
         eff_sched = step_ms / (step_ms + t_exposed)
     else:
         # sync-op schedule (this XLA build): placement evidence. A
-        # gradient all-reduce with compute scheduled AFTER it in the
-        # instruction stream is overlappable by the runtime (the ICI
-        # transfer proceeds while later fusions run); bytes whose
-        # all-reduce sits at the schedule tail cannot overlap anything.
-        overlappable = sum(s["bytes"] for s in sched["sync_all_reduces"]
-                           if s["compute_ops_after"] >= 2)
+        # collective with compute scheduled AFTER it in the instruction
+        # stream is overlappable by the runtime (the transfer proceeds
+        # while later fusions run); bytes at the schedule tail cannot
+        # overlap anything.
+        t_exposed = sum(wire_ms(s_)[0]
+                        for s_ in sched["sync_all_reduces"]
+                        if s_["compute_ops_after"] < 2)
+        # megascale DCN sends: overlap unknown from the text — charge
+        # them as fully exposed (conservative)
+        if ms_bytes:
+            t_exposed += ms_bytes / (args.dcn_gbps * 1e9) * 1e3
+        overlappable = sum(s_["bytes"]
+                           for s_ in sched["sync_all_reduces"]
+                           if s_["compute_ops_after"] >= 2)
         hidden_frac = overlappable / grad_bytes if grad_bytes else 0.0
-        t_exposed = ring_ms(grad_bytes - overlappable)
         eff_sched = step_ms / (step_ms + t_exposed)
 
     result = {
-        "topology": args.topology, "n_chips": n,
+        "topology": args.topology, "num_slices": args.num_slices,
+        "n_chips": n,
         "batch_per_chip": args.batch_per_chip,
         "global_batch": args.batch_per_chip * n,
         "async_all_reduces": n_async,
         "async_with_compute_inside": len(overlapped),
         "compute_ops_inside_windows": ops_inside,
-        "sync_all_reduces": len(sched["sync_all_reduces"]),
-        "grad_allreduce_bytes": grad_bytes,
-        "ring_time_ms_at_ici": round(t_comm_ms, 3),
+        "sync_collectives": len(sched["sync_all_reduces"]),
+        "collective_op_counts": {
+            op: sum(1 for s_ in sched["sync_all_reduces"]
+                    if s_.get("op") == op)
+            for op in ("all-reduce", "reduce-scatter", "all-gather")},
+        "grad_collective_bytes": grad_bytes,
+        "megascale_dcn_sends": sched.get("megascale_sends", 0),
+        "megascale_dcn_bytes": ms_bytes,
+        "wire_time_ms": round(t_comm_ms, 3),
+        "wire_time_dcn_ms": round(t_dcn_ms, 3),
         "single_chip_step_ms": step_ms,
         "overlappable_bytes_fraction": round(hidden_frac, 4),
         "dp_efficiency_no_overlap": round(eff_no_overlap, 4),
@@ -296,9 +393,10 @@ def main():
         "total_compute_ops": sched["total_compute_ops"],
     }
     print(json.dumps(result, indent=2))
+    slug = args.topology.replace(":", "_") + (
+        f"_x{args.num_slices}" if args.num_slices > 1 else "")
     out = args.out or os.path.join(
-        REPO, "benchmarks", "runs", "scaling_aot_" +
-        args.topology.replace(":", "_") + ".json")
+        REPO, "benchmarks", "runs", f"scaling_aot_{slug}.json")
     sync_tail = sorted(sched["sync_all_reduces"],
                        key=lambda s: -s["bytes"])[:40]
     with open(out, "w") as f:
