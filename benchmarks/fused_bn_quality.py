@@ -2,13 +2,14 @@
 """Training-quality check for every fused conv+BN recipe.
 
 Trains the SAME small ResNet (identical init, identical data order)
-under fused_bn modes False / True / "int8" / "full" / "q8" / "defer" /
-"q8sr" and reports per-mode final train loss and held-out accuracy.
+under fused_bn modes False / True / "int8" / "q8" / "defer" / "q8sr"
+and reports per-mode final train loss and held-out accuracy.
 Parity is ASSERTED for every mode except deterministic "q8", whose
 straight-through stash noise produces a real held-out gap at horizon
 (reported, not asserted — BENCHMARKS.md "Convergence at horizon");
 "q8sr" (unbiased stochastic rounding) restores parity and IS asserted.
-Run on CPU (kernels in force-interpret mode) or TPU.
+("full" was retired with the Pallas conv kernels in round 5.)
+Runs on CPU or TPU — every mode is XLA-level.
 
 Run: python benchmarks/fused_bn_quality.py [--steps 60]
 """
@@ -36,12 +37,8 @@ def main():
     import paddle_tpu as paddle
     from paddle_tpu import layer
     from paddle_tpu.models import resnet
-    from paddle_tpu.ops.pallas import conv_bn as fused_mod
     from paddle_tpu.topology import Topology, Value
     from paddle_tpu.utils.rng import KeySource
-
-    if jax.devices()[0].platform != "tpu":
-        fused_mod.FORCE_INTERPRET = True   # drive the kernels on CPU
 
     rng = np.random.RandomState(0)
     # synthetic separable 4-class task over 3x16x16 images
@@ -59,7 +56,7 @@ def main():
     xt, yt = make(n_test, 2)
 
     results = {}
-    for mode in (False, True, "int8", "full", "q8", "defer", "q8sr"):
+    for mode in (False, True, "int8", "q8", "defer", "q8sr"):
         x = layer.data("img", paddle.data_type.dense_vector(3 * 16 * 16))
         lbl = layer.data("lbl", paddle.data_type.integer_value(4))
         # the q8 pipeline needs a dense stem before its entry stash (the

@@ -38,6 +38,10 @@ def main():
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--eval-every", type=int, default=200)
     ap.add_argument("--modes", default="0,defer,q8sr,q8")
+    ap.add_argument("--noise", type=float, default=3.0,
+                    help="sample noise sigma; must be large enough that "
+                    "the width-64 net does NOT saturate held-out "
+                    "accuracy, or arm differences become invisible")
     ap.add_argument("--platform", default=None)
     args = ap.parse_args()
 
@@ -73,7 +77,8 @@ def main():
     def make(n, seed):
         r = np.random.RandomState(seed)
         ys = r.randint(0, 10, n)
-        xs = (protos[ys] + r.randn(n, dim).astype(np.float32) * 0.9)
+        xs = (protos[ys]
+              + r.randn(n, dim).astype(np.float32) * args.noise)
         return xs.astype(np.float32), ys.astype(np.int32)
 
     xs, ys = make(n_train, 1)
@@ -146,6 +151,7 @@ def main():
     out = {
         "config": {"width": args.width, "depth": args.depth,
                    "batch": args.batch, "steps": args.steps,
+                   "noise": args.noise,
                    "channel_ladder": [args.width, 2 * args.width,
                                       4 * args.width],
                    "task": "synthetic 10-class CIFAR-shaped"},

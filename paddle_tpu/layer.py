@@ -421,17 +421,16 @@ def img_conv_bn(input, filter_size, num_filters: int,
                 param_attr=None, bn_param_attr=None, bn_bias_attr=None,
                 moving_average_fraction=0.9, epsilon=1e-5, img_size=None,
                 conv_name: Optional[str] = None,
-                bn_name: Optional[str] = None, save8: bool = False,
-                fused_bwd: bool = False):
-    """Fused conv→batch-norm block (streaming-BN: the Pallas conv kernel
-    emits the batch statistics from its own epilogue, removing the
-    stats-reduce pass over the activation — ops/pallas/conv_bn.py; the
-    capability slot of the reference's CudnnBatchNormLayer fused with
-    ExpandConvLayer). Falls back to XLA conv + jnp stats off-TPU or on
-    unsupported shapes, so numerics are identical everywhere. No conv
-    bias (BN's beta subsumes it — the reference's conv_bn_layer does the
-    same, benchmark/paddle/image/resnet.py:13)."""
-    from paddle_tpu.ops.pallas import conv_bn as ops_fused
+                bn_name: Optional[str] = None, save8: bool = False):
+    """Fused conv→batch-norm block (ops/conv_bn.py: the stats reductions
+    ride the conv's fusion group, normalize is a per-channel affine, and
+    the backward is the closed-form two-pass BN VJP — the capability
+    slot of the reference's CudnnBatchNormLayer fused with
+    ExpandConvLayer). ``save8`` stashes the backward's saved activations
+    as per-channel int8. No conv bias (BN's beta subsumes it — the
+    reference's conv_bn_layer does the same,
+    benchmark/paddle/image/resnet.py:13)."""
+    from paddle_tpu.ops import conv_bn as ops_fused
 
     name = name or auto_name("img_conv_bn")
     # conv_name / bn_name control PARAMETER naming so a fused layer can
@@ -477,7 +476,7 @@ def img_conv_bn(input, filter_size, num_filters: int,
                 x, params[wspec.name], params[gamma.name],
                 params[beta.name], rm, rv, stride=stride, padding=padding,
                 momentum=moving_average_fraction, eps=epsilon,
-                save8=save8, fused_bwd=fused_bwd)
+                save8=save8)
             ctx.state_out[mean_s.name] = nm
             ctx.state_out[var_s.name] = nv
         else:

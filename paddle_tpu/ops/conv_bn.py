@@ -1,0 +1,177 @@
+"""Fused conv + batch-norm training op — XLA-level composition.
+
+Capability slot of the reference's fused CudnnBatchNormLayer
+(paddle/gserver/layers/CudnnBatchNormLayer.cpp) and its hand-fused conv
+epilogues (paddle/cuda/src/hl_cuda_cnn.cu): one op produces the conv
+output AND consumes its batch statistics, with a closed-form two-pass
+batch-norm VJP and XLA's own conv VJP for the convolution backward.
+
+Everything here is expressed at the XLA level on purpose. Round 3 built
+Pallas streaming-stats conv kernels (1x1-as-GEMM and 3x3-as-shifted-GEMM
+with in-register Σ/Σ² epilogues, plus fused backward kernels); the
+round-4 on-chip A/B measured them at 0.43-0.59x of this plain-XLA
+composition (1490.8/1264.7/1093.1 vs 2543.6 img/s on ResNet-50,
+benchmarks/runs/2026-07-31_0136_*). The trace showed why: an opaque
+custom-call blocks XLA's free epilogue fusions on both neighbours, and
+the NHWC→[M,C] reshapes cost copies (190 vs 710 GB/s effective kernel
+bandwidth). The kernels were deleted in round 5; the winning levers that
+absorb MORE of the layer at the XLA level live in ops/q8.py (the
+defer/q8/q8sr stash recipes). This module keeps the XLA-level wins:
+
+- single fused forward: XLA fuses the Σ/Σ² reductions into the conv
+  consumer chain and the normalize is a per-channel affine;
+- closed-form BN backward (no autodiff through the stats), two passes;
+- ``save8``: backward's saved activations (x, centered y) stashed as
+  per-channel int8 — halves their backward read traffic and residual
+  memory for ~0.4% stash rounding noise (forward values untouched).
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def conv_bn_stats(x, w, *, stride=1, padding="SAME"):
+    """(conv(x, w), Σy, Σy²) — sums per output channel over N·H·W.
+
+    The reductions sit right after the conv in one XLA fusion group; no
+    separate stats pass over the activation survives optimization."""
+    from paddle_tpu.core import dtypes
+    from paddle_tpu.ops import conv as ops_conv
+
+    # honor the global MXU compute-dtype policy exactly like
+    # ops_conv.conv2d does — fused and unfused paths must emit the SAME
+    # dtype or the custom-VJP cotangents mismatch downstream
+    cdt = dtypes.compute_dtype()
+    y = ops_conv.conv2d(x.astype(cdt), w.astype(cdt), stride=stride,
+                        padding=padding)
+    yf = y.astype(jnp.float32)
+    axes = tuple(range(y.ndim - 1))
+    return y, jnp.sum(yf, axis=axes), jnp.sum(yf * yf, axis=axes)
+
+
+def _quant8(t):
+    """Per-channel symmetric int8 quantization of a saved activation:
+    halves the backward's read traffic for that residual (bf16 2B →
+    int8 1B) at the cost of an extra int8 write in forward — net ~0.5
+    byte/element saved, plus halved residual memory. ~0.4% relative
+    rounding noise on the stashed tensor (127 levels), applied only to
+    backward READS of saved activations, never the forward values."""
+    tf = t.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(tf), axis=tuple(range(t.ndim - 1)))
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(tf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _dequant8(q, scale, dtype):
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _conv_bn(x, w, gamma, beta, stride, padding, eps, save8):
+    return _conv_bn_fwd(x, w, gamma, beta, stride, padding, eps, save8)[0]
+
+
+def _conv_bn_fwd(x, w, gamma, beta, stride, padding, eps, save8):
+    y, s1, s2 = conv_bn_stats(x, w, stride=stride, padding=padding)
+    count = y.size // y.shape[-1]
+    mean = s1 / count
+    var = jnp.maximum(s2 / count - jnp.square(mean), 0.0)
+    inv = lax.rsqrt(var + eps)
+    g32 = gamma.astype(jnp.float32)
+    scale = (g32 * inv).astype(y.dtype)
+    shift = (beta.astype(jnp.float32) - mean * g32 * inv).astype(y.dtype)
+    out = y * scale + shift
+    if save8:
+        # x: zero-size dtype token — residual pytrees may hold only JAX
+        # values, and bwd must rebuild x in its ORIGINAL dtype so the
+        # returned cotangent matches the primal.
+        stash_x = (_quant8(x), jnp.zeros((0,), x.dtype))
+        # y: quantize the CENTERED conv output (y - mean), not raw y —
+        # the backward only ever consumes ŷ = (y - mean)·inv, and for a
+        # channel whose |mean| dwarfs its std (exactly what BN fixes)
+        # raw-y quantization noise amplified by inv would corrupt dγ/dx;
+        # centering bounds the stash noise at ~range/254 in ŷ units
+        # regardless of channel statistics.
+        stash_y = _quant8(y.astype(jnp.float32) - mean)
+    else:
+        stash_x = stash_y = None
+    # mean/var feed running stats only — gradient-stopped by construction
+    # (the VJP ignores their cotangents)
+    return ((out, lax.stop_gradient(mean), lax.stop_gradient(var)),
+            (None if save8 else x, None if save8 else y, stash_x, stash_y,
+             w, mean, inv, gamma))
+
+
+def _conv_bn_bwd(stride, padding, eps, save8, res, cts):
+    from paddle_tpu.ops import conv as ops_conv
+
+    x, y, stash_x, stash_y, w, mean, inv, gamma = res
+    if save8:
+        (qx, sx), xtok = stash_x
+        qz, sz = stash_y
+        # the f32 view fuses into the reductions below (no materialized
+        # dequant copy)
+        centered = qz.astype(jnp.float32) * sz     # = y - mean (stashed)
+        x_full = _dequant8(qx, sx, xtok.dtype)
+        x_dt = xtok.dtype
+    else:
+        centered = y.astype(jnp.float32) - mean
+        x_full = x
+        x_dt = x.dtype
+    dout = cts[0].astype(jnp.float32)
+    n = centered.size // centered.shape[-1]
+    axes = tuple(range(centered.ndim - 1))
+    # the cotangent w.r.t. the conv output is EXACTLY the batch-norm dx
+    # identity (ops/norm.py _bn_apply_bwd with x := y): two passes —
+    # one fused reduction (Σdy, Σdy·ŷ) and the elementwise g stage
+    sum_dy = jnp.sum(dout, axis=axes)
+    yhat = centered * inv
+    sum_dy_yhat = jnp.sum(dout * yhat, axis=axes)
+    sc = gamma.astype(jnp.float32) * inv / n
+    g = (sc * (n * dout - sum_dy - yhat * sum_dy_yhat)).astype(
+        cts[0].dtype)
+    # delegate the conv backward to XLA's conv VJP (its MXU conv
+    # backward is already optimal — the fused win is forward-traffic)
+    _, conv_vjp = jax.vjp(
+        lambda x_, w_: ops_conv.conv2d(x_, w_, stride=stride,
+                                       padding=padding), x_full, w)
+    dx, dw = conv_vjp(g)
+    return (dx.astype(x_dt), dw.astype(w.dtype),
+            sum_dy_yhat.astype(gamma.dtype), sum_dy.astype(gamma.dtype))
+
+
+_conv_bn.defvjp(_conv_bn_fwd, _conv_bn_bwd)
+
+
+def conv_bn_train(x, w, gamma, beta, running_mean, running_var, *,
+                  stride=1, padding="SAME", momentum=0.9, eps=1e-5,
+                  save8: bool = False
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused conv→BN training step: the conv output's batch statistics
+    are consumed in the same fusion group, the normalize is a
+    per-channel affine, and the backward is the closed-form two-pass BN
+    VJP + XLA's conv VJP. ``save8`` stashes the backward's saved
+    activations (x, centered y) as per-channel int8.
+    Returns (out, new_running_mean, new_running_var)."""
+    out, mean, var = _conv_bn(x, w, gamma, beta, stride, padding, eps,
+                              save8)
+    new_mean = momentum * running_mean + (1 - momentum) * mean
+    new_var = momentum * running_var + (1 - momentum) * var
+    return (out, new_mean.astype(running_mean.dtype),
+            new_var.astype(running_var.dtype))
+
+
+def conv_bn_infer(x, w, gamma, beta, running_mean, running_var, *,
+                  stride=1, padding="SAME", eps=1e-5):
+    """Inference path: plain conv + folded-affine BN (no stats needed)."""
+    from paddle_tpu.ops import conv as ops_conv
+    from paddle_tpu.ops import norm as ops_norm
+
+    y = ops_conv.conv2d(x, w, stride=stride, padding=padding)
+    return ops_norm.batch_norm_infer(y, gamma, beta, running_mean,
+                                     running_var, eps=eps)

@@ -30,7 +30,7 @@ def test_op_dtype_contracts(rng, bf16_policy):
     the fused conv+BN path must match conv2d exactly."""
     from paddle_tpu.ops import conv as ops_conv
     from paddle_tpu.ops import math as ops_math
-    from paddle_tpu.ops.pallas import conv_bn as fused
+    from paddle_tpu.ops import conv_bn as fused
     x = jnp.asarray(rng.randn(2, 8, 8, 4).astype(np.float32))
     w = jnp.asarray(rng.randn(3, 3, 4, 8).astype(np.float32))
     assert ops_conv.conv2d(x, w).dtype == jnp.bfloat16

@@ -1,6 +1,9 @@
-"""Streaming conv+BN-stats kernels (interpret mode on CPU; the same code
-path drives Mosaic on TPU) vs the unfused conv2d + batch_norm_train
-composition."""
+"""Fused conv+BN op (ops/conv_bn.py — XLA-level composition with a
+closed-form BN VJP) vs the unfused conv2d + batch_norm_train composition.
+
+The round-3 Pallas streaming-stats kernels were retired in round 5 after
+the on-chip A/B measured them at 0.43-0.59x of this plain-XLA path (see
+ops/conv_bn.py docstring); these tests cover the surviving op."""
 
 import jax
 import jax.numpy as jnp
@@ -8,50 +11,8 @@ import numpy as np
 import pytest
 
 from paddle_tpu.ops import conv as ops_conv
+from paddle_tpu.ops import conv_bn as fused
 from paddle_tpu.ops import norm as ops_norm
-from paddle_tpu.ops.pallas import conv_bn as fused
-
-
-class TestStatsKernels:
-    def test_matmul_stats_ragged_shapes(self, rng):
-        """M, K not multiples of the blocks: padded rows/cols must not
-        leak into y or the statistics."""
-        m, c, k = 70, 24, 40          # bm=256->padded, bk=128->padded
-        x = jnp.asarray(rng.randn(m, c).astype(np.float32))
-        w = jnp.asarray(rng.randn(c, k).astype(np.float32))
-        y, s1, s2 = fused.matmul_bn_stats(x, w, interpret=True)
-        want = np.asarray(x) @ np.asarray(w)
-        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-5,
-                                   atol=1e-5)
-        np.testing.assert_allclose(np.asarray(s1), want.sum(0), rtol=1e-4,
-                                   atol=1e-4)
-        np.testing.assert_allclose(np.asarray(s2), (want ** 2).sum(0),
-                                   rtol=1e-4, atol=1e-4)
-
-    def test_conv3x3_stats_matches_lax(self, rng):
-        n, h, w_, c, k = 2, 8, 8, 16, 32
-        x = jnp.asarray(rng.randn(n, h, w_, c).astype(np.float32))
-        w = jnp.asarray(rng.randn(3, 3, c, k).astype(np.float32) * 0.1)
-        y, s1, s2 = fused.conv3x3_bn_stats(x, w, interpret=True)
-        want = np.asarray(ops_conv.conv2d(x, w, stride=1, padding="SAME"))
-        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4,
-                                   atol=1e-4)
-        np.testing.assert_allclose(np.asarray(s1), want.sum((0, 1, 2)),
-                                   rtol=1e-4, atol=1e-3)
-        np.testing.assert_allclose(np.asarray(s2),
-                                   (want ** 2).sum((0, 1, 2)),
-                                   rtol=1e-4, atol=1e-3)
-
-    def test_conv1x1_stride2_dispatch(self, rng):
-        x = jnp.asarray(rng.randn(2, 8, 8, 6).astype(np.float32))
-        w = jnp.asarray(rng.randn(1, 1, 6, 10).astype(np.float32))
-        y, s1, s2 = fused.conv_bn_stats(x, w, stride=2, padding="SAME",
-                                        interpret=True)
-        want = np.asarray(ops_conv.conv2d(x, w, stride=2, padding="SAME"))
-        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-5,
-                                   atol=1e-5)
-        np.testing.assert_allclose(np.asarray(s1), want.sum((0, 1, 2)),
-                                   rtol=1e-4, atol=1e-4)
 
 
 class TestFusedConvBN:
@@ -72,7 +33,7 @@ class TestFusedConvBN:
         rv = jnp.ones((k,), jnp.float32)
         out, nm, nv = fused.conv_bn_train(
             x, w, gamma, beta, rm, rv, stride=stride, momentum=0.9,
-            eps=1e-5, interpret=True)
+            eps=1e-5)
         ref, rnm, rnv = self._compose_ref(x, w, gamma, beta, rm, rv,
                                           stride)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -96,7 +57,7 @@ class TestFusedConvBN:
         def loss_fused(x_, w_, g_, b_):
             out, _, _ = fused.conv_bn_train(
                 jnp.asarray(x_), jnp.asarray(w_), jnp.asarray(g_),
-                jnp.asarray(b_), rm, rv, stride=stride, interpret=True)
+                jnp.asarray(b_), rm, rv, stride=stride)
             return jnp.mean((out - tgt) ** 2)
 
         def loss_ref(x_, w_, g_, b_):
@@ -180,15 +141,14 @@ class TestFusedLayerAndModel:
                                    np.asarray(uo2[uname].array),
                                    rtol=2e-4, atol=2e-4)
 
-    def test_fused_resnet_trains_through_kernels(self, rng, monkeypatch):
-        """resnet_cifar10 basic blocks with fused_bn, kernels forced to
-        interpret mode — the full model trains through the Pallas path."""
+    def test_fused_resnet_trains(self, rng):
+        """resnet_cifar10 basic blocks with fused_bn — the full model
+        trains through the fused op."""
         import paddle_tpu as paddle
         from paddle_tpu import layer
         from paddle_tpu.models import resnet
         from paddle_tpu.topology import Topology, Value
         from paddle_tpu.utils.rng import KeySource
-        monkeypatch.setattr(fused, "FORCE_INTERPRET", True)
         dt = paddle.data_type
 
         x = layer.data("img", dt.dense_vector(3 * 8 * 8))
@@ -224,6 +184,19 @@ class TestFusedLayerAndModel:
             losses.append(float(l))
         assert losses[-1] < losses[0], losses
         assert np.isfinite(losses).all()
+
+    def test_full_mode_is_retired(self):
+        """fused='full' (the deleted Pallas backward kernels) must fail
+        loudly with a pointer at the replacement recipes, not silently
+        train a different configuration."""
+        import paddle_tpu as paddle
+        from paddle_tpu import layer
+        from paddle_tpu.models import resnet
+        dt = paddle.data_type
+        x = layer.data("xr", dt.dense_vector(8 * 8 * 3))
+        with pytest.raises(ValueError, match="retired"):
+            resnet.conv_bn_layer(x, 8, 3, 1, 1, None, ch_in=3,
+                                 name="r_c1", fused="full")
 
 
 class TestFusedUnfusedInterchange:
@@ -292,8 +265,7 @@ class TestInt8Stash:
             def loss(x_, w_, g_, b_):
                 out, _, _ = fused.conv_bn_train(
                     jnp.asarray(x_), jnp.asarray(w_), jnp.asarray(g_),
-                    jnp.asarray(b_), rm, rv, stride=1, interpret=True,
-                    save8=save8)
+                    jnp.asarray(b_), rm, rv, stride=1, save8=save8)
                 return jnp.mean((out - tgt) ** 2), out
             (l, out), grads = jax.value_and_grad(
                 loss, argnums=(0, 1, 2, 3), has_aux=True)(x, w, gamma,
@@ -309,145 +281,13 @@ class TestInt8Stash:
             assert rel < 0.03, (name, rel)
 
 
-class TestFusedBackwardKernels:
-    """fused_bwd: the BN-backward g stage recomputed inside Pallas
-    conv-backward kernels — gradients must match the XLA-VJP path."""
-
-    @pytest.mark.parametrize("ksize,stride", [(1, 1), (1, 2), (3, 1)])
-    def test_grads_match_unfused_backward(self, rng, ksize, stride):
-        n, h, w_, c, k = 2, 8, 8, 8, 16
-        x = rng.randn(n, h, w_, c).astype(np.float32)
-        w = rng.randn(ksize, ksize, c, k).astype(np.float32) * 0.2
-        gamma = rng.rand(k).astype(np.float32) + 0.5
-        beta = rng.randn(k).astype(np.float32) * 0.1
-        rm = jnp.zeros((k,), jnp.float32)
-        rv = jnp.ones((k,), jnp.float32)
-        tgt = rng.randn(n, h // stride, w_ // stride, k).astype(np.float32)
-
-        def loss(fused_bwd):
-            def f(x_, w_, g_, b_):
-                out, _, _ = fused.conv_bn_train(
-                    jnp.asarray(x_), jnp.asarray(w_), jnp.asarray(g_),
-                    jnp.asarray(b_), rm, rv, stride=stride,
-                    interpret=True, fused_bwd=fused_bwd)
-                return jnp.mean((out - tgt) ** 2)
-            return f
-
-        g_fk = jax.grad(loss(True), argnums=(0, 1, 2, 3))(x, w, gamma,
-                                                          beta)
-        g_ref = jax.grad(loss(False), argnums=(0, 1, 2, 3))(x, w, gamma,
-                                                            beta)
-        for name, a, b in zip("xwgb", g_fk, g_ref):
-            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       rtol=2e-3, atol=2e-4,
-                                       err_msg=f"d{name}")
-
-    def test_composes_with_save8(self, rng):
-        """The intended pairing: int8 stash feeds the backward kernels."""
-        n, h, w_, c, k = 2, 6, 6, 4, 8
-        x = rng.randn(n, h, w_, c).astype(np.float32)
-        w = rng.randn(3, 3, c, k).astype(np.float32) * 0.2
-        gamma = rng.rand(k).astype(np.float32) + 0.5
-        beta = rng.randn(k).astype(np.float32) * 0.1
-        rm = jnp.zeros((k,), jnp.float32)
-        rv = jnp.ones((k,), jnp.float32)
-        tgt = rng.randn(n, h, w_, k).astype(np.float32)
-
-        def loss(save8, fused_bwd):
-            def f(x_, w_, g_, b_):
-                out, _, _ = fused.conv_bn_train(
-                    jnp.asarray(x_), jnp.asarray(w_), jnp.asarray(g_),
-                    jnp.asarray(b_), rm, rv, stride=1, interpret=True,
-                    save8=save8, fused_bwd=fused_bwd)
-                return jnp.mean((out - tgt) ** 2)
-            return f
-
-        g_all = jax.grad(loss(True, True), argnums=(0, 1, 2, 3))(
-            x, w, gamma, beta)
-        g_ref = jax.grad(loss(False, False), argnums=(0, 1, 2, 3))(
-            x, w, gamma, beta)
-        for name, a, b in zip("xwgb", g_all, g_ref):
-            denom = np.abs(np.asarray(b)).max() + 1e-8
-            rel = np.abs(np.asarray(a) - np.asarray(b)).max() / denom
-            assert rel < 0.03, (name, rel)
-
-    def test_mm_bwd_padded_rows_inert(self, rng):
-        """M not a block multiple: the dy-fill trick must keep padded
-        rows out of dx and dw exactly."""
-        m, c, k = 70, 8, 16
-        x2 = jnp.asarray(rng.randn(m, c).astype(np.float32))
-        z2 = jnp.asarray(rng.randn(m, k).astype(np.float32))
-        dy2 = jnp.asarray(rng.randn(m, k).astype(np.float32))
-        w2 = jnp.asarray(rng.randn(c, k).astype(np.float32))
-        gamma = jnp.asarray(rng.rand(k).astype(np.float32) + 0.5)
-        inv = jnp.asarray(rng.rand(k).astype(np.float32) + 0.5)
-        a_sum = jnp.sum(dy2, axis=0)
-        b_sum = jnp.sum(dy2 * z2 * inv, axis=0)
-        # block_m=64 < m so the padding branch (A/n dy-fill) really runs
-        dx, dw = fused.matmul_bn_bwd(x2, z2, dy2, w2, gamma, inv, a_sum,
-                                     b_sum, block_m=64, interpret=True)
-        # reference g + plain matmuls
-        nf = float(m)
-        g = (gamma * inv / nf) * (nf * dy2 - a_sum - z2 * inv * b_sum)
-        np.testing.assert_allclose(np.asarray(dx), np.asarray(g @ w2.T),
-                                   rtol=1e-4, atol=1e-4)
-        np.testing.assert_allclose(np.asarray(dw), np.asarray(x2.T @ g),
-                                   rtol=1e-4, atol=1e-3)
-
-
-def test_fused_full_mode_resnet_trains(rng, monkeypatch):
-    """fused='full' through the model stack: stats epilogue + int8 stash
-    + Pallas backward kernels, all in interpret mode."""
-    import paddle_tpu as paddle
-    from paddle_tpu import layer
-    from paddle_tpu.models import resnet
-    from paddle_tpu.topology import Topology, Value
-    from paddle_tpu.utils.rng import KeySource
-    monkeypatch.setattr(fused, "FORCE_INTERPRET", True)
-    dt = paddle.data_type
-
-    x = layer.data("img", dt.dense_vector(3 * 8 * 8))
-    lbl = layer.data("lbl", dt.integer_value(4))
-    c1 = resnet.conv_bn_layer(x, 8, 3, 1, 1, None, ch_in=3,
-                              name="ff_c1", fused="full")
-    b1 = resnet.bottleneck_block(c1, 8, 4, 1, name="ff_b1", fused="full")
-    pool = layer.img_pool(b1, pool_size=8, stride=1,
-                          pool_type=paddle.pooling.Avg())
-    sm = layer.fc(pool, 4, act=paddle.activation.Softmax(), name="ff_sm")
-    cost = layer.classification_cost(sm, lbl, name="ff_cost")
-    topo = Topology(cost)
-    params = paddle.parameters.create(cost, KeySource(0))
-    fwd = topo.compile()
-    opt = paddle.optimizer.Momentum(momentum=0.9, learning_rate=0.05)
-    o = opt.init_state(params.values)
-    xv = jnp.asarray(rng.randn(8, 3 * 8 * 8).astype(np.float32))
-    yv = jnp.asarray(rng.randint(0, 4, 8).astype(np.int32))
-
-    def step(p, o, s):
-        def loss_fn(p):
-            outs, ns = fwd(p, s, {"img": Value(xv), "lbl": Value(yv)},
-                           is_training=True)
-            return jnp.mean(outs["ff_cost"].array.astype(jnp.float32)), ns
-        (l, ns), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
-        np_, no_ = opt.update(jnp.asarray(0, jnp.int32), g, p, o)
-        return l, np_, no_, ns
-
-    p, s = params.values, params.state
-    losses = []
-    for _ in range(6):
-        l, p, o, s = step(p, o, s)
-        losses.append(float(l))
-    assert losses[-1] < losses[0] and np.isfinite(losses).all(), losses
-
-
-def test_fused_honors_compute_dtype_policy(rng, monkeypatch):
+def test_fused_honors_compute_dtype_policy(rng):
     """Under the real bf16 MXU policy (conftest forces fp32 for test
     numerics) the fused path must emit the SAME dtype as ops_conv.conv2d
     — a mismatch breaks the custom-VJP cotangent chain in full models
     (regression: benchmarks/fused_bn_quality.py caught fp32 fused output
     meeting a bf16 conv_vjp)."""
     from paddle_tpu.utils.flags import GLOBAL_FLAGS
-    monkeypatch.setattr(fused, "FORCE_INTERPRET", True)
     old = GLOBAL_FLAGS.get("compute_dtype", "float32")
     GLOBAL_FLAGS.set_if_known("compute_dtype", "bfloat16")
     try:
@@ -465,8 +305,7 @@ def test_fused_honors_compute_dtype_policy(rng, monkeypatch):
         # and the backward chain composes with a bf16 conv_vjp
         def loss(x_):
             o, _, _ = fused.conv_bn_train(x_, w, gamma, beta, rm, rv,
-                                          stride=1, save8=True,
-                                          fused_bwd=True)
+                                          stride=1, save8=True)
             return jnp.sum(o.astype(jnp.float32) ** 2)
 
         g = jax.grad(loss)(x)
@@ -476,14 +315,11 @@ def test_fused_honors_compute_dtype_policy(rng, monkeypatch):
         GLOBAL_FLAGS.set_if_known("compute_dtype", old)
 
 
-def test_fused_composes_with_dp_sharding(rng, monkeypatch):
+def test_fused_composes_with_dp_sharding(rng):
     """The fused conv+BN custom-VJP op must stay correct when its inputs
-    are GSPMD-sharded over the data axis (the multi-chip DP path; XLA
-    may gather around the pallas_call — correctness first, the bench
-    runs single-chip)."""
+    are GSPMD-sharded over the data axis (the multi-chip DP path)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     from paddle_tpu.core import place
-    monkeypatch.setattr(fused, "FORCE_INTERPRET", True)
     mesh = place.make_mesh((8,), (place.AXIS_DATA,))
     x_host = jnp.asarray(rng.randn(16, 8, 8, 8).astype(np.float32))
     x = jax.device_put(x_host, NamedSharding(
@@ -498,12 +334,13 @@ def test_fused_composes_with_dp_sharding(rng, monkeypatch):
     def step(x, w):
         def loss(w_):
             out, _, _ = fused.conv_bn_train(
-                x, w_, gamma, beta, rm, rv, stride=1, save8=True,
-                fused_bwd=True)
+                x, w_, gamma, beta, rm, rv, stride=1, save8=True)
             return jnp.mean(out.astype(jnp.float32) ** 2)
         return jax.value_and_grad(loss)(w)
 
     l_sh, g_sh = step(x, w)
     l_1d, g_1d = step(jax.device_put(x_host, jax.devices()[0]), w)
     np.testing.assert_allclose(float(l_sh), float(l_1d), rtol=1e-6)
-    np.testing.assert_array_equal(np.asarray(g_sh), np.asarray(g_1d))
+    # partitioned f32 reductions reassociate — tolerance, not bit-equal
+    np.testing.assert_allclose(np.asarray(g_sh), np.asarray(g_1d),
+                               rtol=1e-3, atol=1e-7)
