@@ -148,20 +148,23 @@ def main():
         print(f"mode={mode_s:6} done in {results[mode_s]['wall_s']}s: "
               f"final acc {results[mode_s]['final_acc']}", flush=True)
 
-    out = {
-        "config": {"width": args.width, "depth": args.depth,
-                   "batch": args.batch, "steps": args.steps,
-                   "noise": args.noise,
-                   "channel_ladder": [args.width, 2 * args.width,
-                                      4 * args.width],
-                   "task": "synthetic 10-class CIFAR-shaped"},
-        "results": results,
-    }
-    path = os.path.join(REPO, "benchmarks", "runs",
-                        f"q8_quality_width{args.width}_s{args.steps}.json")
-    with open(path, "w") as f:
-        json.dump(out, f, indent=1)
-    print(f"wrote {path}")
+        # write after EVERY arm so a wall-clock cutoff still leaves the
+        # completed arms' evidence on disk
+        out = {
+            "config": {"width": args.width, "depth": args.depth,
+                       "batch": args.batch, "steps": args.steps,
+                       "noise": args.noise,
+                       "channel_ladder": [args.width, 2 * args.width,
+                                          4 * args.width],
+                       "task": "synthetic 10-class CIFAR-shaped"},
+            "results": results,
+        }
+        path = os.path.join(
+            REPO, "benchmarks", "runs",
+            f"q8_quality_width{args.width}_s{args.steps}.json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {path} ({len(results)} arm(s))", flush=True)
     if "0" in results and results["0"]["final_acc"] is not None:
         base = results["0"]["final_acc"]
         for m, r in results.items():

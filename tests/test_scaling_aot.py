@@ -1,0 +1,90 @@
+"""Unit tests for the scaling_aot scheduled-HLO analyzer: shape-bytes
+parsing under TPU layout tile annotations, collective classification
+(all-reduce / reduce-scatter / all-gather), replica-group parsing (iota,
+transposed iota, explicit), megascale DCN send accounting, and the
+placement stats — hermetic (no compile; synthetic HLO text)."""
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+spec = importlib.util.spec_from_file_location(
+    "scaling_aot_under_test", os.path.join(REPO, "benchmarks",
+                                           "scaling_aot.py"))
+sa = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(sa)
+
+
+class TestShapeBytes:
+    def test_tuple_with_tile_annotations(self):
+        sig = ("(f32[64]{0:T(128)S(1)}, bf16[3,3,64,64]"
+               "{3,2,1,0:T(8,128)(2,1)S(1)}) ")
+        assert sa._shape_bytes(sig) == 64 * 4 + 3 * 3 * 64 * 64 * 2
+
+    def test_ignores_non_dtype_brackets(self):
+        # replica_groups=[1,8]<=[8] must not count as a shape
+        assert sa._shape_bytes("groups=[1,8]<=[8]") == 0
+
+
+class TestParseGroup:
+    def test_iota_plain(self):
+        g = sa._parse_group("replica_groups=[2,8]<=[16], x")
+        assert g == list(range(8))
+
+    def test_iota_transposed(self):
+        g = sa._parse_group("replica_groups=[8,2]<=[8,2]T(1,0), x")
+        assert g == [0, 8]
+
+    def test_explicit(self):
+        g = sa._parse_group("replica_groups={{0,8},{1,9}}, x")
+        assert g == [0, 8]
+
+    def test_absent(self):
+        assert sa._parse_group("no groups here") is None
+
+
+HLO = """HloModule jit_step, is_scheduled=true
+
+ENTRY %main {
+  %fusion.1 = bf16[128,56,56,64]{0,3,2,1:T(8,128)(2,1)} fusion(%p0), kind=kLoop
+  %all-reduce.1 = (f32[64]{0:T(128)S(1)}, f32[64]{0:T(128)S(1)}) all-reduce(%a, %b), channel_id=1, replica_groups={{0,1,2,3,4,5,6,7}}, use_global_device_ids=true
+  %convolution.9 = bf16[128,56,56,64]{0,3,2,1:T(8,128)(2,1)} convolution(%x, %w), window={size=3x3}
+  %reduce-scatter.2 = f32[32]{0:T(128)} reduce-scatter(%g), channel_id=2, replica_groups=[1,8]<=[8], dimensions={0}
+  %all-gather.3 = f32[256]{0:T(256)} all-gather(%h), channel_id=3, replica_groups=[1,8]<=[8], dimensions={0}
+  %send = (f32[1,1,128]{2,1,0:T(1,128)}, u32[], token[]) send(%all-reduce.1, %tok), channel_id=9, is_host_transfer=true, frontend_attributes={megascale_transfer_type="ALL_REDUCE"}
+  %all-reduce.4 = bf16[512]{0:T(512)} all-reduce(%z), channel_id=4, replica_groups=[4,2]<=[4,2]T(1,0), use_global_device_ids=true
+}
+"""
+
+
+class TestAnalyzeSchedule:
+    def test_counts_and_classification(self):
+        s = sa.analyze_schedule(HLO)
+        assert s["total_compute_ops"] == 2          # fusion + convolution
+        ops = {c["op"] for c in s["sync_all_reduces"]}
+        assert ops == {"all-reduce", "reduce-scatter", "all-gather"}
+        assert len(s["sync_all_reduces"]) == 4
+        assert s["megascale_sends"] == 1
+        # payload f32[1,1,128] = 512B + u32 4B (token not counted)
+        assert s["megascale_send_bytes"] == 512 + 4
+
+    def test_bytes_and_groups(self):
+        s = sa.analyze_schedule(HLO)
+        by = {c["name"]: c for c in s["sync_all_reduces"]}
+        assert by["all-reduce.1"]["bytes"] == 2 * 64 * 4   # result tuple
+        assert by["all-reduce.1"]["group_size"] == 8
+        assert by["reduce-scatter.2"]["bytes"] == 32 * 4   # the shard
+        assert by["all-gather.3"]["bytes"] == 256 * 4
+        # transposed iota: group members stride by G=4 -> crosses an
+        # 8-per-slice boundary only at >=2 slices
+        assert by["all-reduce.4"]["group_example"] == [0, 4]
+
+    def test_placement_stats(self):
+        s = sa.analyze_schedule(HLO)
+        by = {c["name"]: c for c in s["sync_all_reduces"]}
+        # all-reduce.1 has the convolution after it; all-reduce.4 is last
+        assert by["all-reduce.1"]["compute_ops_after"] == 1
+        assert by["all-reduce.4"]["compute_ops_after"] == 0
