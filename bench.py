@@ -30,9 +30,10 @@ textbook fwd+bwd count (12.3 GFLOP/image) against the chip's bf16 peak —
 so the gate artifact tracks compute efficiency, not just throughput.
 
 Recipe schedule: with BENCH_FUSED_BN unset, leftover budget measures the
-stash recipes too (BENCH_TRY_MODES, default "defer,q8sr" — defer first:
-it holds convergence parity at horizon where q8 shows an STE gap on the
-toy net, BENCHMARKS.md) and the emitted
+stash recipes too (BENCH_TRY_MODES, default "q8sr,defer" — q8sr first:
+the width-64..256 quality ladder measured it at/above parity, so the
+largest modelled-throughput arm gets scarce tunnel time first,
+BENCHMARKS.md "quality at width") and the emitted
 record is the BEST mode, tagged `modes_measured` — the gate reports the
 framework's best configuration even when the on-chip A/B queue never got
 tunnel time. A failing extra mode is dropped; a budget/driver timeout
@@ -553,7 +554,7 @@ def orchestrate():
     # measured) — the gate reports the framework's best configuration
     # even when the on-chip A/B queue never got tunnel time
     if os.environ.get("BENCH_FUSED_BN") is None:
-        extra = os.environ.get("BENCH_TRY_MODES", "defer,q8sr")
+        extra = os.environ.get("BENCH_TRY_MODES", "q8sr,defer")
     else:
         extra = os.environ.get("BENCH_TRY_MODES", "")
     pending = [FUSED_BN if isinstance(FUSED_BN, str)
