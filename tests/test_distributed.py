@@ -109,6 +109,19 @@ class TestSshLaunch:
             ssh_cmd=(self._shim(tmp_path),), timeout=60)
         assert rcs == [3]
 
+    def test_cli_hosts_mode(self, tmp_path, capsys):
+        """--hosts routes main() through the ssh fan-out."""
+        from paddle_tpu.runtime import launch
+        out = tmp_path / "cli_out"
+        rc = launch.main([
+            "--hosts", "h0,h1", "--port", "7071",
+            "--ssh-cmd", self._shim(tmp_path), "--timeout", "60",
+            "bash", "-c",
+            f"echo $PADDLE_PROCESS_ID:$PADDLE_COORDINATOR >> {out}"])
+        assert rc == 0
+        lines = sorted(out.read_text().split())
+        assert lines == ["0:h0:7071", "1:h0:7071"]
+
 
 class TestHybridMeshSingleProcess:
     def test_single_slice_falls_back_to_plain_mesh(self):
