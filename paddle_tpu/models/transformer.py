@@ -70,10 +70,6 @@ class TransformerConfig:
             raise ValueError(
                 f"remat must be 'none', 'bf16' or 'q8', got "
                 f"{self.remat!r}")
-        if self.moe_experts and self.remat != "none":
-            raise ValueError("moe_experts does not compose with layer "
-                             "remat yet (the MoE block's aux output "
-                             "changes the stash contract)")
 
     def moe_cfg(self):
         """The parallel/moe.MoEConfig this FFN runs under."""
