@@ -118,3 +118,24 @@ def test_generate_accepts_quantized_params(rng):
     import re
     loops = re.findall(r"stablehlo\.while.*?(?:\n  \}|\Z)", shlo, re.S)
     assert any("i8" in l for l in loops), "int8 absent from decode loop"
+
+
+def test_moe_artifact_roundtrip_matches_generate(tmp_path, rng):
+    """The serving artifact carries MoE configs transparently (cfg
+    round-trips through dataclasses.asdict; decode runs the expert FFN
+    drop-free), so the expert family serves like the dense one."""
+    cfg = transformer.TransformerConfig(
+        vocab=40, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_len=32, dtype=jnp.float32, moe_experts=4,
+        moe_capacity_factor=4.0)
+    params = transformer.init_params(jax.random.PRNGKey(1), cfg)
+    B, Tp, new = 2, 6, 8
+    prompt = rng.randint(0, 40, (B, Tp)).astype(np.int32)
+    path = str(tmp_path / "lm_moe.tar")
+    lm_serving.save_lm_artifact(path, params, cfg, batch=B,
+                                prompt_len=Tp, cache_len=Tp + new)
+    srv = lm_serving.load_lm_artifact(path)
+    got = srv.generate(prompt, max_new=new)
+    want = np.asarray(transformer.generate(
+        params, jnp.asarray(prompt), cfg, max_new=new))
+    np.testing.assert_array_equal(got, want)
