@@ -114,6 +114,27 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+# --metrics-out=PATH (or BENCH_METRICS_OUT): machine-readable JSONL trail
+# next to BENCH_*.json — every measured batch and the final record, in the
+# same format `paddle_tpu stats --metrics_file=...` tails. Inline append
+# (not observe.JsonlSink) so the orchestrator stays import-light and a
+# metrics failure can never break the one-JSON-line contract.
+for _a in sys.argv[1:]:
+    if _a.startswith("--metrics-out="):
+        os.environ["BENCH_METRICS_OUT"] = _a.split("=", 1)[1]
+METRICS_OUT = os.environ.get("BENCH_METRICS_OUT")
+
+
+def metrics_write(**rec):
+    if not METRICS_OUT:
+        return
+    try:
+        with open(METRICS_OUT, "a") as f:
+            f.write(json.dumps({"ts": round(time.time(), 3), **rec}) + "\n")
+    except (OSError, ValueError) as e:
+        log(f"metrics-out write failed: {e}")
+
+
 _emit_lock = threading.Lock()
 _emitted = False
 
@@ -230,6 +251,7 @@ def emit(value, error=None, _lv=None, **extra):
         # the artifact records whether this was a clean full run; stale
         # fallback emissions must not masquerade as fresh measurements
         record_run(rec)
+    metrics_write(kind="bench_result", **rec)
     print(json.dumps(rec), flush=True)
     sys.stdout.flush()
     sys.stderr.flush()
@@ -388,6 +410,10 @@ def bench_batch(dog, step_fn, carry, batch, warmup=3, iters=20):
     ips = batch / dt
     log(f"bs={batch}: {dt*1e3:.2f} ms/step  {ips:.0f} images/sec  "
         f"loss {lossv:.3f}")
+    metrics_write(kind="bench_batch", batch=batch, iters=iters,
+                  ms_per_step=round(dt * 1e3, 3),
+                  images_per_sec=round(ips, 1), loss=round(lossv, 4),
+                  mode=str(FUSED_BN), mfu=mfu(ips))
     return ips, (p, o, s)
 
 
@@ -447,6 +473,9 @@ def _emit_best():
             os._exit(0)
         _emitted = True
         print(line_out, flush=True)
+    # bench_best, not bench_result: each child already wrote its own
+    # bench_result line to the shared file — this is the aggregate
+    metrics_write(kind="bench_best", **rec)
     _write_status("done", "ok", _state["children"])
     sys.exit(0)
 

@@ -259,6 +259,89 @@ def job_infer(cfg, args):
     return 0
 
 
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def job_stats(cfg, args):
+    """Observability snapshot (the tentpole CLI surface): with
+    --metrics_file, summarize + tail a JSONL per-step metrics log written
+    by the trainer/bench (`observe.JsonlSink`); without one, render the
+    current process's default metrics registry (--format=prom gives the
+    Prometheus text exposition)."""
+    from paddle_tpu import observe
+
+    if args.metrics_file:
+        try:
+            recs = observe.read_jsonl(args.metrics_file)
+        except OSError as e:
+            print(f"stats: cannot read {args.metrics_file}: {e}",
+                  file=sys.stderr)
+            return 1
+        if not recs:
+            print(f"stats: no records in {args.metrics_file}")
+            return 1
+        steps = [r for r in recs if r.get("kind") == "step"]
+        passes = [r for r in recs if r.get("kind") == "pass"]
+        other = len(recs) - len(steps) - len(passes)
+        print(f"{args.metrics_file}: {len(recs)} records "
+              f"({len(steps)} steps, {len(passes)} passes"
+              + (f", {other} other" if other else "") + ")")
+        if steps:
+            walls = sorted(float(r["wall_time_s"]) for r in steps
+                           if isinstance(r.get("wall_time_s"), (int, float)))
+            eps = [float(r["examples_per_sec"]) for r in steps
+                   if isinstance(r.get("examples_per_sec"), (int, float))]
+            losses = [float(r["loss"]) for r in steps
+                      if isinstance(r.get("loss"), (int, float))]
+            recompiles = sum(1 for r in steps if r.get("recompile"))
+            print(f"  step wall ms: p50 {_pct(walls, .5)*1e3:.2f}  "
+                  f"p90 {_pct(walls, .9)*1e3:.2f}  "
+                  f"max {walls[-1]*1e3:.2f}" if walls else "")
+            if eps:
+                print(f"  examples/sec: last {eps[-1]:.1f}  "
+                      f"mean {sum(eps)/len(eps):.1f}")
+            if losses:
+                print(f"  loss: first {losses[0]:.5f}  last {losses[-1]:.5f}")
+            print(f"  recompiles tagged: {recompiles}")
+        for r in passes:
+            print(f"  pass {r.get('pass_id')}: {r.get('examples')} examples "
+                  f"in {r.get('wall_time_s')}s "
+                  f"({r.get('examples_per_sec')} ex/s) "
+                  f"metrics {r.get('metrics', {})}")
+        if args.last:
+            print(f"--- last {args.last} records ---")
+            import json as _json
+            for r in recs[-args.last:]:
+                print(_json.dumps(r))
+        return 0
+
+    reg = observe.default_registry()
+    if args.format == "prom":
+        print(reg.render_prometheus(), end="")
+        return 0
+    snap = reg.snapshot()
+    if not snap:
+        print("stats: default registry is empty (pass --metrics_file=... "
+              "to inspect a JSONL metrics log)")
+        return 0
+    for name, m in snap.items():
+        print(f"{name} ({m['kind']})" + (f" — {m['help']}" if m['help']
+                                         else ""))
+        for s in m["series"]:
+            lbl = ",".join(f"{k}={v}" for k, v in s["labels"].items())
+            lbl = f"{{{lbl}}}" if lbl else ""
+            if m["kind"] == "histogram":
+                print(f"  {lbl} count {s['count']} avg {s['avg']:.6f} "
+                      f"min {s['min']:.6f} max {s['max']:.6f}")
+            else:
+                print(f"  {lbl} {s['value']}")
+    return 0
+
+
 def job_checkgrad(cfg, args):
     """Whole-model finite-difference gradient verification (reference:
     Trainer::checkGradient, trainer/Trainer.cpp:299-377)."""
@@ -320,9 +403,12 @@ def main(argv=None):
         description="TPU-native trainer CLI (reference: paddle_trainer, "
                     "TrainerMain.cpp)")
     p.add_argument("job", choices=["train", "test", "time", "checkgrad",
-                                   "infer"],
-                   help="what to run (TrainerMain.cpp:52-61)")
-    p.add_argument("--config", required=True, help="python config file")
+                                   "infer", "stats"],
+                   help="what to run (TrainerMain.cpp:52-61; stats "
+                        "renders an observability snapshot)")
+    p.add_argument("--config", default=None,
+                   help="python config file (required for every job "
+                        "except stats)")
     p.add_argument("--num_passes", type=int, default=1)
     p.add_argument("--save_dir", default=None)
     p.add_argument("--init_model_path", default=None)
@@ -337,11 +423,27 @@ def main(argv=None):
     p.add_argument("--warmup_batches", type=int, default=3)
     p.add_argument("--checkgrad_eps", type=float, default=1e-3)
     p.add_argument("--checkgrad_tol", type=float, default=2e-2)
+    p.add_argument("--metrics_file", default=None,
+                   help="JSONL metrics log to summarize (job=stats)")
+    p.add_argument("--last", type=int, default=0,
+                   help="also dump the trailing N raw records (job=stats)")
+    p.add_argument("--format", choices=["pretty", "prom"], default="pretty",
+                   help="registry render format (job=stats)")
+    p.add_argument("--metrics_out", default=None,
+                   help="write per-step JSONL metrics here (train/time "
+                        "jobs; same as PADDLE_TPU_METRICS_PATH)")
     args = p.parse_args(argv)
 
-    cfg = _load_config(args.config)
+    if args.metrics_out:
+        from paddle_tpu import observe
+        observe.configure(args.metrics_out)
     jobs = {"train": job_train, "test": job_test, "time": job_time,
             "checkgrad": job_checkgrad, "infer": job_infer}
+    if args.job == "stats":
+        return job_stats(None, args)
+    if not args.config:
+        p.error(f"--config is required for job={args.job}")
+    cfg = _load_config(args.config)
     return jobs[args.job](cfg, args)
 
 

@@ -26,13 +26,27 @@ On real TPU pods all three are discovered from the TPU metadata by JAX and
 """
 
 import os
+import time
 from typing import Optional, Sequence
 
+from paddle_tpu.observe import metrics as _metrics
 from paddle_tpu.utils.logger import get_logger
 
 log = get_logger("distributed")
 
 _initialized = False
+
+_m_init_s = _metrics.gauge(
+    "distributed_init_seconds", "wall time of jax.distributed.initialize")
+_m_procs = _metrics.gauge("distributed_process_count",
+                          "processes in the cluster")
+_m_devices = _metrics.gauge("distributed_global_devices",
+                            "global device count")
+_m_barriers = _metrics.counter("distributed_barriers_total",
+                               "cross-process barriers entered")
+_m_barrier_s = _metrics.histogram(
+    "distributed_barrier_seconds",
+    "barrier wait time — the straggler detector (BarrierStat slot)")
 
 
 def is_initialized() -> bool:
@@ -74,13 +88,22 @@ def init(coordinator_address: Optional[str] = None,
     if platform:
         jax.config.update("jax_platforms", platform)
     if local_cpu_devices:
-        jax.config.update("jax_num_cpu_devices", local_cpu_devices)
+        from paddle_tpu.utils.flags import set_xla_host_device_count
+        set_xla_host_device_count(local_cpu_devices)
+        try:
+            jax.config.update("jax_num_cpu_devices", local_cpu_devices)
+        except AttributeError:
+            pass  # older JAX reads XLA_FLAGS at backend init instead
 
+    t0 = time.perf_counter()
     if coordinator_address is None and num_processes is None:
         # single-host (or TPU-pod auto-detect) path
         try:
             jax.distributed.initialize()
             _initialized = True
+            _m_init_s.set(time.perf_counter() - t0)
+            _m_procs.set(jax.process_count())
+            _m_devices.set(len(jax.devices()))
             log.info("distributed: auto-initialized, %d processes, "
                      "%d global devices", jax.process_count(),
                      len(jax.devices()))
@@ -93,6 +116,9 @@ def init(coordinator_address: Optional[str] = None,
         num_processes=num_processes,
         process_id=process_id)
     _initialized = True
+    _m_init_s.set(time.perf_counter() - t0)
+    _m_procs.set(jax.process_count())
+    _m_devices.set(len(jax.devices()))
     log.info("distributed: joined as process %d/%d, %d global devices "
              "(%d local)", jax.process_index(), jax.process_count(),
              len(jax.devices()), len(jax.local_devices()))
@@ -104,6 +130,25 @@ def shutdown():
         import jax
         jax.distributed.shutdown()
         _initialized = False
+
+
+def barrier(name: str = "barrier") -> float:
+    """Block until every process reaches this point; returns (and
+    records) this process's wait in seconds. The per-name histogram is
+    the straggler detector the reference built BarrierStat for
+    (paddle/utils/Stat.h BarrierStat): a process whose wait is
+    consistently near-zero while peers wait long IS the straggler.
+    Single-process: returns 0.0 immediately (still counted)."""
+    import jax
+
+    t0 = time.perf_counter()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+    dt = time.perf_counter() - t0
+    _m_barriers.inc(name=name)
+    _m_barrier_s.observe(dt, name=name)
+    return dt
 
 
 def process_index() -> int:

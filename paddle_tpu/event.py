@@ -30,11 +30,19 @@ class BeginIteration:
 
 
 class EndIteration(WithMetric):
-    def __init__(self, pass_id, batch_id, cost, evaluator=None):
+    """End of one trained batch. ``wall_time_s`` / ``examples_per_sec``
+    carry the step's observability scalars (None when the trainer didn't
+    measure them) — the same numbers observe.report() emits, so existing
+    handlers can read them without touching the metrics registry."""
+
+    def __init__(self, pass_id, batch_id, cost, evaluator=None,
+                 wall_time_s=None, examples_per_sec=None):
         super().__init__(evaluator)
         self.pass_id = pass_id
         self.batch_id = batch_id
         self.cost = cost
+        self.wall_time_s = wall_time_s
+        self.examples_per_sec = examples_per_sec
 
 
 class TestResult(WithMetric):

@@ -16,12 +16,14 @@ import dataclasses
 import io as _io
 import json
 import tarfile
+import time
 from typing import Optional, Sequence
 
 import numpy as np
 
 from paddle_tpu.io.checkpoint import _flatten          # shared pytree walk
 from paddle_tpu.io.merged import _add_member as _add   # shared tar append
+from paddle_tpu.observe import metrics as _metrics
 
 FORMAT_VERSION = 2   # max supported; plain artifacts still save as v1
 
@@ -107,6 +109,7 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
     inline, so the loader and LMServer are unchanged.
     """
     import jax
+    import jax.export  # noqa: F401 — jax.export needs an explicit import
     import jax.numpy as jnp
     from paddle_tpu.models import transformer
     from paddle_tpu.ops import q8 as ops_q8
@@ -163,20 +166,52 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
         _add(tar, "decode.bin", exp_decode.serialize())
 
 
+# decode steps run single-digit ms; prefill tens-to-hundreds — buckets
+# must resolve both (default Prometheus buckets start too coarse at 1 ms)
+_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+
 class LMServer:
     """Loaded artifact: compiled prefill + decode, host-side sampling.
 
     ``generate(prompt, max_new)`` mirrors models/transformer.generate
     greedy/temperature semantics but never traces or imports the model.
+
+    Each server carries its own metrics ``Registry`` (serving several
+    artifacts in one process must not cross-pollute counters):
+    prefill/decode call counts, generated-token count, and per-phase
+    latency histograms; ``metrics_text()`` renders the Prometheus text
+    snapshot a scrape endpoint serves verbatim.
     """
 
     def __init__(self, meta, params, prefill_bin, decode_bin):
         import jax
+        import jax.export  # noqa: F401 — needs an explicit import
         self.meta = meta
         self.cfg = _cfg_from_dict(meta["config"])
         self.params = params
         self._prefill = jax.export.deserialize(prefill_bin)
         self._decode = jax.export.deserialize(decode_bin)
+        reg = self.metrics = _metrics.Registry()
+        self._m_prefill = reg.counter(
+            "lm_prefill_calls_total", "prefill (prompt) passes served")
+        self._m_decode = reg.counter(
+            "lm_decode_calls_total", "incremental decode steps served")
+        self._m_tokens = reg.counter(
+            "lm_tokens_generated_total", "tokens sampled across all calls")
+        self._m_requests = reg.counter(
+            "lm_generate_requests_total", "generate() calls",)
+        self._m_prefill_s = reg.histogram(
+            "lm_prefill_seconds", "prefill latency (device call + sample)",
+            buckets=_LATENCY_BUCKETS)
+        self._m_decode_s = reg.histogram(
+            "lm_decode_seconds", "per-token decode latency "
+            "(device call + sample)", buckets=_LATENCY_BUCKETS)
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition snapshot of this server's metrics."""
+        return self.metrics.render_prometheus()
 
     def generate(self, prompt: np.ndarray, max_new: int,
                  temperature: float = 0.0,
@@ -204,14 +239,25 @@ class LMServer:
             return np.asarray([rng.choice(p.shape[-1], p=row)
                                for row in p], np.int32)
 
+        self._m_requests.inc()
+        t0 = time.perf_counter()
         logits, cache = self._prefill.call(
             self.params, jnp.asarray(prompt, jnp.int32))
+        # np.asarray inside sample() is the host sync — latency measured
+        # after it is the latency a caller actually observes
         toks = [sample(np.asarray(logits))]
+        self._m_prefill.inc()
+        self._m_prefill_s.observe(time.perf_counter() - t0)
+        self._m_tokens.inc(b)
         for i in range(max_new - 1):
+            t0 = time.perf_counter()
             logits, cache = self._decode.call(
                 self.params, cache, jnp.asarray(toks[-1], jnp.int32),
                 jnp.asarray(tp + i, jnp.int32))
             toks.append(sample(np.asarray(logits)))
+            self._m_decode.inc()
+            self._m_decode_s.observe(time.perf_counter() - t0)
+            self._m_tokens.inc(b)
         return np.concatenate([prompt,
                                np.stack(toks, axis=1)], axis=1)
 

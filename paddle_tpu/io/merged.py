@@ -197,6 +197,7 @@ def save_inference_model(path: str, output_layer, parameters,
                     _npz_bytes(_split_quantized(values)))
         _add_member(tar, "state.npz", _npz_bytes(parameters.state))
         if export_batch_sizes:
+            import jax.export  # noqa: F401 — needs an explicit import
             serve = jax.jit(_serve_fn(topo))
             for bs in export_batch_sizes:
                 feeds = example_feeds(topo, bs)
@@ -268,6 +269,7 @@ class MergedModel:
                            f"available: {sorted(self._exported)}")
         exp = self._exported[bs]
         if isinstance(exp, (bytes, bytearray)):
+            import jax.export  # noqa: F401 — needs an explicit import
             exp = self._exported[bs] = jax.export.deserialize(bytes(exp))
         outs = exp.call(self.params, self.state, feeds)
         return {k: np.asarray(v) for k, v in outs.items()}

@@ -1,0 +1,141 @@
+"""paddle_tpu.observe — the unified observability layer.
+
+Three pieces:
+
+- ``observe.metrics`` — Counter/Gauge/Histogram registry with a JSONL
+  scalar sink and a Prometheus text renderer (stdlib-only).
+- ``observe.trace`` — nested trace scopes over ``utils/stat.py`` that
+  open ``jax.profiler`` annotations when profiling is enabled.
+- ``observe.report()`` — the one funnel the trainer (and anything else)
+  pushes per-step records through: every record goes to the configured
+  JSONL sink and to any registered handlers, while the existing
+  event-handler path keeps working untouched.
+
+Typical wiring::
+
+    from paddle_tpu import observe
+    observe.configure(jsonl_path="metrics.jsonl")   # or
+    # PADDLE_TPU_METRICS_PATH=metrics.jsonl in the environment
+    ...train...
+    # then: paddle_tpu stats --metrics_file=metrics.jsonl
+"""
+
+import os
+import threading
+from typing import Callable, List, Optional
+
+from paddle_tpu.observe.metrics import (  # noqa: F401 — public surface
+    Counter, Gauge, Histogram, JsonlSink, Registry, counter,
+    default_registry, gauge, histogram, read_jsonl)
+from paddle_tpu.observe.trace import (  # noqa: F401
+    current_scope, step_scope, trace_scope, traced)
+
+_lock = threading.Lock()
+_sink: Optional[JsonlSink] = None
+_sink_source = None            # "configure" | "env" — env never overrides
+_env_checked = False           # PADDLE_TPU_METRICS_PATH probed once
+_handlers: List[Callable[[dict], None]] = []
+
+
+def configure(jsonl_path: Optional[str] = None,
+              flush_every: int = 32) -> Optional[JsonlSink]:
+    """Install (or with ``jsonl_path=None`` remove) the process-wide JSONL
+    metrics sink that ``report()`` feeds. Returns the sink."""
+    global _sink, _sink_source, _env_checked
+    with _lock:
+        if _sink is not None:
+            _sink.close()
+            _sink = None
+        _sink_source = None
+        # explicit configuration settles the question — configure(None)
+        # means "no sink", the env var must not resurrect one
+        _env_checked = True
+        if jsonl_path:
+            _sink = JsonlSink(jsonl_path, flush_every=flush_every)
+            _sink_source = "configure"
+        return _sink
+
+
+def _env_autoconfigure():
+    """PADDLE_TPU_METRICS_PATH wires the sink without code changes (the
+    env contract every other knob in utils/flags.py follows). Probed once
+    per process (and again after reset()) — not on every hot-loop call."""
+    global _sink, _sink_source, _env_checked
+    if _env_checked:
+        return
+    path = os.environ.get("PADDLE_TPU_METRICS_PATH")
+    with _lock:
+        _env_checked = True
+        if path and _sink is None:
+            try:
+                _sink = JsonlSink(path)
+                _sink_source = "env"
+            except OSError as e:
+                # a bad env path must not kill the training loop — the
+                # explicit configure() API still raises for real callers
+                from paddle_tpu.utils.logger import get_logger
+                get_logger("observe").warning(
+                    "PADDLE_TPU_METRICS_PATH=%s unusable (%s); "
+                    "metrics sink disabled", path, e)
+
+
+def sink() -> Optional[JsonlSink]:
+    if not _env_checked:
+        _env_autoconfigure()
+    return _sink
+
+
+def has_consumers() -> bool:
+    """True when report() would reach a sink or handler — hot loops use
+    this to skip building record dicts nobody will read."""
+    return sink() is not None or bool(_handlers)
+
+
+def add_report_handler(fn: Callable[[dict], None]) -> None:
+    """Register a callback invoked with every report() record — the
+    programmatic tap (dashboards, tests) next to the JSONL file."""
+    with _lock:
+        _handlers.append(fn)
+
+
+def remove_report_handler(fn: Callable[[dict], None]) -> None:
+    with _lock:
+        if fn in _handlers:
+            _handlers.remove(fn)
+
+
+def report(record: Optional[dict] = None, **scalars) -> dict:
+    """Emit one observability record (a flat dict of scalars). Fans out
+    to the JSONL sink (when configured) and all registered handlers.
+    Never raises — a broken handler must not kill the training loop."""
+    rec = dict(record or {})
+    rec.update(scalars)
+    s = sink()
+    if s is not None:
+        try:
+            s.write(rec)
+        except (OSError, ValueError, TypeError):
+            pass       # incl. json.dumps on non-serializable values
+    with _lock:
+        handlers = list(_handlers)
+    for fn in handlers:
+        try:
+            fn(rec)
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            pass
+    return rec
+
+
+def reset():
+    """Drop the sink and handlers and zero every default-registry series
+    (test isolation). Registrations survive — module-level metric objects
+    (trainer, master, distributed) must stay wired to the registry."""
+    global _sink, _sink_source, _env_checked
+    with _lock:
+        if _sink is not None:
+            _sink.close()
+        _sink = None
+        _sink_source = None
+        _env_checked = False
+        _handlers.clear()
+    default_registry().clear_series()

@@ -1,0 +1,14 @@
+"""shard_map across JAX versions: new releases expose ``jax.shard_map``
+with ``check_vma=``; older ones ship
+``jax.experimental.shard_map.shard_map`` whose equivalent kwarg is
+``check_rep=``. Every shard_map call in this package goes through here."""
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    try:
+        from jax import shard_map as _sm
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+        kw = {} if check_vma is None else {"check_rep": check_vma}
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)

@@ -165,7 +165,7 @@ def moe_ffn_a2a(params, x: jax.Array, cfg: MoEConfig, mesh: Mesh,
     quantize runs BEFORE the collective, inside the shard, so s8 is what
     crosses the wire (asserted in tests/test_moe_pipeline.py).
     """
-    from jax import shard_map
+    from paddle_tpu.parallel.compat import shard_map
 
     ax = place.AXIS_EXPERT
     if ax not in mesh.axis_names:

@@ -132,7 +132,7 @@ def pipeline_apply_interleaved(stage_params, x: jax.Array,
     gradients; the input/output rings stay full precision so the
     pipeline's own data is untouched.
     """
-    from jax import shard_map
+    from paddle_tpu.parallel.compat import shard_map
 
     S = mesh.shape[stage_axis]
     v = num_chunks

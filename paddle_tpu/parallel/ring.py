@@ -171,7 +171,7 @@ def ring_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
     on the wire; the jnp engine's autodiff backward sends cotangents
     through the same int8 codec per hop (bounded by the grad tolerance
     test — prefer the flash engine for training at scale)."""
-    from jax import shard_map
+    from paddle_tpu.parallel.compat import shard_map
 
     H, Hkv = q.shape[2], k.shape[2]
     tp = (head_axis if head_axis in mesh.axis_names
@@ -255,7 +255,7 @@ def alltoall_attention_spmd(q, k, v, mesh: Mesh, *, causal: bool = False,
     kernel (packed equal-length only); ragged ``lengths`` use the jnp
     engine.
     """
-    from jax import shard_map
+    from paddle_tpu.parallel.compat import shard_map
 
     P_ = mesh.shape[seq_axis]
     H, Hkv = q.shape[2], k.shape[2]

@@ -30,10 +30,30 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
+from paddle_tpu.observe import metrics as _metrics
 from paddle_tpu.runtime import recordio
 from paddle_tpu.utils.logger import get_logger
 
 log = get_logger("master")
+
+# default-registry metrics, labeled by service name so several masters in
+# one process (HA standby tests) stay distinguishable
+_m_queue = _metrics.gauge(
+    "master_task_queue_depth",
+    "tasks per queue (labels: service, queue=todo|pending|done|discarded)")
+_m_done = _metrics.counter("master_tasks_done_total",
+                           "tasks reported done")
+_m_failed = _metrics.counter("master_tasks_failed_total",
+                             "tasks reported failed")
+_m_discarded = _metrics.counter(
+    "master_tasks_discarded_total",
+    "tasks dropped after failure_max failures")
+_m_expired = _metrics.counter("master_lease_expired_total",
+                              "leases that timed out and requeued")
+_m_passes = _metrics.counter("master_passes_total", "completed passes")
+_m_task_wait = _metrics.counter(
+    "master_task_wait_seconds_total",
+    "client time spent polling for a task (the data-barrier wait)")
 
 
 @dataclasses.dataclass
@@ -69,13 +89,15 @@ class MasterService:
                  num_passes: Optional[int] = None,
                  snapshot_path: Optional[str] = None,
                  time_fn=time.monotonic,
-                 snapshot_interval: float = 0.05):
+                 snapshot_interval: float = 0.05,
+                 name: str = "master"):
         """num_passes: stop refilling after this many completed passes
         (None = refill forever; the reference's pass barriers are
         WaitPassStart/Finish, proto/ParameterService.proto:89-95).
         Snapshots are written by a debounced background thread at most
         every ``snapshot_interval`` seconds — queue mutations mark state
         dirty instead of serializing the whole queue per RPC."""
+        self.name = name
         self._lock = threading.Lock()
         self._todo: List[Task] = []
         self._pending: Dict[int, tuple] = {}     # id -> (task, deadline)
@@ -112,6 +134,13 @@ class MasterService:
             threading.Thread(target=self._snapshot_loop,
                              daemon=True).start()
 
+    def _export_queues_locked(self):
+        """Refresh the queue-depth gauges (caller holds self._lock)."""
+        for queue, coll in (("todo", self._todo), ("pending", self._pending),
+                            ("done", self._done),
+                            ("discarded", self._discarded)):
+            _m_queue.set(len(coll), service=self.name, queue=queue)
+
     # -- dataset -----------------------------------------------------------
     def set_dataset(self, paths: Sequence[str], chunks_per_task: int = 1):
         """Partition recordio files into tasks of ``chunks_per_task`` chunks
@@ -135,6 +164,7 @@ class MasterService:
             self._discarded.clear()
             self._epoch = 0
             self._version += 1
+            self._export_queues_locked()
         self._snapshot()
         log.info("master: dataset set, %d tasks", len(tasks))
 
@@ -156,6 +186,7 @@ class MasterService:
                 changed = True
             if changed:
                 self._version += 1
+                self._export_queues_locked()
         if changed:
             # mark dirty (service.go snapshots queue transitions to etcd)
             # so a standby master can adopt fresh state on takeover;
@@ -172,6 +203,8 @@ class MasterService:
             self._done.append(ent[0])
             self._maybe_finish_pass_locked()
             self._version += 1
+            self._export_queues_locked()
+        _m_done.inc(service=self.name)
         self._dirty.set()
         return True
 
@@ -185,7 +218,8 @@ class MasterService:
             self._pending.pop(task_id)
             task = ent[0]
             task.fail_count += 1
-            if task.fail_count >= self.failure_max:
+            discarded = task.fail_count >= self.failure_max
+            if discarded:
                 log.warning("master: task %d discarded after %d failures",
                             task.task_id, task.fail_count)
                 self._discarded.append(task)
@@ -193,6 +227,10 @@ class MasterService:
             else:
                 self._todo.append(task)
             self._version += 1
+            self._export_queues_locked()
+        _m_failed.inc(service=self.name)
+        if discarded:
+            _m_discarded.inc(service=self.name)
         self._dirty.set()
 
     def _requeue_expired_locked(self) -> bool:
@@ -201,12 +239,16 @@ class MasterService:
         for tid in expired:
             task, _ = self._pending.pop(tid)
             task.fail_count += 1
+            _m_expired.inc(service=self.name)
             if task.fail_count >= self.failure_max:
                 self._discarded.append(task)
+                _m_discarded.inc(service=self.name)
                 self._maybe_finish_pass_locked()
             else:
                 log.info("master: lease expired, requeueing task %d", tid)
                 self._todo.append(task)
+        if expired:
+            self._export_queues_locked()
         return bool(expired)
 
     def _maybe_finish_pass_locked(self):
@@ -214,6 +256,7 @@ class MasterService:
             # pass complete: everything done/discarded flows back to todo
             # for the next pass, unless num_passes is exhausted
             self._epoch += 1
+            _m_passes.inc(service=self.name)
             finished = self._done + self._discarded
             self._done, self._discarded = [], []
             if self.num_passes is not None and self._epoch >= self.num_passes:
@@ -333,6 +376,7 @@ class MasterService:
             self._discarded = [Task.from_dict(d)
                                for d in state.get("discarded", [])]
             self._version += 1
+            self._export_queues_locked()
         log.info("master: restored %d todo / %d done (epoch %d)",
                  len(self._todo), len(self._done), self._epoch)
 
@@ -361,6 +405,11 @@ class _Handler(socketserver.StreamRequestHandler):
                     resp = {"todo": svc.num_todo(),
                             "pending": svc.num_pending(),
                             "epoch": svc.epoch()}
+                elif method == "metrics":
+                    # poor-man's scrape endpoint: the master process's
+                    # default registry in Prometheus text format
+                    resp = {"text":
+                            _metrics.default_registry().render_prometheus()}
                 elif method == "request_save_model":
                     resp = {"ok": svc.request_save_model(
                         req["trainer_id"], req.get("block_dur", 60.0))}
@@ -701,6 +750,9 @@ class MasterClient:
                 return {"todo": self._svc.num_todo(),
                         "pending": self._svc.num_pending(),
                         "epoch": self._svc.epoch()}
+            if method == "metrics":
+                return {"text":
+                        _metrics.default_registry().render_prometheus()}
             if method == "request_save_model":
                 return {"ok": self._svc.request_save_model(
                     kw["trainer_id"], kw.get("block_dur", 60.0))}
@@ -729,6 +781,11 @@ class MasterClient:
 
     def status(self):
         return self._rpc("status")
+
+    def metrics_text(self) -> str:
+        """Prometheus text snapshot of the master's registry (local or
+        over the wire — the observability scrape path for trainers)."""
+        return self._rpc("metrics")["text"]
 
     def request_save_model(self, trainer_id: str,
                            block_dur: float = 60.0) -> bool:
@@ -759,6 +816,9 @@ class MasterClient:
                     if st["pending"] == 0 and \
                             self.status()["epoch"] >= start_epoch + max_epochs:
                         return
+                    # the stragglers' barrier: this consumer is drained
+                    # while others still hold leases (BarrierStat slot)
+                    _m_task_wait.inc(poll_interval)
                     time.sleep(poll_interval)
                     continue
                 try:

@@ -17,7 +17,13 @@ if not _LOGGER.handlers:
         datefmt="%m%d %H:%M:%S"))
     _LOGGER.addHandler(_handler)
     _level = os.environ.get("PADDLE_TPU_LOGLEVEL", "INFO").upper()
-    if _level not in logging.getLevelNamesMapping():
+    _names = (logging.getLevelNamesMapping()
+              if hasattr(logging, "getLevelNamesMapping")   # 3.11+
+              else {**{n: v for v, n in logging._levelToName.items()},
+                    # aliases getLevelNamesMapping includes but
+                    # _levelToName lacks — keep 3.10 behavior identical
+                    "WARN": logging.WARNING, "FATAL": logging.CRITICAL})
+    if _level not in _names:
         _LOGGER.warning("invalid PADDLE_TPU_LOGLEVEL=%r, using INFO", _level)
         _level = "INFO"
     _LOGGER.setLevel(_level)

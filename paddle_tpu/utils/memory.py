@@ -11,6 +11,7 @@ Tools:
   (AOT lowering only; the reference's equivalent was trial-and-OOM)
 """
 
+import os
 from typing import Callable, Dict, Optional
 
 import jax
@@ -28,14 +29,43 @@ def device_memory_stats(device=None) -> Dict[str, int]:
     return dict(stats) if stats else {}
 
 
+def host_memory_stats() -> Dict[str, int]:
+    """Host-process memory: {rss_bytes, peak_rss_bytes} (best-effort;
+    empty dict on platforms without /proc or resource). Feeds the
+    trainer's host-memory gauge next to the device HBM gauge."""
+    out: Dict[str, int] = {}
+    try:
+        import resource
+        import sys
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        # linux reports KiB, macOS bytes
+        scale = 1 if sys.platform == "darwin" else 1024
+        out["peak_rss_bytes"] = int(ru.ru_maxrss) * scale
+    except (ImportError, ValueError):
+        pass
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        out["rss_bytes"] = rss_pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    return out
+
+
 def step_memory(fn: Callable, *args, static_argnums=()) -> Dict[str, int]:
     """Compile ``fn`` ahead-of-time and report its memory footprint:
     {peak, arguments, outputs, temps} in bytes. Nothing executes."""
     compiled = jax.jit(fn, static_argnums=static_argnums).lower(
         *args).compile()
     ma = compiled.memory_analysis()
+    # older jaxlib lacks peak_memory_in_bytes; args+outputs+temps is the
+    # upper bound the budgeting decisions need (aliasing makes it safe)
+    peak = getattr(ma, "peak_memory_in_bytes", None)
+    if peak is None:
+        peak = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+                + ma.temp_size_in_bytes)
     return {
-        "peak": int(ma.peak_memory_in_bytes),
+        "peak": int(peak),
         "arguments": int(ma.argument_size_in_bytes),
         "outputs": int(ma.output_size_in_bytes),
         "temps": int(ma.temp_size_in_bytes),

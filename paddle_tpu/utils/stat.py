@@ -30,9 +30,20 @@ class Stat:
     def avg_ms(self):
         return 1e3 * self.total_s / max(1, self.count)
 
+    def reset(self):
+        """Zero the accumulators (per-pass printing must not accumulate
+        forever — reference: StatSet::reset, Stat.h)."""
+        self.total_s = 0.0
+        self.count = 0
+        self.max_s = 0.0
+        self.min_s = float("inf")
+
     def __str__(self):
+        if self.count == 0:
+            return f"{self.name}: total 0.0ms count 0"
         return (f"{self.name}: total {self.total_s*1e3:.1f}ms count {self.count} "
-                f"avg {self.avg_ms:.3f}ms max {self.max_s*1e3:.3f}ms")
+                f"avg {self.avg_ms:.3f}ms max {self.max_s*1e3:.3f}ms "
+                f"min {self.min_s*1e3:.3f}ms")
 
 
 class StatSet:
@@ -49,9 +60,16 @@ class StatSet:
                 self._stats[name] = Stat(name)
             return self._stats[name]
 
-    def reset(self):
+    def reset(self, clear: bool = False):
+        """Zero every timer (``clear=True`` drops the entries entirely).
+        Zeroing keeps registered names visible in the next print, which
+        per-pass reporting wants."""
         with self._lock:
-            self._stats.clear()
+            if clear:
+                self._stats.clear()
+            else:
+                for s in self._stats.values():
+                    s.reset()
 
     def print_status(self, log=print):
         with self._lock:
@@ -66,21 +84,13 @@ global_stats = StatSet()
 
 @contextlib.contextmanager
 def timer_scope(name: str, stats: StatSet = None, use_profiler: bool = None):
-    """REGISTER_TIMER_INFO equivalent; optionally also a profiler trace scope."""
-    stats = stats or global_stats
-    if use_profiler is None:
-        from paddle_tpu.utils.flags import GLOBAL_FLAGS
-        use_profiler = GLOBAL_FLAGS.get("profile", False)
-    ctx = contextlib.nullcontext()
-    if use_profiler:
-        import jax.profiler
-        ctx = jax.profiler.TraceAnnotation(name)
-    start = time.perf_counter()
-    try:
-        with ctx:
-            yield
-    finally:
-        stats.get(name).add(time.perf_counter() - start)
+    """REGISTER_TIMER_INFO equivalent; optionally also a profiler trace
+    scope. Thin alias for ``observe.trace_scope`` (the one
+    implementation: nesting-qualified names, profiler annotations that
+    degrade gracefully without jax) kept for source compatibility."""
+    from paddle_tpu.observe.trace import trace_scope  # lazy: avoids cycle
+    with trace_scope(name, stats=stats, use_profiler=use_profiler):
+        yield
 
 
 class Timer:

@@ -3,9 +3,11 @@ initialises.
 
 Mirrors the reference's strategy of running distributed tests without a real
 cluster (SURVEY.md §4.6 — in-process pservers); on TPU the analog is a
-host-simulated multi-device mesh. jax is already imported by the time conftest
-runs (a site hook pulls it in), so we use the config API rather than env vars —
-it takes effect as long as no backend has been initialised yet.
+host-simulated multi-device mesh. XLA_FLAGS is read at backend initialisation
+(not jax import), so setting it here works even when a site hook imported jax
+first — as long as no backend has been initialised yet. The
+``jax_num_cpu_devices`` config option only exists on newer JAX, so it is a
+feature-detected reinforcement, never a hard requirement.
 """
 
 import os
@@ -14,10 +16,20 @@ os.environ.setdefault("PADDLE_TPU_SEED", "42")
 # keep tests fp32-exact on CPU: matmuls would otherwise downcast to bf16
 os.environ.setdefault("PADDLE_TPU_COMPUTE_DTYPE", "float32")
 
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from paddle_tpu.utils.flags import set_xla_host_device_count  # noqa: E402
+
+set_xla_host_device_count(8)   # token-level replace, pre-backend
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # older JAX: XLA_FLAGS above already forces the 8-device mesh
 
 import numpy as np
 import pytest

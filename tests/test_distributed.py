@@ -32,7 +32,7 @@ WORKER = textwrap.dedent("""
     assert dict(mesh.shape) == {{"dcn": 2, "data": 4}}, mesh.shape
 
     # a cross-host psum over both axes: every device contributes 1
-    from jax import shard_map
+    from paddle_tpu.parallel.compat import shard_map
     ones = jnp.ones((8,), jnp.float32)
     sharded = jax.device_put(
         ones, NamedSharding(mesh, P(("dcn", "data"))))

@@ -120,6 +120,36 @@ def test_generate_accepts_quantized_params(rng):
     assert any("i8" in l for l in loops), "int8 absent from decode loop"
 
 
+def test_server_metrics_prometheus_snapshot(tmp_path, rng):
+    """The serving observability surface: prefill/decode call counters,
+    token counter, and per-phase latency histograms, rendered as a
+    Prometheus text snapshot (acceptance: lm_serving exposes
+    prefill/decode latency histograms + token counters)."""
+    params = transformer.init_params(jax.random.PRNGKey(0), CFG)
+    B, Tp, new = 2, 6, 5
+    prompt = rng.randint(0, 40, (B, Tp)).astype(np.int32)
+    path = str(tmp_path / "lm.tar")
+    lm_serving.save_lm_artifact(path, params, CFG, batch=B,
+                                prompt_len=Tp, cache_len=Tp + new)
+    srv = lm_serving.load_lm_artifact(path)
+    srv.generate(prompt, max_new=new)
+    srv.generate(prompt, max_new=new)
+
+    assert srv._m_prefill.value() == 2
+    assert srv._m_decode.value() == 2 * (new - 1)
+    assert srv._m_tokens.value() == 2 * new * B
+    assert srv.metrics.get("lm_prefill_seconds").snapshot()["count"] == 2
+
+    text = srv.metrics_text()
+    assert "# TYPE lm_prefill_seconds histogram" in text
+    assert "# TYPE lm_decode_seconds histogram" in text
+    assert f"lm_tokens_generated_total {2 * new * B}" in text
+    assert "lm_decode_seconds_bucket" in text and 'le="+Inf"' in text
+    # a second server must start from zero (per-server registries)
+    srv2 = lm_serving.load_lm_artifact(path)
+    assert srv2._m_prefill.value() == 0
+
+
 def test_moe_artifact_roundtrip_matches_generate(tmp_path, rng):
     """The serving artifact carries MoE configs transparently (cfg
     round-trips through dataclasses.asdict; decode runs the expert FFN
