@@ -40,11 +40,15 @@ tunnel time. A failing extra mode is dropped; a budget/driver timeout
 with a measurement in hand emits that measurement, never a failure.
 
 Staleness fallback: when the backend is dead for the entire schedule but
-a verified measurement exists in benchmarks/runs/, the gate emits THAT
-value — honestly labelled `stale: true` with `measured_at`/
-`stale_minutes`/`source_file` and the backend failure in
-`backend_error` — instead of a 0.0 that erases the round's evidence.
-Stale records are never re-appended to benchmarks/runs/.
+a verified measurement exists in benchmarks/runs/, the gate record
+carries THAT number under the separate `stale_value` key (with
+`stale: true`, `measured_at`/`stale_minutes`/`source_file`, the source
+run's config under `stale_*` keys, and the backend failure in
+`backend_error`) while `value` stays 0.0 — a consumer reading only
+`value` can never mistake week-old throughput for a fresh measurement,
+and a consumer that understands staleness still gets the evidence
+(ADVICE.md round-5). Stale records are never re-appended to
+benchmarks/runs/.
 """
 
 import glob
@@ -489,16 +493,21 @@ def _final_fail(reason):
     lv = last_verified()
     stale_cap = float(os.environ.get("BENCH_STALE_MAX_MINUTES", 10080))
     if lv and (time.time() - lv[3]) / 60 <= stale_cap:
-        # the backend is dead but a verified measurement exists: emit it
-        # as the gate value, honestly labelled stale, instead of a 0.0
-        # that erases the evidence (the fourth-round lesson). Evidence
-        # older than the cap (default 7 days) no longer passes the gate.
+        # the backend is dead but a verified measurement exists: carry
+        # it under the SEPARATE stale_value key, honestly labelled,
+        # while `value` stays 0.0 — the evidence survives (the fourth-
+        # round lesson) without a value-only consumer mistaking it for
+        # a fresh measurement (the fifth-round advice). Evidence older
+        # than the cap (default 7 days) is dropped entirely.
         value, ts, fname, mt, src = lv
-        # carry the SOURCE record's config, not this process's — the
-        # evidence may have been measured under a different recipe
-        cfg = {k: src[k] for k in ("fused_bn", "stem_space_to_depth",
-                                   "mfu") if k in src}
-        emit(value, stale=True, measured_at=ts, source_file=fname,
+        # the SOURCE record's config, stale_-prefixed — the evidence may
+        # have been measured under a different recipe than this process
+        cfg = {f"stale_{k}": src[k]
+               for k in ("fused_bn", "stem_space_to_depth", "mfu")
+               if k in src}
+        emit(0.0, stale=True, stale_value=value,
+             stale_vs_baseline=round(value / NORTH_STAR, 4),
+             measured_at=ts, source_file=fname,
              stale_minutes=round((time.time() - mt) / 60),
              backend_error=failure, probes=_state["probes"],
              bench_attempts=_state["children"], **cfg)

@@ -182,27 +182,55 @@ def analyze_schedule(txt: str):
     comp_idx = [i for (i, _, _) in compute_lines]
     n_lines = max(1, len(lines))
     sync = []
+    unparsed = []
     for (i, k, name, b) in events:
         if k not in ("all-reduce", "reduce-scatter", "all-gather"):
             continue
         after = sum(1 for j in comp_idx if j > i)
         group = _parse_group(lines[i])
+        # a replica_groups encoding _parse_group doesn't know falls back
+        # to all-devices-over-ICI in the wire model — FLAG it so a
+        # misparse is visible in the artifact instead of silently
+        # misclassifying DCN-crossing collectives (ADVICE.md round-5)
+        group_unparsed = (group is None
+                          and "replica_groups=" in lines[i])
+        if group_unparsed:
+            unparsed.append({"name": name, "op": k,
+                             "line": lines[i].strip()[:300]})
         sync.append({"name": name, "op": k, "bytes": b,
                      "pos_frac": round(i / n_lines, 4),
                      "compute_ops_after": after,
                      "group_size": len(group) if group else None,
-                     "group_example": group[:16] if group else None})
+                     "group_example": group[:16] if group else None,
+                     "group_unparsed": group_unparsed})
     return {"async_windows": windows, "sync_all_reduces": sync,
             "total_compute_ops": len(compute_lines),
+            "unparsed_replica_groups": unparsed,
             "megascale_sends": megascale_sends,
             "megascale_send_bytes": megascale_send_bytes}
+
+
+def _parse_topology_devices(name):
+    """Per-slice device count from an `AxB`-style topology name
+    ('v5e:2x4' → 8, 'v4:2x2x2' → 8, 'v5e:8' → 8); None when the name
+    carries no parseable dims (use --num-devices then)."""
+    m = re.search(r"(\d+(?:x\d+)+)", name)
+    if m:
+        n = 1
+        for d in m.group(1).split("x"):
+            n *= int(d)
+        return n
+    m = re.search(r":(\d+)$", name)
+    return int(m.group(1)) if m else None
 
 
 def _parse_group(ln):
     """First replica group of a collective line as a device-id list.
     Two HLO formats: iota `replica_groups=[G,S]<=[N]` (G groups of S,
     group 0 = 0..S-1 in iota order) and explicit
-    `replica_groups={{0,8},{1,9},...}`."""
+    `replica_groups={{0,8},{1,9},...}`. Unknown encodings return None —
+    the caller flags them in the artifact (`group_unparsed`) rather
+    than trusting the all-devices default silently."""
     m = re.search(r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\]"
                   r"(T\([\d,]+\))?", ln)
     if m:
@@ -239,14 +267,19 @@ def main():
                     help="analyze a previously dumped scheduled-HLO text "
                     "instead of recompiling (the deviceless XLA:TPU "
                     "compile of this step takes ~20 min on one core)")
+    ap.add_argument("--num-devices", type=int, default=None,
+                    help="per-slice device count for --hlo-file analysis "
+                    "when the topology name has no AxB dims to parse")
     ap.add_argument("--dump-hlo", default=None,
                     help="save the compiled HLO text here for --hlo-file "
                     "reuse")
     args = ap.parse_args()
 
     if args.hlo_file:
-        n = (8 if "2x4" in args.topology else None)
-        assert n, "--hlo-file analysis needs a 2x4-style topology name"
+        n = args.num_devices or _parse_topology_devices(args.topology)
+        if not n:
+            ap.error(f"cannot derive a device count from topology "
+                     f"{args.topology!r}; pass --num-devices")
         n *= args.num_slices
         with open(args.hlo_file) as f:
             txt = f.read()
@@ -391,7 +424,14 @@ def main():
         "dp_efficiency_full_overlap": round(eff_full_overlap, 4),
         "dp_efficiency_scheduled": round(eff_sched, 4),
         "total_compute_ops": sched["total_compute_ops"],
+        "unparsed_replica_groups": len(sched["unparsed_replica_groups"]),
     }
+    if sched["unparsed_replica_groups"]:
+        print(f"WARNING: {len(sched['unparsed_replica_groups'])} "
+              f"collective(s) with unparsed replica_groups — the wire "
+              f"model assumed all-devices-over-ICI for them (see "
+              f"`unparsed_replica_groups` in the artifact)",
+              file=sys.stderr)
     print(json.dumps(result, indent=2))
     slug = args.topology.replace(":", "_") + (
         f"_x{args.num_slices}" if args.num_slices > 1 else "")
@@ -401,7 +441,9 @@ def main():
                        key=lambda s: -s["bytes"])[:40]
     with open(out, "w") as f:
         json.dump({**result, "windows": sched["async_windows"],
-                   "largest_sync_all_reduces": sync_tail}, f, indent=2)
+                   "largest_sync_all_reduces": sync_tail,
+                   "unparsed_replica_group_lines":
+                       sched["unparsed_replica_groups"]}, f, indent=2)
     print(f"wrote {out}")
 
 

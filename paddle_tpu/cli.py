@@ -52,6 +52,12 @@ def _build_trainer(cfg, args):
 def job_train(cfg, args):
     import paddle_tpu as paddle
     trainer, params = _build_trainer(cfg, args)
+    health_srv = None
+    if args.health_port is not None:
+        health_srv = trainer.attach_observability(
+            host=args.health_host, port=args.health_port)
+        print(f"observability: {health_srv.url}/metrics  "
+              f"{health_srv.url}/healthz")
     batch_size = cfg.get("batch_size", 64)
     reader = paddle.batch(cfg["reader"], batch_size)
     test_reader = cfg.get("test_reader")
@@ -78,8 +84,12 @@ def job_train(cfg, args):
                 with open(os.path.join(pdir, "params.tar"), "wb") as f:
                     trainer.save_parameter_to_tar(f)
 
-    trainer.train(reader, num_passes=args.num_passes, event_handler=handler,
-                  feeding=cfg.get("feeding"))
+    try:
+        trainer.train(reader, num_passes=args.num_passes,
+                      event_handler=handler, feeding=cfg.get("feeding"))
+    finally:
+        if health_srv is not None:
+            health_srv.close()
     return 0
 
 
@@ -135,23 +145,26 @@ def measure_time(cfg, batch_size=None, time_batches=20, warmup_batches=3,
 
     if not batches:
         raise ValueError("job=time: reader yielded no batches")
+    from paddle_tpu import observe
     t_start = _time.perf_counter()
     feeds_list = [jax.device_put(feeder.feed(b)) for b in batches]
     jax.block_until_ready(feeds_list)
     nb = len(feeds_list)
     cost = None
-    for i in range(warmup_batches):
-        cost, pv, ov, sv, _ = step(pv, ov, sv, feeds_list[i % nb],
-                                   jnp_int32(i), key)
-    if cost is not None:
-        full_sync(pv, cost)
+    with observe.trace_scope("time_job/warmup"):
+        for i in range(warmup_batches):
+            cost, pv, ov, sv, _ = step(pv, ov, sv, feeds_list[i % nb],
+                                       jnp_int32(i), key)
+        if cost is not None:
+            full_sync(pv, cost)
     warmup_s = _time.perf_counter() - t_start
     t0 = _time.perf_counter()
-    for i in range(time_batches):
-        cost, pv, ov, sv, _ = step(pv, ov, sv, feeds_list[i % nb],
-                                   jnp_int32(warmup_batches + i), key)
-    if cost is not None:
-        full_sync(pv, cost)   # one sync for the whole run: steps are serial
+    with observe.trace_scope("time_job/timed"):
+        for i in range(time_batches):
+            cost, pv, ov, sv, _ = step(pv, ov, sv, feeds_list[i % nb],
+                                       jnp_int32(warmup_batches + i), key)
+        if cost is not None:
+            full_sync(pv, cost)   # one sync for the run: steps are serial
     elapsed = _time.perf_counter() - t0
     ms = 1000 * elapsed / time_batches if time_batches else float("nan")
     return {
@@ -170,6 +183,10 @@ def job_time(cfg, args):
     r = measure_time(cfg, time_batches=args.time_batches,
                      warmup_batches=args.warmup_batches,
                      init_model_path=args.init_model_path)
+    from paddle_tpu import observe
+    if observe.has_consumers():
+        # --metrics_out promises a JSONL trail for the time job too
+        observe.report(dict(r), kind="time_job")
     print(f"time job: {r['ms_per_batch']:.2f} ms/batch, "
           f"{r['examples_per_sec']:.1f} examples/sec "
           f"(batch_size={r['batch_size']}, "
@@ -267,12 +284,23 @@ def _pct(sorted_vals, q):
 
 
 def job_stats(cfg, args):
-    """Observability snapshot (the tentpole CLI surface): with
-    --metrics_file, summarize + tail a JSONL per-step metrics log written
-    by the trainer/bench (`observe.JsonlSink`); without one, render the
-    current process's default metrics registry (--format=prom gives the
-    Prometheus text exposition)."""
+    """Observability snapshot: with --metrics_file, summarize + tail a
+    JSONL per-step metrics log written by the trainer/bench
+    (`observe.JsonlSink`); with --trace, export the in-process span
+    buffer as Chrome-trace JSON; otherwise render the current process's
+    default metrics registry (--format=prom gives the Prometheus text
+    exposition)."""
     from paddle_tpu import observe
+
+    if args.trace:
+        trace = observe.trace_export(args.trace)
+        n = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+        names = {e["name"] for e in trace["traceEvents"]
+                 if e.get("ph") == "X"}
+        print(f"wrote {n} spans ({len(names)} distinct) to {args.trace} "
+              f"— open in chrome://tracing or https://ui.perfetto.dev")
+        if not args.metrics_file and args.format == "pretty":
+            return 0
 
     if args.metrics_file:
         try:
@@ -432,6 +460,16 @@ def main(argv=None):
     p.add_argument("--metrics_out", default=None,
                    help="write per-step JSONL metrics here (train/time "
                         "jobs; same as PADDLE_TPU_METRICS_PATH)")
+    p.add_argument("--trace", default=None,
+                   help="export the run's trace-scope spans as Chrome-"
+                        "trace JSON to this path when the job finishes "
+                        "(job=stats: export the buffer immediately)")
+    p.add_argument("--health_port", type=int, default=None,
+                   help="serve /metrics + /healthz on this port during "
+                        "job=train (0 = ephemeral)")
+    p.add_argument("--health_host", default="127.0.0.1",
+                   help="bind address for --health_port (use 0.0.0.0 "
+                        "for out-of-pod probes; default loopback)")
     args = p.parse_args(argv)
 
     if args.metrics_out:
@@ -444,7 +482,16 @@ def main(argv=None):
     if not args.config:
         p.error(f"--config is required for job={args.job}")
     cfg = _load_config(args.config)
-    return jobs[args.job](cfg, args)
+    try:
+        rc = jobs[args.job](cfg, args)
+    finally:
+        # export even when the job crashes — a timeline of the steps
+        # leading up to the failure is the trace most worth having
+        if args.trace:
+            from paddle_tpu import observe
+            observe.trace_export(args.trace)
+            print(f"trace written to {args.trace}")
+    return rc
 
 
 if __name__ == "__main__":

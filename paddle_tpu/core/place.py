@@ -11,6 +11,7 @@ follows the scaling-book convention: ``data`` (DP), ``model`` (TP),
 """
 
 import functools
+import os
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -23,6 +24,50 @@ AXIS_MODEL = "model"
 AXIS_SEQ = "seq"
 AXIS_EXPERT = "expert"
 AXIS_STAGE = "stage"
+
+# Declared per-chip peak dense-matmul FLOP/s (bf16 with fp32 accumulation
+# — the MXU number every published TPU spec quotes), keyed by substrings
+# of ``device.device_kind``. Matched longest-pattern-first so "v5 lite"
+# wins over "v5". The MFU accounting in ``observe.costs`` divides by
+# this; ``PADDLE_TPU_PEAK_TFLOPS`` overrides (also how a future chip gets
+# a number before the table learns it). The "cpu" entry is a NOMINAL
+# placeholder (0.1 TFLOP/s) so the MFU plumbing stays exercised in CPU
+# tests — absolute CPU MFU values are meaningless and documented as such.
+PEAK_FLOPS_TABLE = (
+    ("v6 lite", 918e12),      # Trillium / v6e
+    ("v6e", 918e12),
+    ("v5 lite", 197e12),      # v5e (device_kind: "TPU v5 lite" / "v5e")
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v4 lite", 137e12),      # v4i
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+    ("cpu", 0.1e12),          # nominal — see note above
+)
+
+
+def peak_flops(device=None) -> Optional[float]:
+    """Declared peak FLOP/s of ``device`` (default: the default device).
+
+    Resolution order: ``PADDLE_TPU_PEAK_TFLOPS`` (in TFLOP/s) →
+    longest-matching ``PEAK_FLOPS_TABLE`` entry against the device kind
+    → None (unknown hardware; MFU reporting then stays silent rather
+    than inventing a denominator)."""
+    env = os.environ.get("PADDLE_TPU_PEAK_TFLOPS")
+    if env:
+        try:
+            return float(env) * 1e12
+        except ValueError:
+            pass
+    device = device or default_device()
+    kind = (getattr(device, "device_kind", "") or device.platform).lower()
+    best = None
+    for pat, flops in PEAK_FLOPS_TABLE:
+        if pat in kind and (best is None or len(pat) > len(best[0])):
+            best = (pat, flops)
+    return best[1] if best else None
 
 
 def local_devices(platform: Optional[str] = None):

@@ -141,6 +141,7 @@ def barrier(name: str = "barrier") -> float:
     Single-process: returns 0.0 immediately (still counted)."""
     import jax
 
+    wall0 = time.time()
     t0 = time.perf_counter()
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
@@ -148,6 +149,10 @@ def barrier(name: str = "barrier") -> float:
     dt = time.perf_counter() - t0
     _m_barriers.inc(name=name)
     _m_barrier_s.observe(dt, name=name)
+    # barrier waits in the Chrome trace: with pid = process index, the
+    # merged multi-host timeline shows exactly which host straggled
+    from paddle_tpu.observe import chrome_trace
+    chrome_trace.record_span(f"barrier/{name}", wall0, dt)
     return dt
 
 

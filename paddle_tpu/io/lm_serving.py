@@ -23,6 +23,7 @@ import numpy as np
 
 from paddle_tpu.io.checkpoint import _flatten          # shared pytree walk
 from paddle_tpu.io.merged import _add_member as _add   # shared tar append
+from paddle_tpu.observe import costs as _costs
 from paddle_tpu.observe import metrics as _metrics
 
 FORMAT_VERSION = 2   # max supported; plain artifacts still save as v1
@@ -140,22 +141,34 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
             a.dtype if hasattr(a, "dtype") else np.asarray(a).dtype),
         params)
     toks = jax.ShapeDtypeStruct((batch, prompt_len), jnp.int32)
-    exp_prefill = jax.export.export(jax.jit(prefill_fn), **kw)(
+    jit_prefill, jit_decode = jax.jit(prefill_fn), jax.jit(decode_fn)
+    exp_prefill = jax.export.export(jit_prefill, **kw)(
         p_shapes, toks)
     cache_shapes = jax.tree_util.tree_map(
         lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
         transformer.init_cache(cfg, batch, cache_len))
-    exp_decode = jax.export.export(jax.jit(decode_fn), **kw)(
-        p_shapes, cache_shapes,
-        jax.ShapeDtypeStruct((batch,), jnp.int32),
-        jax.ShapeDtypeStruct((), jnp.int32))
+    decode_args = (p_shapes, cache_shapes,
+                   jax.ShapeDtypeStruct((batch,), jnp.int32),
+                   jax.ShapeDtypeStruct((), jnp.int32))
+    exp_decode = jax.export.export(jit_decode, **kw)(*decode_args)
+
+    # per-phase cost accounting, stamped into the artifact at export
+    # time (the loader has no model code to re-derive it from): the MFU
+    # denominator's numerator for any host that serves this file
+    cost_analysis = {}
+    for phase, fn, args in (("prefill", jit_prefill, (p_shapes, toks)),
+                            ("decode", jit_decode, decode_args)):
+        ca = _costs.lowered_cost(fn, *args)
+        if ca:
+            cost_analysis[phase] = ca
 
     meta = {
         # quantized artifacts carry nested {"q8","scale"} params — a v2
         # encoding; plain artifacts stay v1 for older loaders
         "format_version": 2 if weights_int8 else 1,
         "batch": batch, "prompt_len": prompt_len, "cache_len": cache_len,
-        "weights_int8": weights_int8, "config": _cfg_to_dict(cfg)}
+        "weights_int8": weights_int8, "config": _cfg_to_dict(cfg),
+        "cost_analysis": cost_analysis}
     flat = _flatten(params)
     buf = _io.BytesIO()
     np.savez(buf, **flat)
@@ -208,10 +221,37 @@ class LMServer:
         self._m_decode_s = reg.histogram(
             "lm_decode_seconds", "per-token decode latency "
             "(device call + sample)", buckets=_LATENCY_BUCKETS)
+        # cost accounting stamped at export time (older artifacts: {})
+        self.cost_analysis = meta.get("cost_analysis", {})
+        self._m_mfu = reg.gauge(
+            "lm_decode_mfu", "model-FLOPs utilisation of the last decode "
+            "step (0 until the artifact carries cost_analysis)")
+        # constant for the process — resolved once, not per decoded token
+        self._peak_flops = _costs.device_peak_flops()
+        self._last_generate = None
 
     def metrics_text(self) -> str:
         """Prometheus text exposition snapshot of this server's metrics."""
         return self.metrics.render_prometheus()
+
+    def health(self) -> dict:
+        """/healthz document: request/token progress of this server."""
+        since = (round(time.perf_counter() - self._last_generate, 3)
+                 if self._last_generate is not None else None)
+        return {"requests": int(self._m_requests.value()),
+                "tokens_generated": int(self._m_tokens.value()),
+                "decode_steps": int(self._m_decode.value()),
+                "seconds_since_request": since,
+                "batch": self.meta["batch"],
+                "cache_len": self.meta["cache_len"]}
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        """Start an ``observe.HealthServer`` over THIS server's registry
+        (``/metrics``) and ``health()`` (``/healthz``). Returns the
+        server; callers own its ``close()``."""
+        from paddle_tpu.observe.health import HealthServer
+        return HealthServer(registry=self.metrics, health_fn=self.health,
+                            host=host, port=port)
 
     def generate(self, prompt: np.ndarray, max_new: int,
                  temperature: float = 0.0,
@@ -240,6 +280,8 @@ class LMServer:
                                for row in p], np.int32)
 
         self._m_requests.inc()
+        self._last_generate = time.perf_counter()
+        decode_flops = self.cost_analysis.get("decode", {}).get("flops")
         t0 = time.perf_counter()
         logits, cache = self._prefill.call(
             self.params, jnp.asarray(prompt, jnp.int32))
@@ -255,9 +297,14 @@ class LMServer:
                 self.params, cache, jnp.asarray(toks[-1], jnp.int32),
                 jnp.asarray(tp + i, jnp.int32))
             toks.append(sample(np.asarray(logits)))
+            dt = time.perf_counter() - t0
             self._m_decode.inc()
-            self._m_decode_s.observe(time.perf_counter() - t0)
+            self._m_decode_s.observe(dt)
             self._m_tokens.inc(b)
+            if self._peak_flops:
+                mfu = _costs.mfu(decode_flops, dt, self._peak_flops)
+                if mfu is not None:
+                    self._m_mfu.set(mfu)
         return np.concatenate([prompt,
                                np.stack(toks, axis=1)], axis=1)
 

@@ -187,6 +187,10 @@ def save_inference_model(path: str, output_layer, parameters,
                        for l in topo.data_layers if l.data_spec is not None},
         "rebuildable": rebuildable,
         "export_batch_sizes": list(export_batch_sizes),
+        # per-exported-batch-size FLOPs/bytes from the lowered-HLO cost
+        # model (observe.costs): MFU accounting for whatever host serves
+        # this artifact, stamped at export time
+        "cost_analysis": {},
     }
 
     with tarfile.open(path, "w") as tar:
@@ -198,13 +202,14 @@ def save_inference_model(path: str, output_layer, parameters,
         _add_member(tar, "state.npz", _npz_bytes(parameters.state))
         if export_batch_sizes:
             import jax.export  # noqa: F401 — needs an explicit import
+            from paddle_tpu.observe import costs as _costs
             serve = jax.jit(_serve_fn(topo))
             for bs in export_batch_sizes:
                 feeds = example_feeds(topo, bs)
                 kw = {}
                 if platforms:
                     kw["platforms"] = list(platforms)
-                exp = jax.export.export(serve, **kw)(
+                abstract = (
                     jax.tree_util.tree_map(
                         lambda v: jax.ShapeDtypeStruct(
                             np.shape(v),
@@ -215,6 +220,10 @@ def save_inference_model(path: str, output_layer, parameters,
                      for k, v in parameters.state.items()},
                     {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                      for k, v in feeds.items()})
+                exp = jax.export.export(serve, **kw)(*abstract)
+                ca = _costs.lowered_cost(serve, *abstract)
+                if ca:
+                    meta["cost_analysis"][str(bs)] = ca
                 _add_member(tar, f"exported_bs{bs}.bin", exp.serialize())
         _add_member(tar, "meta.json", json.dumps(meta).encode())
 
@@ -235,6 +244,13 @@ class MergedModel:
     @property
     def outputs(self):
         return self.meta["outputs"]
+
+    @property
+    def cost_analysis(self):
+        """{batch_size: {"flops", "bytes_accessed"}} stamped at export
+        time (empty for pre-cost-accounting artifacts)."""
+        return {int(k): v for k, v in
+                self.meta.get("cost_analysis", {}).items()}
 
     def _forward(self):
         import jax

@@ -1,11 +1,22 @@
 """paddle_tpu.observe — the unified observability layer.
 
-Three pieces:
+The pieces:
 
 - ``observe.metrics`` — Counter/Gauge/Histogram registry with a JSONL
   scalar sink and a Prometheus text renderer (stdlib-only).
 - ``observe.trace`` — nested trace scopes over ``utils/stat.py`` that
-  open ``jax.profiler`` annotations when profiling is enabled.
+  open ``jax.profiler`` annotations when profiling is enabled, and
+  record spans into the Chrome-trace buffer.
+- ``observe.chrome_trace`` — the bounded span buffer + ``trace_export``
+  rendering chrome://tracing / Perfetto JSON.
+- ``observe.costs`` — XLA cost-model FLOPs/bytes per step + MFU against
+  the ``core/place.py`` peak-FLOPs table.
+- ``observe.compile_tracker`` — jit cache-miss counting with the
+  arg-shape signature behind each miss and a recompile-storm warning.
+- ``observe.flight`` — flight recorder: last-K step ring + config/env
+  snapshot dumped as a JSON post-mortem on NaN/crash.
+- ``observe.health`` — stdlib HTTP ``/metrics`` + ``/healthz`` server
+  attachable to the trainer, LMServer, and MasterServer.
 - ``observe.report()`` — the one funnel the trainer (and anything else)
   pushes per-step records through: every record goes to the configured
   JSONL sink and to any registered handlers, while the existing
@@ -18,12 +29,24 @@ Typical wiring::
     # PADDLE_TPU_METRICS_PATH=metrics.jsonl in the environment
     ...train...
     # then: paddle_tpu stats --metrics_file=metrics.jsonl
+    #       paddle_tpu stats --trace trace.json   (Perfetto timeline)
 """
 
 import os
 import threading
 from typing import Callable, List, Optional
 
+from paddle_tpu.observe.chrome_trace import (  # noqa: F401
+    SpanBuffer, default_buffer, record_span, set_trace_capacity,
+    trace_enabled, trace_export)
+from paddle_tpu.observe import costs  # noqa: F401 — observe.costs.*
+from paddle_tpu.observe.compile_tracker import (  # noqa: F401
+    CompileTracker, arg_signature, default_compile_tracker,
+    track_compiles)
+from paddle_tpu.observe.flight import (  # noqa: F401
+    FlightRecorder, default_flight_recorder, flight_dir,
+    install_excepthook)
+from paddle_tpu.observe.health import HealthServer  # noqa: F401
 from paddle_tpu.observe.metrics import (  # noqa: F401 — public surface
     Counter, Gauge, Histogram, JsonlSink, Registry, counter,
     default_registry, gauge, histogram, read_jsonl)
@@ -32,27 +55,35 @@ from paddle_tpu.observe.trace import (  # noqa: F401
 
 _lock = threading.Lock()
 _sink: Optional[JsonlSink] = None
-_sink_source = None            # "configure" | "env" — env never overrides
-_env_checked = False           # PADDLE_TPU_METRICS_PATH probed once
+_sink_source = None        # "configure" | "flag" | "env" — see sink_source()
+_explicit_off = False      # configure(None) from user code: defaults (env
+                           # var, metrics_path flag) must not resurrect one
+_env_checked = False       # PADDLE_TPU_METRICS_PATH probed once
 _handlers: List[Callable[[dict], None]] = []
 
 
 def configure(jsonl_path: Optional[str] = None,
-              flush_every: int = 32) -> Optional[JsonlSink]:
+              flush_every: int = 32,
+              _source: str = "configure") -> Optional[JsonlSink]:
     """Install (or with ``jsonl_path=None`` remove) the process-wide JSONL
-    metrics sink that ``report()`` feeds. Returns the sink."""
-    global _sink, _sink_source, _env_checked
+    metrics sink that ``report()`` feeds. Returns the sink. ``_source``
+    tags where the sink came from ("configure" | "flag" | "env") so
+    precedence between them stays decidable — callers other than the
+    framework itself should leave it alone."""
+    global _sink, _sink_source, _env_checked, _explicit_off
     with _lock:
         if _sink is not None:
             _sink.close()
             _sink = None
         _sink_source = None
         # explicit configuration settles the question — configure(None)
-        # means "no sink", the env var must not resurrect one
+        # from user code means "no sink", and neither the env var nor
+        # the metrics_path flag may resurrect one
         _env_checked = True
+        _explicit_off = jsonl_path is None and _source == "configure"
         if jsonl_path:
             _sink = JsonlSink(jsonl_path, flush_every=flush_every)
-            _sink_source = "configure"
+            _sink_source = _source
         return _sink
 
 
@@ -83,6 +114,21 @@ def sink() -> Optional[JsonlSink]:
     if not _env_checked:
         _env_autoconfigure()
     return _sink
+
+
+def sink_source() -> Optional[str]:
+    """Where the active sink came from: "configure" (explicit code),
+    "flag" (metrics_path flag via the trainer), or "env"
+    (PADDLE_TPU_METRICS_PATH autoconfiguration); None without a sink.
+    Lets callers honor explicit configuration over the defaults."""
+    sink()                     # settle the env probe first
+    return _sink_source
+
+
+def explicitly_disabled() -> bool:
+    """True after a user-code ``configure(None)``: the trainer's flag
+    path must not resurrect the sink the user just turned off."""
+    return _explicit_off
 
 
 def has_consumers() -> bool:
@@ -127,15 +173,20 @@ def report(record: Optional[dict] = None, **scalars) -> dict:
 
 
 def reset():
-    """Drop the sink and handlers and zero every default-registry series
-    (test isolation). Registrations survive — module-level metric objects
+    """Drop the sink and handlers, zero every default-registry series,
+    and clear the span buffer / flight ring / compile tracker (test
+    isolation). Registrations survive — module-level metric objects
     (trainer, master, distributed) must stay wired to the registry."""
-    global _sink, _sink_source, _env_checked
+    global _sink, _sink_source, _env_checked, _explicit_off
     with _lock:
         if _sink is not None:
             _sink.close()
         _sink = None
         _sink_source = None
         _env_checked = False
+        _explicit_off = False
         _handlers.clear()
     default_registry().clear_series()
+    default_buffer().clear()
+    default_flight_recorder().clear()
+    default_compile_tracker().clear()

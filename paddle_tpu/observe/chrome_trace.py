@@ -1,0 +1,169 @@
+"""Chrome-trace export: trace scopes recorded into a bounded buffer.
+
+``observe.trace_scope`` / ``step_scope`` already accumulate wall time
+into StatSet timers; this module additionally records each closed scope
+as a *span* — (qualified name, wall-clock start, duration, thread) —
+into a bounded in-memory ring buffer, and renders the buffer as
+``chrome://tracing`` / Perfetto JSON (the Trace Event Format, "X"
+complete events).
+
+Multi-host: the event ``pid`` is the distributed process index
+(PADDLE_PROCESS_ID from the launcher, or ``jax.process_index()`` when a
+backend is already up), so traces exported by every host of a
+``distributed`` run concatenate into one timeline that Perfetto groups
+per process. Timestamps are wall-clock epoch microseconds for the same
+reason — hosts share a clock to NTP precision, which is enough to line
+up multi-second training steps.
+
+Stdlib-only and jax-free at import time (the bench orchestrator and the
+CLI both import ``observe``).
+"""
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+def _env_capacity(default: int = 16384) -> int:
+    """Spans kept in the ring buffer; ~100 bytes each. 0 disables
+    recording. A malformed env value falls back to the default — it
+    must not kill every entry point that imports observe (same guard
+    as PADDLE_TPU_PEAK_TFLOPS)."""
+    try:
+        return int(os.environ.get("PADDLE_TPU_TRACE_BUFFER", default))
+    except ValueError:
+        return default
+
+
+DEFAULT_CAPACITY = _env_capacity()
+
+
+class SpanBuffer:
+    """Thread-safe bounded ring of closed spans (oldest evicted first)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._capacity = max(0, int(capacity))
+        self._spans = collections.deque(maxlen=self._capacity or 1)
+        self._dropped = 0
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    @property
+    def enabled(self) -> bool:
+        return self._capacity > 0
+
+    def add(self, name: str, ts_s: float, dur_s: float,
+            tid: Optional[int] = None, args: Optional[dict] = None):
+        if not self._capacity:
+            return
+        if tid is None:
+            tid = threading.get_ident()
+        with self._lock:
+            if len(self._spans) == self._capacity:
+                self._dropped += 1
+            self._spans.append((name, ts_s, dur_s, tid, args))
+
+    def spans(self) -> List[tuple]:
+        with self._lock:
+            return list(self._spans)
+
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def clear(self):
+        with self._lock:
+            self._spans.clear()
+            self._dropped = 0
+
+    def __len__(self):
+        with self._lock:
+            return len(self._spans)
+
+
+_default = SpanBuffer()
+
+
+def default_buffer() -> SpanBuffer:
+    return _default
+
+
+def set_trace_capacity(capacity: int) -> SpanBuffer:
+    """Resize (or with 0 disable) the default span buffer. Existing
+    spans are dropped — call before the run, not mid-trace."""
+    global _default
+    _default = SpanBuffer(capacity)
+    return _default
+
+
+def record_span(name: str, ts_s: float, dur_s: float,
+                args: Optional[dict] = None):
+    """Append one closed span to the default buffer (no-op when trace
+    recording is disabled). ``ts_s`` is wall-clock epoch seconds."""
+    _default.add(name, ts_s, dur_s, args=args)
+
+
+def trace_enabled() -> bool:
+    return _default.enabled
+
+
+def _process_index() -> int:
+    """Distributed process index without forcing a jax backend init:
+    the launcher env contract first, then jax only if already imported
+    (export runs after training, when the backend is long up)."""
+    env = os.environ.get("PADDLE_PROCESS_ID")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    if "jax" in sys.modules:
+        try:
+            return sys.modules["jax"].process_index()
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            pass
+    return 0
+
+
+def trace_export(path: Optional[str] = None,
+                 buffer: Optional[SpanBuffer] = None,
+                 process_index: Optional[int] = None) -> dict:
+    """Render the span buffer as a Chrome Trace Event Format object
+    (open in chrome://tracing or https://ui.perfetto.dev). Writes JSON
+    to ``path`` when given; always returns the trace dict.
+
+    ``process_index`` overrides the pid (tests / offline merge tools);
+    by default it comes from the distributed process index so per-host
+    exports merge cleanly.
+    """
+    buffer = buffer or _default
+    pid = _process_index() if process_index is None else int(process_index)
+    # stable small tids per thread ident, in first-seen order
+    tid_map: Dict[int, int] = {}
+    events = [{"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+               "args": {"name": f"paddle_tpu p{pid}"}}]
+    for name, ts_s, dur_s, ident, args in buffer.spans():
+        tid = tid_map.setdefault(ident, len(tid_map))
+        ev = {"name": name, "cat": "paddle_tpu", "ph": "X",
+              "ts": round(ts_s * 1e6, 3), "dur": round(dur_s * 1e6, 3),
+              "pid": pid, "tid": tid}
+        if args:
+            ev["args"] = args
+        events.append(ev)
+    for ident, tid in tid_map.items():
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": tid, "args": {"name": f"thread-{tid}"}})
+    trace = {"traceEvents": events, "displayTimeUnit": "ms",
+             "otherData": {"dropped_spans": buffer.dropped()}}
+    if path:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(trace, f)
+    return trace

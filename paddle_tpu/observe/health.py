@@ -1,0 +1,103 @@
+"""Health endpoints: a tiny stdlib HTTP server for /metrics + /healthz.
+
+One ``HealthServer`` serves two GET routes:
+
+- ``/metrics`` — the Prometheus text exposition of a registry (default:
+  the process-wide default registry), scrape-ready;
+- ``/healthz`` — a JSON liveness/progress document from a caller-
+  provided ``health_fn()`` (step progress for a trainer, queue depths
+  for a master, request counters for an LMServer). A ``"healthy":
+  False`` key turns the response into HTTP 503 so load balancers and
+  kubelets can act on it without parsing the body.
+
+Attach points: ``SGD.attach_observability()``, ``LMServer.serve()``,
+``MasterServer(http_port=...)`` — or construct one directly around any
+registry. ``port=0`` binds an ephemeral port (tests); the server runs
+on a daemon thread and must be ``close()``d for a clean shutdown.
+
+Stdlib-only: serving observability must not add dependencies to the
+serving path.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+
+class HealthServer:
+    def __init__(self, registry=None, health_fn: Optional[Callable[[],
+                 dict]] = None, host: str = "127.0.0.1", port: int = 0):
+        if registry is None:
+            from paddle_tpu.observe.metrics import default_registry
+            registry = default_registry()
+        self.registry = registry
+        self.health_fn = health_fn
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):   # silence per-request spam
+                pass
+
+            def _send(self, code, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        text = outer.registry.render_prometheus()
+                        self._send(200, text.encode(),
+                                   "text/plain; version=0.0.4")
+                    elif path == "/healthz":
+                        code, doc = outer._health()
+                        self._send(code, json.dumps(doc).encode(),
+                                   "application/json")
+                    else:
+                        self._send(404, b'{"error": "not found"}\n',
+                                   "application/json")
+                except (ConnectionError, BrokenPipeError, OSError):
+                    # scraper timed out / hung up mid-write: nothing to
+                    # answer and nobody to answer it to — swallow, or a
+                    # traceback hits the job's stderr per scrape timeout
+                    pass
+                except Exception as e:  # noqa: BLE001 — a broken probe
+                    # must answer 500, not kill the handler thread
+                    try:
+                        self._send(500, json.dumps(
+                            {"error": str(e)}).encode(),
+                            "application/json")
+                    except OSError:
+                        pass       # the 500 reply can hit a dead socket too
+
+        self._srv = ThreadingHTTPServer((host, port), _Handler)
+        self._srv.daemon_threads = True
+        self.addr = self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def _health(self):
+        from paddle_tpu.observe.metrics import JsonlSink
+        doc = {}
+        if self.health_fn is not None:
+            doc = dict(self.health_fn() or {})
+        healthy = bool(doc.pop("healthy", True))
+        doc["status"] = "ok" if healthy else "unhealthy"
+        return (200 if healthy else 503), JsonlSink._clean(doc)
+
+    @property
+    def port(self) -> int:
+        return self.addr[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.addr[0]}:{self.addr[1]}"
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()

@@ -31,10 +31,17 @@ def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def _escape_label(v: str) -> str:
+    """Prometheus text-format label escaping (backslash, quote, newline)
+    — one raw quote in a label value would invalidate the whole scrape
+    response, not just the one series."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt_labels(key: Tuple[Tuple[str, str], ...]) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in key)
     return "{" + inner + "}"
 
 

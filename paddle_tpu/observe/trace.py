@@ -18,6 +18,7 @@ import threading
 import time
 from typing import Optional
 
+from paddle_tpu.observe import chrome_trace as _chrome
 from paddle_tpu.utils import stat as _stat
 
 _tls = threading.local()
@@ -69,12 +70,15 @@ def trace_scope(name: str, stats: Optional[_stat.StatSet] = None,
     qualified = "/".join(stack)
     ctx = (_profiler_ctx("TraceAnnotation", name)
            if _profiling_enabled(use_profiler) else contextlib.nullcontext())
+    wall0 = time.time()
     start = time.perf_counter()
     try:
         with ctx:
             yield qualified
     finally:
-        stats.get(qualified).add(time.perf_counter() - start)
+        dur = time.perf_counter() - start
+        stats.get(qualified).add(dur)
+        _chrome.record_span(qualified, wall0, dur)
         stack.pop()
 
 
@@ -93,12 +97,15 @@ def step_scope(step_num: int, name: str = "train",
     qualified = "/".join(stack)
     ctx = (_profiler_ctx("StepTraceAnnotation", name, step_num=step_num)
            if _profiling_enabled(use_profiler) else contextlib.nullcontext())
+    wall0 = time.time()
     start = time.perf_counter()
     try:
         with ctx:
             yield
     finally:
-        stats.get(qualified).add(time.perf_counter() - start)
+        dur = time.perf_counter() - start
+        stats.get(qualified).add(dur)
+        _chrome.record_span(qualified, wall0, dur, args={"step": step_num})
         stack.pop()
 
 

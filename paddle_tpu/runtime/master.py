@@ -284,6 +284,25 @@ class MasterService:
             return True
 
     # -- introspection -----------------------------------------------------
+    def health(self) -> dict:
+        """/healthz document: queue depths + pass progress. A close()d
+        master reports unhealthy (HTTP 503) — a retired dispatcher must
+        drain its probers rather than keep attracting trainers."""
+        with self._lock:
+            changed = self._requeue_expired_locked()
+            if changed:
+                self._version += 1
+            doc = {"service": self.name,
+                   "todo": len(self._todo),
+                   "pending": len(self._pending),
+                   "done": len(self._done),
+                   "discarded": len(self._discarded),
+                   "epoch": self._epoch,
+                   "healthy": not self._stop.is_set()}
+        if changed:
+            self._dirty.set()
+        return doc
+
     def num_todo(self):
         with self._lock:
             return len(self._todo)
@@ -422,20 +441,39 @@ class _Handler(socketserver.StreamRequestHandler):
 
 
 class MasterServer:
-    """Serve a MasterService over TCP (the ProtoServer/net-rpc slot)."""
+    """Serve a MasterService over TCP (the ProtoServer/net-rpc slot).
+
+    ``http_port`` (None = off, 0 = ephemeral) additionally starts an
+    ``observe.HealthServer`` next to the wire protocol: ``/metrics`` is
+    the process default registry (where the master gauges live) in
+    Prometheus text, ``/healthz`` is ``service.health()`` — the scrape
+    surface a prober hits without speaking the JSON-RPC wire."""
 
     def __init__(self, service: MasterService, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, http_port: Optional[int] = None):
         self._srv = socketserver.ThreadingTCPServer((host, port), _Handler,
                                                     bind_and_activate=True)
         self._srv.daemon_threads = True
         self._srv.service = service                  # type: ignore
         self.addr = self._srv.server_address
+        self.http = None
+        if http_port is not None:
+            from paddle_tpu.observe.health import HealthServer
+            try:
+                self.http = HealthServer(health_fn=service.health,
+                                         host=host, port=http_port)
+            except Exception:
+                # a failed http bind must not leak the already-bound RPC
+                # socket (a retry on a fixed port would hit EADDRINUSE)
+                self._srv.server_close()
+                raise
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
         self._thread.start()
 
     def shutdown(self):
+        if self.http is not None:
+            self.http.close()
         self._srv.shutdown()
         self._srv.server_close()
 

@@ -33,15 +33,18 @@ from paddle_tpu.utils.rng import global_key_source
 
 class _StepMonitor:
     """Per-step observability: wall time, examples/sec, loss, recompile
-    tagging, and memory gauges — fanned out through ``observe.report()``
-    (JSONL sink + handlers) and the default metrics registry. All host
-    work is O(1) dict/float ops so instrumentation overhead stays in the
-    noise (<5% on the smallnet bench, tested by tests/test_observe.py).
+    tagging, MFU, and memory gauges — fanned out through
+    ``observe.report()`` (JSONL sink + handlers), the default metrics
+    registry, and the flight recorder's last-K ring. All host work is
+    O(1) dict/float ops so instrumentation overhead stays in the noise
+    (<5% on the smallnet bench, tested by tests/test_observe.py).
 
-    Recompile detection: XLA recompiles show up as step-time outliers
-    (the jit cache has no public hit/miss hook on this JAX). A step is
-    tagged when it exceeds ``outlier_factor`` × the running median of the
-    last ``window`` steps; step 0 of a program is always a compile."""
+    Recompile accounting is two-sided: the exact jit-cache-miss count
+    from the compile tracker (arg-shape signatures; ``compile_count``
+    in every record) plus the wall-time outlier heuristic (a step over
+    ``outlier_factor`` × the running median of the last ``window``
+    steps is tagged ``recompile`` — it also catches slowdowns the
+    signature tracker cannot see, e.g. backend-side recompiles)."""
 
     def __init__(self, window: int = 64, outlier_factor: float = 4.0):
         self._times = []                     # ring buffer of recent steps
@@ -59,11 +62,18 @@ class _StepMonitor:
         self.step_time = reg.histogram(
             "train_step_seconds", "per-step wall time (dispatch+sync)")
         self.loss_gauge = reg.gauge("train_loss", "last step's mean loss")
+        self.mfu_gauge = reg.gauge(
+            "train_mfu", "model-FLOPs utilisation of the last step "
+            "(lowered-HLO flops / wall / declared peak; 0 until the "
+            "step cost is known)")
         self.hbm_gauge = reg.gauge(
             "device_bytes_in_use", "device HBM in use (0 when the backend "
             "hides memory stats, e.g. CPU)")
         self.host_gauge = reg.gauge(
             "host_rss_bytes", "host process resident set size")
+        # peak FLOP/s is constant for the process: resolve once, not per
+        # step (env read + device lookup + table scan on the hot path)
+        self._peak_flops = observe.costs.device_peak_flops()
 
     def tag_recompile(self, dt: float) -> bool:
         """Record one step time; True when it is a compile-shaped outlier."""
@@ -90,8 +100,11 @@ class _StepMonitor:
         if host.get("rss_bytes"):
             self.host_gauge.set(host["rss_bytes"])
 
-    def step(self, *, step, pass_id, batch_id, cost, batch_size, dt):
-        """One trained batch: update registry + emit the JSONL record."""
+    def step(self, *, step, pass_id, batch_id, cost, batch_size, dt,
+             flops=None, compile_count=0):
+        """One trained batch: update registry, ring the flight recorder,
+        and emit the JSONL record. ``flops`` is the lowered-HLO step
+        cost when known (None → MFU reports 0)."""
         recompile = self.tag_recompile(dt)
         self.steps.inc()
         self.examples.inc(batch_size)
@@ -100,12 +113,22 @@ class _StepMonitor:
         if recompile:
             self.recompiles.inc()
         eps = batch_size / dt if dt > 0 else 0.0
+        mfu = (observe.costs.mfu(flops, dt, self._peak_flops)
+               if self._peak_flops else None)
+        if mfu is not None:
+            self.mfu_gauge.set(mfu)
+        rec = dict(kind="step", step=step, pass_id=pass_id,
+                   batch_id=batch_id, loss=round(cost, 6),
+                   wall_time_s=round(dt, 6),
+                   examples_per_sec=round(eps, 2),
+                   mfu=round(mfu, 6) if mfu is not None else 0.0,
+                   compile_count=int(compile_count),
+                   recompile=recompile)
+        # the flight ring ALWAYS sees the step — a post-mortem must not
+        # depend on a metrics sink having been configured
+        observe.default_flight_recorder().record(rec)
         if observe.has_consumers():
-            observe.report(kind="step", step=step, pass_id=pass_id,
-                           batch_id=batch_id, loss=round(cost, 6),
-                           wall_time_s=round(dt, 6),
-                           examples_per_sec=round(eps, 2),
-                           recompile=recompile)
+            observe.report(rec)
         return recompile, eps
 
 
@@ -162,6 +185,11 @@ class SGD:
                                   if self.grad_accum_steps > 1 else None)
         self._train_step = self._accum_train_step or self._plain_train_step
         self._eval_step = self._build_eval_step()
+        # (fn id, feed signature) -> lowered-HLO flops (or None when the
+        # cost model punted); filled lazily, once per signature
+        self._step_flops: Dict = {}
+        self._last_step_wall = None          # healthz progress probes
+        self._last_cost = None
         self.evaluators = EvaluatorSet(self.topology.layers)
         if self.grad_accum_steps > 1 and any(
                 getattr(l, "layer_type", "") == "pnpair"
@@ -279,6 +307,42 @@ class SGD:
             self._feeder_cache[key] = DataFeeder(dtypes, feeding)
         return self._feeder_cache[key]
 
+    def _flops_for(self, step_fn, sig, step_args):
+        """Lowered-HLO flops of this step signature (the MFU numerator),
+        computed once per signature — one extra trace, no XLA compile —
+        and only when an observability consumer exists (metrics sink or
+        handler): tracing a big model costs real wall time and nobody
+        would read the number."""
+        if sig in self._step_flops:
+            return self._step_flops[sig]
+        if not observe.has_consumers():
+            return None
+        ca = observe.costs.lowered_cost(step_fn, *step_args)
+        flops = ca["flops"] if ca else None
+        self._step_flops[sig] = flops
+        return flops
+
+    def attach_observability(self, host: str = "127.0.0.1",
+                             port: int = 0):
+        """Serve ``/metrics`` (default registry, Prometheus text) and
+        ``/healthz`` (step progress: step count, last loss, seconds
+        since the last finished step, compile count) for this trainer.
+        Returns the started ``observe.HealthServer`` — callers own its
+        ``close()``. ``port=0`` binds an ephemeral port."""
+
+        def health():
+            since = (round(time.perf_counter() - self._last_step_wall, 3)
+                     if self._last_step_wall is not None else None)
+            return {
+                "step": self._step,
+                "last_loss": self._last_cost,
+                "seconds_since_step": since,
+                "compile_count":
+                    observe.default_compile_tracker().count("train_step"),
+            }
+
+        return observe.HealthServer(health_fn=health, host=host, port=port)
+
     # -- public API --------------------------------------------------------
     def train(self, reader, num_passes=1,
               event_handler: Optional[Callable] = None,
@@ -294,10 +358,16 @@ class SGD:
         ks = global_key_source()
         log_period = GLOBAL_FLAGS.get("log_period", 100)
         # flag-driven JSONL metrics sink (PADDLE_TPU_METRICS_PATH or
-        # paddle.init(metrics_path=...)); an explicitly configured sink wins
+        # paddle.init(metrics_path=...)); an explicitly observe.configure()d
+        # sink wins, but the flag — which paddle.init may have (re)set to a
+        # DIFFERENT path — beats the env-autoconfigured sink and an
+        # earlier value of itself
         mpath = GLOBAL_FLAGS.get("metrics_path")
-        if mpath and observe.sink() is None:
-            observe.configure(mpath)
+        if mpath and not observe.explicitly_disabled() and (
+                observe.sink() is None
+                or (observe.sink_source() in ("env", "flag")
+                    and observe.sink().path != mpath)):
+            observe.configure(mpath, _source="flag")
         self._check_finite = (GLOBAL_FLAGS.get("debug_nans") or
                               GLOBAL_FLAGS.get("debug_infs"))
         ckpt = None
@@ -325,10 +395,22 @@ class SGD:
                 logger.info("resumed from %s (step %d)", latest, self._step)
             ckpt = ckpt_io.AsyncCheckpointer(checkpoint_dir)
 
+        recorder = observe.default_flight_recorder()
+        dumps_before = len(recorder.dumped_paths)
         try:
             self._train_passes(reader, num_passes, event_handler, feeder,
                                ks, log_period, ckpt,
                                GLOBAL_FLAGS.get("checkpoint_period", 0))
+        except Exception as e:
+            # post-mortem for any crash escaping the loop — but only
+            # when a flight dir is explicitly configured (a default-on
+            # dump would litter artifacts through every failing test and
+            # notebook), and not when the NaN tripwire already dumped
+            from paddle_tpu.observe import flight as _flight
+            if (_flight.configured()
+                    and len(recorder.dumped_paths) == dumps_before):
+                recorder.dump(reason="exception in training loop", exc=e)
+            raise
         finally:
             if ckpt is not None:
                 ckpt.close()
@@ -349,10 +431,11 @@ class SGD:
                 data_batch = next(it)
                 # feed() already dispatches the H2D copies (jnp.asarray
                 # is asynchronous); the sharded put is likewise async
-                feeds = feeder.feed(data_batch)
-                if self.parallel is not None:
-                    feeds = jax.device_put(
-                        feeds, self.parallel.feed_shardings(feeds))
+                with observe.trace_scope("feed"):
+                    feeds = feeder.feed(data_batch)
+                    if self.parallel is not None:
+                        feeds = jax.device_put(
+                            feeds, self.parallel.feed_shardings(feeds))
             except StopIteration:
                 break
             except Exception:
@@ -380,32 +463,58 @@ class SGD:
             for batch_id, feeds in enumerate(
                     self._prefetch_feeds(reader, feeder)):
                 event_handler(events.BeginIteration(pass_id, batch_id))
+                step_fn = self._pick_train_step(feeds)
+                # feed-shape signature: params/opt/state shapes are fixed
+                # per run, so the feeds (plus which step fn) fully key the
+                # jit cache entry — an unseen signature IS a compile
+                sig = (id(step_fn),) + observe.arg_signature(feeds)
+                dropout_key = ks.step("dropout", self._step)
+                step_args = (self.parameters.values, self.opt_state,
+                             self.parameters.state, feeds,
+                             jnp.asarray(self._step, jnp.int32),
+                             dropout_key)
+                # the one-time cost retrace stays OUTSIDE the timed
+                # window: a seconds-long trace of a big model must not
+                # masquerade as step wall time in the metrics
+                flops = self._flops_for(step_fn, sig, step_args)
                 step_t0 = time.perf_counter()
                 with observe.step_scope(self._step, "train_step"):
-                    dropout_key = ks.step("dropout", self._step)
-                    (loss, self.parameters.values, self.opt_state,
-                     self.parameters.state, outs) = self._pick_train_step(
-                        feeds)(
-                        self.parameters.values, self.opt_state,
-                        self.parameters.state, feeds,
-                        jnp.asarray(self._step, jnp.int32), dropout_key)
+                    with observe.trace_scope("dispatch"):
+                        (loss, self.parameters.values, self.opt_state,
+                         self.parameters.state, outs) = step_fn(*step_args)
                 self._step += 1
                 self.evaluators.add_batch(outs)
                 # float(loss) is the host sync — per-step wall time must
                 # include it or async dispatch hides the real step time
-                cost = float(loss)
+                with observe.trace_scope("host_sync"):
+                    cost = float(loss)
                 step_dt = time.perf_counter() - step_t0
+                tracker = observe.default_compile_tracker()
+                tracker.record("train_step", sig, step_dt)
+                self._last_step_wall = time.perf_counter()
+                self._last_cost = cost
                 bs = int(next(iter(feeds.values())).array.shape[0])
                 pass_examples += bs
                 _, eps = monitor.step(
                     step=self._step - 1, pass_id=pass_id, batch_id=batch_id,
-                    cost=cost, batch_size=bs, dt=step_dt)
+                    cost=cost, batch_size=bs, dt=step_dt, flops=flops,
+                    compile_count=tracker.count("train_step"))
                 if self._check_finite and not math.isfinite(cost):
                     from paddle_tpu.utils import enforce
-                    enforce.check_numerics(self.parameters.values, "param")
-                    raise enforce.EnforceError(
-                        f"non-finite cost {cost} at pass {pass_id} batch "
-                        f"{batch_id} (params are finite — check inputs/loss)")
+                    try:
+                        enforce.check_numerics(self.parameters.values,
+                                               "param")
+                        raise enforce.EnforceError(
+                            f"non-finite cost {cost} at pass {pass_id} "
+                            f"batch {batch_id} (params are finite — check "
+                            f"inputs/loss)")
+                    except enforce.EnforceError as e:
+                        # the NaN tripwire is a flight-recorder trigger:
+                        # leave the post-mortem before the raise unwinds
+                        observe.default_flight_recorder().dump(
+                            reason=f"non-finite cost {cost} (debug_nans "
+                                   f"tripwire)", exc=e)
+                        raise
                 if log_period and batch_id % log_period == 0:
                     monitor.update_memory_gauges()
                     logger.info("pass %d batch %d cost %.5f %s "

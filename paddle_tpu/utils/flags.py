@@ -118,3 +118,7 @@ GLOBAL_FLAGS.define("debug_infs", False,
 GLOBAL_FLAGS.define("checkpoint_period", 0, "batches between async checkpoints (0=per pass)")
 GLOBAL_FLAGS.define("metrics_path", "", "JSONL per-step metrics file (also: "
                     "PADDLE_TPU_METRICS_PATH); empty = off")
+GLOBAL_FLAGS.define("flight_dir", "", "directory for flight-recorder "
+                    "post-mortem artifacts (also: PADDLE_TPU_FLIGHT_DIR); "
+                    "empty = working directory, and crash dumps beyond the "
+                    "NaN tripwire stay off")

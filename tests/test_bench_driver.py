@@ -212,9 +212,11 @@ class TestOrchestrator:
 
 
 class TestStaleFallback:
-    """A dead backend with verified evidence on disk emits THAT value,
-    labelled stale — never a 0.0 that erases the round (the round-4
-    lesson: four gates of 0.0 with real measurements in shadow files)."""
+    """A dead backend with verified evidence on disk carries THAT value
+    under the separate `stale_value` key (never a bare 0.0 that erases
+    the round — the round-4 lesson), while `value` stays 0.0 so a
+    value-only consumer can't mistake week-old throughput for a fresh
+    measurement (the round-5 advice)."""
 
     def _fail(self, bench, monkeypatch):
         emitted = {}
@@ -232,7 +234,10 @@ class TestStaleFallback:
     def test_dead_backend_emits_stale_value(self, bench, monkeypatch):
         _write(bench, "a.json", [{"metric": METRIC, "value": 2548.4}])
         rec = self._fail(bench, monkeypatch)
-        assert rec["value"] == 2548.4 and rec["error"] is None
+        # value stays 0.0: only the explicit stale_value carries evidence
+        assert rec["value"] == 0.0 and rec["error"] is None
+        assert rec["stale_value"] == 2548.4
+        assert rec["stale_vs_baseline"] == round(2548.4 / 4000.0, 4)
         assert rec["stale"] is True and rec["source_file"] == "a.json"
         assert rec["stale_minutes"] >= 0
         assert "backend unusable" in rec["backend_error"]
@@ -240,13 +245,17 @@ class TestStaleFallback:
     def test_stale_record_carries_source_config(self, bench, monkeypatch):
         """The evidence may have been measured under a different recipe
         than this process's BENCH_FUSED_BN — the stale record must carry
-        the source's config, not the current env's."""
+        the source's config under stale_* keys, not the current env's."""
         monkeypatch.setattr(bench, "FUSED_BN", "int8")
         _write(bench, "a.json", [{"metric": METRIC, "value": 2548.4,
                                   "fused_bn": False, "mfu": 0.1591}])
         rec = self._fail(bench, monkeypatch)
-        assert rec["value"] == 2548.4
-        assert rec["fused_bn"] is False and rec["mfu"] == 0.1591
+        assert rec["stale_value"] == 2548.4
+        assert rec["stale_fused_bn"] is False
+        assert rec["stale_mfu"] == 0.1591
+        # no un-prefixed source config leaks in through the extras (the
+        # real emit's base_record keeps describing THIS process)
+        assert "mfu" not in rec
 
     def test_stale_cap_rejects_ancient_evidence(self, bench, monkeypatch):
         import time as _t
@@ -268,7 +277,8 @@ class TestStaleFallback:
         monkeypatch.setattr(bench.os, "_exit",
                             lambda c: (_ for _ in ()).throw(SystemExit(c)))
         with pytest.raises(SystemExit):
-            bench.emit(2548.4, stale=True, measured_at="2026-07-31")
+            bench.emit(0.0, stale=True, stale_value=2548.4,
+                       measured_at="2026-07-31")
         out = capsys.readouterr().out
         assert json.loads(out)["stale"] is True
         # nothing appended beyond the pre-existing evidence file

@@ -225,12 +225,16 @@ class TestTripwires:
             jax.config.update("jax_debug_nans", False)
             GLOBAL_FLAGS.set("debug_nans", False)
 
-    def test_trainer_raises_on_nan_cost(self):
+    def test_trainer_raises_on_nan_cost(self, tmp_path, monkeypatch):
         import paddle_tpu as paddle
         from paddle_tpu import layer
         from paddle_tpu.utils import enforce
         from paddle_tpu.utils.flags import GLOBAL_FLAGS
         from paddle_tpu.utils.rng import KeySource
+
+        # the tripwire now also dumps a flight-recorder post-mortem —
+        # keep it out of the working directory
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
 
         x = layer.data("x", paddle.data_type.dense_vector(4))
         lbl = layer.data("lbl", paddle.data_type.integer_value(2))
@@ -250,3 +254,4 @@ class TestTripwires:
                 tr.train(reader=paddle.batch(reader, 1), num_passes=1)
         finally:
             GLOBAL_FLAGS.set("debug_infs", False)
+        assert list(tmp_path.glob("flight_*.json"))   # post-mortem left

@@ -149,6 +149,27 @@ def test_server_metrics_prometheus_snapshot(tmp_path, rng):
     srv2 = lm_serving.load_lm_artifact(path)
     assert srv2._m_prefill.value() == 0
 
+    # per-phase XLA cost accounting stamped into the artifact at export
+    # time → the decode-MFU gauge moves on a server that generated
+    assert srv.cost_analysis["prefill"]["flops"] > 0
+    assert srv.cost_analysis["decode"]["flops"] > 0
+    assert srv.metrics.get("lm_decode_mfu").value() > 0
+
+    # /metrics + /healthz over HTTP from this server's own registry
+    import json as _json
+    import urllib.request
+    http = srv.serve()
+    try:
+        scraped = urllib.request.urlopen(
+            http.url + "/metrics", timeout=5).read().decode()
+        assert f"lm_tokens_generated_total {2 * new * B}" in scraped
+        health = _json.loads(urllib.request.urlopen(
+            http.url + "/healthz", timeout=5).read())
+        assert health["status"] == "ok" and health["requests"] == 2
+        assert health["tokens_generated"] == 2 * new * B
+    finally:
+        http.close()
+
 
 def test_moe_artifact_roundtrip_matches_generate(tmp_path, rng):
     """The serving artifact carries MoE configs transparently (cfg

@@ -91,6 +91,10 @@ class TestMergedArtifact:
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
         with pytest.raises(KeyError):
             m.call_exported({"image": rng.randn(3, 64).astype(np.float32)})
+        # XLA cost accounting stamped at export time (MFU numerator for
+        # any serving host); mm is [4,64]@[64,32] → ≥ 2*4*64*32 flops
+        ca = m.cost_analysis
+        assert 4 in ca and ca[4]["flops"] >= 2 * 4 * 64 * 32
 
     def test_fresh_process_no_model_code(self, tmp_path, rng):
         """The merged-model bar: a separate python process loads the tar

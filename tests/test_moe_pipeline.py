@@ -173,6 +173,50 @@ class TestMoEAllToAll:
         with pytest.raises(ValueError, match="divisible"):
             moe.moe_ffn_a2a(params, x, self.CFG, mesh)
 
+    def test_tight_capacity_divergence_pinned(self, rng):
+        """Pin the DOCUMENTED ceil-vs-truncate capacity divergence
+        between the paths (ADVICE.md round-5): moe_ffn budgets
+        ``int(cf·k·N/E)`` slots per expert globally; moe_ffn_a2a budgets
+        ``ceil(cf·k·N_s/E)`` per (expert, source shard) — under a tight
+        capacity factor the a2a path keeps MORE tokens, and a future
+        change to either formula must show up here, not silently alter
+        drop semantics.
+
+        Setup: every token routes to expert 0 (gate column 0 dominates,
+        all-positive inputs), N=8 tokens over pe=2 shards, E=2, cf=0.6:
+        einsum cap = int(2.4) = 2 kept; a2a cap_s = ceil(1.2) = 2 per
+        shard → 4 kept. Dropped tokens produce exactly-zero output rows,
+        so kept counts are countable from the outputs."""
+        import math as _math
+        cfg = moe.MoEConfig(d_model=8, d_ff=16, num_experts=2,
+                            capacity_factor=0.6)
+        N, pe = 8, 2
+        n_s = N // pe
+        cap_einsum = int(cfg.capacity_factor * cfg.top_k * N
+                         / cfg.num_experts)
+        cap_s = _math.ceil(cfg.capacity_factor * cfg.top_k * n_s
+                           / cfg.num_experts)
+        assert cap_einsum == 2 and pe * cap_s == 4    # the divergence
+        mesh = place.make_mesh((pe,), (place.AXIS_EXPERT,))
+        params = moe.init_params(jax.random.PRNGKey(0), cfg)
+        # all-positive tokens + a gate that monotonically favors expert
+        # 0 => every token's first choice is expert 0
+        params["gate"] = jnp.stack([jnp.ones(8), -jnp.ones(8)], axis=1)
+        x = jnp.asarray(np.abs(rng.randn(N, 8)).astype(np.float32) + 0.5)
+
+        def kept_rows(out):
+            return int(jnp.sum(jnp.any(jnp.abs(out) > 1e-9, axis=1)))
+
+        out_e, _ = moe.moe_ffn(params, x, cfg)
+        out_a, _ = moe.moe_ffn_a2a(params, x, cfg, mesh)
+        assert kept_rows(out_e) == cap_einsum          # 2 kept, 6 dropped
+        assert kept_rows(out_a) == pe * cap_s          # 4 kept, 4 dropped
+        # at ample capacity the divergence disappears (both keep all N)
+        ample = moe.MoEConfig(d_model=8, d_ff=16, num_experts=2,
+                              capacity_factor=8.0)
+        assert kept_rows(moe.moe_ffn(params, x, ample)[0]) == N
+        assert kept_rows(moe.moe_ffn_a2a(params, x, ample, mesh)[0]) == N
+
 
 class TestPipeline:
     def _stage_fn(self, p, x):

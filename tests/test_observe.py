@@ -116,6 +116,15 @@ class TestPrometheusRendering:
         # label keys render sorted; le is appended last
         assert 'x_bucket{app="demo",zone="us",le="1"} 1' in text
 
+    def test_label_values_escaped(self):
+        # one raw quote/backslash/newline in a label would invalidate
+        # the ENTIRE scrape response — the text format requires escaping
+        reg = Registry()
+        reg.counter("c").inc(path='dir"x\\y\nz')
+        line = [l for l in reg.render_prometheus().splitlines()
+                if l.startswith("c{")][0]
+        assert line == 'c{path="dir\\"x\\\\y\\nz"} 1'
+
 
 class TestJsonlSink:
     def test_round_trip(self, tmp_path):
@@ -271,6 +280,62 @@ class TestReportHook:
 
     def test_no_consumers_by_default(self):
         assert not observe.has_consumers()
+
+    def test_flag_path_beats_env_sink(self, tmp_path, monkeypatch):
+        """paddle.init(metrics_path=a) with PADDLE_TPU_METRICS_PATH=b in
+        the env must write to a — the flag is explicit configuration,
+        the env sink is only a default."""
+        import paddle_tpu as paddle
+        from paddle_tpu.utils.flags import GLOBAL_FLAGS
+        env_path = str(tmp_path / "env.jsonl")
+        flag_path = str(tmp_path / "flag.jsonl")
+        monkeypatch.setenv("PADDLE_TPU_METRICS_PATH", env_path)
+        GLOBAL_FLAGS.set("metrics_path", flag_path)
+        try:
+            assert observe.sink_source() == "env"   # env autoconfigured
+            tr = TestTrainerInstrumentation._smallnet(self)
+            data = TestTrainerInstrumentation._data(self, 8)
+            tr.train(paddle.batch(lambda: iter(data), 8), num_passes=1)
+        finally:
+            GLOBAL_FLAGS.set("metrics_path", "")
+            observe.configure(None)
+        assert [r for r in read_jsonl(flag_path)
+                if r.get("kind") == "step"]
+
+    def test_changed_flag_path_reconfigures(self, tmp_path):
+        """Re-setting metrics_path between runs must move the sink —
+        a flag-origin sink is a default, not an explicit configure()."""
+        import paddle_tpu as paddle
+        from paddle_tpu.utils.flags import GLOBAL_FLAGS
+        a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+        tr = TestTrainerInstrumentation._smallnet(self)
+        data = TestTrainerInstrumentation._data(self, 8)
+        try:
+            GLOBAL_FLAGS.set("metrics_path", a)
+            tr.train(paddle.batch(lambda: iter(data), 8), num_passes=1)
+            GLOBAL_FLAGS.set("metrics_path", b)
+            tr.train(paddle.batch(lambda: iter(data), 8), num_passes=1)
+        finally:
+            GLOBAL_FLAGS.set("metrics_path", "")
+            observe.configure(None)
+        assert [r for r in read_jsonl(a) if r.get("kind") == "step"]
+        assert [r for r in read_jsonl(b) if r.get("kind") == "step"]
+
+    def test_explicit_disable_beats_flag(self, tmp_path):
+        """observe.configure(None) is an explicit opt-out: a still-set
+        metrics_path flag must not resurrect the sink on train()."""
+        import paddle_tpu as paddle
+        from paddle_tpu.utils.flags import GLOBAL_FLAGS
+        path = str(tmp_path / "off.jsonl")
+        tr = TestTrainerInstrumentation._smallnet(self)
+        data = TestTrainerInstrumentation._data(self, 8)
+        try:
+            GLOBAL_FLAGS.set("metrics_path", path)
+            observe.configure(None)                 # explicit opt-out
+            tr.train(paddle.batch(lambda: iter(data), 8), num_passes=1)
+        finally:
+            GLOBAL_FLAGS.set("metrics_path", "")
+        assert not (tmp_path / "off.jsonl").exists()
 
 
 class TestTrainerInstrumentation:

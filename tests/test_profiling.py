@@ -1,0 +1,415 @@
+"""The deep-profiling subsystem (PR 2 tentpole): Chrome-trace export,
+XLA cost accounting / MFU, the compile tracker, the flight recorder,
+and the /metrics + /healthz health endpoints — including the wiring
+through the trainer, the CLI, and the master."""
+
+import json
+import math
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layer, observe
+from paddle_tpu.observe import chrome_trace, costs
+from paddle_tpu.observe.compile_tracker import CompileTracker
+from paddle_tpu.observe.flight import FlightRecorder
+from paddle_tpu.utils.flags import GLOBAL_FLAGS
+
+
+@pytest.fixture(autouse=True)
+def _isolate_observe():
+    observe.reset()
+    yield
+    observe.reset()
+
+
+def _smallnet():
+    img = layer.data("x", paddle.data_type.dense_vector(8))
+    lbl = layer.data("y", paddle.data_type.integer_value(3))
+    out = layer.fc(img, 3, act=paddle.activation.Softmax())
+    cost = layer.classification_cost(out, lbl, name="cost")
+    params = paddle.parameters.create(cost)
+    return paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(learning_rate=0.1))
+
+
+def _data(n=40, bad_at=None):
+    r = np.random.RandomState(0)
+    rows = [(r.rand(8).astype("float32"), int(r.randint(3)))
+            for _ in range(n)]
+    if bad_at is not None:
+        x = rows[bad_at][0].copy()
+        x[0] = np.nan
+        rows[bad_at] = (x, rows[bad_at][1])
+    return rows
+
+
+class TestChromeTrace:
+    def test_span_schema_roundtrip(self, tmp_path):
+        with observe.trace_scope("step", use_profiler=False):
+            with observe.trace_scope("fwd", use_profiler=False):
+                pass
+        path = str(tmp_path / "t.json")
+        trace = observe.trace_export(path, process_index=3)
+        with open(path) as f:
+            loaded = json.load(f)            # valid JSON on disk
+        assert loaded == json.loads(json.dumps(trace))
+        xs = [e for e in loaded["traceEvents"] if e["ph"] == "X"]
+        assert {e["name"] for e in xs} == {"step", "step/fwd"}
+        for e in xs:
+            assert e["pid"] == 3 and isinstance(e["tid"], int)
+            assert e["ts"] > 0 and e["dur"] >= 0
+        # nesting: the child span lies inside the parent's window
+        by = {e["name"]: e for e in xs}
+        assert by["step"]["ts"] <= by["step/fwd"]["ts"]
+        assert (by["step/fwd"]["ts"] + by["step/fwd"]["dur"]
+                <= by["step"]["ts"] + by["step"]["dur"] + 1e-3)
+        metas = [e for e in loaded["traceEvents"] if e["ph"] == "M"]
+        assert any(m["name"] == "process_name" for m in metas)
+        assert any(m["name"] == "thread_name" for m in metas)
+
+    def test_buffer_bounded_and_drop_counted(self):
+        buf = chrome_trace.SpanBuffer(capacity=4)
+        for i in range(7):
+            buf.add(f"s{i}", 0.0, 0.001)
+        assert len(buf) == 4 and buf.dropped() == 3
+        names = [s[0] for s in buf.spans()]
+        assert names == ["s3", "s4", "s5", "s6"]      # oldest evicted
+        assert chrome_trace.trace_export(buffer=buf)[
+            "otherData"]["dropped_spans"] == 3
+
+    def test_disabled_buffer_records_nothing(self):
+        buf = chrome_trace.SpanBuffer(capacity=0)
+        buf.add("s", 0.0, 0.1)
+        assert len(buf) == 0 and not buf.enabled
+
+    def test_stats_cli_trace_on_toy_training_run(self, tmp_path, capsys):
+        """The acceptance path: 5-step toy training run, then
+        ``paddle_tpu stats --trace out.json`` → valid Chrome-trace JSON
+        with >= 3 distinct span names."""
+        from paddle_tpu import cli
+        tr = _smallnet()
+        tr.train(paddle.batch(lambda: iter(_data(40)), 8), num_passes=1)
+        out = str(tmp_path / "out.json")
+        assert cli.main(["stats", "--trace", out]) == 0
+        assert "perfetto" in capsys.readouterr().out
+        with open(out) as f:
+            trace = json.load(f)
+        names = {e["name"] for e in trace["traceEvents"]
+                 if e.get("ph") == "X"}
+        assert len(names) >= 3
+        assert {"train_step", "train_step/dispatch",
+                "host_sync", "feed"} <= names
+
+
+class TestCostsAndMFU:
+    def test_lowered_cost_known_flops(self):
+        """MFU numerator against a known-FLOPs toy model: one [M,K]@[K,N]
+        matmul is exactly 2·M·K·N flops in the HLO cost model."""
+        import jax
+        import jax.numpy as jnp
+        M, K, N = 64, 32, 16
+        f = jax.jit(lambda a, b: a @ b)
+        ca = costs.lowered_cost(
+            f, jax.ShapeDtypeStruct((M, K), jnp.float32),
+            jax.ShapeDtypeStruct((K, N), jnp.float32))
+        assert ca is not None
+        assert ca["flops"] == 2 * M * K * N
+        assert ca["bytes_accessed"] > 0
+        # concrete args are abstracted, never executed
+        a = jnp.ones((M, K)), jnp.ones((K, N))
+        assert costs.lowered_cost(f, *a)["flops"] == 2 * M * K * N
+
+    def test_mfu_formula_and_peak_override(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_PEAK_TFLOPS", "2.0")   # 2e12
+        from paddle_tpu.core import place
+        assert place.peak_flops() == 2.0e12
+        # 1e9 flops in 1 ms at 2e12 peak → 0.5 MFU
+        assert math.isclose(costs.mfu(1e9, 1e-3), 0.5)
+        assert costs.mfu(None, 1e-3) is None
+        assert costs.mfu(1e9, 0.0) is None
+
+    def test_peak_table_matches_device_kinds(self):
+        from paddle_tpu.core import place
+
+        class _Dev:
+            def __init__(self, kind, platform="tpu"):
+                self.device_kind = kind
+                self.platform = platform
+
+        assert place.peak_flops(_Dev("TPU v4")) == 275e12
+        assert place.peak_flops(_Dev("TPU v5 lite")) == 197e12   # not v5p
+        assert place.peak_flops(_Dev("TPU v5p")) == 459e12
+        assert place.peak_flops(_Dev("cpu", "cpu")) == 0.1e12
+        assert place.peak_flops(_Dev("warp drive", "quantum")) is None
+
+    def test_trainer_steps_carry_mfu_and_compile_count(self, tmp_path,
+                                                       monkeypatch):
+        """Acceptance: the trainer's JSONL records include `mfu` and
+        `compile_count` fields, and the MFU gauge moves."""
+        monkeypatch.setenv("PADDLE_TPU_PEAK_TFLOPS", "0.000001")
+        path = str(tmp_path / "m.jsonl")
+        observe.configure(path)
+        tr = _smallnet()
+        tr.train(paddle.batch(lambda: iter(_data(40)), 8), num_passes=1)
+        observe.configure(None)
+        steps = [r for r in observe.read_jsonl(path)
+                 if r.get("kind") == "step"]
+        assert len(steps) == 5
+        for r in steps:
+            assert "mfu" in r and "compile_count" in r
+        assert all(r["compile_count"] == 1 for r in steps)  # one shape
+        assert any(r["mfu"] > 0 for r in steps)
+        assert observe.default_registry().get("train_mfu").value() > 0
+
+
+class TestCompileTracker:
+    def test_miss_counting_under_forced_reshape(self):
+        """Acceptance: a shape change IS a compile. Drive a jitted fn
+        through the tracker with two shapes → two misses; repeats hit."""
+        import jax
+        import jax.numpy as jnp
+        tracker = CompileTracker()
+        f = observe.track_compiles(jax.jit(lambda x: (x * 2).sum()),
+                                   "toy", tracker=tracker)
+        f(jnp.ones((8, 4)))
+        f(jnp.ones((8, 4)))
+        assert tracker.count("toy") == 1
+        f(jnp.ones((16, 4)))                   # forced reshape → miss
+        assert tracker.count("toy") == 2
+        assert tracker.compile_seconds("toy") > 0
+        misses = tracker.misses("toy")
+        assert len(misses) == 2
+        assert "16, 4" in misses[1]["signature"]
+
+    def test_storm_warning_logged(self):
+        import io
+        import logging
+        buf = io.StringIO()
+        handler = logging.StreamHandler(buf)
+        logging.getLogger("paddle_tpu").addHandler(handler)
+        try:
+            tracker = CompileTracker(storm_threshold=3)
+            for i in range(3):
+                tracker.record("hot_fn", (("shape", i),), 0.5)
+            # below the threshold: quiet
+            tracker2 = CompileTracker(storm_threshold=3)
+            tracker2.record("calm_fn", ("a",), 0.1)
+            tracker2.record("calm_fn", ("b",), 0.1)
+        finally:
+            logging.getLogger("paddle_tpu").removeHandler(handler)
+        err = buf.getvalue()
+        assert "recompile storm" in err and "hot_fn" in err
+        assert "calm_fn" not in err
+
+    def test_kwarg_shape_change_is_a_miss(self):
+        """A keyword-argument shape change recompiles like any other —
+        it must participate in the tracked signature."""
+        import jax
+        import jax.numpy as jnp
+        tracker = CompileTracker()
+        f = observe.track_compiles(
+            jax.jit(lambda x, mask: (x * mask).sum()), "kw",
+            tracker=tracker)
+        f(jnp.ones((8,)), mask=jnp.ones((8,)))
+        f(jnp.ones((8,)), mask=jnp.ones((8,)))
+        assert tracker.count("kw") == 1
+        f(jnp.ones((8,)), mask=jnp.ones((1,)))   # kwarg reshape → miss
+        assert tracker.count("kw") == 2
+
+    def test_trainer_ragged_batch_counts_as_compile(self, tmp_path):
+        """drop_last=False leaves a ragged final batch (20 % 8 = 4): a
+        second jit signature the tracker must count."""
+        path = str(tmp_path / "m.jsonl")
+        observe.configure(path)
+        tr = _smallnet()
+        tr.train(paddle.batch(lambda: iter(_data(20)), 8,
+                              drop_last=False), num_passes=1)
+        observe.configure(None)
+        steps = [r for r in observe.read_jsonl(path)
+                 if r.get("kind") == "step"]
+        assert steps[-1]["compile_count"] == 2
+        tracker = observe.default_compile_tracker()
+        assert tracker.count("train_step") == 2
+        reg = observe.default_registry()
+        assert reg.get("compile_cache_misses_total").value(
+            fn="train_step") == 2
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        rec = FlightRecorder(capacity=3)
+        for i in range(5):
+            rec.record({"step": i})
+        assert [r["step"] for r in rec.records()] == [2, 3, 4]
+
+    def test_dump_artifact_contents(self, tmp_path):
+        rec = FlightRecorder()
+        rec.record({"step": 0, "loss": float("nan")})
+        path = rec.dump(path=str(tmp_path / "f.json"), reason="unit",
+                        exc=ValueError("boom"))
+        with open(path) as f:
+            art = json.load(f)                 # NaN sanitized → valid
+        assert art["kind"] == "flight_recorder" and art["reason"] == "unit"
+        assert art["last_steps"][0]["loss"] == "nan"
+        assert art["exception"]["type"] == "ValueError"
+        assert "config" in art and "env" in art and "metrics" in art
+        assert rec.dumped_paths == [path]
+
+    def test_induced_nan_leaves_postmortem(self, tmp_path, monkeypatch):
+        """Acceptance: an induced NaN leaves a flight-recorder
+        post-mortem artifact on disk (via the debug_nans tripwire)."""
+        from paddle_tpu.utils.enforce import EnforceError
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        GLOBAL_FLAGS.set("debug_nans", True)
+        try:
+            tr = _smallnet()
+            with pytest.raises(EnforceError, match="non-finite"):
+                tr.train(paddle.batch(
+                    lambda: iter(_data(40, bad_at=10)), 8), num_passes=1)
+        finally:
+            GLOBAL_FLAGS.set("debug_nans", False)
+        arts = list(tmp_path.glob("flight_*.json"))
+        assert len(arts) == 1
+        with open(arts[0]) as f:
+            art = json.load(f)
+        assert "non-finite cost" in art["reason"]
+        assert art["exception"]["type"] == "EnforceError"
+        # the ring holds the healthy steps BEFORE the poisoned batch
+        assert len(art["last_steps"]) >= 1
+        assert art["last_steps"][0]["kind"] == "step"
+        assert art["config"]["debug_nans"] is True
+
+    def test_no_artifact_without_tripwire_or_configured_dir(self,
+                                                           tmp_path,
+                                                           monkeypatch):
+        """A reader crash in a default-config run must NOT litter
+        post-mortems into the working directory."""
+        monkeypatch.chdir(tmp_path)
+
+        def bad_reader():
+            yield from _data(16)
+            raise RuntimeError("reader died")
+
+        tr = _smallnet()
+        with pytest.raises(RuntimeError, match="reader died"):
+            tr.train(paddle.batch(bad_reader, 8), num_passes=1)
+        assert list(tmp_path.glob("flight_*.json")) == []
+
+    def test_configured_accepts_explicit_cwd(self, monkeypatch):
+        from paddle_tpu.observe import flight
+        monkeypatch.delenv("PADDLE_TPU_FLIGHT_DIR", raising=False)
+        assert not flight.configured()
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", ".")
+        assert flight.configured()      # explicit "." opts INTO cwd dumps
+
+    def test_crash_dump_when_dir_configured(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+
+        def bad_reader():
+            yield from _data(16)
+            raise RuntimeError("reader died")
+
+        tr = _smallnet()
+        with pytest.raises(RuntimeError):
+            tr.train(paddle.batch(bad_reader, 8), num_passes=1)
+        arts = list(tmp_path.glob("flight_*.json"))
+        assert len(arts) == 1
+        with open(arts[0]) as f:
+            assert json.load(f)["exception"]["type"] == "RuntimeError"
+
+
+class TestHealthEndpoints:
+    def _get(self, url):
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status, r.read()
+
+    def test_metrics_and_healthz_smoke(self):
+        observe.default_registry().counter("probe_total").inc(3)
+        srv = observe.HealthServer(
+            health_fn=lambda: {"queue": 7, "healthy": True})
+        try:
+            code, body = self._get(srv.url + "/metrics")
+            assert code == 200 and b"probe_total 3" in body
+            code, body = self._get(srv.url + "/healthz")
+            doc = json.loads(body)
+            assert code == 200 and doc == {"queue": 7, "status": "ok"}
+            with pytest.raises(urllib.error.HTTPError) as e:
+                self._get(srv.url + "/nope")
+            assert e.value.code == 404
+        finally:
+            srv.close()
+
+    def test_unhealthy_is_503(self):
+        srv = observe.HealthServer(health_fn=lambda: {"healthy": False})
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                self._get(srv.url + "/healthz")
+            assert e.value.code == 503
+            assert json.loads(e.value.read())["status"] == "unhealthy"
+        finally:
+            srv.close()
+
+    def test_trainer_attach_observability(self):
+        tr = _smallnet()
+        tr.train(paddle.batch(lambda: iter(_data(16)), 8), num_passes=1)
+        srv = tr.attach_observability()
+        try:
+            _, body = self._get(srv.url + "/healthz")
+            doc = json.loads(body)
+            assert doc["step"] == 2 and doc["status"] == "ok"
+            assert doc["compile_count"] == 1
+            assert doc["seconds_since_step"] >= 0
+            _, body = self._get(srv.url + "/metrics")
+            assert b"train_steps_total 2" in body
+        finally:
+            srv.close()
+
+    def test_master_http_bind_failure_releases_rpc_port(self):
+        """A failed /metrics bind must close the already-bound RPC
+        socket — a fixed-port retry would otherwise hit EADDRINUSE."""
+        import socket
+        from paddle_tpu.runtime.master import MasterServer, MasterService
+        svc = MasterService(name="m_leak")
+        blocker = socket.socket()
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        http_port = blocker.getsockname()[1]
+        probe = MasterServer(svc)          # learn a free wire port
+        wire_port = probe.addr[1]
+        probe.shutdown()
+        try:
+            with pytest.raises(OSError):
+                MasterServer(svc, port=wire_port, http_port=http_port)
+            # the wire port must be free again after the failure
+            srv = MasterServer(svc, port=wire_port)
+            srv.shutdown()
+        finally:
+            blocker.close()
+            svc.close()
+
+    def test_master_http_port(self, tmp_path):
+        from paddle_tpu.runtime import recordio
+        from paddle_tpu.runtime.master import MasterServer, MasterService
+        rio = str(tmp_path / "d.rio")
+        recordio.write_records(rio, list(range(30)), chunk_records=10)
+        svc = MasterService(name="m_http")
+        svc.set_dataset([rio])
+        srv = MasterServer(svc, http_port=0)
+        try:
+            assert srv.http is not None
+            _, body = self._get(srv.http.url + "/healthz")
+            doc = json.loads(body)
+            assert doc["todo"] == 3 and doc["pending"] == 0
+            assert doc["service"] == "m_http" and doc["status"] == "ok"
+            svc.get_task()
+            _, body = self._get(srv.http.url + "/healthz")
+            assert json.loads(body)["pending"] == 1
+            _, body = self._get(srv.http.url + "/metrics")
+            assert b"master_task_queue_depth" in body
+        finally:
+            srv.shutdown()
+            svc.close()

@@ -88,3 +88,35 @@ class TestAnalyzeSchedule:
         # all-reduce.1 has the convolution after it; all-reduce.4 is last
         assert by["all-reduce.1"]["compute_ops_after"] == 1
         assert by["all-reduce.4"]["compute_ops_after"] == 0
+
+    def test_unparsed_replica_groups_flagged(self):
+        """An encoding _parse_group doesn't know must be FLAGGED in the
+        artifact, not silently modeled as all-devices-over-ICI
+        (ADVICE.md round-5)."""
+        assert sa.analyze_schedule(HLO)["unparsed_replica_groups"] == []
+        weird = HLO.replace(
+            "replica_groups=[4,2]<=[4,2]T(1,0)",
+            "replica_groups=[2,2,2]<=[8]")   # 3-D group shape: unknown
+        s = sa.analyze_schedule(weird)
+        assert len(s["unparsed_replica_groups"]) == 1
+        assert s["unparsed_replica_groups"][0]["name"] == "all-reduce.4"
+        by = {c["name"]: c for c in s["sync_all_reduces"]}
+        assert by["all-reduce.4"]["group_unparsed"] is True
+        assert by["all-reduce.1"]["group_unparsed"] is False
+
+
+class TestTopologyParse:
+    """--hlo-file device counts come from the topology dims (or
+    --num-devices), not a hard-coded '2x4' substring."""
+
+    def test_two_dim(self):
+        assert sa._parse_topology_devices("v5e:2x4") == 8
+
+    def test_three_dim(self):
+        assert sa._parse_topology_devices("v4:2x2x4") == 16
+
+    def test_single_count(self):
+        assert sa._parse_topology_devices("v5e:8") == 8
+
+    def test_unparseable(self):
+        assert sa._parse_topology_devices("v5litepod") is None
