@@ -1,0 +1,272 @@
+#!/usr/bin/env python
+"""Continuous-batching vs lockstep LM serving under Poisson load.
+
+Replays ONE request trace (Poisson arrivals, mixed prompt/output
+lengths) against both serving surfaces:
+
+- ``engine``   — ``serving.DecodeEngine``: slot-based KV arena, bucketed
+  slot prefill, per-slot positions, on-device sampling ([B] ids are the
+  only per-step host traffic).
+- ``lockstep`` — the ``LMServer.generate``-shaped baseline: FIFO batch
+  formation (wait to fill a batch), one shared prompt bucket, every row
+  decodes to the LONGEST request's max_new, host-side argmax over the
+  full [B, vocab] logits each token.
+
+Reports goodput tokens/sec (only tokens a request asked for count) and
+p50/p99 request latency + TTFT per variant, one JSON line each, plus a
+``serving_engine_speedup`` line — the continuous-batching win. The
+engine's compile discipline (at most one compile per prefill bucket +
+one for decode) is asserted via the observe compile tracker.
+
+Usage: python benchmarks/serving_bench.py [--requests 32] [--batch 4]
+           [--rate 4] [--prompt-lens 6,12,24] [--max-new 8,16,32]
+           [--metrics-out=serving.jsonl] [--smoke]
+Prints one JSON line per variant (``--smoke``: tiny model + near-zero
+inter-arrival gaps, the tier-1 fast path).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+# --metrics-out=PATH (or BENCH_METRICS_OUT): JSONL trail next to the
+# stdout JSON lines, bench.py conventions (inline append, never fatal)
+for _a in sys.argv[1:]:
+    if _a.startswith("--metrics-out="):
+        os.environ["BENCH_METRICS_OUT"] = _a.split("=", 1)[1]
+METRICS_OUT = os.environ.get("BENCH_METRICS_OUT")
+
+
+def metrics_write(**rec):
+    if not METRICS_OUT:
+        return
+    try:
+        with open(METRICS_OUT, "a") as f:
+            f.write(json.dumps({"ts": round(time.time(), 3), **rec})
+                    + "\n")
+    except (OSError, ValueError) as e:
+        print(f"metrics-out write failed: {e}", file=sys.stderr)
+
+
+def _pct(vals, q):
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))]
+
+
+def build_workload(n, rate, prompt_lens, max_news, vocab, seed):
+    """[(arrival_s, prompt ids, max_new)] — Poisson arrivals, mixed
+    prompt/output lengths (the batch-formation-hostile shape)."""
+    rng = np.random.RandomState(seed)
+    t, work = 0.0, []
+    for _ in range(n):
+        t += rng.exponential(1.0 / rate)
+        tp = int(prompt_lens[rng.randint(len(prompt_lens))])
+        work.append((t, rng.randint(0, vocab, tp).astype(np.int32),
+                     int(max_news[rng.randint(len(max_news))])))
+    return work
+
+
+def run_engine(params, cfg, work, *, batch, cache_len, buckets):
+    """Wall-clock replay through DecodeEngine; returns the result dict.
+    A warmup pass (one request per bucket in the trace) pays every
+    compile before the clock starts; the tracker then proves the timed
+    run added none."""
+    from paddle_tpu.observe.compile_tracker import CompileTracker
+    from paddle_tpu.serving import DecodeEngine
+
+    tracker = CompileTracker()
+    eng = DecodeEngine.from_params(params, cfg, batch=batch,
+                                   cache_len=cache_len, buckets=buckets,
+                                   seed=0, tracker=tracker)
+    from paddle_tpu.core import ragged
+    for b in sorted({ragged.bucket_length(len(p), eng.buckets)
+                     for _, p, _ in work}):
+        eng.submit(np.zeros(min(b, cache_len - 2), np.int32), 2)
+    eng.run_until_idle()
+    warm = dict(eng.compile_counts())
+
+    reqs, i, t0 = [], 0, time.perf_counter()
+    while len(reqs) < len(work) or not eng.idle:
+        now = time.perf_counter() - t0
+        while i < len(work) and work[i][0] <= now:
+            _, prompt, max_new = work[i]
+            reqs.append(eng.submit(prompt, max_new))
+            i += 1
+        if eng.idle:
+            time.sleep(min(max(work[i][0] - now, 0.0), 0.05))
+            continue
+        eng.step()
+    wall = time.perf_counter() - t0
+
+    assert eng.compile_counts() == warm, (
+        f"timed run recompiled: {warm} -> {eng.compile_counts()}")
+    assert eng.compile_counts()["decode"] == 1
+    assert eng.compile_counts()["prefill"] <= len(eng.buckets)
+    toks = sum(len(r.tokens) for r in reqs)
+    lat = [r.latency_s for r in reqs]
+    ttft = [r.ttft_s for r in reqs]
+    return {"variant": "engine", "requests": len(reqs), "tokens": toks,
+            "wall_s": round(wall, 4),
+            "tokens_per_sec": round(toks / wall, 2),
+            "p50_latency_s": round(_pct(lat, 0.5), 4),
+            "p99_latency_s": round(_pct(lat, 0.99), 4),
+            "ttft_p50_s": round(_pct(ttft, 0.5), 4),
+            "ttft_p99_s": round(_pct(ttft, 0.99), 4),
+            "compiles": eng.compile_counts()}
+
+
+def run_lockstep(params, cfg, work, *, batch, cache_len, buckets):
+    """The pre-engine serving discipline on the same trace: fill a
+    FIFO batch (pad the tail group), share one prompt bucket, decode
+    max(max_new) steps for everyone, sample on host from full logits."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import ragged
+    from paddle_tpu.models import transformer
+
+    prefill = jax.jit(
+        lambda p, t: transformer.prefill(p, t, cfg, cache_len))
+    step = jax.jit(
+        lambda p, c, t, pos: transformer.decode_step(p, c, t, pos, cfg))
+
+    def serve_group(group):
+        """One lockstep batch decode, max(max_new) steps for all rows."""
+        bucket = ragged.bucket_length(max(len(p) for _, p, _ in group),
+                                      buckets)
+        toks = np.zeros((batch, bucket), np.int32)
+        for r, (_, p, _) in enumerate(group):
+            # lockstep needs ONE shared prompt length: left-pad to the
+            # group bucket (padding content doesn't affect step timing;
+            # a real lockstep server refuses mixed lengths outright)
+            toks[r, -len(p):] = p
+        steps = max(m for _, _, m in group)
+        logits, cache = prefill(params, jnp.asarray(toks))
+        out = np.asarray(logits).argmax(-1).astype(np.int32)
+        for j in range(steps - 1):
+            # host-side sampling baseline: the full [B, vocab] logits
+            # cross to numpy every token
+            logits, cache = step(params, cache, jnp.asarray(out),
+                                 jnp.asarray(bucket + j, jnp.int32))
+            out = np.asarray(logits).argmax(-1).astype(np.int32)
+
+    # warmup: compile each bucket the trace uses + the decode step
+    for b in sorted({ragged.bucket_length(len(p), buckets)
+                     for _, p, _ in work}):
+        serve_group([(0.0, np.zeros(b, np.int32), 2)])
+
+    done, i, pending = 0, 0, []
+    lat, ttfts, goodput = [], [], 0
+    t0 = time.perf_counter()
+    while i < len(work) or pending:
+        now = time.perf_counter() - t0
+        while i < len(work) and work[i][0] <= now:
+            pending.append(work[i])
+            i += 1
+        if len(pending) >= batch or (i == len(work) and pending):
+            group = pending[:batch]
+            pending = pending[batch:]
+            serve_group(group)
+            end = time.perf_counter() - t0
+            for arr, _p, m in group:
+                lat.append(end - arr)
+                ttfts.append(end - arr)   # lockstep: tokens land at the
+                goodput += m              # END of the batch decode
+            done += len(group)
+        elif i < len(work):
+            time.sleep(min(max(work[i][0] - now, 0.0), 0.05))
+    wall = time.perf_counter() - t0
+    return {"variant": "lockstep", "requests": done,
+            "tokens": goodput, "wall_s": round(wall, 4),
+            "tokens_per_sec": round(goodput / wall, 2),
+            "p50_latency_s": round(_pct(lat, 0.5), 4),
+            "p99_latency_s": round(_pct(lat, 0.99), 4),
+            "ttft_p50_s": round(_pct(ttfts, 0.5), 4),
+            "ttft_p99_s": round(_pct(ttfts, 0.99), 4)}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--batch", type=int, default=8,
+                    help="KV-arena slots (= lockstep batch size)")
+    ap.add_argument("--rate", type=float, default=16.0,
+                    help="Poisson arrival rate, requests/sec")
+    ap.add_argument("--vocab", type=int, default=2048)
+    ap.add_argument("--d-model", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--cache-len", type=int, default=256)
+    ap.add_argument("--prompt-lens", default="8,16,32,64",
+                    help="mixed prompt lengths (lockstep pads each "
+                         "group to the max)")
+    ap.add_argument("--max-new", default="4,8,16,64",
+                    help="mixed output budgets (lockstep decodes every "
+                         "row to the group max)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--metrics-out", default=None,
+                    help="append JSONL records here (bench.py trail "
+                         "conventions)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny preset for the tier-1 fast test: few "
+                         "requests, near-zero inter-arrival gaps")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.requests, args.batch, args.rate = 6, 2, 1e6
+        args.vocab, args.d_model, args.layers = 64, 16, 2
+        args.cache_len = 64
+        args.prompt_lens, args.max_new = "4,10", "4,8"
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab=args.vocab, d_model=args.d_model,
+        n_heads=max(2, args.d_model // 32), n_kv_heads=0,
+        n_layers=args.layers, d_ff=args.d_model * 4,
+        max_len=args.cache_len,
+        dtype=jnp.float32 if jax.default_backend() == "cpu"
+        else jnp.bfloat16, use_rope=True)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    prompt_lens = [int(x) for x in args.prompt_lens.split(",")]
+    max_news = [int(x) for x in args.max_new.split(",")]
+    buckets = tuple(sorted({
+        2 ** int(np.ceil(np.log2(max(t, 2)))) for t in prompt_lens}))
+    work = build_workload(args.requests, args.rate, prompt_lens,
+                          max_news, args.vocab, args.seed)
+
+    results = {}
+    for runner in (run_engine, run_lockstep):
+        r = runner(params, cfg, work, batch=args.batch,
+                   cache_len=args.cache_len, buckets=buckets)
+        r.update({"bench": "serving", "platform": jax.default_backend(),
+                  "batch": args.batch, "rate": args.rate,
+                  "requests_total": args.requests})
+        results[r["variant"]] = r
+        print(json.dumps(r), flush=True)
+        metrics_write(**r)
+
+    speedup = (results["engine"]["tokens_per_sec"]
+               / max(results["lockstep"]["tokens_per_sec"], 1e-9))
+    final = {"bench": "serving", "metric": "serving_engine_speedup",
+             "value": round(speedup, 3),
+             "platform": jax.default_backend()}
+    print(json.dumps(final), flush=True)
+    metrics_write(**final)
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -276,6 +276,91 @@ def job_infer(cfg, args):
     return 0
 
 
+def job_serve(args):
+    """Continuous-batching LM serving over stdio: load a format-v3
+    ``lm_serving`` artifact, schedule JSONL requests from stdin through
+    the slot-based ``serving.DecodeEngine``, write one JSONL result per
+    request to stdout as it completes (NOT in submission order — that is
+    the point of continuous batching).
+
+    Request lines:  {"prompt": [ids...], "max_new": 32,
+                     "temperature": 0.8, "top_k": 40, "eos_id": 2}
+    Result lines:   {"id": ..., "tokens": [ids...], "finish_reason":
+                     "eos"|"max_tokens", "ttft_ms": ..., "latency_ms": ...}
+
+    ``--health_port`` exposes the engine's /metrics + /healthz (queue
+    depth, slot occupancy, TTFT histograms) while serving.
+    """
+    import json
+
+    from paddle_tpu.io import lm_serving
+
+    srv = lm_serving.load_lm_artifact(args.model)
+    try:
+        eng = srv.engine()
+    except ValueError as e:
+        print(f"serve: {e}", file=sys.stderr)
+        return 1
+    health_srv = None
+    if args.health_port is not None:
+        health_srv = eng.serve(host=args.health_host,
+                               port=args.health_port)
+        print(f"observability: {health_srv.url}/metrics  "
+              f"{health_srv.url}/healthz", file=sys.stderr)
+
+    def emit(req):
+        print(json.dumps({
+            "id": req.rid, "tokens": [int(t) for t in req.tokens],
+            "finish_reason": req.finish_reason,
+            "ttft_ms": round(1000 * req.ttft_s, 3),
+            "latency_ms": round(1000 * req.latency_s, 3)}), flush=True)
+
+    # stdin is read on a side thread feeding a queue: the main loop must
+    # keep stepping in-flight requests (and emitting their results)
+    # while a streaming client holds the pipe open between requests — a
+    # blocking `for line in sys.stdin` would stall decode until EOF
+    import queue as _queue
+    import threading
+
+    inbox: "_queue.Queue" = _queue.Queue()
+
+    def _read_stdin():
+        for line in sys.stdin:
+            inbox.put(line)
+        inbox.put(None)                 # EOF marker
+
+    threading.Thread(target=_read_stdin, daemon=True).start()
+    eof = False
+    try:
+        while not (eof and eng.idle):
+            try:
+                # busy engine: drain input opportunistically; idle
+                # engine: block briefly so waiting costs no CPU
+                line = inbox.get(timeout=0.05 if eng.idle else 0.0)
+                if line is None:
+                    eof = True
+                elif line.strip():
+                    try:
+                        r = json.loads(line)
+                        eng.submit(
+                            np.asarray(r["prompt"], np.int32),
+                            int(r.get("max_new", args.max_new)),
+                            temperature=float(r.get("temperature", 0.0)),
+                            top_k=int(r.get("top_k", 0)),
+                            eos_id=r.get("eos_id"))
+                    except (ValueError, KeyError, TypeError) as e:
+                        print(json.dumps({"error": str(e)}), flush=True)
+            except _queue.Empty:
+                pass
+            if not eng.idle:
+                for d in eng.step():
+                    emit(d)
+    finally:
+        if health_srv is not None:
+            health_srv.close()
+    return 0
+
+
 def _pct(sorted_vals, q):
     if not sorted_vals:
         return 0.0
@@ -431,9 +516,10 @@ def main(argv=None):
         description="TPU-native trainer CLI (reference: paddle_trainer, "
                     "TrainerMain.cpp)")
     p.add_argument("job", choices=["train", "test", "time", "checkgrad",
-                                   "infer", "stats"],
+                                   "infer", "stats", "serve"],
                    help="what to run (TrainerMain.cpp:52-61; stats "
-                        "renders an observability snapshot)")
+                        "renders an observability snapshot; serve runs "
+                        "the continuous-batching LM engine over stdio)")
     p.add_argument("--config", default=None,
                    help="python config file (required for every job "
                         "except stats)")
@@ -441,7 +527,11 @@ def main(argv=None):
     p.add_argument("--save_dir", default=None)
     p.add_argument("--init_model_path", default=None)
     p.add_argument("--model", default=None,
-                   help="merged-model artifact for job=infer")
+                   help="merged-model artifact for job=infer / format-v3 "
+                        "lm_serving artifact for job=serve")
+    p.add_argument("--max_new", type=int, default=64,
+                   help="default max_new for job=serve requests that "
+                        "omit it")
     p.add_argument("--output_path", default=None,
                    help="where job=infer saves outputs (.npz)")
     p.add_argument("--infer_limit", type=int, default=0,
@@ -466,7 +556,7 @@ def main(argv=None):
                         "(job=stats: export the buffer immediately)")
     p.add_argument("--health_port", type=int, default=None,
                    help="serve /metrics + /healthz on this port during "
-                        "job=train (0 = ephemeral)")
+                        "job=train or job=serve (0 = ephemeral)")
     p.add_argument("--health_host", default="127.0.0.1",
                    help="bind address for --health_port (use 0.0.0.0 "
                         "for out-of-pod probes; default loopback)")
@@ -479,6 +569,10 @@ def main(argv=None):
             "checkgrad": job_checkgrad, "infer": job_infer}
     if args.job == "stats":
         return job_stats(None, args)
+    if args.job == "serve":
+        if not args.model:
+            p.error("--model=lm.tar is required for job=serve")
+        return job_serve(args)
     if not args.config:
         p.error(f"--config is required for job={args.job}")
     cfg = _load_config(args.config)

@@ -26,7 +26,10 @@ from paddle_tpu.io.merged import _add_member as _add   # shared tar append
 from paddle_tpu.observe import costs as _costs
 from paddle_tpu.observe import metrics as _metrics
 
-FORMAT_VERSION = 2   # max supported; plain artifacts still save as v1
+FORMAT_VERSION = 3   # max supported; plain artifacts still save as v1,
+#                      int8-weight ones as v2; v3 adds the continuous-
+#                      batching engine modules (slot prefill per bucket +
+#                      vector-position decode with on-device sampling)
 
 
 def _unflatten(flat):
@@ -99,7 +102,9 @@ def quantize_lm_params(params):
 def save_lm_artifact(path: str, params, cfg, *, batch: int,
                      prompt_len: int, cache_len: int,
                      platforms: Optional[Sequence[str]] = None,
-                     weights_int8: bool = False) -> None:
+                     weights_int8: bool = False,
+                     engine_buckets: Optional[Sequence[int]] = None
+                     ) -> None:
     """Export the serving pair at fixed shapes and pack the artifact.
 
     batch/prompt_len/cache_len fix the exported shapes (AOT modules are
@@ -108,6 +113,12 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
     ``weights_int8`` stores the big matmul weights as per-output-channel
     int8 (see quantize_lm_params) — the exported modules dequantize
     inline, so the loader and LMServer are unchanged.
+    ``engine_buckets`` additionally exports the continuous-batching
+    engine programs (format v3): one slot-prefill module per prompt
+    bucket plus one per-slot-position decode module with on-device
+    greedy/temperature/top-k sampling; ``LMServer.engine()`` schedules
+    over them. ``batch`` doubles as the KV-arena slot count. v1/v2
+    artifacts keep loading into the legacy lockstep path unchanged.
     """
     import jax
     import jax.export  # noqa: F401 — jax.export needs an explicit import
@@ -152,23 +163,64 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
                    jax.ShapeDtypeStruct((), jnp.int32))
     exp_decode = jax.export.export(jit_decode, **kw)(*decode_args)
 
+    # format-v3 engine programs: slot prefill per bucket + one vector-
+    # position decode step with the sampler fused in (token ids are the
+    # only host-bound output)
+    engine_members = {}
+    if engine_buckets:
+        from paddle_tpu.serving import sampling as _sampling
+        buckets = sorted({int(b) for b in engine_buckets})
+        bad = [b for b in buckets if b < 1 or b > cache_len]
+        if bad:
+            raise ValueError(f"engine_buckets {bad} outside "
+                             f"[1, cache_len={cache_len}]")
+        eng_prefill, eng_decode = _sampling.engine_step_fns(
+            cfg, dequant=(ops_q8.dequantize_tree if weights_int8
+                          else None))
+        i32 = jax.ShapeDtypeStruct((), jnp.int32)
+        f32 = jax.ShapeDtypeStruct((), jnp.float32)
+        for b in buckets:
+            ep = jax.export.export(jax.jit(eng_prefill), **kw)(
+                p_shapes, cache_shapes,
+                jax.ShapeDtypeStruct((1, b), jnp.int32),
+                i32, i32, f32, i32, i32)
+            engine_members[f"engine_prefill_{b}.bin"] = ep.serialize()
+        eng_decode_args = (
+            p_shapes, cache_shapes,
+            jax.ShapeDtypeStruct((batch,), jnp.int32),
+            jax.ShapeDtypeStruct((batch,), jnp.int32),
+            jax.ShapeDtypeStruct((batch,), jnp.bool_),
+            jax.ShapeDtypeStruct((batch,), jnp.float32),
+            jax.ShapeDtypeStruct((batch,), jnp.int32), i32)
+        jit_eng_decode = jax.jit(eng_decode)
+        engine_members["engine_decode.bin"] = jax.export.export(
+            jit_eng_decode, **kw)(*eng_decode_args).serialize()
+
     # per-phase cost accounting, stamped into the artifact at export
     # time (the loader has no model code to re-derive it from): the MFU
     # denominator's numerator for any host that serves this file
     cost_analysis = {}
-    for phase, fn, args in (("prefill", jit_prefill, (p_shapes, toks)),
-                            ("decode", jit_decode, decode_args)):
+    phases = [("prefill", jit_prefill, (p_shapes, toks)),
+              ("decode", jit_decode, decode_args)]
+    if engine_buckets:
+        phases.append(("engine_decode", jit_eng_decode, eng_decode_args))
+    for phase, fn, args in phases:
         ca = _costs.lowered_cost(fn, *args)
         if ca:
             cost_analysis[phase] = ca
 
     meta = {
         # quantized artifacts carry nested {"q8","scale"} params — a v2
-        # encoding; plain artifacts stay v1 for older loaders
-        "format_version": 2 if weights_int8 else 1,
+        # encoding; plain artifacts stay v1 for older loaders; engine
+        # modules (whose member names older loaders would not recognise)
+        # bump to v3
+        "format_version": 3 if engine_buckets
+        else (2 if weights_int8 else 1),
         "batch": batch, "prompt_len": prompt_len, "cache_len": cache_len,
         "weights_int8": weights_int8, "config": _cfg_to_dict(cfg),
         "cost_analysis": cost_analysis}
+    if engine_buckets:
+        meta["engine_buckets"] = buckets
     flat = _flatten(params)
     buf = _io.BytesIO()
     np.savez(buf, **flat)
@@ -177,6 +229,8 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
         _add(tar, "params.npz", buf.getvalue())
         _add(tar, "prefill.bin", exp_prefill.serialize())
         _add(tar, "decode.bin", exp_decode.serialize())
+        for name, blob in engine_members.items():
+            _add(tar, name, blob)
 
 
 # decode steps run single-digit ms; prefill tens-to-hundreds — buckets
@@ -198,7 +252,8 @@ class LMServer:
     snapshot a scrape endpoint serves verbatim.
     """
 
-    def __init__(self, meta, params, prefill_bin, decode_bin):
+    def __init__(self, meta, params, prefill_bin, decode_bin,
+                 engine_bins=None):
         import jax
         import jax.export  # noqa: F401 — needs an explicit import
         self.meta = meta
@@ -206,6 +261,11 @@ class LMServer:
         self.params = params
         self._prefill = jax.export.deserialize(prefill_bin)
         self._decode = jax.export.deserialize(decode_bin)
+        # format-v3 continuous-batching modules (absent on v1/v2):
+        # deserialized lazily by engine() — lockstep-only consumers of a
+        # v3 artifact pay nothing for them
+        self._engine_bins = dict(engine_bins or {})
+        self.engine_buckets = tuple(meta.get("engine_buckets", ()))
         reg = self.metrics = _metrics.Registry()
         self._m_prefill = reg.counter(
             "lm_prefill_calls_total", "prefill (prompt) passes served")
@@ -253,9 +313,57 @@ class LMServer:
         return HealthServer(registry=self.metrics, health_fn=self.health,
                             host=host, port=port)
 
+    def engine(self, *, seed: Optional[int] = None, registry=None,
+               tracker=None):
+        """Continuous-batching ``serving.DecodeEngine`` over this
+        artifact's format-v3 modules (one compiled slot-prefill per
+        prompt bucket + one vector-position decode with on-device
+        sampling). Raises on v1/v2 artifacts — re-export with
+        ``engine_buckets=`` to serve continuously; ``generate()`` stays
+        the lockstep fallback either way."""
+        import jax.export
+        import jax.numpy as jnp
+        from paddle_tpu.serving.engine import DecodeEngine
+        if not self._engine_bins:
+            raise ValueError(
+                f"artifact (format v{self.meta['format_version']}) has "
+                f"no engine modules — re-export with "
+                f"save_lm_artifact(..., engine_buckets=(...)) for "
+                f"continuous batching")
+        prefills = {b: jax.export.deserialize(
+            self._engine_bins[f"engine_prefill_{b}.bin"]).call
+            for b in self.engine_buckets}
+        decode = jax.export.deserialize(
+            self._engine_bins["engine_decode.bin"]).call
+
+        def prefill(params, cache, tokens, *rest):
+            return prefills[tokens.shape[1]](params, cache, tokens,
+                                             *rest)
+
+        # zero-filled KV arena straight from the meta (no model code —
+        # the shape is determined by the config alone)
+        cfg = self.cfg
+        shape = (cfg.n_layers, self.meta["batch"], self.meta["cache_len"],
+                 cfg.kv_heads, cfg.head_dim)
+        cache = {"k": jnp.zeros(shape, cfg.dtype),
+                 "v": jnp.zeros(shape, cfg.dtype)}
+        return DecodeEngine(
+            prefill, decode, self.params, cache,
+            batch=self.meta["batch"], cache_len=self.meta["cache_len"],
+            buckets=self.engine_buckets, seed=seed, registry=registry,
+            tracker=tracker)
+
     def generate(self, prompt: np.ndarray, max_new: int,
                  temperature: float = 0.0,
-                 seed: Optional[int] = None) -> np.ndarray:
+                 seed: Optional[int] = None,
+                 eos_id: Optional[int] = None) -> np.ndarray:
+        """Lockstep batch generation (every row decodes in unison).
+
+        ``seed=None`` draws fresh OS entropy — two unseeded sampling
+        calls differ; pass an int for reproducibility. ``eos_id`` stops
+        the decode loop early once EVERY row has emitted it (rows that
+        finish first keep emitting ``eos_id`` as padding), so the result
+        is ``[B, prompt_len + n]`` with ``n <= max_new``."""
         import jax.numpy as jnp
         if max_new < 1:
             raise ValueError(f"generate: max_new must be >= 1, "
@@ -268,7 +376,10 @@ class LMServer:
         if tp + max_new > self.meta["cache_len"]:
             raise ValueError(f"{tp + max_new} positions exceed the "
                              f"exported cache_len {self.meta['cache_len']}")
-        rng = np.random.RandomState(0 if seed is None else seed)
+        # seed=None must NOT collapse to RandomState(0): that made every
+        # "unseeded" sampling call deterministically identical. None lets
+        # RandomState pull fresh OS entropy.
+        rng = np.random.RandomState(seed)
 
         def sample(logits):
             if temperature <= 0:
@@ -291,12 +402,24 @@ class LMServer:
         self._m_prefill.inc()
         self._m_prefill_s.observe(time.perf_counter() - t0)
         self._m_tokens.inc(b)
+        done = (toks[0] == eos_id) if eos_id is not None else None
+        # device-side position carry: pos advances with an on-device add
+        # instead of re-uploading a fresh host scalar every token
+        pos = jnp.asarray(tp, jnp.int32)
         for i in range(max_new - 1):
-            t0 = time.perf_counter()
+            if eos_id is not None and done.all():
+                break          # every row terminated: drop the wasted
+            t0 = time.perf_counter()   # lockstep tail steps
             logits, cache = self._decode.call(
                 self.params, cache, jnp.asarray(toks[-1], jnp.int32),
-                jnp.asarray(tp + i, jnp.int32))
-            toks.append(sample(np.asarray(logits)))
+                pos)
+            pos = pos + 1
+            tok = sample(np.asarray(logits))
+            if eos_id is not None:
+                # rows already finished pad with eos_id from here on
+                tok = np.where(done, eos_id, tok).astype(np.int32)
+                done = done | (tok == eos_id)
+            toks.append(tok)
             dt = time.perf_counter() - t0
             self._m_decode.inc()
             self._m_decode_s.observe(dt)
@@ -320,5 +443,7 @@ def load_lm_artifact(path: str) -> LMServer:
     with np.load(_io.BytesIO(members["params.npz"]),
                  allow_pickle=False) as z:
         params = _unflatten({k: z[k] for k in z.files})
+    engine_bins = {k: v for k, v in members.items()
+                   if k.startswith("engine_")}
     return LMServer(meta, params, members["prefill.bin"],
-                    members["decode.bin"])
+                    members["decode.bin"], engine_bins=engine_bins)

@@ -190,6 +190,23 @@ def _rope_tables(positions, head_dim, theta):
     return jnp.cos(ang), jnp.sin(ang)
 
 
+def _rope_rows(x, tables):
+    """Rotary embedding for one token PER ROW: x [B, H, Dh] with per-row
+    cos/sin tables [B, Dh/2] (each batch row sits at its own position —
+    the continuous-batching decode layout). Elementwise math is identical
+    to ``_rope``'s, so a row at position p rotates bitwise the same as a
+    lockstep step at scalar position p."""
+    cos, sin = tables
+    cos = cos[:, None, :]
+    sin = sin[:, None, :]
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+        axis=-1).astype(x.dtype)
+
+
 def _rope(x, tables):
     """Rotary position embedding over the head dim of [..., T, H, Dh]
     (pairing halves: (x1, x2) -> (x1·cos − x2·sin, x1·sin + x2·cos)).
@@ -233,7 +250,7 @@ def forward(params, tokens: jax.Array, cfg: TransformerConfig, *,
 
 
 def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head,
-                  dropout_key=None, return_aux=False):
+                  dropout_key=None, return_aux=False, gather_pos=None):
     B, T = tokens.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     if not 0.0 <= cfg.dropout < 1.0:
@@ -349,6 +366,14 @@ def _forward_impl(params, tokens, cfg, mesh, lengths, return_kv, head,
         # serving prefill: only the final position feeds the vocab head —
         # skips the O(T·vocab) logits tensor a full head would materialize
         x = x[:, -1:]
+    elif head == "gather":
+        # slot prefill: the prompt is right-padded to a bucket length, so
+        # the position feeding the vocab head is the TRACED index
+        # ``gather_pos`` [B] (the true last prompt token), not -1. Same
+        # O(vocab) head as "last"; causality already isolates the real
+        # prefix from the padding, so no attention mask is needed and the
+        # gathered activations are bitwise the unpadded forward's.
+        x = jnp.take_along_axis(x, gather_pos.reshape(-1, 1, 1), axis=1)
     x = _layer_norm(x, params["ln_f"], params["ln_f_b"])
     logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
                         params["embed"].astype(jnp.float32))
@@ -451,6 +476,128 @@ def decode_step(params, cache, tokens: jax.Array, pos: jax.Array,
             from paddle_tpu.parallel import moe
             # decode capacity = full batch (cf = E/k): inference must
             # not drop tokens the way Switch training capacity does
+            mc = _dc.replace(cfg.moe_cfg(), capacity_factor=float(
+                cfg.moe_experts) / cfg.moe_top_k)
+            out, _ = moe.moe_ffn(
+                {"gate": w["gate"], "w_in": w["moe_w_in"],
+                 "w_out": w["moe_w_out"]}, h2, mc)
+            x = x + out.astype(x.dtype)
+        else:
+            ff = jax.nn.gelu(h2 @ w["mlp_in"].astype(h2.dtype))
+            x = x + ff @ w["mlp_out"].astype(ff.dtype)
+        return x, (kc, vc)
+
+    x, (kn, vn) = jax.lax.scan(block, x,
+                               (params["blocks"], cache["k"], cache["v"]))
+    x = _layer_norm(x, params["ln_f"], params["ln_f_b"])
+    logits = jnp.einsum("bd,vd->bv", x.astype(jnp.float32),
+                        params["embed"].astype(jnp.float32))
+    return logits, {"k": kn, "v": vn}
+
+
+def prefill_into_slot(params, cache, tokens: jax.Array, length: jax.Array,
+                      slot: jax.Array, cfg: TransformerConfig, *,
+                      mesh: Optional[Mesh] = None):
+    """Prefill ONE request into arena row ``slot`` of a shared KV cache.
+
+    tokens [1, Tb] is the prompt right-padded to a bucket length Tb;
+    ``length`` (scalar int32, traced) is the true prompt length and
+    ``slot`` (scalar int32, traced) the arena row. Returns (logits at the
+    last real prompt position [1, vocab] fp32, updated cache). All shapes
+    are static, so the engine compiles ONCE per (bucket, arena) pair and
+    new requests join mid-flight without retracing.
+
+    Correctness of right-padding without a mask: KV projections are
+    per-position, and causal attention means padded positions only feed
+    their OWN outputs — the gathered position ``length - 1`` attends to
+    real tokens exclusively, so its logits are bitwise the unpadded
+    forward's. The padded rows' garbage KV lands at positions
+    ``length..Tb-1``, each of which is overwritten by a decode step
+    BEFORE any per-slot attention mask (``pos >= position``) can read it.
+    Rows other than ``slot`` are untouched (dynamic_update_slice writes a
+    1-row slab)."""
+    if tokens.shape[0] != 1:
+        raise ValueError(f"prefill_into_slot takes one request "
+                         f"([1, Tb] tokens), got {tokens.shape}")
+    logits, (kc, vc) = _forward_impl(
+        params, tokens, cfg, mesh, None, True, head="gather",
+        gather_pos=jnp.reshape(length, (1,)) - 1)
+    slot = jnp.asarray(slot, jnp.int32)
+    zero = jnp.zeros((), jnp.int32)
+    idx = (zero, slot, zero, zero, zero)
+    return logits[:, 0], {
+        "k": jax.lax.dynamic_update_slice(
+            cache["k"], kc.astype(cache["k"].dtype), idx),
+        "v": jax.lax.dynamic_update_slice(
+            cache["v"], vc.astype(cache["v"].dtype), idx)}
+
+
+def decode_step_slots(params, cache, tokens: jax.Array, pos: jax.Array,
+                      active: jax.Array, cfg: TransformerConfig):
+    """One incremental step with PER-SLOT positions: tokens [B] int32,
+    ``pos`` [B] int32 (each row's write/attend position) and ``active``
+    [B] bool → (logits [B, vocab] fp32, updated cache).
+
+    The continuous-batching variant of ``decode_step``: every arena row
+    advances independently, so requests of different lengths decode in
+    one compiled program. Inactive rows compute (harmlessly) but their
+    cache rows are NOT written — admission and recycling can't perturb
+    in-flight neighbours. For rows whose pos equals a lockstep call's
+    scalar pos, the arithmetic is elementwise identical to
+    ``decode_step``'s, so logits match bitwise (tested).
+
+    The block body deliberately mirrors ``decode_step``'s rather than
+    sharing it: the lockstep path keeps its cheaper scalar-index
+    ``dynamic_update_slice`` (and its exported v1/v2 artifact program),
+    while this variant needs per-row where-writes. The bitwise test in
+    tests/test_serving_engine.py pins the two against drifting."""
+    B = tokens.shape[0]
+    H, Dh = cfg.n_heads, cfg.head_dim
+    Hkv = cfg.kv_heads
+    kvd = Hkv * Dh
+    max_len = cache["k"].shape[2]
+    pos = jnp.asarray(pos, jnp.int32)
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    if not cfg.use_rope:
+        x = x + jnp.take(params["pos"], pos, axis=0).astype(cfg.dtype)
+    rope_tabs = _rope_tables(pos, Dh, cfg.rope_theta) \
+        if cfg.use_rope else None
+    # [B, max_len] one-hot write mask: row b writes position pos[b] only
+    # when active — a where() against the arena instead of
+    # dynamic_update_slice, because each row targets a different index
+    write = ((jnp.arange(max_len, dtype=jnp.int32)[None, :]
+              == pos[:, None]) & active[:, None])
+    attend = (jnp.arange(max_len, dtype=jnp.int32)[None, :]
+              <= pos[:, None])                          # [B, max_len]
+
+    def block(x, scanned):
+        w, kc, vc = scanned                  # kc/vc [B, max_len, Hkv, Dh]
+        h = _layer_norm(x, w["ln1"], w["ln1_b"])
+        qkv = h @ w["qkv"].astype(h.dtype)   # [B, D + 2*kvd]
+        q, k, v = jnp.split(qkv, [H * Dh, H * Dh + kvd], axis=-1)
+        if cfg.use_rope:
+            q = _rope_rows(q.reshape(B, H, Dh), rope_tabs).reshape(
+                B, H * Dh)
+            k = _rope_rows(k.reshape(B, Hkv, Dh), rope_tabs).reshape(
+                B, kvd)
+        kc = jnp.where(write[:, :, None, None],
+                       k.reshape(B, 1, Hkv, Dh).astype(kc.dtype), kc)
+        vc = jnp.where(write[:, :, None, None],
+                       v.reshape(B, 1, Hkv, Dh).astype(vc.dtype), vc)
+        g = H // Hkv
+        q32 = q.reshape(B, Hkv, g, Dh).astype(jnp.float32)
+        s = jnp.einsum("bkgd,btkd->bkgt", q32,
+                       kc.astype(jnp.float32)) / math.sqrt(Dh)
+        s = jnp.where(attend[:, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bkgt,btkd->bkgd", p, vc.astype(jnp.float32))
+        attn = attn.reshape(B, cfg.d_model).astype(cfg.dtype)
+        x = x + attn @ w["attn_out"].astype(attn.dtype)
+        h2 = _layer_norm(x, w["ln2"], w["ln2_b"])
+        if cfg.moe_experts:
+            import dataclasses as _dc
+
+            from paddle_tpu.parallel import moe
             mc = _dc.replace(cfg.moe_cfg(), capacity_factor=float(
                 cfg.moe_experts) / cfg.moe_top_k)
             out, _ = moe.moe_ffn(

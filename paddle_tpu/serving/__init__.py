@@ -1,0 +1,19 @@
+"""Continuous-batching LM serving (slot-based KV arena + scheduler).
+
+Public surface:
+
+- :class:`~paddle_tpu.serving.engine.DecodeEngine` — the scheduler
+  (FIFO admission, slot recycling, bucketed prefill, on-device
+  sampling); build via ``DecodeEngine.from_params`` or a format-v3
+  artifact's ``LMServer.engine()``.
+- :class:`~paddle_tpu.serving.engine.EngineRequest` — per-request
+  lifecycle record (tokens, TTFT, latency, finish reason).
+- :func:`~paddle_tpu.serving.sampling.sample_tokens` /
+  :func:`~paddle_tpu.serving.sampling.engine_step_fns` — the pure step
+  programs (greedy / temperature / top-k inside the compiled step).
+"""
+
+from paddle_tpu.serving.engine import (  # noqa: F401
+    DEFAULT_PREFILL_BUCKETS, DecodeEngine, EngineRequest)
+from paddle_tpu.serving.sampling import (  # noqa: F401
+    engine_step_fns, sample_tokens)

@@ -1,0 +1,378 @@
+"""Continuous-batching LM decode engine: slot scheduler over a KV arena.
+
+The lockstep serving surface (``io/lm_serving.LMServer.generate``)
+forces every request into one fixed-shape batch: shared prompt length,
+shared step count, host-side sampling. This engine replaces batch
+formation with SLOTS: the KV cache is a ``[L, B, cache_len, Hkv, Dh]``
+arena whose B rows are leased to requests independently. A request
+
+1. queues (FIFO) until a slot frees,
+2. prefills into its slot via ``transformer.prefill_into_slot`` — the
+   prompt is right-padded to a bucket length (``core/ragged`` buckets),
+   so the engine compiles at most once per bucket,
+3. decodes in the shared per-slot-position step
+   (``transformer.decode_step_slots`` + on-device sampling) alongside
+   whatever else is in flight, each row at its own position,
+4. terminates on EOS / max_new and releases the slot to the next
+   queued request — mid-flight, no other row perturbed.
+
+Every shape is static: one compile per prefill bucket + ONE for decode,
+verified by the observe compile tracker under the names
+``serving_engine.prefill`` / ``serving_engine.decode``.
+
+The host loop only ever moves ``[B] int32`` token ids off device (the
+sampler runs inside the step); scheduling state (positions, active
+mask, per-slot temperature/top_k) lives in numpy and is re-uploaded as
+tiny vectors per step.
+
+Observability: each engine carries its own metrics ``Registry`` —
+queue-wait and time-to-first-token histograms, slot-occupancy and
+queue-depth gauges, token/step counters, per-request goodput — and
+``serve()`` exposes them on the standard ``/metrics`` + ``/healthz``
+endpoints (``observe/health.py``).
+"""
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.observe import compile_tracker as _ct
+from paddle_tpu.observe import metrics as _metrics
+
+# prefill buckets: small powers of two keep compile count tiny while
+# wasting at most ~2x padded prefill compute on a mixed workload
+DEFAULT_PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512)
+
+# decode steps run single-digit ms; prefill tens-to-hundreds (matches
+# io/lm_serving's serving-latency resolution)
+_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+_GOODPUT_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                    500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+
+@dataclasses.dataclass
+class EngineRequest:
+    """One generation request and its lifecycle record."""
+    rid: int
+    prompt: np.ndarray                  # [Tp] int32
+    max_new: int
+    temperature: float = 0.0
+    top_k: int = 0
+    eos_id: Optional[int] = None
+    # -- lifecycle (filled by the engine) --------------------------------
+    bucket: int = 0
+    slot: int = -1
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    status: str = "queued"              # queued | running | done
+    finish_reason: Optional[str] = None  # eos | max_tokens
+    submit_t: float = 0.0
+    prefill_t: Optional[float] = None
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+
+    @property
+    def output(self) -> np.ndarray:
+        """prompt + generated ids, the ``generate()``-shaped result."""
+        return np.concatenate([self.prompt,
+                               np.asarray(self.tokens, np.int32)])
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submit_t
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.finish_t is None:
+            return None
+        return self.finish_t - self.submit_t
+
+
+class DecodeEngine:
+    """Slot-based continuous-batching scheduler over compiled step fns.
+
+    ``prefill`` / ``decode`` follow the ``sampling.engine_step_fns``
+    signatures (params threaded explicitly, cache functional). Build one
+    with :meth:`from_params` (in-process jit) or
+    :meth:`io.lm_serving.LMServer.engine` (format-v3 AOT artifact).
+    """
+
+    def __init__(self, prefill: Callable, decode: Callable, params, cache,
+                 *, batch: int, cache_len: int,
+                 buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS,
+                 seed: Optional[int] = None,
+                 registry: Optional[_metrics.Registry] = None,
+                 tracker: Optional[_ct.CompileTracker] = None):
+        import jax.numpy as jnp
+        self._jnp = jnp
+        self._prefill_fn = prefill
+        self._decode_fn = decode
+        self.params = params
+        self.cache = cache
+        self.batch = int(batch)
+        self.cache_len = int(cache_len)
+        self.buckets = tuple(sorted({int(b) for b in buckets
+                                     if int(b) <= cache_len}))
+        if not self.buckets:
+            raise ValueError(f"no prefill bucket fits cache_len="
+                             f"{cache_len} (buckets={tuple(buckets)})")
+        # engine-level "unseeded must not repeat": like the LMServer fix,
+        # None draws fresh OS entropy instead of collapsing to a constant
+        self._rng = np.random.RandomState(seed)
+        # per-engine tracker by default: a shared (global) tracker would
+        # have seen another engine's signatures already and mis-credit /
+        # swallow this engine's real compiles in compile_counts()
+        self._tracker = tracker or _ct.CompileTracker()
+        # -- host-side slot state (uploaded as [B] vectors per step) -----
+        B = self.batch
+        self._pos = np.zeros(B, np.int32)
+        self._active = np.zeros(B, bool)
+        self._last = np.zeros(B, np.int32)
+        self._temp = np.zeros(B, np.float32)
+        self._topk = np.zeros(B, np.int32)
+        self._slot_req: List[Optional[EngineRequest]] = [None] * B
+        self._free = deque(range(B))
+        self._queue: deque = deque()
+        self._ids = itertools.count()
+        # -- metrics ------------------------------------------------------
+        reg = self.metrics = registry or _metrics.Registry()
+        self._m_requests = reg.counter(
+            "engine_requests_total", "requests submitted")
+        self._m_completed = reg.counter(
+            "engine_requests_completed_total",
+            "requests finished, by termination reason")
+        self._m_tokens = reg.counter(
+            "engine_tokens_total", "tokens emitted across all requests")
+        self._m_steps = reg.counter(
+            "engine_decode_steps_total", "batched decode steps executed")
+        self._m_prefills = reg.counter(
+            "engine_prefill_calls_total", "slot prefills executed")
+        self._m_queue = reg.gauge(
+            "engine_queue_depth", "requests waiting for a slot")
+        self._m_occupancy = reg.gauge(
+            "engine_slots_active", "arena slots currently decoding")
+        self._m_wait_s = reg.histogram(
+            "engine_queue_wait_seconds", "submit -> prefill-start wait",
+            buckets=_LATENCY_BUCKETS)
+        self._m_ttft_s = reg.histogram(
+            "engine_ttft_seconds", "submit -> first token (queue wait + "
+            "prefill)", buckets=_LATENCY_BUCKETS)
+        self._m_prefill_s = reg.histogram(
+            "engine_prefill_seconds", "slot-prefill device latency",
+            buckets=_LATENCY_BUCKETS)
+        self._m_step_s = reg.histogram(
+            "engine_decode_step_seconds", "batched decode-step latency "
+            "(device call + [B]-ids host sync)", buckets=_LATENCY_BUCKETS)
+        self._m_goodput = reg.histogram(
+            "engine_request_tokens_per_sec", "per-request goodput: "
+            "tokens emitted / (finish - submit)",
+            buckets=_GOODPUT_BUCKETS)
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_params(cls, params, cfg, *, batch: int, cache_len: int,
+                    buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS,
+                    seed: Optional[int] = None, **kw):
+        """In-process engine: jit the step fns against live params (the
+        no-artifact path tests and benchmarks drive)."""
+        import jax
+        from paddle_tpu.models import transformer
+        from paddle_tpu.serving import sampling
+        if cache_len > cfg.max_len:
+            raise ValueError(f"cache_len {cache_len} exceeds cfg.max_len "
+                             f"{cfg.max_len}")
+        prefill_fn, decode_fn = sampling.engine_step_fns(cfg)
+        cache = transformer.init_cache(cfg, batch, cache_len)
+        return cls(jax.jit(prefill_fn), jax.jit(decode_fn), params, cache,
+                   batch=batch, cache_len=cache_len, buckets=buckets,
+                   seed=seed, **kw)
+
+    # -- request API -------------------------------------------------------
+    def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
+               top_k: int = 0, eos_id: Optional[int] = None
+               ) -> EngineRequest:
+        """Queue one request; returns its (live) EngineRequest record."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("submit: empty prompt")
+        if max_new < 1:
+            raise ValueError(f"submit: max_new must be >= 1, "
+                             f"got {max_new}")
+        from paddle_tpu.core import ragged
+        if prompt.size > self.buckets[-1]:
+            # beyond the largest bucket there is no compiled prefill
+            # program (AOT artifacts ship exactly one per bucket)
+            raise ValueError(
+                f"submit: prompt length {prompt.size} exceeds the "
+                f"largest prefill bucket {self.buckets[-1]}")
+        bucket = ragged.bucket_length(prompt.size, self.buckets)
+        if prompt.size + max_new > self.cache_len:
+            raise ValueError(
+                f"submit: {prompt.size} prompt + {max_new} new tokens "
+                f"exceed cache_len {self.cache_len}")
+        req = EngineRequest(
+            rid=next(self._ids), prompt=prompt, max_new=int(max_new),
+            temperature=float(temperature), top_k=int(top_k),
+            eos_id=eos_id, bucket=bucket, submit_t=time.perf_counter())
+        self._queue.append(req)
+        self._m_requests.inc()
+        self._m_queue.set(len(self._queue))
+        return req
+
+    @property
+    def active_count(self) -> int:
+        return int(self._active.sum())
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def idle(self) -> bool:
+        return not self._queue and not self._active.any()
+
+    # -- scheduler ---------------------------------------------------------
+    def _seed(self) -> np.int32:
+        return np.int32(self._rng.randint(0, 2 ** 31 - 1))
+
+    def _finish(self, req: EngineRequest, reason: str, now: float):
+        req.status, req.finish_reason, req.finish_t = "done", reason, now
+        self._m_completed.inc(reason=reason)
+        if req.latency_s and req.latency_s > 0:
+            self._m_goodput.observe(len(req.tokens) / req.latency_s)
+        slot = req.slot
+        if slot >= 0:
+            self._active[slot] = False
+            self._slot_req[slot] = None
+            self._free.append(slot)
+
+    def _emit(self, req: EngineRequest, tok: int, now: float) -> bool:
+        """Record one emitted token; True when the request finished."""
+        req.tokens.append(int(tok))
+        self._m_tokens.inc()
+        if req.first_token_t is None:
+            req.first_token_t = now
+            self._m_ttft_s.observe(now - req.submit_t)
+        if req.eos_id is not None and tok == req.eos_id:
+            self._finish(req, "eos", now)
+            return True
+        if len(req.tokens) >= req.max_new:
+            self._finish(req, "max_tokens", now)
+            return True
+        return False
+
+    def _admit(self, finished: List[EngineRequest]):
+        jnp = self._jnp
+        while self._queue and self._free:
+            req = self._queue.popleft()
+            slot = self._free.popleft()
+            now = time.perf_counter()
+            req.prefill_t = now
+            self._m_wait_s.observe(now - req.submit_t)
+            padded = np.zeros((1, req.bucket), np.int32)
+            padded[0, :req.prompt.size] = req.prompt
+            t0 = time.perf_counter()
+            tok, self.cache = self._tracker.track_call(
+                "serving_engine.prefill", self._prefill_fn,
+                self.params, self.cache, jnp.asarray(padded),
+                np.int32(req.prompt.size), np.int32(slot),
+                np.float32(req.temperature), np.int32(req.top_k),
+                self._seed())
+            tok = int(np.asarray(tok))
+            now = time.perf_counter()
+            self._m_prefill_s.observe(now - t0)
+            self._m_prefills.inc()
+            req.slot, req.status = slot, "running"
+            self._slot_req[slot] = req
+            if self._emit(req, tok, now):
+                finished.append(req)    # one-token request: slot already
+                continue                # recycled by _finish
+            self._active[slot] = True
+            self._pos[slot] = req.prompt.size
+            self._last[slot] = tok
+            self._temp[slot] = req.temperature
+            self._topk[slot] = req.top_k
+        self._m_queue.set(len(self._queue))
+
+    def step(self) -> List[EngineRequest]:
+        """One scheduler iteration: admit waiting requests into free
+        slots, run one batched decode step for everything in flight.
+        Returns the requests that finished during this step."""
+        finished: List[EngineRequest] = []
+        self._admit(finished)
+        if self._active.any():
+            jnp = self._jnp
+            t0 = time.perf_counter()
+            nxt, self.cache = self._tracker.track_call(
+                "serving_engine.decode", self._decode_fn,
+                self.params, self.cache, jnp.asarray(self._last),
+                jnp.asarray(self._pos), jnp.asarray(self._active),
+                jnp.asarray(self._temp), jnp.asarray(self._topk),
+                self._seed())
+            nxt = np.asarray(nxt)       # the only device->host transfer:
+            now = time.perf_counter()   # [B] int32 ids
+            self._m_step_s.observe(now - t0)
+            self._m_steps.inc()
+            for slot in np.flatnonzero(self._active):
+                req = self._slot_req[slot]
+                tok = int(nxt[slot])
+                self._pos[slot] += 1
+                self._last[slot] = tok
+                if self._emit(req, tok, now):
+                    finished.append(req)
+        self._m_occupancy.set(self.active_count)
+        return finished
+
+    def run_until_idle(self, max_steps: int = 100_000
+                       ) -> List[EngineRequest]:
+        """Drive ``step()`` until queue and arena drain; returns every
+        request finished along the way (submission order not guaranteed
+        — requests terminate independently)."""
+        done: List[EngineRequest] = []
+        for _ in range(max_steps):
+            if self.idle:
+                return done
+            done.extend(self.step())
+        raise RuntimeError(f"engine did not drain in {max_steps} steps "
+                           f"({self.queue_depth} queued, "
+                           f"{self.active_count} active)")
+
+    # -- observability -----------------------------------------------------
+    def health(self) -> dict:
+        return {"requests": int(self._m_requests.value()),
+                "completed": sum(
+                    int(self._m_completed.value(reason=r))
+                    for r in ("eos", "max_tokens")),
+                "tokens": int(self._m_tokens.value()),
+                "decode_steps": int(self._m_steps.value()),
+                "queue_depth": self.queue_depth,
+                "slots_active": self.active_count,
+                "slots_total": self.batch,
+                "cache_len": self.cache_len,
+                "prefill_buckets": list(self.buckets)}
+
+    def metrics_text(self) -> str:
+        return self.metrics.render_prometheus()
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        """/metrics + /healthz over this engine's registry; caller owns
+        ``close()``."""
+        from paddle_tpu.observe.health import HealthServer
+        return HealthServer(registry=self.metrics, health_fn=self.health,
+                            host=host, port=port)
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Compilations the tracker charged to this engine's two
+        programs — the "one per bucket + one for decode" invariant."""
+        return {"prefill": self._tracker.count("serving_engine.prefill"),
+                "decode": self._tracker.count("serving_engine.decode")}

@@ -1,0 +1,84 @@
+"""On-device token sampling for the continuous-batching decode engine.
+
+The legacy LMServer samples host-side: every decoded token ships the
+full ``[B, vocab]`` logits to numpy and loops ``RandomState.choice`` per
+row — exactly the host/device sync PAPERS' non-GPU-inference field study
+(arxiv 2607.08215) names as the decode-loop throughput killer. Here the
+sampler is a pure jnp function that runs INSIDE the compiled decode
+step, so only the sampled ids ``[B] int32`` ever cross to the host.
+
+Per-slot controls are runtime vectors (static shapes, one compile):
+
+- ``temperature`` [B] float32 — ``<= 0`` means greedy argmax for that
+  row; the categorical draw still happens but is discarded by a
+  ``where``, keeping the program shape-identical for any mix.
+- ``top_k`` [B] int32 — ``<= 0`` (or ``>= vocab``) disables filtering.
+  A runtime k can't use ``lax.top_k`` (static k), so the row is sorted
+  once and everything below the k-th value is masked to ``-inf``; ties
+  at the threshold survive, matching the usual top-k convention.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(logits: jax.Array, key: jax.Array,
+                  temperature: jax.Array, top_k: jax.Array) -> jax.Array:
+    """logits [B, V] fp32, per-slot temperature [B] / top_k [B] →
+    sampled ids [B] int32 (greedy rows use argmax, first-index ties —
+    the same convention as the host-side legacy path)."""
+    V = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    k = jnp.clip(top_k.astype(jnp.int32), 0, V)
+    srt = jnp.sort(logits, axis=-1)[:, ::-1]          # descending
+    kth = jnp.take_along_axis(srt, jnp.maximum(k - 1, 0)[:, None],
+                              axis=-1)                # [B, 1]
+    keep = (k[:, None] <= 0) | (logits >= kth)
+    z = jnp.where(keep, logits, -jnp.inf)
+    t = jnp.where(temperature > 0, temperature, 1.0)  # div-safe for
+    z = z / t[:, None].astype(jnp.float32)            # greedy rows
+    sampled = jax.random.categorical(key, z, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
+def engine_step_fns(cfg, dequant=None):
+    """(prefill_fn, decode_fn) closures over a TransformerConfig — the
+    two programs the engine compiles (once per prefill bucket, once for
+    decode) and ``save_lm_artifact`` exports as the format-v3 modules.
+
+    ``dequant`` optionally maps the stored param tree to live weights
+    (the weights_int8 artifact path); identity when None.
+
+    prefill_fn(params, cache, tokens [1, Tb], length (), slot (),
+               temperature (), top_k (), seed ()) → (token (), cache)
+    decode_fn(params, cache, tokens [B], pos [B], active [B] bool,
+              temperature [B], top_k [B], seed ()) → (tokens [B], cache)
+
+    Sampling happens inside both programs (``sample_tokens``), so each
+    call returns int32 ids only — no logits cross the host boundary.
+    ``seed`` is a fresh per-call int32; the key derives inside the
+    program, keeping the exported signature plain-integer.
+    """
+    from paddle_tpu.models import transformer
+
+    def _live(params):
+        return dequant(params) if dequant is not None else params
+
+    def prefill_fn(params, cache, tokens, length, slot, temperature,
+                   top_k, seed):
+        logits, cache = transformer.prefill_into_slot(
+            _live(params), cache, tokens, length, slot, cfg)
+        key = jax.random.PRNGKey(seed)
+        tok = sample_tokens(logits, key, jnp.reshape(temperature, (1,)),
+                            jnp.reshape(top_k, (1,)))
+        return tok[0], cache
+
+    def decode_fn(params, cache, tokens, pos, active, temperature,
+                  top_k, seed):
+        logits, cache = transformer.decode_step_slots(
+            _live(params), cache, tokens, pos, active, cfg)
+        key = jax.random.PRNGKey(seed)
+        return sample_tokens(logits, key, temperature, top_k), cache
+
+    return prefill_fn, decode_fn

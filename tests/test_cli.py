@@ -88,3 +88,110 @@ class TestCliJobs:
         assert rc == 0
         preds = np.load(out_npz)["cm_out"]
         assert preds.shape == (8, 4)
+
+
+class TestCliServe:
+    def test_serve_streams_jsonl_requests(self, tmp_path, monkeypatch,
+                                          capsys):
+        """job=serve: format-v3 artifact + JSONL stdin -> one JSONL
+        result per request (continuous batching over the stdio stream),
+        matching the engine's direct greedy output."""
+        import io
+        import json
+        import sys as _sys
+
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.io import lm_serving
+        from paddle_tpu.models import transformer
+
+        cfg = transformer.TransformerConfig(
+            vocab=40, d_model=16, n_heads=2, n_kv_heads=1, n_layers=2,
+            d_ff=32, max_len=32, dtype=jnp.float32, use_rope=True)
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        model = str(tmp_path / "lm_v3.tar")
+        lm_serving.save_lm_artifact(model, params, cfg, batch=2,
+                                    prompt_len=4, cache_len=24,
+                                    engine_buckets=(8,))
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, 40, n).tolist() for n in (4, 7)]
+        lines = [json.dumps({"prompt": p, "max_new": 5})
+                 for p in prompts]
+        lines.append(json.dumps({"prompt": [], "max_new": 5}))  # bad
+        monkeypatch.setattr(_sys, "stdin",
+                            io.StringIO("\n".join(lines) + "\n"))
+        rc = cli.main(["serve", f"--model={model}"])
+        assert rc == 0
+        out = [json.loads(l) for l in
+               capsys.readouterr().out.strip().splitlines()]
+        results = {r["id"]: r for r in out if "id" in r}
+        errors = [r for r in out if "error" in r]
+        assert len(results) == 2 and len(errors) == 1
+        assert "empty prompt" in errors[0]["error"]
+        want = {i: np.asarray(transformer.generate(
+            params, jnp.asarray([p], jnp.int32), cfg, max_new=5))[0]
+            for i, p in enumerate(prompts)}
+        for i, p in enumerate(prompts):
+            assert results[i]["finish_reason"] == "max_tokens"
+            assert results[i]["tokens"] == want[i][len(p):].tolist()
+            assert results[i]["ttft_ms"] > 0
+
+    def test_serve_rejects_lockstep_artifact(self, tmp_path, capsys):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.io import lm_serving
+        from paddle_tpu.models import transformer
+
+        cfg = transformer.TransformerConfig(
+            vocab=40, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+            max_len=32, dtype=jnp.float32)
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        model = str(tmp_path / "lm_v1.tar")
+        lm_serving.save_lm_artifact(model, params, cfg, batch=1,
+                                    prompt_len=4, cache_len=12)
+        rc = cli.main(["serve", f"--model={model}"])
+        assert rc == 1
+        assert "engine_buckets" in capsys.readouterr().err
+
+    def test_serve_streams_results_while_stdin_open(self, tmp_path):
+        """A streaming client that holds the pipe open must get each
+        result as its request completes — the engine steps while stdin
+        is idle (regression: decode used to stall until EOF)."""
+        import json
+        import subprocess
+        import sys as _sys
+
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.io import lm_serving
+        from paddle_tpu.models import transformer
+
+        cfg = transformer.TransformerConfig(
+            vocab=40, d_model=16, n_heads=2, n_kv_heads=1, n_layers=2,
+            d_ff=32, max_len=32, dtype=jnp.float32, use_rope=True)
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        model = str(tmp_path / "lm_v3.tar")
+        lm_serving.save_lm_artifact(model, params, cfg, batch=2,
+                                    prompt_len=4, cache_len=24,
+                                    engine_buckets=(8,))
+        p = subprocess.Popen(
+            [_sys.executable, "-m", "paddle_tpu", "serve",
+             f"--model={model}"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        try:
+            p.stdin.write(json.dumps(
+                {"prompt": [1, 2, 3], "max_new": 4}) + "\n")
+            p.stdin.flush()
+            # stdin stays OPEN: the first result must arrive anyway
+            first = json.loads(p.stdout.readline())
+            assert first["id"] == 0 and len(first["tokens"]) == 4
+            p.stdin.write(json.dumps(
+                {"prompt": [5, 6], "max_new": 3}) + "\n")
+            p.stdin.close()
+            second = json.loads(p.stdout.readline())
+            assert second["id"] == 1 and len(second["tokens"]) == 3
+            assert p.wait(timeout=60) == 0
+        finally:
+            p.kill()

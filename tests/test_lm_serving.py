@@ -171,6 +171,99 @@ def test_server_metrics_prometheus_snapshot(tmp_path, rng):
         http.close()
 
 
+def test_generate_unseeded_sampling_not_deterministic(tmp_path, rng):
+    """seed=None used to collapse to RandomState(0): every 'unseeded'
+    sampling call replayed the same stream. Now it draws OS entropy."""
+    params = transformer.init_params(jax.random.PRNGKey(0), CFG)
+    B, Tp, new = 2, 6, 8
+    prompt = rng.randint(0, 40, (B, Tp)).astype(np.int32)
+    path = str(tmp_path / "lm.tar")
+    lm_serving.save_lm_artifact(path, params, CFG, batch=B,
+                                prompt_len=Tp, cache_len=Tp + new)
+    srv = lm_serving.load_lm_artifact(path)
+    # near-uniform sampling over 40 symbols x 16 draws: a repeat of the
+    # whole matrix is ~40^-16 — an effectively impossible coincidence
+    a = srv.generate(prompt, max_new=new, temperature=100.0)
+    b = srv.generate(prompt, max_new=new, temperature=100.0)
+    assert not np.array_equal(a, b)
+    # explicit seeds stay reproducible
+    a = srv.generate(prompt, max_new=new, temperature=1.0, seed=7)
+    b = srv.generate(prompt, max_new=new, temperature=1.0, seed=7)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_generate_eos_early_exit(tmp_path, rng):
+    """eos_id stops the lockstep decode loop once every row emitted it,
+    and rows that finish first pad with eos_id."""
+    params = transformer.init_params(jax.random.PRNGKey(0), CFG)
+    B, Tp, new = 2, 6, 8
+    # identical rows => identical greedy streams => both rows hit the
+    # eos at the same (deterministic) step
+    prompt = np.tile(rng.randint(0, 40, (1, Tp)), (B, 1)).astype(np.int32)
+    path = str(tmp_path / "lm.tar")
+    lm_serving.save_lm_artifact(path, params, CFG, batch=B,
+                                prompt_len=Tp, cache_len=Tp + new)
+    srv = lm_serving.load_lm_artifact(path)
+    full = srv.generate(prompt, max_new=new)
+    gen = full[0, Tp:]
+    # first position whose token value hasn't occurred before (the toy
+    # model may emit one token forever: fall back to the first token)
+    idx = next((i for i in range(1, new) if gen[i] not in gen[:i]), 0)
+    steps_before = srv._m_decode.value()
+    out = srv.generate(prompt, max_new=new, eos_id=int(gen[idx]))
+    # loop exited right after the eos token: idx decode steps, not new-1
+    assert out.shape == (B, Tp + idx + 1)
+    np.testing.assert_array_equal(out, full[:, :Tp + idx + 1])
+    assert srv._m_decode.value() - steps_before == idx
+    # rows that never emit eos keep the full-length contract
+    out2 = srv.generate(prompt, max_new=new, eos_id=39999)
+    assert out2.shape == (B, Tp + new)
+
+
+def test_engine_artifact_v3_roundtrip(tmp_path, rng):
+    """Format v3: engine modules ride the artifact; the continuous-
+    batching engine serves bitwise the same greedy tokens as the legacy
+    lockstep path, and v3 still loads into LMServer.generate."""
+    from paddle_tpu.observe.compile_tracker import CompileTracker
+    params = transformer.init_params(jax.random.PRNGKey(0), CFG)
+    B, Tp, new = 2, 6, 8
+    prompt = rng.randint(0, 40, (B, Tp)).astype(np.int32)
+    path = str(tmp_path / "lm_v3.tar")
+    lm_serving.save_lm_artifact(path, params, CFG, batch=B,
+                                prompt_len=Tp, cache_len=Tp + new,
+                                engine_buckets=(8,))
+    srv = lm_serving.load_lm_artifact(path)
+    assert srv.meta["format_version"] == 3
+    assert srv.engine_buckets == (8,)
+    assert srv.cost_analysis["engine_decode"]["flops"] > 0
+    # legacy lockstep path unchanged on a v3 artifact
+    got = srv.generate(prompt, max_new=new)
+    want = np.asarray(transformer.generate(
+        params, jnp.asarray(prompt), CFG, max_new=new))
+    np.testing.assert_array_equal(got, want)
+    # engine path: same tokens per request, one compile per program
+    tracker = CompileTracker()
+    eng = srv.engine(seed=0, tracker=tracker)
+    reqs = [eng.submit(prompt[i], max_new=new) for i in range(B)]
+    eng.run_until_idle()
+    for i, r in enumerate(reqs):
+        np.testing.assert_array_equal(r.output, want[i])
+    assert eng.compile_counts() == {"prefill": 1, "decode": 1}
+
+
+def test_engine_requires_v3(tmp_path, rng):
+    """v1/v2 artifacts refuse engine() with a re-export hint."""
+    import pytest
+    params = transformer.init_params(jax.random.PRNGKey(0), CFG)
+    path = str(tmp_path / "lm_v1.tar")
+    lm_serving.save_lm_artifact(path, params, CFG, batch=1,
+                                prompt_len=4, cache_len=12)
+    srv = lm_serving.load_lm_artifact(path)
+    assert srv.meta["format_version"] == 1
+    with pytest.raises(ValueError, match="engine_buckets"):
+        srv.engine()
+
+
 def test_moe_artifact_roundtrip_matches_generate(tmp_path, rng):
     """The serving artifact carries MoE configs transparently (cfg
     round-trips through dataclasses.asdict; decode runs the expert FFN

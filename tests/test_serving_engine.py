@@ -1,0 +1,267 @@
+"""Continuous-batching decode engine: per-slot positions must be
+bitwise-faithful to lockstep decode, admission/recycling must not
+perturb in-flight slots, sampling runs on device, and the whole engine
+compiles once per prefill bucket + once for decode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.models import transformer
+from paddle_tpu.observe.compile_tracker import CompileTracker
+from paddle_tpu.serving import DecodeEngine, sample_tokens
+
+CFG = transformer.TransformerConfig(
+    vocab=40, d_model=16, n_heads=2, n_kv_heads=1, n_layers=2, d_ff=32,
+    max_len=64, dtype=jnp.float32, use_rope=True)
+CFG_ABS = transformer.TransformerConfig(
+    vocab=40, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+    max_len=64, dtype=jnp.float32, use_rope=False)
+PARAMS = transformer.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _engine(batch=2, cache_len=32, buckets=(8, 16), seed=0,
+            params=PARAMS, cfg=CFG):
+    return DecodeEngine.from_params(
+        params, cfg, batch=batch, cache_len=cache_len, buckets=buckets,
+        seed=seed, tracker=CompileTracker())
+
+
+class TestSlotDecodeKernels:
+    @pytest.mark.parametrize("cfg", [CFG, CFG_ABS],
+                             ids=["rope", "learned-pos"])
+    def test_vector_pos_decode_bitwise_matches_lockstep(self, cfg, rng):
+        """Aligned positions: decode_step_slots == decode_step bitwise
+        (logits AND cache), for both position encodings."""
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        B, Tp = 3, 6
+        prompt = jnp.asarray(rng.randint(0, 40, (B, Tp)), jnp.int32)
+        logits, cache = transformer.prefill(params, prompt, cfg, 20)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        l_lock, c_lock = transformer.decode_step(
+            params, cache, tok, jnp.asarray(Tp, jnp.int32), cfg)
+        l_slot, c_slot = transformer.decode_step_slots(
+            params, cache, tok, jnp.full((B,), Tp, jnp.int32),
+            jnp.ones((B,), bool), cfg)
+        np.testing.assert_array_equal(np.asarray(l_lock),
+                                      np.asarray(l_slot))
+        for leaf in ("k", "v"):
+            np.testing.assert_array_equal(np.asarray(c_lock[leaf]),
+                                          np.asarray(c_slot[leaf]))
+
+    def test_inactive_slots_not_written(self, rng):
+        """active=False rows keep their cache bitwise intact and rows
+        never cross-write (each row targets its own position)."""
+        B, Tp = 3, 6
+        prompt = jnp.asarray(rng.randint(0, 40, (B, Tp)), jnp.int32)
+        _, cache = transformer.prefill(PARAMS, prompt, CFG, 20)
+        tok = jnp.zeros((B,), jnp.int32)
+        active = jnp.asarray([True, False, True])
+        _, c2 = transformer.decode_step_slots(
+            PARAMS, cache, tok, jnp.asarray([6, 3, 9], jnp.int32),
+            active, CFG)
+        # row 1 untouched everywhere
+        np.testing.assert_array_equal(np.asarray(cache["k"][:, 1]),
+                                      np.asarray(c2["k"][:, 1]))
+        # row 0 wrote position 6 only; row 2 wrote position 9 only
+        k0, k2 = np.asarray(c2["k"][:, 0]), np.asarray(c2["k"][:, 2])
+        k0_ref = np.asarray(cache["k"][:, 0])
+        assert not np.array_equal(k0[:, 6], k0_ref[:, 6])
+        np.testing.assert_array_equal(k0[:, 7:], k0_ref[:, 7:])
+        np.testing.assert_array_equal(k2[:, 6:9],
+                                      np.asarray(cache["k"][:, 2, 6:9]))
+        assert not np.array_equal(k2[:, 9],
+                                  np.asarray(cache["k"][:, 2, 9]))
+
+    def test_prefill_into_slot_matches_batched_prefill(self, rng):
+        """Right-padded slot prefill reproduces the unpadded lockstep
+        prefill logits bitwise and leaves other arena rows zero."""
+        B, Tp, cache_len = 3, 6, 24
+        prompt = jnp.asarray(rng.randint(0, 40, (B, Tp)), jnp.int32)
+        logits, _ = transformer.prefill(PARAMS, prompt, CFG, cache_len)
+        arena = transformer.init_cache(CFG, B, cache_len)
+        padded = jnp.pad(prompt[1:2], ((0, 0), (0, 2)))   # bucket 8
+        lg, arena = transformer.prefill_into_slot(
+            PARAMS, arena, padded, jnp.asarray(Tp, jnp.int32),
+            jnp.asarray(1, jnp.int32), CFG)
+        np.testing.assert_array_equal(np.asarray(lg[0]),
+                                      np.asarray(logits[1]))
+        np.testing.assert_array_equal(np.asarray(arena["k"][:, 0]), 0.0)
+        np.testing.assert_array_equal(np.asarray(arena["k"][:, 2]), 0.0)
+
+
+class TestOnDeviceSampling:
+    def test_greedy_rows_argmax(self, rng):
+        logits = jnp.asarray(rng.randn(4, 12), jnp.float32)
+        out = sample_tokens(logits, jax.random.PRNGKey(0),
+                            jnp.zeros(4), jnp.zeros(4, jnp.int32))
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(logits).argmax(-1))
+
+    def test_top_k_restricts_support(self, rng):
+        """With top_k=k, samples only ever land in the k largest."""
+        logits = jnp.asarray(rng.randn(2, 20), jnp.float32)
+        top3 = np.argsort(np.asarray(logits), -1)[:, -3:]
+        for s in range(20):
+            out = np.asarray(sample_tokens(
+                logits, jax.random.PRNGKey(s),
+                jnp.full(2, 1.5), jnp.full(2, 3, jnp.int32)))
+            for row in range(2):
+                assert out[row] in top3[row]
+
+    def test_mixed_greedy_and_sampled_rows(self, rng):
+        logits = jnp.asarray(rng.randn(2, 12), jnp.float32)
+        out = np.asarray(sample_tokens(
+            logits, jax.random.PRNGKey(3),
+            jnp.asarray([0.0, 5.0]), jnp.zeros(2, jnp.int32)))
+        assert out[0] == np.asarray(logits[0]).argmax()
+
+
+class TestEngineScheduling:
+    def test_engine_matches_lockstep_generate(self, rng):
+        """Greedy engine output == transformer.generate per request,
+        with mixed prompt lengths sharing the arena."""
+        eng = _engine()
+        prompts = [rng.randint(0, 40, n).astype(np.int32)
+                   for n in (5, 9, 3)]
+        reqs = [eng.submit(p, max_new=6) for p in prompts]
+        done = eng.run_until_idle()
+        assert len(done) == 3
+        for r, p in zip(reqs, prompts):
+            want = np.asarray(transformer.generate(
+                PARAMS, jnp.asarray(p[None]), CFG, max_new=6))[0]
+            np.testing.assert_array_equal(r.output, want)
+            assert r.finish_reason == "max_tokens"
+
+    def test_mid_flight_admission_does_not_perturb(self, rng):
+        """The continuous-batching invariant: a request admitted into a
+        free slot changes NOTHING for its in-flight neighbour."""
+        pa = rng.randint(0, 40, 5).astype(np.int32)
+        pb = rng.randint(0, 40, 9).astype(np.int32)
+        solo = _engine()
+        ra_solo = solo.submit(pa, max_new=8)
+        solo.run_until_idle()
+
+        eng = _engine()
+        ra = eng.submit(pa, max_new=8)
+        for _ in range(3):
+            eng.step()              # A mid-flight with 4 tokens
+        assert len(ra.tokens) == 4
+        rb = eng.submit(pb, max_new=6)   # joins slot 1 mid-flight
+        eng.run_until_idle()
+        np.testing.assert_array_equal(ra.output, ra_solo.output)
+        want_b = np.asarray(transformer.generate(
+            PARAMS, jnp.asarray(pb[None]), CFG, max_new=6))[0]
+        np.testing.assert_array_equal(rb.output, want_b)
+
+    def test_eos_recycles_slot_for_queued_request(self, rng):
+        """EOS termination frees the slot; the queued request fills it
+        and decodes correctly in the recycled row."""
+        pa = rng.randint(0, 40, 5).astype(np.int32)
+        pc = rng.randint(0, 40, 7).astype(np.int32)
+        probe = _engine(batch=1)
+        ra = probe.submit(pa, max_new=8)
+        probe.run_until_idle()
+        # pick an eos that first appears mid-stream (greedy stream is
+        # deterministic, so the replay terminates exactly there)
+        idx = next(i for i in range(1, len(ra.tokens))
+                   if ra.tokens[i] not in ra.tokens[:i])
+        eos = ra.tokens[idx]
+
+        eng = _engine(batch=1)      # one slot: C must wait for A's EOS
+        ra2 = eng.submit(pa, max_new=8, eos_id=eos)
+        rc = eng.submit(pc, max_new=4)
+        assert eng.queue_depth == 2          # admission happens in step()
+        eng.step()
+        assert rc.status == "queued"         # arena full until A's EOS
+        eng.run_until_idle()
+        assert ra2.finish_reason == "eos"
+        assert ra2.tokens == ra.tokens[:idx + 1]  # stops AT the eos
+        assert rc.slot == 0 and rc.finish_reason == "max_tokens"
+        want_c = np.asarray(transformer.generate(
+            PARAMS, jnp.asarray(pc[None]), CFG, max_new=4))[0]
+        np.testing.assert_array_equal(rc.output, want_c)
+
+    def test_compile_once_per_bucket_plus_decode(self, rng):
+        """The static-shape contract: N distinct prompt buckets compile
+        N prefills; every decode step shares ONE compilation."""
+        eng = _engine(batch=2, buckets=(8, 16, 32))
+        for n in (3, 5, 12, 7, 15, 2):      # buckets 8 and 16 only
+            eng.submit(rng.randint(0, 40, n).astype(np.int32),
+                       max_new=4)
+        eng.run_until_idle()
+        assert eng.compile_counts() == {"prefill": 2, "decode": 1}
+
+    def test_submit_guards(self, rng):
+        eng = _engine(cache_len=16, buckets=(8,))
+        with pytest.raises(ValueError, match="exceed cache_len"):
+            eng.submit(rng.randint(0, 40, 8), max_new=16)
+        with pytest.raises(ValueError, match="largest prefill bucket"):
+            eng.submit(rng.randint(0, 40, 12), max_new=2)
+        with pytest.raises(ValueError, match="max_new"):
+            eng.submit(rng.randint(0, 40, 4), max_new=0)
+
+    def test_unseeded_engines_differ(self, rng):
+        """seed=None engines must not replay one sampling stream."""
+        prompt = rng.randint(0, 40, 5).astype(np.int32)
+        outs = []
+        for _ in range(2):
+            eng = _engine(seed=None)
+            r = eng.submit(prompt, max_new=12, temperature=100.0)
+            eng.run_until_idle()
+            outs.append(list(r.tokens))
+        assert outs[0] != outs[1]
+
+
+class TestEngineObservability:
+    def test_metrics_and_health_endpoint(self, rng):
+        import json as _json
+        import urllib.request
+        eng = _engine()
+        for n in (5, 9, 3):
+            eng.submit(rng.randint(0, 40, n).astype(np.int32), max_new=4)
+        eng.run_until_idle()
+        assert eng.metrics.get("engine_tokens_total").value() == 12
+        assert eng.metrics.get(
+            "engine_ttft_seconds").snapshot()["count"] == 3
+        assert eng.metrics.get(
+            "engine_requests_completed_total").value(
+                reason="max_tokens") == 3
+        assert eng.metrics.get("engine_slots_active").value() == 0
+        text = eng.metrics_text()
+        assert "# TYPE engine_queue_wait_seconds histogram" in text
+        assert "engine_request_tokens_per_sec_bucket" in text
+        http = eng.serve()
+        try:
+            health = _json.loads(urllib.request.urlopen(
+                http.url + "/healthz", timeout=5).read())
+            assert health["status"] == "ok"
+            assert health["completed"] == 3 and health["tokens"] == 12
+            scraped = urllib.request.urlopen(
+                http.url + "/metrics", timeout=5).read().decode()
+            assert "engine_tokens_total 12" in scraped
+        finally:
+            http.close()
+
+
+class TestServingBenchSmoke:
+    def test_bench_smoke_engine_beats_nothing_but_runs(self):
+        """Tier-1 exercise of the full bench path (--smoke): both
+        variants produce sane numbers and the engine's compile
+        invariant (asserted inside run_engine) holds. The engine-wins
+        throughput claim is the full-size run's, not the toy's."""
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "serving_bench_under_test",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+                "benchmarks", "serving_bench.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        results = mod.main(["--smoke"])
+        assert results["engine"]["requests"] == 6
+        assert results["engine"]["tokens"] == results["lockstep"]["tokens"]
+        assert results["engine"]["tokens_per_sec"] > 0
+        assert results["engine"]["compiles"]["decode"] == 1
