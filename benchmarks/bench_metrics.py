@@ -1,0 +1,34 @@
+"""Shared JSONL metrics-trail plumbing for benchmark scripts
+(`--metrics-out=PATH` / `BENCH_METRICS_OUT`): one record per line next
+to the stdout JSON, appended inline and never fatal — bench.py
+conventions. Import with the benchmarks dir on sys.path (every script
+here inserts its own dirname)."""
+
+import json
+import os
+import sys
+import time
+
+
+def resolve_metrics_out(argv=None):
+    """Honor ``--metrics-out=PATH`` (from ``argv`` or the process
+    args) over the BENCH_METRICS_OUT env var; returns the active path
+    (or None). Exports the flag value into the env so child helpers
+    see the same trail."""
+    for a in (sys.argv[1:] if argv is None else argv):
+        if isinstance(a, str) and a.startswith("--metrics-out="):
+            os.environ["BENCH_METRICS_OUT"] = a.split("=", 1)[1]
+    return os.environ.get("BENCH_METRICS_OUT")
+
+
+def metrics_write(path, **rec):
+    """Append one timestamped record to the JSONL trail (no-op without
+    a path; IO problems warn on stderr instead of killing the bench)."""
+    if not path:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps({"ts": round(time.time(), 3), **rec})
+                    + "\n")
+    except (OSError, ValueError) as e:
+        print(f"metrics-out write failed: {e}", file=sys.stderr)

@@ -32,26 +32,20 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
+from bench_metrics import metrics_write as _metrics_write  # noqa: E402
+from bench_metrics import resolve_metrics_out  # noqa: E402
+
 # --metrics-out=PATH (or BENCH_METRICS_OUT): JSONL trail next to the
 # stdout JSON lines, bench.py conventions (inline append, never fatal)
-for _a in sys.argv[1:]:
-    if _a.startswith("--metrics-out="):
-        os.environ["BENCH_METRICS_OUT"] = _a.split("=", 1)[1]
-METRICS_OUT = os.environ.get("BENCH_METRICS_OUT")
+METRICS_OUT = resolve_metrics_out()
 
 
 def metrics_write(**rec):
-    if not METRICS_OUT:
-        return
-    try:
-        with open(METRICS_OUT, "a") as f:
-            f.write(json.dumps({"ts": round(time.time(), 3), **rec})
-                    + "\n")
-    except (OSError, ValueError) as e:
-        print(f"metrics-out write failed: {e}", file=sys.stderr)
+    _metrics_write(METRICS_OUT, **rec)
 
 
 def _pct(vals, q):

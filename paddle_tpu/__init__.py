@@ -37,7 +37,7 @@ _SUBMODULES = (
     "initializer", "networks", "optimizer", "parameters", "pooling",
     "topology", "trainer", "event", "reader", "dataset", "inference",
     "evaluator", "parallel", "models", "io", "runtime", "recurrent",
-    "projection", "image", "plot", "distributed", "observe",
+    "projection", "image", "plot", "distributed", "observe", "pipeline",
 )
 
 

@@ -24,6 +24,7 @@ chunks. Two scaling paths:
 import hashlib
 import json
 import os
+import pickle
 import queue
 import tempfile
 import threading
@@ -119,10 +120,13 @@ def _prune_old(save_dir, keep):
 
 
 def _write_single(save_dir, step, trees, keep, host_trees=None,
-                  sharded=False, process_index=0, process_count=1):
+                  sharded=False, process_index=0, process_count=1,
+                  blobs=None):
     """Shared atomic-write core for save_checkpoint and AsyncCheckpointer.
     ``trees``: {fname: pytree} (ignored per-entry when host_trees carries
-    the pre-flattened host copy)."""
+    the pre-flattened host copy). ``blobs``: {name: bytes} opaque
+    payloads (the pipeline's pickled stream position) written verbatim
+    as ``<name><suffix>.pkl`` with their checksum in the manifest."""
     name = f"ckpt-{step:08d}"
     final = os.path.join(save_dir, name)
     os.makedirs(save_dir, exist_ok=True)
@@ -137,6 +141,11 @@ def _write_single(save_dir, step, trees, keep, host_trees=None,
         _write_tree(tmp, base + suffix, tree, manifest, sharded,
                     host_trees={base + suffix: host_trees[base]}
                     if host_trees else None)
+    for bname, data in (blobs or {}).items():
+        bpath = os.path.join(tmp, bname + suffix + ".pkl")
+        with open(bpath, "wb") as f:
+            f.write(data)
+        manifest.setdefault("blobs", {})[bname + suffix] = _file_md5(bpath)
     with open(os.path.join(tmp, f"manifest{suffix}.json"), "w") as f:
         json.dump(manifest, f)
     if process_count > 1:
@@ -163,18 +172,27 @@ def _write_single(save_dir, step, trees, keep, host_trees=None,
 def save_checkpoint(save_dir: str, step: int, params: Dict,
                     opt_state=None, model_state=None, keep: int = 3,
                     process_index: int = 0, process_count: int = 1,
-                    sharded: bool = False):
+                    sharded: bool = False, pipeline_state=None):
     """Write checkpoint 'pass-%05d' style dir; prunes old ones.
 
     With ``sharded=True`` (or process_count>1) each array entry stores this
     process's addressable shards plus their index metadata — the multi-host
-    layout where every host writes only what it owns."""
+    layout where every host writes only what it owns.
+
+    ``pipeline_state``: the input pipeline's ``state_dict()`` (source
+    cursor, shuffle RNG + buffer, batch counter) — persisted next to the
+    model so a restore continues the data stream mid-epoch on the exact
+    next batch (``load_pipeline_state``)."""
+    blobs = None
+    if pipeline_state is not None:
+        blobs = {"pipeline": pickle.dumps(pipeline_state, protocol=4)}
     return _write_single(
         save_dir, step,
         {"params": params, "opt_state": opt_state,
          "model_state": model_state},
         keep, sharded=sharded or process_count > 1,
-        process_index=process_index, process_count=process_count)
+        process_index=process_index, process_count=process_count,
+        blobs=blobs)
 
 
 def latest_checkpoint(save_dir: str) -> Optional[str]:
@@ -253,6 +271,32 @@ def load_checkpoint(path: str, params: Dict, opt_state=None, model_state=None,
     return (manifests[0]["step"], *out)
 
 
+def load_pipeline_state(path: str, process_index: int = 0,
+                        verify: bool = True) -> Optional[dict]:
+    """Read the input-pipeline stream position saved with this
+    checkpoint (or None for checkpoints written without one — every
+    pre-pipeline checkpoint stays loadable)."""
+    try:
+        names = sorted(os.listdir(path))
+    except OSError:
+        return None
+    for fn in names:
+        if not (fn.startswith("manifest") and fn.endswith(".json")):
+            continue
+        with open(os.path.join(path, fn)) as f:
+            manifest = json.load(f)
+        if manifest.get("process_index", 0) != process_index:
+            continue
+        for bname, digest in manifest.get("blobs", {}).items():
+            if bname == "pipeline" or bname.startswith("pipeline.p"):
+                bpath = os.path.join(path, bname + ".pkl")
+                if verify:
+                    _verify_file(bpath, digest)
+                with open(bpath, "rb") as f:
+                    return pickle.load(f)
+    return None
+
+
 class AsyncCheckpointer:
     """Asynchronous checkpoint writer.
 
@@ -275,21 +319,24 @@ class AsyncCheckpointer:
             item = self._q.get()
             if item is None:
                 return
-            step, host_trees = item
+            step, host_trees, blobs = item
             try:
-                self._write(step, host_trees)
+                self._write(step, host_trees, blobs)
             except Exception as e:  # surfaced on next save()/wait()
                 self._err = e
             finally:
                 self._q.task_done()
 
-    def _write(self, step, host_trees):
+    def _write(self, step, host_trees, blobs=None):
         _write_single(self.save_dir, step,
                       {base: None for base in host_trees}, self.keep,
-                      host_trees=host_trees)
+                      host_trees=host_trees, blobs=blobs)
 
     def save(self, step: int, params: Dict, opt_state=None,
-             model_state=None):
+             model_state=None, pipeline_state=None):
+        """``pipeline_state`` is pickled HERE, on the caller's thread —
+        the pipeline keeps mutating as training continues, so the worker
+        must serialize a frozen snapshot, not a live reference."""
         if self._err is not None:
             err, self._err = self._err, None
             raise err
@@ -299,7 +346,10 @@ class AsyncCheckpointer:
             if tree is not None:
                 host_trees[fname] = {k: np.asarray(v)
                                      for k, v in _flatten(tree).items()}
-        self._q.put((int(step), host_trees))
+        blobs = None
+        if pipeline_state is not None:
+            blobs = {"pipeline": pickle.dumps(pipeline_state, protocol=4)}
+        self._q.put((int(step), host_trees, blobs))
 
     def wait(self):
         self._q.join()

@@ -6,6 +6,8 @@ import queue
 import random
 import threading
 
+from paddle_tpu.utils.threadq import put_stoppable as _put_stoppable
+
 
 def map_readers(func, *readers):
     def reader():
@@ -69,32 +71,54 @@ def compose(*readers, check_alignment=True):
     return reader
 
 
+def _close_workers(queues, threads, stop):
+    """Generator-close path: join the worker threads (waking any blocked
+    put by draining), warning instead of hanging when one is stuck
+    inside user code — close() must always return."""
+    from paddle_tpu.utils.threadq import drain_join
+    leaked = drain_join(queues, threads, stop)
+    if leaked:
+        from paddle_tpu.utils.logger import get_logger
+        get_logger("reader").warning(
+            "reader close: %d worker thread(s) still blocked in user "
+            "code after 10s (%s) — abandoning them as daemons",
+            len(leaked), ", ".join(t.name for t in leaked))
+
+
 def buffered(reader_fn, size):
     """Thread-prefetch up to `size` samples (reference: decorator.py:180).
     Source exceptions propagate to the consumer rather than silently
-    truncating the stream."""
+    truncating the stream; closing the generator mid-iteration (break,
+    GC) joins the fill thread instead of leaking it blocked on a full
+    queue."""
     end = object()
 
     def reader():
         q = queue.Queue(maxsize=size)
+        stop = threading.Event()
 
         def fill():
             try:
                 for e in reader_fn():
-                    q.put(e)
-                q.put(end)
-            except BaseException as exc:
-                q.put((end, exc))
+                    if not _put_stoppable(q, e, stop):
+                        return
+                _put_stoppable(q, end, stop)
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                _put_stoppable(q, (end, exc), stop)
 
-        t = threading.Thread(target=fill, daemon=True)
+        t = threading.Thread(target=fill, name="reader-buffered",
+                             daemon=True)
         t.start()
-        while True:
-            e = q.get()
-            if e is end:
-                break
-            if isinstance(e, tuple) and len(e) == 2 and e[0] is end:
-                raise e[1]
-            yield e
+        try:
+            while True:
+                e = q.get()
+                if e is end:
+                    break
+                if isinstance(e, tuple) and len(e) == 2 and e[0] is end:
+                    raise e[1]
+                yield e
+        finally:
+            _close_workers([q], [t], stop)
     return reader
 
 
@@ -119,65 +143,95 @@ def cache(reader_fn):
 
 def xmap_readers(mapper, reader_fn, process_num, buffer_size, order=False):
     """Parallel map over samples with worker threads (reference:
-    decorator.py:229 XmapEndSignal machinery)."""
+    decorator.py:229 XmapEndSignal machinery).
+
+    Failure semantics: a SOURCE exception (the feed thread) poisons the
+    workers and re-raises at the consumer — previously the feed thread
+    died silently, the workers blocked on an empty in-queue forever, and
+    the consumer hung. Worker (mapper) exceptions re-raise at the
+    consumer as before. Closing the generator early joins every thread
+    (no daemon-thread leak after partial iteration)."""
     end = object()
 
     def reader():
         in_q = queue.Queue(buffer_size)
         out_q = queue.Queue(buffer_size)
+        stop = threading.Event()
 
         def feed():
-            for i, s in enumerate(reader_fn()):
-                in_q.put((i, s))
-            for _ in range(process_num):
-                in_q.put(end)
+            try:
+                for i, s in enumerate(reader_fn()):
+                    if not _put_stoppable(in_q, (i, s), stop):
+                        return
+                for _ in range(process_num):
+                    if not _put_stoppable(in_q, end, stop):
+                        return
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                # the consumer must see the source failure (not hang),
+                # and the workers must still be released
+                _put_stoppable(out_q, (end, exc), stop)
+                for _ in range(process_num):
+                    if not _put_stoppable(in_q, end, stop):
+                        return
 
         def work():
             try:
                 while True:
-                    item = in_q.get()
+                    try:
+                        item = in_q.get(timeout=0.1)
+                    except queue.Empty:
+                        if stop.is_set():
+                            return
+                        continue
                     if item is end:
-                        out_q.put(end)
+                        _put_stoppable(out_q, end, stop)
                         break
                     i, s = item
-                    out_q.put((i, mapper(s)))
-            except BaseException as exc:
-                out_q.put((end, exc))
+                    if not _put_stoppable(out_q, (i, mapper(s)), stop):
+                        return
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                _put_stoppable(out_q, (end, exc), stop)
 
-        threads = [threading.Thread(target=feed, daemon=True)]
-        threads += [threading.Thread(target=work, daemon=True)
+        threads = [threading.Thread(target=feed, name="reader-xmap-feed",
+                                    daemon=True)]
+        threads += [threading.Thread(target=work,
+                                     name="reader-xmap-worker",
+                                     daemon=True)
                     for _ in range(process_num)]
         for t in threads:
             t.start()
 
         def classify(item):
-            """Returns 'end', 'error', or 'data'; raises worker errors."""
+            """Returns 'end' or 'data'; raises propagated errors."""
             if item is end:
                 return "end"
             if isinstance(item, tuple) and len(item) == 2 and item[0] is end:
                 raise item[1]
             return "data"
 
-        finished = 0
-        if not order:
-            while finished < process_num:
-                item = out_q.get()
-                if classify(item) == "end":
-                    finished += 1
-                else:
-                    yield item[1]
-        else:
-            pending, want = {}, 0
-            while finished < process_num or pending:
-                if want in pending:
-                    yield pending.pop(want)
-                    want += 1
-                    continue
-                if finished >= process_num:
-                    break  # workers done but a gap remains (dropped index)
-                item = out_q.get()
-                if classify(item) == "end":
-                    finished += 1
-                else:
-                    pending[item[0]] = item[1]
+        try:
+            finished = 0
+            if not order:
+                while finished < process_num:
+                    item = out_q.get()
+                    if classify(item) == "end":
+                        finished += 1
+                    else:
+                        yield item[1]
+            else:
+                pending, want = {}, 0
+                while finished < process_num or pending:
+                    if want in pending:
+                        yield pending.pop(want)
+                        want += 1
+                        continue
+                    if finished >= process_num:
+                        break  # workers done, a gap remains (dropped index)
+                    item = out_q.get()
+                    if classify(item) == "end":
+                        finished += 1
+                    else:
+                        pending[item[0]] = item[1]
+        finally:
+            _close_workers([in_q, out_q], threads, stop)
     return reader

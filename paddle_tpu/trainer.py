@@ -347,14 +347,45 @@ class SGD:
     def train(self, reader, num_passes=1,
               event_handler: Optional[Callable] = None,
               feeding: Optional[Dict[str, int]] = None,
-              checkpoint_dir: Optional[str] = None):
+              checkpoint_dir: Optional[str] = None,
+              prefetch: int = 0):
         """checkpoint_dir: when set, checkpoints (params + optimizer state +
         model state) are written asynchronously every ``checkpoint_period``
         batches (flag; 0 = once per pass) and training resumes from the
         latest checkpoint found there (reference: ParamUtil per-pass dirs +
-        --init_model_path/--start_pass, trainer/ParamUtil.cpp)."""
+        --init_model_path/--start_pass, trainer/ParamUtil.cpp).
+
+        prefetch: >0 feeds through the async input pipeline
+        (``paddle_tpu.pipeline``) with a staging ring of that many
+        batches — conversion and host→device transfer run on pipeline
+        threads so step N+1's feeds are on device while step N executes.
+        ``reader`` may also BE a ``pipeline.Pipeline`` (prefetch implied),
+        which additionally makes resume exact: the pipeline's stream
+        position rides inside every checkpoint and a restore continues
+        mid-epoch on the exact next batch. 0 keeps the synchronous
+        one-batch-lookahead path."""
         event_handler = event_handler or (lambda e: None)
         feeder = self._feeder(feeding)
+        from paddle_tpu.pipeline import Pipeline
+        pipe, own_pipe = None, False
+        if isinstance(reader, Pipeline):
+            pipe = reader
+        elif prefetch and int(prefetch) > 0:
+            # without a checkpoint dir the wrapped pipeline's state can
+            # never be consumed — skip the per-batch snapshot entirely
+            pipe = Pipeline(reader, prefetch=int(prefetch),
+                            track_state=checkpoint_dir is not None)
+            own_pipe = True
+        if pipe is not None:
+            transfer = None
+            if self.parallel is not None:
+                par = self.parallel
+
+                def transfer(feeds):
+                    return jax.device_put(feeds,
+                                          par.feed_shardings(feeds))
+
+            pipe.attach(convert=feeder.feed, transfer=transfer)
         ks = global_key_source()
         log_period = GLOBAL_FLAGS.get("log_period", 100)
         # flag-driven JSONL metrics sink (PADDLE_TPU_METRICS_PATH or
@@ -379,6 +410,13 @@ class SGD:
                  self.parameters.state) = ckpt_io.load_checkpoint(
                     latest, self.parameters.values, self.opt_state,
                     self.parameters.state)
+                if pipe is not None and pipe.track_state:
+                    ps = ckpt_io.load_pipeline_state(latest)
+                    if ps is not None:
+                        # continue the data stream mid-epoch on the
+                        # exact next batch (shuffle RNG, shard cursor,
+                        # in-flight samples all restored)
+                        pipe.load_state_dict(ps)
                 if self.parallel is not None:
                     # loaded host arrays must go back to the mesh layout
                     # __init__ applied to the fresh init values
@@ -400,7 +438,8 @@ class SGD:
         try:
             self._train_passes(reader, num_passes, event_handler, feeder,
                                ks, log_period, ckpt,
-                               GLOBAL_FLAGS.get("checkpoint_period", 0))
+                               GLOBAL_FLAGS.get("checkpoint_period", 0),
+                               pipe=pipe)
         except Exception as e:
             # post-mortem for any crash escaping the loop — but only
             # when a flight dir is explicitly configured (a default-on
@@ -414,6 +453,9 @@ class SGD:
         finally:
             if ckpt is not None:
                 ckpt.close()
+            if own_pipe:
+                pipe.close()   # user-passed pipelines stay open: their
+                               # state_dict/resume lifecycle is theirs
 
     def _prefetch_feeds(self, reader, feeder):
         """One-batch-lookahead feed pipeline: batch N+1 is fed and its
@@ -432,10 +474,13 @@ class SGD:
                 # feed() already dispatches the H2D copies (jnp.asarray
                 # is asynchronous); the sharded put is likewise async
                 with observe.trace_scope("feed"):
-                    feeds = feeder.feed(data_batch)
+                    with observe.trace_scope("convert"):
+                        feeds = feeder.feed(data_batch)
                     if self.parallel is not None:
-                        feeds = jax.device_put(
-                            feeds, self.parallel.feed_shardings(feeds))
+                        with observe.trace_scope("transfer"):
+                            feeds = jax.device_put(
+                                feeds,
+                                self.parallel.feed_shardings(feeds))
             except StopIteration:
                 break
             except Exception:
@@ -453,15 +498,18 @@ class SGD:
             yield prev
 
     def _train_passes(self, reader, num_passes, event_handler, feeder, ks,
-                      log_period, ckpt, period):
+                      log_period, ckpt, period, pipe=None):
         monitor = _StepMonitor()
         for pass_id in range(num_passes):
             event_handler(events.BeginPass(pass_id))
             self.evaluators.reset()
             pass_t0 = time.perf_counter()
             pass_examples = 0
-            for batch_id, feeds in enumerate(
-                    self._prefetch_feeds(reader, feeder)):
+            # pipelined mode: one iter() == one epoch, resuming mid-epoch
+            # after a restore; feeds arrive converted + device-resident
+            feed_iter = (iter(pipe) if pipe is not None
+                         else self._prefetch_feeds(reader, feeder))
+            for batch_id, feeds in enumerate(feed_iter):
                 event_handler(events.BeginIteration(pass_id, batch_id))
                 step_fn = self._pick_train_step(feeds)
                 # feed-shape signature: params/opt/state shapes are fixed
@@ -525,10 +573,16 @@ class SGD:
                     wall_time_s=step_dt, examples_per_sec=eps))
                 if ckpt is not None and period and self._step % period == 0:
                     ckpt.save(self._step, self.parameters.values,
-                              self.opt_state, self.parameters.state)
+                              self.opt_state, self.parameters.state,
+                              pipeline_state=(
+                                  pipe.state_dict() if pipe is not None
+                                  and pipe.track_state else None))
             if ckpt is not None and not period:
                 ckpt.save(self._step, self.parameters.values,
-                          self.opt_state, self.parameters.state)
+                          self.opt_state, self.parameters.state,
+                          pipeline_state=(
+                              pipe.state_dict() if pipe is not None
+                              and pipe.track_state else None))
             monitor.update_memory_gauges()
             pass_dt = time.perf_counter() - pass_t0
             if observe.has_consumers():

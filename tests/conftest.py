@@ -43,3 +43,34 @@ def pytest_configure(config):
 @pytest.fixture
 def rng():
     return np.random.RandomState(1234)
+
+
+@pytest.fixture(autouse=True)
+def _pipeline_thread_leak_guard():
+    """Fail any test that leaves input-pipeline or reader worker threads
+    alive: every pipeline/reader thread is named with a ``pipeline-`` /
+    ``reader-`` prefix and must be joined by ``close()`` or generator
+    close. The gc.collect() first closes abandoned reader generators
+    deterministically (their close handlers join the workers); a short
+    grace loop absorbs threads that are mid-exit."""
+    yield
+    import gc
+    import threading
+    import time
+
+    def leaked():
+        return [t.name for t in threading.enumerate()
+                if t.is_alive()
+                and t.name.startswith(("pipeline-", "reader-"))]
+
+    if not leaked():
+        return
+    gc.collect()
+    deadline = time.time() + 3.0
+    names = leaked()
+    while names and time.time() < deadline:
+        time.sleep(0.05)
+        names = leaked()
+    assert not names, (
+        f"test leaked live pipeline/reader threads: {names} — close() "
+        f"the pipeline or exhaust/close the reader generator")

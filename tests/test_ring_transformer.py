@@ -658,13 +658,30 @@ class TestRoPE:
                                      10000.0)
 
     def test_generate_and_beam_run(self, rng):
+        """Greedy decode equals beam_size=1 EXACTLY under RoPE — both
+        paths break logit ties stably toward the lower token id (argmax
+        and top_k share that contract), so this holds even on a
+        random-init toy model with near-tied logits. Against a wider
+        beam only the SCORE ordering is an invariant: beam-2 may
+        legitimately out-score the greedy path (that was the old
+        flaky assert — greedy == beam-2's best is not a theorem)."""
         cfg = self.CFG
         params = transformer.init_params(jax.random.PRNGKey(2), cfg)
         prompt = jnp.asarray(rng.randint(0, 30, (1, 4)), jnp.int32)
         g = transformer.generate(params, prompt, cfg, max_new=5)
-        b, _ = transformer.beam_search(params, prompt, cfg, max_new=5,
-                                       beam_size=2)
-        np.testing.assert_array_equal(np.asarray(g), np.asarray(b[:, 0]))
+        b1, _ = transformer.beam_search(params, prompt, cfg, max_new=5,
+                                        beam_size=1)
+        np.testing.assert_array_equal(np.asarray(g),
+                                      np.asarray(b1[:, 0]))
+        b2, s2 = transformer.beam_search(params, prompt, cfg, max_new=5,
+                                         beam_size=2)
+        assert b2.shape == (1, 2, 9) and s2.shape == (1, 2)
+        # beam-2's best hypothesis scores at least the greedy path
+        logits = transformer.forward(params, g[:, :-1], cfg)
+        lp = jax.nn.log_softmax(logits, axis=-1)[0]
+        pos = jnp.arange(3, 8)
+        greedy_score = float(jnp.sum(lp[pos, g[0, 4:]]))
+        assert float(s2[0, 0]) >= greedy_score - 1e-4
 
     def test_ring_flash_matches_full_under_rope(self, rng):
         """RoPE applies before the attention engine, so ring+flash CP
