@@ -25,4 +25,19 @@ if grep -aq 'slowest 20 durations' "$log"; then
     echo '== SLOWEST TESTS (trim candidates for the 870 s cutoff) =='
     sed -n '/slowest 20 durations/,/^[=[:space:]]*$/p' "$log" | head -25
 fi
+# surface the latest ZeRO-1 A/B so opt-state-bytes regressions are
+# visible next to the test gate (benchmarks/zero_bench.py writes it)
+latest_zero=$(ls -t benchmarks/runs/zero_bench*.json 2>/dev/null | head -1)
+if [ -n "$latest_zero" ]; then
+    echo "== ZERO-1 OPT-STATE BYTES (latest bench: $latest_zero) =="
+    python - "$latest_zero" <<'PY'
+import json, sys
+d = json.load(open(sys.argv[1]))
+print(f"opt_state_bytes_per_device zero0={d['zero0']['opt_state_bytes_per_device']} "
+      f"zero1={d['zero1']['opt_state_bytes_per_device']} "
+      f"ratio={d['opt_state_bytes_ratio']} (data={d['data_axis']}) "
+      f"traj_allclose={d['traj_allclose']} "
+      f"collective_pattern_ok={d['collective_pattern_ok']}")
+PY
+fi
 exit $rc

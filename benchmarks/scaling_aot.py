@@ -41,12 +41,18 @@ sys.path.insert(0, REPO)
 import numpy as np
 
 
-def build_step(batch_per_chip, n_chips, mesh, batch_axes=("data",)):
+def build_step(batch_per_chip, n_chips, mesh, batch_axes=("data",),
+               zero1=False):
+    """``zero1=True`` applies the ZeRO-1 weight-update sharding
+    (parallel/spmd.py): optimizer state + update shard over the ``data``
+    axis, so the TPU pipeline forms reduce-scatter + post-update
+    all-gather instead of the full-gradient all-reduce."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu import layer
     from paddle_tpu.models import resnet
+    from paddle_tpu.parallel import spmd as pspmd
     from paddle_tpu.topology import Topology, Value
     from paddle_tpu.utils.rng import KeySource
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -68,6 +74,7 @@ def build_step(batch_per_chip, n_chips, mesh, batch_axes=("data",)):
 
     values_sds, state_sds, opt_sds = jax.eval_shape(_make)
     fwd = topo.compile()
+    dist = pspmd.DistConfig(mesh, zero_stage=1) if zero1 else None
 
     def train_step(p, o, s, images, labels, step):
         def loss_fn(p):
@@ -76,7 +83,11 @@ def build_step(batch_per_chip, n_chips, mesh, batch_axes=("data",)):
             return jnp.mean(outs["cost"].array.astype(jnp.float32)), ns
 
         (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
-        np_, no_ = opt.update(step, grads, p, o)
+        if dist is not None:
+            np_, no_ = pspmd.zero_constrained_update(dist, opt, step,
+                                                     grads, p, o)
+        else:
+            np_, no_ = opt.update(step, grads, p, o)
         return loss, np_, no_, ns
 
     rep = NamedSharding(mesh, P())
@@ -86,8 +97,10 @@ def build_step(batch_per_chip, n_chips, mesh, batch_axes=("data",)):
                 jax.ShapeDtypeStruct((gb, 224, 224, 3), jnp.float32),
                 jax.ShapeDtypeStruct((gb,), jnp.int32),
                 jax.ShapeDtypeStruct((), jnp.int32))
+    opt_sharding = (dist.state_shardings(opt_sds) if dist is not None
+                    else jax.tree.map(lambda _: rep, abstract[1]))
     shardings = (jax.tree.map(lambda _: rep, abstract[0]),
-                 jax.tree.map(lambda _: rep, abstract[1]),
+                 opt_sharding,
                  jax.tree.map(lambda _: rep, abstract[2]), dat, dat, rep)
     jf = jax.jit(train_step, in_shardings=shardings,
                  out_shardings=(rep, shardings[0], shardings[1],
@@ -128,6 +141,8 @@ def analyze_schedule(txt: str):
     Shape parsing is layout-robust: TPU shapes carry tile annotations
     with parens (``{3,2,1,0:T(8,128)(2,1)}``), so the op line is split
     at the opcode token instead of regex-matching the signature."""
+    from paddle_tpu.parallel.spmd import FUSED_REDUCE_SCATTER_RE
+
     entry = txt[txt.index("ENTRY"):]
     lines = entry.splitlines()
     events = []       # (idx, kind, name, bytes)
@@ -148,6 +163,17 @@ def analyze_schedule(txt: str):
             if sig_m:
                 megascale_send_bytes += _shape_bytes(sig_m.group(1))
                 megascale_sends += 1
+        # XLA:TPU lowers reduce-scatter to a kCustom fusion calling an
+        # %all-reduce-scatter computation (the --zero1 grad sync): count
+        # the call site as the collective it is (matcher shared with
+        # paddle_tpu.parallel.spmd.zero_collective_evidence)
+        if FUSED_REDUCE_SCATTER_RE.search(ln):
+            sig_m = re.match(r"\s*(?:ROOT )?%[\w.\-]+ = (.*?)\bfusion\(",
+                             ln)
+            if sig_m:
+                events.append((i, "reduce-scatter", f"fused_rs.{i}",
+                               _shape_bytes(sig_m.group(1))))
+            continue
         m = op_re.match(ln)
         if not m:
             continue
@@ -273,6 +299,12 @@ def main():
     ap.add_argument("--dump-hlo", default=None,
                     help="save the compiled HLO text here for --hlo-file "
                     "reuse")
+    ap.add_argument("--zero1", action="store_true",
+                    help="ZeRO-1 weight-update sharding: opt state + "
+                    "update shard over the data axis; the schedule then "
+                    "shows reduce-scatter + post-update all-gather "
+                    "instead of the full-grad all-reduce "
+                    "(docs/howto_distributed.md)")
     args = ap.parse_args()
 
     if args.hlo_file:
@@ -310,7 +342,8 @@ def main():
               f"{args.batch_per_chip}")
 
         jf, abstract = build_step(args.batch_per_chip, n, mesh,
-                                  batch_axes=batch_axes)
+                                  batch_axes=batch_axes,
+                                  zero1=args.zero1)
         lowered = jf.lower(*abstract)
         compiled = lowered.compile()
         txt = compiled.as_text()
@@ -402,6 +435,7 @@ def main():
 
     result = {
         "topology": args.topology, "num_slices": args.num_slices,
+        "zero1": bool(args.zero1),
         "n_chips": n,
         "batch_per_chip": args.batch_per_chip,
         "global_batch": args.batch_per_chip * n,
@@ -434,7 +468,8 @@ def main():
               file=sys.stderr)
     print(json.dumps(result, indent=2))
     slug = args.topology.replace(":", "_") + (
-        f"_x{args.num_slices}" if args.num_slices > 1 else "")
+        f"_x{args.num_slices}" if args.num_slices > 1 else "") + (
+        "_zero1" if args.zero1 else "")
     out = args.out or os.path.join(
         REPO, "benchmarks", "runs", f"scaling_aot_{slug}.json")
     sync_tail = sorted(sched["sync_all_reduces"],

@@ -1,0 +1,299 @@
+"""ZeRO-1 on/off A/B: per-device optimizer-state bytes + step wall time.
+
+The weight-update-sharding acceptance measurement (ISSUE 5): on a CPU
+``data=N`` mesh with Adam, ``DistConfig(zero_stage=1)`` must
+
+  1. cut per-device optimizer-state bytes to ~1/N of the replicated
+     figure (modulo indivisible leaves — the report says which),
+  2. leave the loss trajectory allclose-identical to zero=0,
+  3. compile to the reduce-scatter collective pattern with NO
+     full-gradient all-reduce (``spmd.zero_collective_evidence``;
+     XLA:CPU emits the manual all-reduce+shard-slice form — pass
+     ``--tpu-check`` to run the same step through the REAL deviceless
+     XLA:TPU pipeline, which forms the fused all-reduce-scatter).
+
+Emits the standard ``--metrics-out=`` JSONL trail (bench_metrics.py
+conventions) plus a JSON artifact under benchmarks/runs/.
+
+Usage:
+  python benchmarks/zero_bench.py [--data 4] [--batch-per-shard 32]
+      [--steps 12] [--hidden 512] [--metrics-out=zero.jsonl]
+      [--tpu-check] [--smoke]
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_metrics import metrics_write, resolve_metrics_out  # noqa: E402
+
+
+def _force_cpu_devices(n):
+    """CPU platform with n virtual devices, BEFORE backend init (the
+    dryrun_multichip technique); no-op when a backend already exists
+    with enough devices (in-process test use)."""
+    from paddle_tpu.utils.flags import set_xla_host_device_count
+    set_xla_host_device_count(n)
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n)
+    except (RuntimeError, AttributeError):
+        pass
+    assert len(jax.devices()) >= n, (
+        f"need {n} devices, have {len(jax.devices())} — run in a fresh "
+        f"process or under tests/conftest.py")
+
+
+def _build_trainer(data_n, zero, dim, hidden, classes=8, lr=0.02):
+    import paddle_tpu as paddle
+    from paddle_tpu import layer, parallel
+    from paddle_tpu.core import place
+    from paddle_tpu.utils.rng import KeySource
+
+    x = layer.data("zb_x", paddle.data_type.dense_vector(dim))
+    lbl = layer.data("zb_l", paddle.data_type.integer_value(classes))
+    h1 = layer.fc(x, hidden, act=paddle.activation.Relu(), name="zb_h1")
+    h2 = layer.fc(h1, hidden, act=paddle.activation.Relu(), name="zb_h2")
+    out = layer.fc(h2, classes, act=paddle.activation.Softmax(),
+                   name="zb_o")
+    cost = layer.classification_cost(out, lbl, name="zb_cost")
+    params = paddle.parameters.create(cost, KeySource(7))
+    mesh = place.make_mesh((data_n,), (place.AXIS_DATA,))
+    return paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=lr),
+        parallel=parallel.data_parallel(mesh, zero=zero))
+
+
+def _dataset(dim, classes, batch, steps):
+    import numpy as np
+    rng = np.random.RandomState(0)
+    protos = rng.randn(classes, dim).astype(np.float32)
+    out = []
+    for _ in range(batch * steps):
+        y = int(rng.randint(classes))
+        out.append((protos[y] + rng.randn(dim).astype(np.float32) * 0.5,
+                    y))
+    return out
+
+
+def _hlo_evidence(tr, data):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.parallel import spmd
+
+    feeds = tr._feeder(None).feed(data)
+    feeds = jax.device_put(feeds, tr.parallel.feed_shardings(feeds))
+    args = (tr.parameters.values, tr.opt_state, tr.parameters.state,
+            feeds, jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0))
+    txt = tr._plain_train_step.lower(*args).compile().as_text()
+    biggest = max(np.asarray(v).nbytes
+                  for v in tr.parameters.values.values())
+    return spmd.zero_collective_evidence(txt, biggest)
+
+
+def _run_variant(args, zero, data):
+    import paddle_tpu as paddle
+
+    tr = _build_trainer(args.data, zero, args.dim, args.hidden)
+    batch = args.data * args.batch_per_shard
+    walls, losses = [], []
+
+    def on_event(e):
+        if isinstance(e, paddle.event.EndIteration):
+            walls.append(e.wall_time_s)
+            losses.append(e.cost)
+
+    tr.train(reader=paddle.batch(lambda: iter(data), batch),
+             num_passes=1, event_handler=on_event)
+    timed = walls[args.warmup:] or walls
+    return tr, {
+        "zero": zero,
+        "opt_state_bytes_per_device": tr.opt_state_bytes_per_device(),
+        "step_ms_median": round(statistics.median(timed) * 1e3, 3),
+        "steps_timed": len(timed),
+        "losses": [round(l, 6) for l in losses],
+    }
+
+
+def _tpu_check(args):
+    """The same sharded update through the REAL XLA:TPU pipeline,
+    deviceless (jax.experimental.topologies AOT — no chips needed): the
+    TPU pass stack forms the fused all-reduce-scatter collective the
+    CPU pipeline cannot."""
+    # libtpu stalls for minutes retrying the GCP metadata server when
+    # run outside a TPU VM; skipping the query is what makes the
+    # deviceless compile start instantly
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+    try:
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import topologies
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from paddle_tpu.parallel import spmd
+
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name=args.tpu_topology)
+    except Exception as e:           # no libtpu / unknown topology
+        return {"skipped": f"{type(e).__name__}: {e}"}
+
+    n = len(topo.devices)
+    mesh = Mesh(np.array(topo.devices).reshape(n), ("data",))
+    dist = spmd.DistConfig(mesh, zero_stage=1)
+    import paddle_tpu as paddle
+
+    opt = paddle.optimizer.Adam(learning_rate=0.02)
+    D, H = args.dim, args.hidden
+    params = {"w1": jax.ShapeDtypeStruct((D, H), jnp.float32),
+              "b1": jax.ShapeDtypeStruct((H,), jnp.float32),
+              "w2": jax.ShapeDtypeStruct((H, H), jnp.float32)}
+    opt_state = {k: (v, v) for k, v in params.items()}   # Adam (m, v)
+    upd = dist.zero_update_shardings(params)
+    keep = dist.param_shardings(params)
+    st = dist.state_shardings(opt_state)
+
+    def step(p, o, x, y, t):
+        def loss(p):
+            h = jnp.maximum(x @ p["w1"] + p["b1"], 0.0)
+            return jnp.mean((h @ p["w2"] - y) ** 2)
+
+        l, g = jax.value_and_grad(loss)(p)
+        np_, no_ = spmd.zero_constrained_update(
+            dist, opt, t, g, p, o, update_shardings=upd,
+            keep_shardings=keep, state_shardings=st)
+        return l, np_, no_
+
+    rep = NamedSharding(mesh, P())
+    dat = NamedSharding(mesh, P("data"))
+    B = 8 * n
+    abstract = (params, opt_state,
+                jax.ShapeDtypeStruct((B, D), jnp.float32),
+                jax.ShapeDtypeStruct((B, H), jnp.float32),
+                jax.ShapeDtypeStruct((), jnp.int32))
+    jf = jax.jit(step, in_shardings=(keep, st, dat, dat, rep),
+                 out_shardings=(rep, keep, st))
+    t0 = time.time()
+    txt = jf.lower(*abstract).compile().as_text()
+    biggest = D * H * 4
+    ev = spmd.zero_collective_evidence(txt, biggest)
+    ev["topology"] = args.tpu_topology
+    ev["compile_seconds"] = round(time.time() - t0, 1)
+    ev["ok"] = (ev["reduce_scatter"] >= 1
+                and ev["full_grad_all_reduce"] == 0)
+    ev.pop("full_grad_all_reduce_lines", None)
+    return ev
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", type=int, default=4,
+                    help="data-axis size (CPU virtual devices)")
+    ap.add_argument("--batch-per-shard", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--dim", type=int, default=256)
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--metrics-out", default=None)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 sizing: tiny model, few steps")
+    ap.add_argument("--tpu-check", action="store_true",
+                    help="also AOT-compile the sharded update with the "
+                    "deviceless XLA:TPU pipeline and assert the fused "
+                    "reduce-scatter appears")
+    ap.add_argument("--tpu-topology", default="v5e:2x2")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.dim, args.hidden = 32, 64
+        args.steps, args.warmup = 6, 2
+    mpath = resolve_metrics_out([f"--metrics-out={args.metrics_out}"]
+                                if args.metrics_out else None)
+
+    _force_cpu_devices(args.data)
+    import numpy as np
+
+    data = _dataset(args.dim, 8, args.data * args.batch_per_shard,
+                    args.steps)
+    t0, r0 = _run_variant(args, 0, data)
+    t1, r1 = _run_variant(args, 1, data)
+
+    ev0 = _hlo_evidence(t0, data[:args.data * args.batch_per_shard])
+    ev1 = _hlo_evidence(t1, data[:args.data * args.batch_per_shard])
+    for ev in (ev0, ev1):
+        ev.pop("full_grad_all_reduce_lines", None)
+
+    bytes_ratio = (r1["opt_state_bytes_per_device"]
+                   / max(1, r0["opt_state_bytes_per_device"]))
+    max_loss_diff = float(np.max(np.abs(
+        np.asarray(r0["losses"]) - np.asarray(r1["losses"]))))
+    report = t1.parallel.zero_report(t1.parameters.values)
+    result = {
+        "bench": "zero_bench", "data_axis": args.data,
+        "batch_per_shard": args.batch_per_shard,
+        "model": {"dim": args.dim, "hidden": args.hidden,
+                  "optimizer": "adam"},
+        "zero0": r0, "zero1": r1,
+        "opt_state_bytes_ratio": round(bytes_ratio, 4),
+        "bytes_quartered_ok": bytes_ratio <= 1.0 / args.data + 0.05,
+        "max_loss_diff": max_loss_diff,
+        # layout-change fp drift accumulates on the overfit tail of this
+        # bigger model ({1,0} vs {0,1} matmul operand layouts reduce in
+        # a different order); the STRICT allclose contract (2e-4) is
+        # proven for 20 steps × {SGD, Momentum, Adam} × {plain, accum}
+        # in tests/test_zero.py on the reference model
+        "traj_allclose": bool(np.allclose(r0["losses"], r1["losses"],
+                                          rtol=2e-2, atol=2e-3)),
+        "hlo_zero0": ev0, "hlo_zero1": ev1,
+        # CPU contract: the full-gradient all-reduce is GONE and the
+        # updated params all-gather back. Whether the grad sync shows up
+        # as the manual reduce-scatter form or as XLA's gather-the-
+        # activations partial-einsum strategy is the partitioner's
+        # choice per shape; the literal reduce-scatter collective is
+        # asserted on the real TPU pipeline (--tpu-check).
+        "collective_pattern_ok": (ev1["full_grad_all_reduce"] == 0
+                                  and ev1["param_all_gather"] >= 1
+                                  and ev0["full_grad_all_reduce"] >= 1),
+        "replicated_leaves": report["replicated"],
+    }
+    if args.tpu_check:
+        result["tpu_check"] = _tpu_check(args)
+
+    for variant, r in (("zero0", r0), ("zero1", r1)):
+        metrics_write(mpath, bench="zero_bench", variant=variant,
+                      metric="opt_state_bytes_per_device",
+                      value=r["opt_state_bytes_per_device"],
+                      data_axis=args.data)
+        metrics_write(mpath, bench="zero_bench", variant=variant,
+                      metric="step_ms_median", value=r["step_ms_median"],
+                      data_axis=args.data)
+    metrics_write(mpath, bench="zero_bench",
+                  metric="opt_state_bytes_ratio", value=bytes_ratio,
+                  data_axis=args.data,
+                  traj_allclose=result["traj_allclose"],
+                  collective_pattern_ok=result["collective_pattern_ok"])
+
+    print(json.dumps(result, indent=2))
+    out = args.out or os.path.join(REPO, "benchmarks", "runs",
+                                   f"zero_bench_data{args.data}.json")
+    if not args.smoke:
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"wrote {out}", file=sys.stderr)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -121,12 +121,15 @@ def _prune_old(save_dir, keep):
 
 def _write_single(save_dir, step, trees, keep, host_trees=None,
                   sharded=False, process_index=0, process_count=1,
-                  blobs=None):
+                  blobs=None, meta=None):
     """Shared atomic-write core for save_checkpoint and AsyncCheckpointer.
     ``trees``: {fname: pytree} (ignored per-entry when host_trees carries
     the pre-flattened host copy). ``blobs``: {name: bytes} opaque
     payloads (the pipeline's pickled stream position) written verbatim
-    as ``<name><suffix>.pkl`` with their checksum in the manifest."""
+    as ``<name><suffix>.pkl`` with their checksum in the manifest.
+    ``meta``: JSON-able layout metadata (e.g. the ZeRO sharding layout
+    the state was trained under) stored in the manifest — restores onto
+    a different mesh read it to know a reshard is happening."""
     name = f"ckpt-{step:08d}"
     final = os.path.join(save_dir, name)
     os.makedirs(save_dir, exist_ok=True)
@@ -135,6 +138,8 @@ def _write_single(save_dir, step, trees, keep, host_trees=None,
     manifest = {"step": int(step), "files": {},
                 "process_index": process_index,
                 "process_count": process_count}
+    if meta is not None:
+        manifest["meta"] = meta
     for base, tree in trees.items():
         if tree is None and not (host_trees and base in host_trees):
             continue
@@ -172,7 +177,8 @@ def _write_single(save_dir, step, trees, keep, host_trees=None,
 def save_checkpoint(save_dir: str, step: int, params: Dict,
                     opt_state=None, model_state=None, keep: int = 3,
                     process_index: int = 0, process_count: int = 1,
-                    sharded: bool = False, pipeline_state=None):
+                    sharded: bool = False, pipeline_state=None,
+                    meta=None):
     """Write checkpoint 'pass-%05d' style dir; prunes old ones.
 
     With ``sharded=True`` (or process_count>1) each array entry stores this
@@ -192,7 +198,24 @@ def save_checkpoint(save_dir: str, step: int, params: Dict,
          "model_state": model_state},
         keep, sharded=sharded or process_count > 1,
         process_index=process_index, process_count=process_count,
-        blobs=blobs)
+        blobs=blobs, meta=meta)
+
+
+def checkpoint_meta(path: str) -> Optional[dict]:
+    """The layout metadata stored with a checkpoint (``meta=`` at save
+    time; e.g. the ZeRO optimizer-state layout) — None for checkpoints
+    written without any, so every older checkpoint stays loadable."""
+    try:
+        names = sorted(os.listdir(path))
+    except OSError:
+        return None
+    for fn in names:
+        if fn.startswith("manifest") and fn.endswith(".json"):
+            with open(os.path.join(path, fn)) as f:
+                manifest = json.load(f)
+            if manifest.get("meta") is not None:
+                return manifest["meta"]
+    return None
 
 
 def latest_checkpoint(save_dir: str) -> Optional[str]:
@@ -319,24 +342,26 @@ class AsyncCheckpointer:
             item = self._q.get()
             if item is None:
                 return
-            step, host_trees, blobs = item
+            step, host_trees, blobs, meta = item
             try:
-                self._write(step, host_trees, blobs)
+                self._write(step, host_trees, blobs, meta)
             except Exception as e:  # surfaced on next save()/wait()
                 self._err = e
             finally:
                 self._q.task_done()
 
-    def _write(self, step, host_trees, blobs=None):
+    def _write(self, step, host_trees, blobs=None, meta=None):
         _write_single(self.save_dir, step,
                       {base: None for base in host_trees}, self.keep,
-                      host_trees=host_trees, blobs=blobs)
+                      host_trees=host_trees, blobs=blobs, meta=meta)
 
     def save(self, step: int, params: Dict, opt_state=None,
-             model_state=None, pipeline_state=None):
+             model_state=None, pipeline_state=None, meta=None):
         """``pipeline_state`` is pickled HERE, on the caller's thread —
         the pipeline keeps mutating as training continues, so the worker
-        must serialize a frozen snapshot, not a live reference."""
+        must serialize a frozen snapshot, not a live reference.
+        ``meta``: JSON-able layout metadata for the manifest (see
+        ``save_checkpoint``)."""
         if self._err is not None:
             err, self._err = self._err, None
             raise err
@@ -349,7 +374,7 @@ class AsyncCheckpointer:
         blobs = None
         if pipeline_state is not None:
             blobs = {"pipeline": pickle.dumps(pipeline_state, protocol=4)}
-        self._q.put((int(step), host_trees, blobs))
+        self._q.put((int(step), host_trees, blobs, meta))
 
     def wait(self):
         self._q.join()

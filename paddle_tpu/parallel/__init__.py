@@ -12,4 +12,5 @@ from paddle_tpu.core.place import (AXIS_DATA, AXIS_EXPERT, AXIS_MODEL,
                                    make_mesh)
 from paddle_tpu.parallel.spmd import (DistConfig, data_model_parallel,
                                       data_parallel, embedding_vocab_rule,
-                                      fc_column_rule, fc_row_rule)
+                                      fc_column_rule, fc_row_rule,
+                                      zero_constrained_update)

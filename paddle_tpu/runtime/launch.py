@@ -56,7 +56,7 @@ def launch_local(nprocs: int, argv: Sequence[str],
 
 
 def _wait_all(procs: Sequence[subprocess.Popen],
-              timeout: float) -> List[int]:
+              timeout: float, grace: float = 5.0) -> List[int]:
     deadline = time.time() + timeout
     rcs = []
     for p in procs:
@@ -64,9 +64,62 @@ def _wait_all(procs: Sequence[subprocess.Popen],
         try:
             rcs.append(p.wait(timeout=remain))
         except subprocess.TimeoutExpired:
+            # ssh-mode teardown (ADVICE round-5): killing only the local
+            # ssh client leaves the REMOTE worker tree running — and
+            # holding the coordinator port. launch_ssh wraps every remote
+            # command in a stdin watchdog (_wrap_remote), so closing our
+            # end of the stdin pipe delivers EOF to the watchdog, which
+            # TERM-then-KILLs the worker's whole process group; only then
+            # is the local client killed if it still lingers.
+            if p.stdin is not None:
+                try:
+                    p.stdin.close()
+                except OSError:
+                    pass
+                try:
+                    rcs.append(p.wait(timeout=grace))
+                    continue
+                except subprocess.TimeoutExpired:
+                    pass
             p.kill()
             rcs.append(-9)
+    for p in procs:                 # close leftover stdin pipes (ssh mode)
+        if p.stdin is not None and not p.stdin.closed:
+            try:
+                p.stdin.close()
+            except OSError:
+                pass
     return rcs
+
+
+def _wrap_remote(cmd: str, grace: float = 3.0) -> str:
+    """Wrap a remote command so its whole process tree dies when the ssh
+    connection goes away (local timeout/kill, network drop). The worker
+    runs in its own session (``setsid`` → its pid is the process-group
+    id); a watchdog reads stdin and on EOF — which is what a closed ssh
+    connection delivers — TERMs, then after ``grace`` seconds KILLs,
+    that group. On normal completion the watchdog group is reaped and
+    the worker's exit status is preserved (ssh propagates it)."""
+    q = shlex.quote(cmd)
+    return (
+        # the connection's stdin must reach the BACKGROUNDED watchdog
+        # explicitly (fd 3): POSIX shells give async jobs /dev/null as
+        # stdin, which would EOF the watchdog instantly
+        "exec 3<&0; "
+        "if command -v setsid >/dev/null 2>&1; then S=setsid; else S=; fi; "
+        f"$S sh -c {q} 3<&- & c=$!; "
+        # 'kill -s SIG -- "-pid"' is the pgroup form every sh builtin
+        # (dash included) actually parses; pid fallback for setsid-less
+        # hosts where the group does not exist
+        f"C=$c G={grace} $S sh -c "
+        "'cat <&3 >/dev/null; kill -s TERM -- \"-$C\" 2>/dev/null || "
+        "kill -s TERM \"$C\" 2>/dev/null; sleep $G; "
+        "kill -s KILL -- \"-$C\" 2>/dev/null || "
+        "kill -s KILL \"$C\" 2>/dev/null' "
+        "& k=$!; exec 3<&-; "
+        "wait $c; rc=$?; "
+        "kill -s KILL -- \"-$k\" 2>/dev/null || kill -s KILL $k 2>/dev/null; "
+        "exit $rc")
 
 
 def launch_ssh(hosts: Sequence[str], argv: Sequence[str], *,
@@ -84,7 +137,13 @@ def launch_ssh(hosts: Sequence[str], argv: Sequence[str], *,
     beyond the code and its interpreter being present (pass ``workdir``
     to cd into the repo checkout first). Workers must call
     ``paddle_tpu.distributed.init()``. Returns per-host return codes
-    (ssh propagates the remote exit status)."""
+    (ssh propagates the remote exit status).
+
+    Every remote command runs under a process-group watchdog
+    (``_wrap_remote``): if the ssh connection drops — including
+    ``_wait_all`` timing out and closing the client's stdin — the whole
+    remote worker tree is torn down instead of lingering and holding
+    the coordinator port (ADVICE round-5)."""
     envs_common = dict(env_extra or {})
     procs = []
     for rank, host in enumerate(hosts):
@@ -94,9 +153,13 @@ def launch_ssh(hosts: Sequence[str], argv: Sequence[str], *,
         exports = " ".join(f"{k}={shlex.quote(str(v))}"
                            for k, v in envs.items())
         cd = f"cd {shlex.quote(workdir)} && " if workdir else ""
-        remote = (cd + "env " + exports + " "
-                  + " ".join(shlex.quote(a) for a in argv))
-        procs.append(subprocess.Popen([*ssh_cmd, host, remote]))
+        # exec so the wrapper's $c IS the worker process, not an
+        # intermediate sh — on setsid-less hosts the watchdog's
+        # pid-fallback kill then still reaches the worker itself
+        remote = _wrap_remote(cd + "exec env " + exports + " "
+                              + " ".join(shlex.quote(a) for a in argv))
+        procs.append(subprocess.Popen([*ssh_cmd, host, remote],
+                                      stdin=subprocess.PIPE))
     return _wait_all(procs, timeout)
 
 

@@ -46,11 +46,13 @@ class _StepMonitor:
     steps is tagged ``recompile`` — it also catches slowdowns the
     signature tracker cannot see, e.g. backend-side recompiles)."""
 
-    def __init__(self, window: int = 64, outlier_factor: float = 4.0):
+    def __init__(self, window: int = 64, outlier_factor: float = 4.0,
+                 opt_state_bytes: int = 0):
         self._times = []                     # ring buffer of recent steps
         self._window = window
         self._factor = outlier_factor
         self._idx = 0
+        self._opt_bytes = int(opt_state_bytes)
         reg = observe.default_registry()
         self.steps = reg.counter(
             "train_steps_total", "optimizer steps taken")
@@ -71,6 +73,14 @@ class _StepMonitor:
             "hides memory stats, e.g. CPU)")
         self.host_gauge = reg.gauge(
             "host_rss_bytes", "host process resident set size")
+        self.opt_bytes_gauge = reg.gauge(
+            "opt_state_bytes_per_device",
+            "optimizer-state bytes resident on ONE device — under "
+            "ZeRO-1 (DistConfig zero_stage=1) this is ~1/data-axis of "
+            "the replicated figure")
+        # set unconditionally: a stateless-optimizer run must overwrite
+        # a previous run's value on the shared registry, not expose it
+        self.opt_bytes_gauge.set(self._opt_bytes)
         # peak FLOP/s is constant for the process: resolve once, not per
         # step (env read + device lookup + table scan on the hot path)
         self._peak_flops = observe.costs.device_peak_flops()
@@ -123,6 +133,7 @@ class _StepMonitor:
                    examples_per_sec=round(eps, 2),
                    mfu=round(mfu, 6) if mfu is not None else 0.0,
                    compile_count=int(compile_count),
+                   opt_state_bytes=self._opt_bytes,
                    recompile=recompile)
         # the flight ring ALWAYS sees the step — a post-mortem must not
         # depend on a metrics sink having been configured
@@ -173,6 +184,9 @@ class SGD:
         if parallel is not None:
             pv = parameters.values
             parameters.values = parallel.shard_params(pv)
+            # zero_stage>=1: state_shardings lays the opt-state leaves of
+            # replicated params over the data axis (ZeRO-1) — the same
+            # call places them replicated under zero=0
             self.opt_state = jax.device_put(
                 self.opt_state, parallel.state_shardings(self.opt_state))
             if parameters.state:
@@ -180,6 +194,16 @@ class SGD:
                     parameters.state,
                     jax.tree.map(lambda _: parallel.replicated(),
                                  parameters.state))
+            if getattr(parallel, "zero_stage", 0) >= 1:
+                rep = parallel.zero_report(parameters.values)
+                logger.debug(
+                    "zero=%d over %s=%d: %d param states sharded, "
+                    "%d replicated (%s)", rep["zero_stage"], rep["axis"],
+                    rep["axis_size"], len(rep["sharded"]),
+                    len(rep["replicated"]),
+                    ", ".join(f"{k}: {v}"
+                              for k, v in rep["replicated"].items())
+                    or "none")
         self._plain_train_step = self._build_train_step()
         self._accum_train_step = (self._build_accum_train_step()
                                   if self.grad_accum_steps > 1 else None)
@@ -200,10 +224,23 @@ class SGD:
                 "counted — the metric differs from unaccumulated training")
 
     # -- compiled steps ----------------------------------------------------
+    def _zero_shardings(self):
+        """(update, keep, state) sharding dicts for the ZeRO-1 constraint
+        points, computed ONCE at step-build time (None under zero=0 /
+        local training — the steps then call opt.update directly)."""
+        par = self.parallel
+        if par is None or getattr(par, "zero_stage", 0) < 1:
+            return None
+        return (par.zero_update_shardings(self.parameters.values),
+                par.param_shardings(self.parameters.values),
+                par.state_shardings(self.opt_state))
+
     def _build_train_step(self):
         fwd = self._forward
         opt = self.optimizer
         cost_name = self.cost.name
+        par = self.parallel
+        zero = self._zero_shardings()
 
         def train_step(params, opt_state, state, feeds, step, dropout_key):
             def loss_fn(p):
@@ -215,7 +252,18 @@ class SGD:
 
             (loss, (outs, new_state)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            new_params, new_opt = opt.update(step, grads, params, opt_state)
+            if zero is not None:
+                # ZeRO-1: grad reduce-scatters, the update runs on 1/N
+                # shards against the sharded opt state, updated params
+                # all-gather back (parallel/spmd.py)
+                from paddle_tpu.parallel import spmd
+                new_params, new_opt = spmd.zero_constrained_update(
+                    par, opt, step, grads, params, opt_state,
+                    update_shardings=zero[0], keep_shardings=zero[1],
+                    state_shardings=zero[2])
+            else:
+                new_params, new_opt = opt.update(step, grads, params,
+                                                 opt_state)
             return loss, new_params, new_opt, new_state, outs
 
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
@@ -230,6 +278,8 @@ class SGD:
         opt = self.optimizer
         cost_name = self.cost.name
         n = self.grad_accum_steps
+        par = self.parallel
+        zero = self._zero_shardings()
         metric_names = [l.name for l in self.topology.layers
                         if hasattr(l, "metric_finalize")]
 
@@ -257,17 +307,32 @@ class SGD:
                     loss_fn, has_aux=True)(params)
                 acc = jax.tree_util.tree_map(
                     lambda a, b: a + b.astype(jnp.float32), acc, g)
+                if zero is not None:
+                    # keep the accumulator ZeRO-sharded through the scan:
+                    # each microbatch's grad reduce-scatters into the
+                    # shard instead of all-reducing a full copy
+                    acc = jax.lax.with_sharding_constraint(acc, zero[0])
                 mets = {m: outs[m].array.astype(jnp.float32)
                         for m in metric_names if m in outs}
                 return (st2, acc), (loss, mets)
 
             zeros = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            if zero is not None:
+                zeros = jax.lax.with_sharding_constraint(zeros, zero[0])
             (new_state, acc), (losses, mets) = jax.lax.scan(
                 micro, (state, zeros), (mfeeds, keys))
             grads = jax.tree_util.tree_map(
                 lambda a, p: (a / n).astype(p.dtype), acc, params)
-            new_params, new_opt = opt.update(step, grads, params, opt_state)
+            if zero is not None:
+                from paddle_tpu.parallel import spmd
+                new_params, new_opt = spmd.zero_constrained_update(
+                    par, opt, step, grads, params, opt_state,
+                    update_shardings=zero[0], keep_shardings=zero[1],
+                    state_shardings=zero[2])
+            else:
+                new_params, new_opt = opt.update(step, grads, params,
+                                                 opt_state)
             outs = {m: Value(v.sum(axis=0)) for m, v in mets.items()}
             return (jnp.mean(losses), new_params, new_opt, new_state, outs)
 
@@ -299,6 +364,40 @@ class SGD:
                 and leaves[0].shape[0] % self.grad_accum_steps == 0):
             return self._accum_train_step
         return self._plain_train_step
+
+    def _zero_meta(self):
+        """The opt-state layout this trainer runs under, for checkpoint
+        manifests (None for local / zero=0 training — older checkpoints
+        without the key compare equal)."""
+        par = self.parallel
+        if par is None or getattr(par, "zero_stage", 0) < 1:
+            return None
+        return {"zero_stage": int(par.zero_stage),
+                "axis": par.batch_axis,
+                "axis_size": par.zero_axis_size()}
+
+    def _ckpt_meta(self):
+        z = self._zero_meta()
+        return {"zero": z} if z is not None else None
+
+    def opt_state_bytes_per_device(self) -> int:
+        """Optimizer-state bytes resident on ONE device: each leaf
+        contributes its per-device shard (``sharding.shard_shape``), so
+        replicated state counts in full while ZeRO-sharded state counts
+        at ~1/axis-size — the number the ``opt_state_bytes_per_device``
+        gauge and the zero on/off A/B (benchmarks/zero_bench.py) report."""
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self.opt_state):
+            shape = tuple(jnp.shape(leaf))
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is not None and hasattr(sharding, "shard_shape"):
+                shape = sharding.shard_shape(shape)
+            itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", 4)
+            n = 1
+            for s in shape:
+                n *= int(s)
+            total += n * itemsize
+        return total
 
     def _feeder(self, feeding):
         key = tuple(sorted(feeding.items())) if feeding else None
@@ -419,7 +518,17 @@ class SGD:
                         pipe.load_state_dict(ps)
                 if self.parallel is not None:
                     # loaded host arrays must go back to the mesh layout
-                    # __init__ applied to the fresh init values
+                    # __init__ applied to the fresh init values; the
+                    # checkpoint holds FULL arrays (shards are merged at
+                    # load), so this device_put IS the resharding restore
+                    # when the mesh or zero layout changed since the save
+                    saved = (ckpt_io.checkpoint_meta(latest) or {}
+                             ).get("zero")
+                    cur = self._zero_meta()
+                    if saved != cur:
+                        logger.info(
+                            "checkpoint opt-state layout %s -> restoring "
+                            "into %s (resharding)", saved, cur)
                     self.parameters.values = self.parallel.shard_params(
                         self.parameters.values)
                     self.opt_state = jax.device_put(
@@ -499,7 +608,8 @@ class SGD:
 
     def _train_passes(self, reader, num_passes, event_handler, feeder, ks,
                       log_period, ckpt, period, pipe=None):
-        monitor = _StepMonitor()
+        monitor = _StepMonitor(
+            opt_state_bytes=self.opt_state_bytes_per_device())
         for pass_id in range(num_passes):
             event_handler(events.BeginPass(pass_id))
             self.evaluators.reset()
@@ -576,13 +686,15 @@ class SGD:
                               self.opt_state, self.parameters.state,
                               pipeline_state=(
                                   pipe.state_dict() if pipe is not None
-                                  and pipe.track_state else None))
+                                  and pipe.track_state else None),
+                              meta=self._ckpt_meta())
             if ckpt is not None and not period:
                 ckpt.save(self._step, self.parameters.values,
                           self.opt_state, self.parameters.state,
                           pipeline_state=(
                               pipe.state_dict() if pipe is not None
-                              and pipe.track_state else None))
+                              and pipe.track_state else None),
+                          meta=self._ckpt_meta())
             monitor.update_memory_gauges()
             pass_dt = time.perf_counter() - pass_t0
             if observe.has_consumers():

@@ -109,6 +109,39 @@ class TestSshLaunch:
             ssh_cmd=(self._shim(tmp_path),), timeout=60)
         assert rcs == [3]
 
+    def test_timeout_tears_down_remote_tree(self, tmp_path):
+        """On _wait_all timeout the REMOTE worker tree must die too, not
+        just the local ssh client (ADVICE round-5): the wrapper's stdin
+        watchdog sees the closed connection and kills the worker's
+        process group — here a sleeper that would otherwise outlive the
+        launcher by a minute (and keep holding the coordinator port)."""
+        import time
+
+        from paddle_tpu.runtime import launch
+
+        pidfile = tmp_path / "worker.pid"
+        worker = tmp_path / "sleeper.py"
+        worker.write_text(
+            "import os, time, sys\n"
+            f"open({str(pidfile)!r}, 'w').write(str(os.getpid()))\n"
+            "time.sleep(60)\n")
+        t0 = time.time()
+        rcs = launch.launch_ssh(
+            ["hostA"], ["python", str(worker)],
+            ssh_cmd=(self._shim(tmp_path),), timeout=2.0)
+        assert rcs[0] != 0, rcs
+        assert time.time() - t0 < 30          # did not sit out the sleep
+        pid = int(pidfile.read_text())
+        deadline = time.time() + 10
+        alive = True
+        while alive and time.time() < deadline:
+            try:
+                os.kill(pid, 0)
+                time.sleep(0.2)
+            except OSError:
+                alive = False
+        assert not alive, f"remote worker {pid} survived the teardown"
+
     def test_cli_hosts_mode(self, tmp_path, capsys):
         """--hosts routes main() through the ssh fan-out."""
         from paddle_tpu.runtime import launch
@@ -121,6 +154,68 @@ class TestSshLaunch:
         assert rc == 0
         lines = sorted(out.read_text().split())
         assert lines == ["0:h0:7071", "1:h0:7071"]
+
+
+class TestZeroCollectivePattern:
+    """ZeRO-1's compiled-HLO contract on the virtual CPU mesh: the
+    full-gradient all-reduce of classic DP disappears under zero=1 in
+    favour of the reduce-scatter form (XLA:CPU emits it as the manual
+    all-reduce-consumed-only-by-shard-slices pattern — the CPU pipeline
+    lacks the reduce-scatter-creator pass; ``benchmarks/zero_bench.py
+    --tpu-check`` and ``scaling_aot.py --zero1`` show the real XLA:TPU
+    fused all-reduce-scatter) plus a param-sized post-update all-gather.
+    ``parallel.spmd.zero_collective_evidence`` classifies all three."""
+
+    def _evidence(self, zero, accum=1):
+        import jax
+        import jax.numpy as jnp
+
+        import paddle_tpu as paddle
+        from paddle_tpu import layer, parallel
+        from paddle_tpu.core import place
+        from paddle_tpu.parallel import spmd
+        from paddle_tpu.utils.rng import KeySource
+
+        x = layer.data("x", paddle.data_type.dense_vector(8))
+        lbl = layer.data("lbl", paddle.data_type.integer_value(3))
+        h = layer.fc(x, 16, act=paddle.activation.Relu(), name="zh")
+        out = layer.fc(h, 3, act=paddle.activation.Softmax(), name="zo")
+        cost = layer.classification_cost(out, lbl, name="zcost")
+        params = paddle.parameters.create(cost, KeySource(11))
+        mesh = place.make_mesh((4,), (place.AXIS_DATA,))
+        tr = paddle.trainer.SGD(
+            cost=cost, parameters=params,
+            update_equation=paddle.optimizer.Adam(learning_rate=0.05),
+            parallel=parallel.data_parallel(mesh, zero=zero),
+            grad_accum_steps=accum)
+        feeds = tr._feeder(None).feed(
+            [(np.random.RandomState(0).randn(8).astype(np.float32), 1)
+             for _ in range(16)])
+        feeds = jax.device_put(feeds, tr.parallel.feed_shardings(feeds))
+        args = (tr.parameters.values, tr.opt_state, tr.parameters.state,
+                feeds, jnp.asarray(0, jnp.int32),
+                jax.random.PRNGKey(0))
+        step = tr._accum_train_step if accum > 1 else tr._plain_train_step
+        txt = step.lower(*args).compile().as_text()
+        biggest = max(np.asarray(v).nbytes
+                      for v in tr.parameters.values.values())
+        return spmd.zero_collective_evidence(txt, biggest)
+
+    def test_zero0_has_full_grad_all_reduce(self):
+        ev = self._evidence(zero=0)
+        assert ev["full_grad_all_reduce"] >= 1, ev
+        assert ev["param_all_gather"] == 0, ev
+
+    def test_zero1_reduce_scatters_and_gathers(self):
+        ev = self._evidence(zero=1)
+        assert ev["full_grad_all_reduce"] == 0, ev
+        assert ev["reduce_scatter"] >= 1, ev
+        assert ev["param_all_gather"] >= 1, ev
+
+    def test_zero1_accum_step_same_pattern(self):
+        ev = self._evidence(zero=1, accum=2)
+        assert ev["full_grad_all_reduce"] == 0, ev
+        assert ev["param_all_gather"] >= 1, ev
 
 
 class TestHybridMeshSingleProcess:
