@@ -40,4 +40,22 @@ print(f"opt_state_bytes_per_device zero0={d['zero0']['opt_state_bytes_per_device
       f"collective_pattern_ok={d['collective_pattern_ok']}")
 PY
 fi
+# ...and the latest paged-serving A/B (benchmarks/serving_bench.py)
+latest_serving=$(ls -t benchmarks/runs/*serving_paged*.json 2>/dev/null | head -1)
+if [ -n "$latest_serving" ]; then
+    echo "== PAGED SERVING (latest bench: $latest_serving) =="
+    python - "$latest_serving" <<'PY'
+import json, sys
+d = json.load(open(sys.argv[1]))
+tp, lat = d["throughput"], d["latency"]
+print(f"tokens/sec paged={tp['engine_paged']['tokens_per_sec']} "
+      f"row-arena={tp['engine_slots']['tokens_per_sec']} "
+      f"lockstep={tp['lockstep']['tokens_per_sec']} "
+      f"(speedup={d['serving_paged_speedup']}) | "
+      f"adversarial ttft_p99 paged={lat['engine_paged']['ttft_p99_s']} "
+      f"row-arena={lat['engine_slots']['ttft_p99_s']} "
+      f"(ratio={d['serving_paged_ttft_p99_ratio']}) | "
+      f"prefix_hit_blocks={tp['engine_paged']['prefix_hit_blocks']}")
+PY
+fi
 exit $rc

@@ -1,31 +1,53 @@
 #!/usr/bin/env python
-"""Continuous-batching vs lockstep LM serving under Poisson load.
+"""Paged vs row-arena vs lockstep LM serving under Poisson load.
 
-Replays ONE request trace (Poisson arrivals, mixed prompt/output
-lengths) against both serving surfaces:
+Replays request traces against three serving surfaces:
 
-- ``engine``   — ``serving.DecodeEngine``: slot-based KV arena, bucketed
-  slot prefill, per-slot positions, on-device sampling ([B] ids are the
-  only per-step host traffic).
-- ``lockstep`` — the ``LMServer.generate``-shaped baseline: FIFO batch
-  formation (wait to fill a batch), one shared prompt bucket, every row
-  decodes to the LONGEST request's max_new, host-side argmax over the
-  full [B, vocab] logits each token.
+- ``engine_paged`` — ``serving.PagedDecodeEngine``: block-table KV
+  pool, chunked prefill interleaved with decode, content-hash prefix
+  cache (shared prompts prefill once, concurrent same-prefix requests
+  adopt each other's blocks mid-flight), on-device sampling.
+- ``engine_slots`` — the PR-3 ``serving.DecodeEngine``: whole-row KV
+  arena, monolithic bucketed prefill (one long prompt stalls every
+  in-flight decoder for its full duration).
+- ``lockstep``    — the ``LMServer.generate``-shaped baseline: FIFO
+  batch formation, one shared prompt bucket, every row decodes to the
+  LONGEST request's max_new, host-side argmax per token.
 
-Reports goodput tokens/sec (only tokens a request asked for count) and
-p50/p99 request latency + TTFT per variant, one JSON line each, plus a
-``serving_engine_speedup`` line — the continuous-batching win. The
-engine's compile discipline (at most one compile per prefill bucket +
-one for decode) is asserted via the observe compile tracker.
+TWO phases, each its own trace over the same request mix:
 
-Usage: python benchmarks/serving_bench.py [--requests 32] [--batch 4]
-           [--rate 4] [--prompt-lens 6,12,24] [--max-new 8,16,32]
+- **throughput** — every request arrives at t=0 (offered load
+  saturates the engine), no adversary: wall clock measures CAPACITY,
+  which is where the prefix cache pays (tokens/sec, block occupancy,
+  hit counts). ``serving_paged_speedup`` = paged/row-arena tokens/sec.
+- **latency** — Poisson arrivals at ``--rate`` (chosen so the engines
+  keep up): TTFT percentiles measure the SCHEDULING path.
+  ``--long-prompt-adversarial`` drops ONE near-``cache_len`` prompt
+  mid-burst — the row-arena engine stalls everything for its
+  monolithic prefill, the paged engine interleaves chunks with decode
+  steps. ``serving_paged_ttft_p99_ratio`` = paged/row-arena TTFT p99.
+
+Trace shaping: ``--shared-prefix-frac F`` injects one common system
+prompt (``--shared-prefix-len`` tokens) into fraction F of each trace
+— the "millions of users share a system prompt" regime.
+
+Each (variant, phase) replays ``--repeats`` times on a FRESH engine
+(cold prefix cache; compiled programs shared via one jit + tracker)
+and reports the best run — the least-machine-interference estimate on
+a noisy host. Engine compile discipline (one compile per prefill
+bucket / (chunk bucket, context span) pair + one decode) is asserted
+via the compile tracker. A JSON artifact lands in benchmarks/runs/
+(``--out`` to override; skipped under ``--smoke`` unless --out given).
+
+Usage: python benchmarks/serving_bench.py [--requests 96] [--batch 8]
+           [--rate 16] [--shared-prefix-frac 0.5]
+           [--long-prompt-adversarial] [--block-size 16]
+           [--chunk-tokens 64] [--repeats 3]
            [--metrics-out=serving.jsonl] [--smoke]
-Prints one JSON line per variant (``--smoke``: tiny model + near-zero
-inter-arrival gaps, the tier-1 fast path).
 """
 
 import argparse
+import datetime
 import json
 import os
 import sys
@@ -38,6 +60,8 @@ import numpy as np
 
 from bench_metrics import metrics_write as _metrics_write  # noqa: E402
 from bench_metrics import resolve_metrics_out  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # --metrics-out=PATH (or BENCH_METRICS_OUT): JSONL trail next to the
 # stdout JSON lines, bench.py conventions (inline append, never fatal)
@@ -55,39 +79,50 @@ def _pct(vals, q):
     return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))]
 
 
-def build_workload(n, rate, prompt_lens, max_news, vocab, seed):
+def build_workload(n, rate, prompt_lens, max_news, vocab, seed, *,
+                   shared_frac=0.0, shared_len=0, adversarial=False,
+                   cache_len=0, adversarial_max_new=8, burst=0):
     """[(arrival_s, prompt ids, max_new)] — Poisson arrivals, mixed
-    prompt/output lengths (the batch-formation-hostile shape)."""
+    prompt/output lengths (the batch-formation-hostile shape).
+
+    ``shared_frac`` of the requests get one common ``shared_len``-token
+    system prompt prepended (prefix-cache traffic); ``adversarial``
+    additionally inserts ONE near-``cache_len`` prompt arriving
+    MID-BURST: the ``burst`` trace arrivals after the midpoint are
+    compressed to land milliseconds behind it — the field study's
+    long-multimodal-prompt-vs-interactive-traffic collision. A
+    row-arena engine must run its monolithic prefill (and then each
+    victim's, sequentially) before the burst sees first tokens; the
+    paged engine interleaves the victims' (often prefix-cache-hit)
+    chunks with the adversary's."""
     rng = np.random.RandomState(seed)
+    prefix = rng.randint(0, vocab, shared_len).astype(np.int32)
     t, work = 0.0, []
     for _ in range(n):
         t += rng.exponential(1.0 / rate)
         tp = int(prompt_lens[rng.randint(len(prompt_lens))])
-        work.append((t, rng.randint(0, vocab, tp).astype(np.int32),
+        prompt = rng.randint(0, vocab, tp).astype(np.int32)
+        if shared_frac > 0 and rng.rand() < shared_frac:
+            prompt = np.concatenate([prefix, prompt])
+        work.append((t, prompt,
                      int(max_news[rng.randint(len(max_news))])))
+    if adversarial:
+        tp_adv = cache_len - adversarial_max_new
+        mid = len(work) // 2
+        t_mid = work[mid][0]
+        for j in range(mid, min(mid + burst, len(work))):
+            work[j] = ((t_mid + (j - mid + 1) * 1e-3,) + work[j][1:])
+        work.append((t_mid, rng.randint(0, vocab, tp_adv).astype(np.int32),
+                     adversarial_max_new))
+        work.sort(key=lambda w: w[0])
     return work
 
 
-def run_engine(params, cfg, work, *, batch, cache_len, buckets):
-    """Wall-clock replay through DecodeEngine; returns the result dict.
-    A warmup pass (one request per bucket in the trace) pays every
-    compile before the clock starts; the tracker then proves the timed
-    run added none."""
-    from paddle_tpu.observe.compile_tracker import CompileTracker
-    from paddle_tpu.serving import DecodeEngine
-
-    tracker = CompileTracker()
-    eng = DecodeEngine.from_params(params, cfg, batch=batch,
-                                   cache_len=cache_len, buckets=buckets,
-                                   seed=0, tracker=tracker)
-    from paddle_tpu.core import ragged
-    for b in sorted({ragged.bucket_length(len(p), eng.buckets)
-                     for _, p, _ in work}):
-        eng.submit(np.zeros(min(b, cache_len - 2), np.int32), 2)
-    eng.run_until_idle()
-    warm = dict(eng.compile_counts())
-
+def _replay(eng, work):
+    """Wall-clock trace replay against either engine; samples slot and
+    block occupancy per scheduler step."""
     reqs, i, t0 = [], 0, time.perf_counter()
+    occ_slots, occ_blocks = [], []
     while len(reqs) < len(work) or not eng.idle:
         now = time.perf_counter() - t0
         while i < len(work) and work[i][0] <= now:
@@ -98,27 +133,124 @@ def run_engine(params, cfg, work, *, batch, cache_len, buckets):
             time.sleep(min(max(work[i][0] - now, 0.0), 0.05))
             continue
         eng.step()
+        occ_slots.append(eng.active_count)
+        if hasattr(eng, "pool"):
+            occ_blocks.append(eng.pool.in_use)
     wall = time.perf_counter() - t0
+    return reqs, wall, occ_slots, occ_blocks
 
-    assert eng.compile_counts() == warm, (
-        f"timed run recompiled: {warm} -> {eng.compile_counts()}")
-    assert eng.compile_counts()["decode"] == 1
-    assert eng.compile_counts()["prefill"] <= len(eng.buckets)
+
+def _result(variant, eng, reqs, wall, occ_slots, occ_blocks):
     toks = sum(len(r.tokens) for r in reqs)
     lat = [r.latency_s for r in reqs]
     ttft = [r.ttft_s for r in reqs]
-    return {"variant": "engine", "requests": len(reqs), "tokens": toks,
-            "wall_s": round(wall, 4),
-            "tokens_per_sec": round(toks / wall, 2),
-            "p50_latency_s": round(_pct(lat, 0.5), 4),
-            "p99_latency_s": round(_pct(lat, 0.99), 4),
-            "ttft_p50_s": round(_pct(ttft, 0.5), 4),
-            "ttft_p99_s": round(_pct(ttft, 0.99), 4),
-            "compiles": eng.compile_counts()}
+    r = {"variant": variant, "requests": len(reqs), "tokens": toks,
+         "wall_s": round(wall, 4),
+         "tokens_per_sec": round(toks / wall, 2),
+         "p50_latency_s": round(_pct(lat, 0.5), 4),
+         "p99_latency_s": round(_pct(lat, 0.99), 4),
+         "ttft_p50_s": round(_pct(ttft, 0.5), 4),
+         "ttft_p99_s": round(_pct(ttft, 0.99), 4),
+         "slot_occupancy_mean": round(
+             float(np.mean(occ_slots)) / eng.batch, 3) if occ_slots
+         else 0.0,
+         "compiles": eng.compile_counts()}
+    if occ_blocks:
+        r.update({
+            "blocks_total": eng.pool.num_blocks,
+            "blocks_in_use_peak": int(max(occ_blocks)),
+            "blocks_in_use_mean": round(float(np.mean(occ_blocks)), 1),
+            "prefix_hit_blocks": int(eng.metrics.get(
+                "engine_prefix_cache_hit_blocks_total").value()),
+            "prefix_miss_blocks": int(eng.metrics.get(
+                "engine_prefix_cache_miss_blocks_total").value()),
+            "prefix_hit_tokens_total": sum(
+                r_.prefix_hit_tokens for r_ in reqs)})
+    return r
 
 
-def run_lockstep(params, cfg, work, *, batch, cache_len, buckets):
-    """The pre-engine serving discipline on the same trace: fill a
+def _paged_programs(lens, chunk, bs, buckets):
+    """The (chunk bucket, page-vector length) program set a COLD walk
+    of the given prompt lengths reaches — one compile each (prefix
+    hits and mid-flight adoption only ever SKIP chunk calls)."""
+    from paddle_tpu.core import ragged
+    progs = set()
+    for n in lens:
+        off = 0
+        while off < n:
+            c = min(n - off, chunk)
+            b = ragged.bucket_length(c, buckets)
+            progs.add((b, off // bs + -(-b // bs)))
+            off += c
+    return progs
+
+
+def paged_factory(params, cfg, *, batch, cache_len, block_size,
+                  chunk_tokens, num_blocks, tracker):
+    """() -> fresh PagedDecodeEngine (cold pool + prefix cache) around
+    ONE jitted program pair and ONE tracker, so repeat replays reuse
+    the compile cache and the compile invariant spans all of them."""
+    import jax
+
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving import PagedDecodeEngine, sampling
+    nb = int(num_blocks if num_blocks is not None
+             else batch * (cache_len // block_size))
+    prefill_fn, decode_fn = sampling.paged_step_fns(cfg, block_size)
+    jpf, jdf = jax.jit(prefill_fn), jax.jit(decode_fn)
+
+    def make():
+        pool = transformer.init_block_pool(cfg, nb, block_size)
+        return PagedDecodeEngine(
+            jpf, jdf, params, pool, batch=batch, cache_len=cache_len,
+            block_size=block_size, num_blocks=nb,
+            chunk_tokens=chunk_tokens, seed=0, tracker=tracker)
+
+    return make
+
+
+def slots_factory(params, cfg, *, batch, cache_len, buckets, tracker):
+    """() -> fresh row-arena DecodeEngine, same shared-compile setup."""
+    import jax
+
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving import DecodeEngine, sampling
+    prefill_fn, decode_fn = sampling.engine_step_fns(cfg)
+    jpf, jdf = jax.jit(prefill_fn), jax.jit(decode_fn)
+
+    def make():
+        cache = transformer.init_cache(cfg, batch, cache_len)
+        return DecodeEngine(jpf, jdf, params, cache, batch=batch,
+                            cache_len=cache_len, buckets=buckets,
+                            seed=0, tracker=tracker)
+
+    return make
+
+
+def warm_engine(factory, work, vocab):
+    """One cold submit per distinct trace length covers every program
+    the replay can reach; returns the compile counts to hold fixed."""
+    wrng = np.random.RandomState(7)
+    eng = factory()
+    for n in sorted({len(p) for _, p, _ in work}):
+        eng.submit(wrng.randint(0, vocab, n).astype(np.int32), 2)
+        eng.run_until_idle()
+    return dict(eng.compile_counts())
+
+
+def engine_once(factory, variant, work, warm):
+    """One replay on a FRESH engine (cold pool + prefix cache; the
+    compiled programs and tracker are the factory's, shared)."""
+    eng = factory()
+    reqs, wall, occ_s, occ_b = _replay(eng, work)
+    assert eng.compile_counts() == warm, (
+        f"{variant}: timed replay recompiled: "
+        f"{warm} -> {eng.compile_counts()}")
+    return _result(variant, eng, reqs, wall, occ_s, occ_b)
+
+
+def lockstep_factory(params, cfg, *, batch, cache_len, buckets):
+    """(warm_fn, once_fn) for the pre-engine serving discipline: fill a
     FIFO batch (pad the tail group), share one prompt bucket, decode
     max(max_new) steps for everyone, sample on host from full logits."""
     import jax
@@ -152,63 +284,102 @@ def run_lockstep(params, cfg, work, *, batch, cache_len, buckets):
                                  jnp.asarray(bucket + j, jnp.int32))
             out = np.asarray(logits).argmax(-1).astype(np.int32)
 
-    # warmup: compile each bucket the trace uses + the decode step
-    for b in sorted({ragged.bucket_length(len(p), buckets)
-                     for _, p, _ in work}):
-        serve_group([(0.0, np.zeros(b, np.int32), 2)])
+    def warm(work):
+        # compile each bucket the trace uses + the decode step
+        for b in sorted({ragged.bucket_length(len(p), buckets)
+                         for _, p, _ in work}):
+            serve_group([(0.0, np.zeros(b, np.int32), 2)])
 
-    done, i, pending = 0, 0, []
-    lat, ttfts, goodput = [], [], 0
-    t0 = time.perf_counter()
-    while i < len(work) or pending:
-        now = time.perf_counter() - t0
-        while i < len(work) and work[i][0] <= now:
-            pending.append(work[i])
-            i += 1
-        if len(pending) >= batch or (i == len(work) and pending):
-            group = pending[:batch]
-            pending = pending[batch:]
-            serve_group(group)
-            end = time.perf_counter() - t0
-            for arr, _p, m in group:
-                lat.append(end - arr)
-                ttfts.append(end - arr)   # lockstep: tokens land at the
-                goodput += m              # END of the batch decode
-            done += len(group)
-        elif i < len(work):
-            time.sleep(min(max(work[i][0] - now, 0.0), 0.05))
-    wall = time.perf_counter() - t0
-    return {"variant": "lockstep", "requests": done,
-            "tokens": goodput, "wall_s": round(wall, 4),
-            "tokens_per_sec": round(goodput / wall, 2),
-            "p50_latency_s": round(_pct(lat, 0.5), 4),
-            "p99_latency_s": round(_pct(lat, 0.99), 4),
-            "ttft_p50_s": round(_pct(ttfts, 0.5), 4),
-            "ttft_p99_s": round(_pct(ttfts, 0.99), 4)}
+    def once(work):
+        done, i, pending = 0, 0, []
+        lat, ttfts, goodput = [], [], 0
+        t0 = time.perf_counter()
+        while i < len(work) or pending:
+            now = time.perf_counter() - t0
+            while i < len(work) and work[i][0] <= now:
+                pending.append(work[i])
+                i += 1
+            if len(pending) >= batch or (i == len(work) and pending):
+                group = pending[:batch]
+                pending = pending[batch:]
+                serve_group(group)
+                end = time.perf_counter() - t0
+                for arr, _p, m in group:
+                    lat.append(end - arr)
+                    ttfts.append(end - arr)   # lockstep: tokens land
+                    goodput += m              # at the END of the batch
+                done += len(group)
+            elif i < len(work):
+                time.sleep(min(max(work[i][0] - now, 0.0), 0.05))
+        wall = time.perf_counter() - t0
+        return {"variant": "lockstep", "requests": done,
+                "tokens": goodput, "wall_s": round(wall, 4),
+                "tokens_per_sec": round(goodput / wall, 2),
+                "p50_latency_s": round(_pct(lat, 0.5), 4),
+                "p99_latency_s": round(_pct(lat, 0.99), 4),
+                "ttft_p50_s": round(_pct(ttfts, 0.5), 4),
+                "ttft_p99_s": round(_pct(ttfts, 0.99), 4)}
+
+    return warm, once
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--requests", type=int, default=96,
+                    help="trace length; sized so ONE adversarial "
+                         "request cannot occupy the p99 index (TTFT "
+                         "p99 measures the 99%, not the adversary)")
     ap.add_argument("--batch", type=int, default=8,
-                    help="KV-arena slots (= lockstep batch size)")
+                    help="decode slots (= lockstep batch size)")
     ap.add_argument("--rate", type=float, default=16.0,
-                    help="Poisson arrival rate, requests/sec")
+                    help="latency-phase Poisson arrival rate, req/s "
+                         "(the throughput phase arrives all-at-once). "
+                         "The default offers a load BETWEEN the two "
+                         "engines' measured capacities: the row engine "
+                         "falls steadily behind while the paged engine "
+                         "keeps up — the SLO band the prefix cache "
+                         "buys")
     ap.add_argument("--vocab", type=int, default=2048)
     ap.add_argument("--d-model", type=int, default=128)
     ap.add_argument("--layers", type=int, default=4)
-    ap.add_argument("--cache-len", type=int, default=256)
-    ap.add_argument("--prompt-lens", default="8,16,32,64",
+    ap.add_argument("--cache-len", type=int, default=512)
+    ap.add_argument("--prompt-lens", default="16,32,64,96",
                     help="mixed prompt lengths (lockstep pads each "
                          "group to the max)")
-    ap.add_argument("--max-new", default="4,8,16,64",
+    ap.add_argument("--max-new", default="4,8,16,32",
                     help="mixed output budgets (lockstep decodes every "
                          "row to the group max)")
+    ap.add_argument("--shared-prefix-frac", type=float, default=0.5,
+                    help="fraction of requests carrying one common "
+                         "system prompt (prefix-cache traffic)")
+    ap.add_argument("--shared-prefix-len", type=int, default=256,
+                    help="length of the shared system prompt (long "
+                         "enough that the row engine's bucket-padded "
+                         "prefill cost is material — the field study's "
+                         "system-prompt regime)")
+    ap.add_argument("--long-prompt-adversarial", action="store_true",
+                    help="insert ONE near-cache_len prompt mid-burst "
+                         "into the latency trace (the chunked-prefill "
+                         "stress)")
+    ap.add_argument("--block-size", type=int, default=16,
+                    help="paged-engine KV block size (tokens)")
+    ap.add_argument("--chunk-tokens", type=int, default=64,
+                    help="paged-engine prefill chunk size (tokens)")
+    ap.add_argument("--num-blocks", type=int, default=None,
+                    help="paged pool size (default: HBM parity with "
+                         "the row arena, batch*cache_len/block_size)")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="replays per (variant, phase); the best run "
+                         "is reported (noise-robust on shared hosts)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--platform", default=None)
     ap.add_argument("--metrics-out", default=None,
                     help="append JSONL records here (bench.py trail "
                          "conventions)")
+    ap.add_argument("--out", default=None,
+                    help="JSON artifact path (default: "
+                         "benchmarks/runs/<date>_serving_paged.json; "
+                         "skipped under --smoke unless given)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny preset for the tier-1 fast test: few "
                          "requests, near-zero inter-arrival gaps")
@@ -218,47 +389,155 @@ def main(argv=None):
         args.vocab, args.d_model, args.layers = 64, 16, 2
         args.cache_len = 64
         args.prompt_lens, args.max_new = "4,10", "4,8"
+        args.shared_prefix_frac = max(args.shared_prefix_frac, 0.5)
+        args.shared_prefix_len = 16
+        args.block_size, args.chunk_tokens = 8, 16
+        args.long_prompt_adversarial = True
+        args.repeats = 1
 
     import jax
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     import jax.numpy as jnp
 
+    from paddle_tpu.core import ragged
     from paddle_tpu.models import transformer
+    from paddle_tpu.observe.compile_tracker import CompileTracker
 
+    prompt_lens = [int(x) for x in args.prompt_lens.split(",")]
+    max_news = [int(x) for x in args.max_new.split(",")]
+    # the lockstep baseline LEFT-pads a group to its prompt bucket and
+    # decodes every row from position bucket onward, so ITS cache (and
+    # the model's position budget) must provision bucket + output on
+    # top of the worst bucket = cache_len — the engines, which track
+    # true prompt lengths, stay at cache_len (the HBM-parity point)
+    lk_cache_len = args.cache_len + max(max_news)
     cfg = transformer.TransformerConfig(
         vocab=args.vocab, d_model=args.d_model,
         n_heads=max(2, args.d_model // 32), n_kv_heads=0,
         n_layers=args.layers, d_ff=args.d_model * 4,
-        max_len=args.cache_len,
+        max_len=lk_cache_len,
         dtype=jnp.float32 if jax.default_backend() == "cpu"
         else jnp.bfloat16, use_rope=True)
     params = transformer.init_params(jax.random.PRNGKey(0), cfg)
-    prompt_lens = [int(x) for x in args.prompt_lens.split(",")]
-    max_news = [int(x) for x in args.max_new.split(",")]
-    buckets = tuple(sorted({
-        2 ** int(np.ceil(np.log2(max(t, 2)))) for t in prompt_lens}))
-    work = build_workload(args.requests, args.rate, prompt_lens,
-                          max_news, args.vocab, args.seed)
+    shaping = dict(shared_frac=args.shared_prefix_frac,
+                   shared_len=args.shared_prefix_len,
+                   cache_len=args.cache_len)
+    # throughput: offered load saturates the engine (capacity);
+    # latency: Poisson at --rate (scheduling-path TTFT)
+    work_tp = build_workload(args.requests, 1e9, prompt_lens, max_news,
+                             args.vocab, args.seed, **shaping)
+    work_lat = build_workload(
+        args.requests, args.rate, prompt_lens, max_news, args.vocab,
+        args.seed + 1, adversarial=args.long_prompt_adversarial,
+        burst=args.batch, **shaping)
+    all_lens = {len(p) for _, p, _ in work_tp + work_lat}
+    # row-arena/lockstep prompt buckets must cover every trace length
+    # (the paged engine needs no such bucket: chunked prefill)
+    buckets = tuple(sorted({min(
+        2 ** int(np.ceil(np.log2(max(n, 2)))), args.cache_len)
+        for n in all_lens}))
+
+    trace_cfg = {"trace_requests": args.requests, "rate": args.rate,
+                 "shared_prefix_frac": args.shared_prefix_frac,
+                 "shared_prefix_len": args.shared_prefix_len,
+                 "long_prompt_adversarial": args.long_prompt_adversarial,
+                 "block_size": args.block_size,
+                 "chunk_tokens": args.chunk_tokens,
+                 "cache_len": args.cache_len, "batch": args.batch,
+                 "repeats": args.repeats}
+
+    # the paged tracker's storm threshold sits above the chunk-grid
+    # program ceiling: one compile per (bucket, span) is the DESIGN,
+    # not a storm (the invariant below still pins the exact count)
+    from paddle_tpu.serving import default_chunk_buckets
+    chunk = min(args.chunk_tokens, args.cache_len)
+    n_chunk_buckets = len(default_chunk_buckets(chunk))
+    paged_tr = CompileTracker(
+        storm_threshold=(args.cache_len // chunk) * n_chunk_buckets + 2)
+    slots_tr = CompileTracker()
+    mk_paged = paged_factory(
+        params, cfg, batch=args.batch, cache_len=args.cache_len,
+        block_size=args.block_size, chunk_tokens=args.chunk_tokens,
+        num_blocks=args.num_blocks, tracker=paged_tr)
+    mk_slots = slots_factory(
+        params, cfg, batch=args.batch, cache_len=args.cache_len,
+        buckets=buckets, tracker=slots_tr)
+
+    lk_warm, lk_once = lockstep_factory(
+        params, cfg, batch=args.batch, cache_len=lk_cache_len,
+        buckets=buckets)
 
     results = {}
-    for runner in (run_engine, run_lockstep):
-        r = runner(params, cfg, work, batch=args.batch,
-                   cache_len=args.cache_len, buckets=buckets)
-        r.update({"bench": "serving", "platform": jax.default_backend(),
-                  "batch": args.batch, "rate": args.rate,
-                  "requests_total": args.requests})
-        results[r["variant"]] = r
-        print(json.dumps(r), flush=True)
-        metrics_write(**r)
+    repeats = max(1, args.repeats)
+    for phase, work in (("throughput", work_tp), ("latency", work_lat)):
+        paged_warm = warm_engine(mk_paged, work, args.vocab)
+        slots_warm = warm_engine(mk_slots, work, args.vocab)
+        lk_warm(work)
+        # repeats INTERLEAVED across variants so ambient machine load
+        # lands on all of them, not on whichever ran first; each phase
+        # keeps the repeat best at ITS OWN figure of merit (capacity:
+        # tokens/sec; scheduling: TTFT p99) for every variant alike
+        def better(r, b):
+            if phase == "latency":
+                return r["ttft_p99_s"] < b["ttft_p99_s"]
+            return r["tokens_per_sec"] > b["tokens_per_sec"]
 
-    speedup = (results["engine"]["tokens_per_sec"]
-               / max(results["lockstep"]["tokens_per_sec"], 1e-9))
-    final = {"bench": "serving", "metric": "serving_engine_speedup",
-             "value": round(speedup, 3),
-             "platform": jax.default_backend()}
-    print(json.dumps(final), flush=True)
-    metrics_write(**final)
+        best = {}
+        for _ in range(repeats):
+            for variant, once in (
+                    ("engine_paged", lambda: engine_once(
+                        mk_paged, "engine_paged", work, paged_warm)),
+                    ("engine_slots", lambda: engine_once(
+                        mk_slots, "engine_slots", work, slots_warm)),
+                    ("lockstep", lambda: lk_once(work))):
+                r = once()
+                if variant not in best or better(r, best[variant]):
+                    best[variant] = r
+        results[phase] = {}
+        for variant, r in best.items():
+            r.update({"bench": "serving", "phase": phase,
+                      "platform": jax.default_backend(), **trace_cfg})
+            results[phase][variant] = r
+            print(json.dumps(r), flush=True)
+            metrics_write(**r)
+
+    # compile discipline across BOTH phases and all repeats: one
+    # program per (chunk bucket, context span) / prompt bucket + one
+    # decode, regardless of paging, hits, or adoption
+    progs = _paged_programs(all_lens, chunk, args.block_size,
+                            default_chunk_buckets(chunk))
+    assert paged_tr.count("serving_engine.decode") == 1
+    assert paged_tr.count("serving_engine.prefill") == len(progs), (
+        f"paged compile invariant: expected {len(progs)} chunk "
+        f"programs {sorted(progs)}, saw "
+        f"{paged_tr.count('serving_engine.prefill')}")
+    assert slots_tr.count("serving_engine.decode") == 1
+    assert slots_tr.count("serving_engine.prefill") <= len(buckets)
+
+    tp, lat = results["throughput"], results["latency"]
+    speedup = (tp["engine_paged"]["tokens_per_sec"]
+               / max(tp["engine_slots"]["tokens_per_sec"], 1e-9))
+    ttft_ratio = (lat["engine_paged"]["ttft_p99_s"]
+                  / max(lat["engine_slots"]["ttft_p99_s"], 1e-9))
+    for metric, value in (("serving_paged_speedup", speedup),
+                          ("serving_paged_ttft_p99_ratio", ttft_ratio)):
+        line = {"bench": "serving", "metric": metric,
+                "value": round(value, 3),
+                "platform": jax.default_backend(), **trace_cfg}
+        print(json.dumps(line), flush=True)
+        metrics_write(**line)
+    results["serving_paged_speedup"] = round(speedup, 3)
+    results["serving_paged_ttft_p99_ratio"] = round(ttft_ratio, 3)
+
+    out = args.out or os.path.join(
+        REPO, "benchmarks", "runs",
+        f"{datetime.date.today()}_serving_paged.json")
+    if args.out or not args.smoke:
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(results, f, indent=2)
+        print(f"wrote {out}", file=sys.stderr)
     return results
 
 

@@ -26,10 +26,14 @@ from paddle_tpu.io.merged import _add_member as _add   # shared tar append
 from paddle_tpu.observe import costs as _costs
 from paddle_tpu.observe import metrics as _metrics
 
-FORMAT_VERSION = 3   # max supported; plain artifacts still save as v1,
+FORMAT_VERSION = 4   # max supported; plain artifacts still save as v1,
 #                      int8-weight ones as v2; v3 adds the continuous-
 #                      batching engine modules (slot prefill per bucket +
-#                      vector-position decode with on-device sampling)
+#                      vector-position decode with on-device sampling);
+#                      v4 replaces them with the PAGED engine modules
+#                      (chunked block-pool prefill per chunk bucket +
+#                      page-table decode — prefix caching and chunked
+#                      prefill are host-side scheduling over them)
 
 
 def _unflatten(flat):
@@ -103,7 +107,10 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
                      prompt_len: int, cache_len: int,
                      platforms: Optional[Sequence[str]] = None,
                      weights_int8: bool = False,
-                     engine_buckets: Optional[Sequence[int]] = None
+                     engine_buckets: Optional[Sequence[int]] = None,
+                     engine_paged: bool = False,
+                     engine_block_size: int = 16,
+                     engine_num_blocks: Optional[int] = None
                      ) -> None:
     """Export the serving pair at fixed shapes and pack the artifact.
 
@@ -119,6 +126,18 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
     greedy/temperature/top-k sampling; ``LMServer.engine()`` schedules
     over them. ``batch`` doubles as the KV-arena slot count. v1/v2
     artifacts keep loading into the legacy lockstep path unchanged.
+    ``engine_paged=True`` exports the PAGED engine instead (format v4):
+    ``engine_buckets`` become CHUNK buckets, one
+    ``engine_prefill_paged_<C>_<P>.bin`` chunk-prefill module per
+    (chunk bucket C, page-vector length P) pair on the fixed chunk grid
+    (``max(engine_buckets)`` tokens — the context span a chunk attends
+    over is encoded in its page-vector SHAPE), plus
+    one ``engine_decode_paged.bin`` page-table decode; the KV pool is
+    ``engine_num_blocks`` (default ``batch * cache_len/block_size``,
+    HBM parity with the v3 arena) blocks of ``engine_block_size``
+    tokens. ``LMServer.engine()`` then schedules a
+    ``serving.PagedDecodeEngine`` (chunked prefill + prefix cache)
+    over them; v3 artifacts keep loading into the legacy slot engine.
     """
     import jax
     import jax.export  # noqa: F401 — jax.export needs an explicit import
@@ -165,8 +184,13 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
 
     # format-v3 engine programs: slot prefill per bucket + one vector-
     # position decode step with the sampler fused in (token ids are the
-    # only host-bound output)
+    # only host-bound output); format v4 swaps them for the PAGED pair
+    # (chunk prefill per chunk bucket + page-table decode)
     engine_members = {}
+    engine_paged_meta = None
+    if engine_paged and not engine_buckets:
+        raise ValueError("engine_paged=True needs engine_buckets= "
+                         "(the chunk buckets to export)")
     if engine_buckets:
         from paddle_tpu.serving import sampling as _sampling
         buckets = sorted({int(b) for b in engine_buckets})
@@ -174,26 +198,77 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
         if bad:
             raise ValueError(f"engine_buckets {bad} outside "
                              f"[1, cache_len={cache_len}]")
-        eng_prefill, eng_decode = _sampling.engine_step_fns(
-            cfg, dequant=(ops_q8.dequantize_tree if weights_int8
-                          else None))
+        dequant = ops_q8.dequantize_tree if weights_int8 else None
         i32 = jax.ShapeDtypeStruct((), jnp.int32)
         f32 = jax.ShapeDtypeStruct((), jnp.float32)
-        for b in buckets:
-            ep = jax.export.export(jax.jit(eng_prefill), **kw)(
-                p_shapes, cache_shapes,
-                jax.ShapeDtypeStruct((1, b), jnp.int32),
-                i32, i32, f32, i32, i32)
-            engine_members[f"engine_prefill_{b}.bin"] = ep.serialize()
-        eng_decode_args = (
-            p_shapes, cache_shapes,
-            jax.ShapeDtypeStruct((batch,), jnp.int32),
-            jax.ShapeDtypeStruct((batch,), jnp.int32),
-            jax.ShapeDtypeStruct((batch,), jnp.bool_),
-            jax.ShapeDtypeStruct((batch,), jnp.float32),
-            jax.ShapeDtypeStruct((batch,), jnp.int32), i32)
+
+        def _vec(dt):
+            return jax.ShapeDtypeStruct((batch,), dt)
+
+        def _eng_decode_args(kv_shapes, *extra):
+            # shared decode signature (tokens, pos, active, [pages,]
+            # temperature, top_k, seed) — one spot to extend for both
+            # the slot and paged exports
+            return (p_shapes, kv_shapes, _vec(jnp.int32),
+                    _vec(jnp.int32), _vec(jnp.bool_), *extra,
+                    _vec(jnp.float32), _vec(jnp.int32), i32)
+        if engine_paged:
+            bs = int(engine_block_size)
+            if bs < 1 or cache_len % bs:
+                raise ValueError(f"cache_len {cache_len} must be a "
+                                 f"positive multiple of "
+                                 f"engine_block_size {bs}")
+            pages = cache_len // bs
+            nb = int(engine_num_blocks if engine_num_blocks is not None
+                     else batch * pages)
+            chunk = max(buckets)        # the engine's prefill chunk grid
+            if chunk % bs or cache_len % chunk:
+                raise ValueError(
+                    f"paged export needs block_size {bs} | chunk "
+                    f"{chunk} | cache_len {cache_len} (each dividing "
+                    f"the next): the chunk grid anchors the exported "
+                    f"context spans")
+            engine_paged_meta = {"block_size": bs, "num_blocks": nb,
+                                 "pages_per_slot": pages,
+                                 "chunk_tokens": chunk}
+            eng_prefill, eng_decode = _sampling.paged_step_fns(
+                cfg, bs, dequant=dequant)
+            pool_shapes = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                transformer.init_block_pool(cfg, nb, bs))
+            # one chunk-prefill module per (bucket, context span) the
+            # fixed chunk grid can reach: a chunk's context length is
+            # encoded in its page-vector SHAPE (span specialization —
+            # cold chunks attend over C tokens, not cache_len), so each
+            # (C, P) pair is its own AOT program
+            for ctx in range(0, cache_len, chunk):
+                for b in buckets:
+                    pv = ctx // bs + -(-b // bs)
+                    ep = jax.export.export(jax.jit(eng_prefill), **kw)(
+                        p_shapes, pool_shapes,
+                        jax.ShapeDtypeStruct((1, b), jnp.int32), i32,
+                        jax.ShapeDtypeStruct((pv,), jnp.int32),
+                        f32, i32, i32)
+                    engine_members[
+                        f"engine_prefill_paged_{b}_{pv}.bin"] = \
+                        ep.serialize()
+            eng_decode_args = _eng_decode_args(
+                pool_shapes,
+                jax.ShapeDtypeStruct((batch, pages), jnp.int32))
+            eng_decode_member = "engine_decode_paged.bin"
+        else:
+            eng_prefill, eng_decode = _sampling.engine_step_fns(
+                cfg, dequant=dequant)
+            for b in buckets:
+                ep = jax.export.export(jax.jit(eng_prefill), **kw)(
+                    p_shapes, cache_shapes,
+                    jax.ShapeDtypeStruct((1, b), jnp.int32),
+                    i32, i32, f32, i32, i32)
+                engine_members[f"engine_prefill_{b}.bin"] = ep.serialize()
+            eng_decode_args = _eng_decode_args(cache_shapes)
+            eng_decode_member = "engine_decode.bin"
         jit_eng_decode = jax.jit(eng_decode)
-        engine_members["engine_decode.bin"] = jax.export.export(
+        engine_members[eng_decode_member] = jax.export.export(
             jit_eng_decode, **kw)(*eng_decode_args).serialize()
 
     # per-phase cost accounting, stamped into the artifact at export
@@ -213,14 +288,16 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
         # quantized artifacts carry nested {"q8","scale"} params — a v2
         # encoding; plain artifacts stay v1 for older loaders; engine
         # modules (whose member names older loaders would not recognise)
-        # bump to v3
-        "format_version": 3 if engine_buckets
+        # bump to v3; paged engine modules to v4
+        "format_version": (4 if engine_paged else 3) if engine_buckets
         else (2 if weights_int8 else 1),
         "batch": batch, "prompt_len": prompt_len, "cache_len": cache_len,
         "weights_int8": weights_int8, "config": _cfg_to_dict(cfg),
         "cost_analysis": cost_analysis}
     if engine_buckets:
         meta["engine_buckets"] = buckets
+    if engine_paged_meta:
+        meta["engine_paged"] = engine_paged_meta
     flat = _flatten(params)
     buf = _io.BytesIO()
     np.savez(buf, **flat)
@@ -314,22 +391,77 @@ class LMServer:
                             host=host, port=port)
 
     def engine(self, *, seed: Optional[int] = None, registry=None,
-               tracker=None):
-        """Continuous-batching ``serving.DecodeEngine`` over this
-        artifact's format-v3 modules (one compiled slot-prefill per
-        prompt bucket + one vector-position decode with on-device
-        sampling). Raises on v1/v2 artifacts — re-export with
+               tracker=None, chunk_tokens: Optional[int] = None):
+        """Continuous-batching engine over this artifact's modules:
+        a ``serving.PagedDecodeEngine`` for format-v4 artifacts (paged
+        block pool + chunked prefill + prefix cache; the chunk grid is
+        the artifact's — ``chunk_tokens`` may only restate it, the
+        prefill modules are span-specialized), the legacy
+        ``serving.DecodeEngine`` for format-v3 (whole-row arena).
+        Raises on v1/v2 artifacts — re-export with
         ``engine_buckets=`` to serve continuously; ``generate()`` stays
         the lockstep fallback either way."""
         import jax.export
         import jax.numpy as jnp
-        from paddle_tpu.serving.engine import DecodeEngine
+        from paddle_tpu.serving.engine import (DecodeEngine,
+                                               PagedDecodeEngine)
         if not self._engine_bins:
             raise ValueError(
                 f"artifact (format v{self.meta['format_version']}) has "
                 f"no engine modules — re-export with "
                 f"save_lm_artifact(..., engine_buckets=(...)) for "
                 f"continuous batching")
+        cfg = self.cfg
+        paged = self.meta.get("engine_paged")
+        if paged:
+            meta_chunk = int(paged.get("chunk_tokens",
+                                       max(self.engine_buckets)))
+            if chunk_tokens is not None and int(chunk_tokens) != \
+                    meta_chunk:
+                raise ValueError(
+                    f"artifact exported on a chunk grid of "
+                    f"{meta_chunk} tokens (its prefill modules are "
+                    f"(bucket, context-span)-specialized); "
+                    f"chunk_tokens={chunk_tokens} has no programs — "
+                    f"re-export to change the grid")
+            prefills = {}
+            for name, blob in self._engine_bins.items():
+                if not name.startswith("engine_prefill_paged_"):
+                    continue
+                b, pv = name[len("engine_prefill_paged_"):
+                             -len(".bin")].split("_")
+                prefills[(int(b), int(pv))] = \
+                    jax.export.deserialize(blob).call
+            decode = jax.export.deserialize(
+                self._engine_bins["engine_decode_paged.bin"]).call
+
+            def prefill(params, pool, tokens, length, pagevec, *rest):
+                key = (tokens.shape[1], pagevec.shape[0])
+                return prefills[key](params, pool, tokens, length,
+                                     pagevec, *rest)
+
+            # zero-filled block pool straight from the meta (no model
+            # code — config + pool geometry determine the shape)
+            shape = (cfg.n_layers,
+                     paged["num_blocks"] * paged["block_size"],
+                     cfg.kv_heads, cfg.head_dim)
+            pool = {"k": jnp.zeros(shape, cfg.dtype),
+                    "v": jnp.zeros(shape, cfg.dtype)}
+            return PagedDecodeEngine(
+                prefill, decode, self.params, pool,
+                batch=self.meta["batch"],
+                cache_len=self.meta["cache_len"],
+                block_size=paged["block_size"],
+                num_blocks=paged["num_blocks"],
+                chunk_tokens=meta_chunk,
+                chunk_buckets=self.engine_buckets, seed=seed,
+                registry=registry, tracker=tracker)
+        if chunk_tokens is not None:
+            raise ValueError(
+                f"chunk_tokens={chunk_tokens}: this artifact (format "
+                f"v{self.meta['format_version']}) has no paged engine "
+                f"modules, so prefill cannot be chunked — re-export "
+                f"with save_lm_artifact(..., engine_paged=True)")
         prefills = {b: jax.export.deserialize(
             self._engine_bins[f"engine_prefill_{b}.bin"]).call
             for b in self.engine_buckets}
@@ -342,7 +474,6 @@ class LMServer:
 
         # zero-filled KV arena straight from the meta (no model code —
         # the shape is determined by the config alone)
-        cfg = self.cfg
         shape = (cfg.n_layers, self.meta["batch"], self.meta["cache_len"],
                  cfg.kv_heads, cfg.head_dim)
         cache = {"k": jnp.zeros(shape, cfg.dtype),

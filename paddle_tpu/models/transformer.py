@@ -413,6 +413,20 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
 
+def init_block_pool(cfg: TransformerConfig, num_blocks: int,
+                    block_size: int):
+    """Paged KV pool for the block-table decode engine:
+    [L, num_blocks * block_size, kv_heads, Dh] per k/v. Block ``i`` owns
+    the aligned span ``[i*block_size, (i+1)*block_size)`` of the flat
+    position axis; per-slot page tables (``serving/blocks.BlockPool``)
+    map logical positions onto blocks, so HBM is committed per BLOCK
+    actually written instead of ``cache_len`` per arena row."""
+    shape = (cfg.n_layers, int(num_blocks) * int(block_size),
+             cfg.kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
 def prefill(params, tokens: jax.Array, cfg: TransformerConfig,
             cache_len: int, *, mesh: Optional[Mesh] = None):
     """Batched prompt ingestion: the SAME traced block the training path
@@ -613,6 +627,261 @@ def decode_step_slots(params, cache, tokens: jax.Array, pos: jax.Array,
                                (params["blocks"], cache["k"], cache["v"]))
     x = _layer_norm(x, params["ln_f"], params["ln_f_b"])
     logits = jnp.einsum("bd,vd->bv", x.astype(jnp.float32),
+                        params["embed"].astype(jnp.float32))
+    return logits, {"k": kn, "v": vn}
+
+
+def decode_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
+                      active: jax.Array, pages: jax.Array,
+                      cfg: TransformerConfig, *, block_size: int):
+    """One incremental step over the PAGED block pool: tokens [B] int32,
+    ``pos`` [B] int32, ``active`` [B] bool, ``pages`` [B, P] int32 block
+    ids → (logits [B, vocab] fp32, updated pool).
+
+    The block-table variant of ``decode_step_slots``: the cache is the
+    flat pool ``init_block_pool`` builds ([L, M, Hkv, Dh] with
+    M = num_blocks·block_size) and each slot reads its KV through a
+    gathered logical view ``[B, T]`` (T = P·block_size) built from its
+    page vector — every shape static, so the engine still compiles the
+    decode step exactly ONCE for any paging. Row b writes its new k/v at
+    the physical index ``pages[b, pos[b]//bs]·bs + pos[b]%bs`` via a
+    scatter whose inactive rows target an out-of-bounds index and are
+    DROPPED (mode="drop") — admission/recycling can't perturb in-flight
+    neighbours, matching ``decode_step_slots``'s inactive-row contract.
+
+    For a slot whose pages tile a contiguous span (the identity mapping)
+    the gathered view IS the old arena row, T equals the arena's
+    cache_len, and every elementwise/reduction shape matches
+    ``decode_step_slots`` — logits and written cache values are bitwise
+    identical (pinned in tests/test_paged_engine.py), so the two decode
+    paths cannot drift."""
+    B = tokens.shape[0]
+    P = pages.shape[1]
+    bs = int(block_size)
+    T = P * bs
+    H, Dh = cfg.n_heads, cfg.head_dim
+    Hkv = cfg.kv_heads
+    kvd = Hkv * Dh
+    M = cache["k"].shape[1]
+    pos = jnp.asarray(pos, jnp.int32)
+    pages = jnp.asarray(pages, jnp.int32)
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    if not cfg.use_rope:
+        x = x + jnp.take(params["pos"], pos, axis=0).astype(cfg.dtype)
+    rope_tabs = _rope_tables(pos, Dh, cfg.rope_theta) \
+        if cfg.use_rope else None
+    # logical->physical index map per slot [B, T]: page-strided spans
+    gidx = (pages[:, :, None] * bs
+            + jnp.arange(bs, dtype=jnp.int32)[None, None, :]
+            ).reshape(B, T)
+    # physical write index per row; inactive rows aim out of bounds so
+    # the scatter drops them (the paged analog of the where()-write)
+    wpage = jnp.take_along_axis(pages, (pos // bs)[:, None],
+                                axis=1)[:, 0]
+    widx = jnp.where(active, wpage * bs + pos % bs, M)
+    attend = (jnp.arange(T, dtype=jnp.int32)[None, :]
+              <= pos[:, None])                           # [B, T] logical
+
+    def block(x, scanned):
+        w, kc, vc = scanned                  # kc/vc [M, Hkv, Dh]
+        h = _layer_norm(x, w["ln1"], w["ln1_b"])
+        qkv = h @ w["qkv"].astype(h.dtype)   # [B, D + 2*kvd]
+        q, k, v = jnp.split(qkv, [H * Dh, H * Dh + kvd], axis=-1)
+        if cfg.use_rope:
+            q = _rope_rows(q.reshape(B, H, Dh), rope_tabs).reshape(
+                B, H * Dh)
+            k = _rope_rows(k.reshape(B, Hkv, Dh), rope_tabs).reshape(
+                B, kvd)
+        kc = kc.at[widx].set(k.reshape(B, Hkv, Dh).astype(kc.dtype),
+                             mode="drop")
+        vc = vc.at[widx].set(v.reshape(B, Hkv, Dh).astype(vc.dtype),
+                             mode="drop")
+        kt = jnp.take(kc, gidx, axis=0)      # [B, T, Hkv, Dh] logical view
+        vt = jnp.take(vc, gidx, axis=0)
+        g = H // Hkv
+        q32 = q.reshape(B, Hkv, g, Dh).astype(jnp.float32)
+        s = jnp.einsum("bkgd,btkd->bkgt", q32,
+                       kt.astype(jnp.float32)) / math.sqrt(Dh)
+        s = jnp.where(attend[:, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bkgt,btkd->bkgd", p, vt.astype(jnp.float32))
+        attn = attn.reshape(B, cfg.d_model).astype(cfg.dtype)
+        x = x + attn @ w["attn_out"].astype(attn.dtype)
+        h2 = _layer_norm(x, w["ln2"], w["ln2_b"])
+        if cfg.moe_experts:
+            import dataclasses as _dc
+
+            from paddle_tpu.parallel import moe
+            mc = _dc.replace(cfg.moe_cfg(), capacity_factor=float(
+                cfg.moe_experts) / cfg.moe_top_k)
+            out, _ = moe.moe_ffn(
+                {"gate": w["gate"], "w_in": w["moe_w_in"],
+                 "w_out": w["moe_w_out"]}, h2, mc)
+            x = x + out.astype(x.dtype)
+        else:
+            ff = jax.nn.gelu(h2 @ w["mlp_in"].astype(h2.dtype))
+            x = x + ff @ w["mlp_out"].astype(ff.dtype)
+        return x, (kc, vc)
+
+    x, (kn, vn) = jax.lax.scan(block, x,
+                               (params["blocks"], cache["k"], cache["v"]))
+    x = _layer_norm(x, params["ln_f"], params["ln_f_b"])
+    logits = jnp.einsum("bd,vd->bv", x.astype(jnp.float32),
+                        params["embed"].astype(jnp.float32))
+    return logits, {"k": kn, "v": vn}
+
+
+def prefill_into_blocks(params, cache, tokens: jax.Array,
+                        length: jax.Array, pages: jax.Array,
+                        cfg: TransformerConfig, *, block_size: int):
+    """Prefill ONE CHUNK of one request's prompt into its pages of the
+    block pool.
+
+    tokens [1, C] is a chunk of the prompt right-padded to a chunk
+    bucket C; ``length`` (scalar int32, traced) counts its valid
+    tokens; ``pages`` [P] int32 is the PREFIX of the slot's page vector
+    covering context + chunk — the chunk occupies the LAST
+    ``ceil(C/block_size)`` pages, so the tokens already resident for
+    this slot (prefix-cache hits + earlier chunks) number
+    ``ctx = (P - ceil(C/block_size)) * block_size``, a STATIC property
+    of the argument shapes. The engine keeps ctx block-aligned by
+    construction (hits and chunk boundaries are multiples of the chunk
+    size). Returns (logits at global position ``ctx + length - 1``
+    [1, vocab] fp32, updated pool).
+
+    The layer scan carries NOTHING pool-sized: the context KV is
+    gathered ONCE up front ([L, ctx, Hkv, Dh], read-only per-layer
+    inputs), each layer attends over ``concat(context, chunk)`` with the
+    context fully visible and the chunk causally masked, and the chunk's
+    KV lands in the pool post-scan as one masked contiguous-span
+    ``dynamic_update_slice`` per chunk page (padded rows write back the
+    span's old bytes). Cold prompts (ctx = 0) therefore cost
+    the same as a slot prefill of the same bucket instead of dragging
+    the whole arena view through every layer, and the per-chunk price
+    scales with ``C · (ctx + C)``, not ``C · cache_len``.
+
+    Compile discipline: one compile per (chunk bucket, context pages)
+    shape pair — a fixed chunk grid, so a prompt of any length costs
+    ``ceil(Tp/chunk)`` compiled calls interleaved with decode steps
+    instead of one monolithic stall. Because the engine's chunk grid is
+    deterministic and prefix-cache hits are chunk-aligned, a hit replay
+    runs bitwise the cold prefill's programs on bitwise the cold
+    prefill's values (pinned in tests/test_paged_engine.py)."""
+    if tokens.shape[0] != 1:
+        raise ValueError(f"prefill_into_blocks takes one request "
+                         f"([1, C] tokens), got {tokens.shape}")
+    C = tokens.shape[1]
+    bs = int(block_size)
+    P = pages.shape[0]
+    pc = -(-C // bs)                    # pages the chunk itself spans
+    S = (P - pc) * bs                   # static context length
+    if S < 0:
+        raise ValueError(f"pages vector ({P}) shorter than the chunk's "
+                         f"own span ({pc} pages for C={C})")
+    H, Dh = cfg.n_heads, cfg.head_dim
+    Hkv = cfg.kv_heads
+    kvd = Hkv * Dh
+    length = jnp.asarray(length, jnp.int32)
+    pages = jnp.asarray(pages, jnp.int32)
+    gpos = S + jnp.arange(C, dtype=jnp.int32)            # [C] global
+    x = jnp.take(params["embed"], tokens[0], axis=0).astype(cfg.dtype)
+    if not cfg.use_rope:
+        # clip keeps padded rows (whose writes drop anyway) in range
+        x = x + jnp.take(params["pos"],
+                         jnp.minimum(gpos, params["pos"].shape[0] - 1),
+                         axis=0).astype(cfg.dtype)
+    rope_tabs = _rope_tables(gpos, Dh, cfg.rope_theta) \
+        if cfg.use_rope else None
+    valid = jnp.arange(C, dtype=jnp.int32) < length
+    # context gather (once, all layers): every context position is real
+    # (ctx tokens were written by hits/earlier chunks), no mask needed
+    gidx = (pages[:P - pc, None] * bs
+            + jnp.arange(bs, dtype=jnp.int32)[None, :]).reshape(S)
+    ctx_k = jnp.take(cache["k"], gidx, axis=1)  # [L, S, Hkv, Dh]
+    ctx_v = jnp.take(cache["v"], gidx, axis=1)
+    # [C, S+C] mask: context fully visible, chunk causally masked
+    attend = jnp.concatenate(
+        [jnp.ones((C, S), bool),
+         jnp.tril(jnp.ones((C, C), bool))], axis=1)
+
+    def block(x, scanned):
+        w, ck, cv = scanned                  # ck/cv [S, Hkv, Dh] (read)
+        h = _layer_norm(x, w["ln1"], w["ln1_b"])
+        qkv = h @ w["qkv"].astype(h.dtype)   # [C, D + 2*kvd]
+        q, k, v = jnp.split(qkv, [H * Dh, H * Dh + kvd], axis=-1)
+        if cfg.use_rope:
+            q = _rope_rows(q.reshape(C, H, Dh), rope_tabs).reshape(
+                C, H * Dh)
+            k = _rope_rows(k.reshape(C, Hkv, Dh), rope_tabs).reshape(
+                C, kvd)
+        kck = k.reshape(C, Hkv, Dh)
+        vck = v.reshape(C, Hkv, Dh)
+        kall = jnp.concatenate([ck.astype(jnp.float32),
+                                kck.astype(jnp.float32)], axis=0)
+        vall = jnp.concatenate([cv.astype(jnp.float32),
+                                vck.astype(jnp.float32)], axis=0)
+        g = H // Hkv
+        q32 = q.reshape(C, Hkv, g, Dh).astype(jnp.float32)
+        s = jnp.einsum("ckgd,tkd->ckgt", q32, kall) / math.sqrt(Dh)
+        s = jnp.where(attend[:, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("ckgt,tkd->ckgd", p, vall)
+        attn = attn.reshape(C, cfg.d_model).astype(cfg.dtype)
+        x = x + attn @ w["attn_out"].astype(attn.dtype)
+        h2 = _layer_norm(x, w["ln2"], w["ln2_b"])
+        if cfg.moe_experts:
+            import dataclasses as _dc
+
+            from paddle_tpu.parallel import moe
+            # inference capacity (cf = E/k): prefill must not drop
+            # tokens the way Switch training capacity does
+            mc = _dc.replace(cfg.moe_cfg(), capacity_factor=float(
+                cfg.moe_experts) / cfg.moe_top_k)
+            out, _ = moe.moe_ffn(
+                {"gate": w["gate"], "w_in": w["moe_w_in"],
+                 "w_out": w["moe_w_out"]}, h2, mc)
+            x = x + out.astype(x.dtype)
+        else:
+            ff = jax.nn.gelu(h2 @ w["mlp_in"].astype(h2.dtype))
+            x = x + ff @ w["mlp_out"].astype(ff.dtype)
+        return x, (kck.astype(cache["k"].dtype),
+                   vck.astype(cache["v"].dtype))
+
+    x, (ks, vs) = jax.lax.scan(block, x,
+                               (params["blocks"], ctx_k, ctx_v))
+    # pool write for the whole chunk, all layers (ks [L, C, Hkv, Dh]):
+    # one masked read-modify-write of the CONTIGUOUS bs-token span per
+    # chunk page — dynamic_update_slice, not a scatter (a [C]-index
+    # scatter into the flat pool is several ms slower per call on CPU).
+    # Padded rows write back the span's old bytes, the RMW equivalent
+    # of the scatter's mode="drop".
+    pad = pc * bs - C
+    if pad:
+        ks = jnp.pad(ks, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        vs = jnp.pad(vs, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        vfull = jnp.concatenate([valid, jnp.zeros((pad,), bool)])
+    else:
+        vfull = valid
+    kn, vn = cache["k"], cache["v"]
+    L = kn.shape[0]
+    for j in range(pc):
+        dst = pages[P - pc + j] * bs
+        vmask = vfull[j * bs:(j + 1) * bs][None, :, None, None]
+        kj = ks[:, j * bs:(j + 1) * bs]
+        vj = vs[:, j * bs:(j + 1) * bs]
+        old_k = jax.lax.dynamic_slice(kn, (0, dst, 0, 0),
+                                      (L, bs, Hkv, Dh))
+        old_v = jax.lax.dynamic_slice(vn, (0, dst, 0, 0),
+                                      (L, bs, Hkv, Dh))
+        kn = jax.lax.dynamic_update_slice(
+            kn, jnp.where(vmask, kj, old_k), (0, dst, 0, 0))
+        vn = jax.lax.dynamic_update_slice(
+            vn, jnp.where(vmask, vj, old_v), (0, dst, 0, 0))
+    # only the last VALID chunk position feeds the vocab head (the
+    # gather-head discipline of prefill_into_slot)
+    x = jnp.take(x, jnp.reshape(jnp.maximum(length - 1, 0), (1,)), axis=0)
+    x = _layer_norm(x, params["ln_f"], params["ln_f_b"])
+    logits = jnp.einsum("td,vd->tv", x.astype(jnp.float32),
                         params["embed"].astype(jnp.float32))
     return logits, {"k": kn, "v": vn}
 

@@ -1,19 +1,30 @@
-"""Continuous-batching LM serving (slot-based KV arena + scheduler).
+"""Continuous-batching LM serving (paged block-table KV + scheduler).
 
 Public surface:
 
-- :class:`~paddle_tpu.serving.engine.DecodeEngine` — the scheduler
-  (FIFO admission, slot recycling, bucketed prefill, on-device
-  sampling); build via ``DecodeEngine.from_params`` or a format-v3
+- :class:`~paddle_tpu.serving.engine.PagedDecodeEngine` — the paged
+  engine (block-pool KV, chunked prefill interleaved with decode,
+  content-hash prefix cache with refcounted blocks + LRU eviction);
+  build via ``PagedDecodeEngine.from_params`` or a format-v4
   artifact's ``LMServer.engine()``.
+- :class:`~paddle_tpu.serving.engine.DecodeEngine` — the legacy
+  row-per-request arena engine (FIFO admission, slot recycling,
+  bucketed whole-prompt prefill); format-v3 artifacts load here.
 - :class:`~paddle_tpu.serving.engine.EngineRequest` — per-request
-  lifecycle record (tokens, TTFT, latency, finish reason).
+  lifecycle record (tokens, TTFT, latency, finish reason,
+  prefix_hit_tokens).
+- :class:`~paddle_tpu.serving.blocks.BlockPool` — host-side block
+  allocator / prefix cache the paged engine schedules over.
 - :func:`~paddle_tpu.serving.sampling.sample_tokens` /
-  :func:`~paddle_tpu.serving.sampling.engine_step_fns` — the pure step
+  :func:`~paddle_tpu.serving.sampling.engine_step_fns` /
+  :func:`~paddle_tpu.serving.sampling.paged_step_fns` — the pure step
   programs (greedy / temperature / top-k inside the compiled step).
 """
 
+from paddle_tpu.serving.blocks import (  # noqa: F401
+    BlockPool, chain_hash, prompt_block_hashes)
 from paddle_tpu.serving.engine import (  # noqa: F401
-    DEFAULT_PREFILL_BUCKETS, DecodeEngine, EngineRequest)
+    DEFAULT_PREFILL_BUCKETS, DecodeEngine, EngineRequest,
+    PagedDecodeEngine, default_chunk_buckets)
 from paddle_tpu.serving.sampling import (  # noqa: F401
-    engine_step_fns, sample_tokens)
+    engine_step_fns, paged_step_fns, sample_tokens)

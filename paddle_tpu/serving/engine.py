@@ -30,6 +30,13 @@ queue-wait and time-to-first-token histograms, slot-occupancy and
 queue-depth gauges, token/step counters, per-request goodput — and
 ``serve()`` exposes them on the standard ``/metrics`` + ``/healthz``
 endpoints (``observe/health.py``).
+
+:class:`PagedDecodeEngine` supersedes the row-per-request arena with a
+block-table KV layout (paged pool + per-slot page vectors, chunked
+prefill interleaved with decode, content-hash prefix cache with
+refcounted blocks and LRU eviction) — see its docstring;
+:class:`DecodeEngine` remains the legacy whole-row engine that
+format-v3 artifacts load into.
 """
 
 import dataclasses
@@ -67,8 +74,13 @@ class EngineRequest:
     # -- lifecycle (filled by the engine) --------------------------------
     bucket: int = 0
     slot: int = -1
+    prefix_hit_tokens: int = 0          # prompt tokens served from the
+    #                                     prefix cache (paged engine)
+    block_hashes: Optional[List[bytes]] = None  # prompt block digests,
+    #                                     memoized at first admission try
     tokens: List[int] = dataclasses.field(default_factory=list)
-    status: str = "queued"              # queued | running | done
+    status: str = "queued"              # queued | prefilling (paged,
+    #                                     mid-chunk) | running | done
     finish_reason: Optional[str] = None  # eos | max_tokens
     submit_t: float = 0.0
     prefill_t: Optional[float] = None
@@ -304,19 +316,39 @@ class DecodeEngine:
             self._topk[slot] = req.top_k
         self._m_queue.set(len(self._queue))
 
+    # hooks the paged subclass specializes -------------------------------
+    def _schedule(self, finished: List[EngineRequest]):
+        """Admission (and, for the paged engine, prefill-chunk) work
+        that runs before the decode step."""
+        self._admit(finished)
+
+    def _pre_decode(self):
+        """Host bookkeeping needed before a decode step may run (the
+        paged engine allocates write pages here)."""
+
+    def _decode_extra(self):
+        """Extra decode-program args inserted after ``active`` (the
+        paged engine's page table)."""
+        return ()
+
+    def _update_gauges(self):
+        self._m_occupancy.set(self.active_count)
+
     def step(self) -> List[EngineRequest]:
         """One scheduler iteration: admit waiting requests into free
         slots, run one batched decode step for everything in flight.
         Returns the requests that finished during this step."""
         finished: List[EngineRequest] = []
-        self._admit(finished)
+        self._schedule(finished)
         if self._active.any():
             jnp = self._jnp
+            self._pre_decode()
             t0 = time.perf_counter()
             nxt, self.cache = self._tracker.track_call(
                 "serving_engine.decode", self._decode_fn,
                 self.params, self.cache, jnp.asarray(self._last),
                 jnp.asarray(self._pos), jnp.asarray(self._active),
+                *self._decode_extra(),
                 jnp.asarray(self._temp), jnp.asarray(self._topk),
                 self._seed())
             nxt = np.asarray(nxt)       # the only device->host transfer:
@@ -330,7 +362,7 @@ class DecodeEngine:
                 self._last[slot] = tok
                 if self._emit(req, tok, now):
                     finished.append(req)
-        self._m_occupancy.set(self.active_count)
+        self._update_gauges()
         return finished
 
     def run_until_idle(self, max_steps: int = 100_000
@@ -376,3 +408,450 @@ class DecodeEngine:
         programs — the "one per bucket + one for decode" invariant."""
         return {"prefill": self._tracker.count("serving_engine.prefill"),
                 "decode": self._tracker.count("serving_engine.decode")}
+
+
+def default_chunk_buckets(chunk_tokens: int) -> tuple:
+    """Power-of-two chunk buckets up to ``chunk_tokens`` (which is
+    always included): a prompt's tail chunk pads to the smallest
+    covering bucket instead of the full chunk size."""
+    out, b = {int(chunk_tokens)}, 8
+    while b < chunk_tokens:
+        out.add(b)
+        b *= 2
+    return tuple(sorted(out))
+
+
+class PagedDecodeEngine(DecodeEngine):
+    """Block-table continuous batching: paged KV, chunked prefill,
+    prefix cache.
+
+    Replaces the row-per-request arena with a block POOL
+    (``models/transformer.init_block_pool``): HBM is committed per
+    ``block_size``-token block actually written — a request holds
+    ``ceil((Tp + max_new)/block_size)`` blocks instead of a whole
+    ``cache_len`` row — and the pool can be sized independently of
+    ``batch``. On top of the pool:
+
+    - **chunked prefill** — prompts are admitted in ``chunk_tokens``
+      chunks (``transformer.prefill_into_blocks``), ONE chunk per
+      ``step()`` interleaved with the batched decode step, so a long
+      prompt no longer stalls in-flight decoders for its full duration,
+      and any prompt with ``Tp + max_new <= cache_len`` is accepted (no
+      largest-bucket rejection);
+    - **prefix cache** — full prompt blocks are published under
+      content-chain hashes (``serving/blocks``); a later prompt sharing
+      the prefix maps the cached blocks into its page table with a
+      refcount bump and skips their prefill compute. Refcount-0 cached
+      blocks park in an LRU and are evicted oldest-first under
+      allocation pressure. Hit decoding is bitwise the cold-prefill
+      decoding (the gathered KV values are identical).
+
+    Admission reserves a request's worst-case block count up front and
+    allocates lazily, so decode never stalls mid-flight on an empty
+    pool; a request that cannot reserve waits FIFO at the queue head.
+    Compile discipline: at most one compile per (chunk bucket, context
+    span) pair — the chunk grid is fixed at ``chunk_tokens``, so the
+    reachable spans are the multiples of ``chunk_tokens`` below
+    ``cache_len`` — plus ONE decode (same tracker names,
+    ``compile_counts()``). Span specialization is what keeps a COLD
+    chunk's attention at ``C x C`` instead of ``C x cache_len``.
+    """
+
+    def __init__(self, prefill: Callable, decode: Callable, params,
+                 cache, *, batch: int, cache_len: int, block_size: int,
+                 num_blocks: Optional[int] = None, chunk_tokens: int = 64,
+                 chunk_buckets: Optional[Sequence[int]] = None,
+                 seed: Optional[int] = None,
+                 registry: Optional[_metrics.Registry] = None,
+                 tracker: Optional[_ct.CompileTracker] = None):
+        from paddle_tpu.serving import blocks as _blocks
+        bs = int(block_size)
+        if bs < 1 or cache_len % bs:
+            raise ValueError(f"cache_len {cache_len} must be a positive "
+                             f"multiple of block_size {bs}")
+        chunk_tokens = min(int(chunk_tokens), int(cache_len))
+        if chunk_tokens < 1:
+            raise ValueError(f"chunk_tokens must be >= 1, "
+                             f"got {chunk_tokens}")
+        # the chunk grid anchors the static context spans: chunk
+        # boundaries (and therefore prefix-hit cutoffs) must land on
+        # block edges, and the grid must tile cache_len so every page
+        # vector a chunk needs fits in pages_per_slot
+        if chunk_tokens % bs:
+            raise ValueError(f"chunk_tokens {chunk_tokens} must be a "
+                             f"multiple of block_size {bs}")
+        if cache_len % chunk_tokens:
+            raise ValueError(f"cache_len {cache_len} must be a multiple "
+                             f"of chunk_tokens {chunk_tokens}")
+        if chunk_buckets is None:
+            chunk_buckets = default_chunk_buckets(chunk_tokens)
+        if tracker is None:
+            # the paged engine LEGITIMATELY compiles one prefill program
+            # per reachable (chunk bucket, context span) pair — raise
+            # the default tracker's storm threshold past that ceiling so
+            # normal chunk-grid traffic doesn't read as a recompile
+            # storm (a caller-supplied tracker keeps its own threshold)
+            spans = max(1, int(cache_len) // chunk_tokens)
+            tracker = _ct.CompileTracker(
+                storm_threshold=spans * len(tuple(chunk_buckets)) + 2)
+        super().__init__(prefill, decode, params, cache, batch=batch,
+                         cache_len=cache_len, buckets=chunk_buckets,
+                         seed=seed, registry=registry, tracker=tracker)
+        self.block_size = bs
+        self.pages_per_slot = cache_len // bs
+        self.num_blocks = int(num_blocks if num_blocks is not None
+                              else batch * self.pages_per_slot)
+        self.chunk_tokens = chunk_tokens
+        self.pool = _blocks.BlockPool(self.num_blocks, bs)
+        B = self.batch
+        # page table uploaded on change (most decode steps reuse the
+        # cached device copy); unallocated entries stay 0 and are only
+        # ever read under the attend mask
+        self._pages = np.zeros((B, self.pages_per_slot), np.int32)
+        self._pages_dev = None
+        self._nalloc = [0] * B              # pages allocated per slot
+        self._slot_blocks: List[List[int]] = [[] for _ in range(B)]
+        self._slot_hashes: List[List[bytes]] = [[] for _ in range(B)]
+        self._slot_off = [0] * B            # next prompt token to prefill
+        self._slot_reserved = [0] * B       # unallocated reservation left
+        self._slot_prefill_s = [0.0] * B    # device seconds across chunks
+        self._prefilling: deque = deque()   # slots mid-prompt, round-robin
+        self._evictions_seen = 0
+        reg = self.metrics
+        self._m_blocks_in_use = reg.gauge(
+            "engine_blocks_in_use", "pool blocks referenced by live "
+            "requests")
+        self._m_blocks_free = reg.gauge(
+            "engine_blocks_free", "pool blocks holding nothing (not "
+            "even evictable cached content)")
+        self._m_blocks_cached = reg.gauge(
+            "engine_blocks_cached", "refcount-0 prefix-cache blocks "
+            "parked in the LRU (evictable)")
+        self._m_prefix_hits = reg.counter(
+            "engine_prefix_cache_hit_blocks_total",
+            "prompt blocks served from the prefix cache (prefill "
+            "compute skipped)")
+        self._m_prefix_miss = reg.counter(
+            "engine_prefix_cache_miss_blocks_total",
+            "full prompt blocks that had to be prefilled")
+        self._m_evictions = reg.counter(
+            "engine_prefix_cache_evictions_total",
+            "cached blocks evicted LRU-oldest-first under allocation "
+            "pressure")
+        self._m_chunks = reg.counter(
+            "engine_prefill_chunks_total", "prefill chunk programs "
+            "executed (several per long prompt)")
+        self._m_stall = reg.histogram(
+            "engine_prefill_stall_seconds", "time in-flight decoders "
+            "were stalled by one prefill chunk (observed per chunk run "
+            "while any slot was decoding)", buckets=_LATENCY_BUCKETS)
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_params(cls, params, cfg, *, batch: int, cache_len: int,
+                    block_size: int = 16,
+                    num_blocks: Optional[int] = None,
+                    chunk_tokens: int = 64,
+                    chunk_buckets: Optional[Sequence[int]] = None,
+                    seed: Optional[int] = None, **kw):
+        """In-process paged engine: jit the chunk-prefill/paged-decode
+        programs against live params (the no-artifact path tests and
+        benchmarks drive)."""
+        import jax
+        from paddle_tpu.models import transformer
+        from paddle_tpu.serving import sampling
+        if cache_len > cfg.max_len:
+            raise ValueError(f"cache_len {cache_len} exceeds cfg.max_len "
+                             f"{cfg.max_len}")
+        if block_size < 1 or cache_len % block_size:
+            raise ValueError(f"cache_len {cache_len} must be a positive "
+                             f"multiple of block_size {block_size}")
+        nb = int(num_blocks if num_blocks is not None
+                 else batch * (cache_len // block_size))
+        prefill_fn, decode_fn = sampling.paged_step_fns(cfg, block_size)
+        pool = transformer.init_block_pool(cfg, nb, block_size)
+        return cls(jax.jit(prefill_fn), jax.jit(decode_fn), params, pool,
+                   batch=batch, cache_len=cache_len,
+                   block_size=block_size, num_blocks=nb,
+                   chunk_tokens=chunk_tokens, chunk_buckets=chunk_buckets,
+                   seed=seed, **kw)
+
+    # -- request API -------------------------------------------------------
+    def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
+               top_k: int = 0, eos_id: Optional[int] = None
+               ) -> EngineRequest:
+        """Queue one request. Unlike the row-arena engine there is no
+        largest-bucket rejection: any prompt with
+        ``len(prompt) + max_new <= cache_len`` is accepted and prefilled
+        in chunks."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("submit: empty prompt")
+        if max_new < 1:
+            raise ValueError(f"submit: max_new must be >= 1, "
+                             f"got {max_new}")
+        if prompt.size + max_new > self.cache_len:
+            raise ValueError(
+                f"submit: {prompt.size} prompt + {max_new} new tokens "
+                f"exceed cache_len {self.cache_len}")
+        need = -(-(prompt.size + max_new) // self.block_size)
+        if need > self.num_blocks:
+            # _admit reserves the worst-case block count up front; a
+            # request needing more blocks than the pool HAS could never
+            # reserve and would livelock the FIFO queue head forever
+            raise ValueError(
+                f"submit: {prompt.size} prompt + {max_new} new tokens "
+                f"need {need} blocks, exceeding the pool's "
+                f"{self.num_blocks}")
+        req = EngineRequest(
+            rid=next(self._ids), prompt=prompt, max_new=int(max_new),
+            temperature=float(temperature), top_k=int(top_k),
+            eos_id=eos_id, bucket=0, submit_t=time.perf_counter())
+        self._queue.append(req)
+        self._m_requests.inc()
+        self._m_queue.set(len(self._queue))
+        return req
+
+    @property
+    def idle(self) -> bool:
+        return (not self._queue and not self._prefilling
+                and not self._active.any())
+
+    # -- scheduler ---------------------------------------------------------
+    def _alloc_page(self, slot: int):
+        b = self.pool.alloc()
+        self._pages[slot, self._nalloc[slot]] = b
+        self._pages_dev = None
+        self._nalloc[slot] += 1
+        self._slot_blocks[slot].append(b)
+        self._slot_reserved[slot] -= 1
+
+    def _admit(self, finished: List[EngineRequest]):
+        from paddle_tpu.serving import blocks as _blocks
+        bs = self.block_size
+        while self._queue and self._free:
+            req = self._queue[0]
+            Tp = req.prompt.size
+            hashes = req.block_hashes
+            if hashes is None:      # computed once per request: the
+                #                     digests are a pure function of
+                #                     the prompt, and a reservation-
+                #                     blocked queue head re-enters here
+                #                     every step
+                hashes = _blocks.prompt_block_hashes(req.prompt, bs)
+                req.block_hashes = hashes
+            # cap hits CHUNK-aligned (not merely block-aligned): the
+            # post-hit chunks must replay the cold prefill's exact
+            # chunk grid for the bitwise hit-vs-cold guarantee, and at
+            # least the last prompt token is always recomputed — the
+            # final chunk must produce logits to sample from
+            per = self.chunk_tokens // bs
+            usable = ((Tp - 1) // self.chunk_tokens) * per
+            hits: List[int] = []
+            for h in hashes[:usable]:
+                b = self.pool.lookup(h)
+                if b is None:
+                    break
+                hits.append(b)
+            # a PARTIAL-chunk hit run must round DOWN to the chunk
+            # grid: starting prefill mid-chunk would reach (bucket,
+            # span) shapes off the exported grid — KeyError on v4
+            # artifacts, extra compiles in-process
+            hits = hits[:len(hits) // per * per]
+            need = -(-(Tp + req.max_new) // bs) - len(hits)
+            # hits parked refcount-0 in the LRU are about to be revived
+            # by share(): they leave the allocatable set, so the
+            # reservation must clear them TOO or a later lazy alloc()
+            # could find the pool exhausted despite its reservation
+            revive = sum(1 for b in hits if self.pool.refcount(b) == 0)
+            if not self.pool.can_reserve(need + revive):
+                break               # FIFO head-of-line: wait for blocks
+            self._queue.popleft()
+            slot = self._free.popleft()
+            self.pool.reserve(need)
+            for b in hits:
+                self.pool.share(b)
+            self._pages[slot, :] = 0
+            self._pages[slot, :len(hits)] = hits
+            self._pages_dev = None
+            self._nalloc[slot] = len(hits)
+            self._slot_blocks[slot] = list(hits)
+            self._slot_hashes[slot] = hashes
+            self._slot_off[slot] = len(hits) * bs
+            self._slot_reserved[slot] = need
+            self._slot_prefill_s[slot] = 0.0
+            req.prefix_hit_tokens = len(hits) * bs
+            self._m_prefix_hits.inc(len(hits))
+            # misses are counted as chunks actually run cold
+            # (_prefill_chunk): a block published by a CONCURRENT
+            # same-prefix request mid-prefill is adopted, not missed
+            now = time.perf_counter()
+            req.prefill_t = now
+            self._m_wait_s.observe(now - req.submit_t)
+            req.slot, req.status = slot, "prefilling"
+            self._slot_req[slot] = req
+            self._prefilling.append(slot)
+        self._m_queue.set(len(self._queue))
+
+    def _try_adopt(self, slot: int) -> bool:
+        """Map the slot's NEXT chunk straight onto cached blocks when
+        every block of it is already published — a CONCURRENT
+        same-prefix request cold-prefilled it after this one was
+        admitted. Shares the blocks, returns the reservation, skips the
+        chunk program entirely. Only whole chunk-aligned chunks below
+        the hit cap qualify, so the hit-vs-cold bitwise guarantee's
+        chunk grid is preserved."""
+        req = self._slot_req[slot]
+        off = self._slot_off[slot]
+        bs, K = self.block_size, self.chunk_tokens
+        cap = ((req.prompt.size - 1) // K) * K
+        if off % K or off >= cap:
+            return False
+        hashes = self._slot_hashes[slot]
+        first = off // bs
+        blocks = []
+        for j in range(first, first + K // bs):
+            b = self.pool.lookup(hashes[j])
+            if b is None:
+                return False
+            blocks.append(b)
+        for b in blocks:
+            self.pool.share(b)
+            self._pages[slot, self._nalloc[slot]] = b
+            self._nalloc[slot] += 1
+            self._slot_blocks[slot].append(b)
+        self._pages_dev = None
+        self.pool.unreserve(len(blocks))
+        self._slot_reserved[slot] -= len(blocks)
+        self._slot_off[slot] = off + K
+        req.prefix_hit_tokens += K
+        self._m_prefix_hits.inc(len(blocks))
+        return True
+
+    def _prefill_chunk(self, finished: List[EngineRequest]):
+        from paddle_tpu.core import ragged
+        jnp = self._jnp
+        slot = self._prefilling.popleft()
+        req = self._slot_req[slot]
+        while self._try_adopt(slot):
+            pass
+        off = self._slot_off[slot]
+        c = min(req.prompt.size - off, self.chunk_tokens)
+        bucket = ragged.bucket_length(c, self.buckets)
+        end_page = -(-(off + c) // self.block_size)
+        while self._nalloc[slot] < end_page:
+            self._alloc_page(slot)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :c] = req.prompt[off:off + c]
+        # the page-vector PREFIX covering context + chunk: its length
+        # (off/bs context pages + the bucket's own span) is what makes
+        # the chunk program span-specialized — a cold chunk attends
+        # over C tokens, not cache_len. Entries past the allocated
+        # count back only padding positions, whose writes drop.
+        npages = off // self.block_size + -(-bucket // self.block_size)
+        stalled = bool(self._active.any())
+        t0 = time.perf_counter()
+        tok, self.cache = self._tracker.track_call(
+            "serving_engine.prefill", self._prefill_fn,
+            self.params, self.cache, jnp.asarray(padded),
+            np.int32(c), jnp.asarray(self._pages[slot, :npages]),
+            np.float32(req.temperature), np.int32(req.top_k),
+            self._seed())
+        tok = int(np.asarray(tok))
+        now = time.perf_counter()
+        # accumulate per-chunk device time; the histogram observes one
+        # per-request total at the final chunk so its semantics match
+        # the row-arena engine's (chunk-grain timing lives in the stall
+        # histogram and engine_prefill_chunks_total)
+        self._slot_prefill_s[slot] += now - t0
+        self._m_chunks.inc()
+        if stalled:
+            self._m_stall.observe(now - t0)
+        # publish the chunk's fully-written prompt blocks NOW (not at
+        # prompt completion): a concurrent same-prefix request adopts
+        # them instead of re-prefilling — a burst of shared-prefix
+        # arrivals cold-prefills the prefix exactly once
+        for j in range(off // self.block_size,
+                       (off + c) // self.block_size):
+            self.pool.publish(self._slot_hashes[slot][j],
+                              int(self._pages[slot, j]))
+            self._m_prefix_miss.inc()
+        self._slot_off[slot] = off + c
+        if off + c < req.prompt.size:
+            self._prefilling.append(slot)   # round-robin: one chunk per
+            return                          # step, decode in between
+        # final chunk: emit the sampled first token
+        self._m_prefill_s.observe(self._slot_prefill_s[slot])
+        self._m_prefills.inc()
+        req.status = "running"
+        if self._emit(req, tok, now):
+            finished.append(req)            # blocks released by _finish;
+            return                          # published ones park in LRU
+        self._active[slot] = True
+        self._pos[slot] = req.prompt.size
+        self._last[slot] = tok
+        self._temp[slot] = req.temperature
+        self._topk[slot] = req.top_k
+
+    def _finish(self, req: EngineRequest, reason: str, now: float):
+        slot = req.slot
+        if slot >= 0:
+            for b in self._slot_blocks[slot]:
+                self.pool.release(b)
+            self.pool.unreserve(self._slot_reserved[slot])
+            self._slot_blocks[slot] = []
+            self._slot_hashes[slot] = []
+            self._slot_reserved[slot] = 0
+            self._nalloc[slot] = 0
+            self._pages[slot, :] = 0
+            self._pages_dev = None
+        super()._finish(req, reason, now)
+
+    def _schedule(self, finished: List[EngineRequest]):
+        self._admit(finished)
+        # With decoders in flight, at most ONE chunk runs per step —
+        # the stall a prefill inflicts on them is bounded by a single
+        # chunk program. With NOTHING decoding there is nobody to
+        # stall: drain chunks back-to-back (a burst of arrivals reaches
+        # its first tokens as fast as the row engine's monolithic
+        # prefill would) until a finished prompt activates a decoder.
+        while self._prefilling:
+            self._prefill_chunk(finished)
+            if finished:
+                self._admit(finished)   # a one-token request freed its
+                #                         slot mid-schedule
+            if self._active.any():
+                break
+
+    def _pre_decode(self):
+        # lazily allocate the page each active row is about to write
+        # (reservation at admission guarantees this never fails)
+        for slot in np.flatnonzero(self._active):
+            if self._pos[slot] // self.block_size >= self._nalloc[slot]:
+                self._alloc_page(slot)
+
+    def _decode_extra(self):
+        if self._pages_dev is None:
+            self._pages_dev = self._jnp.asarray(self._pages)
+        return (self._pages_dev,)
+
+    def _update_gauges(self):
+        super()._update_gauges()
+        pool = self.pool
+        self._m_blocks_in_use.set(pool.in_use)
+        self._m_blocks_free.set(pool.free_count)
+        self._m_blocks_cached.set(pool.cached_free_count)
+        if pool.evictions > self._evictions_seen:
+            self._m_evictions.inc(pool.evictions - self._evictions_seen)
+            self._evictions_seen = pool.evictions
+
+    # -- observability -----------------------------------------------------
+    def health(self) -> dict:
+        doc = super().health()
+        doc.update({"block_size": self.block_size,
+                    "blocks_total": self.num_blocks,
+                    "blocks_in_use": self.pool.in_use,
+                    "blocks_cached": self.pool.cached_free_count,
+                    "prefix_cache_entries": self.pool.cached_count,
+                    "chunk_tokens": self.chunk_tokens})
+        return doc

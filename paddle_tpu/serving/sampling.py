@@ -82,3 +82,47 @@ def engine_step_fns(cfg, dequant=None):
         return sample_tokens(logits, key, temperature, top_k), cache
 
     return prefill_fn, decode_fn
+
+
+def paged_step_fns(cfg, block_size: int, dequant=None):
+    """(prefill_chunk_fn, decode_fn) for the PAGED block-pool engine —
+    compiled once per chunk bucket / once for decode, and exported by
+    ``save_lm_artifact`` as the format-v4 modules.
+
+    prefill_fn(params, pool, tokens [1, C], length (), pages [P],
+               temperature (), top_k (), seed ()) → (token (), pool)
+    decode_fn(params, pool, tokens [B], pos [B], active [B] bool,
+              pages [B, P], temperature [B], top_k [B], seed ())
+              → (tokens [B], pool)
+
+    The chunk's context length is implied by the SHAPES: the pages
+    vector covers context + chunk, so each (chunk bucket, context
+    pages) pair is its own compiled program. Sampling runs inside both:
+    the prefill token only matters on a prompt's FINAL chunk (the
+    engine discards the others), but sampling unconditionally keeps the
+    exported signature uniform.
+    """
+    from paddle_tpu.models import transformer
+
+    def _live(params):
+        return dequant(params) if dequant is not None else params
+
+    def prefill_fn(params, pool, tokens, length, pages,
+                   temperature, top_k, seed):
+        logits, pool = transformer.prefill_into_blocks(
+            _live(params), pool, tokens, length, pages, cfg,
+            block_size=block_size)
+        key = jax.random.PRNGKey(seed)
+        tok = sample_tokens(logits, key, jnp.reshape(temperature, (1,)),
+                            jnp.reshape(top_k, (1,)))
+        return tok[0], pool
+
+    def decode_fn(params, pool, tokens, pos, active, pages, temperature,
+                  top_k, seed):
+        logits, pool = transformer.decode_step_paged(
+            _live(params), pool, tokens, pos, active, pages, cfg,
+            block_size=block_size)
+        key = jax.random.PRNGKey(seed)
+        return sample_tokens(logits, key, temperature, top_k), pool
+
+    return prefill_fn, decode_fn

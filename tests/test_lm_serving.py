@@ -251,6 +251,60 @@ def test_engine_artifact_v3_roundtrip(tmp_path, rng):
     assert eng.compile_counts() == {"prefill": 1, "decode": 1}
 
 
+def test_engine_artifact_v4_paged_roundtrip(tmp_path, rng):
+    """Format v4: paged engine modules ride the artifact; engine()
+    schedules a PagedDecodeEngine (chunked prefill + prefix cache) over
+    them, v4 still serves the legacy lockstep path, and a prompt beyond
+    any chunk bucket is accepted."""
+    import pytest
+    from paddle_tpu.observe.compile_tracker import CompileTracker
+    from paddle_tpu.serving import PagedDecodeEngine
+    params = transformer.init_params(jax.random.PRNGKey(0), CFG)
+    B, Tp, new = 2, 6, 8
+    prompt = rng.randint(0, 40, (B, Tp)).astype(np.int32)
+    path = str(tmp_path / "lm_v4.tar")
+    lm_serving.save_lm_artifact(path, params, CFG, batch=B,
+                                prompt_len=Tp, cache_len=32,
+                                engine_buckets=(8, 16),
+                                engine_paged=True, engine_block_size=8)
+    srv = lm_serving.load_lm_artifact(path)
+    assert srv.meta["format_version"] == 4
+    assert srv.meta["engine_paged"] == {
+        "block_size": 8, "num_blocks": 8, "pages_per_slot": 4,
+        "chunk_tokens": 16}
+    assert srv.cost_analysis["engine_decode"]["flops"] > 0
+    # legacy lockstep path unchanged on a v4 artifact
+    got = srv.generate(prompt, max_new=new)
+    want = np.asarray(transformer.generate(
+        params, jnp.asarray(prompt), CFG, max_new=new))
+    np.testing.assert_array_equal(got, want)
+    # paged engine path: same tokens, chunked long prompt included
+    tracker = CompileTracker()
+    eng = srv.engine(seed=0, tracker=tracker)
+    assert isinstance(eng, PagedDecodeEngine)
+    reqs = [eng.submit(prompt[i], max_new=new) for i in range(B)]
+    long_p = rng.randint(0, 40, 24).astype(np.int32)   # > max bucket 16
+    reqs.append(eng.submit(long_p, max_new=4))
+    eng.run_until_idle()
+    want_long = np.asarray(transformer.generate(
+        params, jnp.asarray(long_p[None]), CFG, max_new=4))[0]
+    for r, w in zip(reqs, list(want) + [want_long]):
+        np.testing.assert_array_equal(r.output, w)
+    assert eng.compile_counts()["decode"] == 1
+    # at most one program per (chunk bucket, context span) on the
+    # exported grid: buckets {8,16} x context {0,16} tokens
+    assert eng.compile_counts()["prefill"] <= 4
+    # replaying the long prompt hits its cached prefix blocks
+    r2 = eng.submit(long_p, max_new=4)
+    eng.run_until_idle()
+    assert r2.prefix_hit_tokens == 16
+    np.testing.assert_array_equal(r2.output, want_long)
+    # the chunk grid is baked into the artifact's module shapes —
+    # engine() refuses to schedule a different one
+    with pytest.raises(ValueError, match="chunk grid"):
+        srv.engine(chunk_tokens=8)
+
+
 def test_engine_requires_v3(tmp_path, rng):
     """v1/v2 artifacts refuse engine() with a re-export hint."""
     import pytest

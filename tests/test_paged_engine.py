@@ -1,0 +1,418 @@
+"""Paged KV engine: block-table decode must be bitwise-faithful to the
+slot arena, chunked prefill must reproduce monolithic prefill, the
+block pool must never leak or double-free, prefix-cache hits must serve
+bitwise the cold-prefill tokens, and the engine still compiles once per
+chunk bucket + once for decode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.models import transformer
+from paddle_tpu.observe.compile_tracker import CompileTracker
+from paddle_tpu.serving import BlockPool, PagedDecodeEngine
+
+CFG = transformer.TransformerConfig(
+    vocab=40, d_model=16, n_heads=2, n_kv_heads=1, n_layers=2, d_ff=32,
+    max_len=64, dtype=jnp.float32, use_rope=True)
+CFG_ABS = transformer.TransformerConfig(
+    vocab=40, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+    max_len=64, dtype=jnp.float32, use_rope=False)
+PARAMS = transformer.init_params(jax.random.PRNGKey(0), CFG)
+
+BS = 8          # block size shared by the kernel contracts below
+
+
+def _pool_from_arena(cache, cfg):
+    """Arena [L, B, T, Hkv, Dh] -> flat pool with the identity paging
+    (slot b's pages tile its contiguous span)."""
+    L, B, T = cache["k"].shape[:3]
+    pool = {k: jnp.reshape(v, (L, B * T, cfg.kv_heads, cfg.head_dim))
+            for k, v in cache.items()}
+    pages = np.arange(B * (T // BS), dtype=np.int32).reshape(B, T // BS)
+    return pool, jnp.asarray(pages)
+
+
+def _paged(batch=2, cache_len=32, block_size=8, chunk_tokens=8,
+           num_blocks=None, seed=0, params=PARAMS, cfg=CFG):
+    return PagedDecodeEngine.from_params(
+        params, cfg, batch=batch, cache_len=cache_len,
+        block_size=block_size, chunk_tokens=chunk_tokens,
+        num_blocks=num_blocks, seed=seed, tracker=CompileTracker())
+
+
+class TestPagedKernels:
+    @pytest.mark.parametrize("cfg", [CFG, CFG_ABS],
+                             ids=["rope", "learned-pos"])
+    def test_paged_decode_bitwise_matches_slots(self, cfg, rng):
+        """Identity paging: decode_step_paged == decode_step_slots
+        bitwise (logits AND written cache), both position encodings."""
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        B, Tp, T = 3, 6, 32
+        prompt = jnp.asarray(rng.randint(0, 40, (B, Tp)), jnp.int32)
+        logits, cache = transformer.prefill(params, prompt, cfg, T)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        pos = jnp.asarray([6, 3, 9], jnp.int32)
+        active = jnp.asarray([True, False, True])
+        l_slot, c_slot = transformer.decode_step_slots(
+            params, cache, tok, pos, active, cfg)
+        pool, pages = _pool_from_arena(cache, cfg)
+        l_paged, c_paged = transformer.decode_step_paged(
+            params, pool, tok, pos, active, pages, cfg, block_size=BS)
+        np.testing.assert_array_equal(np.asarray(l_slot),
+                                      np.asarray(l_paged))
+        for leaf in ("k", "v"):
+            want = np.asarray(c_slot[leaf]).reshape(
+                np.asarray(c_paged[leaf]).shape)
+            np.testing.assert_array_equal(want, np.asarray(c_paged[leaf]))
+
+    def test_scrambled_pages_same_logits(self, rng):
+        """Physical block placement is invisible: a permuted page table
+        holding the same logical content decodes bitwise identically."""
+        B, Tp, T = 2, 6, 32
+        P = T // BS
+        prompt = jnp.asarray(rng.randint(0, 40, (B, Tp)), jnp.int32)
+        logits, cache = transformer.prefill(PARAMS, prompt, CFG, T)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        pos = jnp.full((B,), Tp, jnp.int32)
+        active = jnp.ones((B,), bool)
+        pool, pages = _pool_from_arena(cache, CFG)
+        l_id, _ = transformer.decode_step_paged(
+            PARAMS, pool, tok, pos, active, pages, CFG, block_size=BS)
+        # scramble: permute the physical blocks, remap the page table
+        perm = rng.permutation(B * P).astype(np.int32)
+        scat = np.empty_like(perm)
+        scat[perm] = np.arange(B * P, dtype=np.int32)
+        gidx = (perm[:, None] * BS + np.arange(BS)).reshape(-1)
+        pool2 = {k: jnp.asarray(np.asarray(v)[:, gidx])
+                 for k, v in pool.items()}
+        pages2 = jnp.asarray(scat[np.asarray(pages).reshape(-1)]
+                             .reshape(B, P))
+        l_sc, _ = transformer.decode_step_paged(
+            PARAMS, pool2, tok, pos, active, pages2, CFG, block_size=BS)
+        np.testing.assert_array_equal(np.asarray(l_id), np.asarray(l_sc))
+
+    def test_chunked_prefill_matches_single_chunk(self, rng):
+        """Chunked prefill on the fixed (block-aligned) chunk grid
+        reproduces one monolithic prefill within tolerance — the chunk
+        program attends over concat(context, chunk), a different einsum
+        shape than the monolithic pass — and the SAME chunk grid
+        replayed onto a different physical block placement is BITWISE
+        identical (the kernel core of the prefix-cache hit-replay
+        guarantee)."""
+        Tp = 14
+        prompt = rng.randint(0, 40, Tp).astype(np.int32)
+
+        def run(chunks, pages):
+            pool, off, lg = transformer.init_block_pool(CFG, 6, BS), 0, \
+                None
+            for c in chunks:
+                bucket = 8 if c <= 8 else 16
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :c] = prompt[off:off + c]
+                pv = pages[:off // BS + -(-bucket // BS)]
+                lg, pool = transformer.prefill_into_blocks(
+                    PARAMS, pool, jnp.asarray(padded),
+                    jnp.asarray(c, jnp.int32),
+                    jnp.asarray(pv, jnp.int32), CFG, block_size=BS)
+                off += c
+            return lg, pool
+
+        lg1, pool1 = run([14], np.asarray([0, 1], np.int32))
+        lg2, pool2 = run([8, 6], np.asarray([0, 1], np.int32))
+        np.testing.assert_allclose(np.asarray(lg1), np.asarray(lg2),
+                                   rtol=1e-5, atol=1e-6)
+        for leaf in ("k", "v"):
+            np.testing.assert_allclose(np.asarray(pool1[leaf]),
+                                       np.asarray(pool2[leaf]),
+                                       rtol=1e-5, atol=1e-6)
+        # same grid, scrambled physical placement: bitwise
+        lg3, pool3 = run([8, 6], np.asarray([4, 2], np.int32))
+        np.testing.assert_array_equal(np.asarray(lg2), np.asarray(lg3))
+        for leaf in ("k", "v"):
+            a = np.asarray(pool2[leaf])
+            b = np.asarray(pool3[leaf])
+            np.testing.assert_array_equal(a[:, 0 * BS:1 * BS],
+                                          b[:, 4 * BS:5 * BS])
+            np.testing.assert_array_equal(a[:, 1 * BS:2 * BS],
+                                          b[:, 2 * BS:3 * BS])
+
+    def test_prefill_into_blocks_matches_slot_prefill(self, rng):
+        """Block prefill reproduces prefill_into_slot's gathered-head
+        logits (tolerance contract: the two trace different einsum
+        shapes) and leaves unmapped blocks zero."""
+        Tp, T = 6, 24
+        prompt = jnp.asarray(rng.randint(0, 40, (1, Tp)), jnp.int32)
+        arena = transformer.init_cache(CFG, 1, T)
+        padded = jnp.pad(prompt, ((0, 0), (0, 2)))          # bucket 8
+        lg_slot, _ = transformer.prefill_into_slot(
+            PARAMS, arena, padded, jnp.asarray(Tp, jnp.int32),
+            jnp.asarray(0, jnp.int32), CFG)
+        pool = transformer.init_block_pool(CFG, 6, BS)
+        pages = jnp.asarray([3], jnp.int32)     # one scrambled page:
+        lg, pool = transformer.prefill_into_blocks(  # ctx 0, bucket 8
+            PARAMS, pool, padded, jnp.asarray(Tp, jnp.int32), pages,
+            CFG, block_size=BS)
+        np.testing.assert_allclose(np.asarray(lg_slot), np.asarray(lg),
+                                   rtol=1e-5, atol=1e-6)
+        k = np.asarray(pool["k"])
+        for b in (0, 1, 2, 4, 5):                            # unmapped
+            np.testing.assert_array_equal(
+                k[:, b * BS:(b + 1) * BS], 0.0)
+
+
+class TestBlockPool:
+    def test_reserve_alloc_release_accounting(self):
+        pool = BlockPool(4, 8)
+        assert pool.allocatable == 4 and pool.idle
+        pool.reserve(3)
+        assert not pool.can_reserve(2) and pool.can_reserve(1)
+        a, b = pool.alloc(), pool.alloc()
+        assert pool.in_use == 2 and pool.reserved == 1
+        pool.unreserve(1)
+        pool.release(a)
+        pool.release(b)
+        assert pool.idle and pool.free_count == 4
+        with pytest.raises(RuntimeError, match="reservation"):
+            pool.alloc()
+
+    def test_refcounted_sharing_and_lru_park(self):
+        pool = BlockPool(2, 4)
+        pool.reserve(1)
+        b = pool.alloc()
+        pool.publish(b"h1", b)
+        pool.share(b)                        # second holder
+        pool.release(b)                      # first gone
+        assert pool.refcount(b) == 1 and pool.in_use == 1
+        pool.release(b)                      # last gone -> LRU, not free
+        assert pool.cached_free_count == 1 and pool.free_count == 1
+        assert pool.lookup(b"h1") == b       # still serves hits
+        pool.share(b)                        # revival out of the LRU
+        assert pool.refcount(b) == 1 and pool.cached_free_count == 0
+        pool.release(b)
+
+    def test_lru_eviction_oldest_first_unpublishes(self):
+        pool = BlockPool(2, 4)
+        pool.reserve(2)
+        b1, b2 = pool.alloc(), pool.alloc()
+        pool.publish(b"h1", b1)
+        pool.publish(b"h2", b2)
+        pool.release(b1)                     # LRU order: b1 oldest
+        pool.release(b2)
+        pool.reserve(1)
+        got = pool.alloc()                   # evicts b1, not b2
+        assert got == b1 and pool.evictions == 1
+        assert pool.lookup(b"h1") is None and pool.lookup(b"h2") == b2
+        pool.release(got)
+
+    def test_double_release_and_share_free_guards(self):
+        pool = BlockPool(2, 4)
+        pool.reserve(1)
+        b = pool.alloc()
+        pool.release(b)
+        with pytest.raises(RuntimeError, match="refcount"):
+            pool.release(b)
+        with pytest.raises(RuntimeError, match="not cached"):
+            pool.share(b)
+
+
+class TestPagedEngineScheduling:
+    def test_matches_generate_mixed_lengths(self, rng):
+        """Greedy paged-engine output == transformer.generate per
+        request, mixed prompt lengths sharing the pool."""
+        eng = _paged()
+        prompts = [rng.randint(0, 40, n).astype(np.int32)
+                   for n in (5, 9, 3)]
+        reqs = [eng.submit(p, max_new=6) for p in prompts]
+        done = eng.run_until_idle()
+        assert len(done) == 3
+        for r, p in zip(reqs, prompts):
+            want = np.asarray(transformer.generate(
+                PARAMS, jnp.asarray(p[None]), CFG, max_new=6))[0]
+            np.testing.assert_array_equal(r.output, want)
+            assert r.finish_reason == "max_tokens"
+
+    def test_long_prompt_chunked_no_bucket_rejection(self, rng):
+        """A prompt far beyond chunk_tokens is admitted (the v3
+        largest-bucket rejection is gone) and decodes correctly through
+        chunked prefill."""
+        eng = _paged(cache_len=32, chunk_tokens=8)
+        p = rng.randint(0, 40, 26).astype(np.int32)
+        r = eng.submit(p, max_new=6)         # 26 > chunk max 8
+        short = eng.submit(rng.randint(0, 40, 4).astype(np.int32),
+                           max_new=4)
+        eng.run_until_idle()
+        want = np.asarray(transformer.generate(
+            PARAMS, jnp.asarray(p[None]), CFG, max_new=6))[0]
+        np.testing.assert_array_equal(r.output, want)
+        assert short.finish_reason == "max_tokens"
+        with pytest.raises(ValueError, match="exceed cache_len"):
+            eng.submit(rng.randint(0, 40, 28).astype(np.int32),
+                       max_new=8)
+
+    def test_prefix_hit_bitwise_identical_to_cold(self, rng):
+        """Prefix-cache-hit generation is bitwise the cold prefill's:
+        same prompt replayed, and a shared-prefix different-tail prompt
+        vs its own cold engine."""
+        prefix = rng.randint(0, 40, 16).astype(np.int32)
+        tail_a = rng.randint(0, 40, 5).astype(np.int32)
+        tail_b = rng.randint(0, 40, 7).astype(np.int32)
+        pa = np.concatenate([prefix, tail_a])
+        pb = np.concatenate([prefix, tail_b])
+
+        cold = _paged(cache_len=48, chunk_tokens=8)
+        ra_cold = cold.submit(pa, max_new=6)
+        cold.run_until_idle()
+        rb_cold = cold.submit(pb, max_new=6)
+        cold.run_until_idle()
+        assert ra_cold.prefix_hit_tokens == 0
+        assert rb_cold.prefix_hit_tokens == 16      # pa cached the prefix
+
+        warm = _paged(cache_len=48, chunk_tokens=8)
+        warm.submit(pa, max_new=6)
+        warm.run_until_idle()
+        ra_hit = warm.submit(pa, max_new=6)         # full-prompt replay
+        warm.run_until_idle()
+        assert ra_hit.prefix_hit_tokens == 16
+        assert ra_hit.tokens == ra_cold.tokens
+        # different tail over the shared prefix, vs ITS cold run
+        rb_hit = warm.submit(pb, max_new=6)
+        warm.run_until_idle()
+        assert rb_hit.prefix_hit_tokens == 16
+        assert rb_hit.tokens == rb_cold.tokens
+
+    def test_shared_blocks_survive_one_requesters_finish(self, rng):
+        """Refcounting: two in-flight requests share prefix blocks; the
+        first one's termination must not free or corrupt them for the
+        second."""
+        prefix = rng.randint(0, 40, 16).astype(np.int32)
+        pa = np.concatenate([prefix, rng.randint(0, 40, 3).astype(np.int32)])
+        pb = np.concatenate([prefix, rng.randint(0, 40, 5).astype(np.int32)])
+        solo = _paged(cache_len=48, chunk_tokens=8)
+        rb_solo = solo.submit(pb, max_new=10)
+        solo.run_until_idle()
+
+        eng = _paged(cache_len=48, chunk_tokens=8)
+        eng.submit(pa, max_new=2)
+        eng.run_until_idle()                  # publishes the prefix
+        ra = eng.submit(pa, max_new=2)        # hits, finishes early
+        rb = eng.submit(pb, max_new=10)       # hits, decodes long
+        eng.run_until_idle()
+        assert ra.prefix_hit_tokens == 16 and rb.prefix_hit_tokens == 16
+        assert ra.finish_reason == "max_tokens"
+        np.testing.assert_array_equal(rb.output, rb_solo.output)
+
+    def test_no_block_leak_after_full_trace(self, rng):
+        """After a drained trace every block is back (free or parked in
+        the LRU), nothing reserved, and the in-use gauge reads 0."""
+        eng = _paged(batch=2, cache_len=32, chunk_tokens=8)
+        total = eng.pool.num_blocks
+        alloc0 = eng.pool.free_count + eng.pool.cached_free_count
+        for n in (5, 20, 9, 3, 26, 13, 7):
+            eng.submit(rng.randint(0, 40, n).astype(np.int32),
+                       max_new=int(rng.randint(1, 6)))
+        eng.run_until_idle()
+        assert eng.pool.idle
+        # published blocks PARK in the LRU rather than returning to
+        # free, so the no-leak invariant is on the ALLOCATABLE count
+        assert eng.pool.free_count + eng.pool.cached_free_count \
+            == alloc0 == total
+        assert eng.metrics.get("engine_blocks_in_use").value() == 0
+        assert eng.metrics.get("engine_blocks_free").value() == \
+            eng.pool.free_count
+
+    def test_lru_eviction_under_pressure_keeps_correctness(self, rng):
+        """A pool sized for ~1 request forces LRU eviction of cached
+        prefix blocks; results stay exact and the eviction counter
+        moves."""
+        eng = _paged(batch=1, cache_len=32, chunk_tokens=8,
+                     num_blocks=4)
+        prompts = [rng.randint(0, 40, 17).astype(np.int32)
+                   for _ in range(3)]
+        for p in prompts:
+            r = eng.submit(p, max_new=4)
+            eng.run_until_idle()
+            want = np.asarray(transformer.generate(
+                PARAMS, jnp.asarray(p[None]), CFG, max_new=4))[0]
+            np.testing.assert_array_equal(r.output, want)
+        assert eng.pool.evictions > 0
+        assert eng.metrics.get(
+            "engine_prefix_cache_evictions_total").value() == \
+            eng.pool.evictions
+
+    def test_compile_once_per_chunk_shape_plus_decode(self, rng):
+        """Each distinct (chunk bucket, context span) pair compiles
+        exactly once; every decode step shares ONE compilation
+        regardless of paging."""
+        from paddle_tpu.core import ragged
+        eng = _paged(batch=2, cache_len=32, chunk_tokens=8)
+        lens = (3, 26, 9, 12)
+        for n in lens:
+            eng.submit(rng.randint(0, 40, n).astype(np.int32),
+                       max_new=4)
+        eng.run_until_idle()
+        progs = set()       # the chunk walk the scheduler performs
+        for n in lens:
+            off = 0
+            while off < n:
+                c = min(n - off, eng.chunk_tokens)
+                b = ragged.bucket_length(c, eng.buckets)
+                progs.add((b, off // eng.block_size
+                           + -(-b // eng.block_size)))
+                off += c
+        counts = eng.compile_counts()
+        assert counts["decode"] == 1
+        assert counts["prefill"] == len(progs) == 4
+
+    def test_admission_waits_for_blocks(self, rng):
+        """A request that cannot reserve its worst case waits FIFO even
+        with a free slot; it admits once blocks release."""
+        eng = _paged(batch=2, cache_len=32, chunk_tokens=8,
+                     num_blocks=4)
+        big_a = eng.submit(rng.randint(0, 40, 17).astype(np.int32),
+                           max_new=7)          # 3 blocks
+        big_b = eng.submit(rng.randint(0, 40, 17).astype(np.int32),
+                           max_new=7)          # needs 3 more: waits
+        eng.step()
+        assert big_a.status != "queued" and big_b.status == "queued"
+        eng.run_until_idle()
+        assert big_b.finish_reason == "max_tokens"
+        want = np.asarray(transformer.generate(
+            PARAMS, jnp.asarray(big_b.prompt[None]), CFG, max_new=7))[0]
+        np.testing.assert_array_equal(big_b.output, want)
+
+    def test_submit_rejects_worst_case_beyond_pool(self, rng):
+        """A request whose worst-case block need exceeds the POOL (not
+        just cache_len) must be rejected at submit: it could never
+        reserve, and would livelock the FIFO queue head forever."""
+        eng = _paged(batch=2, cache_len=32, chunk_tokens=8,
+                     num_blocks=3)             # pool < cache_len/bs
+        with pytest.raises(ValueError, match="blocks"):
+            eng.submit(rng.randint(0, 40, 17).astype(np.int32),
+                       max_new=8)              # needs ceil(25/8) = 4
+        # the worst case that fits the pool is still served
+        ok = eng.submit(rng.randint(0, 40, 17).astype(np.int32),
+                        max_new=7)             # needs exactly 3
+        eng.run_until_idle()
+        assert ok.finish_reason == "max_tokens"
+
+    def test_metrics_and_health(self, rng):
+        eng = _paged(cache_len=32, chunk_tokens=8)
+        prefix = rng.randint(0, 40, 8).astype(np.int32)
+        for tail in (3, 5):     # sequential: the second prompt's prefix
+            eng.submit(np.concatenate(  # block hits the first's cache
+                [prefix, rng.randint(0, 40, tail).astype(np.int32)]),
+                max_new=4)
+            eng.run_until_idle()
+        assert eng.metrics.get(
+            "engine_prefix_cache_hit_blocks_total").value() >= 1
+        assert eng.metrics.get(
+            "engine_prefix_cache_miss_blocks_total").value() >= 1
+        assert eng.metrics.get("engine_prefill_chunks_total").value() >= 2
+        text = eng.metrics_text()
+        assert "# TYPE engine_prefill_stall_seconds histogram" in text
+        assert "engine_blocks_in_use" in text
+        h = eng.health()
+        assert h["blocks_total"] == eng.pool.num_blocks
+        assert h["blocks_in_use"] == 0 and h["block_size"] == 8

@@ -247,10 +247,11 @@ class TestEngineObservability:
 
 class TestServingBenchSmoke:
     def test_bench_smoke_engine_beats_nothing_but_runs(self):
-        """Tier-1 exercise of the full bench path (--smoke): both
-        variants produce sane numbers and the engine's compile
-        invariant (asserted inside run_engine) holds. The engine-wins
-        throughput claim is the full-size run's, not the toy's."""
+        """Tier-1 exercise of the full bench path (--smoke): all three
+        variants (paged / row-arena / lockstep) produce sane numbers on
+        a shared-prefix + long-prompt-adversarial trace and the compile
+        invariants (asserted inside the runners) hold. The paged-wins
+        throughput/TTFT claims are the full-size run's, not the toy's."""
         import importlib.util
         import os
         spec = importlib.util.spec_from_file_location(
@@ -261,7 +262,21 @@ class TestServingBenchSmoke:
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         results = mod.main(["--smoke"])
-        assert results["engine"]["requests"] == 6
-        assert results["engine"]["tokens"] == results["lockstep"]["tokens"]
-        assert results["engine"]["tokens_per_sec"] > 0
-        assert results["engine"]["compiles"]["decode"] == 1
+        # throughput phase: the 6 Poisson requests; latency phase adds
+        # 1 adversarial long prompt
+        tp, lat = results["throughput"], results["latency"]
+        assert tp["engine_paged"]["requests"] == 6
+        assert lat["engine_paged"]["requests"] == 7
+        for phase in (tp, lat):
+            assert phase["engine_paged"]["tokens"] == \
+                phase["engine_slots"]["tokens"] == \
+                phase["lockstep"]["tokens"]
+            assert phase["engine_paged"]["tokens_per_sec"] > 0
+            assert phase["engine_paged"]["compiles"]["decode"] == 1
+            assert phase["engine_slots"]["compiles"]["decode"] == 1
+            # the shared-prefix half of the trace hit the prefix cache
+            assert phase["engine_paged"]["prefix_hit_blocks"] >= 1
+            assert phase["engine_paged"]["blocks_in_use_peak"] <= \
+                phase["engine_paged"]["blocks_total"]
+        assert results["serving_paged_speedup"] > 0
+        assert results["serving_paged_ttft_p99_ratio"] > 0
