@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Perf-regression sentinel over ``benchmarks/runs/`` artifacts.
+
+``run_tier1.sh`` used to tail-echo the latest serving/zero artifacts,
+leaving the reader to diff figures by eye. This checker compares the
+LATEST artifact of each benchmark family against the PREVIOUS one at
+that family's figures of merit and prints one PASS/REGRESSED verdict
+per figure, with a noise band sized to how jittery the figure is on a
+shared host:
+
+- ratios and byte counts are near-deterministic (tight band);
+- wall-clock throughput/latency figures breathe with machine load
+  (wide band).
+
+A family with fewer than two artifacts reports BASELINE (nothing to
+compare — the current run becomes the next run's baseline). Exit code
+1 iff any figure REGRESSED, so CI can gate on it; run_tier1.sh only
+surfaces the report (the tier-1 test verdict stays pytest's).
+
+Usage: python benchmarks/check_regression.py [--dir benchmarks/runs]
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+RUNS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "runs")
+
+# (dotted value path, direction, relative noise band)
+# direction: "higher" = bigger is better, "lower" = smaller is better,
+# "true" = must stay truthy (band unused)
+FAMILIES = {
+    "serving": {
+        "glob": "*serving_paged*.json",
+        "figures": [
+            ("serving_paged_speedup", "higher", 0.15),
+            ("throughput.engine_paged.tokens_per_sec", "higher", 0.25),
+            ("latency.engine_paged.ttft_p99_s", "lower", 0.35),
+        ],
+    },
+    "zero": {
+        "glob": "zero_bench*.json",
+        "figures": [
+            ("opt_state_bytes_ratio", "lower", 0.02),
+            ("zero1.opt_state_bytes_per_device", "lower", 0.02),
+            ("zero1.step_ms_median", "lower", 0.35),
+            ("traj_allclose", "true", 0.0),
+        ],
+    },
+}
+
+
+def lookup(doc, path):
+    """Dotted-path lookup; None when any segment is missing."""
+    cur = doc
+    for seg in path.split("."):
+        if not isinstance(cur, dict) or seg not in cur:
+            return None
+        cur = cur[seg]
+    return cur
+
+
+def compare_figure(latest, prev, direction, band):
+    """(verdict, detail) for one figure of merit; SKIP when either
+    artifact lacks it (schema drift is not a regression)."""
+    if latest is None or prev is None:
+        return "SKIP", "missing in latest" if latest is None \
+            else "missing in previous"
+    if direction == "true":
+        return ("PASS", "still true") if latest else \
+            ("REGRESSED", f"was {prev!r}, now {latest!r}")
+    latest, prev = float(latest), float(prev)
+    if direction == "higher":
+        floor = prev * (1.0 - band)
+        ok = latest >= floor
+        detail = (f"latest {latest:g} vs prev {prev:g} "
+                  f"(floor {floor:g}, band {band:.0%})")
+    else:
+        ceil = prev * (1.0 + band)
+        ok = latest <= ceil
+        detail = (f"latest {latest:g} vs prev {prev:g} "
+                  f"(ceiling {ceil:g}, band {band:.0%})")
+    return ("PASS" if ok else "REGRESSED"), detail
+
+
+def check_family(name, spec, runs_dir):
+    """Compare the two newest artifacts of one family; returns the
+    list of (figure, verdict, detail) lines (empty = no artifacts)."""
+    # order by date-stamped basename, not mtime: a fresh git checkout
+    # gives every committed artifact the same mtime, which would make
+    # latest-vs-previous arbitrary (and a gating CI compare inverted)
+    paths = sorted(glob.glob(os.path.join(runs_dir, spec["glob"])),
+                   key=os.path.basename)
+    if not paths:
+        return [("-", "SKIP", "no artifacts")]
+    if len(paths) < 2:
+        return [("-", "BASELINE",
+                 f"only {os.path.basename(paths[-1])} — nothing to "
+                 f"compare against yet")]
+    prev_p, latest_p = paths[-2], paths[-1]
+    try:
+        with open(prev_p) as f:
+            prev = json.load(f)
+        with open(latest_p) as f:
+            latest = json.load(f)
+    except (OSError, ValueError) as e:
+        return [("-", "SKIP", f"unreadable artifact: {e}")]
+    lines = [("-", "COMPARING",
+              f"{os.path.basename(latest_p)} vs "
+              f"{os.path.basename(prev_p)}")]
+    for path, direction, band in spec["figures"]:
+        verdict, detail = compare_figure(
+            lookup(latest, path), lookup(prev, path), direction, band)
+        lines.append((path, verdict, detail))
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default=RUNS,
+                    help="artifact directory (default benchmarks/runs)")
+    args = ap.parse_args(argv)
+    regressed = False
+    for name, spec in FAMILIES.items():
+        for figure, verdict, detail in check_family(name, spec,
+                                                    args.dir):
+            print(f"sentinel {name} {figure}: {verdict} — {detail}")
+            regressed |= verdict == "REGRESSED"
+    print("SENTINEL: " + ("REGRESSED" if regressed else "PASS"))
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -169,6 +169,89 @@ def _result(variant, eng, reqs, wall, occ_slots, occ_blocks):
     return r
 
 
+def attribution_section(work, reqs, burst, request_log):
+    """Per-request tail-latency attribution of one latency-phase
+    replay: top-10 slowest by TTFT with their component split, plus —
+    when the trace carries the long-prompt adversary — the VICTIM
+    summary: the burst requests arriving just behind the adversary,
+    whose TTFT the chunked-prefill design promises is dominated by
+    prefill-stall (bounded, one chunk at a time) rather than queue
+    wait (the row-arena failure mode) or decode.
+
+    Records come from the ENGINE's own ring (``eng.request_log``) —
+    one source of truth for the field mapping — joined to the trace's
+    arrival times by rid."""
+    from paddle_tpu.observe import requests as _oreq
+    by_rid = {r["rid"]: r for r in request_log.records()}
+    recs = []
+    for i, r in enumerate(reqs):
+        rec = by_rid.get(r.rid)
+        assert rec is not None, (
+            f"r{r.rid} missing from the engine request ring "
+            f"(capacity {request_log.capacity}, "
+            f"{request_log.evicted()} evicted) — trace too large "
+            f"for the ring; raise PADDLE_TPU_REQUEST_LOG")
+        rec = dict(rec)
+        rec["arrival_s"] = round(work[i][0], 6)
+        rec["attribution"] = _oreq.attribute(rec)
+        recs.append(rec)
+    slowest = sorted(recs, key=lambda r: r["ttft_s"] or 0.0,
+                     reverse=True)[:10]
+    out = {"requests": len(recs), "slowest_by_ttft": slowest}
+    adversary = max(range(len(work)), key=lambda i: len(work[i][1]))
+    t_adv = work[adversary][0]
+    victims = [recs[i] for i in range(len(work))
+               if i != adversary
+               and t_adv <= work[i][0] <= t_adv + burst * 1e-3 + 1e-9]
+    if victims:
+        # dominance over the TTFT components (queue/own/stall): the
+        # victims' damage is time-to-first-token — a long generation
+        # afterwards (decode) is not the adversary's doing
+        dom = {}
+        for v in victims:
+            d = v["attribution"]["ttft_dominant"]
+            dom[d] = dom.get(d, 0) + 1
+        out["victims"] = {
+            "count": len(victims),
+            "adversary_prompt_tokens": len(work[adversary][1]),
+            "ttft_dominant_counts": dom,
+            "ttft_dominant": max(dom, key=dom.get),
+            "ttft_p50_s": round(_pct(
+                [v["ttft_s"] for v in victims], 0.5), 6),
+            "prefill_stall_p50_s": round(_pct(
+                [v["prefill_stall_s"] for v in victims], 0.5), 6),
+            "queue_wait_p50_s": round(_pct(
+                [v["queue_wait_s"] for v in victims], 0.5), 6)}
+    return out
+
+
+def assert_lifecycles_joined(trace, reqs, buf):
+    """Every completed request of the replay must have a fully-joined
+    lifecycle in the exported trace: its async track present, every
+    opened slice closed (b/e balanced), and a first_token marker — no
+    orphan spans, no foreign tracks."""
+    assert buf.dropped() == 0, (
+        f"trace ring dropped {buf.dropped()} events — joins "
+        f"unverifiable; raise PADDLE_TPU_TRACE_BUFFER")
+    evs = [e for e in trace["traceEvents"] if e.get("cat") == "request"]
+    by_id = {}
+    for e in evs:
+        by_id.setdefault(e["id"], []).append(e)
+    for r in reqs:
+        assert r.finish_reason is not None, f"r{r.rid} never finished"
+        es = by_id.get(r.trace_id)
+        assert es, f"request {r.trace_id}: no lifecycle events"
+        b = sum(1 for e in es if e["ph"] == "b")
+        e_ = sum(1 for e in es if e["ph"] == "e")
+        assert b == e_ >= 1, (
+            f"request {r.trace_id}: orphan async spans "
+            f"({b} opened, {e_} closed)")
+        assert any(e["name"] == "first_token" for e in es), (
+            f"request {r.trace_id}: no first_token marker")
+    extra = set(by_id) - {r.trace_id for r in reqs}
+    assert not extra, f"orphan request tracks in trace: {sorted(extra)}"
+
+
 def _paged_programs(lens, chunk, bs, buckets):
     """The (chunk bucket, page-vector length) program set a COLD walk
     of the given prompt lengths reaches — one compile each (prefix
@@ -380,6 +463,11 @@ def main(argv=None):
                     help="JSON artifact path (default: "
                          "benchmarks/runs/<date>_serving_paged.json; "
                          "skipped under --smoke unless given)")
+    ap.add_argument("--trace-out", default=None,
+                    help="export the per-request lifecycle trace of a "
+                         "dedicated latency-phase replay (Chrome-trace "
+                         "JSON) and assert every completed request's "
+                         "lifecycle is fully joined — no orphan spans")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny preset for the tier-1 fast test: few "
                          "requests, near-zero inter-arrival gaps")
@@ -514,6 +602,37 @@ def main(argv=None):
         f"{paged_tr.count('serving_engine.prefill')}")
     assert slots_tr.count("serving_engine.decode") == 1
     assert slots_tr.count("serving_engine.prefill") <= len(buckets)
+
+    # dedicated attribution replay: one more latency-phase run on a
+    # fresh paged engine with request-lifecycle tracing captured — the
+    # per-request tail-latency evidence (and, with --trace-out, the
+    # joined-timeline export). Programs are already compiled, so this
+    # replay adds no compiles (the invariant above already swept it).
+    if args.trace_out or args.long_prompt_adversarial:
+        from paddle_tpu import observe
+        buf = observe.default_buffer()
+        if not buf.enabled or buf.capacity < 4096:
+            buf = observe.set_trace_capacity(65536)
+        buf.clear()
+        eng = mk_paged()
+        reqs, _, _, _ = _replay(eng, work_lat)
+        attribution = attribution_section(work_lat, reqs,
+                                          burst=args.batch,
+                                          request_log=eng.request_log)
+        results["attribution"] = attribution
+        line = {"bench": "serving", "phase": "attribution",
+                "requests": attribution["requests"]}
+        if "victims" in attribution:
+            line.update({f"victims_{k}": v for k, v in
+                         attribution["victims"].items()})
+        print(json.dumps(line), flush=True)
+        metrics_write(**line)
+        if args.trace_out:
+            trace = observe.trace_export(args.trace_out)
+            assert_lifecycles_joined(trace, reqs, buf)
+            print(f"wrote per-request trace to {args.trace_out} "
+                  f"({len(reqs)} requests, all lifecycles joined)",
+                  file=sys.stderr)
 
     tp, lat = results["throughput"], results["latency"]
     speedup = (tp["engine_paged"]["tokens_per_sec"]

@@ -301,12 +301,19 @@ def job_serve(args):
     except ValueError as e:
         print(f"serve: {e}", file=sys.stderr)
         return 1
+    if args.ttft_slo_ms:
+        from paddle_tpu.observe import SloConfig
+        eng.configure_slo(SloConfig(
+            ttft_s=args.ttft_slo_ms / 1000.0,
+            target=args.slo_target,
+            window_s=args.slo_window_s))
     health_srv = None
     if args.health_port is not None:
         health_srv = eng.serve(host=args.health_host,
                                port=args.health_port)
         print(f"observability: {health_srv.url}/metrics  "
-              f"{health_srv.url}/healthz", file=sys.stderr)
+              f"{health_srv.url}/healthz  {health_srv.url}/requests",
+              file=sys.stderr)
 
     def emit(req):
         print(json.dumps({
@@ -376,6 +383,31 @@ def job_stats(cfg, args):
     default metrics registry (--format=prom gives the Prometheus text
     exposition)."""
     from paddle_tpu import observe
+
+    if args.requests:
+        log = observe.default_request_log()
+        slow = log.slowest(args.requests, by="ttft_s")
+        summary = log.summary()
+        print(f"request log: {summary['count']} records "
+              f"(capacity {summary['capacity']}, "
+              f"{summary['evicted']} evicted) — by dominant component: "
+              + (", ".join(f"{k}={v}" for k, v in sorted(
+                  summary["by_dominant_component"].items())) or "none"))
+        for r in slow:
+            a = r["attribution"]
+            comps = " ".join(
+                f"{c[:-2]} {1000 * a['components'][c]:.1f}ms"
+                for c in observe.requests.COMPONENTS)
+            print(f"  r{r.get('rid')} ttft {1000 * (r.get('ttft_s') or 0):.1f}ms "
+                  f"latency {1000 * (r.get('latency_s') or 0):.1f}ms "
+                  f"tokens {r.get('tokens')} "
+                  f"cache_hit {r.get('cache_hit_frac', 0):.0%} "
+                  f"[{comps}] -> dominated by {a['dominant']} "
+                  f"({r.get('finish_reason')})")
+        if not slow:
+            print("  (no completed requests recorded in this process)")
+        if not args.trace and not args.metrics_file:
+            return 0
 
     if args.trace:
         trace = observe.trace_export(args.trace)
@@ -560,6 +592,20 @@ def main(argv=None):
     p.add_argument("--health_host", default="127.0.0.1",
                    help="bind address for --health_port (use 0.0.0.0 "
                         "for out-of-pod probes; default loopback)")
+    p.add_argument("--requests", type=int, default=0,
+                   help="job=stats: print the N slowest requests of "
+                        "this process's request log with attributed "
+                        "latency components (0 = off)")
+    p.add_argument("--ttft_slo_ms", type=float, default=None,
+                   help="job=serve: TTFT SLO in ms — /healthz reports "
+                        "degraded when the rolling burn rate exceeds "
+                        "the budget (observe.SloConfig)")
+    p.add_argument("--slo_target", type=float, default=0.99,
+                   help="fraction of requests that must meet the TTFT "
+                        "SLO (job=serve; default 0.99)")
+    p.add_argument("--slo_window_s", type=float, default=60.0,
+                   help="rolling window for SLO evaluation, seconds "
+                        "(job=serve)")
     args = p.parse_args(argv)
 
     if args.metrics_out:

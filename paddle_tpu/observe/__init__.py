@@ -37,8 +37,10 @@ import threading
 from typing import Callable, List, Optional
 
 from paddle_tpu.observe.chrome_trace import (  # noqa: F401
-    SpanBuffer, default_buffer, record_span, set_trace_capacity,
-    trace_enabled, trace_export)
+    SpanBuffer, default_buffer, record_event, record_span,
+    set_trace_capacity, trace_enabled, trace_export)
+from paddle_tpu.observe import bottleneck  # noqa: F401
+from paddle_tpu.observe.bottleneck import attribute_step  # noqa: F401
 from paddle_tpu.observe import costs  # noqa: F401 — observe.costs.*
 from paddle_tpu.observe.compile_tracker import (  # noqa: F401
     CompileTracker, arg_signature, default_compile_tracker,
@@ -50,8 +52,13 @@ from paddle_tpu.observe.health import HealthServer  # noqa: F401
 from paddle_tpu.observe.metrics import (  # noqa: F401 — public surface
     Counter, Gauge, Histogram, JsonlSink, Registry, counter,
     default_registry, gauge, histogram, read_jsonl)
+from paddle_tpu.observe import requests  # noqa: F401 — observe.requests.*
+from paddle_tpu.observe.requests import (  # noqa: F401
+    RequestLog, default_request_log)
 from paddle_tpu.observe.trace import (  # noqa: F401
     current_scope, step_scope, trace_scope, traced)
+from paddle_tpu.observe.window import (  # noqa: F401
+    SloConfig, WindowedQuantiles)
 
 _lock = threading.Lock()
 _sink: Optional[JsonlSink] = None
@@ -190,3 +197,4 @@ def reset():
     default_buffer().clear()
     default_flight_recorder().clear()
     default_compile_tracker().clear()
+    default_request_log().clear()

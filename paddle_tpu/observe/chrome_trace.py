@@ -59,7 +59,13 @@ class SpanBuffer:
         return self._capacity > 0
 
     def add(self, name: str, ts_s: float, dur_s: float,
-            tid: Optional[int] = None, args: Optional[dict] = None):
+            tid: Optional[int] = None, args: Optional[dict] = None,
+            ph: str = "X", ev_id: Optional[str] = None,
+            cat: Optional[str] = None):
+        """Record one closed span (``ph="X"``, the default) or one
+        async/instant lifecycle event (``ph`` in ``b``/``n``/``e`` with
+        an ``ev_id`` joining the events of one logical flow — a serving
+        request's timeline)."""
         if not self._capacity:
             return
         if tid is None:
@@ -67,7 +73,8 @@ class SpanBuffer:
         with self._lock:
             if len(self._spans) == self._capacity:
                 self._dropped += 1
-            self._spans.append((name, ts_s, dur_s, tid, args))
+            self._spans.append((name, ts_s, dur_s, tid, args, ph,
+                                ev_id, cat))
 
     def spans(self) -> List[tuple]:
         with self._lock:
@@ -109,6 +116,20 @@ def record_span(name: str, ts_s: float, dur_s: float,
     _default.add(name, ts_s, dur_s, args=args)
 
 
+def record_event(name: str, ts_s: float, ph: str, ev_id: str,
+                 cat: str = "request", args: Optional[dict] = None):
+    """Append one async lifecycle event to the default buffer. Phases
+    follow the Trace Event Format's nestable-async family: ``b`` opens
+    a slice, ``e`` closes the most recent open slice, ``n`` is an
+    instant marker — all joined per ``(cat, ev_id)``, so Perfetto
+    renders the events of one request as one track next to the engine's
+    step spans. No-op when trace recording is disabled."""
+    if ph not in ("b", "n", "e"):
+        raise ValueError(f"record_event: ph must be b/n/e, got {ph!r}")
+    _default.add(name, ts_s, 0.0, args=args, ph=ph, ev_id=str(ev_id),
+                 cat=cat)
+
+
 def trace_enabled() -> bool:
     return _default.enabled
 
@@ -148,11 +169,21 @@ def trace_export(path: Optional[str] = None,
     tid_map: Dict[int, int] = {}
     events = [{"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
                "args": {"name": f"paddle_tpu p{pid}"}}]
-    for name, ts_s, dur_s, ident, args in buffer.spans():
+    for span in buffer.spans():
+        # pre-PR-7 5-tuples may survive in caller-held buffers; treat
+        # the missing fields as a plain "X" span
+        name, ts_s, dur_s, ident, args = span[:5]
+        ph, ev_id, cat = (span[5:8] if len(span) >= 8
+                          else ("X", None, None))
         tid = tid_map.setdefault(ident, len(tid_map))
-        ev = {"name": name, "cat": "paddle_tpu", "ph": "X",
-              "ts": round(ts_s * 1e6, 3), "dur": round(dur_s * 1e6, 3),
-              "pid": pid, "tid": tid}
+        ev = {"name": name, "cat": cat or "paddle_tpu", "ph": ph,
+              "ts": round(ts_s * 1e6, 3), "pid": pid, "tid": tid}
+        if ph == "X":
+            ev["dur"] = round(dur_s * 1e6, 3)
+        else:
+            # nestable-async events join on (cat, id); the engine bakes
+            # its engine-instance id into ev_id so exports never collide
+            ev["id"] = ev_id
         if args:
             ev["args"] = args
         events.append(ev)

@@ -8,7 +8,15 @@ One ``HealthServer`` serves two GET routes:
   provided ``health_fn()`` (step progress for a trainer, queue depths
   for a master, request counters for an LMServer). A ``"healthy":
   False`` key turns the response into HTTP 503 so load balancers and
-  kubelets can act on it without parsing the body.
+  kubelets can act on it without parsing the body. Three-state status:
+  the document's ``status`` may also be ``"degraded"`` (SLO burn-rate
+  breach — still HTTP 200 with the reason in the body, so traffic
+  keeps flowing while schedulers/operators react) — only
+  ``unhealthy`` maps to 503.
+- ``/requests`` — present when a ``requests_fn`` is supplied (the
+  decode engines pass theirs): the top-k slowest requests with their
+  attributed latency components (``observe/requests.py``), the
+  tail-latency post-mortem a dashboard links to.
 
 Attach points: ``SGD.attach_observability()``, ``LMServer.serve()``,
 ``MasterServer(http_port=...)`` — or construct one directly around any
@@ -27,12 +35,19 @@ from typing import Callable, Optional
 
 class HealthServer:
     def __init__(self, registry=None, health_fn: Optional[Callable[[],
-                 dict]] = None, host: str = "127.0.0.1", port: int = 0):
+                 dict]] = None, host: str = "127.0.0.1", port: int = 0,
+                 requests_fn: Optional[Callable[[], dict]] = None,
+                 metrics_fn: Optional[Callable[[], str]] = None):
         if registry is None:
             from paddle_tpu.observe.metrics import default_registry
             registry = default_registry()
         self.registry = registry
         self.health_fn = health_fn
+        self.requests_fn = requests_fn
+        # metrics_fn overrides the registry render for `/metrics` so an
+        # owner can refresh derived gauges per scrape (the engines'
+        # window quantiles expire with time and must not scrape stale)
+        self.metrics_fn = metrics_fn
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -50,12 +65,19 @@ class HealthServer:
                 path = self.path.split("?", 1)[0]
                 try:
                     if path == "/metrics":
-                        text = outer.registry.render_prometheus()
+                        text = (outer.metrics_fn() if outer.metrics_fn
+                                else outer.registry.render_prometheus())
                         self._send(200, text.encode(),
                                    "text/plain; version=0.0.4")
                     elif path == "/healthz":
                         code, doc = outer._health()
                         self._send(code, json.dumps(doc).encode(),
+                                   "application/json")
+                    elif (path == "/requests"
+                          and outer.requests_fn is not None):
+                        from paddle_tpu.observe.metrics import JsonlSink
+                        doc = JsonlSink._clean(outer.requests_fn() or {})
+                        self._send(200, json.dumps(doc).encode(),
                                    "application/json")
                     else:
                         self._send(404, b'{"error": "not found"}\n',
@@ -87,8 +109,15 @@ class HealthServer:
         if self.health_fn is not None:
             doc = dict(self.health_fn() or {})
         healthy = bool(doc.pop("healthy", True))
-        doc["status"] = "ok" if healthy else "unhealthy"
-        return (200 if healthy else 503), JsonlSink._clean(doc)
+        status = doc.get("status")
+        if not healthy:
+            status = "unhealthy"          # the bool always wins: a probe
+            #                               saying healthy=False must 503
+        elif status not in ("ok", "degraded", "unhealthy"):
+            status = "ok"
+        doc["status"] = status
+        return (503 if status == "unhealthy" else 200), \
+            JsonlSink._clean(doc)
 
     @property
     def port(self) -> int:

@@ -211,6 +211,29 @@ class Histogram(Metric):
                 "min": c["min"] if c["count"] else 0.0,
                 "max": c["max"] if c["count"] else 0.0}
 
+    def quantile(self, q: float, **labels) -> float:
+        """Bucket-resolution quantile estimate from the cumulative
+        counts: the upper bound of the first bucket whose cumulative
+        count covers rank ``q*count`` (the Prometheus convention,
+        without interpolation — the answer is exact to one bucket
+        width). Observations above the last bucket report the tracked
+        max; empty series report 0. The sliding-window estimator
+        (``observe/window.py``) must agree with this on a stationary
+        stream — pinned by tests."""
+        cell = self._peek(labels)
+        if cell is None:
+            return 0.0
+        c = self._read_cell(cell)
+        if not c["count"]:
+            return 0.0
+        rank = q * c["count"]
+        cum = 0
+        for ub, n in zip(self.buckets, c["counts"]):
+            cum += n
+            if cum >= rank and cum > 0:
+                return ub
+        return c["max"]          # the +Inf bucket: report the real max
+
     def time(self, **labels):
         """Context manager observing the elapsed wall time in seconds."""
         return _HistTimer(self, labels)

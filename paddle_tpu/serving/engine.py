@@ -47,8 +47,16 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from paddle_tpu.observe import chrome_trace as _chrome
 from paddle_tpu.observe import compile_tracker as _ct
 from paddle_tpu.observe import metrics as _metrics
+from paddle_tpu.observe import requests as _requests
+from paddle_tpu.observe.window import SloConfig, WindowedQuantiles
+
+# per-process engine instance counter: bakes into request trace ids
+# (``eng<N>.r<rid>``) so several engines' lifecycle events never
+# collide in one exported timeline
+_ENGINE_IDS = itertools.count()
 
 # prefill buckets: small powers of two keep compile count tiny while
 # wasting at most ~2x padded prefill compute on a mixed workload
@@ -86,6 +94,11 @@ class EngineRequest:
     prefill_t: Optional[float] = None
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
+    prefill_own_s: float = 0.0          # device time of this request's
+    #                                     OWN prefill chunk(s)
+    trace_id: str = ""                  # eng<N>.r<rid>: joins this
+    #                                     request's lifecycle events
+    decode_open: bool = False           # a "decode" trace slice is open
 
     @property
     def output(self) -> np.ndarray:
@@ -105,6 +118,34 @@ class EngineRequest:
             return None
         return self.finish_t - self.submit_t
 
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.prefill_t is None:
+            return None
+        return self.prefill_t - self.submit_t
+
+    @property
+    def prefill_stall_s(self) -> Optional[float]:
+        """Admitted -> first token, minus own prefill device time:
+        time parked behind OTHER requests' chunks and the decode steps
+        interleaved between them (near 0 on the row-arena engine,
+        whose prefill is monolithic)."""
+        if self.first_token_t is None or self.prefill_t is None:
+            return None
+        return max(self.first_token_t - self.prefill_t
+                   - self.prefill_own_s, 0.0)
+
+    @property
+    def decode_s(self) -> Optional[float]:
+        if self.finish_t is None or self.first_token_t is None:
+            return None
+        return self.finish_t - self.first_token_t
+
+    @property
+    def cache_hit_frac(self) -> float:
+        """Fraction of the prompt served from the prefix cache."""
+        return self.prefix_hit_tokens / max(int(self.prompt.size), 1)
+
 
 class DecodeEngine:
     """Slot-based continuous-batching scheduler over compiled step fns.
@@ -120,7 +161,8 @@ class DecodeEngine:
                  buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS,
                  seed: Optional[int] = None,
                  registry: Optional[_metrics.Registry] = None,
-                 tracker: Optional[_ct.CompileTracker] = None):
+                 tracker: Optional[_ct.CompileTracker] = None,
+                 slo: Optional[SloConfig] = None):
         import jax.numpy as jnp
         self._jnp = jnp
         self._prefill_fn = prefill
@@ -152,6 +194,17 @@ class DecodeEngine:
         self._free = deque(range(B))
         self._queue: deque = deque()
         self._ids = itertools.count()
+        # -- request-scoped observability --------------------------------
+        self._engine_id = next(_ENGINE_IDS)
+        # perf_counter -> wall-clock anchor: lifecycle events must land
+        # on the same epoch timeline as the trace-scope spans, but the
+        # engine's internal timestamps stay monotonic perf_counter
+        self._wall_anchor = time.time() - time.perf_counter()
+        self.request_log = _requests.RequestLog()
+        self.slo: Optional[SloConfig] = None
+        self._win_ttft: WindowedQuantiles = None  # set by configure_slo
+        self._win_tps: WindowedQuantiles = None
+        self.configure_slo(slo)
         # -- metrics ------------------------------------------------------
         reg = self.metrics = registry or _metrics.Registry()
         self._m_requests = reg.counter(
@@ -185,6 +238,20 @@ class DecodeEngine:
             "engine_request_tokens_per_sec", "per-request goodput: "
             "tokens emitted / (finish - submit)",
             buckets=_GOODPUT_BUCKETS)
+        self._m_win_ttft = reg.gauge(
+            "engine_ttft_window_seconds", "rolling TTFT quantile over "
+            "the SLO window (label q = p50|p95|p99) — the cumulative "
+            "histogram cannot answer this once traffic has history")
+        self._m_win_tps = reg.gauge(
+            "engine_tokens_per_sec_window", "rolling per-request "
+            "goodput quantile over the SLO window (label q)")
+        self._m_burn = reg.gauge(
+            "engine_slo_burn_rate", "TTFT SLO burn rate: windowed "
+            "violation fraction / error budget (0 without a "
+            "configured SLO)")
+        self._m_rejected = reg.counter(
+            "engine_requests_rejected_total",
+            "submissions rejected at validation, by reason")
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -205,37 +272,138 @@ class DecodeEngine:
                    batch=batch, cache_len=cache_len, buckets=buckets,
                    seed=seed, **kw)
 
+    # -- request-scoped observability --------------------------------------
+    def configure_slo(self, slo: Optional[SloConfig]):
+        """Install (or with ``None`` clear) the TTFT SLO this engine's
+        `/healthz` evaluates over its rolling window. Resets the window
+        estimators to the new window length — callable after
+        construction (the ``paddle_tpu serve --ttft_slo_ms`` path)."""
+        self.slo = slo
+        win = slo.window_s if slo is not None else 60.0
+        self._win_ttft = WindowedQuantiles(window_s=win)
+        self._win_tps = WindowedQuantiles(window_s=win)
+
+    def _wall(self, perf_t: float) -> float:
+        return self._wall_anchor + perf_t
+
+    def _ev(self, req: EngineRequest, name: str, ph: str, perf_t: float,
+            **args):
+        """One lifecycle event on this request's async trace track."""
+        _chrome.record_event(name, self._wall(perf_t), ph, req.trace_id,
+                             args=args or None)
+
+    def _reject(self, rid: int, reason: str, msg: str) -> ValueError:
+        """Account + trace a rejected submission; returns (does not
+        raise) the ValueError so call sites read ``raise self._reject``."""
+        now = time.perf_counter()
+        self._m_rejected.inc(reason=reason)
+        _chrome.record_event(
+            "request_rejected", self._wall(now), "n",
+            f"eng{self._engine_id}.r{rid}",
+            args={"rid": rid, "reason": reason})
+        # a rejection leaves a record too (observe/requests.py promises
+        # one per finished OR rejected request): no measured components,
+        # so attribute() reports dominance "none" and slowest(by latency)
+        # skips it, but a rejection storm shows in summary()'s by_reason
+        rec = {"rid": rid, "engine": self._engine_id,
+               "trace_id": f"eng{self._engine_id}.r{rid}",
+               "submit_ts": round(self._wall(now), 6),
+               "finish_reason": f"rejected:{reason}",
+               "prompt_tokens": None, "tokens": 0,
+               "queue_wait_s": None, "prefill_own_s": None,
+               "prefill_stall_s": None, "decode_s": None,
+               "ttft_s": None, "latency_s": None, "cache_hit_frac": 0.0}
+        self.request_log.add(rec)
+        _requests.default_request_log().add(rec)
+        return ValueError(msg)
+
+    def _enqueue(self, req: EngineRequest) -> EngineRequest:
+        """Shared submit tail: queue the request and open its trace
+        track (async ``request`` slice + nested ``queued`` slice)."""
+        req.trace_id = f"eng{self._engine_id}.r{req.rid}"
+        self._queue.append(req)
+        self._m_requests.inc()
+        self._m_queue.set(len(self._queue))
+        self._ev(req, "request", "b", req.submit_t, rid=req.rid,
+                 prompt_tokens=int(req.prompt.size), max_new=req.max_new)
+        self._ev(req, "queued", "b", req.submit_t)
+        return req
+
+    def _record_request(self, req: EngineRequest):
+        """One flat record into the engine's bounded request ring AND
+        the process default (``observe.default_request_log()``)."""
+        def r6(v):
+            return round(v, 6) if v is not None else None
+
+        rec = {"rid": req.rid, "engine": self._engine_id,
+               "trace_id": req.trace_id,
+               "submit_ts": round(self._wall(req.submit_t), 6),
+               "finish_reason": req.finish_reason,
+               "prompt_tokens": int(req.prompt.size),
+               "tokens": len(req.tokens),
+               "queue_wait_s": r6(req.queue_wait_s),
+               "prefill_own_s": r6(req.prefill_own_s),
+               "prefill_stall_s": r6(req.prefill_stall_s),
+               "decode_s": r6(req.decode_s),
+               "ttft_s": r6(req.ttft_s),
+               "latency_s": r6(req.latency_s),
+               "cache_hit_frac": round(req.cache_hit_frac, 4)}
+        self.request_log.add(rec)
+        _requests.default_request_log().add(rec)
+
+    def _slo_burn_rate(self) -> float:
+        if self.slo is None:
+            return 0.0
+        return self.slo.burn_rate(
+            self._win_ttft.fraction_over(self.slo.ttft_s))
+
+    def _update_window_gauges(self):
+        """Refresh the rolling-quantile gauges + burn rate. Called when
+        requests finish (request-grain, not step-grain, so the sort
+        stays off the per-token path) AND on every read of the gauges
+        (``health()`` / ``metrics_text()``): window samples expire with
+        time, so a gauge last written mid-breach would otherwise report
+        that breach forever once traffic stops, contradicting the
+        live-computed `/healthz`."""
+        ttft = self._win_ttft.quantiles((0.5, 0.95, 0.99))
+        tps = self._win_tps.quantiles((0.5, 0.95, 0.99))
+        for lbl, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+            self._m_win_ttft.set(ttft[q], q=lbl)
+            self._m_win_tps.set(tps[q], q=lbl)
+        self._m_burn.set(self._slo_burn_rate())
+
     # -- request API -------------------------------------------------------
     def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
                top_k: int = 0, eos_id: Optional[int] = None
                ) -> EngineRequest:
         """Queue one request; returns its (live) EngineRequest record."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        rid = next(self._ids)
         if prompt.size < 1:
-            raise ValueError("submit: empty prompt")
+            raise self._reject(rid, "empty_prompt", "submit: empty prompt")
         if max_new < 1:
-            raise ValueError(f"submit: max_new must be >= 1, "
-                             f"got {max_new}")
+            raise self._reject(rid, "bad_max_new",
+                               f"submit: max_new must be >= 1, "
+                               f"got {max_new}")
         from paddle_tpu.core import ragged
         if prompt.size > self.buckets[-1]:
             # beyond the largest bucket there is no compiled prefill
             # program (AOT artifacts ship exactly one per bucket)
-            raise ValueError(
+            raise self._reject(
+                rid, "prompt_too_long",
                 f"submit: prompt length {prompt.size} exceeds the "
                 f"largest prefill bucket {self.buckets[-1]}")
         bucket = ragged.bucket_length(prompt.size, self.buckets)
         if prompt.size + max_new > self.cache_len:
-            raise ValueError(
+            raise self._reject(
+                rid, "exceeds_cache",
                 f"submit: {prompt.size} prompt + {max_new} new tokens "
                 f"exceed cache_len {self.cache_len}")
         req = EngineRequest(
-            rid=next(self._ids), prompt=prompt, max_new=int(max_new),
+            rid=rid, prompt=prompt, max_new=int(max_new),
             temperature=float(temperature), top_k=int(top_k),
             eos_id=eos_id, bucket=bucket, submit_t=time.perf_counter())
-        self._queue.append(req)
-        self._m_requests.inc()
-        self._m_queue.set(len(self._queue))
-        return req
+        return self._enqueue(req)
 
     @property
     def active_count(self) -> int:
@@ -261,20 +429,40 @@ class DecodeEngine:
         req.status, req.finish_reason, req.finish_t = "done", reason, now
         self._m_completed.inc(reason=reason)
         if req.latency_s and req.latency_s > 0:
-            self._m_goodput.observe(len(req.tokens) / req.latency_s)
+            goodput = len(req.tokens) / req.latency_s
+            self._m_goodput.observe(goodput)
+            self._win_tps.observe(goodput)
         slot = req.slot
         if slot >= 0:
             self._active[slot] = False
             self._slot_req[slot] = None
             self._free.append(slot)
+        if req.decode_open:
+            self._ev(req, "decode", "e", now)
+            req.decode_open = False
+        self._ev(req, "finished", "n", now, reason=reason,
+                 tokens=len(req.tokens))
+        self._ev(req, "request", "e", now)
+        self._record_request(req)
+        self._update_window_gauges()
 
     def _emit(self, req: EngineRequest, tok: int, now: float) -> bool:
         """Record one emitted token; True when the request finished."""
         req.tokens.append(int(tok))
         self._m_tokens.inc()
+        finishing = ((req.eos_id is not None and tok == req.eos_id)
+                     or len(req.tokens) >= req.max_new)
         if req.first_token_t is None:
             req.first_token_t = now
-            self._m_ttft_s.observe(now - req.submit_t)
+            ttft = now - req.submit_t
+            self._m_ttft_s.observe(ttft)
+            self._win_ttft.observe(ttft)
+            self._ev(req, "prefill", "e", now)
+            self._ev(req, "first_token", "n", now,
+                     ttft_ms=round(1000 * ttft, 3))
+            if not finishing:
+                self._ev(req, "decode", "b", now)
+                req.decode_open = True
         if req.eos_id is not None and tok == req.eos_id:
             self._finish(req, "eos", now)
             return True
@@ -291,6 +479,10 @@ class DecodeEngine:
             now = time.perf_counter()
             req.prefill_t = now
             self._m_wait_s.observe(now - req.submit_t)
+            self._ev(req, "queued", "e", now)
+            self._ev(req, "admitted", "n", now, slot=slot,
+                     queue_wait_ms=round(1000 * (now - req.submit_t), 3))
+            self._ev(req, "prefill", "b", now)
             padded = np.zeros((1, req.bucket), np.int32)
             padded[0, :req.prompt.size] = req.prompt
             t0 = time.perf_counter()
@@ -302,8 +494,11 @@ class DecodeEngine:
                 self._seed())
             tok = int(np.asarray(tok))
             now = time.perf_counter()
+            req.prefill_own_s = now - t0
             self._m_prefill_s.observe(now - t0)
             self._m_prefills.inc()
+            self._ev(req, "prefill_chunk", "n", now,
+                     tokens=int(req.prompt.size), bucket=req.bucket)
             req.slot, req.status = slot, "running"
             self._slot_req[slot] = req
             if self._emit(req, tok, now):
@@ -381,27 +576,64 @@ class DecodeEngine:
 
     # -- observability -----------------------------------------------------
     def health(self) -> dict:
-        return {"requests": int(self._m_requests.value()),
-                "completed": sum(
-                    int(self._m_completed.value(reason=r))
-                    for r in ("eos", "max_tokens")),
-                "tokens": int(self._m_tokens.value()),
-                "decode_steps": int(self._m_steps.value()),
-                "queue_depth": self.queue_depth,
-                "slots_active": self.active_count,
-                "slots_total": self.batch,
-                "cache_len": self.cache_len,
-                "prefill_buckets": list(self.buckets)}
+        doc = {"requests": int(self._m_requests.value()),
+               "completed": sum(
+                   int(self._m_completed.value(reason=r))
+                   for r in ("eos", "max_tokens")),
+               "tokens": int(self._m_tokens.value()),
+               "decode_steps": int(self._m_steps.value()),
+               "queue_depth": self.queue_depth,
+               "slots_active": self.active_count,
+               "slots_total": self.batch,
+               "cache_len": self.cache_len,
+               "prefill_buckets": list(self.buckets)}
+        self._update_window_gauges()
+        ttft = self._win_ttft.quantiles((0.5, 0.95, 0.99))
+        doc["window"] = {
+            "window_s": self._win_ttft.window_s,
+            "requests": self._win_ttft.count(),
+            "ttft_p50_s": round(ttft[0.5], 6),
+            "ttft_p95_s": round(ttft[0.95], 6),
+            "ttft_p99_s": round(ttft[0.99], 6),
+            "tokens_per_sec_p50": round(self._win_tps.quantile(0.5), 3)}
+        if self.slo is not None:
+            burn = self._slo_burn_rate()
+            doc["slo"] = {"ttft_s": self.slo.ttft_s,
+                          "target": self.slo.target,
+                          "window_s": self.slo.window_s,
+                          "burn_threshold": self.slo.burn_threshold,
+                          "ttft_burn_rate": round(burn, 4)}
+            if burn > self.slo.burn_threshold:
+                # degraded, NOT unhealthy: /healthz stays 200 (load
+                # balancers keep routing) while the reason is machine-
+                # readable — the hook the SLO-aware scheduler steers on
+                doc["status"] = "degraded"
+                doc["degraded_reason"] = (
+                    f"ttft_slo_burn_rate {burn:.2f} > "
+                    f"{self.slo.burn_threshold} (p99 "
+                    f"{ttft[0.99]:.4f}s vs slo {self.slo.ttft_s}s over "
+                    f"{self._win_ttft.count()} requests)")
+        return doc
+
+    def requests_doc(self, k: int = 10) -> dict:
+        """The `/requests` section: aggregate summary + top-k slowest
+        with attributed latency components."""
+        doc = self.request_log.summary()
+        doc["slowest_by_ttft"] = self.request_log.slowest(k, by="ttft_s")
+        return doc
 
     def metrics_text(self) -> str:
+        self._update_window_gauges()   # expire-on-read: see the docstring
         return self.metrics.render_prometheus()
 
     def serve(self, host: str = "127.0.0.1", port: int = 0):
-        """/metrics + /healthz over this engine's registry; caller owns
-        ``close()``."""
+        """/metrics + /healthz + /requests over this engine's registry;
+        caller owns ``close()``."""
         from paddle_tpu.observe.health import HealthServer
         return HealthServer(registry=self.metrics, health_fn=self.health,
-                            host=host, port=port)
+                            host=host, port=port,
+                            requests_fn=self.requests_doc,
+                            metrics_fn=self.metrics_text)
 
     def compile_counts(self) -> Dict[str, int]:
         """Compilations the tracker charged to this engine's two
@@ -463,7 +695,8 @@ class PagedDecodeEngine(DecodeEngine):
                  chunk_buckets: Optional[Sequence[int]] = None,
                  seed: Optional[int] = None,
                  registry: Optional[_metrics.Registry] = None,
-                 tracker: Optional[_ct.CompileTracker] = None):
+                 tracker: Optional[_ct.CompileTracker] = None,
+                 slo: Optional[SloConfig] = None):
         from paddle_tpu.serving import blocks as _blocks
         bs = int(block_size)
         if bs < 1 or cache_len % bs:
@@ -496,7 +729,8 @@ class PagedDecodeEngine(DecodeEngine):
                 storm_threshold=spans * len(tuple(chunk_buckets)) + 2)
         super().__init__(prefill, decode, params, cache, batch=batch,
                          cache_len=cache_len, buckets=chunk_buckets,
-                         seed=seed, registry=registry, tracker=tracker)
+                         seed=seed, registry=registry, tracker=tracker,
+                         slo=slo)
         self.block_size = bs
         self.pages_per_slot = cache_len // bs
         self.num_blocks = int(num_blocks if num_blocks is not None
@@ -585,13 +819,16 @@ class PagedDecodeEngine(DecodeEngine):
         ``len(prompt) + max_new <= cache_len`` is accepted and prefilled
         in chunks."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        rid = next(self._ids)
         if prompt.size < 1:
-            raise ValueError("submit: empty prompt")
+            raise self._reject(rid, "empty_prompt", "submit: empty prompt")
         if max_new < 1:
-            raise ValueError(f"submit: max_new must be >= 1, "
-                             f"got {max_new}")
+            raise self._reject(rid, "bad_max_new",
+                               f"submit: max_new must be >= 1, "
+                               f"got {max_new}")
         if prompt.size + max_new > self.cache_len:
-            raise ValueError(
+            raise self._reject(
+                rid, "exceeds_cache",
                 f"submit: {prompt.size} prompt + {max_new} new tokens "
                 f"exceed cache_len {self.cache_len}")
         need = -(-(prompt.size + max_new) // self.block_size)
@@ -599,18 +836,16 @@ class PagedDecodeEngine(DecodeEngine):
             # _admit reserves the worst-case block count up front; a
             # request needing more blocks than the pool HAS could never
             # reserve and would livelock the FIFO queue head forever
-            raise ValueError(
+            raise self._reject(
+                rid, "exceeds_pool",
                 f"submit: {prompt.size} prompt + {max_new} new tokens "
                 f"need {need} blocks, exceeding the pool's "
                 f"{self.num_blocks}")
         req = EngineRequest(
-            rid=next(self._ids), prompt=prompt, max_new=int(max_new),
+            rid=rid, prompt=prompt, max_new=int(max_new),
             temperature=float(temperature), top_k=int(top_k),
             eos_id=eos_id, bucket=0, submit_t=time.perf_counter())
-        self._queue.append(req)
-        self._m_requests.inc()
-        self._m_queue.set(len(self._queue))
-        return req
+        return self._enqueue(req)
 
     @property
     def idle(self) -> bool:
@@ -688,6 +923,11 @@ class PagedDecodeEngine(DecodeEngine):
             now = time.perf_counter()
             req.prefill_t = now
             self._m_wait_s.observe(now - req.submit_t)
+            self._ev(req, "queued", "e", now)
+            self._ev(req, "admitted", "n", now, slot=slot,
+                     queue_wait_ms=round(1000 * (now - req.submit_t), 3),
+                     hit_blocks=len(hits), reserved_blocks=need)
+            self._ev(req, "prefill", "b", now)
             req.slot, req.status = slot, "prefilling"
             self._slot_req[slot] = req
             self._prefilling.append(slot)
@@ -726,6 +966,8 @@ class PagedDecodeEngine(DecodeEngine):
         self._slot_off[slot] = off + K
         req.prefix_hit_tokens += K
         self._m_prefix_hits.inc(len(blocks))
+        self._ev(req, "prefix_adopt", "n", time.perf_counter(),
+                 hit_blocks=len(blocks), tokens=K)
         return True
 
     def _prefill_chunk(self, finished: List[EngineRequest]):
@@ -771,16 +1013,24 @@ class PagedDecodeEngine(DecodeEngine):
         # prompt completion): a concurrent same-prefix request adopts
         # them instead of re-prefilling — a burst of shared-prefix
         # arrivals cold-prefills the prefix exactly once
+        cold = 0
         for j in range(off // self.block_size,
                        (off + c) // self.block_size):
             self.pool.publish(self._slot_hashes[slot][j],
                               int(self._pages[slot, j]))
             self._m_prefix_miss.inc()
+            cold += 1
+        self._ev(req, "prefill_chunk", "n", now, tokens=int(c),
+                 cold_blocks=cold,
+                 hit_blocks=req.prefix_hit_tokens // self.block_size,
+                 stalled_decoders=int(self._active.sum()) if stalled
+                 else 0)
         self._slot_off[slot] = off + c
         if off + c < req.prompt.size:
             self._prefilling.append(slot)   # round-robin: one chunk per
             return                          # step, decode in between
         # final chunk: emit the sampled first token
+        req.prefill_own_s = self._slot_prefill_s[slot]
         self._m_prefill_s.observe(self._slot_prefill_s[slot])
         self._m_prefills.inc()
         req.status = "running"

@@ -78,6 +78,14 @@ class _StepMonitor:
             "optimizer-state bytes resident on ONE device — under "
             "ZeRO-1 (DistConfig zero_stage=1) this is ~1/data-axis of "
             "the replicated figure")
+        self.bottleneck_frac = reg.gauge(
+            "train_bottleneck_fraction",
+            "last step's time split by component (label component = "
+            "input|compute|sync; observe/bottleneck.py semantics)")
+        self.bottleneck_steps = reg.counter(
+            "train_steps_bottleneck_total",
+            "steps by bottleneck classification (label bottleneck = "
+            "input_bound|compute_bound|sync_bound)")
         # set unconditionally: a stateless-optimizer run must overwrite
         # a previous run's value on the shared registry, not expose it
         self.opt_bytes_gauge.set(self._opt_bytes)
@@ -111,10 +119,15 @@ class _StepMonitor:
             self.host_gauge.set(host["rss_bytes"])
 
     def step(self, *, step, pass_id, batch_id, cost, batch_size, dt,
-             flops=None, compile_count=0):
+             flops=None, compile_count=0, feed_s=0.0, dispatch_s=0.0,
+             sync_s=0.0):
         """One trained batch: update registry, ring the flight recorder,
         and emit the JSONL record. ``flops`` is the lowered-HLO step
-        cost when known (None → MFU reports 0)."""
+        cost when known (None → MFU reports 0). ``feed_s`` /
+        ``dispatch_s`` / ``sync_s`` are the step's span components;
+        together with the modeled compute time (flops / peak) they
+        classify the step input|compute|sync-bound
+        (observe/bottleneck.py)."""
         recompile = self.tag_recompile(dt)
         self.steps.inc()
         self.examples.inc(batch_size)
@@ -127,6 +140,14 @@ class _StepMonitor:
                if self._peak_flops else None)
         if mfu is not None:
             self.mfu_gauge.set(mfu)
+        est_compute = (flops / self._peak_flops
+                       if flops and self._peak_flops else None)
+        label, frac = observe.attribute_step(feed_s, dispatch_s, sync_s,
+                                             est_compute)
+        for comp, f in frac.items():
+            self.bottleneck_frac.set(round(f, 6), component=comp)
+        if label != "unknown":
+            self.bottleneck_steps.inc(bottleneck=label)
         rec = dict(kind="step", step=step, pass_id=pass_id,
                    batch_id=batch_id, loss=round(cost, 6),
                    wall_time_s=round(dt, 6),
@@ -134,7 +155,11 @@ class _StepMonitor:
                    mfu=round(mfu, 6) if mfu is not None else 0.0,
                    compile_count=int(compile_count),
                    opt_state_bytes=self._opt_bytes,
-                   recompile=recompile)
+                   recompile=recompile,
+                   bottleneck=label,
+                   frac_input=round(frac["input"], 4),
+                   frac_compute=round(frac["compute"], 4),
+                   frac_sync=round(frac["sync"], 4))
         # the flight ring ALWAYS sees the step — a post-mortem must not
         # depend on a metrics sink having been configured
         observe.default_flight_recorder().record(rec)
@@ -619,7 +644,18 @@ class SGD:
             # after a restore; feeds arrive converted + device-resident
             feed_iter = (iter(pipe) if pipe is not None
                          else self._prefetch_feeds(reader, feeder))
-            for batch_id, feeds in enumerate(feed_iter):
+            batch_id = -1
+            while True:
+                # feed wait timed explicitly: the input component of the
+                # step's bottleneck attribution (sync path: convert+H2D
+                # of the NEXT batch; pipelined: the staging-ring get)
+                feed_t0 = time.perf_counter()
+                try:
+                    feeds = next(feed_iter)
+                except StopIteration:
+                    break
+                feed_s = time.perf_counter() - feed_t0
+                batch_id += 1
                 event_handler(events.BeginIteration(pass_id, batch_id))
                 step_fn = self._pick_train_step(feeds)
                 # feed-shape signature: params/opt/state shapes are fixed
@@ -640,12 +676,15 @@ class SGD:
                     with observe.trace_scope("dispatch"):
                         (loss, self.parameters.values, self.opt_state,
                          self.parameters.state, outs) = step_fn(*step_args)
+                dispatch_s = time.perf_counter() - step_t0
                 self._step += 1
                 self.evaluators.add_batch(outs)
                 # float(loss) is the host sync — per-step wall time must
                 # include it or async dispatch hides the real step time
+                sync_t0 = time.perf_counter()
                 with observe.trace_scope("host_sync"):
                     cost = float(loss)
+                sync_s = time.perf_counter() - sync_t0
                 step_dt = time.perf_counter() - step_t0
                 tracker = observe.default_compile_tracker()
                 tracker.record("train_step", sig, step_dt)
@@ -656,7 +695,8 @@ class SGD:
                 _, eps = monitor.step(
                     step=self._step - 1, pass_id=pass_id, batch_id=batch_id,
                     cost=cost, batch_size=bs, dt=step_dt, flops=flops,
-                    compile_count=tracker.count("train_step"))
+                    compile_count=tracker.count("train_step"),
+                    feed_s=feed_s, dispatch_s=dispatch_s, sync_s=sync_s)
                 if self._check_finite and not math.isfinite(cost):
                     from paddle_tpu.utils import enforce
                     try:

@@ -261,7 +261,13 @@ class TestServingBenchSmoke:
                 "benchmarks", "serving_bench.py"))
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
-        results = mod.main(["--smoke"])
+        trace_out = os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                                 f"req_trace_{os.getpid()}.json")
+        try:
+            results = mod.main(["--smoke", f"--trace-out={trace_out}"])
+        finally:
+            if os.path.exists(trace_out):
+                os.remove(trace_out)
         # throughput phase: the 6 Poisson requests; latency phase adds
         # 1 adversarial long prompt
         tp, lat = results["throughput"], results["latency"]
@@ -280,3 +286,14 @@ class TestServingBenchSmoke:
                 phase["engine_paged"]["blocks_total"]
         assert results["serving_paged_speedup"] > 0
         assert results["serving_paged_ttft_p99_ratio"] > 0
+        # per-request attribution replay: every request attributed
+        # (the joined-lifecycle invariant is asserted INSIDE the bench
+        # when --trace-out is given — reaching here means it held)
+        attr = results["attribution"]
+        assert attr["requests"] == 7
+        assert len(attr["slowest_by_ttft"]) == 7
+        comps = attr["slowest_by_ttft"][0]["attribution"]["components"]
+        assert set(comps) == {"queue_wait_s", "prefill_own_s",
+                              "prefill_stall_s", "decode_s"}
+        assert attr["victims"]["count"] >= 1
+        assert attr["victims"]["adversary_prompt_tokens"] == 56
