@@ -8,9 +8,10 @@ describes a v5e pod slice and `jit(...).lower().compile()` runs the full
 XLA:TPU pipeline — SPMD partitioning, collective insertion, and the
 latency-hiding scheduler — exactly as it would for 8 physical chips.
 
-This tool AOT-compiles the flagship ResNet-50 DP train step (the same
-builder contract as bench.py) over a v5e:2x4 mesh and extracts from the
-optimized, SCHEDULED HLO:
+This tool AOT-compiles a DP train step (the flagship ResNet-50 via the
+bench.py builder contract, or ``--model mlp`` — a three-layer Adam MLP
+that compiles in seconds, for iterating on collective patterns) over a
+v5e mesh and extracts from the optimized, SCHEDULED HLO:
 
   1. every async collective pair (`all-reduce-start` → `all-reduce-done`)
      with its tensor bytes;
@@ -19,14 +20,21 @@ optimized, SCHEDULED HLO:
      overlap the backward;
   3. an analytic step-time model: hidden collectives cost max(0,
      t_comm − t_overlapped_compute); with the measured single-chip step
-     time this yields the DP scaling efficiency the north star asks for.
+     time this yields the DP scaling efficiency the north star asks for;
+  4. with ``--num-slices N``: which collectives cross the slice (DCN)
+     boundary and at what size — under ``--zero2``/``--zero3`` the
+     hierarchical contract is that ONLY 1/N-sharded gradient tensors
+     cross DCN (ICI reduce-scatter inside the slice first), reported as
+     ``hierarchical_ok`` / ``largest_dcn_collective_bytes``.
 
 Reference protocol being matched: the 4-GPU speedup tables in
 /root/reference/benchmark/README.md:72-93 (their evidence was measured
 wall-clock; ours is the compiler's actual schedule + measured single-chip
 step time, the feasible substitute in a 1-chip environment).
 
-Usage:  python benchmarks/scaling_aot.py [--topology v5e:2x4] [--batch-per-chip 128]
+Usage:  python benchmarks/scaling_aot.py [--topology v5e:2x4]
+            [--batch-per-chip 128] [--zero 0..3 | --zero1/--zero2/--zero3]
+            [--model resnet50|mlp] [--num-slices N]
 """
 
 import argparse
@@ -42,11 +50,13 @@ import numpy as np
 
 
 def build_step(batch_per_chip, n_chips, mesh, batch_axes=("data",),
-               zero1=False):
-    """``zero1=True`` applies the ZeRO-1 weight-update sharding
+               zero_stage=0):
+    """``zero_stage>=1`` applies the ZeRO weight-update sharding
     (parallel/spmd.py): optimizer state + update shard over the ``data``
     axis, so the TPU pipeline forms reduce-scatter + post-update
-    all-gather instead of the full-gradient all-reduce."""
+    all-gather instead of the full-gradient all-reduce; stage 3 stores
+    the params as 1/N shards with on-use all-gathers. Returns
+    (jitted_fn, abstract_args, largest_param_bytes)."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -74,9 +84,14 @@ def build_step(batch_per_chip, n_chips, mesh, batch_axes=("data",),
 
     values_sds, state_sds, opt_sds = jax.eval_shape(_make)
     fwd = topo.compile()
-    dist = pspmd.DistConfig(mesh, zero_stage=1) if zero1 else None
+    dist = (pspmd.DistConfig(mesh, zero_stage=zero_stage)
+            if zero_stage >= 1 else None)
+    comp_sh = dist.param_shardings(values_sds) if dist is not None else None
 
     def train_step(p, o, s, images, labels, step):
+        if dist is not None and dist.zero_stage >= 3:
+            p = jax.lax.with_sharding_constraint(p, comp_sh)
+
         def loss_fn(p):
             outs, ns = fwd(p, s, {"image": Value(images),
                                   "label": Value(labels)}, is_training=True)
@@ -97,15 +112,83 @@ def build_step(batch_per_chip, n_chips, mesh, batch_axes=("data",),
                 jax.ShapeDtypeStruct((gb, 224, 224, 3), jnp.float32),
                 jax.ShapeDtypeStruct((gb,), jnp.int32),
                 jax.ShapeDtypeStruct((), jnp.int32))
-    opt_sharding = (dist.state_shardings(opt_sds) if dist is not None
-                    else jax.tree.map(lambda _: rep, abstract[1]))
-    shardings = (jax.tree.map(lambda _: rep, abstract[0]),
-                 opt_sharding,
+    if dist is not None:
+        opt_sharding = dist.state_shardings(opt_sds)
+        param_sharding = dist.store_shardings(values_sds)
+    else:
+        opt_sharding = jax.tree.map(lambda _: rep, abstract[1])
+        param_sharding = jax.tree.map(lambda _: rep, abstract[0])
+    shardings = (param_sharding, opt_sharding,
                  jax.tree.map(lambda _: rep, abstract[2]), dat, dat, rep)
     jf = jax.jit(train_step, in_shardings=shardings,
                  out_shardings=(rep, shardings[0], shardings[1],
                                 shardings[2]))
-    return jf, abstract
+    sizes = [int(np.prod(v.shape)) * v.dtype.itemsize
+             for v in jax.tree_util.tree_leaves(values_sds)]
+    return jf, abstract, {"largest": max(sizes), "total": sum(sizes)}
+
+
+def build_step_mlp(batch_per_chip, n_chips, mesh, batch_axes=("data",),
+                   zero_stage=0, dim=1024, hidden=4096):
+    """A three-layer Adam MLP train step — big enough that its param
+    collectives dominate scalar bookkeeping, small enough that the
+    deviceless XLA:TPU compile takes seconds (the ResNet-50 path takes
+    ~20 min on this one-core host), for iterating on the ZeRO collective
+    patterns and the multi-slice DCN analysis."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.parallel import spmd as pspmd
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    opt = paddle.optimizer.Adam(learning_rate=0.02)
+    params = {"w1": jax.ShapeDtypeStruct((dim, hidden), jnp.float32),
+              "b1": jax.ShapeDtypeStruct((hidden,), jnp.float32),
+              "w2": jax.ShapeDtypeStruct((hidden, hidden), jnp.float32),
+              "b2": jax.ShapeDtypeStruct((hidden,), jnp.float32),
+              "w3": jax.ShapeDtypeStruct((hidden, dim), jnp.float32)}
+    opt_state = {k: (v, v) for k, v in params.items()}   # Adam (m, v)
+    dist = (pspmd.DistConfig(mesh, zero_stage=zero_stage)
+            if zero_stage >= 1 else None)
+    rep = NamedSharding(mesh, P())
+    dat = NamedSharding(mesh, P(batch_axes))
+    if dist is not None:
+        store = dist.store_shardings(params)
+        comp = dist.param_shardings(params)
+        upd = dist.zero_update_shardings(params)
+        st = dist.state_shardings(opt_state)
+    else:
+        store = {k: rep for k in params}
+        st = {k: (rep, rep) for k in params}
+
+    def train_step(p, o, x, y, step):
+        if dist is not None and dist.zero_stage >= 3:
+            p = jax.lax.with_sharding_constraint(p, comp)
+
+        def loss_fn(p):
+            h = jnp.maximum(x @ p["w1"] + p["b1"], 0.0)
+            h = jnp.maximum(h @ p["w2"] + p["b2"], 0.0)
+            return jnp.mean((h @ p["w3"] - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        if dist is not None:
+            np_, no_ = pspmd.zero_constrained_update(
+                dist, opt, step, grads, p, o, update_shardings=upd,
+                keep_shardings=store, state_shardings=st)
+        else:
+            np_, no_ = opt.update(step, grads, p, o)
+        return loss, np_, no_
+
+    gb = batch_per_chip * n_chips
+    abstract = (params, opt_state,
+                jax.ShapeDtypeStruct((gb, dim), jnp.float32),
+                jax.ShapeDtypeStruct((gb, dim), jnp.float32),
+                jax.ShapeDtypeStruct((), jnp.int32))
+    jf = jax.jit(train_step, in_shardings=(store, st, dat, dat, rep),
+                 out_shardings=(rep, store, st))
+    sizes = [int(np.prod(v.shape)) * 4
+             for v in jax.tree_util.tree_leaves(params)]
+    return jf, abstract, {"largest": max(sizes), "total": sum(sizes)}
 
 
 _SIZE = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "s8": 1,
@@ -131,12 +214,13 @@ def analyze_schedule(txt: str):
     """Parse the scheduled entry computation.
 
     Two evidence modes, depending on what the XLA build emits:
-    - async ``all-reduce-start``/``-done`` pairs → per-window overlap
-      (compute ops scheduled inside each window);
-    - sync ``all-reduce`` ops in a scheduled module (this build) →
-      PLACEMENT evidence: a gradient all-reduce interleaved mid-backward
-      (compute scheduled after it) is what lets the runtime overlap it;
-      a clump at the end of the schedule cannot overlap anything.
+    - async ``*-start``/``*-done`` pairs (all-reduce, all-gather,
+      reduce-scatter) → per-window overlap (compute ops scheduled inside
+      each window);
+    - sync collectives in a scheduled module (this build) → PLACEMENT
+      evidence: a gradient collective interleaved mid-backward (compute
+      scheduled after it) is what lets the runtime overlap it; a clump
+      at the end of the schedule cannot overlap anything.
 
     Shape parsing is layout-robust: TPU shapes carry tile annotations
     with parens (``{3,2,1,0:T(8,128)(2,1)}``), so the op line is split
@@ -145,15 +229,18 @@ def analyze_schedule(txt: str):
 
     entry = txt[txt.index("ENTRY"):]
     lines = entry.splitlines()
-    events = []       # (idx, kind, name, bytes)
+    events = []       # (idx, kind, name, bytes, op)
     start_of = {}
     compute_lines = []
     op_re = re.compile(
         r"\s*%([\w.\-]+)\s*=\s*(.*?)\b"
-        r"(all-reduce-start|all-reduce-done|all-reduce|reduce-scatter|"
-        r"all-gather|fusion|convolution|custom-call)\(")
+        r"(all-reduce-start|all-reduce-done|all-reduce|"
+        r"all-gather-start|all-gather-done|all-gather|"
+        r"reduce-scatter-start|reduce-scatter-done|reduce-scatter|"
+        r"fusion|convolution|custom-call)\(")
     megascale_send_bytes = 0
     megascale_sends = 0
+    megascale_send_max = 0
     for i, ln in enumerate(lines):
         # multi-slice modules express the cross-slice (DCN) phase of the
         # hierarchical all-reduce as megascale-annotated send/recv host
@@ -161,10 +248,12 @@ def analyze_schedule(txt: str):
         if "megascale_transfer_type" in ln and re.match(r"\s*%send", ln):
             sig_m = re.match(r"\s*%[\w.\-]+ = (.*?)\bsend\(", ln)
             if sig_m:
-                megascale_send_bytes += _shape_bytes(sig_m.group(1))
+                b = _shape_bytes(sig_m.group(1))
+                megascale_send_bytes += b
+                megascale_send_max = max(megascale_send_max, b)
                 megascale_sends += 1
         # XLA:TPU lowers reduce-scatter to a kCustom fusion calling an
-        # %all-reduce-scatter computation (the --zero1 grad sync): count
+        # %all-reduce-scatter computation (the --zero grad sync): count
         # the call site as the collective it is (matcher shared with
         # paddle_tpu.parallel.spmd.zero_collective_evidence)
         if FUSED_REDUCE_SCATTER_RE.search(ln):
@@ -172,34 +261,61 @@ def analyze_schedule(txt: str):
                              ln)
             if sig_m:
                 events.append((i, "reduce-scatter", f"fused_rs.{i}",
-                               _shape_bytes(sig_m.group(1))))
+                               _shape_bytes(sig_m.group(1)),
+                               "reduce-scatter"))
             continue
         m = op_re.match(ln)
         if not m:
             continue
         name, sig, kind = m.group(1), m.group(2), m.group(3)
-        if kind == "all-reduce-start":
-            # async start's shape is the tuple (operand, result) — the
-            # wire traffic is ONE copy of the gradient, not both halves
-            events.append((i, "start", name, _shape_bytes(sig) // 2))
-            start_of[name] = i
-        elif kind == "all-reduce-done":
-            dep = re.search(r"all-reduce-done\(.*?%?([\w.\-]+)\)", ln)
-            events.append((i, "done", dep.group(1) if dep else name, 0))
+        if kind.endswith("-start"):
+            op = kind[:-len("-start")]
+            # async start's shape is the (operand, result) tuple — the
+            # wire traffic of an all-reduce is ONE copy of the gradient,
+            # not both halves; gathers/scatters carry the bigger half
+            b = _shape_bytes(sig)
+            events.append((i, "start", name,
+                           b // 2 if op == "all-reduce" else b, op))
+            # the start line carries the replica_groups: keep them so
+            # the DCN classifier sees async collectives too (a slice-
+            # spanning async gather must not escape hierarchical_ok)
+            start_of[name] = (i, _parse_group(lines[i]))
+        elif kind.endswith("-done"):
+            dep = re.search(kind + r"\(.*?%?([\w.\-]+)\)", ln)
+            # the done's own shape is the collective RESULT (shard for
+            # reduce-scatter, full tensor for all-gather/all-reduce)
+            events.append((i, "done", dep.group(1) if dep else name,
+                           _shape_bytes(sig), kind[:-len("-done")]))
         elif kind in ("all-reduce", "reduce-scatter", "all-gather"):
-            events.append((i, kind, name, _shape_bytes(sig)))
+            events.append((i, kind, name, _shape_bytes(sig), kind))
         else:
             compute_lines.append((i, kind, ln))
     windows = []
-    for i, k, name, nbytes in events:
+    for i, k, name, nbytes, op in events:
         if k == "done":
-            s = start_of.get(name)
-            if s is not None:
-                sbytes = next(b for (j, kk, n2, b) in events
-                              if j == s and kk == "start")
+            entry_s = start_of.get(name)
+            if entry_s is not None:
+                s, group = entry_s
+                sbytes, sop = next(
+                    (b, o) for (j, kk, n2, b, o) in events
+                    if j == s and kk == "start")
                 inside = [c for c in compute_lines if s < c[0] < i]
+                # the done op's result shape is the true collective
+                # result (shard for reduce-scatter, full for gather) —
+                # the start tuple bundles operand+result, which would
+                # feed the (g-1)x reduce-scatter wire factor ~g-fold
+                # too many bytes
                 windows.append({"start_line": s, "done_line": i,
-                                "bytes": sbytes,
+                                "bytes": nbytes if nbytes else sbytes,
+                                "op": sop,
+                                "group_size": len(group) if group
+                                else None,
+                                "group_example": group[:16] if group
+                                else None,
+                                "group_min": min(group) if group
+                                else None,
+                                "group_max": max(group) if group
+                                else None,
                                 "compute_ops_inside": len(inside),
                                 "conv_ops_inside": sum(
                                     1 for c in inside
@@ -209,7 +325,7 @@ def analyze_schedule(txt: str):
     n_lines = max(1, len(lines))
     sync = []
     unparsed = []
-    for (i, k, name, b) in events:
+    for (i, k, name, b, op) in events:
         if k not in ("all-reduce", "reduce-scatter", "all-gather"):
             continue
         after = sum(1 for j in comp_idx if j > i)
@@ -228,12 +344,15 @@ def analyze_schedule(txt: str):
                      "compute_ops_after": after,
                      "group_size": len(group) if group else None,
                      "group_example": group[:16] if group else None,
+                     "group_min": min(group) if group else None,
+                     "group_max": max(group) if group else None,
                      "group_unparsed": group_unparsed})
     return {"async_windows": windows, "sync_all_reduces": sync,
             "total_compute_ops": len(compute_lines),
             "unparsed_replica_groups": unparsed,
             "megascale_sends": megascale_sends,
-            "megascale_send_bytes": megascale_send_bytes}
+            "megascale_send_bytes": megascale_send_bytes,
+            "megascale_send_max_bytes": megascale_send_max}
 
 
 def _parse_topology_devices(name):
@@ -275,6 +394,14 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--topology", default="v5e:2x4")
     ap.add_argument("--batch-per-chip", type=int, default=128)
+    ap.add_argument("--model", choices=("resnet50", "mlp"),
+                    default="resnet50",
+                    help="resnet50: the flagship bench step (~20 min "
+                    "deviceless compile on one core); mlp: three-layer "
+                    "Adam MLP, compiles in seconds — for ZeRO collective "
+                    "/ multi-slice DCN analysis")
+    ap.add_argument("--mlp-dim", type=int, default=1024)
+    ap.add_argument("--mlp-hidden", type=int, default=4096)
     ap.add_argument("--single-chip-ms", type=float, default=50.3,
                     help="measured single-chip step ms at this per-chip "
                     "batch (BENCHMARKS.md resnet50 bs=128: 52.59 unfused, "
@@ -292,21 +419,30 @@ def main():
     ap.add_argument("--hlo-file", default=None,
                     help="analyze a previously dumped scheduled-HLO text "
                     "instead of recompiling (the deviceless XLA:TPU "
-                    "compile of this step takes ~20 min on one core)")
+                    "compile of the resnet50 step takes ~20 min on one "
+                    "core)")
     ap.add_argument("--num-devices", type=int, default=None,
                     help="per-slice device count for --hlo-file analysis "
                     "when the topology name has no AxB dims to parse")
     ap.add_argument("--dump-hlo", default=None,
                     help="save the compiled HLO text here for --hlo-file "
                     "reuse")
-    ap.add_argument("--zero1", action="store_true",
-                    help="ZeRO-1 weight-update sharding: opt state + "
-                    "update shard over the data axis; the schedule then "
-                    "shows reduce-scatter + post-update all-gather "
-                    "instead of the full-grad all-reduce "
+    ap.add_argument("--zero", type=int, default=0, choices=(0, 1, 2, 3),
+                    help="ZeRO stage: 1 shards opt state + update over "
+                    "the data axis (schedule shows reduce-scatter + "
+                    "post-update all-gather instead of the full-grad "
+                    "all-reduce); 2 shards the gradients; 3 stores "
+                    "params sharded with on-use all-gathers "
                     "(docs/howto_distributed.md)")
+    ap.add_argument("--zero1", dest="zero", action="store_const",
+                    const=1, help="alias for --zero 1")
+    ap.add_argument("--zero2", dest="zero", action="store_const",
+                    const=2, help="alias for --zero 2")
+    ap.add_argument("--zero3", dest="zero", action="store_const",
+                    const=3, help="alias for --zero 3")
     args = ap.parse_args()
 
+    param_info = None
     if args.hlo_file:
         n = args.num_devices or _parse_topology_devices(args.topology)
         if not n:
@@ -330,7 +466,8 @@ def main():
         if args.num_slices > 1:
             # hybrid mesh: slice-crossing axis (DCN) outermost, ICI DP
             # inner — the distributed.hybrid_mesh layout; the batch
-            # shards over BOTH axes (pure DP across the pod)
+            # shards over BOTH axes (pure DP across the pod) while the
+            # ZeRO shard axis stays the inner ICI axis (hierarchical)
             mesh = Mesh(np.array(topo.devices).reshape(
                 args.num_slices, n // args.num_slices), ("dcn", "data"))
             batch_axes = ("dcn", "data")
@@ -338,12 +475,16 @@ def main():
             mesh = Mesh(np.array(topo.devices).reshape(n), ("data",))
             batch_axes = ("data",)
         print(f"topology {args.topology} x{args.num_slices} slices: {n} "
-              f"devices; DP train step, per-chip batch "
-              f"{args.batch_per_chip}")
+              f"devices; {args.model} DP train step, per-chip batch "
+              f"{args.batch_per_chip}, zero={args.zero}")
 
-        jf, abstract = build_step(args.batch_per_chip, n, mesh,
-                                  batch_axes=batch_axes,
-                                  zero1=args.zero1)
+        builder = (build_step if args.model == "resnet50"
+                   else lambda *a, **kw2: build_step_mlp(
+                       *a, dim=args.mlp_dim, hidden=args.mlp_hidden,
+                       **kw2))
+        jf, abstract, param_info = builder(
+            args.batch_per_chip, n, mesh, batch_axes=batch_axes,
+            zero_stage=args.zero)
         lowered = jf.lower(*abstract)
         compiled = lowered.compile()
         txt = compiled.as_text()
@@ -358,30 +499,53 @@ def main():
     ops_inside = sum(w["compute_ops_inside"] for w in sched["async_windows"])
     n_per_slice = n // max(1, args.num_slices)
 
+    _WIRE_FACTOR = {
+        "all-reduce": lambda g: 2 * (g - 1) / g,
+        "all-gather": lambda g: (g - 1) / g,
+        "reduce-scatter": lambda g: float(g - 1),
+    }
+
+    def crosses_dcn(c):
+        """Whether this collective's replica group spans slices —
+        decided from the group's min/max member ids, which is EXACT:
+        any member outside the min's slice would displace either the
+        min or the max into a different slice (the truncated
+        group_example preview is display-only; a 32-wide group's first
+        16 members can all sit inside slice 0). A collective with NO
+        parseable group (the fused reduce-scatter call site carries its
+        groups inside the called computation) is intra-slice:
+        multi-slice TPU builds express the cross-slice phase as
+        megascale send/recv host transfers, counted separately — the
+        only groups that ride DCN as HLO collectives are explicit
+        slice-spanning ones."""
+        lo, hi = c.get("group_min"), c.get("group_max")
+        if lo is None or hi is None:
+            return False
+        return lo // n_per_slice != hi // n_per_slice
+
     def wire_ms(c):
         """Ring-model wire time of one collective, over the link class
         its replica group actually rides (a group crossing a slice
         boundary goes over DCN). Result-shape bytes B:
         all-reduce 2(g-1)/g·B; all-gather (g-1)/g·B;
         reduce-scatter (g-1)·B (the result is the 1/g shard)."""
-        group = c.get("group_example") or list(range(n))
-        g = c.get("group_size") or n
-        dcn = len({d // n_per_slice for d in group}) > 1
+        g = c.get("group_size") or n_per_slice
+        dcn = crosses_dcn(c)
         bw = (args.dcn_gbps if dcn else args.ici_gbps) * 1e9
-        b = c["bytes"]
-        factor = {"all-reduce": 2 * (g - 1) / g,
-                  "all-gather": (g - 1) / g,
-                  "reduce-scatter": float(g - 1)}[c.get("op",
-                                                        "all-reduce")]
-        return factor * b / bw * 1e3, dcn
+        factor = _WIRE_FACTOR[c.get("op", "all-reduce")](g)
+        return factor * c["bytes"] / bw * 1e3, dcn
 
     grad_bytes = sum(w["bytes"] for w in sched["async_windows"]) + \
         sum(s["bytes"] for s in sched["sync_all_reduces"])
     t_comm_ms, t_dcn_ms = 0.0, 0.0
+    dcn_collectives = []
     for s_ in sched["sync_all_reduces"]:
         t, dcn = wire_ms(s_)
+        s_["crosses_dcn"] = dcn
         t_comm_ms += t
-        t_dcn_ms += t if dcn else 0.0
+        if dcn:
+            t_dcn_ms += t
+            dcn_collectives.append(s_)
     # megascale DCN phase (multi-slice): the send payloads, one-way
     ms_bytes = sched.get("megascale_send_bytes", 0)
     if ms_bytes:
@@ -389,8 +553,12 @@ def main():
         t_comm_ms += t
         t_dcn_ms += t
     for w in sched["async_windows"]:
-        t_comm_ms += 2 * (n - 1) / n * w["bytes"] / (args.ici_gbps
-                                                     * 1e9) * 1e3
+        t, dcn = wire_ms(w)
+        w["crosses_dcn"] = dcn
+        t_comm_ms += t
+        if dcn:
+            t_dcn_ms += t
+            dcn_collectives.append(w)
     step_ms = args.single_chip_ms
     # pessimistic bound: every collective fully serializes after the
     # compute (zero overlap)
@@ -406,8 +574,7 @@ def main():
         ms_per_op = step_ms / total_ops
         t_exposed = 0.0
         for w in sched["async_windows"]:
-            t_wire = 2 * (n - 1) / n * w["bytes"] / (args.ici_gbps
-                                                     * 1e9) * 1e3
+            t_wire = wire_ms(w)[0]
             t_exposed += max(0.0, t_wire - w["compute_ops_inside"]
                              * ms_per_op)
         for s_ in sched["sync_all_reduces"]:
@@ -433,9 +600,33 @@ def main():
         hidden_frac = overlappable / grad_bytes if grad_bytes else 0.0
         eff_sched = step_ms / (step_ms + t_exposed)
 
+    # hierarchical-DCN contract (multi-slice + zero>=1): nothing bigger
+    # than a 1/n_ici shard crosses the slice boundary. XLA bundles the
+    # cross-slice phase into one megascale transfer of ALL grad shards,
+    # so the bound is total-param-bytes/n_ici: a hierarchical transfer
+    # sits at exactly that, while a full-gradient DCN phase would show
+    # >= largest_param (single grad, un-reduce-scattered) or
+    # total_param (bundled) — both over the bound for n_ici >= 2.
+    largest_dcn = max(
+        [c["bytes"] for c in dcn_collectives] +
+        [sched.get("megascale_send_max_bytes", 0)] + [0])
+    dcn_bytes_total = (sum(c["bytes"] for c in dcn_collectives)
+                       + ms_bytes)
+    hierarchical_ok = None
+    shard_bound = None
+    if args.num_slices > 1 and param_info:
+        shard_bound = param_info["total"] / max(1, n_per_slice)
+        hierarchical_ok = bool(
+            largest_dcn <= shard_bound * 1.05 + 4096
+            and dcn_bytes_total <= shard_bound * (
+                2.10 + 0.05) + 8192)
+        # dcn_bytes_total bound: the reduce phase (shards in) + the
+        # broadcast phase (reduced shards out) = 2x one shard set
+
     result = {
         "topology": args.topology, "num_slices": args.num_slices,
-        "zero1": bool(args.zero1),
+        "model": args.model,
+        "zero_stage": args.zero,
         "n_chips": n,
         "batch_per_chip": args.batch_per_chip,
         "global_batch": args.batch_per_chip * n,
@@ -450,6 +641,13 @@ def main():
         "grad_collective_bytes": grad_bytes,
         "megascale_dcn_sends": sched.get("megascale_sends", 0),
         "megascale_dcn_bytes": ms_bytes,
+        "dcn_crossing_collectives": len(dcn_collectives),
+        "dcn_collective_bytes": dcn_bytes_total,
+        "largest_dcn_collective_bytes": largest_dcn,
+        "largest_param_bytes": (param_info or {}).get("largest"),
+        "total_param_bytes": (param_info or {}).get("total"),
+        "dcn_shard_bound_bytes": shard_bound,
+        "hierarchical_ok": hierarchical_ok,
         "wire_time_ms": round(t_comm_ms, 3),
         "wire_time_dcn_ms": round(t_dcn_ms, 3),
         "single_chip_step_ms": step_ms,
@@ -469,7 +667,8 @@ def main():
     print(json.dumps(result, indent=2))
     slug = args.topology.replace(":", "_") + (
         f"_x{args.num_slices}" if args.num_slices > 1 else "") + (
-        "_zero1" if args.zero1 else "")
+        f"_{args.model}" if args.model != "resnet50" else "") + (
+        f"_zero{args.zero}" if args.zero else "")
     out = args.out or os.path.join(
         REPO, "benchmarks", "runs", f"scaling_aot_{slug}.json")
     sync_tail = sorted(sched["sync_all_reduces"],
@@ -477,6 +676,8 @@ def main():
     with open(out, "w") as f:
         json.dump({**result, "windows": sched["async_windows"],
                    "largest_sync_all_reduces": sync_tail,
+                   "dcn_crossing_detail": sorted(
+                       dcn_collectives, key=lambda s: -s["bytes"])[:20],
                    "unparsed_replica_group_lines":
                        sched["unparsed_replica_groups"]}, f, indent=2)
     print(f"wrote {out}")

@@ -1,24 +1,32 @@
-"""ZeRO-1 on/off A/B: per-device optimizer-state bytes + step wall time.
+"""ZeRO stage A/B: per-device param/grad/opt-state bytes + step wall time.
 
-The weight-update-sharding acceptance measurement (ISSUE 5): on a CPU
-``data=N`` mesh with Adam, ``DistConfig(zero_stage=1)`` must
+The weight-update/gradient/parameter-sharding acceptance measurement
+(ISSUEs 5+8): on a CPU ``data=N`` mesh with Adam, ``DistConfig``
+``zero_stage=1/2/3`` must
 
-  1. cut per-device optimizer-state bytes to ~1/N of the replicated
-     figure (modulo indivisible leaves — the report says which),
-  2. leave the loss trajectory allclose-identical to zero=0,
-  3. compile to the reduce-scatter collective pattern with NO
-     full-gradient all-reduce (``spmd.zero_collective_evidence``;
-     XLA:CPU emits the manual all-reduce+shard-slice form — pass
-     ``--tpu-check`` to run the same step through the REAL deviceless
-     XLA:TPU pipeline, which forms the fused all-reduce-scatter).
+  1. cut per-device bytes to ~1/N of the replicated figure (modulo
+     indivisible leaves — the report says which): optimizer state at
+     stage 1, gradients too at stage 2, parameters too at stage 3,
+  2. leave the loss trajectory allclose-identical to zero=0 at EVERY
+     stage,
+  3. compile to the staged collective patterns with NO full-gradient
+     all-reduce from stage 1 on, and at stage 3 no resident full
+     parameter and only on-use all-gathers
+     (``spmd.zero_collective_evidence``; XLA:CPU emits the manual
+     all-reduce+shard-slice form — pass ``--tpu-check`` to run the same
+     steps through the REAL deviceless XLA:TPU pipeline, which forms
+     the fused all-reduce-scatter),
+  4. keep step time no worse than the stage-1 measurement (the
+     collectives overlap compute; nothing serializes behind a bigger
+     transfer).
 
 Emits the standard ``--metrics-out=`` JSONL trail (bench_metrics.py
 conventions) plus a JSON artifact under benchmarks/runs/.
 
 Usage:
   python benchmarks/zero_bench.py [--data 4] [--batch-per-shard 32]
-      [--steps 12] [--hidden 512] [--metrics-out=zero.jsonl]
-      [--tpu-check] [--smoke]
+      [--steps 12] [--hidden 512] [--stages 0,1,2,3]
+      [--metrics-out=zero.jsonl] [--tpu-check] [--smoke]
 """
 
 import argparse
@@ -120,80 +128,94 @@ def _run_variant(args, zero, data):
     return tr, {
         "zero": zero,
         "opt_state_bytes_per_device": tr.opt_state_bytes_per_device(),
+        "grad_bytes_per_device": tr.grad_bytes_per_device(),
+        "param_bytes_per_device": tr.param_bytes_per_device(),
         "step_ms_median": round(statistics.median(timed) * 1e3, 3),
+        # min is the steal-robust program-speed estimator (timeit's
+        # rationale): this one-core host shares with the harness, so a
+        # background spike can double one variant's median while the
+        # min stays put — cross-stage comparisons use the min
+        "step_ms_min": round(min(timed) * 1e3, 3),
         "steps_timed": len(timed),
         "losses": [round(l, 6) for l in losses],
     }
 
 
-def _tpu_check(args):
-    """The same sharded update through the REAL XLA:TPU pipeline,
+def _stage_contract_ok(stage, ev, ev0, ratios, slack=0.05):
+    """The per-stage pass/fail: bytes ratios within 1/N (+ indivisible
+    slack) for everything the stage shards, and the HLO pattern — no
+    full-grad all-reduce from stage 1 on, sharded-resident params with
+    only on-use gathers at stage 3. ev0 is the zero=0 evidence (must
+    show the classic full-grad all-reduce the stages eliminate)."""
+    target = ratios["target"] + slack
+    ok = ev0["full_grad_all_reduce"] >= 1
+    if stage >= 1:
+        ok = ok and ev["full_grad_all_reduce"] == 0
+        ok = ok and ratios["opt_state"] <= target
+    if stage >= 2:
+        ok = ok and ratios["grad"] <= target
+    if stage >= 3:
+        ok = ok and ratios["param"] <= target
+        ok = ok and ev["resident_full_args"] == 0
+        ok = ok and ev["on_use_all_gather"] >= 1
+        ok = ok and ev["output_all_gather"] == 0
+    else:
+        ok = ok and (stage == 0 or ev["param_all_gather"] >= 1)
+    return bool(ok)
+
+
+def _tpu_check_stage(args, stage):
+    """One ZeRO stage's step through the REAL XLA:TPU pipeline,
     deviceless (jax.experimental.topologies AOT — no chips needed): the
     TPU pass stack forms the fused all-reduce-scatter collective the
-    CPU pipeline cannot."""
+    CPU pipeline cannot, and at stage 3 the params enter as shards with
+    on-use all-gathers the latency-hiding scheduler can prefetch. The
+    step is scaling_aot's MLP builder — the same program the multi-
+    slice DCN analysis compiles, so the two proofs can't drift."""
+    import numpy as np
+    from jax.experimental import topologies
+    from jax.sharding import Mesh
+
+    from paddle_tpu.parallel import spmd
+    from scaling_aot import build_step_mlp
+
+    topo = topologies.get_topology_desc(
+        platform="tpu", topology_name=args.tpu_topology)
+
+    n = len(topo.devices)
+    mesh = Mesh(np.array(topo.devices).reshape(n), ("data",))
+    jf, abstract, param_info = build_step_mlp(
+        8, n, mesh, batch_axes=("data",), zero_stage=stage,
+        dim=args.dim, hidden=args.hidden)
+    t0 = time.time()
+    txt = jf.lower(*abstract).compile().as_text()
+    ev = spmd.zero_collective_evidence(txt, param_info["largest"])
+    ev["topology"] = args.tpu_topology
+    ev["compile_seconds"] = round(time.time() - t0, 1)
+    ok = (ev["reduce_scatter"] >= 1
+          and ev["full_grad_all_reduce"] == 0)
+    if stage >= 3:
+        ok = ok and (ev["resident_full_args"] == 0
+                     and ev["on_use_all_gather"] >= 1)
+    ev["ok"] = ok
+    ev.pop("full_grad_all_reduce_lines", None)
+    return ev
+
+
+def _tpu_check(args, stages):
     # libtpu stalls for minutes retrying the GCP metadata server when
     # run outside a TPU VM; skipping the query is what makes the
     # deviceless compile start instantly
     os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
-    try:
-        import numpy as np
-        import jax
-        import jax.numpy as jnp
-        from jax.experimental import topologies
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-        from paddle_tpu.parallel import spmd
-
-        topo = topologies.get_topology_desc(
-            platform="tpu", topology_name=args.tpu_topology)
-    except Exception as e:           # no libtpu / unknown topology
-        return {"skipped": f"{type(e).__name__}: {e}"}
-
-    n = len(topo.devices)
-    mesh = Mesh(np.array(topo.devices).reshape(n), ("data",))
-    dist = spmd.DistConfig(mesh, zero_stage=1)
-    import paddle_tpu as paddle
-
-    opt = paddle.optimizer.Adam(learning_rate=0.02)
-    D, H = args.dim, args.hidden
-    params = {"w1": jax.ShapeDtypeStruct((D, H), jnp.float32),
-              "b1": jax.ShapeDtypeStruct((H,), jnp.float32),
-              "w2": jax.ShapeDtypeStruct((H, H), jnp.float32)}
-    opt_state = {k: (v, v) for k, v in params.items()}   # Adam (m, v)
-    upd = dist.zero_update_shardings(params)
-    keep = dist.param_shardings(params)
-    st = dist.state_shardings(opt_state)
-
-    def step(p, o, x, y, t):
-        def loss(p):
-            h = jnp.maximum(x @ p["w1"] + p["b1"], 0.0)
-            return jnp.mean((h @ p["w2"] - y) ** 2)
-
-        l, g = jax.value_and_grad(loss)(p)
-        np_, no_ = spmd.zero_constrained_update(
-            dist, opt, t, g, p, o, update_shardings=upd,
-            keep_shardings=keep, state_shardings=st)
-        return l, np_, no_
-
-    rep = NamedSharding(mesh, P())
-    dat = NamedSharding(mesh, P("data"))
-    B = 8 * n
-    abstract = (params, opt_state,
-                jax.ShapeDtypeStruct((B, D), jnp.float32),
-                jax.ShapeDtypeStruct((B, H), jnp.float32),
-                jax.ShapeDtypeStruct((), jnp.int32))
-    jf = jax.jit(step, in_shardings=(keep, st, dat, dat, rep),
-                 out_shardings=(rep, keep, st))
-    t0 = time.time()
-    txt = jf.lower(*abstract).compile().as_text()
-    biggest = D * H * 4
-    ev = spmd.zero_collective_evidence(txt, biggest)
-    ev["topology"] = args.tpu_topology
-    ev["compile_seconds"] = round(time.time() - t0, 1)
-    ev["ok"] = (ev["reduce_scatter"] >= 1
-                and ev["full_grad_all_reduce"] == 0)
-    ev.pop("full_grad_all_reduce_lines", None)
-    return ev
+    out = {}
+    for stage in stages:
+        if stage < 1:
+            continue
+        try:
+            out[str(stage)] = _tpu_check_stage(args, stage)
+        except Exception as e:       # no libtpu / unknown topology
+            out[str(stage)] = {"skipped": f"{type(e).__name__}: {e}"}
+    return out
 
 
 def main(argv=None):
@@ -205,19 +227,24 @@ def main(argv=None):
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--dim", type=int, default=256)
     ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--stages", default="0,1,2,3",
+                    help="comma-separated zero stages to A/B (0 is the "
+                    "baseline and always runs)")
     ap.add_argument("--metrics-out", default=None)
     ap.add_argument("--out", default=None)
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 sizing: tiny model, few steps")
     ap.add_argument("--tpu-check", action="store_true",
-                    help="also AOT-compile the sharded update with the "
+                    help="also AOT-compile each stage's update with the "
                     "deviceless XLA:TPU pipeline and assert the fused "
-                    "reduce-scatter appears")
+                    "reduce-scatter (and, at stage 3, sharded-resident "
+                    "params with on-use gathers) appears")
     ap.add_argument("--tpu-topology", default="v5e:2x2")
     args = ap.parse_args(argv)
     if args.smoke:
         args.dim, args.hidden = 32, 64
         args.steps, args.warmup = 6, 2
+    stages = sorted({int(s) for s in str(args.stages).split(",")} | {0})
     mpath = resolve_metrics_out([f"--metrics-out={args.metrics_out}"]
                                 if args.metrics_out else None)
 
@@ -226,67 +253,136 @@ def main(argv=None):
 
     data = _dataset(args.dim, 8, args.data * args.batch_per_shard,
                     args.steps)
-    t0, r0 = _run_variant(args, 0, data)
-    t1, r1 = _run_variant(args, 1, data)
-
-    ev0 = _hlo_evidence(t0, data[:args.data * args.batch_per_shard])
-    ev1 = _hlo_evidence(t1, data[:args.data * args.batch_per_shard])
-    for ev in (ev0, ev1):
+    evid, runs, trainers = {}, {}, {}
+    for stage in stages:
+        tr, r = _run_variant(args, stage, data)
+        runs[stage], trainers[stage] = r, tr
+        ev = _hlo_evidence(tr, data[:args.data * args.batch_per_shard])
         ev.pop("full_grad_all_reduce_lines", None)
+        evid[stage] = ev
 
-    bytes_ratio = (r1["opt_state_bytes_per_device"]
-                   / max(1, r0["opt_state_bytes_per_device"]))
-    max_loss_diff = float(np.max(np.abs(
-        np.asarray(r0["losses"]) - np.asarray(r1["losses"]))))
-    report = t1.parallel.zero_report(t1.parameters.values)
+    r0, ev0 = runs[0], evid[0]
+
+    def ratio(stage, key):
+        return round(runs[stage][f"{key}_bytes_per_device"]
+                     / max(1, r0[f"{key}_bytes_per_device"]), 4)
+
+    stage_summaries = {}
+    for stage in stages:
+        r = runs[stage]
+        ratios = {"opt_state": ratio(stage, "opt_state"),
+                  "grad": ratio(stage, "grad"),
+                  "param": ratio(stage, "param"),
+                  "target": 1.0 / args.data}
+        traj = bool(np.allclose(r0["losses"], r["losses"],
+                                rtol=2e-2, atol=2e-3))
+        stage_summaries[str(stage)] = {
+            **{k: r[k] for k in (
+                "opt_state_bytes_per_device", "grad_bytes_per_device",
+                "param_bytes_per_device", "step_ms_median",
+                "step_ms_min", "steps_timed")},
+            "opt_state_bytes_ratio": ratios["opt_state"],
+            "grad_bytes_ratio": ratios["grad"],
+            "param_bytes_ratio": ratios["param"],
+            "traj_allclose": traj,
+            "contract_ok": _stage_contract_ok(stage, evid[stage], ev0,
+                                              ratios),
+            "hlo": evid[stage],
+        }
+
+    if 1 in runs:
+        s1 = runs[1]["step_ms_min"]
+        step_time_no_worse = all(
+            runs[s]["step_ms_min"] <= s1 * 1.25
+            for s in stages if s >= 2)
+    else:
+        # "no worse than stage 1" is unmeasurable without stage 1 —
+        # null makes the sentinel SKIP instead of gating a fabricated
+        # comparison against the slow stage-0 baseline
+        step_time_no_worse = None
+
+    bytes_ratio = ratio(1, "opt_state") if 1 in runs else None
+    max_loss_diff = max(
+        float(np.max(np.abs(np.asarray(r0["losses"])
+                            - np.asarray(runs[s]["losses"]))))
+        for s in stages)
+    report = trainers[max(stages)].parallel.zero_report(
+        trainers[max(stages)].parameters.values)
     result = {
         "bench": "zero_bench", "data_axis": args.data,
         "batch_per_shard": args.batch_per_shard,
         "model": {"dim": args.dim, "hidden": args.hidden,
                   "optimizer": "adam"},
-        "zero0": r0, "zero1": r1,
-        "opt_state_bytes_ratio": round(bytes_ratio, 4),
-        "bytes_quartered_ok": bytes_ratio <= 1.0 / args.data + 0.05,
+        "stages": stage_summaries,
+        "step_time_no_worse_than_stage1": (
+            None if step_time_no_worse is None
+            else bool(step_time_no_worse)),
         "max_loss_diff": max_loss_diff,
         # layout-change fp drift accumulates on the overfit tail of this
         # bigger model ({1,0} vs {0,1} matmul operand layouts reduce in
         # a different order); the STRICT allclose contract (2e-4) is
         # proven for 20 steps × {SGD, Momentum, Adam} × {plain, accum}
-        # in tests/test_zero.py on the reference model
-        "traj_allclose": bool(np.allclose(r0["losses"], r1["losses"],
-                                          rtol=2e-2, atol=2e-3)),
-        "hlo_zero0": ev0, "hlo_zero1": ev1,
-        # CPU contract: the full-gradient all-reduce is GONE and the
-        # updated params all-gather back. Whether the grad sync shows up
-        # as the manual reduce-scatter form or as XLA's gather-the-
-        # activations partial-einsum strategy is the partitioner's
-        # choice per shape; the literal reduce-scatter collective is
-        # asserted on the real TPU pipeline (--tpu-check).
-        "collective_pattern_ok": (ev1["full_grad_all_reduce"] == 0
-                                  and ev1["param_all_gather"] >= 1
-                                  and ev0["full_grad_all_reduce"] >= 1),
+        # × stages {1, 2, 3} in tests/test_zero.py on the reference
+        # model
+        "traj_allclose": all(s["traj_allclose"]
+                             for s in stage_summaries.values()),
         "replicated_leaves": report["replicated"],
     }
+    # legacy keys (PR-5 schema) so the perf sentinel can compare this
+    # artifact against the stage-1-only one it follows
+    if 1 in runs:
+        result["zero0"] = {k: v for k, v in r0.items() if k != "losses"}
+        result["zero1"] = {k: v for k, v in runs[1].items()
+                           if k != "losses"}
+        result["opt_state_bytes_ratio"] = bytes_ratio
+        result["bytes_quartered_ok"] = \
+            bytes_ratio <= 1.0 / args.data + 0.05
+        result["hlo_zero0"] = ev0
+        result["hlo_zero1"] = evid[1]
+        result["collective_pattern_ok"] = (
+            evid[1]["full_grad_all_reduce"] == 0
+            and evid[1]["param_all_gather"] >= 1
+            and ev0["full_grad_all_reduce"] >= 1)
     if args.tpu_check:
-        result["tpu_check"] = _tpu_check(args)
+        result["tpu_check"] = _tpu_check(args, stages)
 
-    for variant, r in (("zero0", r0), ("zero1", r1)):
-        metrics_write(mpath, bench="zero_bench", variant=variant,
-                      metric="opt_state_bytes_per_device",
-                      value=r["opt_state_bytes_per_device"],
-                      data_axis=args.data)
-        metrics_write(mpath, bench="zero_bench", variant=variant,
-                      metric="step_ms_median", value=r["step_ms_median"],
-                      data_axis=args.data)
-    metrics_write(mpath, bench="zero_bench",
-                  metric="opt_state_bytes_ratio", value=bytes_ratio,
-                  data_axis=args.data,
-                  traj_allclose=result["traj_allclose"],
-                  collective_pattern_ok=result["collective_pattern_ok"])
+    for stage in stages:
+        r = runs[stage]
+        for metric in ("opt_state_bytes_per_device",
+                       "grad_bytes_per_device",
+                       "param_bytes_per_device", "step_ms_median",
+                       "step_ms_min"):
+            metrics_write(mpath, bench="zero_bench",
+                          variant=f"zero{stage}", metric=metric,
+                          value=r[metric], data_axis=args.data)
+    if bytes_ratio is not None:
+        # only written when stage 1 actually ran — a fabricated 1.0 /
+        # never-evaluated pattern boolean would poison trail consumers
+        metrics_write(mpath, bench="zero_bench",
+                      metric="opt_state_bytes_ratio",
+                      value=bytes_ratio, data_axis=args.data,
+                      traj_allclose=result["traj_allclose"],
+                      collective_pattern_ok=result[
+                          "collective_pattern_ok"])
 
     print(json.dumps(result, indent=2))
-    out = args.out or os.path.join(REPO, "benchmarks", "runs",
-                                   f"zero_bench_data{args.data}.json")
+    # date-stamped so the regression sentinel's basename ordering pairs
+    # the two NEWEST stages artifacts (a fixed name would overwrite in
+    # place and leave every new figure permanently uncompared); a
+    # same-day rerun gets a _b/_c/... suffix — '_' sorts after '.', so
+    # later runs still order later and the before/after-a-change
+    # workflow keeps both artifacts instead of destroying the baseline
+    out = args.out
+    if out is None:
+        base = os.path.join(
+            REPO, "benchmarks", "runs",
+            time.strftime("%Y-%m-%d") + f"_zero_bench_data{args.data}"
+            f"_stages")
+        out = base + ".json"
+        i = 0
+        while os.path.exists(out) and not args.smoke:
+            i += 1
+            out = f"{base}_{chr(ord('a') + i)}.json"
     if not args.smoke:
         os.makedirs(os.path.dirname(out), exist_ok=True)
         with open(out, "w") as f:

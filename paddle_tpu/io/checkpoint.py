@@ -19,6 +19,16 @@ chunks. Two scaling paths:
   each process writes only its addressable shards to its own npz
   (``params.p{K}.npz``); load merges every process file present. Shard
   overlap is fine (replicated arrays): last writer wins on identical data.
+
+ZeRO resharding (``meta.zero`` manifest path): single-host saves hold
+FULL host arrays — ``np.asarray`` on a ZeRO-sharded leaf (stage>=1 opt
+state, stage 3 params) gathers its shards — so a restore under a
+DIFFERENT zero stage or mesh size IS the reshard: the trainer
+device_puts the loaded full arrays into the current config's layout and
+logs the layout change it read from ``meta.zero``. Multi-host saves keep
+per-shard entries; ``_load_group`` reassembles the full array before the
+same re-layout. Proven save@zero=3/data=4 → restore@zero∈{0,1,2} and
+data=2 in tests/test_zero.py::TestZeroCheckpointResharding.
 """
 
 import hashlib

@@ -13,19 +13,47 @@ stage (PP). A DistConfig holds the mesh plus regex→PartitionSpec rules for
 parameters; anything unmatched is replicated (pure DP). Batch-norm under
 GSPMD becomes synced-BN for free — the batch mean is a global reduction.
 
-ZeRO-1 (``zero_stage=1`` / ``data_parallel(zero=1)``): pure-DP replicates
+ZeRO (``zero_stage=1..3`` / ``data_parallel(zero=N)``): pure-DP replicates
 every unmatched parameter AND its optimizer state on every chip, and every
 replica then applies the identical weight update. Following "Automatic
 Cross-Replica Sharding of Weight Update in Data-Parallel Training" (Xu et
-al.), the fix here is only sharding annotations: optimizer-state leaves of
-replicated parameters lay out over the ``data`` axis (largest dim divisible
-by the axis size; tiny/indivisible leaves stay replicated —
-``zero_report()`` says which and why), and the trainer constrains
-grads/params/updated-params around ``opt.update`` so XLA rewrites the
-gradient all-reduce into reduce-scatter + sharded update + post-update
-all-gather. Memory: Adam's 2× param-bytes of state (plus the fp32 update
-math) drops to ~1/axis-size per chip; numerics are unchanged (the same
-sums, distributed).
+al.), the fix here is only sharding annotations, staged:
+
+- **Stage 1** — optimizer-state leaves of replicated parameters lay out
+  over the ``data`` axis (largest dim divisible by the axis size;
+  tiny/indivisible leaves stay replicated — ``zero_report()`` says which
+  and why), and the trainer constrains grads/params/updated-params around
+  ``opt.update`` so XLA rewrites the gradient all-reduce into
+  reduce-scatter + sharded update + post-update all-gather.
+- **Stage 2** — gradients take the same ``zero_spec`` layout as first-class
+  policy (``grad_shardings``): the grad at the update boundary is committed
+  to its 1/N shard and the grad-accumulation scan carry rides sharded, so
+  each microbatch reduce-scatters INTO the shard instead of materializing
+  a full replicated gradient between microbatches. (Gradients are
+  step-transients in the jitted design — no persistent grad buffer exists
+  in the plain path, so stage 2's resident-memory bite is the accumulator;
+  the plain-path program is identical to stage 1's, which already reduces
+  at the update boundary.)
+- **Stage 3** — parameters are STORED in the ``zero_spec`` layout
+  (``store_shardings``; the jit inputs/outputs are 1/N shards) and
+  all-gathered on use: the trainer constrains them to their compute layout
+  (replicated / TP) inside the step, XLA inserts one on-use all-gather per
+  leaf and schedules it under earlier compute (the prefetch), and the
+  backward of that gather IS a reduce-scatter — no full gradient and no
+  resident full parameter exist anywhere. The post-update all-gather of
+  stages 1-2 disappears (updated params stay sharded).
+
+Memory: Adam's 2× param-bytes of state (plus the fp32 update math) drops
+to ~1/axis-size per chip at stage 1, gradients follow at stage 2, and
+parameters at stage 3 (param+grad+state → ~1/N). Numerics are unchanged at
+every stage (the same sums, distributed).
+
+Multi-slice meshes (an outer ``dcn`` axis from ``distributed.hybrid_mesh``)
+keep the ZeRO shard axis at ``batch_axis`` (the ICI ring inside a slice):
+the batch shards over BOTH axes, gradients reduce-scatter over ICI, and
+only the 1/N-sharded grads cross DCN (a shard-sized all-reduce over
+``dcn``) — the hierarchical rewrite ``benchmarks/scaling_aot.py
+--zero2/--zero3`` proves on the deviceless XLA:TPU multi-slice pipeline.
 """
 
 import dataclasses
@@ -47,9 +75,11 @@ class DistConfig:
     param_rules: Sequence[Tuple[str, P]] = ()
     batch_axis: str = place.AXIS_DATA
     # 0 = replicate optimizer state (classic DP); 1 = shard the optimizer
-    # state and weight update of replicated params over batch_axis (ZeRO-1)
+    # state and weight update of replicated params over batch_axis (ZeRO-1);
+    # 2 = gradients/accumulators take the same layout (ZeRO-2); 3 = params
+    # are stored sharded and all-gathered on use (ZeRO-3)
     zero_stage: int = 0
-    # leaves with fewer elements than this stay replicated under zero=1
+    # leaves with fewer elements than this stay replicated under zero>=1
     # (sharding a bias saves nothing and adds collective latency); 0 shards
     # everything divisible
     zero_min_size: int = 0
@@ -66,23 +96,49 @@ class DistConfig:
     def param_sharding(self, name: str, arr) -> NamedSharding:
         return NamedSharding(self.mesh, self.param_spec(name, np.ndim(arr)))
 
+    def dcn_axis(self) -> Optional[str]:
+        """The cross-slice mesh axis, when this mesh carries one
+        (``distributed.hybrid_mesh`` names it ``dcn``). The batch then
+        shards over BOTH axes while the ZeRO shard axis stays
+        ``batch_axis`` (the ICI ring inside one slice) — so every
+        ZeRO collective over ``dcn`` moves only 1/N-sharded tensors
+        (the hierarchical rewrite)."""
+        names = tuple(getattr(self.mesh, "axis_names", ()))
+        if "dcn" in names and self.batch_axis != "dcn":
+            return "dcn"
+        return None
+
     def batch_sharding(self) -> NamedSharding:
-        """Axis-0 sharding for every feed leaf (batch dim)."""
-        return NamedSharding(self.mesh, P(self.batch_axis))
+        """Axis-0 sharding for every feed leaf (batch dim); on a
+        multi-slice mesh the batch shards over (dcn, batch_axis) —
+        pure DP across the pod."""
+        d = self.dcn_axis()
+        spec = P((d, self.batch_axis)) if d else P(self.batch_axis)
+        return NamedSharding(self.mesh, spec)
 
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 
     # -- pytree helpers ----------------------------------------------------
     def shard_params(self, params: Dict) -> Dict:
-        return {k: jax.device_put(v, self.param_sharding(k, v))
+        """Place params in their STORED layout: the rule/TP layout, or
+        the 1/N ``zero_spec`` shard under ``zero_stage>=3``."""
+        return {k: jax.device_put(v, self.store_sharding(k, v))
                 for k, v in params.items()}
 
     def param_shardings(self, params: Dict) -> Dict:
+        """The COMPUTE layout of each param (rule-matched or replicated)
+        — what the forward/backward consume; under ``zero_stage=3`` the
+        trainer constrains stored shards to this inside the step (the
+        on-use all-gather)."""
         return {k: self.param_sharding(k, v) for k, v in params.items()}
 
-    # -- ZeRO-1 policy -----------------------------------------------------
+    # -- ZeRO policy -------------------------------------------------------
     def zero_axis_size(self) -> int:
+        """Size of the ZeRO shard axis. On a multi-slice mesh this is
+        the ICI ``batch_axis`` only — the ``dcn`` axis never divides the
+        shard (hierarchical: slices keep replica copies of the 1/N
+        shards, and cross-slice traffic is shard-sized)."""
         return int(dict(self.mesh.shape).get(self.batch_axis, 1))
 
     def _zero_dim(self, shape) -> Optional[int]:
@@ -104,7 +160,7 @@ class DistConfig:
 
     def zero_spec(self, name: str, shape) -> P:
         """Update-time PartitionSpec of one replicated-param leaf under
-        zero=1 (``P()`` when it stays replicated). Leaves of params
+        zero>=1 (``P()`` when it stays replicated). Leaves of params
         matched by a TP rule are NOT zero-eligible — their state already
         shards like the param."""
         if self.zero_stage < 1:
@@ -121,14 +177,44 @@ class DistConfig:
         params: ZeRO-sharded for replicated params, the param's own
         sharding otherwise. The trainer constrains grads/params to this
         around ``opt.update`` so XLA turns the grad all-reduce into
-        reduce-scatter and all-gathers the updated params afterwards."""
+        reduce-scatter and (below stage 3) all-gathers the updated
+        params afterwards."""
         return {k: NamedSharding(self.mesh, self.zero_spec(k, np.shape(v)))
                 for k, v in params.items()}
 
-    def zero_report(self, params: Dict) -> Dict:
-        """What zero=1 does to each param's optimizer state: which leaves
-        shard (and on which dim), which stay replicated and why —
-        the debug trail for "why didn't my memory drop by 1/N"."""
+    def grad_spec(self, name: str, shape, accum: bool = False) -> P:
+        """Layout of the longest-lived gradient object of one param:
+        the ``zero_spec`` 1/N shard at stage>=2 — and for the
+        grad-accumulation scan carry already at stage>=1, where the
+        carry rides sharded so each microbatch reduce-scatters into it
+        — else the param's own layout (full for pure DP)."""
+        if self.zero_stage >= 2 or (accum and self.zero_stage >= 1):
+            return self.zero_spec(name, tuple(shape))
+        return self.param_spec(name, len(shape))
+
+    def grad_shardings(self, params: Dict, accum: bool = False) -> Dict:
+        return {k: NamedSharding(self.mesh,
+                                 self.grad_spec(k, np.shape(v), accum))
+                for k, v in params.items()}
+
+    def store_spec(self, name: str, shape) -> P:
+        """The STORED (between-steps resident) layout of one param:
+        ``zero_spec`` at stage 3 (params live as 1/N shards and are
+        all-gathered on use), the compute layout otherwise."""
+        if self.zero_stage >= 3:
+            return self.zero_spec(name, tuple(shape))
+        return self.param_spec(name, len(shape))
+
+    def store_sharding(self, name: str, arr) -> NamedSharding:
+        return NamedSharding(self.mesh,
+                             self.store_spec(name, np.shape(arr)))
+
+    def store_shardings(self, params: Dict) -> Dict:
+        return {k: self.store_sharding(k, v) for k, v in params.items()}
+
+    def _zero_classify(self, params: Dict) -> Tuple[Dict, Dict]:
+        """(sharded, replicated) per-leaf decisions of the zero_spec
+        policy, with the reason each replicated leaf stays replicated."""
         n = self.zero_axis_size()
         sharded, replicated = {}, {}
         for k, v in params.items():
@@ -150,9 +236,39 @@ class DistConfig:
             else:
                 replicated[k] = (f"no dim of {list(shape)} divisible by "
                                  f"{self.batch_axis}={n}")
-        return {"zero_stage": self.zero_stage, "axis": self.batch_axis,
-                "axis_size": n, "sharded": sharded,
-                "replicated": replicated}
+        return sharded, replicated
+
+    def zero_report(self, params: Dict) -> Dict:
+        """What the configured zero stage does to each param, per leaf —
+        the debug trail for "why didn't my memory drop by 1/N". The
+        top-level ``sharded``/``replicated`` keys are the optimizer-state
+        view (stage>=1); ``grads`` and ``params`` carry the same per-leaf
+        decisions for gradient accumulators (stage>=2) and stored
+        parameters (stage 3), or name the stage gate that keeps every
+        leaf in its param layout."""
+        n = self.zero_axis_size()
+        sharded, replicated = self._zero_classify(params)
+        stage = self.zero_stage
+
+        def view(active, gate_msg):
+            if active:
+                return {"sharded": sharded, "replicated": replicated}
+            return {"sharded": {},
+                    "replicated": {k: gate_msg for k in params}}
+
+        return {"zero_stage": stage, "axis": self.batch_axis,
+                "axis_size": n, "dcn_axis": self.dcn_axis(),
+                "sharded": sharded if stage >= 1 else {},
+                "replicated": replicated if stage >= 1
+                else {k: "zero_stage<1 (state mirrors param layout)"
+                      for k in params},
+                "grads": view(stage >= 2,
+                              "zero_stage<2 (grads keep param layout; "
+                              "the accum carry still rides sharded at "
+                              "stage 1)"),
+                "params": view(stage >= 3,
+                               "zero_stage<3 (params stored in compute "
+                               "layout)")}
 
     def state_shardings(self, state: Dict) -> Dict:
         """Optimizer/model state mirrors its parameter's sharding: entries
@@ -181,21 +297,25 @@ class DistConfig:
 def data_parallel(mesh: Optional[Mesh] = None, zero: int = 0) -> DistConfig:
     """Pure DP: replicate params, shard batch (the MultiGradientMachine +
     pserver replacement). ``zero=1`` shards the optimizer state and weight
-    update over the data axis (ZeRO-1 — see the module docstring)."""
+    update over the data axis (ZeRO-1), ``zero=2`` the gradient
+    accumulators too, ``zero=3`` the stored parameters with on-use
+    all-gather — see the module docstring."""
     return DistConfig(mesh or place.default_mesh(), zero_stage=zero)
 
 
 def zero_constrained_update(dist: DistConfig, opt, step, grads, params,
                             opt_state, update_shardings=None,
                             keep_shardings=None, state_shardings=None):
-    """The ZeRO-1 graph transform around one optimizer update, as pure
+    """The ZeRO graph transform around one optimizer update, as pure
     sharding constraints (trace-time; call inside the jitted step):
 
         grads/params  → update layout (replicated params slice over
                         ``data`` — XLA rewrites their grad all-reduce
                         into reduce-scatter)
         opt.update    → runs elementwise on 1/N-size shards
-        new params    → back to the serving layout (all-gather)
+        new params    → back to the STORED layout: the serving layout
+                        below stage 3 (all-gather), the 1/N shard at
+                        stage 3 (no post-update all-gather exists)
         new opt state → pinned to the sharded layout
 
     The three sharding dicts can be passed precomputed (the trainer
@@ -205,7 +325,7 @@ def zero_constrained_update(dist: DistConfig, opt, step, grads, params,
         return opt.update(step, grads, params, opt_state)
     wsc = jax.lax.with_sharding_constraint
     upd = update_shardings or dist.zero_update_shardings(params)
-    keep = keep_shardings or dist.param_shardings(params)
+    keep = keep_shardings or dist.store_shardings(params)
     st = state_shardings or dist.state_shardings(opt_state)
     grads = wsc(grads, upd)
     params = wsc(params, upd)
@@ -250,14 +370,24 @@ def _hlo_shape_bytes(sig: str) -> int:
     return total
 
 
+# consumer opcodes that only move data — classification follows through
+# them to the real consumer (a post-SPMD CPU all-gather is usually read
+# via a layout copy; async collectives via their -done op)
+_TRANSPARENT_OPS = frozenset((
+    "copy", "bitcast", "bitcast-convert", "get-tuple-element",
+    "all-gather-done", "all-reduce-done", "reduce-scatter-done",
+    "optimization-barrier"))
+
+
 def zero_collective_evidence(hlo_text: str, min_bytes: int) -> Dict:
     """Classify a compiled (post-SPMD) module's collectives for the
-    ZeRO-1 contract — "the grad all-reduce became reduce-scatter + a
-    post-update all-gather". ``min_bytes`` separates gradient/param-sized
+    ZeRO contracts. ``min_bytes`` separates gradient/param-sized
     collectives from scalar bookkeeping (loss means, clip norms): pass
-    the largest replicated param's nbytes.
+    the largest replicated param's nbytes. NOTE the module is
+    per-device-shaped post-SPMD, so callers must size the model so that
+    per-device feed/state leaves stay under ``min_bytes``.
 
-    Counts three things, accepting every lowering XLA actually emits:
+    Returns counts, accepting every lowering XLA actually emits:
     - ``reduce_scatter``: literal ``reduce-scatter`` ops; XLA:TPU's fused
       form (a kCustom fusion calling a computation named
       ``*reduce-scatter*`` — its INTERNAL full-size all-reduce is part of
@@ -265,28 +395,95 @@ def zero_collective_evidence(hlo_text: str, min_bytes: int) -> Dict:
       CPU pipeline lacks the reduce-scatter-creator pass, so the
       partitioner leaves an all-reduce ≥ min_bytes whose every consumer
       immediately slices it to a fraction of its size).
-    - ``param_all_gather``: all-gathers ≥ min_bytes (the updated-param
-      regather).
+    - ``param_all_gather``: all-gathers ≥ min_bytes (sync or async
+      ``all-gather-start``), split into
+      ``on_use_all_gather`` — consumed by compute: the stage-3
+      gather-on-use form — and ``output_all_gather`` — flowing only to
+      the module output: the stage-1/2 post-update regather. Stage 3's
+      "only on-use all-gathers" contract is ``output_all_gather == 0``.
     - ``full_grad_all_reduce``: all-reduces ≥ min_bytes consumed at full
-      size — the classic DP gradient sync ZeRO-1 must eliminate.
+      size — the classic DP gradient sync ZeRO must eliminate at every
+      stage (the stage>=2 contract extends it to the accumulation path).
+    - ``resident_full_args``: ENTRY parameters ≥ min_bytes — stage 3's
+      "no replicated resident parameter" is ``resident_full_args == 0``
+      (a zero-sharded param enters at 1/N of ``min_bytes``).
     """
     # split the module into computations; ops inside a *reduce-scatter*
     # computation body are the collective's own implementation
     comp_re = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\([^)]*\)\s*->")
     op_re = re.compile(
         r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*(.*?)\b"
-        r"(all-reduce-start|all-reduce|reduce-scatter|all-gather)\(")
+        r"(all-reduce-start|all-reduce|"
+        r"reduce-scatter-start|reduce-scatter-done|reduce-scatter|"
+        r"all-gather-start|all-gather-done|all-gather|parameter)\(")
     comp = None
+    entry_comp = None
     lines = hlo_text.splitlines()
     comp_of = []
     for ln in lines:
         m = comp_re.match(ln)
         if m and "=" not in ln.split("(")[0]:
             comp = m.group(1)
+            if ln.lstrip().startswith("ENTRY"):
+                entry_comp = comp
         comp_of.append(comp)
+
+    # op index + per-computation consumer map (def line excluded)
+    def_line_re = re.compile(r"^\s*(ROOT\s+)?%([\w.\-]+)\s*=\s*(.*)$")
+    opcode_re = re.compile(r"(?:^|\s)([a-z][\w\-]*)\(")
+    ref_re = re.compile(r"%([\w.\-]+)\b")
+    op_at = {}            # line idx -> (name, opcode, bytes, is_root)
+    uses = {}             # (comp, name) -> [consumer line idx]
+    for i, ln in enumerate(lines):
+        m = def_line_re.match(ln)
+        if not m:
+            continue
+        is_root, name, rhs = bool(m.group(1)), m.group(2), m.group(3)
+        om = opcode_re.search(rhs)
+        if not om:
+            continue
+        opcode = om.group(1)
+        nbytes = _hlo_shape_bytes(rhs[:om.start()])
+        op_at[i] = (name, opcode, nbytes, is_root)
+        for ref in ref_re.findall(rhs[om.start():]):
+            if ref != name:
+                uses.setdefault((comp_of[i], ref), []).append(i)
+
+    def consumers_of(cname, name):
+        return [op_at[j] for j in uses.get((cname, name), ())
+                if j in op_at]
+
+    def gather_sink(cname, name, depth=0):
+        """'use' when any (transitively, through data movers) consumer
+        is compute; 'output' when the value only reaches the ENTRY
+        ROOT/output tuple — the post-update regather of stages 1-2.
+        A ROOT of a NON-entry computation returns the value to its
+        caller (TPU wraps collectives in sub-computations), which is a
+        use, not a module output."""
+        if depth > 6:
+            return "use"            # conservative: assume consumed
+        sinks = set()
+        cons = consumers_of(cname, name)
+        if not cons:
+            # no consumer: an entry op feeds the output directly; in a
+            # sub-computation the value escapes through the caller
+            return "output" if cname == entry_comp else "use"
+        for (cn, opcode, _b, is_root) in cons:
+            if opcode in _TRANSPARENT_OPS or (opcode == "tuple"
+                                              and not is_root):
+                sinks.add(gather_sink(cname, cn, depth + 1))
+            elif opcode == "tuple" and is_root:
+                sinks.add("output" if cname == entry_comp else "use")
+            else:
+                sinks.add("use")
+        return "use" if "use" in sinks else "output"
+
     out = {"reduce_scatter": 0, "param_all_gather": 0,
+           "on_use_all_gather": 0, "output_all_gather": 0,
+           "resident_full_args": 0,
            "full_grad_all_reduce": 0, "full_grad_all_reduce_lines": []}
     big_ars = []          # (idx, name, bytes, comp)
+    big_ags = []          # (idx, name, comp, kind, is_root)
     for i, ln in enumerate(lines):
         if "reduce-scatter" in (comp_of[i] or ""):
             continue
@@ -298,14 +495,30 @@ def zero_collective_evidence(hlo_text: str, min_bytes: int) -> Dict:
             continue
         name, sig, kind = m.groups()
         nbytes = _hlo_shape_bytes(sig)
-        if kind == "reduce-scatter":
+        if kind == "parameter":
+            if comp_of[i] == entry_comp and nbytes >= min_bytes:
+                out["resident_full_args"] += 1
+        elif kind in ("reduce-scatter", "reduce-scatter-start"):
             out["reduce_scatter"] += 1
-        elif kind == "all-gather" and nbytes >= min_bytes:
-            out["param_all_gather"] += 1
+        elif kind in ("all-gather-done", "reduce-scatter-done"):
+            pass          # counted at its -start; sink follows through
+        elif kind.startswith("all-gather") and nbytes >= min_bytes:
+            # async start shape is the (operand, result) tuple: the
+            # result alone clears min_bytes whenever the sync form would
+            big_ags.append((i, name, comp_of[i], kind,
+                            ln.lstrip().startswith("ROOT")))
         elif kind.startswith("all-reduce") and nbytes >= min_bytes:
             if kind == "all-reduce-start":
                 nbytes //= 2      # async tuple shape: (operand, result)
             big_ars.append((i, name, nbytes, comp_of[i]))
+
+    for i, name, cname, kind, is_root in big_ags:
+        out["param_all_gather"] += 1
+        sink = ("output" if is_root and cname == entry_comp
+                else gather_sink(cname, name))
+        out["on_use_all_gather" if sink == "use"
+            else "output_all_gather"] += 1
+
     def _consumer_result_bytes(line):
         """Bytes of a consumer op's RESULT shape: the text between '='
         and the opcode token (tuple shapes contain parens, so a naive
@@ -318,11 +531,11 @@ def zero_collective_evidence(hlo_text: str, min_bytes: int) -> Dict:
         return _hlo_shape_bytes(seg[:m.start()] if m else seg)
 
     for i, name, nbytes, cname in big_ars:
-        # consumers: later lines in the same computation using %name
-        ref = re.compile(r"%" + re.escape(name) + r"\b")
-        consumers = [lines[j] for j in range(len(lines))
-                     if j != i and comp_of[j] == cname
-                     and ref.search(lines[j])]
+        # consumers: ops in the same computation reading %name (exact
+        # name via the uses map — a \b regex would also prefix-match
+        # %name.1, polluting the consumer set)
+        consumers = [lines[j] for j in uses.get((cname, name), ())
+                     if j != i]
         sliced = bool(consumers) and all(
             0 < _consumer_result_bytes(c) * 2 <= nbytes
             for c in consumers if "=" in c)

@@ -47,12 +47,15 @@ class _StepMonitor:
     signature tracker cannot see, e.g. backend-side recompiles)."""
 
     def __init__(self, window: int = 64, outlier_factor: float = 4.0,
-                 opt_state_bytes: int = 0):
+                 opt_state_bytes: int = 0, grad_bytes: int = 0,
+                 param_bytes: int = 0):
         self._times = []                     # ring buffer of recent steps
         self._window = window
         self._factor = outlier_factor
         self._idx = 0
         self._opt_bytes = int(opt_state_bytes)
+        self._grad_bytes = int(grad_bytes)
+        self._param_bytes = int(param_bytes)
         reg = observe.default_registry()
         self.steps = reg.counter(
             "train_steps_total", "optimizer steps taken")
@@ -76,8 +79,18 @@ class _StepMonitor:
         self.opt_bytes_gauge = reg.gauge(
             "opt_state_bytes_per_device",
             "optimizer-state bytes resident on ONE device — under "
-            "ZeRO-1 (DistConfig zero_stage=1) this is ~1/data-axis of "
+            "ZeRO (DistConfig zero_stage>=1) this is ~1/data-axis of "
             "the replicated figure")
+        self.grad_bytes_gauge = reg.gauge(
+            "grad_bytes_per_device",
+            "bytes of the longest-lived gradient object on ONE device "
+            "(the accum-scan carry, or the transient grad at the "
+            "update point) — ~1/data-axis under ZeRO stage>=2")
+        self.param_bytes_gauge = reg.gauge(
+            "param_bytes_per_device",
+            "parameter bytes resident on ONE device between steps — "
+            "~1/data-axis under ZeRO stage 3 (params stored sharded, "
+            "all-gathered on use)")
         self.bottleneck_frac = reg.gauge(
             "train_bottleneck_fraction",
             "last step's time split by component (label component = "
@@ -89,6 +102,8 @@ class _StepMonitor:
         # set unconditionally: a stateless-optimizer run must overwrite
         # a previous run's value on the shared registry, not expose it
         self.opt_bytes_gauge.set(self._opt_bytes)
+        self.grad_bytes_gauge.set(self._grad_bytes)
+        self.param_bytes_gauge.set(self._param_bytes)
         # peak FLOP/s is constant for the process: resolve once, not per
         # step (env read + device lookup + table scan on the hot path)
         self._peak_flops = observe.costs.device_peak_flops()
@@ -155,6 +170,8 @@ class _StepMonitor:
                    mfu=round(mfu, 6) if mfu is not None else 0.0,
                    compile_count=int(compile_count),
                    opt_state_bytes=self._opt_bytes,
+                   grad_bytes=self._grad_bytes,
+                   param_bytes=self._param_bytes,
                    recompile=recompile,
                    bottleneck=label,
                    frac_input=round(frac["input"], 4),
@@ -229,6 +246,19 @@ class SGD:
                     ", ".join(f"{k}: {v}"
                               for k, v in rep["replicated"].items())
                     or "none")
+                # stages 2/3 add grad / stored-param layout decisions —
+                # same per-leaf reasons, logged per object class
+                for section in ("grads", "params"):
+                    view = rep[section]
+                    if view["sharded"]:
+                        logger.debug(
+                            "zero=%d %s: %d sharded, %d replicated (%s)",
+                            rep["zero_stage"], section,
+                            len(view["sharded"]),
+                            len(view["replicated"]),
+                            ", ".join(f"{k}: {v}" for k, v in
+                                      view["replicated"].items())
+                            or "none")
         self._plain_train_step = self._build_train_step()
         self._accum_train_step = (self._build_accum_train_step()
                                   if self.grad_accum_steps > 1 else None)
@@ -250,15 +280,22 @@ class SGD:
 
     # -- compiled steps ----------------------------------------------------
     def _zero_shardings(self):
-        """(update, keep, state) sharding dicts for the ZeRO-1 constraint
-        points, computed ONCE at step-build time (None under zero=0 /
-        local training — the steps then call opt.update directly)."""
+        """(update, keep, state, compute) sharding dicts for the ZeRO
+        constraint points, computed ONCE at step-build time (None under
+        zero=0 / local training — the steps then call opt.update
+        directly). ``keep`` is the STORED layout updated params return
+        to: the serving layout below stage 3, the 1/N shard at stage 3.
+        ``compute`` is non-None only at stage 3 — the full/TP layout the
+        forward constrains stored shards to (the on-use all-gather)."""
         par = self.parallel
         if par is None or getattr(par, "zero_stage", 0) < 1:
             return None
-        return (par.zero_update_shardings(self.parameters.values),
-                par.param_shardings(self.parameters.values),
-                par.state_shardings(self.opt_state))
+        values = self.parameters.values
+        return (par.zero_update_shardings(values),
+                par.store_shardings(values),
+                par.state_shardings(self.opt_state),
+                par.param_shardings(values) if par.zero_stage >= 3
+                else None)
 
     def _build_train_step(self):
         fwd = self._forward
@@ -269,6 +306,13 @@ class SGD:
 
         def train_step(params, opt_state, state, feeds, step, dropout_key):
             def loss_fn(p):
+                if zero is not None and zero[3] is not None:
+                    # ZeRO-3 gather-on-use: stored 1/N shards constrained
+                    # to the compute layout — XLA inserts one all-gather
+                    # per leaf at its first use (prefetchable under
+                    # earlier layers' compute) and the gather's backward
+                    # transpose IS the grad reduce-scatter
+                    p = jax.lax.with_sharding_constraint(p, zero[3])
                 outs, new_state = fwd(p, state, feeds, is_training=True,
                                       dropout_key=dropout_key)
                 per_example = outs[cost_name].array
@@ -278,9 +322,10 @@ class SGD:
             (loss, (outs, new_state)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             if zero is not None:
-                # ZeRO-1: grad reduce-scatters, the update runs on 1/N
+                # ZeRO: grad reduce-scatters, the update runs on 1/N
                 # shards against the sharded opt state, updated params
-                # all-gather back (parallel/spmd.py)
+                # return to the stored layout (all-gather below stage 3,
+                # still sharded at stage 3 — parallel/spmd.py)
                 from paddle_tpu.parallel import spmd
                 new_params, new_opt = spmd.zero_constrained_update(
                     par, opt, step, grads, params, opt_state,
@@ -322,6 +367,12 @@ class SGD:
                 fd, mkey = xs
 
                 def loss_fn(p):
+                    if zero is not None and zero[3] is not None:
+                        # ZeRO-3: gather stored shards on use, per
+                        # microbatch (the gather's transpose reduce-
+                        # scatters this microbatch's grad into the
+                        # sharded accumulator below)
+                        p = jax.lax.with_sharding_constraint(p, zero[3])
                     outs, st2 = fwd(p, st, fd, is_training=True,
                                     dropout_key=mkey)
                     per_example = outs[cost_name].array
@@ -405,23 +456,65 @@ class SGD:
         z = self._zero_meta()
         return {"zero": z} if z is not None else None
 
+    @staticmethod
+    def _leaf_shard_bytes(leaf, sharding=None, itemsize=None) -> int:
+        """Per-device bytes of one leaf: its shard shape under
+        ``sharding`` (the leaf's own by default), times itemsize."""
+        shape = tuple(jnp.shape(leaf))
+        sharding = sharding if sharding is not None else getattr(
+            leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            shape = sharding.shard_shape(shape)
+        if itemsize is None:
+            itemsize = getattr(getattr(leaf, "dtype", None),
+                               "itemsize", 4)
+        n = 1
+        for s in shape:
+            n *= int(s)
+        return n * itemsize
+
     def opt_state_bytes_per_device(self) -> int:
         """Optimizer-state bytes resident on ONE device: each leaf
         contributes its per-device shard (``sharding.shard_shape``), so
         replicated state counts in full while ZeRO-sharded state counts
         at ~1/axis-size — the number the ``opt_state_bytes_per_device``
         gauge and the zero on/off A/B (benchmarks/zero_bench.py) report."""
+        return sum(self._leaf_shard_bytes(leaf) for leaf in
+                   jax.tree_util.tree_leaves(self.opt_state))
+
+    def param_bytes_per_device(self) -> int:
+        """Parameter bytes resident on ONE device between steps (per-leaf
+        ``sharding.shard_shape``): the full replicated figure for pure DP
+        / ZeRO<=2, ~1/axis-size under ZeRO-3 where params are stored
+        sharded and all-gathered on use — the ``param_bytes_per_device``
+        gauge and the per-stage A/B in ``benchmarks/zero_bench.py``."""
+        return sum(self._leaf_shard_bytes(leaf) for leaf in
+                   jax.tree_util.tree_leaves(self.parameters.values))
+
+    def grad_bytes_per_device(self) -> int:
+        """Per-device bytes of the longest-lived gradient object, from
+        the sharding plan's LAYOUT COMMITMENT (gradients are
+        step-transients in the jitted design — there is no persistent
+        grad buffer to measure): under grad accumulation this is the
+        fp32 scan-carry accumulator, which rides ZeRO-sharded from
+        stage 1 on; without accumulation it is the gradient at the
+        update boundary — committed to 1/N by the stage>=2 contract
+        (``DistConfig.grad_spec``), the param layout otherwise. XLA may
+        transiently materialize a full-shape partial-sum before the
+        reduce at any stage; this gauge reports what the plan requires
+        to stay live, which is what bounds the accumulator and the
+        update's working set."""
+        par = self.parallel
+        accum = self.grad_accum_steps > 1
         total = 0
-        for leaf in jax.tree_util.tree_leaves(self.opt_state):
-            shape = tuple(jnp.shape(leaf))
-            sharding = getattr(leaf, "sharding", None)
-            if sharding is not None and hasattr(sharding, "shard_shape"):
-                shape = sharding.shard_shape(shape)
-            itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", 4)
-            n = 1
-            for s in shape:
-                n *= int(s)
-            total += n * itemsize
+        for k, v in self.parameters.values.items():
+            sh = None
+            if par is not None:
+                sh = jax.sharding.NamedSharding(
+                    par.mesh,
+                    par.grad_spec(k, tuple(jnp.shape(v)), accum=accum))
+            total += self._leaf_shard_bytes(
+                v, sharding=sh, itemsize=4 if accum else None)
         return total
 
     def _feeder(self, feeding):
@@ -634,7 +727,9 @@ class SGD:
     def _train_passes(self, reader, num_passes, event_handler, feeder, ks,
                       log_period, ckpt, period, pipe=None):
         monitor = _StepMonitor(
-            opt_state_bytes=self.opt_state_bytes_per_device())
+            opt_state_bytes=self.opt_state_bytes_per_device(),
+            grad_bytes=self.grad_bytes_per_device(),
+            param_bytes=self.param_bytes_per_device())
         for pass_id in range(num_passes):
             event_handler(events.BeginPass(pass_id))
             self.evaluators.reset()

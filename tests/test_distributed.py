@@ -157,14 +157,17 @@ class TestSshLaunch:
 
 
 class TestZeroCollectivePattern:
-    """ZeRO-1's compiled-HLO contract on the virtual CPU mesh: the
-    full-gradient all-reduce of classic DP disappears under zero=1 in
-    favour of the reduce-scatter form (XLA:CPU emits it as the manual
+    """The ZeRO stages' compiled-HLO contracts on the virtual CPU mesh:
+    the full-gradient all-reduce of classic DP disappears under zero>=1
+    in favour of the reduce-scatter form (XLA:CPU emits it as the manual
     all-reduce-consumed-only-by-shard-slices pattern — the CPU pipeline
     lacks the reduce-scatter-creator pass; ``benchmarks/zero_bench.py
-    --tpu-check`` and ``scaling_aot.py --zero1`` show the real XLA:TPU
-    fused all-reduce-scatter) plus a param-sized post-update all-gather.
-    ``parallel.spmd.zero_collective_evidence`` classifies all three."""
+    --tpu-check`` and ``scaling_aot.py --zero1/2/3`` show the real
+    XLA:TPU fused all-reduce-scatter) plus a param-sized post-update
+    all-gather below stage 3; at stage 2 the contract extends to the
+    accumulation path, and at stage 3 params enter the module as 1/N
+    shards with only on-use all-gathers.
+    ``parallel.spmd.zero_collective_evidence`` classifies all of it."""
 
     def _evidence(self, zero, accum=1):
         import jax
@@ -216,6 +219,40 @@ class TestZeroCollectivePattern:
         ev = self._evidence(zero=1, accum=2)
         assert ev["full_grad_all_reduce"] == 0, ev
         assert ev["param_all_gather"] >= 1, ev
+
+    def test_zero2_no_full_grad_all_reduce_anywhere(self):
+        """Stage 2: the sharded-gradient contract holds on the plain AND
+        the accumulation path — no gradient-sized all-reduce is consumed
+        at full size anywhere (each microbatch reduce-scatters into the
+        sharded carry; XLA may also choose the gather-the-activations
+        strategy, which never materializes a full grad either). Params
+        are still resident in full (that is stage 3's job)."""
+        for accum in (1, 2):
+            ev = self._evidence(zero=2, accum=accum)
+            assert ev["full_grad_all_reduce"] == 0, (accum, ev)
+            assert ev["resident_full_args"] >= 1, (accum, ev)
+
+    def test_zero3_sharded_resident_params_gather_on_use(self):
+        """Stage 3: no ENTRY argument is a full replicated parameter
+        (params enter as 1/N zero_spec shards — per-device entry shapes
+        prove residency), the all-gathers that exist are consumed by
+        compute (gather-on-use), none flow straight to the output (the
+        post-update regather of stages 1-2 is gone), and the gather's
+        backward transpose reduce-scatters the grads — no full-gradient
+        all-reduce."""
+        for accum in (1, 2):
+            ev = self._evidence(zero=3, accum=accum)
+            assert ev["resident_full_args"] == 0, (accum, ev)
+            assert ev["on_use_all_gather"] >= 1, (accum, ev)
+            assert ev["output_all_gather"] == 0, (accum, ev)
+            assert ev["full_grad_all_reduce"] == 0, (accum, ev)
+            assert ev["reduce_scatter"] >= 1, (accum, ev)
+
+    def test_zero0_has_full_resident_params(self):
+        """The stage-3 discriminator is meaningful: classic DP shows
+        replicated full-param entry args."""
+        ev = self._evidence(zero=0)
+        assert ev["resident_full_args"] >= 1, ev
 
 
 class TestHybridMeshSingleProcess:

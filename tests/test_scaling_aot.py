@@ -89,6 +89,44 @@ class TestAnalyzeSchedule:
         assert by["all-reduce.1"]["compute_ops_after"] == 1
         assert by["all-reduce.4"]["compute_ops_after"] == 0
 
+    def test_async_all_gather_window(self):
+        """all-gather-start/done pairs (the ZeRO-3 on-use gathers under
+        the TPU async scheduler) form overlap windows like async
+        all-reduces do — with op recorded and the tuple-shape bytes
+        taken whole (operand shard + full result)."""
+        hlo = """HloModule jit_step, is_scheduled=true
+
+ENTRY %main {
+  %ag-start = (f32[64]{0}, f32[256]{0}) all-gather-start(%p), channel_id=5, replica_groups=[1,4]<=[4], dimensions={0}
+  %fusion.2 = f32[128]{0} fusion(%q), kind=kLoop
+  %ag-done = f32[256]{0} all-gather-done(%ag-start)
+  %convolution.1 = f32[128]{0} convolution(%ag-done, %w), window={size=1}
+}
+"""
+        s = sa.analyze_schedule(hlo)
+        assert len(s["async_windows"]) == 1
+        w = s["async_windows"][0]
+        assert w["op"] == "all-gather"
+        # window bytes = the DONE op's result shape (the collective's
+        # true result), not the start's operand+result tuple — the
+        # reduce-scatter wire factor (g-1)x needs shard-sized bytes
+        assert w["bytes"] == 256 * 4
+        assert w["group_min"] == 0 and w["group_max"] == 3
+        assert w["compute_ops_inside"] == 1      # the fusion overlaps
+
+    def test_megascale_send_max_bytes(self):
+        s = sa.analyze_schedule(HLO)
+        assert s["megascale_send_max_bytes"] == s["megascale_send_bytes"]
+        two = HLO.replace(
+            "%send = (f32[1,1,128]",
+            "%send.9 = (f32[1,1,64]{2,1,0:T(1,64)}, u32[], token[]) "
+            "send(%x, %tok), channel_id=8, is_host_transfer=true, "
+            "frontend_attributes={megascale_transfer_type=\"ALL_REDUCE\"}"
+            "\n  %send = (f32[1,1,128]")
+        s2 = sa.analyze_schedule(two)
+        assert s2["megascale_sends"] == 2
+        assert s2["megascale_send_max_bytes"] == 512 + 4
+
     def test_unparsed_replica_groups_flagged(self):
         """An encoding _parse_group doesn't know must be FLAGGED in the
         artifact, not silently modeled as all-devices-over-ICI

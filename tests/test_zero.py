@@ -1,14 +1,20 @@
-"""ZeRO-1 sharded weight update (parallel/spmd.py ``zero_stage=1``).
+"""ZeRO sharded training (parallel/spmd.py ``zero_stage=1..3``).
 
-The contract under test (ISSUE 5 / Xu et al., "Automatic Cross-Replica
+The contract under test (ISSUEs 5+8 / Xu et al., "Automatic Cross-Replica
 Sharding of Weight Update in Data-Parallel Training"): on a pure-DP mesh
-the optimizer state of replicated params shards over the ``data`` axis
-(largest divisible dim; tiny/indivisible leaves stay replicated with a
-report), the update runs on 1/N shards between a grad reduce-scatter and
-a post-update all-gather, and the training trajectory is numerically
-IDENTICAL to classic replicated DP — for SGD/Momentum/Adam, with and
-without grad accumulation, across checkpoint save/restore onto a
-different mesh size (the checkpoint holds full arrays, so restore IS the
+- stage 1 shards the optimizer state of replicated params over ``data``
+  (largest divisible dim; tiny/indivisible leaves stay replicated with a
+  report) and runs the update on 1/N shards between a grad
+  reduce-scatter and a post-update all-gather;
+- stage 2 additionally lays gradients (and the accum-scan carry) out
+  with the same ``zero_spec`` — ``grad_bytes_per_device`` → ~1/N;
+- stage 3 additionally STORES params as 1/N shards, all-gathered on use
+  inside the step — ``param_bytes_per_device`` → ~1/N, no post-update
+  all-gather, and the gather's backward transpose IS the reduce-scatter;
+and at every stage the training trajectory is numerically IDENTICAL to
+classic replicated DP — for SGD/Momentum/Adam, with and without grad
+accumulation, across checkpoint save/restore onto a different mesh size
+or zero stage (the checkpoint holds full arrays, so restore IS the
 reshard)."""
 
 import numpy as np
@@ -110,9 +116,91 @@ class TestZeroPolicy:
         assert rep["replicated"]["s"] == "scalar"
         assert rep["axis_size"] == 4
 
+    def test_grad_spec_stages(self):
+        """Gradients take the zero layout at stage>=2; the accum carry
+        already at stage>=1; plain stage-1 grads keep the param layout."""
+        p = {"w": np.zeros((8, 16), np.float32)}
+        assert self._cfg(zero=2).grad_spec("w", (8, 16)) == \
+            P(None, "data")
+        assert self._cfg(zero=1).grad_spec("w", (8, 16)) == P()
+        assert self._cfg(zero=1).grad_spec("w", (8, 16), accum=True) == \
+            P(None, "data")
+        assert self._cfg(zero=0).grad_spec("w", (8, 16),
+                                           accum=True) == P()
+        sh = self._cfg(zero=2).grad_shardings(p)
+        assert sh["w"].spec == P(None, "data")
+
+    def test_store_spec_stages(self):
+        """Params are stored sharded only at stage 3 — stages 0-2 keep
+        the compute layout resident."""
+        assert self._cfg(zero=3).store_spec("w", (8, 16)) == \
+            P(None, "data")
+        assert self._cfg(zero=2).store_spec("w", (8, 16)) == P()
+        # indivisible leaves stay replicated even at stage 3
+        assert self._cfg(zero=3).store_spec("b", (3,)) == P()
+
+    def test_stage3_tp_matched_params_keep_their_layout(self):
+        mesh = place.make_mesh((2, 4),
+                               (place.AXIS_DATA, place.AXIS_MODEL))
+        cfg = parallel.DistConfig(
+            mesh, param_rules=[parallel.fc_column_rule(r"^h\.w$")],
+            zero_stage=3)
+        assert cfg.store_spec("h.w", (8, 16)) == P(None, place.AXIS_MODEL)
+        assert cfg.grad_spec("h.w", (8, 16)) == P(None, place.AXIS_MODEL)
+
+    def test_hierarchical_dcn_axis(self):
+        """On a multi-slice (dcn x data) mesh the batch shards over BOTH
+        axes but the ZeRO shard axis stays the ICI data axis — so the
+        1/N shard never divides over dcn and every cross-slice
+        collective moves shard-sized tensors (the hierarchical
+        rewrite)."""
+        mesh = place.make_mesh((2, 4), ("dcn", place.AXIS_DATA))
+        cfg = parallel.DistConfig(mesh, zero_stage=2)
+        assert cfg.dcn_axis() == "dcn"
+        assert cfg.zero_axis_size() == 4          # ICI only
+        assert cfg.batch_sharding().spec == P(("dcn", "data"))
+        assert cfg.zero_spec("w", (8, 16)) == P(None, "data")
+        rep = cfg.zero_report({"w": np.zeros((8, 16), np.float32)})
+        assert rep["dcn_axis"] == "dcn" and rep["axis_size"] == 4
+        # single-slice meshes are unchanged
+        plain = self._cfg()
+        assert plain.dcn_axis() is None
+        assert plain.batch_sharding().spec == P("data")
+
+    def test_report_grad_and_param_sections(self):
+        params = {"h.w": np.zeros((8, 16), np.float32),
+                  "o.b": np.zeros((3,), np.float32)}
+        r1 = self._cfg(zero=1).zero_report(params)
+        assert not r1["grads"]["sharded"]
+        assert "zero_stage<2" in r1["grads"]["replicated"]["h.w"]
+        assert "zero_stage<3" in r1["params"]["replicated"]["h.w"]
+        r2 = self._cfg(zero=2).zero_report(params)
+        assert r2["grads"]["sharded"]["h.w"]["shard_shape"] == [8, 4]
+        assert "divisible" in r2["grads"]["replicated"]["o.b"]
+        assert not r2["params"]["sharded"]
+        r3 = self._cfg(zero=3).zero_report(params)
+        assert r3["params"]["sharded"]["h.w"]["shard_shape"] == [8, 4]
+        assert "divisible" in r3["params"]["replicated"]["o.b"]
+
+
+_BASELINES = {}        # (opt, accum) -> zero=0 loss trajectory
+
+
+def _baseline(opt, accum=1):
+    """The zero=0 reference trajectory, computed once per (opt, accum)
+    — every stage compares against the same run."""
+    key = (opt, accum)
+    if key not in _BASELINES:
+        mesh = place.make_mesh((4,), (place.AXIS_DATA,))
+        _BASELINES[key], _ = _train(parallel.data_parallel(mesh),
+                                    OPTIMIZERS[opt], accum=accum)
+    return _BASELINES[key]
+
 
 class TestZeroNumerics:
-    """zero=1 must be a pure layout change: same losses as zero=0."""
+    """Every zero stage must be a pure layout change: same losses as
+    zero=0 — stage 2's sharded accumulators and stage 3's gather-on-use
+    params included."""
 
     MESH = (4,)
 
@@ -121,8 +209,7 @@ class TestZeroNumerics:
 
     @pytest.mark.parametrize("opt", ["sgd", "momentum", "adam"])
     def test_trajectory_matches_zero0(self, opt):
-        c0, _ = _train(parallel.data_parallel(self._mesh()),
-                       OPTIMIZERS[opt])
+        c0 = _baseline(opt)
         c1, tr = _train(parallel.data_parallel(self._mesh(), zero=1),
                         OPTIMIZERS[opt])
         assert len(c0) == 20
@@ -130,12 +217,29 @@ class TestZeroNumerics:
 
     @pytest.mark.parametrize("opt", ["momentum", "adam"])
     def test_trajectory_matches_with_grad_accum(self, opt):
-        c0, _ = _train(parallel.data_parallel(self._mesh()),
-                       OPTIMIZERS[opt], accum=2)
+        c0 = _baseline(opt, accum=2)
         c1, _ = _train(parallel.data_parallel(self._mesh(), zero=1),
                        OPTIMIZERS[opt], accum=2)
         assert len(c0) == 20
         np.testing.assert_allclose(c0, c1, rtol=2e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("opt", ["sgd", "momentum", "adam"])
+    @pytest.mark.parametrize("stage", [2, 3])
+    def test_stage23_trajectory_matches_zero0(self, stage, opt):
+        c0 = _baseline(opt)
+        cz, _ = _train(parallel.data_parallel(self._mesh(), zero=stage),
+                       OPTIMIZERS[opt])
+        assert len(c0) == 20
+        np.testing.assert_allclose(c0, cz, rtol=2e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("opt", ["sgd", "momentum", "adam"])
+    @pytest.mark.parametrize("stage", [2, 3])
+    def test_stage23_trajectory_matches_with_grad_accum(self, stage, opt):
+        c0 = _baseline(opt, accum=2)
+        cz, _ = _train(parallel.data_parallel(self._mesh(), zero=stage),
+                       OPTIMIZERS[opt], accum=2)
+        assert len(c0) == 20
+        np.testing.assert_allclose(c0, cz, rtol=2e-4, atol=1e-5)
 
     def test_opt_state_sharded_and_bytes_quartered(self):
         _, t0 = _train(parallel.data_parallel(self._mesh()),
@@ -172,12 +276,72 @@ class TestZeroNumerics:
         finally:
             observe.configure(None)
 
+    def test_stage3_param_and_grad_bytes_quartered(self):
+        """Stage 2 quarters the gradient layout, stage 3 additionally
+        the resident params — each ≤ 1/4 + the indivisible-leaf slack
+        (o.b: 3 floats)."""
+        _, t0 = _train(parallel.data_parallel(self._mesh()),
+                       OPTIMIZERS["adam"], passes=1)
+        _, t2 = _train(parallel.data_parallel(self._mesh(), zero=2),
+                       OPTIMIZERS["adam"], passes=1)
+        _, t3 = _train(parallel.data_parallel(self._mesh(), zero=3),
+                       OPTIMIZERS["adam"], passes=1)
+        slack = 3 * 4
+        assert t0.grad_bytes_per_device() == t0.param_bytes_per_device()
+        assert t2.grad_bytes_per_device() <= \
+            t0.grad_bytes_per_device() / 4 + slack
+        # stage 2 params stay resident in full
+        assert t2.param_bytes_per_device() == t0.param_bytes_per_device()
+        assert t3.param_bytes_per_device() <= \
+            t0.param_bytes_per_device() / 4 + slack
+        assert t3.grad_bytes_per_device() <= \
+            t0.grad_bytes_per_device() / 4 + slack
+        assert t3.opt_state_bytes_per_device() <= \
+            t0.opt_state_bytes_per_device() / 4 + 2 * slack
+        # the stored arrays really are 1/N shards on device
+        w = t3.parameters.values["h.w"]
+        assert "data" in str(w.sharding.spec)
+
+    def test_stage1_accum_carry_counts_sharded_grad_bytes(self):
+        """The accum-scan carry rides ZeRO-sharded from stage 1 on —
+        the gauge must report the carry's real (sharded, fp32) bytes."""
+        _, t1p = _train(parallel.data_parallel(self._mesh(), zero=1),
+                        OPTIMIZERS["sgd"], passes=1)
+        _, t1a = _train(parallel.data_parallel(self._mesh(), zero=1),
+                        OPTIMIZERS["sgd"], passes=1, accum=2)
+        assert t1p.grad_bytes_per_device() == t1p.param_bytes_per_device()
+        assert t1a.grad_bytes_per_device() <= \
+            t1p.grad_bytes_per_device() / 4 + 3 * 4
+
+    def test_step_records_carry_grad_and_param_bytes(self, tmp_path):
+        from paddle_tpu import observe
+        mpath = str(tmp_path / "m.jsonl")
+        observe.configure(mpath)
+        try:
+            _, tr = _train(parallel.data_parallel(self._mesh(), zero=3),
+                           OPTIMIZERS["adam"], passes=1)
+            observe.sink().flush()
+            recs = [r for r in observe.read_jsonl(mpath)
+                    if r.get("kind") == "step"]
+            assert recs and all(
+                r["grad_bytes"] == tr.grad_bytes_per_device()
+                and r["param_bytes"] == tr.param_bytes_per_device()
+                for r in recs)
+            reg = observe.default_registry()
+            assert reg.get("grad_bytes_per_device").value() == \
+                tr.grad_bytes_per_device()
+            assert reg.get("param_bytes_per_device").value() == \
+                tr.param_bytes_per_device()
+        finally:
+            observe.configure(None)
+
 
 class TestZeroBenchSmoke:
     def test_smoke_ab(self, tmp_path):
-        """zero_bench --smoke, tier-1 sized: the A/B must show the bytes
-        drop, the matching trajectory, and the collective rewrite, and
-        leave the standard bench_metrics JSONL trail."""
+        """zero_bench --smoke, tier-1 sized: the staged A/B must show
+        the per-stage bytes drops (opt state at 1, + grads at 2,
+        + params at 3), the matching trajectories, and the collective
+        rewrites, and leave the standard bench_metrics JSONL trail."""
         import importlib.util
         import json
         import os
@@ -197,10 +361,20 @@ class TestZeroBenchSmoke:
         assert res["traj_allclose"], res["max_loss_diff"]
         assert res["collective_pattern_ok"], (res["hlo_zero0"],
                                               res["hlo_zero1"])
+        for stage in ("1", "2", "3"):
+            s = res["stages"][stage]
+            assert s["contract_ok"], (stage, s)
+            assert s["traj_allclose"], (stage, s)
+        assert res["stages"]["2"]["grad_bytes_ratio"] <= 0.3
+        assert res["stages"]["3"]["param_bytes_ratio"] <= 0.3
+        assert res["stages"]["3"]["hlo"]["resident_full_args"] == 0
         with open(trail) as f:
             recs = [json.loads(l) for l in f]
-        assert any(r.get("metric") == "opt_state_bytes_per_device"
-                   and r.get("variant") == "zero1" for r in recs)
+        for variant in ("zero1", "zero2", "zero3"):
+            assert any(r.get("metric") == "opt_state_bytes_per_device"
+                       and r.get("variant") == variant for r in recs)
+        assert any(r.get("metric") == "param_bytes_per_device"
+                   and r.get("variant") == "zero3" for r in recs)
 
 
 class TestZeroCheckpointResharding:
@@ -253,3 +427,47 @@ class TestZeroCheckpointResharding:
                                    atol=1e-5)
         for leaf in tr_z0.opt_state["h.w"]:
             assert leaf.sharding.is_fully_replicated
+
+    def test_stage3_resharding_restore_trajectories(self, tmp_path):
+        """Save under zero=3/data=4 (params stored as 1/N shards — the
+        checkpoint still holds FULL host arrays, np.asarray gathers the
+        shards), then restore at every lower stage and onto a smaller
+        mesh: same trajectory; same-layout restore bit-identical."""
+        import shutil
+
+        from paddle_tpu.io import checkpoint as ckpt_io
+
+        ref, _ = self._run(3, (4,), 6)
+
+        ckdir = str(tmp_path / "ck3")
+        first, _ = self._run(3, (4,), 3, ckdir=ckdir)
+        np.testing.assert_array_equal(ref[:6], first)
+
+        latest = ckpt_io.latest_checkpoint(ckdir)
+        assert ckpt_io.checkpoint_meta(latest) == {
+            "zero": {"zero_stage": 3, "axis": "data", "axis_size": 4}}
+
+        # same layout: BIT-IDENTICAL continuation
+        same_dir = str(tmp_path / "same3")
+        shutil.copytree(ckdir, same_dir)
+        cont_same, tr_same = self._run(3, (4,), 3, ckdir=same_dir)
+        np.testing.assert_array_equal(ref[6:], cont_same)
+        assert "data" in str(
+            tr_same.parameters.values["h.w"].sharding.spec)
+
+        # restore at zero in {0, 1, 2} on data=4, and at data=2
+        for stage in (0, 1, 2):
+            d = str(tmp_path / f"z{stage}")
+            shutil.copytree(ckdir, d)
+            cont, tr = self._run(stage, (4,), 3, ckdir=d)
+            np.testing.assert_allclose(ref[6:], cont, rtol=2e-4,
+                                       atol=1e-5)
+            # below stage 3 the params come back resident-replicated
+            assert tr.parameters.values[
+                "h.w"].sharding.is_fully_replicated
+        half = str(tmp_path / "half3")
+        shutil.copytree(ckdir, half)
+        cont_half, tr_half = self._run(3, (2,), 3, ckdir=half)
+        np.testing.assert_allclose(ref[6:], cont_half, rtol=2e-4,
+                                   atol=1e-5)
+        assert tr_half.parallel.zero_axis_size() == 2
