@@ -38,6 +38,15 @@ FAMILIES = {
             ("serving_paged_speedup", "higher", 0.15),
             ("throughput.engine_paged.tokens_per_sec", "higher", 0.25),
             ("latency.engine_paged.ttft_p99_s", "lower", 0.35),
+            # decode-MFU + int8-serving floors (PR-10 artifact fields;
+            # SKIP against pre-PR-10 artifacts is by design): MFU is
+            # wall-clock-derived like throughput, so it breathes with
+            # host load; the int8/fp32 RATIO mostly cancels the machine
+            # and gets the tight band
+            ("throughput.engine_paged.decode_mfu", "higher", 0.35),
+            ("throughput.engine_paged_int8.tokens_per_sec",
+             "higher", 0.25),
+            ("serving_int8_speedup", "higher", 0.15),
         ],
     },
     "zero": {

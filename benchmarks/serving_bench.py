@@ -14,6 +14,19 @@ Replays request traces against three serving surfaces:
   batch formation, one shared prompt bucket, every row decodes to the
   LONGEST request's max_new, host-side argmax per token.
 
+The throughput phase additionally A/Bs the paged engine's hot-path
+levers (figures of merit: tokens/sec and decode MFU per variant):
+
+- ``engine_paged_int8``  — same engine, ``quantize_lm_params`` int8
+  weights consumed natively by the decode step (in-scan dequant,
+  1-byte weight reads per token; prefill dequantizes wholesale).
+- ``engine_paged_pallas`` — same engine, flash-decode Pallas kernel +
+  fused sampling epilogue (``ops/pallas/decode.py``), timed only where
+  the ``PADDLE_TPU_PALLAS`` policy resolves ``on`` (TPU under
+  ``auto``); off-TPU the artifact records the mode and skips the timed
+  run, and ``--smoke`` instead replays a tiny greedy trace through the
+  interpret-mode kernel asserting ids identical to the XLA path.
+
 TWO phases, each its own trace over the same request mix:
 
 - **throughput** — every request arrives at t=0 (offered load
@@ -144,9 +157,14 @@ def _result(variant, eng, reqs, wall, occ_slots, occ_blocks):
     toks = sum(len(r.tokens) for r in reqs)
     lat = [r.latency_s for r in reqs]
     ttft = [r.ttft_s for r in reqs]
+    mfu = eng.decode_mfu()
     r = {"variant": variant, "requests": len(reqs), "tokens": toks,
          "wall_s": round(wall, 4),
          "tokens_per_sec": round(toks / wall, 2),
+         # decode MFU (PR-2 accounting): decode FLOPs / (mean step s ×
+         # declared chip peak) — nominal-peak on CPU, honest on TPU
+         "decode_mfu": round(mfu, 9) if mfu is not None else None,
+         "pallas": eng.pallas_mode,
          "p50_latency_s": round(_pct(lat, 0.5), 4),
          "p99_latency_s": round(_pct(lat, 0.99), 4),
          "ttft_p50_s": round(_pct(ttft, 0.5), 4),
@@ -269,25 +287,37 @@ def _paged_programs(lens, chunk, bs, buckets):
 
 
 def paged_factory(params, cfg, *, batch, cache_len, block_size,
-                  chunk_tokens, num_blocks, tracker):
+                  chunk_tokens, num_blocks, tracker, pallas=None):
     """() -> fresh PagedDecodeEngine (cold pool + prefix cache) around
     ONE jitted program pair and ONE tracker, so repeat replays reuse
-    the compile cache and the compile invariant spans all of them."""
+    the compile cache and the compile invariant spans all of them.
+    ``pallas`` pins the PADDLE_TPU_PALLAS policy for the step programs;
+    ``params`` may be the quantize_lm_params int8 tree (the int8
+    serving variant)."""
     import jax
 
     from paddle_tpu.models import transformer
+    from paddle_tpu.ops.pallas import policy as _pallas_policy
     from paddle_tpu.serving import PagedDecodeEngine, sampling
+    from paddle_tpu.serving.engine import _decode_step_flops
     nb = int(num_blocks if num_blocks is not None
              else batch * (cache_len // block_size))
-    prefill_fn, decode_fn = sampling.paged_step_fns(cfg, block_size)
+    prefill_fn, decode_fn = sampling.paged_step_fns(cfg, block_size,
+                                                    pallas=pallas)
     jpf, jdf = jax.jit(prefill_fn), jax.jit(decode_fn)
+    pool0 = transformer.init_block_pool(cfg, nb, block_size)
+    flops = _decode_step_flops(
+        jdf, params, pool0, batch,
+        np.zeros((batch, cache_len // block_size), np.int32))
+    mode = _pallas_policy.pallas_mode(pallas)
 
     def make():
         pool = transformer.init_block_pool(cfg, nb, block_size)
         return PagedDecodeEngine(
             jpf, jdf, params, pool, batch=batch, cache_len=cache_len,
             block_size=block_size, num_blocks=nb,
-            chunk_tokens=chunk_tokens, seed=0, tracker=tracker)
+            chunk_tokens=chunk_tokens, seed=0, tracker=tracker,
+            decode_flops=flops, pallas_mode=mode)
 
     return make
 
@@ -298,14 +328,18 @@ def slots_factory(params, cfg, *, batch, cache_len, buckets, tracker):
 
     from paddle_tpu.models import transformer
     from paddle_tpu.serving import DecodeEngine, sampling
-    prefill_fn, decode_fn = sampling.engine_step_fns(cfg)
+    from paddle_tpu.serving.engine import _decode_step_flops
+    prefill_fn, decode_fn = sampling.engine_step_fns(cfg, pallas="off")
     jpf, jdf = jax.jit(prefill_fn), jax.jit(decode_fn)
+    cache0 = transformer.init_cache(cfg, batch, cache_len)
+    flops = _decode_step_flops(jdf, params, cache0, batch)
 
     def make():
         cache = transformer.init_cache(cfg, batch, cache_len)
         return DecodeEngine(jpf, jdf, params, cache, batch=batch,
                             cache_len=cache_len, buckets=buckets,
-                            seed=0, tracker=tracker)
+                            seed=0, tracker=tracker, decode_flops=flops,
+                            pallas_mode="off")
 
     return make
 
@@ -451,6 +485,13 @@ def main(argv=None):
     ap.add_argument("--num-blocks", type=int, default=None,
                     help="paged pool size (default: HBM parity with "
                          "the row arena, batch*cache_len/block_size)")
+    ap.add_argument("--pallas", default=None,
+                    choices=("auto", "on", "off", "interpret"),
+                    help="PADDLE_TPU_PALLAS override for the "
+                         "engine_paged_pallas variant (default: env > "
+                         "auto — Pallas on TPU, skipped elsewhere; the "
+                         "interpreter is a correctness path, far too "
+                         "slow for a timed trace off --smoke)")
     ap.add_argument("--repeats", type=int, default=3,
                     help="replays per (variant, phase); the best run "
                          "is reported (noise-robust on shared hosts)")
@@ -538,29 +579,63 @@ def main(argv=None):
     # the paged tracker's storm threshold sits above the chunk-grid
     # program ceiling: one compile per (bucket, span) is the DESIGN,
     # not a storm (the invariant below still pins the exact count)
+    from paddle_tpu.io import lm_serving
+    from paddle_tpu.ops.pallas import policy as pallas_policy
     from paddle_tpu.serving import default_chunk_buckets
     chunk = min(args.chunk_tokens, args.cache_len)
     n_chunk_buckets = len(default_chunk_buckets(chunk))
-    paged_tr = CompileTracker(
-        storm_threshold=(args.cache_len // chunk) * n_chunk_buckets + 2)
+    storm = (args.cache_len // chunk) * n_chunk_buckets + 2
+    paged_kw = dict(batch=args.batch, cache_len=args.cache_len,
+                    block_size=args.block_size,
+                    chunk_tokens=args.chunk_tokens,
+                    num_blocks=args.num_blocks)
+    paged_tr = CompileTracker(storm_threshold=storm)
     slots_tr = CompileTracker()
-    mk_paged = paged_factory(
-        params, cfg, batch=args.batch, cache_len=args.cache_len,
-        block_size=args.block_size, chunk_tokens=args.chunk_tokens,
-        num_blocks=args.num_blocks, tracker=paged_tr)
+    int8_tr = CompileTracker(storm_threshold=storm)
+    # the baselines PIN pallas="off": on TPU the ambient policy would
+    # otherwise resolve "on" and the "XLA engine" baseline would BE the
+    # Pallas path — serving_pallas_speedup comparing Pallas vs Pallas
+    mk_paged = paged_factory(params, cfg, tracker=paged_tr,
+                             pallas="off", **paged_kw)
     mk_slots = slots_factory(
         params, cfg, batch=args.batch, cache_len=args.cache_len,
         buckets=buckets, tracker=slots_tr)
+    # fp32-vs-int8: the same paged engine over quantize_lm_params
+    # weights — decode reads int8 (in-scan dequant), prefill dequantizes
+    # wholesale; XLA attention either way so the figure isolates the
+    # weight dtype
+    params_q8 = lm_serving.quantize_lm_params(params)
+    mk_int8 = paged_factory(params_q8, cfg, tracker=int8_tr,
+                            pallas="off", **paged_kw)
+    # XLA-vs-Pallas: one more paged variant with the flash-decode
+    # kernel + fused sampling epilogue, run only where the policy turns
+    # it on (auto = TPU; the interpreter is correctness-speed and gets
+    # its own dedicated check under --smoke below)
+    pallas_mode = pallas_policy.pallas_mode(args.pallas)
+    pallas_timed = pallas_mode == "on"
+    pallas_tr = CompileTracker(storm_threshold=storm)
+    mk_pallas = paged_factory(params, cfg, tracker=pallas_tr,
+                              pallas=args.pallas, **paged_kw) \
+        if pallas_timed else None
 
     lk_warm, lk_once = lockstep_factory(
         params, cfg, batch=args.batch, cache_len=lk_cache_len,
         buckets=buckets)
 
-    results = {}
+    results = {"pallas": {"mode": pallas_mode, "timed": pallas_timed}}
     repeats = max(1, args.repeats)
     for phase, work in (("throughput", work_tp), ("latency", work_lat)):
-        paged_warm = warm_engine(mk_paged, work, args.vocab)
-        slots_warm = warm_engine(mk_slots, work, args.vocab)
+        engines = [("engine_paged", mk_paged),
+                   ("engine_slots", mk_slots)]
+        if phase == "throughput":
+            # the capacity phase carries the kernel/int8 A/Bs (their
+            # figures of merit are tokens/sec and decode MFU)
+            if mk_pallas is not None:
+                engines.insert(1, ("engine_paged_pallas", mk_pallas))
+            engines.insert(len(engines) - 1,
+                           ("engine_paged_int8", mk_int8))
+        warms = {name: warm_engine(mk, work, args.vocab)
+                 for name, mk in engines}
         lk_warm(work)
         # repeats INTERLEAVED across variants so ambient machine load
         # lands on all of them, not on whichever ran first; each phase
@@ -571,14 +646,12 @@ def main(argv=None):
                 return r["ttft_p99_s"] < b["ttft_p99_s"]
             return r["tokens_per_sec"] > b["tokens_per_sec"]
 
+        runners = [(name, (lambda mk=mk, name=name: engine_once(
+            mk, name, work, warms[name]))) for name, mk in engines]
+        runners.append(("lockstep", lambda: lk_once(work)))
         best = {}
         for _ in range(repeats):
-            for variant, once in (
-                    ("engine_paged", lambda: engine_once(
-                        mk_paged, "engine_paged", work, paged_warm)),
-                    ("engine_slots", lambda: engine_once(
-                        mk_slots, "engine_slots", work, slots_warm)),
-                    ("lockstep", lambda: lk_once(work))):
+            for variant, once in runners:
                 r = once()
                 if variant not in best or better(r, best[variant]):
                     best[variant] = r
@@ -592,16 +665,52 @@ def main(argv=None):
 
     # compile discipline across BOTH phases and all repeats: one
     # program per (chunk bucket, context span) / prompt bucket + one
-    # decode, regardless of paging, hits, or adoption
+    # decode, regardless of paging, hits, adoption, weight dtype, or
+    # attention engine
     progs = _paged_programs(all_lens, chunk, args.block_size,
                             default_chunk_buckets(chunk))
-    assert paged_tr.count("serving_engine.decode") == 1
-    assert paged_tr.count("serving_engine.prefill") == len(progs), (
-        f"paged compile invariant: expected {len(progs)} chunk "
-        f"programs {sorted(progs)}, saw "
-        f"{paged_tr.count('serving_engine.prefill')}")
+    # the int8/pallas A/B variants replay the throughput trace only —
+    # their reachable program set is that phase's, not the union
+    progs_tp = _paged_programs({len(p) for _, p, _ in work_tp}, chunk,
+                               args.block_size,
+                               default_chunk_buckets(chunk))
+    for name, tr, want in (("paged", paged_tr, progs),
+                           ("int8", int8_tr, progs_tp)) + (
+            (("pallas", pallas_tr, progs_tp),) if pallas_timed else ()):
+        assert tr.count("serving_engine.decode") == 1, name
+        assert tr.count("serving_engine.prefill") == len(want), (
+            f"{name} compile invariant: expected {len(want)} chunk "
+            f"programs {sorted(want)}, saw "
+            f"{tr.count('serving_engine.prefill')}")
     assert slots_tr.count("serving_engine.decode") == 1
     assert slots_tr.count("serving_engine.prefill") <= len(buckets)
+
+    # the interpret-mode flash-decode + fused-sampling path must not
+    # rot on CPU-only CI: replay a tiny greedy trace on a
+    # pallas=interpret engine and demand ids identical to the XLA
+    # engine's (greedy sampling is exact on both paths). Runs under
+    # --smoke (tier-1) AND in the full bench, so the committed artifact
+    # certifies the kernel on the host that produced it.
+    ptr = CompileTracker(storm_threshold=storm)
+    mk_interp = paged_factory(params, cfg, tracker=ptr,
+                              pallas="interpret", **paged_kw)
+    srng = np.random.RandomState(11)
+    tiny = [srng.randint(0, args.vocab, n).astype(np.int32)
+            for n in (5, 9)]
+    out_interp, out_xla = [], []
+    for mk, sink in ((mk_interp, out_interp), (mk_paged, out_xla)):
+        eng = mk()
+        reqs = [eng.submit(p, max_new=4) for p in tiny]
+        eng.run_until_idle()
+        sink.extend(r.output.tolist() for r in reqs)
+    assert out_interp == out_xla, (
+        "pallas interpret decode diverged from the XLA path:\n"
+        f"{out_interp}\nvs\n{out_xla}")
+    results["pallas"]["interpret_check_ok"] = True
+    line = {"bench": "serving", "phase": "pallas_interpret_check",
+            "mode": "interpret", "requests": len(tiny), "ok": True}
+    print(json.dumps(line), flush=True)
+    metrics_write(**line)
 
     # dedicated attribution replay: one more latency-phase run on a
     # fresh paged engine with request-lifecycle tracing captured — the
@@ -639,15 +748,26 @@ def main(argv=None):
                / max(tp["engine_slots"]["tokens_per_sec"], 1e-9))
     ttft_ratio = (lat["engine_paged"]["ttft_p99_s"]
                   / max(lat["engine_slots"]["ttft_p99_s"], 1e-9))
-    for metric, value in (("serving_paged_speedup", speedup),
-                          ("serving_paged_ttft_p99_ratio", ttft_ratio)):
+    int8_speedup = (tp["engine_paged_int8"]["tokens_per_sec"]
+                    / max(tp["engine_paged"]["tokens_per_sec"], 1e-9))
+    figures = [("serving_paged_speedup", speedup),
+               ("serving_paged_ttft_p99_ratio", ttft_ratio),
+               # int8-vs-fp32 on the SAME engine: >1 where weight reads
+               # bound decode (TPU); CPU pays the dequant ALU instead
+               # and reports honestly below 1
+               ("serving_int8_speedup", int8_speedup)]
+    if "engine_paged_pallas" in tp:
+        figures.append((
+            "serving_pallas_speedup",
+            tp["engine_paged_pallas"]["tokens_per_sec"]
+            / max(tp["engine_paged"]["tokens_per_sec"], 1e-9)))
+    for metric, value in figures:
         line = {"bench": "serving", "metric": metric,
                 "value": round(value, 3),
                 "platform": jax.default_backend(), **trace_cfg}
         print(json.dumps(line), flush=True)
         metrics_write(**line)
-    results["serving_paged_speedup"] = round(speedup, 3)
-    results["serving_paged_ttft_p99_ratio"] = round(ttft_ratio, 3)
+        results[metric] = round(value, 3)
 
     out = args.out or os.path.join(
         REPO, "benchmarks", "runs",

@@ -1,10 +1,22 @@
 #!/usr/bin/env python
-"""Sweep flash-attention block sizes on the real chip and print the best
+"""Sweep Pallas kernel tilings on the real chip.
+
+Default mode sweeps flash-ATTENTION block sizes and prints the best
 (block_q, block_k) per (seq, head_dim, dtype) — paste winners into
 ops/pallas/attention.py MEASURED_BLOCKS.
 
+``--decode`` sweeps the flash-DECODE kernel over (KV block size,
+kv-page tile) per (span, head_dim, dtype) — paste winners into
+ops/pallas/decode.py MEASURED_DECODE. The block-size axis is advisory
+for ENGINE configuration (the pool layout is the engine's choice); the
+tile axis is the kernel's page-gather granularity, consulted at
+dispatch when the advisory block size matches the pool actually
+handed over (analytic VMEM-budget default otherwise).
+
 Usage: python benchmarks/tune_flash_blocks.py [--seqs 2048,8192]
        [--head-dims 64,128] [--dtypes bfloat16,float32] [--iters 20]
+       [--decode] [--slots 8] [--kv-heads 8] [--q-per-kv 1]
+       [--interpret]
 """
 
 import argparse
@@ -17,15 +29,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--seqs", default="1024,2048,4096,8192")
-    ap.add_argument("--head-dims", default="64,128")
-    ap.add_argument("--dtypes", default="bfloat16,float32")
-    ap.add_argument("--batch-heads", type=int, default=8)
-    ap.add_argument("--iters", type=int, default=20)
-    args = ap.parse_args()
-
+def attention_sweep(args):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -78,6 +82,107 @@ def main():
     print("\nMEASURED_BLOCKS entries:")
     for k, v in sorted(results.items()):
         print(f"    {k}: {v},")
+
+
+def decode_sweep(args):
+    """Flash-decode (block size, kv-page tile) sweep: B slots decode
+    one token each against a pool holding ``span`` resident tokens per
+    slot; the timed call is the kernel alone (the engine's scatter
+    write and epilogue are tiling-independent)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas import decode as fd
+    from paddle_tpu.utils.sync import host_sync
+
+    rng = np.random.RandomState(0)
+    B, Hkv, G = args.slots, args.kv_heads, args.q_per_kv
+    results = {}
+    for span, d, dname in itertools.product(
+            (int(s) for s in args.seqs.split(",")),
+            (int(s) for s in args.head_dims.split(",")),
+            args.dtypes.split(",")):
+        dtype = jnp.dtype(dname)
+        q = jnp.asarray(rng.randn(B, Hkv, G, d), jnp.float32)
+        pos = jnp.full((B,), span - 1, jnp.int32)
+        best = None
+        for bs in (8, 16, 32, 64, 128):
+            if span % bs:
+                continue
+            P = span // bs
+            M = B * span                      # pool at arena parity
+            if not fd.decode_kernel_fits(M, P, bs, G, d, dtype):
+                print(f"  span={span} d={d} {dname} bs={bs}: VMEM "
+                      f"over budget, skipped", flush=True)
+                continue
+            k = jnp.asarray(rng.randn(M, Hkv, d), dtype)
+            v = jnp.asarray(rng.randn(M, Hkv, d), dtype)
+            pages = jnp.asarray(
+                rng.permutation(M // bs)[:B * P].reshape(B, P)
+                .astype(np.int32))            # scrambled, like production
+            for tile in (1, 2, 4, 8):
+                if P % tile:
+                    continue
+                try:
+                    f = jax.jit(lambda q_, k_, v_, pg, ps, bs=bs,
+                                tile=tile: fd.flash_decode_attention(
+                                    q_, k_, v_, pg, ps, block_size=bs,
+                                    tile=tile,
+                                    interpret=args.interpret))
+                    host_sync(f(q, k, v, pages, pos))
+                    t0 = time.time()
+                    out = None
+                    for _ in range(args.iters):
+                        out = f(q, k, v, pages, pos)
+                    host_sync(out)
+                    dt = (time.time() - t0) / args.iters
+                except Exception as e:               # noqa: BLE001
+                    print(f"  span={span} d={d} {dname} bs={bs} "
+                          f"tile={tile}: FAILED "
+                          f"{type(e).__name__}: {e}", flush=True)
+                    continue
+                print(f"  span={span} d={d} {dname} bs={bs} "
+                      f"tile={tile}: {dt * 1e6:.0f} us/step "
+                      f"({B / dt:.0f} tok/s)", flush=True)
+                if best is None or dt < best[0]:
+                    best = (dt, bs, tile)
+        if best:
+            bucket = 1 << max(0, (span - 1)).bit_length()
+            results[(bucket, d, dname)] = (best[1], best[2])
+            print(f"BEST span={span} d={d} {dname}: "
+                  f"({best[1]}, {best[2]})", flush=True)
+    print("\nMEASURED_DECODE entries:")
+    for k, v in sorted(results.items()):
+        print(f"    {k}: {v},")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="1024,2048,4096,8192",
+                    help="sequence lengths (attention) / resident "
+                         "per-slot spans (--decode)")
+    ap.add_argument("--head-dims", default="64,128")
+    ap.add_argument("--dtypes", default="bfloat16,float32")
+    ap.add_argument("--batch-heads", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--decode", action="store_true",
+                    help="sweep the flash-decode kernel's (block size, "
+                         "kv-page tile) instead of attention blocks")
+    ap.add_argument("--slots", type=int, default=8,
+                    help="--decode: concurrent decode slots (B)")
+    ap.add_argument("--kv-heads", type=int, default=8,
+                    help="--decode: KV heads in the pool")
+    ap.add_argument("--q-per-kv", type=int, default=1,
+                    help="--decode: query heads per KV head (GQA group)")
+    ap.add_argument("--interpret", action="store_true",
+                    help="--decode: run the kernel interpreted "
+                         "(plumbing check off-TPU; timings meaningless)")
+    args = ap.parse_args()
+    if args.decode:
+        decode_sweep(args)
+    else:
+        attention_sweep(args)
 
 
 if __name__ == "__main__":

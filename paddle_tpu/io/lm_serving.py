@@ -192,7 +192,12 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
         raise ValueError("engine_paged=True needs engine_buckets= "
                          "(the chunk buckets to export)")
     if engine_buckets:
+        from paddle_tpu.ops.pallas import policy as _pallas_policy
         from paddle_tpu.serving import sampling as _sampling
+        # stamp which attention/sampling path the engine modules were
+        # compiled with (the resolved PADDLE_TPU_PALLAS policy at
+        # export time) — a loader cannot re-derive it from the .bin
+        engine_pallas = _pallas_policy.pallas_mode(None)
         buckets = sorted({int(b) for b in engine_buckets})
         bad = [b for b in buckets if b < 1 or b > cache_len]
         if bad:
@@ -230,7 +235,8 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
                     f"context spans")
             engine_paged_meta = {"block_size": bs, "num_blocks": nb,
                                  "pages_per_slot": pages,
-                                 "chunk_tokens": chunk}
+                                 "chunk_tokens": chunk,
+                                 "pallas": engine_pallas}
             eng_prefill, eng_decode = _sampling.paged_step_fns(
                 cfg, bs, dequant=dequant)
             pool_shapes = jax.tree_util.tree_map(
@@ -296,6 +302,7 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
         "cost_analysis": cost_analysis}
     if engine_buckets:
         meta["engine_buckets"] = buckets
+        meta["engine_pallas"] = engine_pallas
     if engine_paged_meta:
         meta["engine_paged"] = engine_paged_meta
     flat = _flatten(params)
@@ -455,7 +462,10 @@ class LMServer:
                 num_blocks=paged["num_blocks"],
                 chunk_tokens=meta_chunk,
                 chunk_buckets=self.engine_buckets, seed=seed,
-                registry=registry, tracker=tracker)
+                registry=registry, tracker=tracker,
+                decode_flops=self.cost_analysis.get(
+                    "engine_decode", {}).get("flops"),
+                pallas_mode=self.meta.get("engine_pallas"))
         if chunk_tokens is not None:
             raise ValueError(
                 f"chunk_tokens={chunk_tokens}: this artifact (format "
@@ -482,7 +492,10 @@ class LMServer:
             prefill, decode, self.params, cache,
             batch=self.meta["batch"], cache_len=self.meta["cache_len"],
             buckets=self.engine_buckets, seed=seed, registry=registry,
-            tracker=tracker)
+            tracker=tracker,
+            decode_flops=self.cost_analysis.get(
+                "engine_decode", {}).get("flops"),
+            pallas_mode=self.meta.get("engine_pallas"))
 
     def generate(self, prompt: np.ndarray, max_new: int,
                  temperature: float = 0.0,

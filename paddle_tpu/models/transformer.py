@@ -224,6 +224,66 @@ def _rope(x, tables):
         axis=-1).astype(x.dtype)
 
 
+def _blocks_quantized(params) -> bool:
+    """True when the big matmul weights ride as {"q8","scale"} nodes
+    (``io/lm_serving.quantize_lm_params``) — the int8-weight serving
+    path the decode steps handle natively (dequant INSIDE the layer
+    scan, so weights are read from HBM at 1 byte/elt per token)."""
+    from paddle_tpu.ops import q8 as ops_q8
+    return any(ops_q8.is_quantized_weight(n) for n in
+               jax.tree_util.tree_leaves(
+                   params["blocks"], is_leaf=ops_q8.is_quantized_weight))
+
+
+def _live_layer_weights(w, li):
+    """Dequantize ONE layer's {"q8","scale"} weights inside the scan
+    body, with the anti-hoist defenses proven in ``generate``: the
+    weights arrive as scanned xs (loop-VARIANT by data dependence — a
+    dynamic slice of the int8 stack per iteration), sit behind an
+    optimization barrier, and the scales fold in a float zero derived
+    from the layer counter. XLA therefore cannot rematerialize the full
+    fp32 weight stack outside the loop; each layer's dequant multiply
+    fuses into its matmul operand reads (asserted on the optimized HLO
+    in tests/test_pallas_decode.py)."""
+    from paddle_tpu.ops import q8 as ops_q8
+    w = jax.lax.optimization_barrier(w)
+    eps = li.astype(jnp.float32) * 0.0
+
+    def leaf(n):
+        if ops_q8.is_quantized_weight(n):
+            return ops_q8.dequantize_weight(
+                {"q8": n["q8"], "scale": n["scale"] + eps})
+        return n
+
+    return {k: leaf(v) for k, v in w.items()}
+
+
+def _embed_rows(params, tokens, cfg):
+    """Token-embedding gather, q8-aware: quantized embeddings gather
+    int8 rows and dequantize per row (the [B, 1] scale broadcast fuses
+    into the gather's consumer) — no fp32 [V, D] table materializes."""
+    from paddle_tpu.ops import q8 as ops_q8
+    emb = params["embed"]
+    if ops_q8.is_quantized_weight(emb):
+        return (jnp.take(emb["q8"], tokens, axis=0).astype(jnp.float32)
+                * jnp.take(emb["scale"], tokens, axis=0)).astype(cfg.dtype)
+    return jnp.take(emb, tokens, axis=0).astype(cfg.dtype)
+
+
+def _vocab_logits(x, params):
+    """Final vocab projection [B, D] -> [B, V], q8-aware: the dequant
+    multiply is elementwise on the einsum operand, which XLA fuses into
+    the dot's weight read (1-byte weight traffic on TPU; CPU may
+    materialize — the logits head is one matrix, amortized against the
+    L-layer stack the scan protects)."""
+    from paddle_tpu.ops import q8 as ops_q8
+    emb = params["embed"]
+    emb32 = (ops_q8.dequantize_weight(emb)
+             if ops_q8.is_quantized_weight(emb)
+             else emb.astype(jnp.float32))
+    return jnp.einsum("bd,vd->bv", x.astype(jnp.float32), emb32)
+
+
 def forward(params, tokens: jax.Array, cfg: TransformerConfig, *,
             mesh: Optional[Mesh] = None,
             lengths: Optional[jax.Array] = None,
@@ -564,14 +624,20 @@ def decode_step_slots(params, cache, tokens: jax.Array, pos: jax.Array,
     sharing it: the lockstep path keeps its cheaper scalar-index
     ``dynamic_update_slice`` (and its exported v1/v2 artifact program),
     while this variant needs per-row where-writes. The bitwise test in
-    tests/test_serving_engine.py pins the two against drifting."""
+    tests/test_serving_engine.py pins the two against drifting.
+
+    ``params`` may carry int8 weights ({"q8","scale"} nodes from
+    ``io/lm_serving.quantize_lm_params``): they ride the layer scan as
+    int8 xs and dequantize inside the body (``_live_layer_weights``
+    anti-hoist defenses), so serving reads weights at 1 byte/elt."""
     B = tokens.shape[0]
     H, Dh = cfg.n_heads, cfg.head_dim
     Hkv = cfg.kv_heads
     kvd = Hkv * Dh
     max_len = cache["k"].shape[2]
+    quantized = _blocks_quantized(params)
     pos = jnp.asarray(pos, jnp.int32)
-    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    x = _embed_rows(params, tokens, cfg)
     if not cfg.use_rope:
         x = x + jnp.take(params["pos"], pos, axis=0).astype(cfg.dtype)
     rope_tabs = _rope_tables(pos, Dh, cfg.rope_theta) \
@@ -585,7 +651,9 @@ def decode_step_slots(params, cache, tokens: jax.Array, pos: jax.Array,
               <= pos[:, None])                          # [B, max_len]
 
     def block(x, scanned):
-        w, kc, vc = scanned                  # kc/vc [B, max_len, Hkv, Dh]
+        w, li, kc, vc = scanned              # kc/vc [B, max_len, Hkv, Dh]
+        if quantized:
+            w = _live_layer_weights(w, li)
         h = _layer_norm(x, w["ln1"], w["ln1_b"])
         qkv = h @ w["qkv"].astype(h.dtype)   # [B, D + 2*kvd]
         q, k, v = jnp.split(qkv, [H * Dh, H * Dh + kvd], axis=-1)
@@ -623,17 +691,18 @@ def decode_step_slots(params, cache, tokens: jax.Array, pos: jax.Array,
             x = x + ff @ w["mlp_out"].astype(ff.dtype)
         return x, (kc, vc)
 
-    x, (kn, vn) = jax.lax.scan(block, x,
-                               (params["blocks"], cache["k"], cache["v"]))
+    li = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    x, (kn, vn) = jax.lax.scan(block, x, (params["blocks"], li,
+                                          cache["k"], cache["v"]))
     x = _layer_norm(x, params["ln_f"], params["ln_f_b"])
-    logits = jnp.einsum("bd,vd->bv", x.astype(jnp.float32),
-                        params["embed"].astype(jnp.float32))
+    logits = _vocab_logits(x, params)
     return logits, {"k": kn, "v": vn}
 
 
 def decode_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
                       active: jax.Array, pages: jax.Array,
-                      cfg: TransformerConfig, *, block_size: int):
+                      cfg: TransformerConfig, *, block_size: int,
+                      pallas: Optional[str] = None):
     """One incremental step over the PAGED block pool: tokens [B] int32,
     ``pos`` [B] int32, ``active`` [B] bool, ``pages`` [B, P] int32 block
     ids → (logits [B, vocab] fp32, updated pool).
@@ -654,7 +723,23 @@ def decode_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
     cache_len, and every elementwise/reduction shape matches
     ``decode_step_slots`` — logits and written cache values are bitwise
     identical (pinned in tests/test_paged_engine.py), so the two decode
-    paths cannot drift."""
+    paths cannot drift.
+
+    ``pallas`` picks the attention engine through the package-wide
+    ``PADDLE_TPU_PALLAS`` policy (explicit arg > env > auto): when it
+    resolves ``on``/``interpret`` (and the working set passes the VMEM
+    budget), the gather + score + softmax + weighted sum above is
+    replaced by ``ops.pallas.decode.flash_decode_attention`` — page
+    indices resolved inside the kernel, K/V streamed from the pool, no
+    gathered ``[B, T, Hkv, Dh]`` view or ``[B, H, T]`` score tensor in
+    HBM, bitwise the XLA path's logits on aligned fp32 shapes (pinned
+    in tests/test_pallas_decode.py). The pool WRITE of the step's new
+    k/v stays the same scatter on either engine. ``params`` may carry
+    int8 weights ({"q8","scale"} nodes): they ride the layer scan as
+    int8 xs and dequantize inside the body (``_live_layer_weights``
+    anti-hoist defenses), so serving reads weights at 1 byte/elt."""
+    from paddle_tpu.ops.pallas import decode as _pallas_decode
+    from paddle_tpu.ops.pallas import policy as _pallas_policy
     B = tokens.shape[0]
     P = pages.shape[1]
     bs = int(block_size)
@@ -663,9 +748,16 @@ def decode_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
     Hkv = cfg.kv_heads
     kvd = Hkv * Dh
     M = cache["k"].shape[1]
+    quantized = _blocks_quantized(params)
+    mode = _pallas_policy.pallas_mode(pallas)
+    use_pallas = mode != "off"
+    if use_pallas and mode == "on" and not _pallas_decode.decode_kernel_fits(
+            M, P, bs, H // Hkv, Dh, cache["k"].dtype):
+        use_pallas = False          # pure-XLA fallback rather than an
+        #                             opaque Mosaic VMEM failure
     pos = jnp.asarray(pos, jnp.int32)
     pages = jnp.asarray(pages, jnp.int32)
-    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    x = _embed_rows(params, tokens, cfg)
     if not cfg.use_rope:
         x = x + jnp.take(params["pos"], pos, axis=0).astype(cfg.dtype)
     rope_tabs = _rope_tables(pos, Dh, cfg.rope_theta) \
@@ -683,7 +775,9 @@ def decode_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
               <= pos[:, None])                           # [B, T] logical
 
     def block(x, scanned):
-        w, kc, vc = scanned                  # kc/vc [M, Hkv, Dh]
+        w, li, kc, vc = scanned              # kc/vc [M, Hkv, Dh]
+        if quantized:
+            w = _live_layer_weights(w, li)
         h = _layer_norm(x, w["ln1"], w["ln1_b"])
         qkv = h @ w["qkv"].astype(h.dtype)   # [B, D + 2*kvd]
         q, k, v = jnp.split(qkv, [H * Dh, H * Dh + kvd], axis=-1)
@@ -696,15 +790,23 @@ def decode_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
                              mode="drop")
         vc = vc.at[widx].set(v.reshape(B, Hkv, Dh).astype(vc.dtype),
                              mode="drop")
-        kt = jnp.take(kc, gidx, axis=0)      # [B, T, Hkv, Dh] logical view
-        vt = jnp.take(vc, gidx, axis=0)
         g = H // Hkv
-        q32 = q.reshape(B, Hkv, g, Dh).astype(jnp.float32)
-        s = jnp.einsum("bkgd,btkd->bkgt", q32,
-                       kt.astype(jnp.float32)) / math.sqrt(Dh)
-        s = jnp.where(attend[:, None, None, :], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        attn = jnp.einsum("bkgt,btkd->bkgd", p, vt.astype(jnp.float32))
+        if use_pallas:
+            # the kernel reads the just-written pool (pos attends to
+            # itself) and resolves gidx's page walk internally
+            attn = _pallas_decode.flash_decode_attention(
+                q.reshape(B, Hkv, g, Dh), kc, vc, pages, pos,
+                block_size=bs, interpret=(mode == "interpret"))
+        else:
+            kt = jnp.take(kc, gidx, axis=0)  # [B, T, Hkv, Dh] logical
+            vt = jnp.take(vc, gidx, axis=0)
+            q32 = q.reshape(B, Hkv, g, Dh).astype(jnp.float32)
+            s = jnp.einsum("bkgd,btkd->bkgt", q32,
+                           kt.astype(jnp.float32)) / math.sqrt(Dh)
+            s = jnp.where(attend[:, None, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum("bkgt,btkd->bkgd", p,
+                              vt.astype(jnp.float32))
         attn = attn.reshape(B, cfg.d_model).astype(cfg.dtype)
         x = x + attn @ w["attn_out"].astype(attn.dtype)
         h2 = _layer_norm(x, w["ln2"], w["ln2_b"])
@@ -723,11 +825,11 @@ def decode_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
             x = x + ff @ w["mlp_out"].astype(ff.dtype)
         return x, (kc, vc)
 
-    x, (kn, vn) = jax.lax.scan(block, x,
-                               (params["blocks"], cache["k"], cache["v"]))
+    li = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    x, (kn, vn) = jax.lax.scan(block, x, (params["blocks"], li,
+                                          cache["k"], cache["v"]))
     x = _layer_norm(x, params["ln_f"], params["ln_f_b"])
-    logits = jnp.einsum("bd,vd->bv", x.astype(jnp.float32),
-                        params["embed"].astype(jnp.float32))
+    logits = _vocab_logits(x, params)
     return logits, {"k": kn, "v": vn}
 
 

@@ -4,6 +4,29 @@ paddle/cuda/src/hl_cuda_*.cu; see /opt/skills/guides/pallas_guide.md).
 
 Each kernel ships with a jnp reference implementation and dispatches to it
 off-TPU, so the package runs everywhere; tests exercise the kernels in
-Pallas interpret mode on CPU."""
+Pallas interpret mode on CPU.
+
+Dispatch policy — ``PADDLE_TPU_PALLAS``
+---------------------------------------
+One documented knob decides whether the Pallas kernels run, shared by
+every kernel in this package (``attention.flash_attention``,
+``decode.flash_decode_attention`` / ``decode.fused_sample`` and whatever
+lands next):
+
+- ``auto`` (default) — kernels on TPU, jnp/XLA fallback elsewhere;
+- ``on``        — compile the kernels on the current backend;
+- ``off``       — always the pure-XLA fallback (the path every feature
+  keeps available — correctness never depends on Pallas);
+- ``interpret`` — run the kernels through the Pallas interpreter (the
+  CPU correctness path tier-1 exercises).
+
+Precedence: explicit call-site argument > ``PADDLE_TPU_PALLAS`` env >
+``auto`` (tested in tests/test_pallas_decode.py::TestPallasPolicy).
+"""
+
+from paddle_tpu.ops.pallas.policy import (  # noqa: F401
+    PALLAS_MODES, pallas_mode)
 
 from paddle_tpu.ops.pallas.attention import flash_attention  # noqa: F401
+from paddle_tpu.ops.pallas.decode import (  # noqa: F401,E402
+    flash_decode_attention, fused_sample)

@@ -359,9 +359,13 @@ def flash_attention(q, k, v, *, causal: bool = True,
     Hkv < H (grouped-query attention) is expanded to the q-head layout
     here — a single-device layout concern only; the distributed ring path
     (parallel/ring.py) keeps collectives at Hkv heads and expands locally
-    per ring step. Dispatches to the Pallas kernel on TPU (or interpret
-    mode when forced); off-TPU uses the jnp reference so behaviour is
-    identical everywhere."""
+    per ring step. Dispatch resolves through the package-wide
+    ``PADDLE_TPU_PALLAS`` policy (``ops/pallas/policy.py``): ``auto``
+    keeps the historical behaviour — kernel on TPU, jnp reference
+    elsewhere — while the env var (or the ``interpret`` arg, which wins
+    over it: True pins the interpreter, False the compiled kernel) can
+    force any path on any backend."""
+    from paddle_tpu.ops.pallas import policy as _policy
     b, t, h, d = q.shape
     if k.shape[2] != h:
         k = jnp.repeat(k, h // k.shape[2], axis=2)
@@ -370,8 +374,10 @@ def flash_attention(q, k, v, *, causal: bool = True,
     qr = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     kr = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     vr = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    on_tpu = jax.devices()[0].platform == "tpu"
-    if interpret is None and not on_tpu:
+    mode = _policy.pallas_mode(
+        None if interpret is None else
+        ("interpret" if interpret else "on"))
+    if mode == "off":
         out = _reference(qr, kr, vr, sm_scale, causal)
     else:
         # shape-keyed selection (measured table + VMEM-fit validation)
@@ -385,5 +391,5 @@ def flash_attention(q, k, v, *, causal: bool = True,
             bq = min(block_q, t) if block_q else bq_auto
             bk = min(block_k, t) if block_k else bk_auto
         out = _flash(qr, kr, vr, sm_scale, causal, bq, bk,
-                     bool(interpret))
+                     mode == "interpret")
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,302 @@
+"""Flash-decode over the paged KV pool + fused sampling epilogue.
+
+The serving engine's per-token hot path (``transformer.decode_step_paged``)
+is gather-heavy under XLA: every step materializes a ``[B, T, Hkv, Dh]``
+logical KV view out of the block pool, re-reads it for the score einsum,
+and keeps a ``[B, H, T]`` score tensor in HBM between softmax stages.
+``flash_decode_attention`` is the Pallas replacement: one grid program per
+(slot, kv-head) resolves the slot's page-table indices INSIDE the kernel
+and streams the mapped K/V blocks straight from the pool into VMEM — no
+gathered logical view and no batch-wide score tensor ever exist in HBM.
+Per-slot position masking is fused in, accumulation is fp32.
+
+Decode's score row is ``O(T)`` per program (one query token), not the
+``O(T²)`` of prefill attention, so the whole masked row fits VMEM and the
+kernel applies ONE exact softmax to it (the same max/exp/sum/divide chain
+``jax.nn.softmax`` runs) instead of the prefill flash kernel's
+online-softmax rescaling chain. That choice is what makes the
+interpret-mode kernel BITWISE-identical to the XLA paged path on aligned
+fp32 shapes (pinned in tests/test_pallas_decode.py): an online softmax
+normalizes ``(p@v)/l`` where XLA computes ``(p/l)@v``, a rounding
+difference the streaming buys nothing for at decode shapes.
+
+``fused_sample`` is the epilogue: greedy / temperature / top-k sampling
+(``serving/sampling.sample_tokens`` semantics, per-slot runtime vectors)
+as a Pallas kernel, one program per batch row, so the compiled decode
+step emits ``[B] int32`` token ids with no full-vocab sort: the runtime-k
+threshold is found by a 32-step radix binary search over the
+order-preserving integer image of the logits, and the categorical draw is
+a Gumbel-max over hashed counter-based uniforms (``pltpu.prng`` is
+TPU-only; the hash keeps the kernel interpretable on CPU). Greedy rows
+and the kept top-k SET match ``sample_tokens`` exactly; the categorical
+draw itself matches in distribution, not per-id (different RNG stream —
+the contract tests assert the distribution, greedy ties, and membership).
+
+Dispatch resolves through the package-wide ``PADDLE_TPU_PALLAS`` policy
+(``ops/pallas/policy.py``); the pure-XLA gather path in
+``transformer.decode_step_paged`` remains the always-available fallback.
+"""
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from paddle_tpu.ops.pallas.attention import VMEM_BYTES
+
+NEG_INF = -1e30
+
+# ---------------------------------------------------------------------------
+# tile selection
+# ---------------------------------------------------------------------------
+
+# measured-best (block_size, kv-page tile) keyed (span bucket, head_dim,
+# dtype_name) — filled from on-chip sweeps (benchmarks/tune_flash_blocks.py
+# --decode); consulted before the analytic default. The block_size entry
+# is ADVISORY for engine configuration (the pool layout is the engine's
+# choice); the kernel consults the tile only when the entry's block_size
+# matches the pool it was actually handed. Span buckets are powers of two
+# (lookup rounds up).
+MEASURED_DECODE = {
+    # (span_bucket, head_dim, dtype): (block_size, pages_per_tile)
+}
+
+
+def decode_vmem_bytes(M: int, P: int, block_size: int, G: int, Dh: int,
+                      itemsize: int) -> int:
+    """Upper-bound VMEM residency of one (slot, kv-head) grid program:
+    the pool's head column for k and v (the kernel's blocks), the
+    fp32 gather buffers spanning the slot's T = P·bs logical positions,
+    the q/out tiles, and the score row (s and its softmax)."""
+    T = P * int(block_size)
+    return (2 * M * Dh * itemsize        # k/v pool head columns
+            + 2 * T * Dh * 4             # fp32 gather buffers
+            + 2 * G * Dh * 4             # q, out
+            + 2 * G * T * 4)             # scores + softmax row
+
+
+def decode_kernel_fits(M: int, P: int, block_size: int, G: int, Dh: int,
+                       dtype) -> bool:
+    """Whether the flash-decode working set fits the VMEM budget — the
+    dispatch guard: ``mode="on"`` falls back to the XLA gather path when
+    this says no, rather than letting Mosaic fail opaquely."""
+    itemsize = jnp.dtype(dtype).itemsize
+    return decode_vmem_bytes(M, P, block_size, G, Dh,
+                             itemsize) <= VMEM_BYTES
+
+
+def select_decode_tile(P: int, block_size: int, head_dim: int,
+                       dtype) -> int:
+    """Pages gathered per inner-loop iteration: the measured table first
+    (when its advisory block_size matches the pool's), then the analytic
+    default — the largest power-of-two divisor of P keeping the unrolled
+    gather at <= 256 rows per iteration (past that the unroll stops
+    paying and VMEM pressure from in-flight slices grows)."""
+    span = P * int(block_size)
+    bucket = 1 << max(0, (span - 1)).bit_length()     # next pow2 >= span
+    found = MEASURED_DECODE.get((bucket, head_dim,
+                                 jnp.dtype(dtype).name))
+    if found and found[0] == block_size and P % found[1] == 0:
+        return int(found[1])
+    tile = 1
+    while (tile * 2 <= P and P % (tile * 2) == 0
+           and tile * 2 * block_size <= 256):
+        tile *= 2
+    return tile
+
+
+# ---------------------------------------------------------------------------
+# flash-decode attention kernel
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, *,
+                   block_size, P, tile, G, Dh, scale):
+    """One (slot, kv-head) program. Blocks: pages (1, P), pos (1, 1),
+    q/o (1, 1, G, Dh), k/v the pool's head column (M, 1, Dh). The
+    page-gather loop touches only the slot's MAPPED physical blocks;
+    everything downstream mirrors the XLA gather path's op chain
+    exactly (divide-by-sqrt(Dh), -1e30 mask, jax.nn.softmax) so aligned
+    fp32 shapes reproduce its logits bitwise."""
+    bs = int(block_size)
+    T = P * bs
+
+    def gather(i, carry):
+        kbuf, vbuf = carry
+        for t in range(tile):           # static unroll: tile pages/iter
+            j = i * tile + t
+            pg = pages_ref[0, j]
+            ks = k_ref[pl.ds(pg * bs, bs), 0, :].astype(jnp.float32)
+            vs = v_ref[pl.ds(pg * bs, bs), 0, :].astype(jnp.float32)
+            kbuf = jax.lax.dynamic_update_slice(kbuf, ks, (j * bs, 0))
+            vbuf = jax.lax.dynamic_update_slice(vbuf, vs, (j * bs, 0))
+        return kbuf, vbuf
+
+    kbuf = jnp.zeros((T, Dh), jnp.float32)
+    vbuf = jnp.zeros((T, Dh), jnp.float32)
+    kbuf, vbuf = jax.lax.fori_loop(0, P // tile, gather, (kbuf, vbuf))
+    q = q_ref[0, 0].astype(jnp.float32)                  # [G, Dh]
+    s = jnp.einsum("gd,td->gt", q, kbuf) / scale
+    valid = (jax.lax.broadcasted_iota(jnp.int32, (G, T), 1)
+             <= pos_ref[0, 0])                           # logical mask
+    s = jnp.where(valid, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o_ref[0, 0] = jnp.einsum("gt,td->gd", p, vbuf)
+
+
+def flash_decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                           pages: jax.Array, pos: jax.Array, *,
+                           block_size: int,
+                           tile: Optional[int] = None,
+                           interpret: bool = False) -> jax.Array:
+    """One decode step's attention straight off the paged pool.
+
+    q [B, Hkv, G, Dh] (grouped-query layout, G = n_heads/kv_heads),
+    k/v the flat pool [M, Hkv, Dh], pages [B, P] int32 physical block
+    ids, pos [B] int32 per-slot positions → fp32 [B, Hkv, G, Dh]. The
+    caller owns the pool WRITE of the step's new k/v (a cheap scatter)
+    and must perform it before this reads — position ``pos[b]`` attends
+    to itself.
+
+    Grid (slot, kv-head); the per-program working set must pass
+    ``decode_kernel_fits`` (the dispatch in ``decode_step_paged``
+    guards this and falls back to XLA)."""
+    B, Hkv, G, Dh = q.shape
+    M = k.shape[0]
+    P = pages.shape[1]
+    bs = int(block_size)
+    if tile is None:
+        tile = select_decode_tile(P, bs, Dh, k.dtype)
+    if P % tile:
+        raise ValueError(f"flash_decode: tile {tile} must divide the "
+                         f"page-vector length {P}")
+    kernel = functools.partial(
+        _decode_kernel, block_size=bs, P=P, tile=int(tile), G=G, Dh=Dh,
+        scale=math.sqrt(Dh))
+    return pl.pallas_call(
+        kernel,
+        grid=(B, Hkv),
+        in_specs=[
+            pl.BlockSpec((1, P), lambda b, h: (b, 0)),        # pages
+            pl.BlockSpec((1, 1), lambda b, h: (b, 0)),        # pos
+            pl.BlockSpec((1, 1, G, Dh), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((M, 1, Dh), lambda b, h: (0, h, 0)),  # k pool
+            pl.BlockSpec((M, 1, Dh), lambda b, h: (0, h, 0)),  # v pool
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, Dh), lambda b, h: (b, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, Dh), jnp.float32),
+        interpret=interpret,
+    )(pages.astype(jnp.int32), jnp.reshape(pos, (B, 1)).astype(jnp.int32),
+      q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# fused sampling epilogue
+# ---------------------------------------------------------------------------
+
+
+def _sortable_key(v: jax.Array) -> jax.Array:
+    """fp32 -> uint32 order-preserving image (the radix-sort key map):
+    positive floats get the sign bit set, negative floats flip every
+    bit, so unsigned comparisons order exactly like float compares."""
+    u = jax.lax.bitcast_convert_type(v, jnp.uint32)
+    flip = ((u >> 31) * jnp.uint32(0x7FFFFFFF)) | jnp.uint32(0x80000000)
+    return u ^ flip
+
+
+def _kth_key(keys: jax.Array, k: jax.Array) -> jax.Array:
+    """The k-th largest of ``keys`` [1, V] uint32 (k >= 1, traced) by
+    32-step binary search on the integer threshold — count(keys >= t)
+    is monotone, so the invariant count(>= lo) >= k pins lo to the
+    exact k-th value after the interval collapses. O(32·V) compares, no
+    sort (lax.sort has no Mosaic lowering; this runs anywhere)."""
+    def body(_, lh):
+        lo, hi = lh
+        d = hi - lo
+        mid = lo + (d >> 1) + (d & jnp.uint32(1))   # ceil, overflow-safe
+        cnt = jnp.sum((keys >= mid).astype(jnp.int32))
+        take = cnt >= k
+        return (jnp.where(take, mid, lo),
+                jnp.where(take, hi, mid - jnp.uint32(1)))
+    lo, _ = jax.lax.fori_loop(
+        0, 32, body, (jnp.uint32(0), jnp.uint32(0xFFFFFFFF)))
+    return lo
+
+
+def _hash_uniform(seed: jax.Array, row: jax.Array,
+                  shape: Tuple[int, ...]) -> jax.Array:
+    """Counter-based uniforms in (0, 1): a splitmix-style integer hash
+    of (seed, row, lane) — deterministic for a given seed, independent
+    across rows and lanes, and pure jnp (runs under interpret and
+    Mosaic alike, unlike the TPU-only pltpu PRNG)."""
+    lane = jax.lax.broadcasted_iota(jnp.uint32, shape, len(shape) - 1)
+    h = (seed.astype(jnp.uint32)
+         + row.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+         + (lane + jnp.uint32(1)) * jnp.uint32(0x85EBCA6B))
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x7FEB352D)
+    h = h ^ (h >> 15)
+    h = h * jnp.uint32(0x846CA68B)
+    h = h ^ (h >> 16)
+    return ((h >> 8).astype(jnp.float32) + 0.5) * (1.0 / (1 << 24))
+
+
+def _first_argmax(x: jax.Array, iota: jax.Array) -> jax.Array:
+    """First-index argmax over the last axis ([1, V] -> scalar) — the
+    ``jnp.argmax`` tie convention, written as max+where+min because
+    ``lax.argmax`` has no Mosaic lowering."""
+    m = jnp.max(x, axis=-1, keepdims=True)
+    V = x.shape[-1]
+    return jnp.min(jnp.where(x == m, iota, V))
+
+
+def _sample_kernel(logits_ref, seed_ref, temp_ref, topk_ref, o_ref):
+    """One batch row: greedy argmax, radix top-k threshold, temperature
+    scale, Gumbel-max categorical — ``sample_tokens`` semantics with no
+    full-vocab sort and no second dispatch."""
+    row = pl.program_id(0)
+    v = logits_ref[0].astype(jnp.float32)[None, :]        # [1, V]
+    V = v.shape[-1]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, V), 1)
+    greedy = _first_argmax(v, iota)
+    k = jnp.clip(topk_ref[0, 0], 0, V)
+    keys = _sortable_key(v)
+    kstar = _kth_key(keys, jnp.maximum(k, 1))
+    keep = (k <= 0) | (keys >= kstar)     # ties at the threshold survive
+    z = jnp.where(keep, v, -jnp.inf)
+    temp = temp_ref[0, 0]
+    z = z / jnp.where(temp > 0, temp, 1.0)
+    g = -jnp.log(-jnp.log(_hash_uniform(seed_ref[0, 0], row, (1, V))))
+    sampled = _first_argmax(z + g, iota)
+    o_ref[0, 0] = jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
+
+
+def fused_sample(logits: jax.Array, seed: jax.Array,
+                 temperature: jax.Array, top_k: jax.Array, *,
+                 interpret: bool = False) -> jax.Array:
+    """Sampling epilogue kernel: logits [B, V] fp32, scalar int32
+    ``seed``, per-slot runtime ``temperature`` [B] / ``top_k`` [B] →
+    sampled ids [B] int32. Greedy rows (temperature <= 0) and the kept
+    top-k set match ``serving/sampling.sample_tokens`` exactly; the
+    categorical draw matches in distribution (hash-Gumbel stream, not
+    jax.random's)."""
+    B, V = logits.shape
+    out = pl.pallas_call(
+        _sample_kernel,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, V), lambda b: (b, 0)),
+            pl.BlockSpec((1, 1), lambda b: (0, 0)),
+            pl.BlockSpec((1, 1), lambda b: (b, 0)),
+            pl.BlockSpec((1, 1), lambda b: (b, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda b: (b, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, 1), jnp.int32),
+        interpret=interpret,
+    )(logits, jnp.reshape(jnp.asarray(seed, jnp.int32), (1, 1)),
+      jnp.reshape(temperature, (B, 1)).astype(jnp.float32),
+      jnp.reshape(top_k, (B, 1)).astype(jnp.int32))
+    return out[:, 0]

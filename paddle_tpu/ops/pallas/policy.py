@@ -1,0 +1,29 @@
+"""The ``PADDLE_TPU_PALLAS`` dispatch policy, shared by every kernel in
+this package (kernels import from here rather than from the package
+``__init__`` so the re-export there cannot go circular). See the package
+docstring for the knob's semantics."""
+
+import os
+
+PALLAS_MODES = ("auto", "on", "off", "interpret")
+
+
+def pallas_mode(explicit=None) -> str:
+    """Resolve the package-wide Pallas dispatch policy to one of
+    ``"on" | "off" | "interpret"``.
+
+    ``explicit`` is the call-site override (``None`` defers to the
+    ``PADDLE_TPU_PALLAS`` env var, which defaults to ``auto``). ``auto``
+    resolves to ``on`` exactly when the default jax backend is TPU, so
+    resolving the policy never forces a backend choice elsewhere."""
+    mode = explicit if explicit is not None \
+        else os.environ.get("PADDLE_TPU_PALLAS", "auto")
+    mode = str(mode).lower()
+    if mode not in PALLAS_MODES:
+        raise ValueError(
+            f"PADDLE_TPU_PALLAS={mode!r}: expected one of "
+            f"{PALLAS_MODES} (explicit arg > env > auto)")
+    if mode == "auto":
+        import jax
+        mode = "on" if jax.default_backend() == "tpu" else "off"
+    return mode

@@ -49,6 +49,7 @@ import numpy as np
 
 from paddle_tpu.observe import chrome_trace as _chrome
 from paddle_tpu.observe import compile_tracker as _ct
+from paddle_tpu.observe import costs as _costs
 from paddle_tpu.observe import metrics as _metrics
 from paddle_tpu.observe import requests as _requests
 from paddle_tpu.observe.window import SloConfig, WindowedQuantiles
@@ -162,7 +163,9 @@ class DecodeEngine:
                  seed: Optional[int] = None,
                  registry: Optional[_metrics.Registry] = None,
                  tracker: Optional[_ct.CompileTracker] = None,
-                 slo: Optional[SloConfig] = None):
+                 slo: Optional[SloConfig] = None,
+                 decode_flops: Optional[float] = None,
+                 pallas_mode: Optional[str] = None):
         import jax.numpy as jnp
         self._jnp = jnp
         self._prefill_fn = prefill
@@ -171,6 +174,14 @@ class DecodeEngine:
         self.cache = cache
         self.batch = int(batch)
         self.cache_len = int(cache_len)
+        # decode-MFU accounting (the PR-2 scoreboard): model FLOPs of
+        # one compiled decode step (from lowered cost analysis or the
+        # artifact's cost stamp) against the declared chip peak
+        self.decode_flops = decode_flops
+        self._peak_flops = _costs.device_peak_flops()
+        # which attention/sampling path the decode program compiled
+        # (resolved PADDLE_TPU_PALLAS policy; None = unknown/legacy)
+        self.pallas_mode = pallas_mode
         self.buckets = tuple(sorted({int(b) for b in buckets
                                      if int(b) <= cache_len}))
         if not self.buckets:
@@ -252,25 +263,38 @@ class DecodeEngine:
         self._m_rejected = reg.counter(
             "engine_requests_rejected_total",
             "submissions rejected at validation, by reason")
+        self._m_decode_mfu = reg.gauge(
+            "engine_decode_mfu", "model-FLOPs utilisation of the last "
+            "batched decode step (0 until decode FLOPs and a chip peak "
+            "are known; CPU peaks are nominal — see core/place.py)")
 
     # -- construction ------------------------------------------------------
     @classmethod
     def from_params(cls, params, cfg, *, batch: int, cache_len: int,
                     buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS,
-                    seed: Optional[int] = None, **kw):
+                    seed: Optional[int] = None, pallas: Optional[str] = None,
+                    **kw):
         """In-process engine: jit the step fns against live params (the
-        no-artifact path tests and benchmarks drive)."""
+        no-artifact path tests and benchmarks drive). ``pallas``
+        overrides the ``PADDLE_TPU_PALLAS`` policy for the step
+        programs (fused sampling epilogue on the slot engine)."""
         import jax
         from paddle_tpu.models import transformer
+        from paddle_tpu.ops.pallas import policy as _pallas_policy
         from paddle_tpu.serving import sampling
         if cache_len > cfg.max_len:
             raise ValueError(f"cache_len {cache_len} exceeds cfg.max_len "
                              f"{cfg.max_len}")
-        prefill_fn, decode_fn = sampling.engine_step_fns(cfg)
+        prefill_fn, decode_fn = sampling.engine_step_fns(cfg, pallas=pallas)
         cache = transformer.init_cache(cfg, batch, cache_len)
-        return cls(jax.jit(prefill_fn), jax.jit(decode_fn), params, cache,
+        jdf = jax.jit(decode_fn)
+        if "decode_flops" not in kw:    # the trace is not free — skip
+            kw["decode_flops"] = _decode_step_flops(  # it when supplied
+                jdf, params, cache, batch)
+        return cls(jax.jit(prefill_fn), jdf, params, cache,
                    batch=batch, cache_len=cache_len, buckets=buckets,
-                   seed=seed, **kw)
+                   seed=seed, pallas_mode=_pallas_policy.pallas_mode(pallas),
+                   **kw)
 
     # -- request-scoped observability --------------------------------------
     def configure_slo(self, slo: Optional[SloConfig]):
@@ -550,6 +574,10 @@ class DecodeEngine:
             now = time.perf_counter()   # [B] int32 ids
             self._m_step_s.observe(now - t0)
             self._m_steps.inc()
+            mfu = _costs.mfu(self.decode_flops, now - t0,
+                             self._peak_flops)
+            if mfu is not None:
+                self._m_decode_mfu.set(mfu)
             for slot in np.flatnonzero(self._active):
                 req = self._slot_req[slot]
                 tok = int(nxt[slot])
@@ -575,6 +603,18 @@ class DecodeEngine:
                            f"{self.active_count} active)")
 
     # -- observability -----------------------------------------------------
+    def decode_mfu(self) -> Optional[float]:
+        """Mean decode-step MFU over this engine's lifetime: decode
+        FLOPs / (mean step seconds × chip peak). None until a step ran
+        or when FLOPs/peak are unknown. Noise-robust against the
+        last-step gauge (``engine_decode_mfu``) — the figure
+        ``serving_bench`` reports."""
+        cell = self._m_step_s._peek({})
+        if cell is None or not cell.count:
+            return None
+        return _costs.mfu(self.decode_flops, cell.sum / cell.count,
+                          self._peak_flops)
+
     def health(self) -> dict:
         doc = {"requests": int(self._m_requests.value()),
                "completed": sum(
@@ -586,7 +626,11 @@ class DecodeEngine:
                "slots_active": self.active_count,
                "slots_total": self.batch,
                "cache_len": self.cache_len,
+               "pallas": self.pallas_mode,
                "prefill_buckets": list(self.buckets)}
+        mfu = self.decode_mfu()
+        if mfu is not None:
+            doc["decode_mfu"] = round(mfu, 9)
         self._update_window_gauges()
         ttft = self._win_ttft.quantiles((0.5, 0.95, 0.99))
         doc["window"] = {
@@ -640,6 +684,20 @@ class DecodeEngine:
         programs — the "one per bucket + one for decode" invariant."""
         return {"prefill": self._tracker.count("serving_engine.prefill"),
                 "decode": self._tracker.count("serving_engine.decode")}
+
+
+def _decode_step_flops(decode_fn, params, cache, batch, *extra):
+    """Model FLOPs of one compiled decode step from the lowered HLO
+    cost model (None when unavailable) — the ``engine_decode_mfu``
+    numerator the in-process engines derive themselves; AOT artifacts
+    carry it stamped in ``meta.cost_analysis`` instead."""
+    vec_i = np.zeros(batch, np.int32)
+    vec_f = np.zeros(batch, np.float32)
+    vec_b = np.zeros(batch, bool)
+    cost = _costs.lowered_cost(
+        decode_fn, params, cache, vec_i, vec_i, vec_b, *extra,
+        vec_f, vec_i, np.int32(0))
+    return (cost or {}).get("flops")
 
 
 def default_chunk_buckets(chunk_tokens: int) -> tuple:
@@ -696,7 +754,9 @@ class PagedDecodeEngine(DecodeEngine):
                  seed: Optional[int] = None,
                  registry: Optional[_metrics.Registry] = None,
                  tracker: Optional[_ct.CompileTracker] = None,
-                 slo: Optional[SloConfig] = None):
+                 slo: Optional[SloConfig] = None,
+                 decode_flops: Optional[float] = None,
+                 pallas_mode: Optional[str] = None):
         from paddle_tpu.serving import blocks as _blocks
         bs = int(block_size)
         if bs < 1 or cache_len % bs:
@@ -730,7 +790,8 @@ class PagedDecodeEngine(DecodeEngine):
         super().__init__(prefill, decode, params, cache, batch=batch,
                          cache_len=cache_len, buckets=chunk_buckets,
                          seed=seed, registry=registry, tracker=tracker,
-                         slo=slo)
+                         slo=slo, decode_flops=decode_flops,
+                         pallas_mode=pallas_mode)
         self.block_size = bs
         self.pages_per_slot = cache_len // bs
         self.num_blocks = int(num_blocks if num_blocks is not None
@@ -787,12 +848,18 @@ class PagedDecodeEngine(DecodeEngine):
                     num_blocks: Optional[int] = None,
                     chunk_tokens: int = 64,
                     chunk_buckets: Optional[Sequence[int]] = None,
-                    seed: Optional[int] = None, **kw):
+                    seed: Optional[int] = None,
+                    pallas: Optional[str] = None, **kw):
         """In-process paged engine: jit the chunk-prefill/paged-decode
         programs against live params (the no-artifact path tests and
-        benchmarks drive)."""
+        benchmarks drive). ``pallas`` overrides the
+        ``PADDLE_TPU_PALLAS`` policy for the step programs (flash-decode
+        attention + fused sampling epilogue); ``params`` may be the
+        ``quantize_lm_params`` int8 tree — the decode step then reads
+        weights at 1 byte/elt (in-scan dequant)."""
         import jax
         from paddle_tpu.models import transformer
+        from paddle_tpu.ops.pallas import policy as _pallas_policy
         from paddle_tpu.serving import sampling
         if cache_len > cfg.max_len:
             raise ValueError(f"cache_len {cache_len} exceeds cfg.max_len "
@@ -802,13 +869,20 @@ class PagedDecodeEngine(DecodeEngine):
                              f"multiple of block_size {block_size}")
         nb = int(num_blocks if num_blocks is not None
                  else batch * (cache_len // block_size))
-        prefill_fn, decode_fn = sampling.paged_step_fns(cfg, block_size)
+        prefill_fn, decode_fn = sampling.paged_step_fns(
+            cfg, block_size, pallas=pallas)
         pool = transformer.init_block_pool(cfg, nb, block_size)
-        return cls(jax.jit(prefill_fn), jax.jit(decode_fn), params, pool,
+        jdf = jax.jit(decode_fn)
+        if "decode_flops" not in kw:    # the trace is not free — skip
+            pages = np.zeros((batch, cache_len // block_size), np.int32)
+            kw["decode_flops"] = _decode_step_flops(
+                jdf, params, pool, batch, pages)
+        return cls(jax.jit(prefill_fn), jdf, params, pool,
                    batch=batch, cache_len=cache_len,
                    block_size=block_size, num_blocks=nb,
                    chunk_tokens=chunk_tokens, chunk_buckets=chunk_buckets,
-                   seed=seed, **kw)
+                   seed=seed,
+                   pallas_mode=_pallas_policy.pallas_mode(pallas), **kw)
 
     # -- request API -------------------------------------------------------
     def submit(self, prompt, max_new: int, *, temperature: float = 0.0,

@@ -42,28 +42,92 @@ def sample_tokens(logits: jax.Array, key: jax.Array,
     return jnp.where(temperature > 0, sampled, greedy)
 
 
-def engine_step_fns(cfg, dequant=None):
+def _prefill_live(dequant):
+    """Prefill-side weight resolution: an explicit ``dequant`` wins;
+    otherwise {"q8","scale"} trees dequantize wholesale (prefill is
+    compute-bound — one fp32 materialization amortizes over the whole
+    chunk, unlike the weight-read-bound decode step, which handles q8
+    natively inside its layer scan)."""
+    from paddle_tpu.models import transformer
+    from paddle_tpu.ops import q8 as ops_q8
+
+    def _live(params):
+        if dequant is not None:
+            return dequant(params)
+        if transformer._blocks_quantized(params):
+            return ops_q8.dequantize_tree(params)
+        return params
+
+    return _live
+
+
+def _decode_live(dequant):
+    """Decode-side weight resolution: {"q8","scale"} trees pass through
+    UNTOUCHED (the decode steps dequantize in-scan — pre-dequantizing
+    here would rebuild the fp32 stack per token, the 4-byte-read
+    regression this path exists to kill); a custom ``dequant`` still
+    applies to non-quantized trees."""
+    from paddle_tpu.models import transformer
+
+    def _live(params):
+        if transformer._blocks_quantized(params):
+            return params
+        return dequant(params) if dequant is not None else params
+
+    return _live
+
+
+def _epilogue(mode):
+    """The sampling tail of a decode program under the resolved
+    ``PADDLE_TPU_PALLAS`` mode: the Pallas ``fused_sample`` kernel
+    (greedy/top-k set exact, categorical matching in distribution) when
+    the kernels are on, ``sample_tokens`` otherwise."""
+    if mode == "off":
+        def tail(logits, seed, temperature, top_k):
+            key = jax.random.PRNGKey(seed)
+            return sample_tokens(logits, key, temperature, top_k)
+    else:
+        from paddle_tpu.ops.pallas import decode as _pallas_decode
+
+        def tail(logits, seed, temperature, top_k):
+            return _pallas_decode.fused_sample(
+                logits, seed, temperature, top_k,
+                interpret=(mode == "interpret"))
+    return tail
+
+
+def engine_step_fns(cfg, dequant=None, pallas=None):
     """(prefill_fn, decode_fn) closures over a TransformerConfig — the
     two programs the engine compiles (once per prefill bucket, once for
     decode) and ``save_lm_artifact`` exports as the format-v3 modules.
 
     ``dequant`` optionally maps the stored param tree to live weights
-    (the weights_int8 artifact path); identity when None.
+    for PREFILL (the weights_int8 artifact path); the decode step
+    consumes {"q8","scale"} trees natively (in-scan dequant — 1-byte
+    weight reads per token) and needs no dequant either way.
+    ``pallas`` resolves the package-wide ``PADDLE_TPU_PALLAS`` policy
+    (explicit arg > env > auto): when the kernels are on, the decode
+    sampling tail runs the Pallas ``fused_sample`` epilogue. The slot
+    arena's attention itself stays XLA — the flash-decode kernel
+    targets the paged pool layout (``paged_step_fns``).
 
     prefill_fn(params, cache, tokens [1, Tb], length (), slot (),
                temperature (), top_k (), seed ()) → (token (), cache)
     decode_fn(params, cache, tokens [B], pos [B], active [B] bool,
               temperature [B], top_k [B], seed ()) → (tokens [B], cache)
 
-    Sampling happens inside both programs (``sample_tokens``), so each
-    call returns int32 ids only — no logits cross the host boundary.
-    ``seed`` is a fresh per-call int32; the key derives inside the
-    program, keeping the exported signature plain-integer.
+    Sampling happens inside both programs, so each call returns int32
+    ids only — no logits cross the host boundary. ``seed`` is a fresh
+    per-call int32; any randomness derives inside the program, keeping
+    the exported signature plain-integer.
     """
     from paddle_tpu.models import transformer
+    from paddle_tpu.ops.pallas import policy as _pallas_policy
 
-    def _live(params):
-        return dequant(params) if dequant is not None else params
+    mode = _pallas_policy.pallas_mode(pallas)
+    _live = _prefill_live(dequant)
+    _live_d = _decode_live(dequant)
+    tail = _epilogue(mode)
 
     def prefill_fn(params, cache, tokens, length, slot, temperature,
                    top_k, seed):
@@ -77,14 +141,13 @@ def engine_step_fns(cfg, dequant=None):
     def decode_fn(params, cache, tokens, pos, active, temperature,
                   top_k, seed):
         logits, cache = transformer.decode_step_slots(
-            _live(params), cache, tokens, pos, active, cfg)
-        key = jax.random.PRNGKey(seed)
-        return sample_tokens(logits, key, temperature, top_k), cache
+            _live_d(params), cache, tokens, pos, active, cfg)
+        return tail(logits, seed, temperature, top_k), cache
 
     return prefill_fn, decode_fn
 
 
-def paged_step_fns(cfg, block_size: int, dequant=None):
+def paged_step_fns(cfg, block_size: int, dequant=None, pallas=None):
     """(prefill_chunk_fn, decode_fn) for the PAGED block-pool engine —
     compiled once per chunk bucket / once for decode, and exported by
     ``save_lm_artifact`` as the format-v4 modules.
@@ -101,11 +164,22 @@ def paged_step_fns(cfg, block_size: int, dequant=None):
     the prefill token only matters on a prompt's FINAL chunk (the
     engine discards the others), but sampling unconditionally keeps the
     exported signature uniform.
+
+    ``pallas`` resolves the ``PADDLE_TPU_PALLAS`` policy (explicit arg
+    > env > auto): when on, the decode step's attention runs the
+    flash-decode kernel over the pool and its sampling tail the fused
+    epilogue (``ops/pallas/decode.py``); the pure-XLA path stays the
+    always-available fallback. ``dequant`` applies to PREFILL only —
+    decode consumes {"q8","scale"} trees natively (in-scan dequant,
+    1-byte weight reads per token).
     """
     from paddle_tpu.models import transformer
+    from paddle_tpu.ops.pallas import policy as _pallas_policy
 
-    def _live(params):
-        return dequant(params) if dequant is not None else params
+    mode = _pallas_policy.pallas_mode(pallas)
+    _live = _prefill_live(dequant)
+    _live_d = _decode_live(dequant)
+    tail = _epilogue(mode)
 
     def prefill_fn(params, pool, tokens, length, pages,
                    temperature, top_k, seed):
@@ -120,9 +194,8 @@ def paged_step_fns(cfg, block_size: int, dequant=None):
     def decode_fn(params, pool, tokens, pos, active, pages, temperature,
                   top_k, seed):
         logits, pool = transformer.decode_step_paged(
-            _live(params), pool, tokens, pos, active, pages, cfg,
-            block_size=block_size)
-        key = jax.random.PRNGKey(seed)
-        return sample_tokens(logits, key, temperature, top_k), pool
+            _live_d(params), pool, tokens, pos, active, pages, cfg,
+            block_size=block_size, pallas=mode)
+        return tail(logits, seed, temperature, top_k), pool
 
     return prefill_fn, decode_fn

@@ -267,11 +267,13 @@ def test_engine_artifact_v4_paged_roundtrip(tmp_path, rng):
                                 prompt_len=Tp, cache_len=32,
                                 engine_buckets=(8, 16),
                                 engine_paged=True, engine_block_size=8)
+    from paddle_tpu.ops.pallas import policy as pallas_policy
     srv = lm_serving.load_lm_artifact(path)
     assert srv.meta["format_version"] == 4
     assert srv.meta["engine_paged"] == {
         "block_size": 8, "num_blocks": 8, "pages_per_slot": 4,
-        "chunk_tokens": 16}
+        "chunk_tokens": 16, "pallas": pallas_policy.pallas_mode(None)}
+    assert srv.meta["engine_pallas"] == pallas_policy.pallas_mode(None)
     assert srv.cost_analysis["engine_decode"]["flops"] > 0
     # legacy lockstep path unchanged on a v4 artifact
     got = srv.generate(prompt, max_new=new)
@@ -303,6 +305,40 @@ def test_engine_artifact_v4_paged_roundtrip(tmp_path, rng):
     # engine() refuses to schedule a different one
     with pytest.raises(ValueError, match="chunk grid"):
         srv.engine(chunk_tokens=8)
+
+
+def test_engine_artifact_v4_int8_roundtrip(tmp_path, rng):
+    """v4 + weights_int8: the exported paged decode module consumes the
+    {"q8","scale"} tree NATIVELY (in-scan dequant — 1-byte weight reads
+    per token), and the engine's greedy output equals generate() over
+    the dequantized tree exactly: quantization changes WHERE dequant
+    happens, never the values."""
+    from paddle_tpu.observe.compile_tracker import CompileTracker
+    from paddle_tpu.ops import q8 as ops_q8
+    params = transformer.init_params(jax.random.PRNGKey(0), CFG)
+    path = str(tmp_path / "lm_v4_q8.tar")
+    lm_serving.save_lm_artifact(path, params, CFG, batch=2,
+                                prompt_len=6, cache_len=32,
+                                engine_buckets=(8, 16),
+                                engine_paged=True, engine_block_size=8,
+                                weights_int8=True)
+    srv = lm_serving.load_lm_artifact(path)
+    assert srv.meta["format_version"] == 4
+    assert srv.meta["weights_int8"] is True
+    assert ops_q8.is_quantized_weight(srv.params["blocks"]["qkv"])
+    live = jax.tree_util.tree_map(
+        lambda n: jnp.asarray(ops_q8.dequantize_weight(n))
+        if ops_q8.is_quantized_weight(n) else jnp.asarray(n),
+        srv.params, is_leaf=ops_q8.is_quantized_weight)
+    eng = srv.engine(seed=0, tracker=CompileTracker())
+    prompts = [rng.randint(0, 40, n).astype(np.int32) for n in (5, 9)]
+    reqs = [eng.submit(p, max_new=6) for p in prompts]
+    eng.run_until_idle()
+    for p, r in zip(prompts, reqs):
+        want = np.asarray(transformer.generate(
+            live, jnp.asarray(p[None]), CFG, max_new=6))[0]
+        np.testing.assert_array_equal(r.output, want)
+    assert eng.compile_counts()["decode"] == 1
 
 
 def test_engine_requires_v3(tmp_path, rng):

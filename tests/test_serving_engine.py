@@ -286,6 +286,14 @@ class TestServingBenchSmoke:
                 phase["engine_paged"]["blocks_total"]
         assert results["serving_paged_speedup"] > 0
         assert results["serving_paged_ttft_p99_ratio"] > 0
+        # flash-decode-era fields: decode MFU reported per engine, the
+        # int8 variant rode the throughput phase token-for-token, and
+        # the interpret-mode kernel matched the XLA engine's ids
+        assert tp["engine_paged"]["decode_mfu"] is not None
+        assert tp["engine_paged_int8"]["tokens"] == \
+            tp["engine_paged"]["tokens"]
+        assert results["serving_int8_speedup"] > 0
+        assert results["pallas"]["interpret_check_ok"] is True
         # per-request attribution replay: every request attributed
         # (the joined-lifecycle invariant is asserted INSIDE the bench
         # when --trace-out is given — reaching here means it held)
