@@ -49,6 +49,17 @@ FAMILIES = {
             ("serving_int8_speedup", "higher", 0.15),
         ],
     },
+    "elastic": {
+        # elastic_bench.py recovery figures: wall-clock dominated by
+        # worker restart + jax re-init + recompile, so both get the
+        # widest band; the completed/single-restart boolean must hold
+        "glob": "*elastic_bench*.json",
+        "figures": [
+            ("recovery_seconds", "lower", 0.5),
+            ("detect_seconds", "lower", 0.5),
+            ("completed", "true", 0.0),
+        ],
+    },
     "zero": {
         # the staged artifacts are date-stamped (<date>_zero_bench_
         # data<N>_stages.json) and carry the legacy PR-5 keys too, so

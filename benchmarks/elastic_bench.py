@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Elastic recovery benchmark: how long from killing a gang worker to
+the first post-restore training step.
+
+Runs ``runtime/supervisor.py`` over ``demos/elastic_worker.py`` (the
+deterministic CPU-simulation gang), SIGKILLs one rank mid-step via the
+``PADDLE_TPU_CHAOS`` knob, and reads the supervision history:
+
+- ``detect_seconds``   — last heartbeat of the killed rank -> the
+  supervisor's failure judgment (bounded by poll_interval + heartbeat
+  cadence);
+- ``teardown_restart_seconds`` — judgment -> new gang spawned (flight
+  post-mortem + terminate + backoff);
+- ``recovery_seconds`` — judgment -> first post-restore step beat (the
+  figure of merit: includes worker restart, jax re-init, checkpoint
+  restore + reshard, pipeline seek, recompile).
+
+Artifact: ``benchmarks/runs/<date>_elastic_bench.json`` +
+JSONL trail via bench_metrics (``--metrics-out=``/BENCH_METRICS_OUT).
+``check_regression.py``'s ``elastic`` family holds the recovery-time
+ceiling against the previous run.
+
+Usage: python benchmarks/elastic_bench.py [--nprocs=2] [--nb=12]
+           [--kill-step=5] [--out=PATH] [--metrics-out=PATH]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, HERE)
+sys.path.insert(0, REPO)
+
+from bench_metrics import metrics_write, resolve_metrics_out  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nprocs", type=int, default=2)
+    ap.add_argument("--nb", type=int, default=12)
+    ap.add_argument("--kill-step", type=int, default=5)
+    ap.add_argument("--ckpt-period", type=int, default=2)
+    ap.add_argument("--poll-interval", type=float, default=0.2)
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default benchmarks/runs/"
+                    "<date>_elastic_bench.json)")
+    ap.add_argument("--metrics-out", default=None, dest="metrics_out")
+    args = ap.parse_args(argv)
+    mpath = resolve_metrics_out(
+        [f"--metrics-out={args.metrics_out}"] if args.metrics_out else None)
+
+    from paddle_tpu.runtime.supervisor import Supervisor
+
+    workdir = tempfile.mkdtemp(prefix="elastic_bench_")
+    out = os.path.join(workdir, "out")
+    worker = os.path.join(REPO, "demos", "elastic_worker.py")
+    kill_rank = args.nprocs - 1
+    t0 = time.time()
+    sup = Supervisor(
+        [worker], nprocs=args.nprocs,
+        state_dir=os.path.join(workdir, "state"),
+        devices_per_proc=max(args.nprocs, 2), cluster=False,
+        heartbeat_window=30.0, startup_grace=300.0,
+        poll_interval=args.poll_interval,
+        backoff_base=0.1, backoff_cap=0.5, max_restarts=2,
+        env_extra={
+            "ELASTIC_OUT": out, "ELASTIC_NB": str(args.nb),
+            "ELASTIC_STEP_SLEEP": "0.05",
+            "PADDLE_TPU_CHECKPOINT_PERIOD": str(args.ckpt_period),
+            "PADDLE_TPU_CHAOS":
+                f"kill@step:step={args.kill_step}:rank={kill_rank}"
+                ":epoch=1"})
+    res = sup.run(total_timeout=900)
+    total_wall = time.time() - t0
+
+    detect_s = None
+    try:
+        flight = os.path.join(workdir, "state", "flight",
+                              "restart_epoch0001.json")
+        with open(flight) as f:
+            doc = json.load(f)
+        restart_recs = [r for r in doc.get("last_steps", [])
+                        if r.get("kind") == "supervisor_restart"]
+        hb = restart_recs[-1]["heartbeats"][str(kill_rank)]
+        detect_s = res["attempts"][0]["t_detect"] - hb["ts"]
+    except (OSError, KeyError, IndexError, ValueError):
+        pass
+    recovery_s = None
+    relaunch_s = None
+    if len(res["attempts"]) > 1:
+        recovery_s = res["attempts"][1].get("recovery_seconds")
+        relaunch_s = round(res["attempts"][1]["t_launch"]
+                           - res["attempts"][0]["t_detect"], 3)
+
+    result = {
+        "bench": "elastic_recovery",
+        "nprocs": args.nprocs, "nb": args.nb,
+        "kill_step": args.kill_step, "kill_rank": kill_rank,
+        "poll_interval_s": args.poll_interval,
+        "completed": bool(res["ok"]) and res["restarts"] == 1,
+        "restarts": res["restarts"],
+        "detect_seconds": (round(detect_s, 3)
+                           if detect_s is not None else None),
+        "teardown_restart_seconds": relaunch_s,
+        "recovery_seconds": recovery_s,
+        "total_wall_s": round(total_wall, 3),
+    }
+    print(json.dumps(result, indent=1))
+    metrics_write(mpath, **result)
+    out_path = args.out or os.path.join(
+        HERE, "runs", time.strftime("%Y-%m-%d_%H%M")
+        + "_elastic_bench.json")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(f"artifact: {out_path}")
+    return 0 if result["completed"] and recovery_s else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

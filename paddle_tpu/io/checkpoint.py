@@ -20,6 +20,24 @@ chunks. Two scaling paths:
   (``params.p{K}.npz``); load merges every process file present. Shard
   overlap is fine (replicated arrays): last writer wins on identical data.
 
+Crash-consistent commit protocol (the elastic-training contract): every
+file is written to a hidden tempdir, fsync'd, and published atomically —
+single-host by one ``os.rename`` of the whole dir (re-saving an
+existing step renames the old dir aside first — the exposure is one
+rename syscall, after which the previous period's checkpoint is the
+fallback), multi-host by per-file ``os.replace`` with the manifest
+moved LAST (the manifest's presence is the commit point), then the
+parent directory is fsync'd. A save killed at ANY instant therefore
+leaves an intact restorable checkpoint behind; ``latest_checkpoint`` additionally
+validates completeness (every process's manifest present) so a torn
+multi-host dir is skipped in favour of the previous intact step.
+``fence=`` (a callable) gates the commit: when it returns False at
+publish time — e.g. a zombie worker from a superseded elastic epoch —
+the save aborts with ``CheckpointFencedError`` and nothing is
+published. Chaos hooks (``runtime/chaos.py`` site ``checkpoint``,
+phases pre_write/pre_manifest/pre_commit/mid_commit) let tests
+interrupt each window.
+
 ZeRO resharding (``meta.zero`` manifest path): single-host saves hold
 FULL host arrays — ``np.asarray`` on a ZeRO-sharded leaf (stage>=1 opt
 state, stage 3 params) gathers its shards — so a restore under a
@@ -42,6 +60,33 @@ from typing import Dict, Optional
 
 import jax.numpy as jnp
 import numpy as np
+
+
+class CheckpointFencedError(RuntimeError):
+    """A save's commit fence rejected the publish — the writer belongs
+    to a superseded coordination epoch and must not commit."""
+
+
+def _chaos(phase: str, step: int):
+    """Checkpoint-site chaos hook (no-op unless PADDLE_TPU_CHAOS set)."""
+    if os.environ.get("PADDLE_TPU_CHAOS"):
+        from paddle_tpu.runtime import chaos
+        chaos.maybe_trigger("checkpoint", phase=phase, step=step)
+
+
+def _fsync_path(path):
+    """fsync one file or directory; directory fsync makes the rename
+    itself durable. Best-effort: some filesystems refuse dir fsync."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def _flatten(tree, prefix=""):
@@ -122,16 +167,48 @@ def _write_tree(tmp, fname, tree, manifest, sharded, host_trees=None):
             "index": index_meta}
 
 
+#: incomplete dirs younger than this are presumed to be a peer host's
+#: still-publishing save, not a corpse, and are never pruned
+_TORN_PRUNE_GRACE_S = 900.0
+
+
 def _prune_old(save_dir, keep):
     import shutil
-    kept = sorted(d for d in os.listdir(save_dir) if d.startswith("ckpt-"))
-    for d in kept[:-keep]:
-        shutil.rmtree(os.path.join(save_dir, d), ignore_errors=True)
+    import time as _time
+    names = sorted(d for d in os.listdir(save_dir) if d.startswith("ckpt-"))
+    if not names:
+        return
+    # the keep budget counts COMPLETE checkpoints only: torn dirs (a
+    # host died mid-publish) must not evict restorable state — else a
+    # run of torn saves would leave nothing to restore. Torn dirs are
+    # collected only once their mtime is stale past the grace (a slower
+    # peer may still be publishing into a recent one — its os.replace
+    # must not race a rmtree), and the newest entry is always spared.
+    now = _time.time()
+    complete, stale_torn = [], []
+    for d in names:
+        p = os.path.join(save_dir, d)
+        if is_complete(p):
+            complete.append(d)
+        else:
+            try:
+                age = now - os.path.getmtime(p)
+            except OSError:
+                continue
+            if age > _TORN_PRUNE_GRACE_S:
+                stale_torn.append(d)
+    keep_set = set(complete[-keep:])
+    keep_set.add(names[-1])
+    for d in names:
+        if d in keep_set:
+            continue
+        if d in complete or d in stale_torn:
+            shutil.rmtree(os.path.join(save_dir, d), ignore_errors=True)
 
 
 def _write_single(save_dir, step, trees, keep, host_trees=None,
                   sharded=False, process_index=0, process_count=1,
-                  blobs=None, meta=None):
+                  blobs=None, meta=None, fence=None):
     """Shared atomic-write core for save_checkpoint and AsyncCheckpointer.
     ``trees``: {fname: pytree} (ignored per-entry when host_trees carries
     the pre-flattened host copy). ``blobs``: {name: bytes} opaque
@@ -139,46 +216,125 @@ def _write_single(save_dir, step, trees, keep, host_trees=None,
     as ``<name><suffix>.pkl`` with their checksum in the manifest.
     ``meta``: JSON-able layout metadata (e.g. the ZeRO sharding layout
     the state was trained under) stored in the manifest — restores onto
-    a different mesh read it to know a reshard is happening."""
+    a different mesh read it to know a reshard is happening.
+    ``fence``: callable checked immediately before the publish (and
+    again at the multi-host manifest move, the per-process commit
+    point); False aborts with ``CheckpointFencedError``. A deposition
+    landing INSIDE the final rename syscall can still commit — the
+    window is one rename wide, the same bounded guarantee as the
+    master's snapshot fencing (runtime/supervisor.py epoch fencing)."""
     name = f"ckpt-{step:08d}"
     final = os.path.join(save_dir, name)
     os.makedirs(save_dir, exist_ok=True)
     suffix = f".p{process_index}" if process_count > 1 else ""
     tmp = tempfile.mkdtemp(dir=save_dir, prefix=".tmp-" + name + suffix)
-    manifest = {"step": int(step), "files": {},
-                "process_index": process_index,
-                "process_count": process_count}
-    if meta is not None:
-        manifest["meta"] = meta
-    for base, tree in trees.items():
-        if tree is None and not (host_trees and base in host_trees):
-            continue
-        _write_tree(tmp, base + suffix, tree, manifest, sharded,
-                    host_trees={base + suffix: host_trees[base]}
-                    if host_trees else None)
-    for bname, data in (blobs or {}).items():
-        bpath = os.path.join(tmp, bname + suffix + ".pkl")
-        with open(bpath, "wb") as f:
-            f.write(data)
-        manifest.setdefault("blobs", {})[bname + suffix] = _file_md5(bpath)
-    with open(os.path.join(tmp, f"manifest{suffix}.json"), "w") as f:
-        json.dump(manifest, f)
-    if process_count > 1:
-        # multi-host: move our files into the shared dir; process 0 owns
-        # directory lifecycle, others only add their piece. The manifest
-        # moves LAST — its presence is this process's commit point, so a
-        # reader that sees all manifests sees all data files too.
-        os.makedirs(final, exist_ok=True)
-        manifest_fn = f"manifest{suffix}.json"
-        for fn in sorted(os.listdir(tmp),
-                         key=lambda n: n == manifest_fn):
-            os.replace(os.path.join(tmp, fn), os.path.join(final, fn))
-        os.rmdir(tmp)
-    else:
+    try:
+        _chaos("pre_write", step)
+        manifest = {"step": int(step), "files": {},
+                    "process_index": process_index,
+                    "process_count": process_count}
+        # stamp the gang incarnation (elastic env contract) so a dir
+        # holding pieces from TWO save attempts — torn, restarted,
+        # re-torn at the same step — is judged incomplete instead of
+        # silently merging shards across incarnations
+        if os.environ.get("PADDLE_ELASTIC_EPOCH"):
+            try:
+                manifest["save_epoch"] = int(
+                    os.environ["PADDLE_ELASTIC_EPOCH"])
+            except ValueError:
+                pass
+        if meta is not None:
+            manifest["meta"] = meta
+        for base, tree in trees.items():
+            if tree is None and not (host_trees and base in host_trees):
+                continue
+            _write_tree(tmp, base + suffix, tree, manifest, sharded,
+                        host_trees={base + suffix: host_trees[base]}
+                        if host_trees else None)
+        for bname, data in (blobs or {}).items():
+            bpath = os.path.join(tmp, bname + suffix + ".pkl")
+            with open(bpath, "wb") as f:
+                f.write(data)
+            manifest.setdefault("blobs", {})[bname + suffix] = \
+                _file_md5(bpath)
+        _chaos("pre_manifest", step)
+        with open(os.path.join(tmp, f"manifest{suffix}.json"), "w") as f:
+            json.dump(manifest, f)
+        # durability before visibility: every byte reaches disk while the
+        # checkpoint is still invisible to readers, so the publish below
+        # can never expose data the kernel might lose in a host crash
+        for fn in os.listdir(tmp):
+            _fsync_path(os.path.join(tmp, fn))
+        _fsync_path(tmp)
+        _chaos("pre_commit", step)
+        if fence is not None and not fence():
+            raise CheckpointFencedError(
+                f"checkpoint step {step} not committed: fence rejected "
+                f"the publish (superseded coordination epoch?)")
+        if process_count > 1:
+            # multi-host: move our files into the shared dir; process 0
+            # owns directory lifecycle, others only add their piece. The
+            # manifest moves LAST — its presence is this process's commit
+            # point, so a reader that sees all manifests sees all data
+            # files too (and latest_checkpoint skips dirs missing any
+            # process's manifest).
+            os.makedirs(final, exist_ok=True)
+            if process_index == 0:
+                # re-saving into a dir a LARGER previous gang tore
+                # mid-publish (elastic shrink): stale .pK pieces with
+                # K >= the new process_count have no writer anymore and
+                # would make completeness unsatisfiable forever — drop
+                # them so the dir converges to the new cohort
+                import re
+                for fn in os.listdir(final):
+                    m = re.search(r"\.p(\d+)\.", fn)
+                    if m and int(m.group(1)) >= process_count:
+                        try:
+                            os.unlink(os.path.join(final, fn))
+                        except OSError:
+                            pass
+            manifest_fn = f"manifest{suffix}.json"
+            for fn in sorted(os.listdir(tmp),
+                             key=lambda n: n == manifest_fn):
+                if fn == manifest_fn:
+                    _chaos("mid_commit", step)
+                    # re-check the fence AT the commit point: the
+                    # manifest move is what makes this piece visible,
+                    # so a deposition during the data-file moves still
+                    # aborts (the residual window is one rename wide —
+                    # the same bounded guarantee as the master's
+                    # snapshot fencing)
+                    if fence is not None and not fence():
+                        raise CheckpointFencedError(
+                            f"checkpoint step {step} not committed: "
+                            f"fence rejected the manifest publish")
+                os.replace(os.path.join(tmp, fn), os.path.join(final, fn))
+            # the renames INTO final are directory metadata of final
+            # itself — without this fsync the manifest entry can vanish
+            # in a host crash after the 'commit'
+            _fsync_path(final)
+            os.rmdir(tmp)
+        else:
+            import shutil
+            aside = None
+            if os.path.exists(final):
+                # re-saving an existing step (restore + re-executed
+                # window): move the old dir ASIDE by rename — the
+                # exposure is one rename syscall, not an rmtree's
+                # seconds — publish, then collect the corpse
+                aside = f"{tmp}.old"
+                os.rename(final, aside)
+            os.rename(tmp, final)
+            if aside is not None:
+                shutil.rmtree(aside, ignore_errors=True)
+        _fsync_path(save_dir)
+    except BaseException:
+        # an aborted save must not strand its tempdir as save_dir litter
+        # (the chaos kill/hang paths never reach here — their leftover
+        # .tmp-* dirs are invisible to latest_checkpoint by prefix)
         import shutil
-        if os.path.exists(final):
-            shutil.rmtree(final)
-        os.rename(tmp, final)
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
     if process_index == 0:
         _prune_old(save_dir, keep)
     return final
@@ -188,7 +344,7 @@ def save_checkpoint(save_dir: str, step: int, params: Dict,
                     opt_state=None, model_state=None, keep: int = 3,
                     process_index: int = 0, process_count: int = 1,
                     sharded: bool = False, pipeline_state=None,
-                    meta=None):
+                    meta=None, fence=None):
     """Write checkpoint 'pass-%05d' style dir; prunes old ones.
 
     With ``sharded=True`` (or process_count>1) each array entry stores this
@@ -208,7 +364,7 @@ def save_checkpoint(save_dir: str, step: int, params: Dict,
          "model_state": model_state},
         keep, sharded=sharded or process_count > 1,
         process_index=process_index, process_count=process_count,
-        blobs=blobs, meta=meta)
+        blobs=blobs, meta=meta, fence=fence)
 
 
 def checkpoint_meta(path: str) -> Optional[dict]:
@@ -228,11 +384,67 @@ def checkpoint_meta(path: str) -> Optional[dict]:
     return None
 
 
-def latest_checkpoint(save_dir: str) -> Optional[str]:
+def _read_manifests(path):
+    """Every manifest*.json in a checkpoint dir, parsed (may be [])."""
+    manifests = []
+    for fn in sorted(os.listdir(path)):
+        if fn.startswith("manifest") and fn.endswith(".json"):
+            with open(os.path.join(path, fn)) as f:
+                manifests.append(json.load(f))
+    return manifests
+
+
+def _check_complete(manifests, path):
+    """Raise IOError unless every participating process's manifest is
+    present — a partial multi-host checkpoint (a host died mid-save)
+    must not load: _load_group would silently zero-fill the missing
+    hosts' shards."""
+    if not manifests:
+        raise IOError(f"no manifest in checkpoint dir {path}")
+    want = max(m.get("process_count", 1) for m in manifests)
+    have = sorted(m.get("process_index", 0) for m in manifests)
+    if have != list(range(want)):
+        raise IOError(
+            f"incomplete checkpoint {path}: have manifests for processes "
+            f"{have} of {want} — a host's save did not finish")
+    # all pieces must come from ONE save incarnation: a torn dir
+    # re-written by a restarted gang can transiently hold old-epoch and
+    # new-epoch manifests that happen to cover every index. An
+    # UNSTAMPED manifest (no elastic env) is a wildcard — a host that
+    # lost the env var must not brick an otherwise consistent save.
+    epochs = {m.get("save_epoch") for m in manifests} - {None}
+    if len(epochs) > 1:
+        raise IOError(
+            f"incomplete checkpoint {path}: manifests from mixed save "
+            f"incarnations {sorted(epochs)}")
+
+
+def is_complete(path: str) -> bool:
+    """True when the checkpoint dir is a committed, loadable unit (all
+    manifests present and parseable). Cheap: reads only the manifests."""
+    try:
+        _check_complete(_read_manifests(path), path)
+        return True
+    except (OSError, ValueError, KeyError):
+        return False
+
+
+def latest_checkpoint(save_dir: str,
+                      complete_only: bool = True) -> Optional[str]:
+    """Newest COMMITTED checkpoint dir (or None). A save interrupted
+    mid-publish (multi-host manifest-last window) leaves a torn
+    ``ckpt-*`` dir; with ``complete_only`` (the default) such dirs are
+    skipped so a restore falls back to the previous intact step instead
+    of dying on the torn one — the crash-consistency contract the
+    elastic supervisor restarts depend on."""
     if not os.path.isdir(save_dir):
         return None
-    cks = sorted(d for d in os.listdir(save_dir) if d.startswith("ckpt-"))
-    return os.path.join(save_dir, cks[-1]) if cks else None
+    for d in sorted((d for d in os.listdir(save_dir)
+                     if d.startswith("ckpt-")), reverse=True):
+        path = os.path.join(save_dir, d)
+        if not complete_only or is_complete(path):
+            return path
+    return None
 
 
 def _verify_file(fpath, want):
@@ -278,21 +490,8 @@ def load_checkpoint(path: str, params: Dict, opt_state=None, model_state=None,
     (step, params, opt_state, model_state). Handles both single-process
     checkpoints and the multi-host per-process shard layout (merges every
     manifest*.json present)."""
-    manifests = []
-    for fn in sorted(os.listdir(path)):
-        if fn.startswith("manifest") and fn.endswith(".json"):
-            with open(os.path.join(path, fn)) as f:
-                manifests.append(json.load(f))
-    if not manifests:
-        raise IOError(f"no manifest in checkpoint dir {path}")
-    # a partial multi-host checkpoint (a host died mid-save) must not load:
-    # _load_group would silently zero-fill the missing hosts' shards
-    want = max(m.get("process_count", 1) for m in manifests)
-    have = sorted(m.get("process_index", 0) for m in manifests)
-    if have != list(range(want)):
-        raise IOError(
-            f"incomplete checkpoint {path}: have manifests for processes "
-            f"{have} of {want} — a host's save did not finish")
+    manifests = _read_manifests(path)
+    _check_complete(manifests, path)
     out = []
     for base, tree in (("params", params), ("opt_state", opt_state),
                        ("model_state", model_state)):
@@ -339,9 +538,14 @@ class AsyncCheckpointer:
     pruning on a worker thread. Training resumes immediately; call
     ``wait()`` before reading the directory or exiting."""
 
-    def __init__(self, save_dir: str, keep: int = 3, max_pending: int = 2):
+    def __init__(self, save_dir: str, keep: int = 3, max_pending: int = 2,
+                 fence=None):
+        """``fence``: commit gate checked by the worker thread right
+        before each publish (see ``_write_single``) — a fenced save
+        surfaces as ``CheckpointFencedError`` on the next save()/wait()."""
         self.save_dir = save_dir
         self.keep = keep
+        self.fence = fence
         self._q = queue.Queue(maxsize=max_pending)
         self._err = None
         self._worker = threading.Thread(target=self._run, daemon=True)
@@ -363,7 +567,8 @@ class AsyncCheckpointer:
     def _write(self, step, host_trees, blobs=None, meta=None):
         _write_single(self.save_dir, step,
                       {base: None for base in host_trees}, self.keep,
-                      host_trees=host_trees, blobs=blobs, meta=meta)
+                      host_trees=host_trees, blobs=blobs, meta=meta,
+                      fence=self.fence)
 
     def save(self, step: int, params: Dict, opt_state=None,
              model_state=None, pipeline_state=None, meta=None):

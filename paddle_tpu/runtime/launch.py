@@ -34,6 +34,68 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def spawn_local_procs(nprocs: int, argv: Sequence[str],
+                      devices_per_proc: int = 1,
+                      coordinator_port: Optional[int] = None,
+                      env_extra: Optional[dict] = None,
+                      env_per_rank: Optional[Sequence[dict]] = None,
+                      cluster: bool = True) -> List[subprocess.Popen]:
+    """Spawn ``nprocs`` local worker processes WITHOUT waiting — the
+    restartable-gang primitive the elastic supervisor re-forms on every
+    coordination epoch. ``cluster=False`` omits PADDLE_COORDINATOR so
+    workers run independent single-process JAX runtimes (the CPU
+    simulation path where jaxlib lacks multi-process collectives —
+    ``multiprocess_cpu_supported``); a fresh coordinator port per call
+    is the 'fresh coordination epoch' in cluster mode (no TIME_WAIT or
+    zombie can hold the old port hostage)."""
+    port = coordinator_port or free_port()
+    procs = []
+    for rank in range(nprocs):
+        # update() chain, not dict(**kw): callers may legitimately
+        # override the contract keys (env_extra={"PADDLE_PLATFORM":
+        # ...}) and later layers must win, not TypeError
+        env = dict(os.environ)
+        env.update(PADDLE_NUM_PROCESSES=str(nprocs),
+                   PADDLE_PROCESS_ID=str(rank),
+                   PADDLE_PLATFORM="cpu",
+                   PADDLE_LOCAL_CPU_DEVICES=str(devices_per_proc))
+        env.update(env_extra or {})
+        env.update(env_per_rank[rank] if env_per_rank else {})
+        if cluster:
+            env["PADDLE_COORDINATOR"] = f"127.0.0.1:{port}"
+        procs.append(subprocess.Popen([sys.executable, *argv], env=env))
+    return procs
+
+
+def terminate_procs(procs: Sequence[subprocess.Popen],
+                    grace: float = 3.0) -> None:
+    """Tear a gang down: close stdin pipes first (the ssh watchdog path
+    — EOF TERM-then-KILLs the REMOTE tree), then TERM every local
+    process, then KILL whatever ignored the TERM after ``grace``."""
+    for p in procs:
+        if p.stdin is not None and not p.stdin.closed:
+            try:
+                p.stdin.close()
+            except OSError:
+                pass
+        if p.poll() is None:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+    deadline = time.time() + grace
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                try:
+                    p.wait(timeout=grace)
+                except subprocess.TimeoutExpired:
+                    pass
+
+
 def launch_local(nprocs: int, argv: Sequence[str],
                  devices_per_proc: int = 1,
                  coordinator_port: Optional[int] = None,
@@ -41,17 +103,10 @@ def launch_local(nprocs: int, argv: Sequence[str],
                  timeout: float = 600.0) -> List[int]:
     """Spawn ``nprocs`` local worker processes and wait; returns their
     return codes. Workers must call paddle_tpu.distributed.init()."""
-    port = coordinator_port or free_port()
-    procs = []
-    for rank in range(nprocs):
-        env = dict(os.environ,
-                   PADDLE_COORDINATOR=f"127.0.0.1:{port}",
-                   PADDLE_NUM_PROCESSES=str(nprocs),
-                   PADDLE_PROCESS_ID=str(rank),
-                   PADDLE_PLATFORM="cpu",
-                   PADDLE_LOCAL_CPU_DEVICES=str(devices_per_proc),
-                   **(env_extra or {}))
-        procs.append(subprocess.Popen([sys.executable, *argv], env=env))
+    procs = spawn_local_procs(nprocs, argv,
+                              devices_per_proc=devices_per_proc,
+                              coordinator_port=coordinator_port,
+                              env_extra=env_extra)
     return _wait_all(procs, timeout)
 
 
@@ -144,12 +199,32 @@ def launch_ssh(hosts: Sequence[str], argv: Sequence[str], *,
     ``_wait_all`` timing out and closing the client's stdin — the whole
     remote worker tree is torn down instead of lingering and holding
     the coordinator port (ADVICE round-5)."""
+    procs = spawn_ssh_procs(hosts, argv, port=port, workdir=workdir,
+                            env_extra=env_extra, ssh_cmd=ssh_cmd)
+    return _wait_all(procs, timeout)
+
+
+def spawn_ssh_procs(hosts: Sequence[str], argv: Sequence[str], *,
+                    port: int = 6007, workdir: Optional[str] = None,
+                    env_extra: Optional[dict] = None,
+                    env_per_rank: Optional[Sequence[dict]] = None,
+                    ssh_cmd: Sequence[str] = ("ssh", "-o", "BatchMode=yes")
+                    ) -> List[subprocess.Popen]:
+    """The ssh fan-out WITHOUT waiting — the supervisor's remote-gang
+    primitive: it re-invokes this with a patched ``hosts`` list
+    (replacement-host injection) and a fresh port per coordination
+    epoch, and tears the gang down via ``terminate_procs`` (the stdin
+    watchdog reaches the remote trees). Each worker also gets
+    ``PADDLE_GANG_HOST`` so host-scoped fault policies and logs can
+    name the box they ran on."""
     envs_common = dict(env_extra or {})
     procs = []
     for rank, host in enumerate(hosts):
         envs = {"PADDLE_COORDINATOR": f"{hosts[0]}:{port}",
                 "PADDLE_NUM_PROCESSES": str(len(hosts)),
-                "PADDLE_PROCESS_ID": str(rank), **envs_common}
+                "PADDLE_PROCESS_ID": str(rank),
+                "PADDLE_GANG_HOST": host, **envs_common,
+                **(env_per_rank[rank] if env_per_rank else {})}
         exports = " ".join(f"{k}={shlex.quote(str(v))}"
                            for k, v in envs.items())
         cd = f"cd {shlex.quote(workdir)} && " if workdir else ""
@@ -160,7 +235,59 @@ def launch_ssh(hosts: Sequence[str], argv: Sequence[str], *,
                               + " ".join(shlex.quote(a) for a in argv))
         procs.append(subprocess.Popen([*ssh_cmd, host, remote],
                                       stdin=subprocess.PIPE))
-    return _wait_all(procs, timeout)
+    return procs
+
+
+_MP_CPU_PROBE = """
+import paddle_tpu.distributed as dist
+dist.init()
+import jax, jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
+import numpy as np
+devs = jax.devices()
+assert len(devs) == 2, devs
+mesh = Mesh(np.asarray(devs), ("d",))
+x = jax.device_put(jnp.ones((2,), jnp.float32), NamedSharding(mesh, P("d")))
+from paddle_tpu.parallel.compat import shard_map
+import jax.lax as lax
+total = jax.jit(shard_map(lambda v: lax.psum(jnp.sum(v), "d"), mesh=mesh,
+                          in_specs=P("d"), out_specs=P()))(x)
+assert float(total) == 2.0, float(total)
+"""
+
+_mp_cpu_supported: Optional[bool] = None
+
+
+def multiprocess_cpu_supported(timeout: float = 240.0) -> bool:
+    """Whether THIS jaxlib can actually execute cross-process
+    computations on the CPU backend. Several jaxlib releases accept
+    ``jax.distributed.initialize`` on CPU but then die at dispatch with
+    "Multiprocess computations aren't implemented on the CPU backend" —
+    the probe runs a 2-process 1-device-each psum once per process and
+    caches the verdict, so the slow multi-process tests can skip with a
+    reason instead of failing on an environment limitation. Override
+    with PADDLE_TPU_MULTIPROC_CPU=0/1 to skip the probe."""
+    global _mp_cpu_supported
+    forced = os.environ.get("PADDLE_TPU_MULTIPROC_CPU")
+    if forced is not None:
+        return forced not in ("0", "false", "no")
+    if _mp_cpu_supported is None:
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            probe = os.path.join(td, "probe.py")
+            repo = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            with open(probe, "w") as f:
+                f.write(f"import sys; sys.path.insert(0, {repo!r})\n"
+                        + _MP_CPU_PROBE)
+            try:
+                rcs = launch_local(2, [probe], devices_per_proc=1,
+                                   timeout=timeout)
+            except Exception:  # noqa: BLE001 — a broken probe = no
+                rcs = [-1]
+            _mp_cpu_supported = all(rc == 0 for rc in rcs)
+    return _mp_cpu_supported
 
 
 def main(argv=None):

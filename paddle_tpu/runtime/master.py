@@ -24,6 +24,7 @@ against the new leader (lease tokens keep duplicate/stale reports safe).
 import dataclasses
 import json
 import os
+import random
 import socket
 import socketserver
 import threading
@@ -54,6 +55,13 @@ _m_passes = _metrics.counter("master_passes_total", "completed passes")
 _m_task_wait = _metrics.counter(
     "master_task_wait_seconds_total",
     "client time spent polling for a task (the data-barrier wait)")
+_m_fenced = _metrics.counter(
+    "master_fenced_requests_total",
+    "task RPCs rejected because the worker's coordination epoch is "
+    "older than the fence (zombie gang members)")
+_m_reconnects = _metrics.counter(
+    "master_client_reconnects_total",
+    "client reconnect attempts after a connection failure")
 
 
 @dataclasses.dataclass
@@ -128,6 +136,12 @@ class MasterService:
         # saver is harmless (worst case one extra checkpoint), whereas a
         # restored stale grant could block saves for a full window.
         self._save_grant = (None, 0.0)
+        # elastic epoch fence: task RPCs carrying a worker_epoch below
+        # this are rejected — a zombie from a torn-down gang can never
+        # lease work or commit task state (runtime/supervisor.py bumps
+        # it on every gang restart). Snapshotted: a failed-over master
+        # must keep fencing the same zombies.
+        self._epoch_fence = 0
         if snapshot_path and os.path.exists(snapshot_path):
             self._restore()
         if snapshot_path:
@@ -168,11 +182,40 @@ class MasterService:
         self._snapshot()
         log.info("master: dataset set, %d tasks", len(tasks))
 
+    # -- elastic epoch fencing ---------------------------------------------
+    def set_epoch_fence(self, epoch: int) -> int:
+        """Reject task RPCs from workers whose coordination epoch is
+        below ``epoch`` (monotonic; returns the active fence). The
+        supervisor calls this after every gang teardown so a zombie
+        worker that survived the kill can never lease a task or commit
+        one as done/failed."""
+        with self._lock:
+            self._epoch_fence = max(self._epoch_fence, int(epoch))
+            self._version += 1
+            fence = self._epoch_fence
+        self._dirty.set()
+        log.info("master: epoch fence now %d", fence)
+        return fence
+
+    def _fenced(self, worker_epoch) -> bool:
+        """True when this RPC must be rejected. Workers that do not
+        declare an epoch (pre-elastic clients) are never fenced — the
+        fence is an opt-in contract between supervisor and gang."""
+        if worker_epoch is None:
+            return False
+        with self._lock:
+            fenced = int(worker_epoch) < self._epoch_fence
+        if fenced:
+            _m_fenced.inc(service=self.name)
+        return fenced
+
     # -- task protocol -----------------------------------------------------
-    def get_task(self) -> Optional[Task]:
+    def get_task(self, worker_epoch=None) -> Optional[Task]:
         """Lease one task; None when this pass is drained (caller should
         retry after pending tasks finish, or treat the pass as over when
         num_pending()==0)."""
+        if self._fenced(worker_epoch):
+            return None
         with self._lock:
             changed = self._requeue_expired_locked()
             if not self._todo:
@@ -194,7 +237,10 @@ class MasterService:
             self._dirty.set()
         return task
 
-    def report_done(self, task_id: int, lease: Optional[int] = None) -> bool:
+    def report_done(self, task_id: int, lease: Optional[int] = None,
+                    worker_epoch=None) -> bool:
+        if self._fenced(worker_epoch):
+            return False       # a zombie cannot commit task state
         with self._lock:
             ent = self._pending.get(task_id)
             if ent is None or (lease is not None and ent[0].lease != lease):
@@ -208,9 +254,12 @@ class MasterService:
         self._dirty.set()
         return True
 
-    def report_failed(self, task_id: int, lease: Optional[int] = None):
+    def report_failed(self, task_id: int, lease: Optional[int] = None,
+                      worker_epoch=None):
         """Failed lease: requeue unless over the failure cap
         (service.go failureMax discard)."""
+        if self._fenced(worker_epoch):
+            return             # a zombie cannot fail a live gang's lease
         with self._lock:
             ent = self._pending.get(task_id)
             if ent is None or (lease is not None and ent[0].lease != lease):
@@ -267,14 +316,19 @@ class MasterService:
 
     # -- save-model election ----------------------------------------------
     def request_save_model(self, trainer_id: str,
-                           block_dur: float = 60.0) -> bool:
+                           block_dur: float = 60.0,
+                           worker_epoch=None) -> bool:
         """Elect ONE trainer to save the model: the first asker within a
         ``block_dur`` window gets True, everyone else False until the
         window expires (reference: go/master/service.go RequestSaveModel
         / python/paddle/v2/master/client.py:24 request_save_model — the
         mechanism that stops N data-parallel trainers writing N identical
         checkpoints). Re-asking while holding the grant is idempotent, so
-        a saver that retries its RPC keeps its election."""
+        a saver that retries its RPC keeps its election. Epoch-fenced
+        like the task RPCs: a zombie must not grab the grant and starve
+        the live gang's save windows."""
+        if self._fenced(worker_epoch):
+            return False
         with self._lock:
             now = self._time()
             holder, expiry = self._save_grant
@@ -298,6 +352,7 @@ class MasterService:
                    "done": len(self._done),
                    "discarded": len(self._discarded),
                    "epoch": self._epoch,
+                   "epoch_fence": self._epoch_fence,
                    "healthy": not self._stop.is_set()}
         if changed:
             self._dirty.set()
@@ -332,6 +387,7 @@ class MasterService:
             version = self._version
             state = {
                 "epoch": self._epoch,
+                "epoch_fence": self._epoch_fence,
                 "lease_counter": self._lease_counter,
                 "todo": [t.to_dict() for t in self._todo],
                 # pending leases are deliberately snapshotted as todo: after
@@ -388,6 +444,8 @@ class MasterService:
             # reissue tokens that stale pre-failover reports still hold
             self._lease_counter = max(self._lease_counter,
                                       state.get("lease_counter", 0))
+            self._epoch_fence = max(self._epoch_fence,
+                                    state.get("epoch_fence", 0))
             self._todo = ([Task.from_dict(d) for d in state["todo"]] +
                           [Task.from_dict(d) for d in state["pending"]])
             self._pending = {}
@@ -412,14 +470,18 @@ class _Handler(socketserver.StreamRequestHandler):
                 method = req["method"]
                 svc = self.server.service            # type: ignore
                 if method == "get_task":
-                    t = svc.get_task()
+                    t = svc.get_task(req.get("worker_epoch"))
                     resp = {"task": t.to_dict() if t else None}
                 elif method == "report_done":
                     resp = {"ok": svc.report_done(req["task_id"],
-                                                  req.get("lease"))}
+                                                  req.get("lease"),
+                                                  req.get("worker_epoch"))}
                 elif method == "report_failed":
-                    svc.report_failed(req["task_id"], req.get("lease"))
+                    svc.report_failed(req["task_id"], req.get("lease"),
+                                      req.get("worker_epoch"))
                     resp = {"ok": True}
+                elif method == "set_epoch_fence":
+                    resp = {"fence": svc.set_epoch_fence(req["epoch"])}
                 elif method == "status":
                     resp = {"todo": svc.num_todo(),
                             "pending": svc.num_pending(),
@@ -431,7 +493,8 @@ class _Handler(socketserver.StreamRequestHandler):
                             _metrics.default_registry().render_prometheus()}
                 elif method == "request_save_model":
                     resp = {"ok": svc.request_save_model(
-                        req["trainer_id"], req.get("block_dur", 60.0))}
+                        req["trainer_id"], req.get("block_dur", 60.0),
+                        req.get("worker_epoch"))}
                 else:
                     resp = {"error": f"unknown method {method}"}
             except Exception as e:                   # noqa: BLE001
@@ -729,18 +792,55 @@ def discover_master(discovery_path: str) -> Optional[tuple]:
         return None
 
 
+class DecorrelatedBackoff:
+    """Exponential backoff with decorrelated jitter (the AWS
+    architecture-blog scheme): each delay is uniform on
+    [base, 3 x previous], capped — N clients retrying against one
+    recovering master spread out instead of stampeding in lockstep,
+    and the cap bounds how stale a client can get after recovery."""
+
+    def __init__(self, base: float = 0.05, cap: float = 2.0, rng=None):
+        self.base = float(base)
+        self.cap = float(cap)
+        self._rng = rng or random.Random()
+        self._prev = self.base
+
+    def reset(self):
+        self._prev = self.base
+
+    def next(self) -> float:
+        delay = min(self.cap, self._rng.uniform(self.base,
+                                                self._prev * 3.0))
+        self._prev = delay
+        return delay
+
+
 class MasterClient:
     """Client for trainers. ``addr=None`` talks to an in-process service
     (reference: python/paddle/v2/master/client.py set_dataset/next_record
     over the C binding; here JSON/TCP or direct calls). With
     ``discovery_path`` the client resolves the leader from the HA lock
     file and transparently re-resolves + retries on connection failure
-    (master failover; lease tokens make replayed reports safe)."""
+    (master failover; lease tokens make replayed reports safe).
+    Reconnects back off exponentially with decorrelated jitter so N
+    workers do not stampede a recovering master, and each connect
+    attempt is bounded by ``connect_timeout`` (a black-holed address
+    must not eat the whole failover budget in one attempt).
+
+    ``worker_epoch`` (default: the PADDLE_ELASTIC_EPOCH env the
+    supervisor stamps on every gang member) rides on every task RPC —
+    after a gang restart the master's epoch fence silently retires
+    zombies still holding an older epoch."""
 
     def __init__(self, service: Optional[MasterService] = None,
                  addr: Optional[tuple] = None,
                  discovery_path: Optional[str] = None,
-                 failover_timeout: float = 30.0):
+                 failover_timeout: float = 30.0,
+                 connect_timeout: float = 5.0,
+                 io_timeout: float = 10.0,
+                 backoff_base: float = 0.05,
+                 backoff_cap: float = 2.0,
+                 worker_epoch: Optional[int] = None):
         assert sum(x is not None for x in (service, addr,
                                            discovery_path)) == 1, \
             "pass exactly one of service/addr/discovery_path"
@@ -748,6 +848,15 @@ class MasterClient:
         self._addr = addr
         self._discovery = discovery_path
         self._failover_timeout = failover_timeout
+        self._connect_timeout = connect_timeout
+        self._io_timeout = io_timeout
+        self._backoff = DecorrelatedBackoff(backoff_base, backoff_cap)
+        if worker_epoch is None and os.environ.get("PADDLE_ELASTIC_EPOCH"):
+            try:
+                worker_epoch = int(os.environ["PADDLE_ELASTIC_EPOCH"])
+            except ValueError:
+                pass
+        self._worker_epoch = worker_epoch
         self._sock = None
 
     def _resolve(self):
@@ -755,12 +864,16 @@ class MasterClient:
             return self._addr
         return discover_master(self._discovery)
 
-    def _rpc_once(self, method, **kw):
+    def _rpc_once(self, method, deadline=None, **kw):
         if self._sock is None:
             addr = self._resolve()
             if addr is None:
                 raise ConnectionError("no master leader published")
-            self._sock = socket.create_connection(addr, timeout=10)
+            timeout = self._connect_timeout
+            if deadline is not None:
+                timeout = max(0.1, min(timeout, deadline - time.time()))
+            self._sock = socket.create_connection(addr, timeout=timeout)
+            self._sock.settimeout(self._io_timeout)
             self._file = self._sock.makefile("rwb")
         self._file.write((json.dumps({"method": method, **kw}) + "\n")
                          .encode())
@@ -776,13 +889,15 @@ class MasterClient:
     def _rpc(self, method, **kw):
         if self._svc is not None:
             if method == "get_task":
-                t = self._svc.get_task()
+                t = self._svc.get_task(kw.get("worker_epoch"))
                 return {"task": t.to_dict() if t else None}
             if method == "report_done":
-                return {"ok": self._svc.report_done(kw["task_id"],
-                                                    kw.get("lease"))}
+                return {"ok": self._svc.report_done(
+                    kw["task_id"], kw.get("lease"),
+                    kw.get("worker_epoch"))}
             if method == "report_failed":
-                self._svc.report_failed(kw["task_id"], kw.get("lease"))
+                self._svc.report_failed(kw["task_id"], kw.get("lease"),
+                                        kw.get("worker_epoch"))
                 return {"ok": True}
             if method == "status":
                 return {"todo": self._svc.num_todo(),
@@ -793,29 +908,49 @@ class MasterClient:
                         _metrics.default_registry().render_prometheus()}
             if method == "request_save_model":
                 return {"ok": self._svc.request_save_model(
-                    kw["trainer_id"], kw.get("block_dur", 60.0))}
+                    kw["trainer_id"], kw.get("block_dur", 60.0),
+                    kw.get("worker_epoch"))}
+            if method == "set_epoch_fence":
+                return {"fence": self._svc.set_epoch_fence(kw["epoch"])}
         deadline = time.time() + self._failover_timeout
+        self._backoff.reset()
         while True:
             try:
-                return self._rpc_once(method, **kw)
+                resp = self._rpc_once(method, deadline=deadline, **kw)
+                self._backoff.reset()
+                return resp
             # ValueError: a leader SIGKILLed mid-response leaves a partial
             # line — a decode error is a failover signal, not a bug
             except (ConnectionError, OSError, ValueError) as e:
                 self.close()
                 if self._discovery is None or time.time() > deadline:
                     raise
-                log.info("master client: %s; re-resolving leader", e)
-                time.sleep(0.2)
+                delay = self._backoff.next()
+                _m_reconnects.inc()
+                log.info("master client: %s; re-resolving leader in "
+                         "%.2fs", e, delay)
+                time.sleep(delay)
+
+    def _epoch_kw(self):
+        return ({} if self._worker_epoch is None
+                else {"worker_epoch": self._worker_epoch})
 
     def get_task(self) -> Optional[Task]:
-        d = self._rpc("get_task")["task"]
+        d = self._rpc("get_task", **self._epoch_kw())["task"]
         return Task.from_dict(d) if d else None
 
     def report_done(self, task_id: int, lease: Optional[int] = None):
-        self._rpc("report_done", task_id=task_id, lease=lease)
+        self._rpc("report_done", task_id=task_id, lease=lease,
+                  **self._epoch_kw())
 
     def report_failed(self, task_id: int, lease: Optional[int] = None):
-        self._rpc("report_failed", task_id=task_id, lease=lease)
+        self._rpc("report_failed", task_id=task_id, lease=lease,
+                  **self._epoch_kw())
+
+    def set_epoch_fence(self, epoch: int) -> int:
+        """Supervisor-side: retire every worker whose coordination epoch
+        is below ``epoch`` (returns the active fence)."""
+        return int(self._rpc("set_epoch_fence", epoch=int(epoch))["fence"])
 
     def status(self):
         return self._rpc("status")
@@ -831,7 +966,8 @@ class MasterClient:
         next ``block_dur`` window (python/paddle/v2/master/client.py:24).
         Typical use: ``if client.request_save_model(my_id): save()``."""
         return bool(self._rpc("request_save_model", trainer_id=trainer_id,
-                              block_dur=block_dur)["ok"])
+                              block_dur=block_dur,
+                              **self._epoch_kw())["ok"])
 
     def close(self):
         if self._sock is not None:

@@ -1,0 +1,628 @@
+"""Elastic gang supervision — training that survives worker death and
+resizes the mesh mid-run.
+
+Reference: the Go cloud layer's elastic trainers (PAPER.md § cloud
+layer: etcd-backed master task queue, fault-tolerant pserver) — any
+worker may be preempted; the job continues. TPU-native composition of
+the blocks this repo already has:
+
+- liveness rides the same file-mtime lease scheme as ``LeaderLock``
+  (runtime/master.py): each worker heartbeats a per-rank JSON file; the
+  supervisor judges a worker dead when its process exits nonzero or its
+  heartbeat goes stale past ``heartbeat_window``, and WEDGED when the
+  file stays fresh (the beat thread lives) but step progress stalls
+  past ``wedge_window`` — a hung collective beats but does not step.
+  Workers may also publish a ``health_port`` (``SGD
+  .attach_observability``-style ``/healthz``); the supervisor probes it
+  as a secondary judgment.
+- teardown goes through ``runtime/launch.py``: stdin-watchdog close
+  (the ssh remote-tree killer) + TERM-then-KILL for local gangs.
+- every relaunch is a fresh **coordination epoch**: the supervisor
+  bumps ``<state_dir>/epoch.json`` and stamps ``PADDLE_ELASTIC_EPOCH``
+  into the new gang; in cluster mode a fresh coordinator port re-forms
+  the jax.distributed runtime from scratch. Epoch fencing closes the
+  zombie hole: a worker from a torn-down gang that somehow survived the
+  kill carries a stale epoch, so (a) its checkpoint commits abort
+  (``io/checkpoint.py`` ``fence=``, wired automatically by
+  ``SGD.train`` — write-temp + fsync + atomic rename + manifest-last
+  means nothing partial is ever visible either), and (b) the master
+  rejects its task RPCs (``MasterService.set_epoch_fence``).
+- recovery is a restore: the relaunched trainer finds the latest
+  INTACT checkpoint (torn saves are skipped), reshards it to the new
+  mesh size / ZeRO layout via the manifest's ``meta.zero``, restores
+  the input pipeline's stream position, and continues on the exact
+  next batch.
+- when a worker cannot be replaced (``replacements`` exhausted), the
+  gang degrades gracefully to a smaller mesh (optionally snapped to
+  ``valid_sizes``) instead of dying — the reference's elastic-trainer
+  semantics.
+
+Observability: ``training_restarts_total{reason}``,
+``worker_liveness{rank}``, ``supervisor_state`` (coded; see STATES),
+``supervisor_last_recovery_seconds``, plus a flight-recorder
+post-mortem written into ``<state_dir>/flight/`` on every restart.
+
+The supervisor is deliberately jax-free: it launches, watches files
+and processes, and kills. Workers do the training.
+"""
+
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from paddle_tpu.observe import metrics as _metrics
+from paddle_tpu.runtime import launch as _launch
+from paddle_tpu.runtime.master import DecorrelatedBackoff
+from paddle_tpu.utils.logger import get_logger
+
+log = get_logger("supervisor")
+
+ENV_DIR = "PADDLE_ELASTIC_DIR"
+ENV_EPOCH = "PADDLE_ELASTIC_EPOCH"
+
+#: supervisor_state gauge encoding
+STATES = {"idle": 0, "launching": 1, "running": 2, "teardown": 3,
+          "backoff": 4, "done": 5, "failed": 6}
+
+_m_restarts = _metrics.counter(
+    "training_restarts_total",
+    "supervised gang restarts (label reason = worker_exit|"
+    "heartbeat_lost|wedged|no_heartbeat|unhealthy|attempt_timeout)")
+_m_liveness = _metrics.gauge(
+    "worker_liveness",
+    "per-worker liveness judgment (label rank; 1 = beating, 0 = dead)")
+_m_state = _metrics.gauge(
+    "supervisor_state",
+    "supervision state machine position (0 idle, 1 launching, "
+    "2 running, 3 teardown, 4 backoff, 5 done, 6 failed)")
+_m_recovery = _metrics.gauge(
+    "supervisor_last_recovery_seconds",
+    "kill-detection to first post-restore worker step, last restart")
+_m_gang = _metrics.gauge(
+    "supervisor_gang_size", "workers in the current gang incarnation")
+
+
+# ---------------------------------------------------------------------------
+# the coordination epoch (worker + supervisor side)
+# ---------------------------------------------------------------------------
+
+def _epoch_path(state_dir: str) -> str:
+    return os.path.join(state_dir, "epoch.json")
+
+
+def current_epoch(state_dir: str) -> int:
+    """The fence value: the epoch of the newest gang the supervisor
+    launched (0 before the first launch)."""
+    try:
+        with open(_epoch_path(state_dir)) as f:
+            return int(json.load(f)["epoch"])
+    except (OSError, ValueError, KeyError):
+        return 0
+
+
+def write_epoch(state_dir: str, epoch: int) -> None:
+    os.makedirs(state_dir, exist_ok=True)
+    tmp = f"{_epoch_path(state_dir)}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"epoch": int(epoch), "ts": time.time()}, f)
+    os.replace(tmp, _epoch_path(state_dir))
+
+
+def my_epoch() -> Optional[int]:
+    """This worker's stamped coordination epoch (None outside a gang)."""
+    v = os.environ.get(ENV_EPOCH)
+    try:
+        return int(v) if v else None
+    except ValueError:
+        return None
+
+
+def fence_from_env() -> Optional[object]:
+    """The checkpoint-commit fence for THIS worker: True while its
+    stamped epoch is still the current one. None when not running under
+    a supervisor (no env contract) — saves are then unfenced, exactly
+    as before."""
+    state_dir = os.environ.get(ENV_DIR)
+    epoch = my_epoch()
+    if not state_dir or epoch is None:
+        return None
+    return lambda: current_epoch(state_dir) <= epoch
+
+
+# ---------------------------------------------------------------------------
+# heartbeats (worker side)
+# ---------------------------------------------------------------------------
+
+def _hb_dir(state_dir: str) -> str:
+    return os.path.join(state_dir, "hb")
+
+
+class Heartbeat:
+    """Worker-side liveness + progress beacon: an atomically-replaced
+    per-rank JSON file. The file's mtime is the liveness lease (the
+    background thread refreshes it every ``interval``, LeaderLock
+    style); the ``step``/``step_ts`` fields are the PROGRESS signal the
+    trainer updates per batch — a wedged worker keeps the lease fresh
+    but stops stepping, which is precisely what the supervisor's
+    ``wedge_window`` judges."""
+
+    def __init__(self, state_dir: str, rank: int,
+                 epoch: Optional[int] = None, interval: float = 0.5,
+                 health_port: Optional[int] = None,
+                 start_thread: bool = True):
+        self.state_dir = state_dir
+        self.rank = int(rank)
+        self.epoch = epoch if epoch is not None else (my_epoch() or 0)
+        self.interval = interval
+        # epoch-scoped filename: a zombie from a torn-down gang that
+        # survived the kill (ssh partition) keeps rewriting ITS file —
+        # it must not alternate with the live replacement rank's beats
+        # and make the supervisor judge a beating worker absent
+        self.path = os.path.join(
+            _hb_dir(state_dir),
+            f"worker_{self.rank}_e{self.epoch}.json")
+        os.makedirs(_hb_dir(state_dir), exist_ok=True)
+        self._lock = threading.Lock()
+        self._fields = {"rank": self.rank, "pid": os.getpid(),
+                        "epoch": self.epoch}
+        # ssh gangs run on another box: publish the host so the
+        # supervisor's health probe targets the right machine
+        if os.environ.get("PADDLE_GANG_HOST"):
+            self._fields["host"] = os.environ["PADDLE_GANG_HOST"]
+        if health_port is not None:
+            self._fields["health_port"] = int(health_port)
+        self._stop = threading.Event()
+        self._last_write = 0.0
+        self._write()
+        self._thread = None
+        if start_thread:
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True)
+            self._thread.start()
+
+    @classmethod
+    def from_env(cls, health_port: Optional[int] = None,
+                 interval: float = 0.5) -> Optional["Heartbeat"]:
+        """A Heartbeat wired from the supervisor's env contract, or
+        None when this process is not a supervised gang member."""
+        state_dir = os.environ.get(ENV_DIR)
+        rank = os.environ.get("PADDLE_PROCESS_ID", "0")
+        if not state_dir:
+            return None
+        return cls(state_dir, int(rank), health_port=health_port,
+                   interval=interval)
+
+    def _write(self):
+        with self._lock:
+            rec = dict(self._fields, ts=time.time())
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(rec, f)
+            os.replace(tmp, self.path)
+            self._last_write = time.time()
+        except OSError:
+            pass                 # a missed beat is survivable; dying isn't
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            self._write()
+
+    def beat(self, step: Optional[int] = None):
+        """Record step progress (trainer: once per batch). The write
+        itself is throttled to the beat-thread cadence — fast training
+        steps must not pay a file rewrite (a network-filesystem round
+        trip in ssh mode) per batch; the interval thread publishes the
+        updated fields within one beat period anyway."""
+        with self._lock:
+            if step is not None:
+                self._fields["step"] = int(step)
+                self._fields["step_ts"] = time.time()
+        if time.time() - self._last_write >= self.interval:
+            self._write()
+
+    def done(self):
+        """Mark clean completion (the supervisor stops judging this
+        rank's staleness) and stop the beat thread."""
+        with self._lock:
+            self._fields["done"] = True
+        self._write()
+        self.stop()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+def read_heartbeats(state_dir: str,
+                    epoch: Optional[int] = None) -> Dict[int, dict]:
+    """rank -> heartbeat record (+ ``age`` seconds since last write);
+    unparseable / mid-replace files are skipped. With ``epoch`` only
+    records of that incarnation count (the supervisor's view — a
+    zombie's stale-epoch beats are invisible, not 'absence'); without
+    it the newest incarnation per rank wins (the health endpoint)."""
+    out = {}
+    d = _hb_dir(state_dir)
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    now = time.time()
+    for fn in names:
+        if not (fn.startswith("worker_") and fn.endswith(".json")):
+            continue
+        p = os.path.join(d, fn)
+        try:
+            with open(p) as f:
+                rec = json.load(f)
+            rec["age"] = now - os.path.getmtime(p)
+            rank = int(rec["rank"])
+        except (OSError, ValueError, KeyError):
+            continue
+        if epoch is not None and rec.get("epoch") != epoch:
+            continue
+        prev = out.get(rank)
+        if prev is None or (rec.get("epoch") or 0) >= (prev.get("epoch")
+                                                       or 0):
+            out[rank] = rec
+    return out
+
+
+def _probe_healthz(port: int, host: str = "127.0.0.1",
+                   timeout: float = 0.5) -> Optional[bool]:
+    """True healthy / False unhealthy / None unreachable-or-unknown."""
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/healthz", timeout=timeout):
+            return True
+    except urllib.error.HTTPError as e:
+        return False if e.code == 503 else True
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the supervisor
+# ---------------------------------------------------------------------------
+
+class Supervisor:
+    """Drive a worker gang through launch → watch → teardown → relaunch
+    until it completes, the restart budget runs out, or the gang cannot
+    shrink any further.
+
+    Local mode (``hosts=None``): ``nprocs`` python processes on this
+    machine via ``launch.spawn_local_procs`` — ``cluster=True`` wires
+    PADDLE_COORDINATOR (one jax.distributed runtime per epoch, fresh
+    port each time), ``cluster=False`` runs independent single-process
+    runtimes (the CPU-simulation path; see
+    ``launch.multiprocess_cpu_supported``). ``replacements`` is the
+    spare-host budget: None = unlimited (a local respawn is free), an
+    int = that many worker deaths can be replaced before the gang
+    starts shrinking instead (graceful degradation), optionally snapped
+    down to a size in ``valid_sizes`` (mesh-shape divisibility).
+
+    SSH mode (``hosts=[...]``): one worker per host via
+    ``launch.spawn_ssh_procs``; dead hosts are swapped for
+    ``replacement_hosts`` entries first, dropped when the pool is dry.
+
+    ``max_restarts`` budgets CONSECUTIVE unstable incarnations, not the
+    job's lifetime: an incarnation that stepped and then survived
+    ``stable_window`` seconds refills the budget and cools the backoff
+    when it eventually fails — routine independent preemptions spread
+    over weeks must not exhaust a crash-loop guard.
+
+    ``master``: a MasterService/MasterClient whose ``set_epoch_fence``
+    is called on every relaunch so zombies lose task-RPC rights too.
+    """
+
+    def __init__(self, argv: Sequence[str], nprocs: int, state_dir: str, *,
+                 devices_per_proc: int = 1,
+                 cluster: bool = False,
+                 hosts: Optional[Sequence[str]] = None,
+                 replacement_hosts: Sequence[str] = (),
+                 ssh_port_base: int = 6007,
+                 ssh_cmd: Sequence[str] = ("ssh", "-o", "BatchMode=yes"),
+                 workdir: Optional[str] = None,
+                 env_extra: Optional[dict] = None,
+                 heartbeat_window: float = 10.0,
+                 wedge_window: Optional[float] = None,
+                 startup_grace: float = 120.0,
+                 poll_interval: float = 0.25,
+                 max_restarts: int = 5,
+                 stable_window: float = 300.0,
+                 backoff_base: float = 0.5,
+                 backoff_cap: float = 15.0,
+                 replacements: Optional[int] = None,
+                 min_nprocs: int = 1,
+                 valid_sizes: Optional[Sequence[int]] = None,
+                 attempt_timeout: Optional[float] = None,
+                 master=None,
+                 probe_health: bool = True,
+                 http_port: Optional[int] = None):
+        self.argv = list(argv)
+        self.state_dir = state_dir
+        self.devices_per_proc = devices_per_proc
+        self.cluster = cluster
+        self.hosts = list(hosts) if hosts is not None else None
+        self._spares = list(replacement_hosts)
+        self.ssh_port_base = ssh_port_base
+        self.ssh_cmd = tuple(ssh_cmd)
+        self.workdir = workdir
+        self.env_extra = dict(env_extra or {})
+        self.nprocs = len(self.hosts) if self.hosts is not None \
+            else int(nprocs)
+        self.heartbeat_window = heartbeat_window
+        self.wedge_window = wedge_window
+        self.startup_grace = startup_grace
+        self.poll_interval = poll_interval
+        self.max_restarts = max_restarts
+        self.stable_window = stable_window
+        self._backoff = DecorrelatedBackoff(backoff_base, backoff_cap)
+        self._replacements = replacements
+        self.min_nprocs = min_nprocs
+        self.valid_sizes = (sorted(valid_sizes, reverse=True)
+                            if valid_sizes else None)
+        self.attempt_timeout = attempt_timeout
+        self.master = master
+        self.probe_health = probe_health
+        self._state = "idle"
+        self._epoch = current_epoch(state_dir)
+        self._restarts = 0
+        self._attempts: List[dict] = []
+        self._last_probe: Dict[int, float] = {}
+        self.http = None
+        if http_port is not None:
+            from paddle_tpu.observe.health import HealthServer
+            self.http = HealthServer(health_fn=self.health,
+                                     port=http_port)
+        os.makedirs(state_dir, exist_ok=True)
+
+    # -- introspection ----------------------------------------------------
+    def health(self) -> dict:
+        workers = {}
+        for rank, rec in read_heartbeats(self.state_dir).items():
+            workers[str(rank)] = {
+                "age": round(rec.get("age", -1), 3),
+                "step": rec.get("step"),
+                "epoch": rec.get("epoch"),
+                "done": bool(rec.get("done"))}
+        return {"state": self._state, "epoch": self._epoch,
+                "gang_size": self.nprocs, "restarts": self._restarts,
+                "healthy": self._state != "failed",
+                "workers": workers}
+
+    def _set_state(self, state: str):
+        self._state = state
+        _m_state.set(STATES[state])
+
+    # -- gang lifecycle ---------------------------------------------------
+    def _spawn(self, epoch: int):
+        env = dict(self.env_extra)
+        env[ENV_DIR] = self.state_dir
+        env[ENV_EPOCH] = str(epoch)
+        if self.hosts is not None:
+            # the coordinator binds on hosts[0], so a locally-probed
+            # free_port() would be a lie — walk a per-epoch offset off
+            # ssh_port_base instead: never the previous incarnation's
+            # port (a lingering zombie there can't wedge the rebind),
+            # and deterministic for firewall rules
+            return _launch.spawn_ssh_procs(
+                self.hosts, self.argv,
+                port=self.ssh_port_base + (epoch % 64),
+                workdir=self.workdir, env_extra=env,
+                ssh_cmd=self.ssh_cmd)
+        return _launch.spawn_local_procs(
+            self.nprocs, self.argv,
+            devices_per_proc=self.devices_per_proc,
+            env_extra=env, cluster=self.cluster)
+
+    def _judge(self, procs, epoch, t_launch, attempt):
+        """One monitoring sweep. Returns (verdict, failed_ranks, reason):
+        verdict 'ok' (all exited 0), 'running', or 'fail'."""
+        now = time.time()
+        rcs = [p.poll() for p in procs]
+        failed = [r for r, rc in enumerate(rcs)
+                  if rc is not None and rc != 0]
+        if failed:
+            for r in failed:
+                _m_liveness.set(0, rank=str(r))
+            return "fail", failed, f"worker_exit:{rcs[failed[0]]}"
+        if all(rc == 0 for rc in rcs):
+            return "ok", [], None
+        hbs = read_heartbeats(self.state_dir, epoch)
+        for rank, p in enumerate(procs):
+            if p.poll() == 0:
+                continue                       # clean exit, no judgment
+            rec = hbs.get(rank)
+            if rec is None:
+                # nothing from THIS incarnation yet: jax import +
+                # compile can take a while — the startup grace bounds it
+                if now - t_launch > self.startup_grace:
+                    _m_liveness.set(0, rank=str(rank))
+                    return "fail", [rank], "no_heartbeat"
+                continue
+            if attempt.get("t_first_step") is None and "step" in rec:
+                attempt["t_first_step"] = now
+            if rec.get("done"):
+                _m_liveness.set(1, rank=str(rank))
+                continue
+            if rec.get("age", 0.0) > self.heartbeat_window:
+                _m_liveness.set(0, rank=str(rank))
+                return "fail", [rank], "heartbeat_lost"
+            _m_liveness.set(1, rank=str(rank))
+            if (self.wedge_window is not None
+                    and rec.get("step_ts") is not None
+                    and now - rec["step_ts"] > self.wedge_window):
+                return "fail", [rank], "wedged"
+            port = rec.get("health_port")
+            if (self.probe_health and port
+                    and now - self._last_probe.get(rank, 0.0) > 2.0):
+                self._last_probe[rank] = now
+                if _probe_healthz(port, rec.get("host")
+                                  or "127.0.0.1") is False:
+                    return "fail", [rank], "unhealthy"
+        if (self.attempt_timeout is not None
+                and now - t_launch > self.attempt_timeout):
+            return "fail", list(range(len(procs))), "attempt_timeout"
+        return "running", [], None
+
+    def _post_mortem(self, reason, failed_ranks, epoch):
+        """Flight-recorder artifact for this restart: the judgment, the
+        last heartbeats, and the standard config/env/metrics snapshot."""
+        from paddle_tpu import observe
+        rec = observe.default_flight_recorder()
+        rec.record({"kind": "supervisor_restart", "epoch": epoch,
+                    "reason": reason, "failed_ranks": failed_ranks,
+                    "gang_size": self.nprocs,
+                    "heartbeats": read_heartbeats(self.state_dir)})
+        rec.dump(path=os.path.join(self.state_dir, "flight",
+                                   f"restart_epoch{epoch:04d}.json"),
+                 reason=f"gang restart: {reason}")
+
+    def _next_gang(self, failed_ranks: List[int]) -> bool:
+        """Replacement-host injection / graceful shrink. Returns False
+        when the gang cannot be re-formed within min_nprocs."""
+        nfail = max(1, len(failed_ranks))
+        if self.hosts is not None:
+            dead = [self.hosts[r] for r in failed_ranks
+                    if r < len(self.hosts)] or [self.hosts[-1]]
+            for h in dead:
+                if self._spares:
+                    sub = self._spares.pop(0)
+                    log.warning("supervisor: replacing dead host %s "
+                                "with %s", h, sub)
+                    self.hosts[self.hosts.index(h)] = sub
+                else:
+                    log.warning("supervisor: no replacement for %s — "
+                                "shrinking gang", h)
+                    self.hosts.remove(h)
+            self.nprocs = len(self.hosts)
+        else:
+            covered = nfail
+            if self._replacements is not None:
+                covered = min(nfail, self._replacements)
+                self._replacements -= covered
+            short = nfail - covered
+            if short:
+                log.warning("supervisor: %d worker(s) not replaceable — "
+                            "shrinking gang %d -> %d", short,
+                            self.nprocs, self.nprocs - short)
+            self.nprocs -= short
+        if self.valid_sizes is not None:
+            snapped = next((s for s in self.valid_sizes
+                            if s <= self.nprocs), 0)
+            if snapped != self.nprocs:
+                log.warning("supervisor: snapping gang size %d -> %d "
+                            "(valid mesh sizes)", self.nprocs, snapped)
+            self.nprocs = snapped
+            if self.hosts is not None:
+                self.hosts = self.hosts[:snapped]
+        _m_gang.set(self.nprocs)
+        return self.nprocs >= self.min_nprocs
+
+    # -- the supervision loop ---------------------------------------------
+    def run(self, total_timeout: Optional[float] = None) -> dict:
+        """Supervise until success or give-up; returns a result dict:
+        ``ok``, ``reason`` (on failure), ``restarts``, ``epoch``,
+        ``attempts`` (per-incarnation history with detection and
+        first-post-restore-step timestamps — recovery_seconds rides on
+        every attempt after a restart)."""
+        t_end = (time.time() + total_timeout
+                 if total_timeout is not None else None)
+        while True:
+            epoch = current_epoch(self.state_dir) + 1
+            write_epoch(self.state_dir, epoch)
+            self._epoch = epoch
+            if self.master is not None:
+                self.master.set_epoch_fence(epoch)
+            # stale beats from the previous incarnation must not count
+            shutil.rmtree(_hb_dir(self.state_dir), ignore_errors=True)
+            self._last_probe.clear()
+            self._set_state("launching")
+            _m_gang.set(self.nprocs)
+            log.info("supervisor: launching gang epoch %d (%d workers)",
+                     epoch, self.nprocs)
+            procs = self._spawn(epoch)
+            t_launch = time.time()
+            attempt = {"epoch": epoch, "nprocs": self.nprocs,
+                       "t_launch": t_launch, "t_first_step": None}
+            self._set_state("running")
+            while True:
+                time.sleep(self.poll_interval)
+                verdict, failed, reason = self._judge(
+                    procs, epoch, t_launch, attempt)
+                if verdict != "running":
+                    break
+                if t_end is not None and time.time() > t_end:
+                    verdict, failed = "fail", list(range(len(procs)))
+                    reason = "total_timeout"
+                    break
+            t_detect = time.time()
+            if self._attempts and self._attempts[-1].get("t_detect") \
+                    and attempt["t_first_step"]:
+                rec_s = attempt["t_first_step"] \
+                    - self._attempts[-1]["t_detect"]
+                attempt["recovery_seconds"] = round(rec_s, 3)
+                _m_recovery.set(rec_s)
+            if verdict == "ok":
+                attempt["rcs"] = [p.returncode for p in procs]
+                self._attempts.append(attempt)
+                self._set_state("done")
+                log.info("supervisor: gang epoch %d completed after %d "
+                         "restart(s)", epoch, self._restarts)
+                return {"ok": True, "restarts": self._restarts,
+                        "epoch": epoch, "attempts": self._attempts}
+            attempt.update(reason=reason, failed_ranks=failed,
+                           t_detect=t_detect)
+            self._attempts.append(attempt)
+            self._set_state("teardown")
+            log.warning("supervisor: gang epoch %d failed (%s, ranks "
+                        "%s) — tearing down", epoch, reason, failed)
+            _m_restarts.inc(reason=(reason or "unknown").split(":")[0])
+            self._post_mortem(reason, failed, epoch)
+            _launch.terminate_procs(procs)
+            if (attempt["t_first_step"] is not None
+                    and t_detect - t_launch >= self.stable_window):
+                # a long-stable incarnation failing is a NEW fault, not
+                # a crash loop: refill the restart budget and cool the
+                # backoff, or a job on a preemption-prone fleet would
+                # die on its (max_restarts+1)-th independent preemption
+                self._restarts = 0
+                self._backoff.reset()
+            self._restarts += 1
+            fail_why = None
+            if reason == "total_timeout" or (
+                    t_end is not None and time.time() > t_end):
+                fail_why = "total_timeout"
+            elif self._restarts > self.max_restarts:
+                fail_why = "max_restarts"
+            elif reason == "attempt_timeout":
+                # a whole-gang timeout names no dead machine: retry the
+                # SAME gang instead of debiting N hosts/replacements
+                # for one slow incarnation
+                pass
+            elif not self._next_gang(failed):
+                fail_why = "gang_too_small"
+            if fail_why:
+                self._set_state("failed")
+                log.error("supervisor: giving up (%s) after %d "
+                          "restart(s)", fail_why, self._restarts)
+                return {"ok": False, "reason": fail_why,
+                        "restarts": self._restarts, "epoch": epoch,
+                        "attempts": self._attempts}
+            self._set_state("backoff")
+            delay = self._backoff.next()
+            log.info("supervisor: restart %d/%d in %.2fs (gang -> %d)",
+                     self._restarts, self.max_restarts, delay,
+                     self.nprocs)
+            time.sleep(delay)
+
+    def close(self):
+        if self.http is not None:
+            self.http.close()

@@ -25,6 +25,7 @@ from paddle_tpu.data_feeder import DataFeeder
 from paddle_tpu.evaluator import EvaluatorSet
 from paddle_tpu.optimizer import Optimizer
 from paddle_tpu.parameters import Parameters
+from paddle_tpu.runtime import chaos as _chaos
 from paddle_tpu.topology import LayerOutput, Topology, Value
 from paddle_tpu.utils import logger
 from paddle_tpu.utils.flags import GLOBAL_FLAGS
@@ -580,7 +581,16 @@ class SGD:
         which additionally makes resume exact: the pipeline's stream
         position rides inside every checkpoint and a restore continues
         mid-epoch on the exact next batch. 0 keeps the synchronous
-        one-batch-lookahead path."""
+        one-batch-lookahead path.
+
+        Elastic contract: under a supervisor (PADDLE_ELASTIC_DIR set by
+        ``runtime/supervisor.py``) this entry is crash-re-enterable —
+        it resumes from the latest INTACT checkpoint (torn saves are
+        skipped), heartbeats step progress to the supervisor every
+        batch, and fences every checkpoint commit on the stamped
+        coordination epoch so a zombie from a superseded gang can never
+        publish state. The chaos knob (PADDLE_TPU_CHAOS, site ``step``)
+        is honored at the top of every batch."""
         event_handler = event_handler or (lambda e: None)
         feeder = self._feeder(feeding)
         from paddle_tpu.pipeline import Pipeline
@@ -618,6 +628,15 @@ class SGD:
             observe.configure(mpath, _source="flag")
         self._check_finite = (GLOBAL_FLAGS.get("debug_nans") or
                               GLOBAL_FLAGS.get("debug_infs"))
+        # elastic supervision (runtime/supervisor.py env contract):
+        # heartbeat step progress + fence checkpoint commits on the
+        # stamped coordination epoch; both None outside a supervisor
+        hb, fence = None, None
+        import os as _os
+        if _os.environ.get("PADDLE_ELASTIC_DIR"):
+            from paddle_tpu.runtime import supervisor as _sup
+            hb = _sup.Heartbeat.from_env()
+            fence = _sup.fence_from_env()
         ckpt = None
         if checkpoint_dir is not None:
             from paddle_tpu.io import checkpoint as ckpt_io
@@ -658,15 +677,17 @@ class SGD:
                             jax.tree.map(lambda _: self.parallel.replicated(),
                                          self.parameters.state))
                 logger.info("resumed from %s (step %d)", latest, self._step)
-            ckpt = ckpt_io.AsyncCheckpointer(checkpoint_dir)
+            ckpt = ckpt_io.AsyncCheckpointer(checkpoint_dir, fence=fence)
 
         recorder = observe.default_flight_recorder()
         dumps_before = len(recorder.dumped_paths)
+        trained_ok = False
         try:
             self._train_passes(reader, num_passes, event_handler, feeder,
                                ks, log_period, ckpt,
                                GLOBAL_FLAGS.get("checkpoint_period", 0),
-                               pipe=pipe)
+                               pipe=pipe, hb=hb)
+            trained_ok = True
         except Exception as e:
             # post-mortem for any crash escaping the loop — but only
             # when a flight dir is explicitly configured (a default-on
@@ -678,6 +699,12 @@ class SGD:
                 recorder.dump(reason="exception in training loop", exc=e)
             raise
         finally:
+            if hb is not None:
+                # only a CLEAN exit is marked done (exempt from the
+                # supervisor's staleness judgments); on a crash the
+                # beacon just stops, so a process that lingers after a
+                # swallowed exception still reads heartbeat_lost
+                hb.done() if trained_ok else hb.stop()
             if ckpt is not None:
                 ckpt.close()
             if own_pipe:
@@ -725,7 +752,7 @@ class SGD:
             yield prev
 
     def _train_passes(self, reader, num_passes, event_handler, feeder, ks,
-                      log_period, ckpt, period, pipe=None):
+                      log_period, ckpt, period, pipe=None, hb=None):
         monitor = _StepMonitor(
             opt_state_bytes=self.opt_state_bytes_per_device(),
             grad_bytes=self.grad_bytes_per_device(),
@@ -751,6 +778,11 @@ class SGD:
                     break
                 feed_s = time.perf_counter() - feed_t0
                 batch_id += 1
+                # chaos site 'step': kill/hang/crash BEFORE the step
+                # executes, so "kill at step k" means exactly k steps
+                # are committed (runtime/chaos.py; no-op without the
+                # PADDLE_TPU_CHAOS env knob)
+                _chaos.maybe_trigger("step", step=self._step)
                 event_handler(events.BeginIteration(pass_id, batch_id))
                 step_fn = self._pick_train_step(feeds)
                 # feed-shape signature: params/opt/state shapes are fixed
@@ -785,6 +817,11 @@ class SGD:
                 tracker.record("train_step", sig, step_dt)
                 self._last_step_wall = time.perf_counter()
                 self._last_cost = cost
+                if hb is not None:
+                    # step-progress lease for the elastic supervisor: a
+                    # wedged worker keeps the liveness thread beating
+                    # but this step counter stalls (wedge_window)
+                    hb.beat(self._step)
                 bs = int(next(iter(feeds.values())).array.shape[0])
                 pass_examples += bs
                 _, eps = monitor.step(

@@ -13,6 +13,23 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+
+def _require_multiprocess_cpu():
+    """Several jaxlib releases accept jax.distributed.initialize on CPU
+    but die at dispatch with "Multiprocess computations aren't
+    implemented on the CPU backend". Feature-detect (one cached
+    2-process probe, launch.multiprocess_cpu_supported) and skip with
+    the reason so the slow lane is signal, not noise — the
+    single-process dryrun_multichip proofs (tests/test_parallel.py)
+    stay the tier-1 coverage for multi-chip semantics."""
+    from paddle_tpu.runtime import launch
+    if not launch.multiprocess_cpu_supported():
+        pytest.skip(
+            "this jaxlib cannot execute multi-process computations on "
+            "the CPU backend (probe failed; single-process "
+            "dryrun_multichip proofs cover the tier-1 semantics)")
+
+
 WORKER = textwrap.dedent("""
     import os, sys
     sys.path.insert(0, {repo!r})
@@ -58,6 +75,7 @@ class TestMultiProcessCluster:
     def test_two_process_psum(self, tmp_path):
         """2 processes x 4 virtual CPU devices join one cluster; a hybrid
         dcn x data mesh spans them and a global psum sees all 8 devices."""
+        _require_multiprocess_cpu()
         from paddle_tpu.runtime import launch
 
         worker = tmp_path / "worker.py"
@@ -577,6 +595,7 @@ class TestMultiProcessTransformer:
         """A full transformer LM train step (ring-attention CP x DP)
         spanning 2 processes x 4 virtual devices on a hybrid dcn mesh —
         the multi-host training capability, not just a collective."""
+        _require_multiprocess_cpu()
         from paddle_tpu.runtime import launch
 
         worker = tmp_path / "tworker.py"

@@ -1,0 +1,176 @@
+"""Kill-a-worker chaos proofs on the multi-process CPU path (slow
+lane): a SIGKILL'd gang member is detected, the gang restarts from the
+latest intact checkpoint, and the final trajectory equals an
+uninterrupted run modulo the re-executed step window; shrinking 4->2
+restores a ZeRO checkpoint RESHARDED to the smaller mesh and matches
+the same-data 2-host run from the same checkpoint.
+
+Workers are ``demos/elastic_worker.py``: independent single-process
+JAX runtimes (jaxlib cannot run cross-process CPU collectives — see
+``launch.multiprocess_cpu_supported``) training a bit-deterministic
+replicated stream, so trajectory equality is exact, not approximate."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import checkpoint as ckpt_io
+from paddle_tpu.runtime.supervisor import Supervisor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "demos", "elastic_worker.py")
+
+pytestmark = pytest.mark.slow
+
+
+def _clean_env(extra):
+    env = dict(os.environ, **{k: str(v) for k, v in extra.items()})
+    for k in ("PADDLE_ELASTIC_DIR", "PADDLE_TPU_CHAOS",
+              "PADDLE_COORDINATOR"):
+        env.pop(k, None)
+    return env
+
+
+def _run_worker_direct(out, nprocs, nb, period=2, rank=0, timeout=300):
+    """One un-supervised worker run (the reference trajectory)."""
+    env = _clean_env({
+        "PADDLE_NUM_PROCESSES": nprocs, "PADDLE_PROCESS_ID": rank,
+        "PADDLE_LOCAL_CPU_DEVICES": 4, "PADDLE_ELASTIC_EPOCH": 0,
+        "ELASTIC_OUT": out, "ELASTIC_NB": nb,
+        "PADDLE_TPU_CHECKPOINT_PERIOD": period})
+    subprocess.run([sys.executable, WORKER], env=env, check=True,
+                   timeout=timeout)
+
+
+def _supervise(out, nprocs, nb, chaos, period=2, sleep=0.05, **kw):
+    kw.setdefault("heartbeat_window", 30.0)
+    kw.setdefault("startup_grace", 180.0)
+    kw.setdefault("poll_interval", 0.2)
+    kw.setdefault("backoff_base", 0.1)
+    kw.setdefault("backoff_cap", 0.5)
+    kw.setdefault("max_restarts", 2)
+    kw.setdefault("attempt_timeout", 240.0)
+    s = Supervisor(
+        [WORKER], nprocs=nprocs, state_dir=os.path.join(out, "state"),
+        devices_per_proc=4, cluster=False,
+        env_extra={"ELASTIC_OUT": out, "ELASTIC_NB": str(nb),
+                   "ELASTIC_STEP_SLEEP": str(sleep),
+                   "PADDLE_TPU_CHECKPOINT_PERIOD": str(period),
+                   "PADDLE_TPU_CHAOS": chaos}, **kw)
+    return s, s.run(total_timeout=900)
+
+
+def _final(out, rank, epoch):
+    path = os.path.join(out, f"final_rank{rank}_epoch{epoch}.npz")
+    assert os.path.exists(path), sorted(os.listdir(out))
+    return dict(np.load(path))
+
+
+def _losses(out, rank, epoch):
+    path = os.path.join(out, f"losses_rank{rank}_epoch{epoch}.jsonl")
+    with open(path) as f:
+        return {json.loads(ln)["step"]: json.loads(ln)["loss"]
+                for ln in f if ln.strip()}
+
+
+def _assert_params_equal(a, b):
+    assert sorted(a) == sorted(b)
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=0, atol=0,
+                                   err_msg=k)
+
+
+class TestKillWorkerMidRun:
+    def test_trajectory_equals_uninterrupted_run(self, tmp_path):
+        """SIGKILL rank 1 mid-step at step 5 of 12: the supervisor
+        detects it, restarts the gang (fresh epoch), training restores
+        and completes, and the final params + post-restore loss trail
+        are EXACTLY the uninterrupted run's."""
+        ref = str(tmp_path / "ref")
+        _run_worker_direct(ref, nprocs=2, nb=12)
+
+        out = str(tmp_path / "elastic")
+        s, res = _supervise(
+            out, nprocs=2, nb=12,
+            chaos="kill@step:step=5:rank=1:epoch=1")
+        assert res["ok"], res
+        assert res["restarts"] == 1
+        assert res["attempts"][0]["reason"].startswith("worker_exit")
+        assert res["attempts"][0]["failed_ranks"] == [1]
+        # the restart left a flight post-mortem
+        assert os.listdir(os.path.join(out, "state", "flight"))
+
+        final_epoch = res["epoch"]
+        assert final_epoch == 2
+        for rank in (0, 1):
+            done = json.load(open(os.path.join(
+                out, f"done_rank{rank}_epoch{final_epoch}.json")))
+            assert done["step"] == 12
+            _assert_params_equal(_final(out, rank, final_epoch),
+                                 _final(ref, 0, 0))
+        # loss trail: every step the restarted incarnation executed
+        # matches the uninterrupted run bit-for-bit (the re-executed
+        # window is part of the overlap — determinism makes it equal)
+        ref_losses = _losses(ref, 0, 0)
+        got = _losses(out, 0, final_epoch)
+        assert got, "restarted incarnation logged no steps"
+        assert max(got) == 11                   # ran through the end
+        for step, loss in got.items():
+            np.testing.assert_allclose(loss, ref_losses[step], rtol=0,
+                                       atol=0, err_msg=f"step {step}")
+
+
+class TestShrinkFourToTwo:
+    def test_resharded_resume_matches_two_host_run(self, tmp_path):
+        """4-worker gang loses rank 3 with no replacement: the gang
+        degrades to 2 (valid_sizes snap), every survivor restores the
+        step-4 ZeRO checkpoint written under data=4 RESHARDED into
+        data=2 (meta-driven), and the continued trajectory equals a
+        plain 2-host run resumed from the very same checkpoint."""
+        seed = str(tmp_path / "seed")
+        _run_worker_direct(seed, nprocs=4, nb=4)   # checkpoint @ step 4
+        seed_ck = os.path.join(seed, "ckpt_rank0")
+        latest = ckpt_io.latest_checkpoint(seed_ck)
+        assert latest.endswith("ckpt-00000004")
+        meta = ckpt_io.checkpoint_meta(latest)
+        assert meta["zero"]["axis_size"] == 4      # the layout to reshard
+
+        out = str(tmp_path / "elastic")
+        ref = str(tmp_path / "ref")
+        for rank in range(4):
+            shutil.copytree(seed_ck, os.path.join(out, f"ckpt_rank{rank}"))
+        shutil.copytree(seed_ck, os.path.join(ref, "ckpt_rank0"))
+
+        # reference: a plain 2-host run resumed from the same checkpoint
+        # (period=100: neither scenario writes a new checkpoint before
+        # the kill, so both resume from exactly step 4)
+        _run_worker_direct(ref, nprocs=2, nb=10, period=100)
+
+        s, res = _supervise(
+            out, nprocs=4, nb=10, period=100,
+            chaos="kill@step:step=5:rank=3:epoch=1",
+            replacements=0, valid_sizes=[4, 2], min_nprocs=2)
+        assert res["ok"], res
+        assert res["restarts"] == 1
+        assert res["attempts"][1]["nprocs"] == 2   # 4 -> 2
+        final_epoch = res["epoch"]
+        for rank in (0, 1):
+            done = json.load(open(os.path.join(
+                out, f"done_rank{rank}_epoch{final_epoch}.json")))
+            assert done["step"] == 10 and done["nprocs"] == 2
+            # every survivor's replicated-compute trajectory equals the
+            # reference's (ranks are identical by construction)
+            _assert_params_equal(_final(out, rank, final_epoch),
+                                 _final(ref, 0, 0))
+        # post-restore losses equal the 2-host reference's exactly
+        got = _losses(out, 0, final_epoch)
+        ref_losses = _losses(ref, 0, 0)
+        assert got and min(got) >= 4               # resumed, not restarted
+        for step, loss in got.items():
+            np.testing.assert_allclose(loss, ref_losses[step], rtol=0,
+                                       atol=0, err_msg=f"step {step}")
