@@ -47,6 +47,19 @@ FAMILIES = {
             ("throughput.engine_paged_int8.tokens_per_sec",
              "higher", 0.25),
             ("serving_int8_speedup", "higher", 0.15),
+            # KV-quantization scoreboards (PR-12 fields; SKIP against
+            # older artifacts by design): slots-at-equal-HBM is pure
+            # dtype arithmetic (near-deterministic — tight band) and
+            # the >= 2x-fp32 contract must hold outright; the kv8
+            # throughput ratio cancels the machine like int8's; cold
+            # TTFT is wall-clock (wide band); the rel-L2 quality
+            # figures are seeded-deterministic up to backend rounding
+            ("capacity.slots_at_equal_hbm_int8", "higher", 0.02),
+            ("capacity.slots_int8_ge_2x_fp32", "true", 0.0),
+            ("serving_kv8_speedup", "higher", 0.15),
+            ("cold_prefill.ttft_p50_cold_ms", "lower", 0.35),
+            ("quality.kv_int8_rel_l2", "lower", 0.10),
+            ("quality.kv_int4_rel_l2", "lower", 0.10),
         ],
     },
     "elastic": {

@@ -20,12 +20,32 @@ levers (figures of merit: tokens/sec and decode MFU per variant):
 - ``engine_paged_int8``  — same engine, ``quantize_lm_params`` int8
   weights consumed natively by the decode step (in-scan dequant,
   1-byte weight reads per token; prefill dequantizes wholesale).
-- ``engine_paged_pallas`` — same engine, flash-decode Pallas kernel +
-  fused sampling epilogue (``ops/pallas/decode.py``), timed only where
-  the ``PADDLE_TPU_PALLAS`` policy resolves ``on`` (TPU under
-  ``auto``); off-TPU the artifact records the mode and skips the timed
-  run, and ``--smoke`` instead replays a tiny greedy trace through the
-  interpret-mode kernel asserting ids identical to the XLA path.
+- ``engine_paged_kv8``   — same engine over an int8-quantized KV POOL
+  (``kv_dtype="int8"``: write-time per-(position, head) quantization,
+  dequant fused into the gather) — the decode-side KV-stream lever:
+  throughput must hold while the pool holds ~4x the tokens per byte.
+- ``engine_paged_pallas`` — same engine, flash-decode + chunked-prefill
+  Pallas kernels + fused sampling epilogue (``ops/pallas/``), timed
+  only where the ``PADDLE_TPU_PALLAS`` policy resolves ``on`` (TPU
+  under ``auto``); off-TPU the artifact records the mode and skips the
+  timed run, and every invocation instead replays tiny greedy traces
+  through the interpret-mode kernels — fp32 AND quantized-KV pools —
+  asserting ids identical to the XLA paths.
+
+Beyond the two trace phases, three KV-quantization scoreboards:
+
+- **capacity** — slots-at-equal-HBM: at the fp32 pool's byte budget,
+  how many requests can be RESIDENT at once (admission control is the
+  pool-capacity semantic: reservation math binds, slots don't) for
+  fp32 vs int8 vs int4 pools. Figures ``slots_at_equal_hbm_*`` and the
+  ``slots_int8_ge_2x_fp32`` contract.
+- **cold_prefill** — a shared-prefix-free Poisson trace on a fresh
+  engine: ``ttft_p50_cold_ms`` isolates the chunked-prefill path with
+  zero cache hits (the TTFT half the prefill kernel targets).
+- **quality** — ``kv_int8_rel_l2`` / ``kv_int4_rel_l2``: global rel-L2
+  of quantized-pool decode logits vs the fp32 pool on a cold chunk
+  walk, asserted under ``transformer.kv_rel_l2_budget`` (the PR-5
+  tolerance-contract recipe).
 
 TWO phases, each its own trace over the same request mix:
 
@@ -175,6 +195,8 @@ def _result(variant, eng, reqs, wall, occ_slots, occ_blocks):
          "compiles": eng.compile_counts()}
     if occ_blocks:
         r.update({
+            "kv_dtype": eng.kv_dtype,
+            "kv_bytes_per_token": eng.kv_bytes_per_token,
             "blocks_total": eng.pool.num_blocks,
             "blocks_in_use_peak": int(max(occ_blocks)),
             "blocks_in_use_mean": round(float(np.mean(occ_blocks)), 1),
@@ -287,13 +309,15 @@ def _paged_programs(lens, chunk, bs, buckets):
 
 
 def paged_factory(params, cfg, *, batch, cache_len, block_size,
-                  chunk_tokens, num_blocks, tracker, pallas=None):
+                  chunk_tokens, num_blocks, tracker, pallas=None,
+                  kv_dtype=None):
     """() -> fresh PagedDecodeEngine (cold pool + prefix cache) around
     ONE jitted program pair and ONE tracker, so repeat replays reuse
     the compile cache and the compile invariant spans all of them.
     ``pallas`` pins the PADDLE_TPU_PALLAS policy for the step programs;
     ``params`` may be the quantize_lm_params int8 tree (the int8
-    serving variant)."""
+    serving variant); ``kv_dtype`` quantizes the KV pool itself
+    ("int8"/"int4" — the engine_paged_kv8 variant)."""
     import jax
 
     from paddle_tpu.models import transformer
@@ -305,19 +329,21 @@ def paged_factory(params, cfg, *, batch, cache_len, block_size,
     prefill_fn, decode_fn = sampling.paged_step_fns(cfg, block_size,
                                                     pallas=pallas)
     jpf, jdf = jax.jit(prefill_fn), jax.jit(decode_fn)
-    pool0 = transformer.init_block_pool(cfg, nb, block_size)
+    pool0 = transformer.init_block_pool(cfg, nb, block_size,
+                                        kv_dtype=kv_dtype)
     flops = _decode_step_flops(
         jdf, params, pool0, batch,
         np.zeros((batch, cache_len // block_size), np.int32))
     mode = _pallas_policy.pallas_mode(pallas)
 
     def make():
-        pool = transformer.init_block_pool(cfg, nb, block_size)
+        pool = transformer.init_block_pool(cfg, nb, block_size,
+                                           kv_dtype=kv_dtype)
         return PagedDecodeEngine(
             jpf, jdf, params, pool, batch=batch, cache_len=cache_len,
             block_size=block_size, num_blocks=nb,
             chunk_tokens=chunk_tokens, seed=0, tracker=tracker,
-            decode_flops=flops, pallas_mode=mode)
+            decode_flops=flops, pallas_mode=mode, kv_dtype=kv_dtype)
 
     return make
 
@@ -364,6 +390,241 @@ def engine_once(factory, variant, work, warm):
         f"{variant}: timed replay recompiled: "
         f"{warm} -> {eng.compile_counts()}")
     return _result(variant, eng, reqs, wall, occ_s, occ_b)
+
+
+def capacity_phase(params, cfg, *, cache_len, block_size, chunk_tokens,
+                   batch, num_blocks, vocab, seed):
+    """Slots-at-equal-HBM: at the fp32 pool's byte budget, how many
+    requests can be RESIDENT at once per KV dtype. Admission is the
+    measurement — the engine's worst-case reservation math is the
+    pool-capacity semantic (decode never stalls mid-flight, so what
+    admits is what serves) — taken as ``batch - free_slots`` after one
+    scheduler step with a saturating submit wave and slot count sized
+    past the pool's theoretical ceiling, so blocks, not slots, bind."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import transformer
+    from paddle_tpu.observe.compile_tracker import CompileTracker
+    from paddle_tpu.serving import PagedDecodeEngine
+    nb_fp = int(num_blocks if num_blocks is not None
+                else batch * (cache_len // block_size))
+    budget = nb_fp * block_size * transformer.kv_pool_bytes_per_token(
+        cfg)
+    prompt_len = min(chunk_tokens, cache_len // 2)
+    max_new = min(16, cache_len - prompt_len)
+    per_req = -(-(prompt_len + max_new) // block_size)
+    # the baseline pool stores the MODEL dtype: "fp32" on the CPU bench
+    # config, bf16 on TPU — name the keys honestly, because the >= 2x
+    # contract is only reachable against a 4-byte baseline (vs bf16 the
+    # int8+scale byte ratio is 4Dh/(2Dh+8) < 2 for every head_dim)
+    base_key = ("fp32" if jnp.dtype(cfg.dtype).itemsize >= 4
+                else jnp.dtype(cfg.dtype).name)
+    out = {"pool_bytes_budget": int(budget),
+           "prompt_tokens": prompt_len, "max_new": max_new,
+           "blocks_per_request": per_req, "baseline_kv": base_key}
+    rng = np.random.RandomState(seed + 17)
+    slots = {}
+    for kvd in (None, "int8", "int4"):
+        bytes_tok = transformer.kv_pool_bytes_per_token(cfg, kvd)
+        nb = max(int(budget // (block_size * bytes_tok)), per_req)
+        cap = nb // per_req + 2           # slots can never be binding
+        eng = PagedDecodeEngine.from_params(
+            params, cfg, batch=cap, cache_len=cache_len,
+            block_size=block_size, chunk_tokens=chunk_tokens,
+            num_blocks=nb, seed=0, kv_dtype=kvd, pallas="off",
+            tracker=CompileTracker(), decode_flops=None)
+        for _ in range(cap):
+            eng.submit(rng.randint(0, vocab, prompt_len)
+                       .astype(np.int32), max_new)
+        eng.step()                        # one admission wave
+        key = base_key if kvd is None else kvd
+        slots[key] = eng.batch - eng.free_slots
+        out[f"slots_at_equal_hbm_{key}"] = slots[key]
+        out[f"blocks_at_equal_hbm_{key}"] = nb
+        out[f"kv_bytes_per_token_{key}"] = bytes_tok
+    base = slots[base_key]
+    out["slots_int8_ratio"] = round(slots["int8"] / max(base, 1), 3)
+    out["slots_int4_ratio"] = round(slots["int4"] / max(base, 1), 3)
+    # the contract: >= 2x against an fp32 baseline (the ISSUE figure);
+    # against a narrower baseline the honest bound is the byte-ratio
+    # arithmetic itself, minus admission-granularity slack
+    byte_ratio = (out[f"kv_bytes_per_token_{base_key}"]
+                  / out["kv_bytes_per_token_int8"])
+    if base_key == "fp32":
+        out["slots_int8_ge_2x_fp32"] = bool(slots["int8"] >= 2 * base)
+        out["capacity_contract_ok"] = out["slots_int8_ge_2x_fp32"]
+    else:
+        out["capacity_contract_ok"] = bool(
+            out["slots_int8_ratio"] >= 0.9 * byte_ratio)
+    return out
+
+
+def _chunk_walk(params, cfg, prompt, kv_dtype, *, block_size,
+                chunk_tokens, pallas="off"):
+    """Cold chunk-walk of one prompt on the engine's chunk grid (the
+    same program shapes the engine compiles) into a fresh pool;
+    returns (decode-step logits at position len(prompt), pool)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import ragged
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving import default_chunk_buckets
+    bs = block_size
+    n = len(prompt)
+    pages_needed = -(-(n + 1) // bs)
+    pool = transformer.init_block_pool(cfg, pages_needed + 1, bs,
+                                       kv_dtype=kv_dtype)
+    buckets = default_chunk_buckets(chunk_tokens)
+    pages = np.arange(pages_needed + 1, dtype=np.int32)
+    off, lg = 0, None
+    while off < n:
+        c = min(n - off, chunk_tokens)
+        b = ragged.bucket_length(c, buckets)
+        padded = np.zeros((1, b), np.int32)
+        padded[0, :c] = prompt[off:off + c]
+        pv = pages[:off // bs + -(-b // bs)]
+        lg, pool = transformer.prefill_into_blocks(
+            params, pool, jnp.asarray(padded), np.int32(c),
+            jnp.asarray(pv), cfg, block_size=bs, pallas=pallas)
+        off += c
+    tok = jnp.argmax(lg, -1).astype(jnp.int32)
+    logits, _ = transformer.decode_step_paged(
+        params, pool, tok, jnp.asarray([n], jnp.int32),
+        jnp.ones((1,), bool),
+        jnp.asarray(pages[:pages_needed][None]), cfg, block_size=bs,
+        pallas=pallas)
+    return np.asarray(logits), pool
+
+
+def kv_quality_probe(params, cfg, *, block_size, chunk_tokens, vocab,
+                     seed):
+    """Global rel-L2 of quantized-pool decode logits vs the fp32 pool
+    on one cold multi-chunk prompt — recorded per dtype and ASSERTED
+    under the documented grid-noise budget, so a committed artifact
+    certifies generation quality on the host that produced it."""
+    from paddle_tpu.models import transformer
+    rng = np.random.RandomState(seed + 23)
+    prompt = rng.randint(0, vocab, 2 * chunk_tokens + 5).astype(
+        np.int32)
+    ref, _ = _chunk_walk(params, cfg, prompt, None,
+                         block_size=block_size,
+                         chunk_tokens=chunk_tokens)
+    out = {}
+    for kvd in ("int8", "int4"):
+        lg, _ = _chunk_walk(params, cfg, prompt, kvd,
+                            block_size=block_size,
+                            chunk_tokens=chunk_tokens)
+        rel = float(np.linalg.norm(lg - ref) / np.linalg.norm(ref))
+        budget = transformer.kv_rel_l2_budget(cfg, kvd)
+        assert rel < budget, (
+            f"kv_{kvd}_rel_l2 {rel:.4f} breaches the grid-noise "
+            f"budget {budget:.4f} — wrong-scale-class bug")
+        out[f"kv_{kvd}_rel_l2"] = round(rel, 6)
+        out[f"kv_{kvd}_rel_l2_budget"] = round(budget, 6)
+    return out
+
+
+def tpu_export_check(params, cfg, *, block_size, chunk_tokens, batch,
+                     cache_len):
+    """Deviceless XLA:TPU export of the paged step programs (decode +
+    one contextful chunk prefill) per KV dtype on the XLA attention
+    path — the quantized pool's scatter writes, int8/int4 gathers and
+    fused dequant all compile for TPU with no chip attached. The
+    Pallas-kernel (pallas="on") export is attempted as well and its
+    status recorded verbatim: in this jax version the Mosaic lowering
+    rejects the kernels' per-head pool-column BlockSpec (a head-major
+    pool relayout is the known fix — ROADMAP), so the honest figure is
+    the recorded diagnostic, not a green checkmark."""
+    import jax
+    import jax.export  # noqa: F401
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving import sampling
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+    bs = block_size
+    B = batch
+    P = cache_len // bs
+    p_shapes = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a),
+                                       np.asarray(a).dtype), params)
+    i32 = jax.ShapeDtypeStruct((), jnp.int32)
+    out = {}
+    for kvd in (None, "int8", "int4"):
+        key = "fp32" if kvd is None else kvd
+        pool_shapes = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            transformer.init_block_pool(cfg, B * P, bs, kv_dtype=kvd))
+        dargs = (p_shapes, pool_shapes,
+                 jax.ShapeDtypeStruct((B,), jnp.int32),
+                 jax.ShapeDtypeStruct((B,), jnp.int32),
+                 jax.ShapeDtypeStruct((B,), jnp.bool_),
+                 jax.ShapeDtypeStruct((B, P), jnp.int32),
+                 jax.ShapeDtypeStruct((B,), jnp.float32),
+                 jax.ShapeDtypeStruct((B,), jnp.int32), i32)
+        ctx_pages = chunk_tokens // bs          # one contextful chunk
+        pargs = (p_shapes, pool_shapes,
+                 jax.ShapeDtypeStruct((1, chunk_tokens), jnp.int32),
+                 i32,
+                 jax.ShapeDtypeStruct((2 * ctx_pages,), jnp.int32),
+                 jax.ShapeDtypeStruct((), jnp.float32), i32, i32)
+        pf, df = sampling.paged_step_fns(cfg, bs, pallas="off")
+        try:
+            nd = len(jax.export.export(
+                jax.jit(df), platforms=["tpu"])(*dargs).serialize())
+            np_ = len(jax.export.export(
+                jax.jit(pf), platforms=["tpu"])(*pargs).serialize())
+            out[f"xla_{key}_ok"] = True
+            out[f"xla_{key}_bytes"] = nd + np_
+        except Exception as e:                  # noqa: BLE001
+            out[f"xla_{key}_ok"] = False
+            out[f"xla_{key}_detail"] = (
+                f"{type(e).__name__}: {str(e)[:300]}")
+        # the serving dispatch keeps the kernels OUT of engine programs
+        # until they lower through Mosaic (decode.kernels_dispatchable /
+        # MOSAIC_LOWERABLE), so the honest Pallas figure is a DIRECT
+        # kernel lowering probe, not an engine-program export that
+        # would contain no kernel at all
+        from paddle_tpu.ops.pallas import decode as _fd
+        from paddle_tpu.ops.pallas import prefill as _fp
+        G = cfg.n_heads // cfg.kv_heads
+        pool = transformer.init_block_pool(cfg, B * P, bs,
+                                           kv_dtype=kvd)
+        scales = ((pool["k_scale"][0], pool["v_scale"][0])
+                  if kvd else (None, None))
+        probes = {
+            "pallas_decode": lambda: _fd.flash_decode_attention(
+                jnp.zeros((B, cfg.kv_heads, G, cfg.head_dim),
+                          jnp.float32),
+                pool["k"][0], pool["v"][0],
+                jnp.zeros((B, P), jnp.int32),
+                jnp.zeros((B,), jnp.int32), block_size=bs,
+                k_scale=scales[0], v_scale=scales[1],
+                kv_dtype=kvd or "none"),
+            "pallas_prefill": lambda: _fp.flash_chunk_prefill(
+                jnp.zeros((chunk_tokens, cfg.kv_heads, G,
+                           cfg.head_dim), jnp.float32),
+                jnp.zeros((chunk_tokens, cfg.kv_heads, cfg.head_dim),
+                          jnp.float32),
+                jnp.zeros((chunk_tokens, cfg.kv_heads, cfg.head_dim),
+                          jnp.float32),
+                pool["k"][0], pool["v"][0],
+                jnp.zeros((ctx_pages,), jnp.int32), block_size=bs,
+                k_scale=scales[0], v_scale=scales[1],
+                kv_dtype=kvd or "none"),
+        }
+        for tag, probe in probes.items():
+            try:
+                blob = jax.export.export(
+                    jax.jit(lambda p=probe: p()),
+                    platforms=["tpu"])().serialize()
+                out[f"{tag}_{key}_ok"] = True
+                out[f"{tag}_{key}_bytes"] = len(blob)
+            except Exception as e:              # noqa: BLE001
+                out[f"{tag}_{key}_ok"] = False
+                out[f"{tag}_{key}_detail"] = (
+                    f"{type(e).__name__}: {str(e)[:300]}")
+    return out
 
 
 def lockstep_factory(params, cfg, *, batch, cache_len, buckets):
@@ -509,6 +770,13 @@ def main(argv=None):
                          "dedicated latency-phase replay (Chrome-trace "
                          "JSON) and assert every completed request's "
                          "lifecycle is fully joined — no orphan spans")
+    ap.add_argument("--tpu-check", action="store_true",
+                    help="deviceless XLA:TPU export of the paged step "
+                         "programs per KV dtype (fp32/int8/int4, XLA "
+                         "attention path) — proves the quantized-pool "
+                         "writes/gathers compile for TPU without a "
+                         "chip; the Pallas-kernel export is attempted "
+                         "too and its Mosaic status recorded honestly")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny preset for the tier-1 fast test: few "
                          "requests, near-zero inter-arrival gaps")
@@ -607,12 +875,26 @@ def main(argv=None):
     params_q8 = lm_serving.quantize_lm_params(params)
     mk_int8 = paged_factory(params_q8, cfg, tracker=int8_tr,
                             pallas="off", **paged_kw)
+    # fp32-vs-int8-KV: the same engine over an int8-quantized POOL —
+    # the decode-side KV stream at 1 byte/elt (+ scale rows); XLA
+    # attention and fp32 weights either way so the figure isolates the
+    # KV storage width
+    kv8_tr = CompileTracker(storm_threshold=storm)
+    mk_kv8 = paged_factory(params, cfg, tracker=kv8_tr, pallas="off",
+                           kv_dtype="int8", **paged_kw)
     # XLA-vs-Pallas: one more paged variant with the flash-decode
     # kernel + fused sampling epilogue, run only where the policy turns
     # it on (auto = TPU; the interpreter is correctness-speed and gets
     # its own dedicated check under --smoke below)
     pallas_mode = pallas_policy.pallas_mode(args.pallas)
-    pallas_timed = pallas_mode == "on"
+    # timed only where the kernels would actually be IN the program:
+    # under the Mosaic dispatch guard (decode.kernels_dispatchable)
+    # "on" currently falls back to XLA per-site, and timing that as
+    # "engine_paged_pallas" would report a fake 1.0x kernel speedup
+    from paddle_tpu.ops.pallas import decode as _pallas_decode_mod
+    pallas_timed = (pallas_mode == "on"
+                    and _pallas_decode_mod.kernels_dispatchable(
+                        pallas_mode))
     pallas_tr = CompileTracker(storm_threshold=storm)
     mk_pallas = paged_factory(params, cfg, tracker=pallas_tr,
                               pallas=args.pallas, **paged_kw) \
@@ -628,12 +910,14 @@ def main(argv=None):
         engines = [("engine_paged", mk_paged),
                    ("engine_slots", mk_slots)]
         if phase == "throughput":
-            # the capacity phase carries the kernel/int8 A/Bs (their
-            # figures of merit are tokens/sec and decode MFU)
+            # the throughput phase carries the kernel/int8/kv8 A/Bs
+            # (their figures of merit are tokens/sec and decode MFU)
             if mk_pallas is not None:
                 engines.insert(1, ("engine_paged_pallas", mk_pallas))
             engines.insert(len(engines) - 1,
                            ("engine_paged_int8", mk_int8))
+            engines.insert(len(engines) - 1,
+                           ("engine_paged_kv8", mk_kv8))
         warms = {name: warm_engine(mk, work, args.vocab)
                  for name, mk in engines}
         lk_warm(work)
@@ -675,7 +959,8 @@ def main(argv=None):
                                args.block_size,
                                default_chunk_buckets(chunk))
     for name, tr, want in (("paged", paged_tr, progs),
-                           ("int8", int8_tr, progs_tp)) + (
+                           ("int8", int8_tr, progs_tp),
+                           ("kv8", kv8_tr, progs_tp)) + (
             (("pallas", pallas_tr, progs_tp),) if pallas_timed else ()):
         assert tr.count("serving_engine.decode") == 1, name
         assert tr.count("serving_engine.prefill") == len(want), (
@@ -685,32 +970,115 @@ def main(argv=None):
     assert slots_tr.count("serving_engine.decode") == 1
     assert slots_tr.count("serving_engine.prefill") <= len(buckets)
 
-    # the interpret-mode flash-decode + fused-sampling path must not
-    # rot on CPU-only CI: replay a tiny greedy trace on a
-    # pallas=interpret engine and demand ids identical to the XLA
-    # engine's (greedy sampling is exact on both paths). Runs under
-    # --smoke (tier-1) AND in the full bench, so the committed artifact
-    # certifies the kernel on the host that produced it.
-    ptr = CompileTracker(storm_threshold=storm)
-    mk_interp = paged_factory(params, cfg, tracker=ptr,
-                              pallas="interpret", **paged_kw)
+    # the interpret-mode kernels must not rot on CPU-only CI: replay a
+    # tiny greedy trace on pallas=interpret engines and demand ids
+    # identical to the XLA engines' (greedy sampling is exact on both
+    # paths). One prompt exceeds chunk_tokens so the CHUNKED-PREFILL
+    # kernel runs with real context; the second pass repeats the whole
+    # check over an int8-KV pool, so the FUSED-DEQUANT reads (decode +
+    # prefill) are certified too. Runs under --smoke (tier-1) AND in
+    # the full bench.
     srng = np.random.RandomState(11)
+    n_long = min(chunk + 5, args.cache_len - 8)
     tiny = [srng.randint(0, args.vocab, n).astype(np.int32)
-            for n in (5, 9)]
-    out_interp, out_xla = [], []
-    for mk, sink in ((mk_interp, out_interp), (mk_paged, out_xla)):
-        eng = mk()
-        reqs = [eng.submit(p, max_new=4) for p in tiny]
-        eng.run_until_idle()
-        sink.extend(r.output.tolist() for r in reqs)
-    assert out_interp == out_xla, (
-        "pallas interpret decode diverged from the XLA path:\n"
-        f"{out_interp}\nvs\n{out_xla}")
-    results["pallas"]["interpret_check_ok"] = True
-    line = {"bench": "serving", "phase": "pallas_interpret_check",
-            "mode": "interpret", "requests": len(tiny), "ok": True}
+            for n in (5, 9, n_long)]
+    for kvd in (None, "int8"):
+        # XLA side reuses the throughput factories' compiled programs
+        # (mk_paged / mk_kv8 are the same config at pallas="off"); the
+        # compile-invariant asserts above already ran, so the tiny
+        # replay's extra chunk shapes cannot contaminate them
+        interp_tr = CompileTracker(storm_threshold=storm)
+        variant_mks = [
+            paged_factory(params, cfg, tracker=interp_tr,
+                          pallas="interpret", kv_dtype=kvd, **paged_kw),
+            mk_paged if kvd is None else mk_kv8]
+        outs = []
+        for mk in variant_mks:
+            eng = mk()
+            reqs = [eng.submit(p, max_new=4) for p in tiny]
+            eng.run_until_idle()
+            outs.append([r.output.tolist() for r in reqs])
+        assert outs[0] == outs[1], (
+            f"pallas interpret (kv_dtype={kvd}) diverged from the "
+            f"XLA path:\n{outs[0]}\nvs\n{outs[1]}")
+        key = ("interpret_check_ok" if kvd is None
+               else f"interpret_check_kv{kvd[3:]}_ok")
+        results["pallas"][key] = True
+        line = {"bench": "serving", "phase": "pallas_interpret_check",
+                "mode": "interpret", "kv_dtype": kvd or "none",
+                "requests": len(tiny), "ok": True}
+        print(json.dumps(line), flush=True)
+        metrics_write(**line)
+
+    # KV-quantization scoreboards: slots-at-equal-HBM (capacity),
+    # cold-prefill TTFT (no cache hits — the chunked-prefill path
+    # isolated), and the rel-L2 quality contracts
+    results["capacity"] = capacity_phase(
+        params, cfg, cache_len=args.cache_len,
+        block_size=args.block_size, chunk_tokens=args.chunk_tokens,
+        batch=args.batch, num_blocks=args.num_blocks, vocab=args.vocab,
+        seed=args.seed)
+    line = {"bench": "serving", "phase": "capacity",
+            "platform": jax.default_backend(), **results["capacity"]}
     print(json.dumps(line), flush=True)
     metrics_write(**line)
+    assert results["capacity"]["capacity_contract_ok"], (
+        "int8-KV pool capacity fell short of its contract (2x vs an "
+        "fp32 baseline; the byte-ratio bound vs a narrower one): "
+        f"{results['capacity']}")
+
+    work_cold = build_workload(
+        args.requests, args.rate, prompt_lens, max_news, args.vocab,
+        args.seed + 2, shared_frac=0.0, shared_len=0)
+    cold_variants = [("xla", "off")] + (
+        [("pallas", args.pallas)] if pallas_timed else [])
+    results["cold_prefill"] = {"requests": args.requests,
+                               "rate": args.rate}
+    for cname, cmode in cold_variants:
+        cold_tr = CompileTracker(storm_threshold=storm)
+        mk_cold = paged_factory(params, cfg, tracker=cold_tr,
+                                pallas=cmode, **paged_kw)
+        warm_cold = warm_engine(mk_cold, work_cold, args.vocab)
+        best_cold = None
+        for _ in range(repeats):
+            r = engine_once(mk_cold, f"engine_paged_cold_{cname}",
+                            work_cold, warm_cold)
+            if best_cold is None or r["ttft_p50_s"] < \
+                    best_cold["ttft_p50_s"]:
+                best_cold = r
+        suffix = "" if cname == "xla" else "_pallas"
+        results["cold_prefill"][f"ttft_p50_cold_ms{suffix}"] = round(
+            best_cold["ttft_p50_s"] * 1000, 3)
+        results["cold_prefill"][f"ttft_p99_cold_ms{suffix}"] = round(
+            best_cold["ttft_p99_s"] * 1000, 3)
+    line = {"bench": "serving", "phase": "cold_prefill",
+            "platform": jax.default_backend(),
+            **results["cold_prefill"]}
+    print(json.dumps(line), flush=True)
+    metrics_write(**line)
+
+    results["quality"] = kv_quality_probe(
+        params, cfg, block_size=args.block_size,
+        chunk_tokens=args.chunk_tokens, vocab=args.vocab,
+        seed=args.seed)
+    line = {"bench": "serving", "phase": "kv_quality",
+            **results["quality"]}
+    print(json.dumps(line), flush=True)
+    metrics_write(**line)
+
+    if args.tpu_check:
+        results["tpu_check"] = tpu_export_check(
+            params, cfg, block_size=args.block_size,
+            chunk_tokens=args.chunk_tokens, batch=args.batch,
+            cache_len=args.cache_len)
+        line = {"bench": "serving", "phase": "tpu_check",
+                **{k: v for k, v in results["tpu_check"].items()
+                   if not k.endswith("_detail")}}
+        print(json.dumps(line), flush=True)
+        metrics_write(**line)
+        assert all(results["tpu_check"][f"xla_{d}_ok"]
+                   for d in ("fp32", "int8", "int4")), \
+            results["tpu_check"]
 
     # dedicated attribution replay: one more latency-phase run on a
     # fresh paged engine with request-lifecycle tracing captured — the
@@ -750,12 +1118,20 @@ def main(argv=None):
                   / max(lat["engine_slots"]["ttft_p99_s"], 1e-9))
     int8_speedup = (tp["engine_paged_int8"]["tokens_per_sec"]
                     / max(tp["engine_paged"]["tokens_per_sec"], 1e-9))
+    kv8_speedup = (tp["engine_paged_kv8"]["tokens_per_sec"]
+                   / max(tp["engine_paged"]["tokens_per_sec"], 1e-9))
     figures = [("serving_paged_speedup", speedup),
                ("serving_paged_ttft_p99_ratio", ttft_ratio),
                # int8-vs-fp32 on the SAME engine: >1 where weight reads
                # bound decode (TPU); CPU pays the dequant ALU instead
                # and reports honestly below 1
-               ("serving_int8_speedup", int8_speedup)]
+               ("serving_int8_speedup", int8_speedup),
+               # int8-KV-pool vs fp32-pool throughput on the SAME
+               # engine: ~1 on CPU (the dequant ALU offsets the byte
+               # win); TPU is where the KV-stream-bound step pays. The
+               # capacity win (slots_at_equal_hbm) is dtype-arithmetic
+               # and holds everywhere.
+               ("serving_kv8_speedup", kv8_speedup)]
     if "engine_paged_pallas" in tp:
         figures.append((
             "serving_pallas_speedup",
@@ -769,9 +1145,18 @@ def main(argv=None):
         metrics_write(**line)
         results[metric] = round(value, 3)
 
-    out = args.out or os.path.join(
-        REPO, "benchmarks", "runs",
-        f"{datetime.date.today()}_serving_paged.json")
+    out = args.out
+    if out is None:
+        # same-day reruns get an ordering-preserving _b/_c suffix
+        # instead of overwriting the artifact the regression sentinel
+        # compares against (the zero_bench convention)
+        base = os.path.join(REPO, "benchmarks", "runs",
+                            f"{datetime.date.today()}_serving_paged")
+        out = base + ".json"
+        i = 0
+        while os.path.exists(out) and not args.smoke:
+            i += 1
+            out = f"{base}_{chr(ord('a') + i)}.json"
     if args.out or not args.smoke:
         os.makedirs(os.path.dirname(out), exist_ok=True)
         with open(out, "w") as f:

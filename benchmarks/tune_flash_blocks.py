@@ -13,10 +13,18 @@ tile axis is the kernel's page-gather granularity, consulted at
 dispatch when the advisory block size matches the pool actually
 handed over (analytic VMEM-budget default otherwise).
 
+``--prefill`` sweeps the chunked-PREFILL kernel
+(``ops.pallas.prefill.flash_chunk_prefill``) over (chunk tokens x
+block size x ctx pages-per-tile) per (context span, head_dim, dtype) —
+paste winners into ops/pallas/prefill.py MEASURED_PREFILL. Same
+advisory-only selection semantics as --decode. ``--dtypes`` may name
+the quantized pool storages ``int8``/``int4`` to sweep the
+fused-dequant gather.
+
 Usage: python benchmarks/tune_flash_blocks.py [--seqs 2048,8192]
        [--head-dims 64,128] [--dtypes bfloat16,float32] [--iters 20]
-       [--decode] [--slots 8] [--kv-heads 8] [--q-per-kv 1]
-       [--interpret]
+       [--decode | --prefill] [--chunks 64,128] [--slots 8]
+       [--kv-heads 8] [--q-per-kv 1] [--interpret]
 """
 
 import argparse
@@ -157,6 +165,101 @@ def decode_sweep(args):
         print(f"    {k}: {v},")
 
 
+def prefill_sweep(args):
+    """Chunked-prefill (chunk, block size, ctx pages-per-tile) sweep:
+    one chunk of C tokens attends against ``span`` resident context
+    tokens gathered straight off a scrambled pool; the timed call is
+    the attention kernel alone (the span-write kernel is
+    tiling-independent). ``--dtypes int8,int4`` times the fused-dequant
+    gather off quantized pools."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas import prefill as fpf
+    from paddle_tpu.utils.sync import host_sync
+
+    rng = np.random.RandomState(0)
+    Hkv, G = args.kv_heads, args.q_per_kv
+    results = {}
+    for span, chunk, d, dname in itertools.product(
+            (int(s) for s in args.seqs.split(",")),
+            (int(c) for c in args.chunks.split(",")),
+            (int(s) for s in args.head_dims.split(",")),
+            args.dtypes.split(",")):
+        quant = dname in ("int8", "int4")
+        dtype = jnp.int8 if quant else jnp.dtype(dname)
+        C = chunk
+        q = jnp.asarray(rng.randn(C, Hkv, G, d), jnp.float32)
+        kck = jnp.asarray(rng.randn(C, Hkv, d), jnp.float32)
+        vck = jnp.asarray(rng.randn(C, Hkv, d), jnp.float32)
+        best = None
+        for bs in (8, 16, 32, 64, 128):
+            if span % bs:
+                continue
+            P_ctx = span // bs
+            M = args.slots * span             # pool at arena parity
+            if not fpf.prefill_kernel_fits(
+                    M, span, C, G, d, dtype,
+                    kv_dtype=dname if quant else "none"):
+                print(f"  span={span} C={C} d={d} {dname} bs={bs}: "
+                      f"VMEM over budget, skipped", flush=True)
+                continue
+            d_st = d // 2 if dname == "int4" else d
+            if quant:
+                k = jnp.asarray(rng.randint(-127, 128, (M, Hkv, d_st)),
+                                jnp.int8)
+                v = jnp.asarray(rng.randint(-127, 128, (M, Hkv, d_st)),
+                                jnp.int8)
+                ks = jnp.asarray(rng.rand(M, Hkv), jnp.float32)
+                vs = jnp.asarray(rng.rand(M, Hkv), jnp.float32)
+            else:
+                k = jnp.asarray(rng.randn(M, Hkv, d), dtype)
+                v = jnp.asarray(rng.randn(M, Hkv, d), dtype)
+                ks = vs = None
+            pages = jnp.asarray(
+                rng.permutation(M // bs)[:P_ctx].astype(np.int32))
+            for tile in (1, 2, 4, 8):
+                if P_ctx % tile:
+                    continue
+                try:
+                    f = jax.jit(lambda q_, kc, vc, k_, v_, pg, bs=bs,
+                                tile=tile, ks=ks, vs=vs:
+                                fpf.flash_chunk_prefill(
+                                    q_, kc, vc, k_, v_, pg,
+                                    block_size=bs, tile=tile,
+                                    k_scale=ks, v_scale=vs,
+                                    kv_dtype=dname if quant
+                                    else "none",
+                                    interpret=args.interpret))
+                    host_sync(f(q, kck, vck, k, v, pages))
+                    t0 = time.time()
+                    out = None
+                    for _ in range(args.iters):
+                        out = f(q, kck, vck, k, v, pages)
+                    host_sync(out)
+                    dt = (time.time() - t0) / args.iters
+                except Exception as e:               # noqa: BLE001
+                    print(f"  span={span} C={C} d={d} {dname} bs={bs} "
+                          f"tile={tile}: FAILED "
+                          f"{type(e).__name__}: {e}", flush=True)
+                    continue
+                print(f"  span={span} C={C} d={d} {dname} bs={bs} "
+                      f"tile={tile}: {dt * 1e6:.0f} us/chunk "
+                      f"({C / dt:.0f} tok/s)", flush=True)
+                if best is None or dt < best[0]:
+                    best = (dt, bs, tile)
+        if best:
+            sb = 1 << max(0, (span - 1)).bit_length()
+            cb = 1 << max(0, (chunk - 1)).bit_length()
+            results[(sb, cb, d, dname)] = (best[1], best[2])
+            print(f"BEST span={span} C={C} d={d} {dname}: "
+                  f"({best[1]}, {best[2]})", flush=True)
+    print("\nMEASURED_PREFILL entries:")
+    for k_, v_ in sorted(results.items()):
+        print(f"    {k_}: {v_},")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seqs", default="1024,2048,4096,8192",
@@ -169,6 +272,11 @@ def main():
     ap.add_argument("--decode", action="store_true",
                     help="sweep the flash-decode kernel's (block size, "
                          "kv-page tile) instead of attention blocks")
+    ap.add_argument("--prefill", action="store_true",
+                    help="sweep the chunked-prefill kernel's (chunk, "
+                         "block size, ctx pages-per-tile) instead")
+    ap.add_argument("--chunks", default="64,128",
+                    help="--prefill: chunk sizes (tokens) to sweep")
     ap.add_argument("--slots", type=int, default=8,
                     help="--decode: concurrent decode slots (B)")
     ap.add_argument("--kv-heads", type=int, default=8,
@@ -179,8 +287,12 @@ def main():
                     help="--decode: run the kernel interpreted "
                          "(plumbing check off-TPU; timings meaningless)")
     args = ap.parse_args()
+    if args.decode and args.prefill:
+        ap.error("--decode and --prefill are separate sweeps")
     if args.decode:
         decode_sweep(args)
+    elif args.prefill:
+        prefill_sweep(args)
     else:
         attention_sweep(args)
 

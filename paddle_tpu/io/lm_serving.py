@@ -110,7 +110,8 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
                      engine_buckets: Optional[Sequence[int]] = None,
                      engine_paged: bool = False,
                      engine_block_size: int = 16,
-                     engine_num_blocks: Optional[int] = None
+                     engine_num_blocks: Optional[int] = None,
+                     engine_kv_dtype: Optional[str] = None
                      ) -> None:
     """Export the serving pair at fixed shapes and pack the artifact.
 
@@ -138,6 +139,13 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
     tokens. ``LMServer.engine()`` then schedules a
     ``serving.PagedDecodeEngine`` (chunked prefill + prefix cache)
     over them; v3 artifacts keep loading into the legacy slot engine.
+    ``engine_kv_dtype`` ("int8"/"int4", paged only) exports the engine
+    modules over a QUANTIZED pool (``transformer.init_block_pool``
+    kv_dtype semantics: int8 / nibble-packed values + per-(position,
+    head) fp32 scale tables): the stamp lands in
+    ``meta.engine_paged.kv_dtype`` so the loader rebuilds the exact
+    pool layout with no model code, and the compiled modules carry the
+    write-time quantization + fused-dequant reads.
     """
     import jax
     import jax.export  # noqa: F401 — jax.export needs an explicit import
@@ -148,6 +156,13 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
     if cache_len > cfg.max_len:
         raise ValueError(f"cache_len {cache_len} exceeds cfg.max_len "
                          f"{cfg.max_len}")
+    if engine_kv_dtype and not engine_paged:
+        # checked up front, NOT inside the engine-export branch: an
+        # export that silently dropped the requested quantized pool
+        # would only be discovered at serve time
+        raise ValueError("engine_kv_dtype needs engine_paged=True "
+                         "(the quantized pool is a paged-engine "
+                         "layout)")
 
     if weights_int8:
         params = quantize_lm_params(params)
@@ -236,12 +251,14 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
             engine_paged_meta = {"block_size": bs, "num_blocks": nb,
                                  "pages_per_slot": pages,
                                  "chunk_tokens": chunk,
-                                 "pallas": engine_pallas}
+                                 "pallas": engine_pallas,
+                                 "kv_dtype": engine_kv_dtype or "none"}
             eng_prefill, eng_decode = _sampling.paged_step_fns(
                 cfg, bs, dequant=dequant)
             pool_shapes = jax.tree_util.tree_map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-                transformer.init_block_pool(cfg, nb, bs))
+                transformer.init_block_pool(
+                    cfg, nb, bs, kv_dtype=engine_kv_dtype))
             # one chunk-prefill module per (bucket, context span) the
             # fixed chunk grid can reach: a chunk's context length is
             # encoded in its page-vector SHAPE (span specialization —
@@ -447,13 +464,18 @@ class LMServer:
                 return prefills[key](params, pool, tokens, length,
                                      pagevec, *rest)
 
-            # zero-filled block pool straight from the meta (no model
-            # code — config + pool geometry determine the shape)
-            shape = (cfg.n_layers,
-                     paged["num_blocks"] * paged["block_size"],
-                     cfg.kv_heads, cfg.head_dim)
-            pool = {"k": jnp.zeros(shape, cfg.dtype),
-                    "v": jnp.zeros(shape, cfg.dtype)}
+            # zero-filled block pool from the meta geometry + kv_dtype
+            # stamp, built by the SAME constructor the export shaped
+            # the modules against (one source of truth for the pool
+            # layout — the loader already imports the transformer
+            # module for TransformerConfig, so this adds no dependency)
+            from paddle_tpu.models import transformer
+            kvd = paged.get("kv_dtype", "none")
+            if kvd == "none":
+                kvd = None
+            pool = transformer.init_block_pool(
+                cfg, paged["num_blocks"], paged["block_size"],
+                kv_dtype=kvd)
             return PagedDecodeEngine(
                 prefill, decode, self.params, pool,
                 batch=self.meta["batch"],
@@ -465,7 +487,8 @@ class LMServer:
                 registry=registry, tracker=tracker,
                 decode_flops=self.cost_analysis.get(
                     "engine_decode", {}).get("flops"),
-                pallas_mode=self.meta.get("engine_pallas"))
+                pallas_mode=self.meta.get("engine_pallas"),
+                kv_dtype=kvd)
         if chunk_tokens is not None:
             raise ValueError(
                 f"chunk_tokens={chunk_tokens}: this artifact (format "

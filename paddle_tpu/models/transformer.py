@@ -474,17 +474,90 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
 
 
 def init_block_pool(cfg: TransformerConfig, num_blocks: int,
-                    block_size: int):
+                    block_size: int, kv_dtype: Optional[str] = None):
     """Paged KV pool for the block-table decode engine:
     [L, num_blocks * block_size, kv_heads, Dh] per k/v. Block ``i`` owns
     the aligned span ``[i*block_size, (i+1)*block_size)`` of the flat
     position axis; per-slot page tables (``serving/blocks.BlockPool``)
     map logical positions onto blocks, so HBM is committed per BLOCK
-    actually written instead of ``cache_len`` per arena row."""
-    shape = (cfg.n_layers, int(num_blocks) * int(block_size),
-             cfg.kv_heads, cfg.head_dim)
-    return {"k": jnp.zeros(shape, cfg.dtype),
-            "v": jnp.zeros(shape, cfg.dtype)}
+    actually written instead of ``cache_len`` per arena row.
+
+    ``kv_dtype`` picks the pool storage width. ``None`` keeps the model
+    dtype ({"k","v"} only — the original layout). ``"int8"`` stores k/v
+    as symmetric int8 with one fp32 scale per (layer, position, head)
+    in ``k_scale``/``v_scale`` tables [L, M, kv_heads] that ride
+    BLOCK-major beside the pool — the page table indexes values and
+    scales alike, so scales travel with their block under any paging.
+    ``"int4"`` packs two nibbles per byte ([..., Dh//2] storage, same
+    scale layout). Scales are per pool ROW (write-local): a decode step
+    writing one token never rescales a block's resident neighbours,
+    which is what keeps hit-replay bitwise and blocks relocatable."""
+    M = int(num_blocks) * int(block_size)
+    if kv_dtype in (None, "none"):
+        shape = (cfg.n_layers, M, cfg.kv_heads, cfg.head_dim)
+        return {"k": jnp.zeros(shape, cfg.dtype),
+                "v": jnp.zeros(shape, cfg.dtype)}
+    from paddle_tpu.ops import q8 as ops_q8
+    if kv_dtype not in ops_q8.KV_DTYPES:
+        raise ValueError(f"kv_dtype {kv_dtype!r}: one of "
+                         f"{(None,) + ops_q8.KV_DTYPES}")
+    Dh = cfg.head_dim
+    if kv_dtype == "int4":
+        if Dh % 2:
+            raise ValueError(f"int4 KV packs nibble pairs: head_dim "
+                             f"{Dh} must be even")
+        Dh = Dh // 2
+    shape = (cfg.n_layers, M, cfg.kv_heads, Dh)
+    sshape = (cfg.n_layers, M, cfg.kv_heads)
+    return {"k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(sshape, jnp.float32),
+            "v_scale": jnp.zeros(sshape, jnp.float32)}
+
+
+def pool_kv_dtype(cache, cfg: TransformerConfig) -> str:
+    """The KV storage width a pool pytree carries: ``"none"`` (model
+    dtype), ``"int8"``, or ``"int4"`` — inferred from the pytree
+    structure so the step functions need no extra argument and jit
+    re-specializes automatically when the pool layout changes."""
+    if "k_scale" not in cache:
+        return "none"
+    return "int4" if cache["k"].shape[-1] == cfg.head_dim // 2 \
+        and cfg.head_dim > 1 else "int8"
+
+
+def kv_pool_bytes_per_token(cfg: TransformerConfig,
+                            kv_dtype: Optional[str] = None) -> int:
+    """HBM bytes ONE resident token costs across all layers (k + v +
+    scale rows) — the ``engine_kv_bytes_per_token`` gauge and the
+    slots-at-equal-HBM arithmetic in ``serving_bench``."""
+    Hkv, Dh = cfg.kv_heads, cfg.head_dim
+    if kv_dtype in (None, "none"):
+        per = 2 * Hkv * Dh * jnp.dtype(cfg.dtype).itemsize
+    elif kv_dtype == "int8":
+        per = 2 * Hkv * Dh + 2 * Hkv * 4
+    elif kv_dtype == "int4":
+        per = 2 * Hkv * (Dh // 2) + 2 * Hkv * 4
+    else:
+        raise ValueError(f"kv_dtype {kv_dtype!r}")
+    return cfg.n_layers * per
+
+
+def kv_rel_l2_budget(cfg: TransformerConfig, kv_dtype: str) -> float:
+    """Global rel-L2 budget for decode logits off a quantized pool vs
+    the fp32 pool — the PR-5 tolerance-contract recipe. Symmetric
+    rounding injects at most ``0.5/qmax`` relative noise per KV element
+    (0.5/127 for int8, 0.5/7 for int4); each layer reads quantized K
+    (score perturbation, softmax-damped) and quantized V (weighted-sum
+    perturbation) — 2L independent noise injections that compound in
+    quadrature through the residual stream, so the noise reaching the
+    logits is ~``sqrt(2L) * 0.5/qmax``. Budget = 2x that (slack for
+    unlucky alignment and the softmax nonlinearity, never enough to
+    excuse a wrong-scale bug, which lands at O(1) — measured on the
+    test config: int8 ~0.2% vs budget 1.6%, int4 ~4% vs 29%)."""
+    from paddle_tpu.ops import q8 as ops_q8
+    half_step = 0.5 / ops_q8.KV_QMAX[kv_dtype]
+    return min(0.5, 2.0 * math.sqrt(2 * cfg.n_layers) * half_step)
 
 
 def prefill(params, tokens: jax.Array, cfg: TransformerConfig,
@@ -737,7 +810,18 @@ def decode_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
     k/v stays the same scatter on either engine. ``params`` may carry
     int8 weights ({"q8","scale"} nodes): they ride the layer scan as
     int8 xs and dequantize inside the body (``_live_layer_weights``
-    anti-hoist defenses), so serving reads weights at 1 byte/elt."""
+    anti-hoist defenses), so serving reads weights at 1 byte/elt.
+
+    QUANTIZED pools (``init_block_pool(kv_dtype="int8"/"int4")``,
+    detected from the pytree): the step quantizes its new k/v row at
+    write time (one scale per (row, head) — ``ops/q8.quantize_kv``)
+    and scatters values AND scale rows with the same mode="drop"
+    isolation; reads gather int8/nibble-packed rows plus their scales
+    and widen in the consumer (XLA path) or in-register inside the
+    kernel's gather loop (Pallas path) — history crosses HBM at 1 or
+    1/2 byte/elt, and the fused-dequant kernel stays bitwise the XLA
+    quantized path (tests/test_kv_quant.py)."""
+    from paddle_tpu.ops import q8 as ops_q8
     from paddle_tpu.ops.pallas import decode as _pallas_decode
     from paddle_tpu.ops.pallas import policy as _pallas_policy
     B = tokens.shape[0]
@@ -749,10 +833,13 @@ def decode_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
     kvd = Hkv * Dh
     M = cache["k"].shape[1]
     quantized = _blocks_quantized(params)
+    kvq = pool_kv_dtype(cache, cfg)       # "none" | "int8" | "int4"
     mode = _pallas_policy.pallas_mode(pallas)
-    use_pallas = mode != "off"
+    # dispatchable (backend + Mosaic status) AND the VMEM budget: both
+    # fall back to the pure-XLA path below rather than failing compile
+    use_pallas = _pallas_decode.kernels_dispatchable(mode)
     if use_pallas and mode == "on" and not _pallas_decode.decode_kernel_fits(
-            M, P, bs, H // Hkv, Dh, cache["k"].dtype):
+            M, P, bs, H // Hkv, Dh, cache["k"].dtype, kv_dtype=kvq):
         use_pallas = False          # pure-XLA fallback rather than an
         #                             opaque Mosaic VMEM failure
     pos = jnp.asarray(pos, jnp.int32)
@@ -775,7 +862,11 @@ def decode_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
               <= pos[:, None])                           # [B, T] logical
 
     def block(x, scanned):
-        w, li, kc, vc = scanned              # kc/vc [M, Hkv, Dh]
+        if kvq != "none":
+            w, li, kc, vc, ksc, vsc = scanned  # + scales [M, Hkv]
+        else:
+            w, li, kc, vc = scanned            # kc/vc [M, Hkv, Dh]
+            ksc = vsc = None
         if quantized:
             w = _live_layer_weights(w, li)
         h = _layer_norm(x, w["ln1"], w["ln1_b"])
@@ -786,27 +877,49 @@ def decode_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
                 B, H * Dh)
             k = _rope_rows(k.reshape(B, Hkv, Dh), rope_tabs).reshape(
                 B, kvd)
-        kc = kc.at[widx].set(k.reshape(B, Hkv, Dh).astype(kc.dtype),
-                             mode="drop")
-        vc = vc.at[widx].set(v.reshape(B, Hkv, Dh).astype(vc.dtype),
-                             mode="drop")
+        if kvq != "none":
+            # write-time quantization: one scale per (row, head); the
+            # same scatter discipline drops inactive rows for values
+            # AND scales, so isolation holds for both tables
+            kq, ks_new = ops_q8.quantize_kv(k.reshape(B, Hkv, Dh), kvq)
+            vq, vs_new = ops_q8.quantize_kv(v.reshape(B, Hkv, Dh), kvq)
+            kc = kc.at[widx].set(kq, mode="drop")
+            vc = vc.at[widx].set(vq, mode="drop")
+            ksc = ksc.at[widx].set(ks_new, mode="drop")
+            vsc = vsc.at[widx].set(vs_new, mode="drop")
+        else:
+            kc = kc.at[widx].set(k.reshape(B, Hkv, Dh).astype(kc.dtype),
+                                 mode="drop")
+            vc = vc.at[widx].set(v.reshape(B, Hkv, Dh).astype(vc.dtype),
+                                 mode="drop")
         g = H // Hkv
         if use_pallas:
             # the kernel reads the just-written pool (pos attends to
-            # itself) and resolves gidx's page walk internally
+            # itself) and resolves gidx's page walk internally; for
+            # quantized pools the dequant multiply runs in-register on
+            # the streamed blocks (int8/int4 HBM reads)
             attn = _pallas_decode.flash_decode_attention(
                 q.reshape(B, Hkv, g, Dh), kc, vc, pages, pos,
-                block_size=bs, interpret=(mode == "interpret"))
+                block_size=bs, k_scale=ksc, v_scale=vsc, kv_dtype=kvq,
+                interpret=(mode == "interpret"))
         else:
-            kt = jnp.take(kc, gidx, axis=0)  # [B, T, Hkv, Dh] logical
-            vt = jnp.take(vc, gidx, axis=0)
+            if kvq != "none":
+                # gather int8 rows + their scales, widen in the consumer
+                # (the dequant chain the Pallas kernel replicates)
+                kt = ops_q8.dequantize_kv(
+                    jnp.take(kc, gidx, axis=0),
+                    jnp.take(ksc, gidx, axis=0), kvq)
+                vt = ops_q8.dequantize_kv(
+                    jnp.take(vc, gidx, axis=0),
+                    jnp.take(vsc, gidx, axis=0), kvq)
+            else:
+                kt = jnp.take(kc, gidx, axis=0).astype(jnp.float32)
+                vt = jnp.take(vc, gidx, axis=0).astype(jnp.float32)
             q32 = q.reshape(B, Hkv, g, Dh).astype(jnp.float32)
-            s = jnp.einsum("bkgd,btkd->bkgt", q32,
-                           kt.astype(jnp.float32)) / math.sqrt(Dh)
+            s = jnp.einsum("bkgd,btkd->bkgt", q32, kt) / math.sqrt(Dh)
             s = jnp.where(attend[:, None, None, :], s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
-            attn = jnp.einsum("bkgt,btkd->bkgd", p,
-                              vt.astype(jnp.float32))
+            attn = jnp.einsum("bkgt,btkd->bkgd", p, vt)
         attn = attn.reshape(B, cfg.d_model).astype(cfg.dtype)
         x = x + attn @ w["attn_out"].astype(attn.dtype)
         h2 = _layer_norm(x, w["ln2"], w["ln2_b"])
@@ -823,19 +936,29 @@ def decode_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
         else:
             ff = jax.nn.gelu(h2 @ w["mlp_in"].astype(h2.dtype))
             x = x + ff @ w["mlp_out"].astype(ff.dtype)
+        if kvq != "none":
+            return x, (kc, vc, ksc, vsc)
         return x, (kc, vc)
 
     li = jnp.arange(cfg.n_layers, dtype=jnp.int32)
-    x, (kn, vn) = jax.lax.scan(block, x, (params["blocks"], li,
-                                          cache["k"], cache["v"]))
+    if kvq != "none":
+        x, (kn, vn, ksn, vsn) = jax.lax.scan(
+            block, x, (params["blocks"], li, cache["k"], cache["v"],
+                       cache["k_scale"], cache["v_scale"]))
+        new_cache = {"k": kn, "v": vn, "k_scale": ksn, "v_scale": vsn}
+    else:
+        x, (kn, vn) = jax.lax.scan(block, x, (params["blocks"], li,
+                                              cache["k"], cache["v"]))
+        new_cache = {"k": kn, "v": vn}
     x = _layer_norm(x, params["ln_f"], params["ln_f_b"])
     logits = _vocab_logits(x, params)
-    return logits, {"k": kn, "v": vn}
+    return logits, new_cache
 
 
 def prefill_into_blocks(params, cache, tokens: jax.Array,
                         length: jax.Array, pages: jax.Array,
-                        cfg: TransformerConfig, *, block_size: int):
+                        cfg: TransformerConfig, *, block_size: int,
+                        pallas: Optional[str] = None):
     """Prefill ONE CHUNK of one request's prompt into its pages of the
     block pool.
 
@@ -868,7 +991,26 @@ def prefill_into_blocks(params, cache, tokens: jax.Array,
     instead of one monolithic stall. Because the engine's chunk grid is
     deterministic and prefix-cache hits are chunk-aligned, a hit replay
     runs bitwise the cold prefill's programs on bitwise the cold
-    prefill's values (pinned in tests/test_paged_engine.py)."""
+    prefill's values (pinned in tests/test_paged_engine.py).
+
+    Quantized pools (``init_block_pool(kv_dtype=...)``): the context
+    gathers int8/int4 rows + their scales (1 byte/elt of history
+    through the scan) and dequantizes in the consumer; the chunk's own
+    KV is quantized at write time, per (layer, token, head), with the
+    same masked-span RMW covering values AND scales. In-chunk attention
+    uses the exact (pre-quantization) chunk values — only what decode
+    reads LATER is rounded, matching the decode-write discipline.
+
+    ``pallas`` resolves the ``PADDLE_TPU_PALLAS`` policy: when on, each
+    layer's chunk attention runs ``ops.pallas.prefill.flash_chunk_prefill``
+    (pages resolved inside the kernel, context streamed from the pool
+    with the dequant fused, one exact softmax over the concat — no
+    gathered context or [C, S+C] score tensor in HBM) and the span
+    writes run the ``paged_span_write`` kernel (block-mapped through
+    the page vector via scalar prefetch). The XLA path above stays the
+    always-available fallback and the numerics reference."""
+    from paddle_tpu.ops import q8 as ops_q8
+    from paddle_tpu.ops.pallas import policy as _pallas_policy
     if tokens.shape[0] != 1:
         raise ValueError(f"prefill_into_blocks takes one request "
                          f"([1, C] tokens), got {tokens.shape}")
@@ -883,6 +1025,16 @@ def prefill_into_blocks(params, cache, tokens: jax.Array,
     H, Dh = cfg.n_heads, cfg.head_dim
     Hkv = cfg.kv_heads
     kvd = Hkv * Dh
+    kvq = pool_kv_dtype(cache, cfg)
+    M = cache["k"].shape[1]
+    mode = _pallas_policy.pallas_mode(pallas)
+    from paddle_tpu.ops.pallas import decode as _pallas_decode
+    use_pallas = _pallas_decode.kernels_dispatchable(mode)
+    if use_pallas:
+        from paddle_tpu.ops.pallas import prefill as _pallas_prefill
+        if mode == "on" and not _pallas_prefill.prefill_kernel_fits(
+                M, S, C, H // Hkv, Dh, cache["k"].dtype, kv_dtype=kvq):
+            use_pallas = False      # XLA fallback, not a Mosaic OOM
     length = jnp.asarray(length, jnp.int32)
     pages = jnp.asarray(pages, jnp.int32)
     gpos = S + jnp.arange(C, dtype=jnp.int32)            # [C] global
@@ -895,19 +1047,33 @@ def prefill_into_blocks(params, cache, tokens: jax.Array,
     rope_tabs = _rope_tables(gpos, Dh, cfg.rope_theta) \
         if cfg.use_rope else None
     valid = jnp.arange(C, dtype=jnp.int32) < length
-    # context gather (once, all layers): every context position is real
-    # (ctx tokens were written by hits/earlier chunks), no mask needed
-    gidx = (pages[:P - pc, None] * bs
-            + jnp.arange(bs, dtype=jnp.int32)[None, :]).reshape(S)
-    ctx_k = jnp.take(cache["k"], gidx, axis=1)  # [L, S, Hkv, Dh]
-    ctx_v = jnp.take(cache["v"], gidx, axis=1)
+    if use_pallas:
+        # the kernel resolves the page walk itself: the pool rides the
+        # layer scan as xs (a per-layer view, no gather/copy) and only
+        # the slot's MAPPED context blocks ever stream into VMEM
+        if kvq != "none":
+            ctx_xs = (cache["k"], cache["v"],
+                      cache["k_scale"], cache["v_scale"])
+        else:
+            ctx_xs = (cache["k"], cache["v"])
+    else:
+        # context gather (once, all layers): every context position is
+        # real (ctx tokens were written by hits/earlier chunks), no
+        # mask needed
+        gidx = (pages[:P - pc, None] * bs
+                + jnp.arange(bs, dtype=jnp.int32)[None, :]).reshape(S)
+        ctx_xs = tuple(jnp.take(cache[n], gidx, axis=1)
+                       for n in (("k", "v", "k_scale", "v_scale")
+                                 if kvq != "none" else ("k", "v")))
     # [C, S+C] mask: context fully visible, chunk causally masked
     attend = jnp.concatenate(
         [jnp.ones((C, S), bool),
          jnp.tril(jnp.ones((C, C), bool))], axis=1)
 
     def block(x, scanned):
-        w, ck, cv = scanned                  # ck/cv [S, Hkv, Dh] (read)
+        w = scanned[0]
+        ctx = scanned[1:]       # per-layer pool view (pallas) or the
+        #                         gathered [S, ...] context (XLA)
         h = _layer_norm(x, w["ln1"], w["ln1_b"])
         qkv = h @ w["qkv"].astype(h.dtype)   # [C, D + 2*kvd]
         q, k, v = jnp.split(qkv, [H * Dh, H * Dh + kvd], axis=-1)
@@ -918,16 +1084,34 @@ def prefill_into_blocks(params, cache, tokens: jax.Array,
                 C, kvd)
         kck = k.reshape(C, Hkv, Dh)
         vck = v.reshape(C, Hkv, Dh)
-        kall = jnp.concatenate([ck.astype(jnp.float32),
-                                kck.astype(jnp.float32)], axis=0)
-        vall = jnp.concatenate([cv.astype(jnp.float32),
-                                vck.astype(jnp.float32)], axis=0)
         g = H // Hkv
-        q32 = q.reshape(C, Hkv, g, Dh).astype(jnp.float32)
-        s = jnp.einsum("ckgd,tkd->ckgt", q32, kall) / math.sqrt(Dh)
-        s = jnp.where(attend[:, None, None, :], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        attn = jnp.einsum("ckgt,tkd->ckgd", p, vall)
+        if use_pallas:
+            from paddle_tpu.ops.pallas import prefill as _pp
+            kc, vc = ctx[0], ctx[1]
+            ksc, vsc = (ctx[2], ctx[3]) if kvq != "none" else (None,
+                                                               None)
+            attn = _pp.flash_chunk_prefill(
+                q.reshape(C, Hkv, g, Dh), kck, vck, kc, vc,
+                pages[:P - pc], block_size=bs, k_scale=ksc,
+                v_scale=vsc, kv_dtype=kvq,
+                interpret=(mode == "interpret"))
+            attn = attn.reshape(C, Hkv, g, Dh)
+        else:
+            if kvq != "none":
+                ck = ops_q8.dequantize_kv(ctx[0], ctx[2], kvq)
+                cv = ops_q8.dequantize_kv(ctx[1], ctx[3], kvq)
+            else:
+                ck = ctx[0].astype(jnp.float32)
+                cv = ctx[1].astype(jnp.float32)
+            kall = jnp.concatenate([ck, kck.astype(jnp.float32)],
+                                   axis=0)
+            vall = jnp.concatenate([cv, vck.astype(jnp.float32)],
+                                   axis=0)
+            q32 = q.reshape(C, Hkv, g, Dh).astype(jnp.float32)
+            s = jnp.einsum("ckgd,tkd->ckgt", q32, kall) / math.sqrt(Dh)
+            s = jnp.where(attend[:, None, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum("ckgt,tkd->ckgd", p, vall)
         attn = attn.reshape(C, cfg.d_model).astype(cfg.dtype)
         x = x + attn @ w["attn_out"].astype(attn.dtype)
         h2 = _layer_norm(x, w["ln2"], w["ln2_b"])
@@ -946,46 +1130,61 @@ def prefill_into_blocks(params, cache, tokens: jax.Array,
         else:
             ff = jax.nn.gelu(h2 @ w["mlp_in"].astype(h2.dtype))
             x = x + ff @ w["mlp_out"].astype(ff.dtype)
+        if kvq != "none":
+            # fp values out of the scan; quantized post-scan in one
+            # pass so values and scales stack [L, C, ...] together
+            return x, (kck, vck)
         return x, (kck.astype(cache["k"].dtype),
                    vck.astype(cache["v"].dtype))
 
-    x, (ks, vs) = jax.lax.scan(block, x,
-                               (params["blocks"], ctx_k, ctx_v))
+    x, (ks, vs) = jax.lax.scan(block, x, (params["blocks"],) + ctx_xs)
     # pool write for the whole chunk, all layers (ks [L, C, Hkv, Dh]):
     # one masked read-modify-write of the CONTIGUOUS bs-token span per
     # chunk page — dynamic_update_slice, not a scatter (a [C]-index
     # scatter into the flat pool is several ms slower per call on CPU).
     # Padded rows write back the span's old bytes, the RMW equivalent
-    # of the scatter's mode="drop".
+    # of the scatter's mode="drop". Quantized pools write int8/int4
+    # values + their per-(layer, token, head) scales the same way.
+    if kvq != "none":
+        kq, kscl = ops_q8.quantize_kv(ks, kvq)   # [L,C,Hkv,Dh'], [L,C,Hkv]
+        vq, vscl = ops_q8.quantize_kv(vs, kvq)
+        spans = {"k": kq, "v": vq, "k_scale": kscl, "v_scale": vscl}
+    else:
+        spans = {"k": ks, "v": vs}
     pad = pc * bs - C
     if pad:
-        ks = jnp.pad(ks, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        vs = jnp.pad(vs, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        spans = {n: jnp.pad(a, ((0, 0), (0, pad)) + ((0, 0),) *
+                            (a.ndim - 2)) for n, a in spans.items()}
         vfull = jnp.concatenate([valid, jnp.zeros((pad,), bool)])
     else:
         vfull = valid
-    kn, vn = cache["k"], cache["v"]
-    L = kn.shape[0]
-    for j in range(pc):
-        dst = pages[P - pc + j] * bs
-        vmask = vfull[j * bs:(j + 1) * bs][None, :, None, None]
-        kj = ks[:, j * bs:(j + 1) * bs]
-        vj = vs[:, j * bs:(j + 1) * bs]
-        old_k = jax.lax.dynamic_slice(kn, (0, dst, 0, 0),
-                                      (L, bs, Hkv, Dh))
-        old_v = jax.lax.dynamic_slice(vn, (0, dst, 0, 0),
-                                      (L, bs, Hkv, Dh))
-        kn = jax.lax.dynamic_update_slice(
-            kn, jnp.where(vmask, kj, old_k), (0, dst, 0, 0))
-        vn = jax.lax.dynamic_update_slice(
-            vn, jnp.where(vmask, vj, old_v), (0, dst, 0, 0))
+    new_cache = dict(cache)
+    tail_pages = pages[P - pc:]
+    if use_pallas:
+        from paddle_tpu.ops.pallas import prefill as _pallas_prefill
+        new_cache.update(_pallas_prefill.paged_span_write(
+            {n: cache[n] for n in spans}, spans, tail_pages, vfull,
+            block_size=bs, interpret=(mode == "interpret")))
+    else:
+        for j in range(pc):
+            dst = tail_pages[j] * bs
+            for n, a in spans.items():
+                vmask = vfull[j * bs:(j + 1) * bs].reshape(
+                    (1, bs) + (1,) * (a.ndim - 2))
+                aj = a[:, j * bs:(j + 1) * bs]
+                old = jax.lax.dynamic_slice(
+                    new_cache[n], (0, dst) + (0,) * (a.ndim - 2),
+                    (a.shape[0], bs) + a.shape[2:])
+                new_cache[n] = jax.lax.dynamic_update_slice(
+                    new_cache[n], jnp.where(vmask, aj, old),
+                    (0, dst) + (0,) * (a.ndim - 2))
     # only the last VALID chunk position feeds the vocab head (the
     # gather-head discipline of prefill_into_slot)
     x = jnp.take(x, jnp.reshape(jnp.maximum(length - 1, 0), (1,)), axis=0)
     x = _layer_norm(x, params["ln_f"], params["ln_f_b"])
     logits = jnp.einsum("td,vd->tv", x.astype(jnp.float32),
                         params["embed"].astype(jnp.float32))
-    return logits, {"k": kn, "v": vn}
+    return logits, new_cache
 
 
 def generate(params, prompt: jax.Array, cfg: TransformerConfig, *,

@@ -30,3 +30,5 @@ from paddle_tpu.ops.pallas.policy import (  # noqa: F401
 from paddle_tpu.ops.pallas.attention import flash_attention  # noqa: F401
 from paddle_tpu.ops.pallas.decode import (  # noqa: F401,E402
     flash_decode_attention, fused_sample)
+from paddle_tpu.ops.pallas.prefill import (  # noqa: F401,E402
+    flash_chunk_prefill, paged_span_write)

@@ -39,6 +39,7 @@ Dispatch resolves through the package-wide ``PADDLE_TPU_PALLAS`` policy
 
 import functools
 import math
+import warnings
 from typing import Optional, Tuple
 
 import jax
@@ -48,6 +49,51 @@ from jax.experimental import pallas as pl
 from paddle_tpu.ops.pallas.attention import VMEM_BYTES
 
 NEG_INF = -1e30
+
+# Whether the SERVING kernels (flash_decode_attention, fused_sample,
+# and ops/pallas/prefill.py's pair) can lower through Mosaic to real
+# TPU hardware in this jax version: they cannot — their per-slot/
+# per-head block layouts put a 1 in the second-to-last block dim of
+# multi-row arrays (pages/pos/logits blocks vs a B-row array, pool
+# head columns (M, 1, Dh) vs an Hkv-head pool), violating the Pallas
+# TPU tiling rule, and the gather loops build their VMEM buffers with
+# value-domain dynamic_update_slice, which has no Mosaic lowering.
+# ``serving_bench.py --tpu-check`` records the diagnostics verbatim;
+# the head-major pool relayout that fixes both is a ROADMAP item.
+# Until then ``mode="on"`` must FALL BACK to the XLA path instead of
+# crashing the first compile on a real chip — interpret mode (the
+# CPU correctness path) is unaffected.
+MOSAIC_LOWERABLE = False
+
+_warned_fallback = False
+
+
+def kernels_dispatchable(mode: str) -> bool:
+    """Whether the resolved ``PADDLE_TPU_PALLAS`` mode may actually
+    place the serving kernels in a compiled program on the current
+    default backend. ``interpret`` always can (the interpreter runs
+    anywhere); ``on`` requires a TPU backend AND Mosaic-lowerable
+    kernels — today's layouts are not (see ``MOSAIC_LOWERABLE``), so
+    ``on`` falls back to the XLA path with a one-time warning rather
+    than failing the first compile. Callers still apply their VMEM
+    ``*_kernel_fits`` guards on top."""
+    global _warned_fallback
+    if mode == "interpret":
+        return True
+    if mode != "on":
+        return False
+    if jax.default_backend() != "tpu" or not MOSAIC_LOWERABLE:
+        if not _warned_fallback:
+            _warned_fallback = True
+            warnings.warn(
+                "PADDLE_TPU_PALLAS resolved 'on' but the serving "
+                "kernels cannot lower on this backend (Mosaic tiling "
+                "/ missing-primitive limits — see ops/pallas/decode.py "
+                "MOSAIC_LOWERABLE); serving falls back to the pure-XLA "
+                "path. Interpret mode still exercises the kernels.",
+                RuntimeWarning, stacklevel=2)
+        return False
+    return True
 
 # ---------------------------------------------------------------------------
 # tile selection
@@ -65,40 +111,61 @@ MEASURED_DECODE = {
 }
 
 
+def _kv_store_dims(Dh: int, dtype, kv_dtype: str):
+    """(stored last-dim, stored itemsize, dtype-key name) of the pool's
+    KV arrays under a KV storage width: quantized pools store int8
+    bytes (nibble-packed for int4) with the fp32 scale tables riding
+    beside them."""
+    if kv_dtype in (None, "none"):
+        return Dh, jnp.dtype(dtype).itemsize, jnp.dtype(dtype).name
+    if kv_dtype == "int4":
+        return Dh // 2, 1, "int4"
+    return Dh, 1, "int8"
+
+
 def decode_vmem_bytes(M: int, P: int, block_size: int, G: int, Dh: int,
-                      itemsize: int) -> int:
+                      itemsize: int, kv_dtype: str = "none") -> int:
     """Upper-bound VMEM residency of one (slot, kv-head) grid program:
     the pool's head column for k and v (the kernel's blocks), the
     fp32 gather buffers spanning the slot's T = P·bs logical positions,
-    the q/out tiles, and the score row (s and its softmax)."""
+    the q/out tiles, and the score row (s and its softmax). Quantized
+    pools add the two fp32 scale head columns but shrink the value
+    columns to 1 (int8) or 1/2 (int4) byte/elt."""
     T = P * int(block_size)
-    return (2 * M * Dh * itemsize        # k/v pool head columns
+    if kv_dtype in (None, "none"):
+        vals, scales = 2 * M * Dh * itemsize, 0
+    else:
+        Dh_st = Dh // 2 if kv_dtype == "int4" else Dh
+        vals, scales = 2 * M * Dh_st, 2 * M * 4
+    return (vals                         # k/v pool head columns
+            + scales                     # k/v scale head columns
             + 2 * T * Dh * 4             # fp32 gather buffers
             + 2 * G * Dh * 4             # q, out
             + 2 * G * T * 4)             # scores + softmax row
 
 
 def decode_kernel_fits(M: int, P: int, block_size: int, G: int, Dh: int,
-                       dtype) -> bool:
+                       dtype, kv_dtype: str = "none") -> bool:
     """Whether the flash-decode working set fits the VMEM budget — the
     dispatch guard: ``mode="on"`` falls back to the XLA gather path when
     this says no, rather than letting Mosaic fail opaquely."""
     itemsize = jnp.dtype(dtype).itemsize
-    return decode_vmem_bytes(M, P, block_size, G, Dh,
-                             itemsize) <= VMEM_BYTES
+    return decode_vmem_bytes(M, P, block_size, G, Dh, itemsize,
+                             kv_dtype) <= VMEM_BYTES
 
 
 def select_decode_tile(P: int, block_size: int, head_dim: int,
-                       dtype) -> int:
+                       dtype, kv_dtype: str = "none") -> int:
     """Pages gathered per inner-loop iteration: the measured table first
     (when its advisory block_size matches the pool's), then the analytic
     default — the largest power-of-two divisor of P keeping the unrolled
     gather at <= 256 rows per iteration (past that the unroll stops
-    paying and VMEM pressure from in-flight slices grows)."""
+    paying and VMEM pressure from in-flight slices grows). Quantized
+    pools key the measured table by their storage name ("int8"/"int4")."""
     span = P * int(block_size)
     bucket = 1 << max(0, (span - 1)).bit_length()     # next pow2 >= span
-    found = MEASURED_DECODE.get((bucket, head_dim,
-                                 jnp.dtype(dtype).name))
+    _, _, name = _kv_store_dims(head_dim, dtype, kv_dtype)
+    found = MEASURED_DECODE.get((bucket, head_dim, name))
     if found and found[0] == block_size and P % found[1] == 0:
         return int(found[1])
     tile = 1
@@ -113,14 +180,41 @@ def select_decode_tile(P: int, block_size: int, head_dim: int,
 # ---------------------------------------------------------------------------
 
 
-def _decode_kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, *,
-                   block_size, P, tile, G, Dh, scale):
+def _read_kv_rows(ref, scale_ref, start, bs, kv_dtype):
+    """One block span of a pool head column, widened to fp32 in-register
+    — the fused dequant. ``ref`` holds the stored bytes ((bs, Dh) for
+    fp/int8 pools, (bs, Dh//2) nibble-packed for int4), ``scale_ref``
+    the per-row fp32 scales (quantized pools only). The op chain is
+    EXACTLY the XLA quantized path's (``ops/q8.dequantize_kv``): exact
+    integer unpack, astype(f32), broadcast row-scale multiply — so the
+    kernel stays bitwise the XLA path whatever the storage width."""
+    from paddle_tpu.ops import q8 as ops_q8
+    rows = ref[pl.ds(start, bs), 0, :]
+    if kv_dtype in (None, "none"):
+        return rows.astype(jnp.float32)
+    if kv_dtype == "int4":
+        rows = ops_q8.unpack_int4(rows)
+    return (rows.astype(jnp.float32)
+            * scale_ref[pl.ds(start, bs), 0][:, None])
+
+
+def _decode_kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+                   block_size, P, tile, G, Dh, scale, kv_dtype):
     """One (slot, kv-head) program. Blocks: pages (1, P), pos (1, 1),
-    q/o (1, 1, G, Dh), k/v the pool's head column (M, 1, Dh). The
-    page-gather loop touches only the slot's MAPPED physical blocks;
-    everything downstream mirrors the XLA gather path's op chain
-    exactly (divide-by-sqrt(Dh), -1e30 mask, jax.nn.softmax) so aligned
-    fp32 shapes reproduce its logits bitwise."""
+    q/o (1, 1, G, Dh), k/v the pool's head column (M, 1, Dh-stored) —
+    plus, for quantized pools, the fp32 scale head columns (M, 1). The
+    page-gather loop touches only the slot's MAPPED physical blocks and
+    widens them to fp32 in-register (int8/int4 HBM traffic; the dequant
+    never materializes outside VMEM); everything downstream mirrors the
+    XLA gather path's op chain exactly (divide-by-sqrt(Dh), -1e30 mask,
+    jax.nn.softmax) so aligned fp32 shapes — and quantized pools, whose
+    dequant chain is elementwise-identical — reproduce its logits
+    bitwise."""
+    if kv_dtype in (None, "none"):
+        ks_ref = vs_ref = None
+        o_ref = rest[0]
+    else:
+        ks_ref, vs_ref, o_ref = rest
     bs = int(block_size)
     T = P * bs
 
@@ -129,8 +223,8 @@ def _decode_kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, *,
         for t in range(tile):           # static unroll: tile pages/iter
             j = i * tile + t
             pg = pages_ref[0, j]
-            ks = k_ref[pl.ds(pg * bs, bs), 0, :].astype(jnp.float32)
-            vs = v_ref[pl.ds(pg * bs, bs), 0, :].astype(jnp.float32)
+            ks = _read_kv_rows(k_ref, ks_ref, pg * bs, bs, kv_dtype)
+            vs = _read_kv_rows(v_ref, vs_ref, pg * bs, bs, kv_dtype)
             kbuf = jax.lax.dynamic_update_slice(kbuf, ks, (j * bs, 0))
             vbuf = jax.lax.dynamic_update_slice(vbuf, vs, (j * bs, 0))
         return kbuf, vbuf
@@ -151,6 +245,9 @@ def flash_decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                            pages: jax.Array, pos: jax.Array, *,
                            block_size: int,
                            tile: Optional[int] = None,
+                           k_scale: Optional[jax.Array] = None,
+                           v_scale: Optional[jax.Array] = None,
+                           kv_dtype: str = "none",
                            interpret: bool = False) -> jax.Array:
     """One decode step's attention straight off the paged pool.
 
@@ -161,36 +258,53 @@ def flash_decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     and must perform it before this reads — position ``pos[b]`` attends
     to itself.
 
+    Quantized pools (``kv_dtype`` "int8"/"int4") pass the int8 value
+    arrays ([M, Hkv, Dh] or nibble-packed [M, Hkv, Dh//2]) plus the
+    per-(position, head) fp32 scale tables ``k_scale``/``v_scale``
+    [M, Hkv]: blocks stream into VMEM at their stored width and the
+    dequant multiply runs in-register inside the gather loop — history
+    crosses HBM at 1 (int8) or 1/2 (int4) byte/elt.
+
     Grid (slot, kv-head); the per-program working set must pass
     ``decode_kernel_fits`` (the dispatch in ``decode_step_paged``
     guards this and falls back to XLA)."""
-    B, Hkv, G, Dh = q.shape
+    B, Hkv, G, Dh = q.shape             # Dh is always the LOGICAL dim
+    quant = kv_dtype not in (None, "none")
     M = k.shape[0]
     P = pages.shape[1]
     bs = int(block_size)
+    if quant and (k_scale is None or v_scale is None):
+        raise ValueError(f"kv_dtype={kv_dtype} needs k_scale/v_scale")
     if tile is None:
-        tile = select_decode_tile(P, bs, Dh, k.dtype)
+        tile = select_decode_tile(P, bs, Dh, k.dtype, kv_dtype)
     if P % tile:
         raise ValueError(f"flash_decode: tile {tile} must divide the "
                          f"page-vector length {P}")
+    Dh_st = k.shape[-1]                 # stored last dim (packed int4)
     kernel = functools.partial(
         _decode_kernel, block_size=bs, P=P, tile=int(tile), G=G, Dh=Dh,
-        scale=math.sqrt(Dh))
+        scale=math.sqrt(Dh), kv_dtype=kv_dtype if quant else "none")
+    in_specs = [
+        pl.BlockSpec((1, P), lambda b, h: (b, 0)),        # pages
+        pl.BlockSpec((1, 1), lambda b, h: (b, 0)),        # pos
+        pl.BlockSpec((1, 1, G, Dh), lambda b, h: (b, h, 0, 0)),
+        pl.BlockSpec((M, 1, Dh_st), lambda b, h: (0, h, 0)),  # k pool
+        pl.BlockSpec((M, 1, Dh_st), lambda b, h: (0, h, 0)),  # v pool
+    ]
+    args = [pages.astype(jnp.int32),
+            jnp.reshape(pos, (B, 1)).astype(jnp.int32), q, k, v]
+    if quant:
+        in_specs += [pl.BlockSpec((M, 1), lambda b, h: (0, h)),
+                     pl.BlockSpec((M, 1), lambda b, h: (0, h))]
+        args += [k_scale, v_scale]
     return pl.pallas_call(
         kernel,
         grid=(B, Hkv),
-        in_specs=[
-            pl.BlockSpec((1, P), lambda b, h: (b, 0)),        # pages
-            pl.BlockSpec((1, 1), lambda b, h: (b, 0)),        # pos
-            pl.BlockSpec((1, 1, G, Dh), lambda b, h: (b, h, 0, 0)),
-            pl.BlockSpec((M, 1, Dh), lambda b, h: (0, h, 0)),  # k pool
-            pl.BlockSpec((M, 1, Dh), lambda b, h: (0, h, 0)),  # v pool
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, G, Dh), lambda b, h: (b, h, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, Dh), jnp.float32),
         interpret=interpret,
-    )(pages.astype(jnp.int32), jnp.reshape(pos, (B, 1)).astype(jnp.int32),
-      q, k, v)
+    )(*args)
 
 
 # ---------------------------------------------------------------------------

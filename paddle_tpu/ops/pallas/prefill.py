@@ -1,0 +1,339 @@
+"""Chunked-prefill Pallas kernels over the paged KV pool.
+
+The cold-prefill half of TTFT is one ``transformer.prefill_into_blocks``
+call per chunk: under XLA each layer gathers the context out of the pool
+into an HBM ``[S, Hkv, Dh]`` view, concatenates the chunk's fresh K/V,
+and keeps a ``[C, H, S+C]`` score tensor in HBM between the softmax
+stages; the chunk's KV then lands in the pool as compiler-emitted
+masked-span writes (the exact pattern CUDA-L2 in PAPERS.md shows
+library-emitted kernels leave margin on). Two hand-scheduled kernels
+replace that, behind the same ``PADDLE_TPU_PALLAS`` knob as the decode
+kernels:
+
+- :func:`flash_chunk_prefill` — one chunk's attention against its
+  context, straight off the pool: one grid program per kv-head resolves
+  the slot's context pages INSIDE the kernel, streams only the MAPPED
+  blocks into VMEM (widened to fp32 in-register — for quantized pools
+  the dequant multiply is fused into the gather, so history crosses HBM
+  at its stored 1 or 1/2 byte/elt), concatenates the chunk's K/V in
+  VMEM, and applies ONE exact softmax over the
+  context-visible + chunk-causal mask. No gathered context view and no
+  score tensor ever exist in HBM. Exact softmax (not online rescaling)
+  for the same reason as ``flash_decode_attention``: it reproduces the
+  XLA fallback's op chain, so the interpret-mode kernel is BITWISE the
+  XLA path on aligned fp32 shapes (pinned in
+  tests/test_pallas_prefill.py).
+
+- :func:`paged_span_write` — the chunk's masked span writes: grid over
+  the chunk's pages, each program's output block mapped THROUGH the
+  page vector by scalar prefetch (``pltpu.PrefetchScalarGridSpec``),
+  pool buffers aliased in-place. Padded rows keep the span's old bytes
+  (the RMW the XLA fallback expresses as slice + where + update-slice),
+  and quantized pools write values and scale rows through the same
+  kernel.
+
+Tiling: the context gather unrolls ``tile`` pages per loop iteration —
+measured winners from ``benchmarks/tune_flash_blocks.py --prefill`` go
+in ``MEASURED_PREFILL`` (advisory, exactly like ``MEASURED_DECODE``:
+the block-size entry is an engine-configuration hint, consulted only
+when it matches the pool actually handed over); the analytic default
+mirrors the decode kernel's.
+"""
+
+import functools
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddle_tpu.ops.pallas.attention import VMEM_BYTES
+from paddle_tpu.ops.pallas.decode import NEG_INF, _read_kv_rows
+
+# measured-best (block_size, ctx pages-per-tile) keyed (context-span
+# bucket, chunk bucket, head_dim, dtype_name) — filled from on-chip
+# sweeps (benchmarks/tune_flash_blocks.py --prefill); consulted before
+# the analytic default. Advisory semantics match MEASURED_DECODE: the
+# block_size entry is a hint for engine configuration, and the tile is
+# used only when that advisory matches the pool the kernel was handed.
+MEASURED_PREFILL = {
+    # (span_bucket, chunk_bucket, head_dim, dtype): (block_size, tile)
+}
+
+
+def prefill_vmem_bytes(M: int, S: int, C: int, G: int, Dh: int,
+                       itemsize: int, kv_dtype: str = "none") -> int:
+    """Upper-bound VMEM residency of one kv-head grid program: the
+    pool's head columns (stored width), the fp32 gather buffers over
+    context + chunk, the chunk K/V and q/out tiles, and the
+    ``[C, G, S+C]`` score block (plus its softmax)."""
+    T = S + C
+    if kv_dtype in (None, "none"):
+        vals, scales = 2 * M * Dh * itemsize, 0
+    else:
+        Dh_st = Dh // 2 if kv_dtype == "int4" else Dh
+        vals, scales = 2 * M * Dh_st, 2 * M * 4
+    return (vals + scales                # pool value + scale columns
+            + 2 * T * Dh * 4             # fp32 k/v concat buffers
+            + 2 * C * Dh * 4             # chunk k/v tiles
+            + 2 * C * G * Dh * 4         # q, out
+            + 2 * C * G * T * 4)         # scores + softmax
+
+
+def prefill_kernel_fits(M: int, S: int, C: int, G: int, Dh: int,
+                        dtype, kv_dtype: str = "none") -> bool:
+    """Dispatch guard for ``mode="on"``: fall back to the XLA chunk
+    path when the working set exceeds the VMEM budget rather than
+    letting Mosaic fail opaquely."""
+    itemsize = jnp.dtype(dtype).itemsize
+    return prefill_vmem_bytes(M, S, C, G, Dh, itemsize,
+                              kv_dtype) <= VMEM_BYTES
+
+
+def select_prefill_tile(P_ctx: int, block_size: int, chunk: int,
+                        head_dim: int, dtype,
+                        kv_dtype: str = "none") -> int:
+    """Context pages gathered per inner-loop iteration: the measured
+    table first (when its advisory block_size matches the pool's), then
+    the analytic default — largest power-of-two divisor of ``P_ctx``
+    keeping the unrolled gather at <= 256 rows per iteration."""
+    if P_ctx < 1:
+        return 1
+    span = P_ctx * int(block_size)
+    sb = 1 << max(0, (span - 1)).bit_length()
+    cb = 1 << max(0, (int(chunk) - 1)).bit_length()
+    if kv_dtype in (None, "none"):
+        name = jnp.dtype(dtype).name
+    else:
+        name = kv_dtype
+    found = MEASURED_PREFILL.get((sb, cb, head_dim, name))
+    if found and found[0] == block_size and P_ctx % found[1] == 0:
+        return int(found[1])
+    tile = 1
+    while (tile * 2 <= P_ctx and P_ctx % (tile * 2) == 0
+           and tile * 2 * block_size <= 256):
+        tile *= 2
+    return tile
+
+
+# ---------------------------------------------------------------------------
+# chunk attention kernel
+# ---------------------------------------------------------------------------
+
+
+def _chunk_kernel(*refs, block_size, P_ctx, tile, C, G, Dh, scale,
+                  kv_dtype):
+    """One kv-head program. With context: blocks are pages (1, P_ctx),
+    q (C, 1, G, Dh), chunk k/v (C, 1, Dh), the pool's head columns
+    (M, 1, Dh-stored) (+ scale columns (M, 1) when quantized); without
+    (a cold first chunk), only q and the chunk k/v. The page-gather
+    loop fills the context prefix of the fp32 concat buffer, the
+    chunk's K/V land behind it, and the masked exact softmax mirrors
+    the XLA chunk path's op chain (context fully visible, chunk
+    causal, -1e30 mask, jax.nn.softmax) for the bitwise contract."""
+    quant = kv_dtype not in (None, "none")
+    if P_ctx:
+        if quant:
+            (pages_ref, q_ref, kck_ref, vck_ref, k_ref, v_ref,
+             ks_ref, vs_ref, o_ref) = refs
+        else:
+            (pages_ref, q_ref, kck_ref, vck_ref, k_ref, v_ref,
+             o_ref) = refs
+            ks_ref = vs_ref = None
+    else:
+        q_ref, kck_ref, vck_ref, o_ref = refs
+    bs = int(block_size)
+    S = P_ctx * bs
+    T = S + C
+    kck = kck_ref[:, 0, :].astype(jnp.float32)            # [C, Dh]
+    vck = vck_ref[:, 0, :].astype(jnp.float32)
+    if P_ctx:
+        def gather(i, carry):
+            kbuf, vbuf = carry
+            for t in range(tile):       # static unroll: tile pages/iter
+                j = i * tile + t
+                pg = pages_ref[0, j]
+                ks = _read_kv_rows(k_ref, ks_ref, pg * bs, bs, kv_dtype)
+                vs = _read_kv_rows(v_ref, vs_ref, pg * bs, bs, kv_dtype)
+                kbuf = jax.lax.dynamic_update_slice(kbuf, ks,
+                                                    (j * bs, 0))
+                vbuf = jax.lax.dynamic_update_slice(vbuf, vs,
+                                                    (j * bs, 0))
+            return kbuf, vbuf
+
+        kbuf = jnp.zeros((T, Dh), jnp.float32)
+        vbuf = jnp.zeros((T, Dh), jnp.float32)
+        kbuf, vbuf = jax.lax.fori_loop(0, P_ctx // tile, gather,
+                                       (kbuf, vbuf))
+        kbuf = jax.lax.dynamic_update_slice(kbuf, kck, (S, 0))
+        vbuf = jax.lax.dynamic_update_slice(vbuf, vck, (S, 0))
+    else:
+        kbuf, vbuf = kck, vck
+    q = q_ref[:, 0].astype(jnp.float32)                   # [C, G, Dh]
+    s = jnp.einsum("cgd,td->cgt", q, kbuf) / scale
+    # context fully visible, chunk causally masked: position t is
+    # visible to chunk row c iff t <= S + c
+    row = jax.lax.broadcasted_iota(jnp.int32, (C, 1, T), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (C, 1, T), 2)
+    s = jnp.where(col <= S + row, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o_ref[:, 0] = jnp.einsum("cgt,td->cgd", p, vbuf)
+
+
+def flash_chunk_prefill(q: jax.Array, k_chunk: jax.Array,
+                        v_chunk: jax.Array, k: jax.Array, v: jax.Array,
+                        pages: jax.Array, *, block_size: int,
+                        tile: Optional[int] = None,
+                        k_scale: Optional[jax.Array] = None,
+                        v_scale: Optional[jax.Array] = None,
+                        kv_dtype: str = "none",
+                        interpret: bool = False) -> jax.Array:
+    """One prefill chunk's attention against its pool-resident context.
+
+    q [C, Hkv, G, Dh] (grouped-query layout), k_chunk/v_chunk
+    [C, Hkv, Dh] the chunk's OWN fresh K/V (exact, pre-quantization —
+    in-chunk attention reads what the forward computed; only the pool
+    write is rounded), k/v the flat pool [M, Hkv, Dh-stored], pages
+    [P_ctx] int32 the slot's context pages (context length S =
+    P_ctx·block_size is static, like the XLA chunk path's span
+    specialization) → fp32 [C, Hkv, G, Dh]. Quantized pools also pass
+    ``k_scale``/``v_scale`` [M, Hkv] and the matching ``kv_dtype``.
+
+    A cold first chunk (P_ctx = 0) skips the pool inputs entirely —
+    the kernel is then pure chunk-causal attention."""
+    C, Hkv, G, Dh = q.shape
+    quant = kv_dtype not in (None, "none")
+    P_ctx = int(pages.shape[0])
+    bs = int(block_size)
+    if quant and (k_scale is None or v_scale is None):
+        raise ValueError(f"kv_dtype={kv_dtype} needs k_scale/v_scale")
+    if tile is None:
+        tile = select_prefill_tile(P_ctx, bs, C, Dh, k.dtype, kv_dtype)
+    if P_ctx and P_ctx % tile:
+        raise ValueError(f"flash_chunk_prefill: tile {tile} must "
+                         f"divide the context page count {P_ctx}")
+    kernel = functools.partial(
+        _chunk_kernel, block_size=bs, P_ctx=P_ctx, tile=int(tile),
+        C=C, G=G, Dh=Dh, scale=math.sqrt(Dh),
+        kv_dtype=kv_dtype if quant else "none")
+    in_specs = [
+        pl.BlockSpec((C, 1, G, Dh), lambda h: (0, h, 0, 0)),   # q
+        pl.BlockSpec((C, 1, Dh), lambda h: (0, h, 0)),         # chunk k
+        pl.BlockSpec((C, 1, Dh), lambda h: (0, h, 0)),         # chunk v
+    ]
+    args = [q, k_chunk, v_chunk]
+    if P_ctx:
+        M = k.shape[0]
+        Dh_st = k.shape[-1]
+        in_specs = ([pl.BlockSpec((1, P_ctx), lambda h: (0, 0))]
+                    + in_specs
+                    + [pl.BlockSpec((M, 1, Dh_st), lambda h: (0, h, 0)),
+                       pl.BlockSpec((M, 1, Dh_st),
+                                    lambda h: (0, h, 0))])
+        args = ([jnp.reshape(pages, (1, P_ctx)).astype(jnp.int32)]
+                + args + [k, v])
+        if quant:
+            in_specs += [pl.BlockSpec((M, 1), lambda h: (0, h)),
+                         pl.BlockSpec((M, 1), lambda h: (0, h))]
+            args += [k_scale, v_scale]
+    return pl.pallas_call(
+        kernel,
+        grid=(Hkv,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((C, 1, G, Dh), lambda h: (0, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((C, Hkv, G, Dh), jnp.float32),
+        interpret=interpret,
+    )(*args)
+
+
+# ---------------------------------------------------------------------------
+# masked span-write kernel
+# ---------------------------------------------------------------------------
+
+
+def _span_write_kernel(n: int):
+    """Kernel over ``n`` (span, pool) array pairs: one grid program per
+    chunk page, output blocks mapped through the scalar-prefetched page
+    vector, pool buffers aliased — so each program touches exactly one
+    ``block_size``-token span per array. Padded rows (mask 0) keep the
+    pool's old bytes: the aliased output ref still HOLDS them, so the
+    masked select is a read-modify-write entirely in VMEM."""
+
+    def kernel(pages_ref, mask_ref, *refs):
+        spans = refs[:n]
+        outs = refs[2 * n:]
+        m = mask_ref[0] != 0                              # [bs]
+        for s_ref, o_ref in zip(spans, outs):
+            mv = m.reshape((1, -1) + (1,) * (o_ref.ndim - 2))
+            o_ref[...] = jnp.where(mv, s_ref[...], o_ref[...])
+
+    return kernel
+
+
+def paged_span_write(pool: Dict[str, jax.Array],
+                     spans: Dict[str, jax.Array],
+                     pages: jax.Array, valid: jax.Array, *,
+                     block_size: int,
+                     interpret: bool = False) -> Dict[str, jax.Array]:
+    """Write one chunk's spans into its pool pages, masked per row.
+
+    ``pool`` maps array names to pool buffers [L, M, ...]; ``spans``
+    maps the SAME names to the chunk's stacked spans [L, pc·bs, ...]
+    (values and, for quantized pools, scale rows alike); ``pages``
+    [pc] int32 the chunk's physical pages; ``valid`` [pc·bs] bool the
+    per-row write mask (False rows keep the pool's old bytes — the RMW
+    equivalent of the decode scatter's mode="drop"). Returns the
+    updated pool arrays.
+
+    Grid (pc,); each program's blocks are one page's span per array,
+    placed by indexing the output BlockSpec through the scalar-
+    prefetched page vector — the hand-scheduled form of the masked
+    contiguous-span writes XLA emits for the fallback path, with the
+    pool aliased in-place instead of round-tripping a pool-sized
+    copy."""
+    names = sorted(spans)
+    bs = int(block_size)
+    pc = int(pages.shape[0])
+    n = len(names)
+    mask = valid.astype(jnp.int32).reshape(pc, bs)
+
+    def span_spec(a):
+        blk = (a.shape[0], bs) + a.shape[2:]
+        nd = a.ndim
+
+        def imap(j, pg, nd=nd):
+            return (0, j) + (0,) * (nd - 2)
+
+        return pl.BlockSpec(blk, imap)
+
+    def pool_spec(a):
+        blk = (a.shape[0], bs) + a.shape[2:]
+        nd = a.ndim
+
+        def imap(j, pg, nd=nd):
+            return (0, pg[j]) + (0,) * (nd - 2)
+
+        return pl.BlockSpec(blk, imap)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(pc,),
+        in_specs=([pl.BlockSpec((1, bs), lambda j, pg: (j, 0))]
+                  + [span_spec(spans[nm]) for nm in names]
+                  + [pool_spec(pool[nm]) for nm in names]),
+        out_specs=[pool_spec(pool[nm]) for nm in names],
+    )
+    outs = pl.pallas_call(
+        _span_write_kernel(n),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(pool[nm].shape, pool[nm].dtype)
+                   for nm in names],
+        # pool inputs alias the outputs: index 0 is the scalar-prefetch
+        # pages, 1 the mask, 2..n+1 the spans, n+2.. the pool buffers
+        input_output_aliases={2 + n + i: i for i in range(n)},
+        interpret=interpret,
+    )(pages.astype(jnp.int32), mask,
+      *[spans[nm] for nm in names], *[pool[nm] for nm in names])
+    return dict(zip(names, outs))

@@ -477,6 +477,72 @@ def dequantize_tree(tree, dtype=jnp.float32):
 
 
 # ---------------------------------------------------------------------------
+# KV-cache quantization (serving): per-token, per-head symmetric int8/int4
+# ---------------------------------------------------------------------------
+
+# symmetric clip targets: int8 uses the full signed range; int4 packs two
+# nibbles per byte, each a two's-complement value in [-7, 7] (the -8 code
+# is unused so the grid stays symmetric, like the int8 -128 code)
+KV_QMAX = {"int8": 127.0, "int4": 7.0}
+KV_DTYPES = tuple(KV_QMAX)
+
+
+def pack_int4(q: jax.Array) -> jax.Array:
+    """int8 values in [-7, 7] over an even last axis -> one byte per
+    PAIR: even positions in the low nibble, odd in the high (two's
+    complement within each nibble). Shape [..., D] -> [..., D//2]."""
+    if q.shape[-1] % 2:
+        raise ValueError(f"pack_int4 needs an even last axis, got "
+                         f"{q.shape}")
+    lo = q[..., 0::2].astype(jnp.int32)
+    hi = q[..., 1::2].astype(jnp.int32)
+    return ((hi << 4) | (lo & 0xF)).astype(jnp.int8)
+
+
+def unpack_int4(p: jax.Array) -> jax.Array:
+    """Inverse of :func:`pack_int4`: [..., D//2] int8 -> [..., D] int32.
+    All-int32 shift arithmetic (sign-extend each nibble) so the exact
+    same op chain runs under XLA, Mosaic, and the Pallas interpreter —
+    the integers are exact, so any path is bitwise any other."""
+    p32 = p.astype(jnp.int32)
+    lo = lax.shift_right_arithmetic(lax.shift_left(p32, 28), 28)
+    hi = lax.shift_right_arithmetic(lax.shift_left(p32, 24), 28)
+    return jnp.stack([lo, hi], axis=-1).reshape(
+        p.shape[:-1] + (p.shape[-1] * 2,))
+
+
+def quantize_kv(x: jax.Array, kv_dtype: str):
+    """Symmetric per-row quantization of KV vectors: ``x [..., Dh]`` ->
+    ``(q, scale)`` with one fp32 scale per leading index (per token, per
+    head — write-local, so incremental decode writes never rescale a
+    block's resident neighbours). ``q`` is int8 ``[..., Dh]`` for int8,
+    nibble-packed int8 ``[..., Dh//2]`` for int4. Same round/clip
+    discipline as the activation stash (:func:`_quantize`)."""
+    if kv_dtype not in KV_QMAX:
+        raise ValueError(f"kv_dtype {kv_dtype!r}: one of {KV_DTYPES}")
+    qmax = KV_QMAX[kv_dtype]
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / qmax
+    q = jnp.clip(jnp.round(xf / scale[..., None]),
+                 -qmax, qmax).astype(jnp.int8)
+    if kv_dtype == "int4":
+        q = pack_int4(q)
+    return q, scale
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array,
+                  kv_dtype: str) -> jax.Array:
+    """Inverse read: ``(q [..., Dh'], scale [...]) -> fp32 [..., Dh]``.
+    Elementwise (unpack is exact integer math, the multiply broadcasts
+    the row scale), so it fuses into the consumer — and the identical
+    chain runs inside the Pallas kernels, which is what makes the
+    fused-dequant kernel bitwise the XLA quantized path."""
+    qi = unpack_int4(q) if kv_dtype == "int4" else q
+    return qi.astype(jnp.float32) * scale[..., None]
+
+
+# ---------------------------------------------------------------------------
 # generic layer-granular remat with a quantized stash (transformer slot)
 # ---------------------------------------------------------------------------
 

@@ -756,7 +756,8 @@ class PagedDecodeEngine(DecodeEngine):
                  tracker: Optional[_ct.CompileTracker] = None,
                  slo: Optional[SloConfig] = None,
                  decode_flops: Optional[float] = None,
-                 pallas_mode: Optional[str] = None):
+                 pallas_mode: Optional[str] = None,
+                 kv_dtype: Optional[str] = None):
         from paddle_tpu.serving import blocks as _blocks
         bs = int(block_size)
         if bs < 1 or cache_len % bs:
@@ -798,6 +799,18 @@ class PagedDecodeEngine(DecodeEngine):
                               else batch * self.pages_per_slot)
         self.chunk_tokens = chunk_tokens
         self.pool = _blocks.BlockPool(self.num_blocks, bs)
+        # KV storage width of the device pool ("none" = model dtype;
+        # "int8"/"int4" pools carry per-(position, head) scale tables
+        # the page table indexes alongside the values). Derived HBM
+        # arithmetic uses the pool SHAPES, so it needs no model config.
+        self.kv_dtype = kv_dtype or "none"
+        kshape = cache["k"].shape          # [L, M, Hkv, Dh-stored]
+        L, _, Hkv, Dh_st = kshape
+        per_tok = 2 * Hkv * Dh_st * cache["k"].dtype.itemsize
+        if "k_scale" in cache:
+            per_tok += 2 * Hkv * 4         # fp32 scale rows (k + v)
+        self.kv_bytes_per_token = int(L) * per_tok
+        self.pool_bytes = self.kv_bytes_per_token * self.num_blocks * bs
         B = self.batch
         # page table uploaded on change (most decode steps reuse the
         # cached device copy); unallocated entries stay 0 and are only
@@ -840,6 +853,12 @@ class PagedDecodeEngine(DecodeEngine):
             "engine_prefill_stall_seconds", "time in-flight decoders "
             "were stalled by one prefill chunk (observed per chunk run "
             "while any slot was decoding)", buckets=_LATENCY_BUCKETS)
+        self._m_kv_bytes = reg.gauge(
+            "engine_kv_bytes_per_token", "pool HBM bytes one resident "
+            "token costs across all layers (k + v + scale rows at the "
+            "pool's kv_dtype) — the per-token decode-read traffic and "
+            "the slots-at-equal-HBM denominator")
+        self._m_kv_bytes.set(self.kv_bytes_per_token)
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -849,14 +868,20 @@ class PagedDecodeEngine(DecodeEngine):
                     chunk_tokens: int = 64,
                     chunk_buckets: Optional[Sequence[int]] = None,
                     seed: Optional[int] = None,
-                    pallas: Optional[str] = None, **kw):
+                    pallas: Optional[str] = None,
+                    kv_dtype: Optional[str] = None, **kw):
         """In-process paged engine: jit the chunk-prefill/paged-decode
         programs against live params (the no-artifact path tests and
         benchmarks drive). ``pallas`` overrides the
         ``PADDLE_TPU_PALLAS`` policy for the step programs (flash-decode
-        attention + fused sampling epilogue); ``params`` may be the
-        ``quantize_lm_params`` int8 tree — the decode step then reads
-        weights at 1 byte/elt (in-scan dequant)."""
+        attention + chunk-prefill kernel + fused sampling epilogue);
+        ``params`` may be the ``quantize_lm_params`` int8 tree — the
+        decode step then reads weights at 1 byte/elt (in-scan dequant).
+        ``kv_dtype`` ("int8"/"int4") quantizes the KV pool itself
+        (``transformer.init_block_pool``): history streams at 1 or 1/2
+        byte/elt and the same HBM budget holds 4-8x the blocks — the
+        step programs detect the pool layout from the pytree, so no
+        other wiring changes."""
         import jax
         from paddle_tpu.models import transformer
         from paddle_tpu.ops.pallas import policy as _pallas_policy
@@ -871,7 +896,8 @@ class PagedDecodeEngine(DecodeEngine):
                  else batch * (cache_len // block_size))
         prefill_fn, decode_fn = sampling.paged_step_fns(
             cfg, block_size, pallas=pallas)
-        pool = transformer.init_block_pool(cfg, nb, block_size)
+        pool = transformer.init_block_pool(cfg, nb, block_size,
+                                           kv_dtype=kv_dtype)
         jdf = jax.jit(decode_fn)
         if "decode_flops" not in kw:    # the trace is not free — skip
             pages = np.zeros((batch, cache_len // block_size), np.int32)
@@ -881,7 +907,7 @@ class PagedDecodeEngine(DecodeEngine):
                    batch=batch, cache_len=cache_len,
                    block_size=block_size, num_blocks=nb,
                    chunk_tokens=chunk_tokens, chunk_buckets=chunk_buckets,
-                   seed=seed,
+                   seed=seed, kv_dtype=kv_dtype,
                    pallas_mode=_pallas_policy.pallas_mode(pallas), **kw)
 
     # -- request API -------------------------------------------------------
@@ -1177,5 +1203,8 @@ class PagedDecodeEngine(DecodeEngine):
                     "blocks_in_use": self.pool.in_use,
                     "blocks_cached": self.pool.cached_free_count,
                     "prefix_cache_entries": self.pool.cached_count,
-                    "chunk_tokens": self.chunk_tokens})
+                    "chunk_tokens": self.chunk_tokens,
+                    "kv_dtype": self.kv_dtype,
+                    "kv_bytes_per_token": self.kv_bytes_per_token,
+                    "pool_bytes": self.pool_bytes})
         return doc

@@ -81,14 +81,16 @@ def _epilogue(mode):
     """The sampling tail of a decode program under the resolved
     ``PADDLE_TPU_PALLAS`` mode: the Pallas ``fused_sample`` kernel
     (greedy/top-k set exact, categorical matching in distribution) when
-    the kernels are on, ``sample_tokens`` otherwise."""
-    if mode == "off":
+    the kernels are dispatchable on this backend
+    (``decode.kernels_dispatchable`` — "on" falls back to
+    ``sample_tokens`` until the kernels lower through Mosaic),
+    ``sample_tokens`` otherwise."""
+    from paddle_tpu.ops.pallas import decode as _pallas_decode
+    if not _pallas_decode.kernels_dispatchable(mode):
         def tail(logits, seed, temperature, top_k):
             key = jax.random.PRNGKey(seed)
             return sample_tokens(logits, key, temperature, top_k)
     else:
-        from paddle_tpu.ops.pallas import decode as _pallas_decode
-
         def tail(logits, seed, temperature, top_k):
             return _pallas_decode.fused_sample(
                 logits, seed, temperature, top_k,
@@ -167,11 +169,15 @@ def paged_step_fns(cfg, block_size: int, dequant=None, pallas=None):
 
     ``pallas`` resolves the ``PADDLE_TPU_PALLAS`` policy (explicit arg
     > env > auto): when on, the decode step's attention runs the
-    flash-decode kernel over the pool and its sampling tail the fused
-    epilogue (``ops/pallas/decode.py``); the pure-XLA path stays the
-    always-available fallback. ``dequant`` applies to PREFILL only —
-    decode consumes {"q8","scale"} trees natively (in-scan dequant,
-    1-byte weight reads per token).
+    flash-decode kernel over the pool, the chunk prefill runs the
+    ``ops/pallas/prefill.py`` pair (chunk attention off the pool +
+    span-write kernel), and the sampling tail the fused epilogue; the
+    pure-XLA path stays the always-available fallback. ``dequant``
+    applies to PREFILL only — decode consumes {"q8","scale"} trees
+    natively (in-scan dequant, 1-byte weight reads per token). The
+    pool may be QUANTIZED (``init_block_pool(kv_dtype=...)``): both
+    step programs detect the layout from the pytree and carry the
+    write-time KV quantization + dequantizing reads on every path.
     """
     from paddle_tpu.models import transformer
     from paddle_tpu.ops.pallas import policy as _pallas_policy
@@ -185,7 +191,7 @@ def paged_step_fns(cfg, block_size: int, dequant=None, pallas=None):
                    temperature, top_k, seed):
         logits, pool = transformer.prefill_into_blocks(
             _live(params), pool, tokens, length, pages, cfg,
-            block_size=block_size)
+            block_size=block_size, pallas=mode)
         key = jax.random.PRNGKey(seed)
         tok = sample_tokens(logits, key, jnp.reshape(temperature, (1,)),
                             jnp.reshape(top_k, (1,)))

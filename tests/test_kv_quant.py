@@ -1,0 +1,328 @@
+"""int8/int4 KV-cache quantization of the paged block pool.
+
+Contracts (ISSUE 12, the PR-5/PR-9 tolerance-contract recipe):
+- pack/unpack int4 is an exact integer bijection; quantize_kv error is
+  bounded by half a grid step per element;
+- inactive decode rows write NEITHER values NOR scales (the scatter
+  isolation of the fp32 pool survives quantization);
+- page scrambling (values + scale tables permuted together) is
+  invisible bitwise — scales travel with their blocks;
+- quantized decode logits sit within the documented global rel-L2
+  budget of the fp32 pool (budget derived from the 0.5/127 resp. 0.5/7
+  rounding noise — ``transformer.kv_rel_l2_budget``);
+- hit-backed prefix-cache generation over int8 blocks is BITWISE the
+  cold int8 prefill (the PR-6 contract survives quantization);
+- the flash-decode kernel's fused dequant is bitwise the XLA quantized
+  path (interpret mode), composing with everything above.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.io import lm_serving
+from paddle_tpu.models import transformer
+from paddle_tpu.observe.compile_tracker import CompileTracker
+from paddle_tpu.ops import q8 as ops_q8
+from paddle_tpu.serving import PagedDecodeEngine
+
+CFG = transformer.TransformerConfig(
+    vocab=40, d_model=16, n_heads=2, n_kv_heads=1, n_layers=2, d_ff=32,
+    max_len=64, dtype=jnp.float32, use_rope=True)
+PARAMS = transformer.init_params(jax.random.PRNGKey(0), CFG)
+
+BS = 8
+KV_DTYPES = ("int8", "int4")
+
+
+def _paged(kv_dtype=None, pallas=None, params=PARAMS, **kw):
+    kw.setdefault("batch", 2)
+    kw.setdefault("cache_len", 48)
+    kw.setdefault("block_size", BS)
+    kw.setdefault("chunk_tokens", 8)
+    return PagedDecodeEngine.from_params(
+        params, CFG, seed=0, tracker=CompileTracker(),
+        kv_dtype=kv_dtype, pallas=pallas, **kw)
+
+
+def _cold_pool(prompt, kv_dtype, pages, chunks=(8, 6), params=PARAMS,
+               pallas="off"):
+    """Chunk-walk ``prompt`` into a fresh pool at the given physical
+    placement; returns (final-chunk logits, pool)."""
+    pool = transformer.init_block_pool(CFG, 8, BS, kv_dtype=kv_dtype)
+    off, lg = 0, None
+    for c in chunks:
+        bucket = 8 if c <= 8 else 16
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :c] = prompt[off:off + c]
+        pv = pages[:off // BS + -(-bucket // BS)]
+        lg, pool = transformer.prefill_into_blocks(
+            params, pool, jnp.asarray(padded),
+            jnp.asarray(c, jnp.int32), jnp.asarray(pv, jnp.int32),
+            CFG, block_size=BS, pallas=pallas)
+        off += c
+    return lg, pool
+
+
+def _scramble_quant(pool, pages, rng):
+    """Permute physical blocks of a QUANTIZED pool — values and scale
+    tables move together, page table remapped."""
+    M = pool["k"].shape[1]
+    nb = M // BS
+    perm = rng.permutation(nb).astype(np.int32)     # old block i -> perm[i]
+    gidx = np.empty(M, np.int64)
+    for i in range(nb):
+        gidx[perm[i] * BS:(perm[i] + 1) * BS] = np.arange(
+            i * BS, (i + 1) * BS)
+    pool2 = {k: jnp.asarray(np.asarray(v)[:, gidx])
+             for k, v in pool.items()}
+    pages2 = jnp.asarray(perm[np.asarray(pages)])
+    return pool2, pages2
+
+
+class TestKvPrimitives:
+    def test_int4_pack_unpack_roundtrip(self, rng):
+        q = rng.randint(-7, 8, (3, 5, 8)).astype(np.int8)
+        p = ops_q8.pack_int4(jnp.asarray(q))
+        assert p.shape == (3, 5, 4) and p.dtype == jnp.int8
+        np.testing.assert_array_equal(
+            np.asarray(ops_q8.unpack_int4(p)), q.astype(np.int32))
+        with pytest.raises(ValueError, match="even"):
+            ops_q8.pack_int4(jnp.zeros((2, 3), jnp.int8))
+
+    @pytest.mark.parametrize("kvd", KV_DTYPES)
+    def test_quantize_kv_halfstep_bound(self, kvd, rng):
+        x = jnp.asarray(rng.randn(6, 2, 8).astype(np.float32) * 3.0)
+        q, scale = ops_q8.quantize_kv(x, kvd)
+        assert scale.shape == (6, 2)
+        back = ops_q8.dequantize_kv(q, scale, kvd)
+        err = np.abs(np.asarray(back) - np.asarray(x))
+        # symmetric rounding: at most half a grid step per element
+        assert (err <= np.asarray(scale)[..., None] * 0.5 + 1e-7).all()
+
+    def test_quantize_kv_rejects_unknown(self):
+        with pytest.raises(ValueError, match="kv_dtype"):
+            ops_q8.quantize_kv(jnp.zeros((2, 4)), "int2")
+
+    def test_pool_layouts_and_detection(self):
+        fp = transformer.init_block_pool(CFG, 4, BS)
+        q8p = transformer.init_block_pool(CFG, 4, BS, kv_dtype="int8")
+        q4p = transformer.init_block_pool(CFG, 4, BS, kv_dtype="int4")
+        assert set(fp) == {"k", "v"}
+        assert set(q8p) == {"k", "v", "k_scale", "v_scale"}
+        assert q8p["k"].dtype == jnp.int8
+        assert q8p["k"].shape[-1] == CFG.head_dim
+        assert q4p["k"].shape[-1] == CFG.head_dim // 2
+        assert q8p["k_scale"].shape == (CFG.n_layers, 4 * BS,
+                                        CFG.kv_heads)
+        assert transformer.pool_kv_dtype(fp, CFG) == "none"
+        assert transformer.pool_kv_dtype(q8p, CFG) == "int8"
+        assert transformer.pool_kv_dtype(q4p, CFG) == "int4"
+        with pytest.raises(ValueError, match="kv_dtype"):
+            transformer.init_block_pool(CFG, 4, BS, kv_dtype="fp8")
+        odd = transformer.TransformerConfig(
+            vocab=8, d_model=6, n_heads=2, n_layers=1, d_ff=8,
+            max_len=16, dtype=jnp.float32)          # head_dim 3
+        with pytest.raises(ValueError, match="even"):
+            transformer.init_block_pool(odd, 2, 4, kv_dtype="int4")
+
+    def test_bytes_per_token_and_budgets(self):
+        fp = transformer.kv_pool_bytes_per_token(CFG)
+        q8b = transformer.kv_pool_bytes_per_token(CFG, "int8")
+        q4b = transformer.kv_pool_bytes_per_token(CFG, "int4")
+        L, Hkv, Dh = CFG.n_layers, CFG.kv_heads, CFG.head_dim
+        assert fp == L * 2 * Hkv * Dh * 4            # fp32 model dtype
+        assert q8b == L * (2 * Hkv * Dh + 2 * Hkv * 4)
+        assert q4b == L * (2 * Hkv * (Dh // 2) + 2 * Hkv * 4)
+        assert fp > q8b > q4b
+        # the grid-noise-derived budgets order and stay sane
+        b8 = transformer.kv_rel_l2_budget(CFG, "int8")
+        b4 = transformer.kv_rel_l2_budget(CFG, "int4")
+        assert 0 < b8 < b4 <= 0.5
+
+
+class TestQuantizedPoolKernels:
+    @pytest.mark.parametrize("kvd", KV_DTYPES)
+    def test_inactive_rows_write_neither_values_nor_scales(self, kvd,
+                                                           rng):
+        """The scatter's mode="drop" isolation covers the scale tables
+        too: an inactive row's block bytes AND scale rows are bitwise
+        untouched by a decode step."""
+        p1 = rng.randint(0, 40, 14).astype(np.int32)
+        _, pool = _cold_pool(p1, kvd, np.asarray([0, 1], np.int32))
+        tok = jnp.asarray([3, 5], jnp.int32)
+        pos = jnp.asarray([14, 9], jnp.int32)
+        active = jnp.asarray([True, False])
+        pages = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+        _, out = transformer.decode_step_paged(
+            PARAMS, pool, tok, pos, active, pages, CFG, block_size=BS,
+            pallas="off")
+        for leaf in ("k", "v", "k_scale", "v_scale"):
+            a, b = np.asarray(pool[leaf]), np.asarray(out[leaf])
+            # row 1 (inactive) targets blocks 2/3: untouched
+            np.testing.assert_array_equal(a[:, 2 * BS:4 * BS],
+                                          b[:, 2 * BS:4 * BS])
+        # row 0 (active) did write its position: pos 14 lives in its
+        # page-1 block (physical block 1) at offset 6
+        w = 1 * BS + 14 % BS
+        assert (np.asarray(out["k_scale"])[:, w] > 0).all()
+
+    @pytest.mark.parametrize("kvd", KV_DTYPES)
+    def test_page_scramble_invariance_scales_travel(self, kvd, rng):
+        """Physical placement is invisible on quantized pools: blocks
+        and their scale rows permute together, logits stay bitwise —
+        on the XLA path AND the interpret kernel."""
+        p1 = rng.randint(0, 40, 14).astype(np.int32)
+        lg, pool = _cold_pool(p1, kvd, np.asarray([0, 1], np.int32))
+        tok = jnp.argmax(lg, -1).astype(jnp.int32)
+        pos = jnp.asarray([14], jnp.int32)
+        active = jnp.ones((1,), bool)
+        pages = jnp.asarray([[0, 1]], jnp.int32)
+        l_id, _ = transformer.decode_step_paged(
+            PARAMS, pool, tok, pos, active, pages, CFG, block_size=BS,
+            pallas="off")
+        pool2, pages2 = _scramble_quant(pool, pages, rng)
+        for mode in ("off", "interpret"):
+            l_sc, _ = transformer.decode_step_paged(
+                PARAMS, pool2, tok, pos, active, pages2, CFG,
+                block_size=BS, pallas=mode)
+            np.testing.assert_array_equal(np.asarray(l_id),
+                                          np.asarray(l_sc))
+
+    @pytest.mark.parametrize("kvd", KV_DTYPES)
+    def test_rel_l2_within_documented_budget(self, kvd, rng):
+        """Global rel-L2 of quantized-pool decode logits vs the fp32
+        pool stays under the grid-noise-derived budget (and the budget
+        is tight enough that a wrong-scale bug, which lands O(1),
+        could never hide under it)."""
+        p1 = rng.randint(0, 40, 14).astype(np.int32)
+        pages = np.asarray([0, 1], np.int32)
+        lgs = {}
+        for pool_kvd in (None, kvd):
+            lg, pool = _cold_pool(p1, pool_kvd, pages)
+            tok = jnp.argmax(lg, -1).astype(jnp.int32)
+            lgd, _ = transformer.decode_step_paged(
+                PARAMS, pool, tok, jnp.asarray([14], jnp.int32),
+                jnp.ones((1,), bool), jnp.asarray([[0, 1]], jnp.int32),
+                CFG, block_size=BS, pallas="off")
+            lgs[pool_kvd] = np.asarray(lgd)
+        rel = (np.linalg.norm(lgs[kvd] - lgs[None])
+               / np.linalg.norm(lgs[None]))
+        budget = transformer.kv_rel_l2_budget(CFG, kvd)
+        assert rel < budget, (rel, budget)
+        assert rel > 0          # it IS quantized — exact would mean the
+        #                         fp32 path leaked through
+
+    @pytest.mark.parametrize("kvd", KV_DTYPES)
+    def test_chunk_grid_replay_bitwise_on_scrambled_placement(self, kvd,
+                                                              rng):
+        """The kernel core of the hit-replay guarantee survives
+        quantization: the same chunk grid at a different physical
+        placement produces bitwise the same logits and (relocated)
+        block bytes + scales."""
+        p1 = rng.randint(0, 40, 14).astype(np.int32)
+        lg1, pool1 = _cold_pool(p1, kvd, np.asarray([0, 1], np.int32))
+        lg2, pool2 = _cold_pool(p1, kvd, np.asarray([4, 2], np.int32))
+        np.testing.assert_array_equal(np.asarray(lg1), np.asarray(lg2))
+        for leaf in ("k", "v", "k_scale", "v_scale"):
+            a, b = np.asarray(pool1[leaf]), np.asarray(pool2[leaf])
+            np.testing.assert_array_equal(a[:, 0 * BS:1 * BS],
+                                          b[:, 4 * BS:5 * BS])
+            np.testing.assert_array_equal(a[:, 1 * BS:2 * BS],
+                                          b[:, 2 * BS:3 * BS])
+
+    def test_quant_decode_kernel_bitwise_vs_xla(self, rng):
+        """Fused-dequant flash decode == the XLA quantized path,
+        bitwise, logits AND written pool (values + scales)."""
+        p1 = rng.randint(0, 40, 14).astype(np.int32)
+        for kvd in KV_DTYPES:
+            lg, pool = _cold_pool(p1, kvd, np.asarray([0, 1], np.int32))
+            tok = jnp.argmax(lg, -1).astype(jnp.int32)
+            args = (tok, jnp.asarray([14], jnp.int32),
+                    jnp.ones((1,), bool), jnp.asarray([[0, 1]],
+                                                      jnp.int32))
+            l_x, c_x = transformer.decode_step_paged(
+                PARAMS, pool, *args, CFG, block_size=BS, pallas="off")
+            l_p, c_p = transformer.decode_step_paged(
+                PARAMS, pool, *args, CFG, block_size=BS,
+                pallas="interpret")
+            np.testing.assert_array_equal(np.asarray(l_x),
+                                          np.asarray(l_p))
+            for leaf in c_x:
+                np.testing.assert_array_equal(np.asarray(c_x[leaf]),
+                                              np.asarray(c_p[leaf]))
+
+
+class TestQuantizedEngine:
+    @pytest.mark.parametrize("kvd", KV_DTYPES)
+    def test_prefix_hit_bitwise_identical_to_cold(self, kvd, rng):
+        """The PR-6 contract survives quantization: hit-backed
+        generation over quantized blocks is bitwise the cold quantized
+        prefill — the cached block bytes (values + scales) ARE the
+        cold prefill's."""
+        prefix = rng.randint(0, 40, 16).astype(np.int32)
+        pa = np.concatenate([prefix,
+                             rng.randint(0, 40, 5).astype(np.int32)])
+        pb = np.concatenate([prefix,
+                             rng.randint(0, 40, 7).astype(np.int32)])
+        cold = _paged(kv_dtype=kvd)
+        ra_cold = cold.submit(pa, max_new=6)
+        cold.run_until_idle()
+        rb_cold = cold.submit(pb, max_new=6)
+        cold.run_until_idle()
+        assert ra_cold.prefix_hit_tokens == 0
+        assert rb_cold.prefix_hit_tokens == 16
+
+        warm = _paged(kv_dtype=kvd)
+        warm.submit(pa, max_new=6)
+        warm.run_until_idle()
+        ra_hit = warm.submit(pa, max_new=6)
+        warm.run_until_idle()
+        assert ra_hit.prefix_hit_tokens == 16
+        assert ra_hit.tokens == ra_cold.tokens
+        rb_hit = warm.submit(pb, max_new=6)
+        warm.run_until_idle()
+        assert rb_hit.prefix_hit_tokens == 16
+        assert rb_hit.tokens == rb_cold.tokens
+
+    def test_no_leak_and_gauges(self, rng):
+        eng = _paged(kv_dtype="int8", cache_len=32)
+        fp = _paged(cache_len=32)
+        assert eng.kv_dtype == "int8"
+        assert eng.kv_bytes_per_token == \
+            transformer.kv_pool_bytes_per_token(CFG, "int8")
+        assert fp.kv_bytes_per_token == \
+            transformer.kv_pool_bytes_per_token(CFG)
+        assert eng.kv_bytes_per_token < fp.kv_bytes_per_token
+        assert eng.metrics.get("engine_kv_bytes_per_token").value() \
+            == eng.kv_bytes_per_token
+        for n in (5, 20, 9, 26):
+            eng.submit(rng.randint(0, 40, n).astype(np.int32),
+                       max_new=4)
+        eng.run_until_idle()
+        assert eng.pool.idle
+        assert eng.pool.free_count + eng.pool.cached_free_count \
+            == eng.pool.num_blocks
+        h = eng.health()
+        assert h["kv_dtype"] == "int8"
+        assert h["kv_bytes_per_token"] == eng.kv_bytes_per_token
+        assert h["pool_bytes"] == eng.pool_bytes
+        assert "engine_kv_bytes_per_token" in eng.metrics_text()
+
+    @pytest.mark.parametrize("kvd", KV_DTYPES)
+    def test_pallas_engine_matches_xla_engine(self, kvd, rng):
+        """Fused-dequant kernels (decode + chunked prefill) over a
+        quantized pool: the interpret-mode engine's greedy ids equal
+        the XLA quantized engine's for every request — chunked
+        prompts, prefix hits and all."""
+        prompts = [rng.randint(0, 40, n).astype(np.int32)
+                   for n in (5, 21, 9)]
+        outs = {}
+        for mode in ("interpret", "off"):
+            eng = _paged(kv_dtype=kvd, pallas=mode)
+            reqs = [eng.submit(p, max_new=5) for p in prompts]
+            eng.run_until_idle()
+            outs[mode] = [r.output.tolist() for r in reqs]
+            assert eng.compile_counts()["decode"] == 1
+        assert outs["interpret"] == outs["off"]

@@ -272,7 +272,8 @@ def test_engine_artifact_v4_paged_roundtrip(tmp_path, rng):
     assert srv.meta["format_version"] == 4
     assert srv.meta["engine_paged"] == {
         "block_size": 8, "num_blocks": 8, "pages_per_slot": 4,
-        "chunk_tokens": 16, "pallas": pallas_policy.pallas_mode(None)}
+        "chunk_tokens": 16, "pallas": pallas_policy.pallas_mode(None),
+        "kv_dtype": "none"}
     assert srv.meta["engine_pallas"] == pallas_policy.pallas_mode(None)
     assert srv.cost_analysis["engine_decode"]["flops"] > 0
     # legacy lockstep path unchanged on a v4 artifact
@@ -339,6 +340,55 @@ def test_engine_artifact_v4_int8_roundtrip(tmp_path, rng):
             live, jnp.asarray(p[None]), CFG, max_new=6))[0]
         np.testing.assert_array_equal(r.output, want)
     assert eng.compile_counts()["decode"] == 1
+
+
+def test_engine_artifact_v4_kv_int8_roundtrip(tmp_path, rng):
+    """v4 + engine_kv_dtype="int8": the KV-dtype stamp rides
+    meta.engine_paged, the loader rebuilds the quantized pool (int8
+    values + fp32 scale tables) with no model code, and the served
+    engine's output is bitwise the in-process int8-pool engine's —
+    the artifact pins the pool layout, not just the programs."""
+    import pytest
+    from paddle_tpu.observe.compile_tracker import CompileTracker
+    from paddle_tpu.serving import PagedDecodeEngine
+    params = transformer.init_params(jax.random.PRNGKey(0), CFG)
+    path = str(tmp_path / "lm_v4_kv8.tar")
+    lm_serving.save_lm_artifact(path, params, CFG, batch=2,
+                                prompt_len=6, cache_len=32,
+                                engine_buckets=(8, 16),
+                                engine_paged=True, engine_block_size=8,
+                                engine_kv_dtype="int8")
+    srv = lm_serving.load_lm_artifact(path)
+    assert srv.meta["engine_paged"]["kv_dtype"] == "int8"
+    eng = srv.engine(seed=0, tracker=CompileTracker())
+    assert eng.kv_dtype == "int8"
+    assert eng.cache["k"].dtype == jnp.int8 and "k_scale" in eng.cache
+    ref = PagedDecodeEngine.from_params(
+        params, CFG, batch=2, cache_len=32, block_size=8,
+        chunk_tokens=16, seed=0, kv_dtype="int8",
+        tracker=CompileTracker())
+    prompts = [rng.randint(0, 40, n).astype(np.int32) for n in (5, 24)]
+    outs = {}
+    for name, e in (("art", eng), ("ref", ref)):
+        reqs = [e.submit(p, max_new=6) for p in prompts]
+        e.run_until_idle()
+        outs[name] = [r.output.tolist() for r in reqs]
+    assert outs["art"] == outs["ref"]
+    h = eng.health()
+    assert h["kv_dtype"] == "int8"
+    assert h["kv_bytes_per_token"] == ref.kv_bytes_per_token
+    # the quantized pool is a paged layout — the slot-arena export
+    # cannot carry it, and an export with NO engine at all must raise
+    # too rather than silently dropping the requested quantization
+    with pytest.raises(ValueError, match="engine_paged"):
+        lm_serving.save_lm_artifact(
+            str(tmp_path / "bad.tar"), params, CFG, batch=2,
+            prompt_len=6, cache_len=32, engine_buckets=(8,),
+            engine_kv_dtype="int8")
+    with pytest.raises(ValueError, match="engine_paged"):
+        lm_serving.save_lm_artifact(
+            str(tmp_path / "bad2.tar"), params, CFG, batch=2,
+            prompt_len=6, cache_len=32, engine_kv_dtype="int8")
 
 
 def test_engine_requires_v3(tmp_path, rng):

@@ -342,6 +342,33 @@ class TestEnginePallas:
         assert "engine_decode_mfu" in eng.metrics_text()
 
 
+class TestOnModeFallback:
+    def test_on_mode_serves_via_xla_instead_of_crashing(self, rng):
+        """``pallas="on"`` where the kernels cannot lower — every
+        backend in this jax version, ``MOSAIC_LOWERABLE`` is False —
+        must fall back to the XLA path with a one-time warning, not
+        fail the first compile. This is the path a real TPU hits by
+        DEFAULT (auto resolves "on"): before the guard, the engine
+        died on the Mosaic tiling error at its first decode."""
+        import warnings
+        assert fd.kernels_dispatchable("interpret") is True
+        assert fd.kernels_dispatchable("off") is False
+        fd._warned_fallback = False
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            assert fd.kernels_dispatchable("on") is False
+        assert any("falls back" in str(w.message) for w in rec)
+        prompts = [rng.randint(0, 40, n).astype(np.int32)
+                   for n in (5, 20)]
+        outs = {}
+        for mode in ("on", "off"):
+            eng = _paged(pallas=mode)
+            reqs = [eng.submit(p, max_new=5) for p in prompts]
+            eng.run_until_idle()
+            outs[mode] = [r.output.tolist() for r in reqs]
+        assert outs["on"] == outs["off"]
+
+
 class TestInt8Serving:
     def test_engine_q8_exact_vs_dequantized_reference(self, rng):
         """The in-scan dequant computes with bitwise the SAME live

@@ -294,6 +294,28 @@ class TestServingBenchSmoke:
             tp["engine_paged"]["tokens"]
         assert results["serving_int8_speedup"] > 0
         assert results["pallas"]["interpret_check_ok"] is True
+        # KV-quantization era fields: the int8-KV pool variant rode
+        # the throughput phase token-for-token at ~1/3 the bytes, the
+        # quantized interpret check (fused dequant, decode + chunked
+        # prefill) held, capacity shows >= 2x slots at equal HBM, and
+        # the cold-prefill / quality scoreboards materialized
+        assert tp["engine_paged_kv8"]["tokens"] == \
+            tp["engine_paged"]["tokens"]
+        assert tp["engine_paged_kv8"]["kv_dtype"] == "int8"
+        assert tp["engine_paged_kv8"]["kv_bytes_per_token"] < \
+            tp["engine_paged"]["kv_bytes_per_token"]
+        assert results["serving_kv8_speedup"] > 0
+        assert results["pallas"]["interpret_check_kv8_ok"] is True
+        cap = results["capacity"]
+        assert cap["slots_int8_ge_2x_fp32"] is True
+        assert cap["slots_at_equal_hbm_int8"] >= \
+            2 * cap["slots_at_equal_hbm_fp32"]
+        assert cap["slots_at_equal_hbm_int4"] >= \
+            cap["slots_at_equal_hbm_int8"]
+        assert results["cold_prefill"]["ttft_p50_cold_ms"] > 0
+        q = results["quality"]
+        assert 0 < q["kv_int8_rel_l2"] < q["kv_int8_rel_l2_budget"]
+        assert 0 < q["kv_int4_rel_l2"] < q["kv_int4_rel_l2_budget"]
         # per-request attribution replay: every request attributed
         # (the joined-lifecycle invariant is asserted INSIDE the bench
         # when --trace-out is given — reaching here means it held)
