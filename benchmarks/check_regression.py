@@ -57,9 +57,28 @@ FAMILIES = {
             ("capacity.slots_at_equal_hbm_int8", "higher", 0.02),
             ("capacity.slots_int8_ge_2x_fp32", "true", 0.0),
             ("serving_kv8_speedup", "higher", 0.15),
-            ("cold_prefill.ttft_p50_cold_ms", "lower", 0.35),
+            # cold TTFT is a single-digit-ms latency on a ONE-core
+            # shared host: alternating same-code A/B runs measured
+            # 5-45 ms swings purely from harness-process interleaving
+            # (PR-13 calibration), so the 35% band this figure shipped
+            # with fired on machine state, not code — the 2x ceiling
+            # still catches a real structural regression (a chunk-path
+            # pessimization shows up as an order of magnitude)
+            ("cold_prefill.ttft_p50_cold_ms", "lower", 1.0),
             ("quality.kv_int8_rel_l2", "lower", 0.10),
             ("quality.kv_int4_rel_l2", "lower", 0.10),
+            # multi-tenant scheduling + speculative decoding (PR-13
+            # fields; SKIP against older artifacts by design): the
+            # spec speedup is a same-machine ratio (tight-ish band;
+            # the bench itself asserts the absolute 1.5 floor on
+            # every full run), and the two scheduler contracts —
+            # latency-tier p99 separated below batch-tier, aggregate
+            # goodput no worse than FIFO — are booleans that must
+            # hold outright
+            ("spec_decode_speedup", "higher", 0.15),
+            ("spec_decode.acceptance_rate", "higher", 0.10),
+            ("tier_p99_separation_ok", "true", 0.0),
+            ("goodput_ge_fifo", "true", 0.0),
         ],
     },
     "elastic": {

@@ -627,6 +627,333 @@ def tpu_export_check(params, cfg, *, block_size, chunk_tokens, batch,
     return out
 
 
+def build_draft_pair(vocab, d_model, layers, heads, max_len, *,
+                     alpha=0.05, draft_layers=1, seed=0):
+    """A synthetically distilled (target, draft) pair: the target's
+    layers beyond ``draft_layers`` get their residual-output weights
+    (attn_out / mlp_out) scaled by ``alpha``, and the draft IS the
+    target's first ``draft_layers`` layers + the shared embedding head.
+    The target's compute cost is untouched (matmul shapes identical —
+    small values are not faster), but its logits land close to the
+    draft's, standing in for the trained/distilled draft a production
+    deployment ships. What the spec phase measures is the ENGINE
+    mechanics (propose/verify dispatch structure) at the acceptance
+    rate this pair reaches — the acceptance itself is reported in the
+    artifact, never assumed."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import transformer
+    cfg = transformer.TransformerConfig(
+        vocab=vocab, d_model=d_model, n_heads=heads, n_kv_heads=0,
+        n_layers=layers, d_ff=d_model * 4, max_len=max_len,
+        dtype=jnp.float32, use_rope=True)
+    params = transformer.init_params(jax.random.PRNGKey(seed), cfg)
+    blocks = dict(params["blocks"])
+    for leaf in ("attn_out", "mlp_out"):
+        w = np.array(blocks[leaf])
+        w[draft_layers:] *= alpha
+        blocks[leaf] = jnp.asarray(w)
+    params = dict(params, blocks=blocks)
+    draft_cfg = transformer.TransformerConfig(
+        vocab=vocab, d_model=d_model, n_heads=heads, n_kv_heads=0,
+        n_layers=draft_layers, d_ff=d_model * 4, max_len=max_len,
+        dtype=jnp.float32, use_rope=True)
+    draft_params = dict(params, blocks={
+        k: v[:draft_layers] for k, v in params["blocks"].items()})
+    return cfg, params, draft_cfg, draft_params
+
+
+def spec_phase(args):
+    """Speculative decoding A/B: the SAME greedy trace through a
+    target-only paged engine and a SpecDecodeEngine sharing the pool.
+    Figure of merit: ``spec_decode_speedup`` (tokens/sec ratio) — with
+    output BITWISE-identical between the two engines asserted on every
+    repeat (acceptance moves throughput, never tokens). The phase runs
+    its own config (small draft-friendly model, decode-step-bound
+    trace); the main phases' figures are untouched by it."""
+    import jax
+
+    from paddle_tpu.models import transformer
+    from paddle_tpu.observe.compile_tracker import CompileTracker
+    from paddle_tpu.serving import (PagedDecodeEngine, SpecDecodeEngine,
+                                    sampling)
+    if args.smoke:
+        vocab, d_model, layers, heads = 64, 16, 2, 2
+        cache_len, batch, k, n_req = 64, 2, 2, 4
+        tp, max_new, bs, chunk, repeats = 8, 8, 8, 16, 1
+    else:
+        # decode-step-bound config (modest batch, long pool view):
+        # where one verify dispatch replacing k+1 decode dispatches —
+        # and one pool-view stream serving W rows — actually pays on
+        # this backend; the draft pair's acceptance is ~0.95
+        vocab, d_model, layers, heads = 256, 64, 2, 2
+        cache_len, batch, k, n_req = 512, 6, 6, 24
+        tp, max_new, bs, chunk, repeats = 16, 64, 16, 64, \
+            max(1, args.repeats)
+    cfg, params, draft_cfg, draft_params = build_draft_pair(
+        vocab, d_model, layers, heads, cache_len + 32, seed=args.seed)
+    rng = np.random.RandomState(args.seed + 31)
+    prompts = [rng.randint(0, vocab, tp).astype(np.int32)
+               for _ in range(n_req)]
+    nb = batch * (cache_len // bs)
+    kw = dict(batch=batch, cache_len=cache_len, block_size=bs,
+              chunk_tokens=chunk, num_blocks=nb, seed=0,
+              decode_flops=None)
+    prefill_fn, decode_fn = sampling.paged_step_fns(cfg, bs,
+                                                    pallas="off")
+    jpf, jdf = jax.jit(prefill_fn), jax.jit(decode_fn)
+    spec_fns = sampling.paged_spec_fns(cfg, draft_cfg, bs, k,
+                                       pallas="off")
+    jspec = {n: jax.jit(f) for n, f in spec_fns.items()}
+    tr_t = CompileTracker(storm_threshold=99)
+    tr_s = CompileTracker(storm_threshold=99)
+
+    def mk_target():
+        pool = transformer.init_block_pool(cfg, nb, bs)
+        return PagedDecodeEngine(jpf, jdf, params, pool, tracker=tr_t,
+                                 **kw)
+
+    def mk_spec():
+        pool = transformer.init_block_pool(cfg, nb, bs)
+        dpool = transformer.init_block_pool(draft_cfg, nb, bs)
+        return SpecDecodeEngine(
+            jpf, jdf, params, pool, draft_params=draft_params,
+            draft_cache=dpool, draft_prefill=jspec["draft_prefill"],
+            propose=jspec["propose"], verify=jspec["verify"],
+            draft_verify=jspec["draft_verify"], spec_k=k,
+            tracker=tr_s, **kw)
+
+    def once(mk):
+        eng = mk()
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new=max_new) for p in prompts]
+        eng.run_until_idle()
+        wall = time.perf_counter() - t0
+        toks = sum(len(r.tokens) for r in reqs)
+        return toks / wall, [list(r.tokens) for r in reqs], eng
+
+    for mk in (mk_target, mk_spec):            # warm the programs
+        eng = mk()
+        eng.submit(prompts[0], max_new=4)
+        eng.run_until_idle()
+    best = {"target": 0.0, "spec": 0.0}
+    acc = None
+    for _ in range(repeats):                   # interleaved repeats
+        tps_t, out_t, _ = once(mk_target)
+        tps_s, out_s, eng_s = once(mk_spec)
+        assert out_t == out_s, (
+            "spec-decode greedy output diverged from the target-only "
+            "engine — the bitwise verify contract is broken")
+        best["target"] = max(best["target"], tps_t)
+        best["spec"] = max(best["spec"], tps_s)
+        acc = eng_s.acceptance_rate()
+    # compile discipline: the spec engine adds its OWN program set
+    # (draft prefill mirroring the chunk grid + one propose + one
+    # verify) while the TARGET program set is unchanged — same chunk
+    # programs, and the plain decode program never dispatches
+    assert tr_s.count("serving_engine.prefill") == \
+        tr_t.count("serving_engine.prefill"), (
+        "spec engine changed the TARGET chunk-program set: "
+        f"{tr_s.count('serving_engine.prefill')} vs "
+        f"{tr_t.count('serving_engine.prefill')}")
+    assert tr_s.count("serving_engine.draft_prefill") == \
+        tr_t.count("serving_engine.prefill")
+    assert tr_s.count("serving_engine.propose") == 1
+    assert tr_s.count("serving_engine.verify") == 1
+    assert tr_s.count("serving_engine.decode") == 0
+    assert tr_t.count("serving_engine.decode") == 1
+    speedup = best["spec"] / max(best["target"], 1e-9)
+    out = {"spec_k": k, "vocab": vocab, "d_model": d_model,
+           "layers": layers, "cache_len": cache_len, "batch": batch,
+           "requests": n_req, "max_new": max_new,
+           "draft_layers": draft_cfg.n_layers,
+           "acceptance_rate": round(acc, 4) if acc is not None else None,
+           "target_tokens_per_sec": round(best["target"], 1),
+           "spec_tokens_per_sec": round(best["spec"], 1),
+           "spec_decode_speedup": round(speedup, 3),
+           "greedy_bitwise_ok": True}
+    if not args.smoke:
+        assert speedup >= 1.5, (
+            f"spec_decode_speedup {speedup:.3f} below the 1.5 floor "
+            f"(acceptance {acc}) — artifact would certify a broken "
+            f"figure")
+    return out
+
+
+def build_tiered_workload(n, rate, vocab, seed, *, lat_frac=0.4,
+                          lat_lens=(12, 16, 24), lat_new=(8, 12, 16),
+                          bulk_lens=(48, 64, 96),
+                          bulk_new=(32, 48, 64)):
+    """[(arrival_s, prompt, max_new, tenant, tier)]: an interactive
+    tenant (short prompts, short outputs, latency tier) sharing the
+    engine with a bulk tenant (long prompts, long outputs, batch
+    tier) — the tiered-traffic collision the Ascend field study names
+    as the dominant serving regime."""
+    rng = np.random.RandomState(seed)
+    t, work = 0.0, []
+    for _ in range(n):
+        t += rng.exponential(1.0 / rate)
+        if rng.rand() < lat_frac:
+            tp = int(rng.choice(lat_lens))
+            mn = int(rng.choice(lat_new))
+            work.append((t, rng.randint(0, vocab, tp).astype(np.int32),
+                         mn, "interactive", "latency"))
+        else:
+            tp = int(rng.choice(bulk_lens))
+            mn = int(rng.choice(bulk_new))
+            work.append((t, rng.randint(0, vocab, tp).astype(np.int32),
+                         mn, "bulk", "batch"))
+    return work
+
+
+def _replay_tiered(eng, work, *, tiered):
+    """Replay a tiered workload; ``tiered=False`` submits everything
+    batch-tier (the single-class FIFO baseline) while keeping each
+    request's INTENDED tier for the per-tier percentile split."""
+    reqs, i, t0 = [], 0, time.perf_counter()
+    while len(reqs) < len(work) or not eng.idle:
+        now = time.perf_counter() - t0
+        while i < len(work) and work[i][0] <= now:
+            _, prompt, mn, tenant, tier = work[i]
+            reqs.append((eng.submit(
+                prompt, mn, tenant=tenant,
+                tier=tier if tiered else "batch"), tier))
+            i += 1
+        if eng.idle:
+            time.sleep(min(max(work[i][0] - now, 0.0), 0.05))
+            continue
+        eng.step()
+    wall = time.perf_counter() - t0
+    return reqs, wall
+
+
+def multitenant_phase(args):
+    """Multi-tenant scheduling A/B on ONE Poisson trace mixing an
+    interactive (latency-tier) and a bulk (batch-tier) tenant over a
+    deliberately TIGHT pool: ``tiered`` (real tiers — priority
+    admission + preempt-to-blocks) vs ``fifo`` (everything batch-tier,
+    the single-tenant PR-6 discipline). The scheduler must buy
+    latency-tier TTFT separation (latency p99 < batch p99 under
+    contention) without giving up aggregate goodput — under block
+    pressure it actually GAINS goodput, because tiered admission skips
+    past a reservation-blocked bulk head that FIFO would idle the pool
+    behind."""
+    import jax
+
+    from paddle_tpu.models import transformer
+    from paddle_tpu.observe.compile_tracker import CompileTracker
+    from paddle_tpu.serving import PagedDecodeEngine, sampling
+    if args.smoke:
+        vocab, d_model, layers, heads = 64, 16, 2, 2
+        cache_len, batch, n_req, rate = 64, 2, 8, 1e6
+        bs, chunk, nb, repeats = 8, 16, 12, 1
+        shape = dict(lat_lens=(4, 6), lat_new=(3, 4),
+                     bulk_lens=(16, 24), bulk_new=(8, 16))
+    else:
+        vocab, d_model, layers, heads = 256, 64, 2, 2
+        cache_len, batch, n_req, rate = 512, 8, 64, 150.0
+        bs, chunk = 16, 64
+        # tight pool + offered load far above capacity (the burst
+        # regime): ~3 bulk requests' worst case fills the pool within
+        # the first admission waves, so reservations (not slots) are
+        # the contended resource and latency-tier arrivals landing
+        # behind them actually preempt — on any machine speed
+        nb, repeats = 30, max(1, args.repeats)
+        shape = dict(bulk_new=(48, 64, 96))
+    cfg = transformer.TransformerConfig(
+        vocab=vocab, d_model=d_model, n_heads=heads, n_kv_heads=0,
+        n_layers=layers, d_ff=d_model * 4, max_len=cache_len + 32,
+        dtype=jax.numpy.float32, use_rope=True)
+    params = transformer.init_params(jax.random.PRNGKey(args.seed), cfg)
+    work = build_tiered_workload(n_req, rate, vocab, args.seed + 41,
+                                 **shape)
+    prefill_fn, decode_fn = sampling.paged_step_fns(cfg, bs,
+                                                    pallas="off")
+    jpf, jdf = jax.jit(prefill_fn), jax.jit(decode_fn)
+    tracker = CompileTracker(storm_threshold=99)
+
+    def mk():
+        pool = transformer.init_block_pool(cfg, nb, bs)
+        return PagedDecodeEngine(
+            jpf, jdf, params, pool, batch=batch, cache_len=cache_len,
+            block_size=bs, chunk_tokens=chunk, num_blocks=nb, seed=0,
+            tracker=tracker, decode_flops=None)
+
+    eng = mk()                                  # warm every program
+    for n in sorted({len(p) for _, p, _, _, _ in work}):
+        eng.submit(np.arange(n) % vocab, 2)
+        eng.run_until_idle()
+
+    def once(tiered):
+        eng = mk()
+        reqs, wall = _replay_tiered(eng, work, tiered=tiered)
+        toks = sum(len(r.tokens) for r, _ in reqs)
+        by_tier = {}
+        for r, tier in reqs:
+            by_tier.setdefault(tier, []).append(r.ttft_s)
+        out = {"tokens_per_sec": round(toks / wall, 2),
+               "wall_s": round(wall, 3),
+               "preemptions": int(eng.metrics.get(
+                   "engine_preemptions_total").value()),
+               "resumes_remap": int(eng.metrics.get(
+                   "engine_resumes_total").value(mode="remap")),
+               "resumes_replay": int(eng.metrics.get(
+                   "engine_resumes_total").value(mode="replay"))}
+        for tier, tt in sorted(by_tier.items()):
+            out[f"ttft_p50_{tier}_s"] = round(_pct(tt, 0.5), 4)
+            out[f"ttft_p99_{tier}_s"] = round(_pct(tt, 0.99), 4)
+            out[f"requests_{tier}"] = len(tt)
+        assert eng.pool.idle, "block leak after multi-tenant trace"
+        return out
+
+    runs_t, runs_f = [], []
+    for _ in range(repeats):
+        runs_t.append(once(True))
+        runs_f.append(once(False))
+    # the reported run per variant is its best at ITS OWN figure of
+    # merit (tiered = latency-tier p99, the SLO the scheduler serves;
+    # fifo = goodput, the bar it sets) — but the GOODPUT comparison
+    # must be best-vs-best at the SAME figure, or a machine-load spike
+    # during tiered's best-latency run would masquerade as scheduler
+    # overhead
+    best_t = min(runs_t, key=lambda r: r["ttft_p99_latency_s"])
+    best_f = max(runs_f, key=lambda r: r["tokens_per_sec"])
+    sep_ok = (best_t["ttft_p99_latency_s"]
+              < best_t["ttft_p99_batch_s"])
+    goodput_ratio = (max(r["tokens_per_sec"] for r in runs_t)
+                     / max(best_f["tokens_per_sec"], 1e-9))
+    out = {"requests": n_req, "rate": rate, "batch": batch,
+           "num_blocks": nb, "cache_len": cache_len,
+           "tiered": best_t, "fifo": best_f,
+           "tier_p99_separation_ok": bool(sep_ok),
+           "tier_ttft_p99_ratio": round(
+               best_t["ttft_p99_latency_s"]
+               / max(best_t["ttft_p99_batch_s"], 1e-9), 4),
+           # the scheduler's OWN effect: the latency tier's p99 under
+           # tiered admission vs the SAME requests under FIFO — the
+           # separation a short prompt gets for free cancels out of
+           # this ratio
+           "latency_p99_vs_fifo": round(
+               best_t["ttft_p99_latency_s"]
+               / max(best_f["ttft_p99_latency_s"], 1e-9), 4),
+           "goodput_ratio_vs_fifo": round(goodput_ratio, 4),
+           # >= within a 5% noise band: the two replays race the same
+           # wall clock on a shared host; the tight-pool design makes
+           # tiered genuinely >= 1.0 in the mean (admission skips the
+           # blocked bulk head FIFO idles behind)
+           "goodput_ge_fifo": bool(goodput_ratio >= 0.95)}
+    if not args.smoke:
+        assert sep_ok, (
+            f"latency-tier p99 {best_t['ttft_p99_latency_s']} not "
+            f"separated below batch-tier p99 "
+            f"{best_t['ttft_p99_batch_s']}")
+        assert sum(r["preemptions"] for r in runs_t) >= 1, (
+            "multitenant trace never exercised preemption — the "
+            "artifact would certify an idle scheduler")
+    return out
+
+
 def lockstep_factory(params, cfg, *, batch, cache_len, buckets):
     """(warm_fn, once_fn) for the pre-engine serving discipline: fill a
     FIFO batch (pad the tail group), share one prompt bucket, decode
@@ -1065,6 +1392,31 @@ def main(argv=None):
             **results["quality"]}
     print(json.dumps(line), flush=True)
     metrics_write(**line)
+
+    # multi-tenant scheduling A/B (tiered vs FIFO on a tight pool) and
+    # the speculative-decoding A/B — each on its own phase config, so
+    # the figures above are untouched; both run under --smoke too
+    # (compile asserts + bitwise contracts must not rot on tier-1)
+    results["multitenant"] = multitenant_phase(args)
+    line = {"bench": "serving", "phase": "multitenant",
+            "platform": jax.default_backend(),
+            **{k: v for k, v in results["multitenant"].items()
+               if not isinstance(v, dict)}}
+    print(json.dumps(line), flush=True)
+    metrics_write(**line)
+    results["tier_p99_separation_ok"] = \
+        results["multitenant"]["tier_p99_separation_ok"]
+    results["goodput_ge_fifo"] = \
+        results["multitenant"]["goodput_ge_fifo"]
+
+    results["spec_decode"] = spec_phase(args)
+    line = {"bench": "serving", "phase": "spec_decode",
+            "platform": jax.default_backend(),
+            **results["spec_decode"]}
+    print(json.dumps(line), flush=True)
+    metrics_write(**line)
+    results["spec_decode_speedup"] = \
+        results["spec_decode"]["spec_decode_speedup"]
 
     if args.tpu_check:
         results["tpu_check"] = tpu_export_check(

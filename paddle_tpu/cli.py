@@ -284,23 +284,50 @@ def job_serve(args):
     the point of continuous batching).
 
     Request lines:  {"prompt": [ids...], "max_new": 32,
-                     "temperature": 0.8, "top_k": 40, "eos_id": 2}
+                     "temperature": 0.8, "top_k": 40, "eos_id": 2,
+                     "tenant": "acme", "tier": "latency"}
     Result lines:   {"id": ..., "tokens": [ids...], "finish_reason":
                      "eos"|"max_tokens", "ttft_ms": ..., "latency_ms": ...}
 
+    ``tenant``/``tier`` are optional: tier "latency" admits ahead of
+    "batch" (and may preempt batch work's blocks on a paged engine); a
+    malformed tier is rejected with a counted reason
+    (``engine_requests_rejected_total{reason="bad_tier"}``) and an
+    error line, never a traceback. ``--tenant-budget acme=4096``
+    (repeatable) caps a tenant's in-flight tokens — exhaustion queues.
+
     ``--health_port`` exposes the engine's /metrics + /healthz (queue
-    depth, slot occupancy, TTFT histograms) while serving.
+    depth, slot occupancy, TTFT histograms, per-tier windows) while
+    serving.
     """
     import json
 
     from paddle_tpu.io import lm_serving
 
+    budgets = {}
+    for spec in args.tenant_budget:
+        tenant, eq, tokens = spec.partition("=")
+        try:
+            if not eq or not tenant or int(tokens) < 1:
+                raise ValueError
+            budgets[tenant] = int(tokens)
+        except ValueError:
+            print(f"serve: --tenant-budget expects TENANT=TOKENS "
+                  f"(TOKENS >= 1), got {spec!r}", file=sys.stderr)
+            return 1
     srv = lm_serving.load_lm_artifact(args.model)
     try:
         eng = srv.engine()
     except ValueError as e:
         print(f"serve: {e}", file=sys.stderr)
         return 1
+    if budgets:
+        if not hasattr(eng, "set_tenant_budget"):
+            print("serve: --tenant-budget needs a paged-engine "
+                  "artifact (format v4+)", file=sys.stderr)
+            return 1
+        for tenant, tokens in budgets.items():
+            eng.set_tenant_budget(tenant, tokens)
     if args.ttft_slo_ms:
         from paddle_tpu.observe import SloConfig
         eng.configure_slo(SloConfig(
@@ -354,7 +381,9 @@ def job_serve(args):
                             int(r.get("max_new", args.max_new)),
                             temperature=float(r.get("temperature", 0.0)),
                             top_k=int(r.get("top_k", 0)),
-                            eos_id=r.get("eos_id"))
+                            eos_id=r.get("eos_id"),
+                            tenant=str(r.get("tenant", "default")),
+                            tier=str(r.get("tier", "batch")))
                     except (ValueError, KeyError, TypeError) as e:
                         print(json.dumps({"error": str(e)}), flush=True)
             except _queue.Empty:
@@ -606,6 +635,14 @@ def main(argv=None):
     p.add_argument("--slo_window_s", type=float, default=60.0,
                    help="rolling window for SLO evaluation, seconds "
                         "(job=serve)")
+    p.add_argument("--tenant-budget", "--tenant_budget",
+                   action="append", default=[], dest="tenant_budget",
+                   metavar="TENANT=TOKENS",
+                   help="job=serve: cap TENANT's reserved tokens in "
+                        "flight (prompt+max_new of live requests); "
+                        "repeatable. Exhaustion queues the tenant's "
+                        "requests — it never rejects. Paged-engine "
+                        "artifacts only.")
     args = p.parse_args(argv)
 
     if args.metrics_out:

@@ -26,14 +26,19 @@ from paddle_tpu.io.merged import _add_member as _add   # shared tar append
 from paddle_tpu.observe import costs as _costs
 from paddle_tpu.observe import metrics as _metrics
 
-FORMAT_VERSION = 4   # max supported; plain artifacts still save as v1,
+FORMAT_VERSION = 5   # max supported; plain artifacts still save as v1,
 #                      int8-weight ones as v2; v3 adds the continuous-
 #                      batching engine modules (slot prefill per bucket +
 #                      vector-position decode with on-device sampling);
 #                      v4 replaces them with the PAGED engine modules
 #                      (chunked block-pool prefill per chunk bucket +
 #                      page-table decode — prefix caching and chunked
-#                      prefill are host-side scheduling over them)
+#                      prefill are host-side scheduling over them);
+#                      v5 additionally stamps a DRAFT model for
+#                      speculative decoding (draft params + its chunk
+#                      prefill / fused k-step propose / batched verify
+#                      modules — LMServer.engine() then schedules a
+#                      SpecDecodeEngine over the shared block table)
 
 
 def _unflatten(flat):
@@ -111,7 +116,10 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
                      engine_paged: bool = False,
                      engine_block_size: int = 16,
                      engine_num_blocks: Optional[int] = None,
-                     engine_kv_dtype: Optional[str] = None
+                     engine_kv_dtype: Optional[str] = None,
+                     engine_draft_params=None,
+                     engine_draft_config=None,
+                     engine_spec_k: int = 4
                      ) -> None:
     """Export the serving pair at fixed shapes and pack the artifact.
 
@@ -163,6 +171,18 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
         raise ValueError("engine_kv_dtype needs engine_paged=True "
                          "(the quantized pool is a paged-engine "
                          "layout)")
+    if (engine_draft_params is None) != (engine_draft_config is None):
+        raise ValueError("engine_draft_params and engine_draft_config "
+                         "come together (the draft model for "
+                         "speculative decoding)")
+    if engine_draft_params is not None and not engine_paged:
+        raise ValueError("engine_draft_params needs engine_paged=True "
+                         "(speculative decoding rides the paged block "
+                         "table)")
+    if engine_draft_config is not None \
+            and engine_draft_config.vocab != cfg.vocab:
+        raise ValueError(f"draft vocab {engine_draft_config.vocab} != "
+                         f"target vocab {cfg.vocab}")
 
     if weights_int8:
         params = quantize_lm_params(params)
@@ -279,6 +299,58 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
                 pool_shapes,
                 jax.ShapeDtypeStruct((batch, pages), jnp.int32))
             eng_decode_member = "engine_decode_paged.bin"
+            if engine_draft_params is not None:
+                # v5: the draft's program set — chunk prefill mirroring
+                # the target grid, fused k-step propose, the target's
+                # batched verify, and the draft-side forced-window
+                # write the preempt-resume replay needs
+                dcfg = engine_draft_config
+                k = int(engine_spec_k)
+                W = k + 1
+                spec = _sampling.paged_spec_fns(cfg, dcfg, bs, k,
+                                                dequant=dequant)
+                dp_shapes = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(
+                        np.shape(a),
+                        a.dtype if hasattr(a, "dtype")
+                        else np.asarray(a).dtype), engine_draft_params)
+                dpool_shapes = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    transformer.init_block_pool(dcfg, nb, bs))
+                for ctx in range(0, cache_len, chunk):
+                    for b in buckets:
+                        pv = ctx // bs + -(-b // bs)
+                        ep = jax.export.export(
+                            jax.jit(spec["draft_prefill"]), **kw)(
+                            dp_shapes, dpool_shapes,
+                            jax.ShapeDtypeStruct((1, b), jnp.int32),
+                            i32,
+                            jax.ShapeDtypeStruct((pv,), jnp.int32))
+                        engine_members[
+                            f"engine_draft_prefill_{b}_{pv}.bin"] = \
+                            ep.serialize()
+                pages_s = jax.ShapeDtypeStruct((batch, pages),
+                                               jnp.int32)
+                win_s = jax.ShapeDtypeStruct((batch, W), jnp.int32)
+                engine_members["engine_propose.bin"] = \
+                    jax.export.export(jax.jit(spec["propose"]), **kw)(
+                        dp_shapes, dpool_shapes, _vec(jnp.int32),
+                        _vec(jnp.int32), _vec(jnp.bool_),
+                        _vec(jnp.int32), pages_s).serialize()
+                jit_verify = jax.jit(spec["verify"])
+                verify_args = (p_shapes, pool_shapes, win_s,
+                               _vec(jnp.int32), _vec(jnp.int32),
+                               _vec(jnp.bool_), pages_s,
+                               _vec(jnp.float32), _vec(jnp.int32), i32)
+                engine_members["engine_verify.bin"] = \
+                    jax.export.export(jit_verify, **kw)(
+                        *verify_args).serialize()
+                engine_members["engine_draft_verify.bin"] = \
+                    jax.export.export(
+                        jax.jit(spec["draft_verify"]), **kw)(
+                        dp_shapes, dpool_shapes, win_s,
+                        _vec(jnp.int32), _vec(jnp.int32),
+                        _vec(jnp.bool_), pages_s).serialize()
         else:
             eng_prefill, eng_decode = _sampling.engine_step_fns(
                 cfg, dequant=dequant)
@@ -302,6 +374,10 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
               ("decode", jit_decode, decode_args)]
     if engine_buckets:
         phases.append(("engine_decode", jit_eng_decode, eng_decode_args))
+    if engine_draft_params is not None:
+        # the spec engine dispatches VERIFY rounds, not decode steps —
+        # its MFU numerator is the verify program's model FLOPs
+        phases.append(("engine_verify", jit_verify, verify_args))
     for phase, fn, args in phases:
         ca = _costs.lowered_cost(fn, *args)
         if ca:
@@ -311,9 +387,10 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
         # quantized artifacts carry nested {"q8","scale"} params — a v2
         # encoding; plain artifacts stay v1 for older loaders; engine
         # modules (whose member names older loaders would not recognise)
-        # bump to v3; paged engine modules to v4
-        "format_version": (4 if engine_paged else 3) if engine_buckets
-        else (2 if weights_int8 else 1),
+        # bump to v3; paged engine modules to v4; a stamped draft to v5
+        "format_version": (5 if engine_draft_params is not None
+                           else 4 if engine_paged else 3)
+        if engine_buckets else (2 if weights_int8 else 1),
         "batch": batch, "prompt_len": prompt_len, "cache_len": cache_len,
         "weights_int8": weights_int8, "config": _cfg_to_dict(cfg),
         "cost_analysis": cost_analysis}
@@ -322,12 +399,22 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
         meta["engine_pallas"] = engine_pallas
     if engine_paged_meta:
         meta["engine_paged"] = engine_paged_meta
+    draft_blob = None
+    if engine_draft_params is not None:
+        meta["engine_spec"] = {
+            "k": int(engine_spec_k),
+            "draft_config": _cfg_to_dict(engine_draft_config)}
+        dbuf = _io.BytesIO()
+        np.savez(dbuf, **_flatten(engine_draft_params))
+        draft_blob = dbuf.getvalue()
     flat = _flatten(params)
     buf = _io.BytesIO()
     np.savez(buf, **flat)
     with tarfile.open(path, "w") as tar:
         _add(tar, "meta.json", json.dumps(meta).encode())
         _add(tar, "params.npz", buf.getvalue())
+        if draft_blob is not None:
+            _add(tar, "draft_params.npz", draft_blob)
         _add(tar, "prefill.bin", exp_prefill.serialize())
         _add(tar, "decode.bin", exp_decode.serialize())
         for name, blob in engine_members.items():
@@ -354,12 +441,14 @@ class LMServer:
     """
 
     def __init__(self, meta, params, prefill_bin, decode_bin,
-                 engine_bins=None):
+                 engine_bins=None, draft_params=None):
         import jax
         import jax.export  # noqa: F401 — needs an explicit import
         self.meta = meta
         self.cfg = _cfg_from_dict(meta["config"])
         self.params = params
+        # v5: the stamped speculative-decoding draft (None below v5)
+        self.draft_params = draft_params
         self._prefill = jax.export.deserialize(prefill_bin)
         self._decode = jax.export.deserialize(decode_bin)
         # format-v3 continuous-batching modules (absent on v1/v2):
@@ -476,8 +565,7 @@ class LMServer:
             pool = transformer.init_block_pool(
                 cfg, paged["num_blocks"], paged["block_size"],
                 kv_dtype=kvd)
-            return PagedDecodeEngine(
-                prefill, decode, self.params, pool,
+            eng_kw = dict(
                 batch=self.meta["batch"],
                 cache_len=self.meta["cache_len"],
                 block_size=paged["block_size"],
@@ -489,6 +577,47 @@ class LMServer:
                     "engine_decode", {}).get("flops"),
                 pallas_mode=self.meta.get("engine_pallas"),
                 kv_dtype=kvd)
+            spec = self.meta.get("engine_spec")
+            if spec:
+                # v5: schedule the SpecDecodeEngine over the stamped
+                # draft — its pool rebuilt from the draft config at
+                # the SAME block geometry (one page table, two pools)
+                from paddle_tpu.serving.engine import SpecDecodeEngine
+                dcfg = _cfg_from_dict(spec["draft_config"])
+                draft_pool = transformer.init_block_pool(
+                    dcfg, paged["num_blocks"], paged["block_size"])
+                dprefills = {}
+                for name, blob in self._engine_bins.items():
+                    if not name.startswith("engine_draft_prefill_"):
+                        continue
+                    b, pv = name[len("engine_draft_prefill_"):
+                                 -len(".bin")].split("_")
+                    dprefills[(int(b), int(pv))] = \
+                        jax.export.deserialize(blob).call
+
+                def draft_prefill(dp, dpool, tokens, length, pagevec):
+                    key = (tokens.shape[1], pagevec.shape[0])
+                    return dprefills[key](dp, dpool, tokens, length,
+                                          pagevec)
+
+                eng_kw["decode_flops"] = self.cost_analysis.get(
+                    "engine_verify", {}).get(
+                    "flops", eng_kw["decode_flops"])
+                return SpecDecodeEngine(
+                    prefill, decode, self.params, pool,
+                    draft_params=self.draft_params,
+                    draft_cache=draft_pool,
+                    draft_prefill=draft_prefill,
+                    propose=jax.export.deserialize(
+                        self._engine_bins["engine_propose.bin"]).call,
+                    verify=jax.export.deserialize(
+                        self._engine_bins["engine_verify.bin"]).call,
+                    draft_verify=jax.export.deserialize(
+                        self._engine_bins[
+                            "engine_draft_verify.bin"]).call,
+                    spec_k=spec["k"], **eng_kw)
+            return PagedDecodeEngine(
+                prefill, decode, self.params, pool, **eng_kw)
         if chunk_tokens is not None:
             raise ValueError(
                 f"chunk_tokens={chunk_tokens}: this artifact (format "
@@ -610,7 +739,13 @@ def load_lm_artifact(path: str) -> LMServer:
     with np.load(_io.BytesIO(members["params.npz"]),
                  allow_pickle=False) as z:
         params = _unflatten({k: z[k] for k in z.files})
+    draft_params = None
+    if "draft_params.npz" in members:
+        with np.load(_io.BytesIO(members["draft_params.npz"]),
+                     allow_pickle=False) as z:
+            draft_params = _unflatten({k: z[k] for k in z.files})
     engine_bins = {k: v for k, v in members.items()
                    if k.startswith("engine_")}
     return LMServer(meta, params, members["prefill.bin"],
-                    members["decode.bin"], engine_bins=engine_bins)
+                    members["decode.bin"], engine_bins=engine_bins,
+                    draft_params=draft_params)

@@ -955,6 +955,174 @@ def decode_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
     return logits, new_cache
 
 
+def verify_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
+                      valid: jax.Array, active: jax.Array,
+                      pages: jax.Array, cfg: TransformerConfig, *,
+                      block_size: int):
+    """W tokens of EVERY slot in one pass over the paged pool — the
+    speculative-decoding verify step. tokens [B, W] int32 (row b holds
+    ``[last_token, draft_1, ..., draft_{W-1}]``), ``pos`` [B] int32 (the
+    position row b's FIRST token writes — decode_step_paged's ``pos``
+    semantics), ``valid`` [B] int32 (window rows beyond it neither write
+    nor matter), ``active`` [B] bool, ``pages`` [B, P] the FULL page
+    table → (logits [B, W, vocab] fp32, updated pool).
+
+    This is ``decode_step_paged`` with a W axis, and deliberately
+    nothing more: every reduction an output element depends on keeps
+    the decode step's axis LENGTH — attention scores/softmax/weighted
+    sum run over the same gathered ``T = P·block_size`` logical view
+    (full page table, not a trimmed span), layer norms over d_model,
+    the vocab head over d_model — and every dense op is row-wise over
+    a flattened ``[B·W, ...]`` batch. XLA's CPU/TPU reductions split
+    lanes by axis length, so equal lengths (plus row-independent
+    matmuls) make window row (b, j) BITWISE the decode step this slot
+    would have run at position ``pos+j`` — the property that lets a
+    spec-decode engine promise greedy output bitwise-identical to the
+    target-only engine (pinned in tests/test_spec_decode.py). A
+    chunk-prefill-shaped verify could not promise this: its
+    concat(context, chunk) softmax axis changes length with the span.
+    One backend caveat: the bitwise claim is the GEMM regime's — a
+    one-row decode batch ([1, D] @ W) may lower as a matvec whose
+    accumulation differs from the window's multi-row gemm at the ulp
+    level, so B >= 2 engines carry the pinned guarantee and B = 1 is
+    near-exact (greedy ids still agree except on sub-ulp logit ties).
+
+    Window causality: all W rows' k/v are scattered BEFORE the gather,
+    and row j masks the view at ``t <= pos+j`` — so row j attends to
+    rows < j of its own window plus itself, exactly the sequential
+    decode semantics (row i's activations depend only on positions
+    <= i, so recomputing them batched is the chunked-prefill argument).
+    Rows >= valid (and inactive slots) scatter to the out-of-bounds
+    index and are DROPPED, preserving the inactive-row isolation
+    contract. Rejected draft rows' k/v DO land in the pool — the
+    engine simply rewinds ``pos``, the attend mask hides them, and the
+    next window overwrites them (positions above ``pos`` are never
+    read, the same discipline as a freed slot's stale bytes).
+
+    Quantized pools and int8 {"q8","scale"} weight trees ride exactly
+    as in ``decode_step_paged`` (write-time KV quantization with
+    mode="drop" on values AND scales, in-scan weight dequant)."""
+    from paddle_tpu.ops import q8 as ops_q8
+    B, W = tokens.shape
+    N = B * W
+    P = pages.shape[1]
+    bs = int(block_size)
+    T = P * bs
+    H, Dh = cfg.n_heads, cfg.head_dim
+    Hkv = cfg.kv_heads
+    kvd = Hkv * Dh
+    M = cache["k"].shape[1]
+    quantized = _blocks_quantized(params)
+    kvq = pool_kv_dtype(cache, cfg)
+    pos = jnp.asarray(pos, jnp.int32)
+    valid = jnp.asarray(valid, jnp.int32)
+    pages = jnp.asarray(pages, jnp.int32)
+    gpos = pos[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+    flat = tokens.reshape(N)
+    x = _embed_rows(params, flat, cfg)                  # [N, D]
+    if not cfg.use_rope:
+        # clip keeps rows past `valid` (whose writes drop) in range;
+        # valid rows clip to themselves, bitwise the decode-step take
+        x = x + jnp.take(params["pos"],
+                         jnp.minimum(gpos.reshape(N),
+                                     params["pos"].shape[0] - 1),
+                         axis=0).astype(cfg.dtype)
+    rope_tabs = _rope_tables(gpos.reshape(N), Dh, cfg.rope_theta) \
+        if cfg.use_rope else None
+    # logical->physical map per slot [B, T] (decode's gidx, unchanged)
+    gidx = (pages[:, :, None] * bs
+            + jnp.arange(bs, dtype=jnp.int32)[None, None, :]
+            ).reshape(B, T)
+    # physical write index per window row; rows >= valid and inactive
+    # slots aim out of bounds so the scatter drops them
+    wpage = jnp.take_along_axis(pages, gpos // bs, axis=1)     # [B, W]
+    live = active[:, None] & (jnp.arange(W, dtype=jnp.int32)[None, :]
+                              < valid[:, None])
+    widx = jnp.where(live, wpage * bs + gpos % bs, M).reshape(N)
+    # row (b, j) sees logical positions t <= pos_b + j — the decode
+    # mask at that position, so axis length AND boundary match
+    attend = (jnp.arange(T, dtype=jnp.int32)[None, None, :]
+              <= gpos[:, :, None])                       # [B, W, T]
+
+    def block(x, scanned):
+        if kvq != "none":
+            w, li, kc, vc, ksc, vsc = scanned
+        else:
+            w, li, kc, vc = scanned
+            ksc = vsc = None
+        if quantized:
+            w = _live_layer_weights(w, li)
+        h = _layer_norm(x, w["ln1"], w["ln1_b"])
+        qkv = h @ w["qkv"].astype(h.dtype)              # [N, D + 2*kvd]
+        q, k, v = jnp.split(qkv, [H * Dh, H * Dh + kvd], axis=-1)
+        if cfg.use_rope:
+            q = _rope_rows(q.reshape(N, H, Dh), rope_tabs).reshape(
+                N, H * Dh)
+            k = _rope_rows(k.reshape(N, Hkv, Dh), rope_tabs).reshape(
+                N, kvd)
+        if kvq != "none":
+            kq, ks_new = ops_q8.quantize_kv(k.reshape(N, Hkv, Dh), kvq)
+            vq, vs_new = ops_q8.quantize_kv(v.reshape(N, Hkv, Dh), kvq)
+            kc = kc.at[widx].set(kq, mode="drop")
+            vc = vc.at[widx].set(vq, mode="drop")
+            ksc = ksc.at[widx].set(ks_new, mode="drop")
+            vsc = vsc.at[widx].set(vs_new, mode="drop")
+        else:
+            kc = kc.at[widx].set(k.reshape(N, Hkv, Dh).astype(kc.dtype),
+                                 mode="drop")
+            vc = vc.at[widx].set(v.reshape(N, Hkv, Dh).astype(vc.dtype),
+                                 mode="drop")
+        g = H // Hkv
+        if kvq != "none":
+            kt = ops_q8.dequantize_kv(
+                jnp.take(kc, gidx, axis=0),
+                jnp.take(ksc, gidx, axis=0), kvq)
+            vt = ops_q8.dequantize_kv(
+                jnp.take(vc, gidx, axis=0),
+                jnp.take(vsc, gidx, axis=0), kvq)
+        else:
+            kt = jnp.take(kc, gidx, axis=0).astype(jnp.float32)
+            vt = jnp.take(vc, gidx, axis=0).astype(jnp.float32)
+        q32 = q.reshape(B, W, Hkv, g, Dh).astype(jnp.float32)
+        s = jnp.einsum("bwkgd,btkd->bwkgt", q32, kt) / math.sqrt(Dh)
+        s = jnp.where(attend[:, :, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bwkgt,btkd->bwkgd", p, vt)
+        attn = attn.reshape(N, cfg.d_model).astype(cfg.dtype)
+        x = x + attn @ w["attn_out"].astype(attn.dtype)
+        h2 = _layer_norm(x, w["ln2"], w["ln2_b"])
+        if cfg.moe_experts:
+            import dataclasses as _dc
+
+            from paddle_tpu.parallel import moe
+            mc = _dc.replace(cfg.moe_cfg(), capacity_factor=float(
+                cfg.moe_experts) / cfg.moe_top_k)
+            out, _ = moe.moe_ffn(
+                {"gate": w["gate"], "w_in": w["moe_w_in"],
+                 "w_out": w["moe_w_out"]}, h2, mc)
+            x = x + out.astype(x.dtype)
+        else:
+            ff = jax.nn.gelu(h2 @ w["mlp_in"].astype(h2.dtype))
+            x = x + ff @ w["mlp_out"].astype(ff.dtype)
+        if kvq != "none":
+            return x, (kc, vc, ksc, vsc)
+        return x, (kc, vc)
+
+    li = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    if kvq != "none":
+        x, (kn, vn, ksn, vsn) = jax.lax.scan(
+            block, x, (params["blocks"], li, cache["k"], cache["v"],
+                       cache["k_scale"], cache["v_scale"]))
+        new_cache = {"k": kn, "v": vn, "k_scale": ksn, "v_scale": vsn}
+    else:
+        x, (kn, vn) = jax.lax.scan(block, x, (params["blocks"], li,
+                                              cache["k"], cache["v"]))
+        new_cache = {"k": kn, "v": vn}
+    x = _layer_norm(x, params["ln_f"], params["ln_f_b"])
+    logits = _vocab_logits(x, params)
+    return logits.reshape(B, W, cfg.vocab), new_cache
+
+
 def prefill_into_blocks(params, cache, tokens: jax.Array,
                         length: jax.Array, pages: jax.Array,
                         cfg: TransformerConfig, *, block_size: int,

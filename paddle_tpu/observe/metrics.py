@@ -88,6 +88,15 @@ class Metric:
         with self._lock:
             return dict(self._series)
 
+    def remove(self, **labels):
+        """Drop one labelled series (no-op when absent) — the
+        bounded-cardinality hygiene hook for per-entity samples whose
+        entity set changes at runtime (e.g. a tenant whose budget is
+        removed: its gauge must not freeze at the last written value
+        forever)."""
+        with self._lock:
+            self._series.pop(_label_key(labels), None)
+
     def clear(self):
         with self._lock:
             self._series.clear()

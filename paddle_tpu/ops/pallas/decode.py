@@ -414,3 +414,26 @@ def fused_sample(logits: jax.Array, seed: jax.Array,
       jnp.reshape(temperature, (B, 1)).astype(jnp.float32),
       jnp.reshape(top_k, (B, 1)).astype(jnp.int32))
     return out[:, 0]
+
+
+def fused_spec_verify(logits: jax.Array, draft: jax.Array,
+                      seed: jax.Array, temperature: jax.Array,
+                      top_k: jax.Array, valid: jax.Array, *,
+                      interpret: bool = False):
+    """Speculative-decoding accept/reject epilogue: the PR-9
+    ``fused_sample`` kernel run once per VERIFY-WINDOW row (logits
+    [B, W, V] flattened to [B·W, V] — per-slot temperature/top_k
+    broadcast over the window) followed by the accept fold
+    (``serving.sampling.spec_accept``: leading draft-match run + one
+    correction/bonus token, capped to ``valid`` rows). Greedy rows are
+    the kernel's exact first-index argmax, so the fused path emits
+    bitwise the ``spec_verify_tokens`` greedy tokens — the spec
+    engine's bitwise-greedy contract holds on either epilogue.
+    Returns (sampled [B, W] int32, n_emitted [B] int32)."""
+    from paddle_tpu.serving import sampling as _sampling
+    B, W, V = logits.shape
+    sampled = fused_sample(
+        logits.reshape(B * W, V), seed,
+        jnp.repeat(temperature, W), jnp.repeat(top_k, W),
+        interpret=interpret).reshape(B, W)
+    return sampled, _sampling.spec_accept(sampled, draft, valid)

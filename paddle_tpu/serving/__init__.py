@@ -24,7 +24,8 @@ Public surface:
 from paddle_tpu.serving.blocks import (  # noqa: F401
     BlockPool, chain_hash, prompt_block_hashes)
 from paddle_tpu.serving.engine import (  # noqa: F401
-    DEFAULT_PREFILL_BUCKETS, DecodeEngine, EngineRequest,
-    PagedDecodeEngine, default_chunk_buckets)
+    DEFAULT_PREFILL_BUCKETS, VALID_TIERS, DecodeEngine, EngineRequest,
+    PagedDecodeEngine, SpecDecodeEngine, default_chunk_buckets)
 from paddle_tpu.serving.sampling import (  # noqa: F401
-    engine_step_fns, paged_step_fns, sample_tokens)
+    engine_step_fns, paged_spec_fns, paged_step_fns, sample_tokens,
+    spec_accept, spec_verify_tokens)

@@ -193,3 +193,18 @@ class BlockPool:
             return
         self._index[digest] = block
         self._hash[block] = digest
+
+    def unpublish(self, block: int):
+        """Drop ``block``'s prefix-cache entry, if any. The
+        preempt-to-blocks resume path calls this on the revived PARTIAL
+        tail block right before decoding writes into it again — its
+        bytes are about to stop matching the published digest. A
+        refcount-0 LRU-parked block loses its cache-worthiness too and
+        returns to the plain free list."""
+        h = self._hash.pop(block, None)
+        if h is None:
+            return
+        del self._index[h]
+        if block in self._lru:
+            del self._lru[block]
+            self._free.append(block)

@@ -34,9 +34,16 @@ endpoints (``observe/health.py``).
 :class:`PagedDecodeEngine` supersedes the row-per-request arena with a
 block-table KV layout (paged pool + per-slot page vectors, chunked
 prefill interleaved with decode, content-hash prefix cache with
-refcounted blocks and LRU eviction) — see its docstring;
-:class:`DecodeEngine` remains the legacy whole-row engine that
-format-v3 artifacts load into.
+refcounted blocks and LRU eviction) and carries the multi-tenant
+scheduler: latency/batch tiers with strict-priority admission,
+per-tenant token budgets (exhaustion queues, never rejects), and
+preempt-to-blocks — a batch-tier victim's pages re-publish into the
+prefix cache so resume is either a pure host re-mapping or a
+cache-hit chunked prefill, bitwise either way.
+:class:`SpecDecodeEngine` adds speculative decoding on top (draft
+model sharing the block table, fused k-step propose, batched-window
+verify bitwise the decode step). :class:`DecodeEngine` remains the
+legacy whole-row engine that format-v3 artifacts load into.
 """
 
 import dataclasses
@@ -71,6 +78,12 @@ _GOODPUT_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
                     500.0, 1000.0, 2500.0, 5000.0, 10000.0)
 
 
+# the two scheduling tiers: "latency" admits ahead of "batch" and may
+# preempt a batch-tier victim's blocks; "batch" fills whatever capacity
+# latency traffic leaves (and is the only tier preemption may evict)
+VALID_TIERS = ("latency", "batch")
+
+
 @dataclasses.dataclass
 class EngineRequest:
     """One generation request and its lifecycle record."""
@@ -80,6 +93,8 @@ class EngineRequest:
     temperature: float = 0.0
     top_k: int = 0
     eos_id: Optional[int] = None
+    tenant: str = "default"             # token-budget accounting key
+    tier: str = "batch"                 # latency | batch (VALID_TIERS)
     # -- lifecycle (filled by the engine) --------------------------------
     bucket: int = 0
     slot: int = -1
@@ -100,6 +115,15 @@ class EngineRequest:
     trace_id: str = ""                  # eng<N>.r<rid>: joins this
     #                                     request's lifecycle events
     decode_open: bool = False           # a "decode" trace slice is open
+    preemptions: int = 0                # times preempted to blocks
+    # preempt-to-blocks resume state (paged engine): the host snapshot
+    # taken at preemption (block-chain digests + decode cursor), and —
+    # on the eviction-fallback path — the already-emitted tokens the
+    # replay force-feeds through the decode program without re-emitting
+    snapshot: Optional[dict] = dataclasses.field(
+        default=None, repr=False)
+    replay: Optional[List[int]] = dataclasses.field(
+        default=None, repr=False)
 
     @property
     def output(self) -> np.ndarray:
@@ -306,6 +330,19 @@ class DecodeEngine:
         win = slo.window_s if slo is not None else 60.0
         self._win_ttft = WindowedQuantiles(window_s=win)
         self._win_tps = WindowedQuantiles(window_s=win)
+        # per-tier TTFT windows (created lazily as tiers appear) feed
+        # the {q, tier}-labelled gauge samples: the scheduler's whole
+        # point is per-tier p99 separation, which the aggregate window
+        # cannot show
+        self._win_ttft_tier: Dict[str, WindowedQuantiles] = {}
+        self._tier_window_s = win
+
+    def _tier_window(self, tier: str) -> WindowedQuantiles:
+        win = self._win_ttft_tier.get(tier)
+        if win is None:
+            win = self._win_ttft_tier[tier] = WindowedQuantiles(
+                window_s=self._tier_window_s)
+        return win
 
     def _wall(self, perf_t: float) -> float:
         return self._wall_anchor + perf_t
@@ -349,7 +386,8 @@ class DecodeEngine:
         self._m_requests.inc()
         self._m_queue.set(len(self._queue))
         self._ev(req, "request", "b", req.submit_t, rid=req.rid,
-                 prompt_tokens=int(req.prompt.size), max_new=req.max_new)
+                 prompt_tokens=int(req.prompt.size), max_new=req.max_new,
+                 tenant=req.tenant, tier=req.tier)
         self._ev(req, "queued", "b", req.submit_t)
         return req
 
@@ -363,6 +401,8 @@ class DecodeEngine:
                "trace_id": req.trace_id,
                "submit_ts": round(self._wall(req.submit_t), 6),
                "finish_reason": req.finish_reason,
+               "tenant": req.tenant, "tier": req.tier,
+               "preemptions": req.preemptions,
                "prompt_tokens": int(req.prompt.size),
                "tokens": len(req.tokens),
                "queue_wait_s": r6(req.queue_wait_s),
@@ -394,21 +434,42 @@ class DecodeEngine:
         for lbl, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
             self._m_win_ttft.set(ttft[q], q=lbl)
             self._m_win_tps.set(tps[q], q=lbl)
+        # per-tier split of the same gauge ({q, tier} samples): the
+        # scheduler's effect IS the separation between these series
+        for tier, win in self._win_ttft_tier.items():
+            tq = win.quantiles((0.5, 0.95, 0.99))
+            for lbl, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+                self._m_win_ttft.set(tq[q], q=lbl, tier=tier)
         self._m_burn.set(self._slo_burn_rate())
 
     # -- request API -------------------------------------------------------
-    def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
-               top_k: int = 0, eos_id: Optional[int] = None
-               ) -> EngineRequest:
-        """Queue one request; returns its (live) EngineRequest record."""
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
-        rid = next(self._ids)
+    def _validate_submit(self, rid: int, prompt, max_new: int,
+                         tier: str):
+        """Shared submit validation (both engines): counted rejections,
+        never tracebacks, for the malformed-request classes a JSONL
+        wire can deliver."""
         if prompt.size < 1:
             raise self._reject(rid, "empty_prompt", "submit: empty prompt")
         if max_new < 1:
             raise self._reject(rid, "bad_max_new",
                                f"submit: max_new must be >= 1, "
                                f"got {max_new}")
+        if tier not in VALID_TIERS:
+            raise self._reject(rid, "bad_tier",
+                               f"submit: tier must be one of "
+                               f"{VALID_TIERS}, got {tier!r}")
+
+    def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
+               top_k: int = 0, eos_id: Optional[int] = None,
+               tenant: str = "default", tier: str = "batch"
+               ) -> EngineRequest:
+        """Queue one request; returns its (live) EngineRequest record.
+        ``tenant``/``tier`` ride into the request log and trace events;
+        the row-arena engine schedules FIFO regardless (tiered
+        admission and preemption live in :class:`PagedDecodeEngine`)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        rid = next(self._ids)
+        self._validate_submit(rid, prompt, max_new, tier)
         from paddle_tpu.core import ragged
         if prompt.size > self.buckets[-1]:
             # beyond the largest bucket there is no compiled prefill
@@ -426,7 +487,8 @@ class DecodeEngine:
         req = EngineRequest(
             rid=rid, prompt=prompt, max_new=int(max_new),
             temperature=float(temperature), top_k=int(top_k),
-            eos_id=eos_id, bucket=bucket, submit_t=time.perf_counter())
+            eos_id=eos_id, tenant=str(tenant), tier=str(tier),
+            bucket=bucket, submit_t=time.perf_counter())
         return self._enqueue(req)
 
     @property
@@ -481,6 +543,7 @@ class DecodeEngine:
             ttft = now - req.submit_t
             self._m_ttft_s.observe(ttft)
             self._win_ttft.observe(ttft)
+            self._tier_window(req.tier).observe(ttft)
             self._ev(req, "prefill", "e", now)
             self._ev(req, "first_token", "n", now,
                      ttft_ms=round(1000 * ttft, 3))
@@ -550,6 +613,14 @@ class DecodeEngine:
         paged engine's page table)."""
         return ()
 
+    def _consume_forced(self, slot: int) -> bool:
+        """True when this slot is replaying already-emitted history
+        after a preempt-to-blocks resume (paged engine): the decode
+        step's sampled id is discarded, the known token advances the
+        cursor, and nothing re-emits. The row-arena engine never
+        preempts."""
+        return False
+
     def _update_gauges(self):
         self._m_occupancy.set(self.active_count)
 
@@ -579,6 +650,8 @@ class DecodeEngine:
             if mfu is not None:
                 self._m_decode_mfu.set(mfu)
             for slot in np.flatnonzero(self._active):
+                if self._consume_forced(slot):
+                    continue
                 req = self._slot_req[slot]
                 tok = int(nxt[slot])
                 self._pos[slot] += 1
@@ -640,6 +713,12 @@ class DecodeEngine:
             "ttft_p95_s": round(ttft[0.95], 6),
             "ttft_p99_s": round(ttft[0.99], 6),
             "tokens_per_sec_p50": round(self._win_tps.quantile(0.5), 3)}
+        if self._win_ttft_tier:
+            doc["window"]["tiers"] = {
+                tier: {"requests": win.count(),
+                       "ttft_p50_s": round(win.quantile(0.5), 6),
+                       "ttft_p99_s": round(win.quantile(0.99), 6)}
+                for tier, win in sorted(self._win_ttft_tier.items())}
         if self.slo is not None:
             burn = self._slo_burn_rate()
             doc["slo"] = {"ttft_s": self.slo.ttft_s,
@@ -757,7 +836,8 @@ class PagedDecodeEngine(DecodeEngine):
                  slo: Optional[SloConfig] = None,
                  decode_flops: Optional[float] = None,
                  pallas_mode: Optional[str] = None,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 tenant_budgets: Optional[Dict[str, int]] = None):
         from paddle_tpu.serving import blocks as _blocks
         bs = int(block_size)
         if bs < 1 or cache_len % bs:
@@ -825,7 +905,28 @@ class PagedDecodeEngine(DecodeEngine):
         self._slot_prefill_s = [0.0] * B    # device seconds across chunks
         self._prefilling: deque = deque()   # slots mid-prompt, round-robin
         self._evictions_seen = 0
+        # -- multi-tenant scheduling state -------------------------------
+        # budgets cap a tenant's RESERVED tokens in flight (admitted,
+        # unfinished requests' prompt+max_new); exhaustion queues the
+        # tenant's requests — other tenants admit past them
+        self.tenant_budgets: Dict[str, int] = dict(tenant_budgets or {})
+        self._tenant_used: Dict[str, int] = {}
+        self._preempted: deque = deque()    # preempted reqs awaiting resume
+        self._slot_forced: List[deque] = [deque() for _ in range(B)]
         reg = self.metrics
+        self._m_preempts = reg.counter(
+            "engine_preemptions_total", "batch-tier victims preempted "
+            "to blocks (pages re-published to the prefix cache) so a "
+            "latency-tier request could reserve")
+        self._m_resumes = reg.counter(
+            "engine_resumes_total", "preempted requests resumed, by "
+            "mode: remap = every snapshot block still cached (pure "
+            "host re-mapping), replay = eviction fallback (cache-hit "
+            "chunked prefill + forced decode replay)")
+        self._m_tenant_tokens = reg.gauge(
+            "engine_tenant_tokens_in_flight", "reserved tokens "
+            "(prompt + max_new of live requests) per tenant — what the "
+            "token budget caps")
         self._m_blocks_in_use = reg.gauge(
             "engine_blocks_in_use", "pool blocks referenced by live "
             "requests")
@@ -911,21 +1012,37 @@ class PagedDecodeEngine(DecodeEngine):
                    pallas_mode=_pallas_policy.pallas_mode(pallas), **kw)
 
     # -- request API -------------------------------------------------------
+    def set_tenant_budget(self, tenant: str, tokens: Optional[int]):
+        """Cap (or with ``None`` uncap) ``tenant``'s reserved tokens in
+        flight. Takes effect at the next admission — live requests are
+        never evicted by a budget change (budgets queue, they do not
+        kill). Submissions whose own prompt+max_new exceeds the cap are
+        REJECTED (reason ``exceeds_budget``) — they could never admit;
+        note that shrinking a budget below an already-QUEUED request's
+        charge parks that request until the budget is raised again.
+        Per-tenant gauge samples exist only for budgeted tenants;
+        uncapping drops the sample (it would otherwise freeze at its
+        last value)."""
+        if tokens is None:
+            self.tenant_budgets.pop(tenant, None)
+            self._m_tenant_tokens.remove(tenant=tenant)
+        else:
+            self.tenant_budgets[str(tenant)] = int(tokens)
+
     def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
-               top_k: int = 0, eos_id: Optional[int] = None
+               top_k: int = 0, eos_id: Optional[int] = None,
+               tenant: str = "default", tier: str = "batch"
                ) -> EngineRequest:
         """Queue one request. Unlike the row-arena engine there is no
         largest-bucket rejection: any prompt with
         ``len(prompt) + max_new <= cache_len`` is accepted and prefilled
-        in chunks."""
+        in chunks. ``tier="latency"`` admits ahead of batch-tier work
+        and may preempt a batch victim's blocks under pool pressure;
+        ``tenant`` charges the request's worst-case tokens against that
+        tenant's budget (exhaustion queues, never rejects)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         rid = next(self._ids)
-        if prompt.size < 1:
-            raise self._reject(rid, "empty_prompt", "submit: empty prompt")
-        if max_new < 1:
-            raise self._reject(rid, "bad_max_new",
-                               f"submit: max_new must be >= 1, "
-                               f"got {max_new}")
+        self._validate_submit(rid, prompt, max_new, tier)
         if prompt.size + max_new > self.cache_len:
             raise self._reject(
                 rid, "exceeds_cache",
@@ -941,16 +1058,32 @@ class PagedDecodeEngine(DecodeEngine):
                 f"submit: {prompt.size} prompt + {max_new} new tokens "
                 f"need {need} blocks, exceeding the pool's "
                 f"{self.num_blocks}")
+        budget = self.tenant_budgets.get(str(tenant))
+        if budget is not None and prompt.size + max_new > budget:
+            # same never-admittable class for budgets: a request whose
+            # OWN charge exceeds its tenant's cap could never pass
+            # _budget_ok even with nothing in flight — it would queue
+            # forever (budget exhaustion queues; impossibility rejects)
+            raise self._reject(
+                rid, "exceeds_budget",
+                f"submit: {prompt.size} prompt + {max_new} new tokens "
+                f"exceed tenant {tenant!r}'s budget of {budget}")
         req = EngineRequest(
             rid=rid, prompt=prompt, max_new=int(max_new),
             temperature=float(temperature), top_k=int(top_k),
-            eos_id=eos_id, bucket=0, submit_t=time.perf_counter())
+            eos_id=eos_id, tenant=str(tenant), tier=str(tier),
+            bucket=0, submit_t=time.perf_counter())
         return self._enqueue(req)
 
     @property
+    def preempted_count(self) -> int:
+        """Preempted requests parked awaiting resume."""
+        return len(self._preempted)
+
+    @property
     def idle(self) -> bool:
-        return (not self._queue and not self._prefilling
-                and not self._active.any())
+        return (not self._queue and not self._preempted
+                and not self._prefilling and not self._active.any())
 
     # -- scheduler ---------------------------------------------------------
     def _alloc_page(self, slot: int):
@@ -961,77 +1094,393 @@ class PagedDecodeEngine(DecodeEngine):
         self._slot_blocks[slot].append(b)
         self._slot_reserved[slot] -= 1
 
-    def _admit(self, finished: List[EngineRequest]):
+    # -- multi-tenant admission / preemption -------------------------------
+    def _charge(self, req: EngineRequest) -> int:
+        """Worst-case tokens a live request holds against its tenant's
+        budget — the same prompt+max_new the block reservation backs."""
+        return int(req.prompt.size) + int(req.max_new)
+
+    def _budget_ok(self, req: EngineRequest) -> bool:
+        budget = self.tenant_budgets.get(req.tenant)
+        if budget is None:
+            return True
+        return self._tenant_used.get(req.tenant, 0) \
+            + self._charge(req) <= budget
+
+    def _track_tenant(self, req: EngineRequest, delta: int):
+        used = max(self._tenant_used.get(req.tenant, 0) + delta, 0)
+        if used:
+            self._tenant_used[req.tenant] = used
+        else:
+            # prune at zero: tenant names arrive unvalidated off the
+            # JSONL wire, so keeping dead entries (or per-tenant gauge
+            # samples) would grow host state one permanent row per
+            # tenant name ever seen
+            self._tenant_used.pop(req.tenant, None)
+        if req.tenant in self.tenant_budgets:
+            # gauge cardinality bounded by the CONFIGURED budget set,
+            # not by whatever tenant strings clients invent
+            self._m_tenant_tokens.set(used, tenant=req.tenant)
+
+    def _charge_tenant(self, req: EngineRequest):
+        self._track_tenant(req, self._charge(req))
+
+    def _uncharge_tenant(self, req: EngineRequest):
+        self._track_tenant(req, -self._charge(req))
+
+    def _admission_plan(self, req: EngineRequest):
+        """(hashes, hits, need, revive) for admitting ``req`` now."""
         from paddle_tpu.serving import blocks as _blocks
         bs = self.block_size
-        while self._queue and self._free:
-            req = self._queue[0]
-            Tp = req.prompt.size
-            hashes = req.block_hashes
-            if hashes is None:      # computed once per request: the
-                #                     digests are a pure function of
-                #                     the prompt, and a reservation-
-                #                     blocked queue head re-enters here
-                #                     every step
-                hashes = _blocks.prompt_block_hashes(req.prompt, bs)
-                req.block_hashes = hashes
-            # cap hits CHUNK-aligned (not merely block-aligned): the
-            # post-hit chunks must replay the cold prefill's exact
-            # chunk grid for the bitwise hit-vs-cold guarantee, and at
-            # least the last prompt token is always recomputed — the
-            # final chunk must produce logits to sample from
-            per = self.chunk_tokens // bs
-            usable = ((Tp - 1) // self.chunk_tokens) * per
-            hits: List[int] = []
-            for h in hashes[:usable]:
-                b = self.pool.lookup(h)
-                if b is None:
+        Tp = req.prompt.size
+        hashes = req.block_hashes
+        if hashes is None:      # computed once per request: the digests
+            #                     are a pure function of the prompt, and
+            #                     a reservation-blocked head re-enters
+            #                     here every step
+            hashes = _blocks.prompt_block_hashes(req.prompt, bs)
+            req.block_hashes = hashes
+        # cap hits CHUNK-aligned (not merely block-aligned): the
+        # post-hit chunks must replay the cold prefill's exact chunk
+        # grid for the bitwise hit-vs-cold guarantee, and at least the
+        # last prompt token is always recomputed — the final chunk must
+        # produce logits to sample from
+        per = self.chunk_tokens // bs
+        usable = ((Tp - 1) // self.chunk_tokens) * per
+        hits: List[int] = []
+        for h in hashes[:usable]:
+            b = self.pool.lookup(h)
+            if b is None:
+                break
+            hits.append(b)
+        # a PARTIAL-chunk hit run must round DOWN to the chunk grid:
+        # starting prefill mid-chunk would reach (bucket, span) shapes
+        # off the exported grid — KeyError on v4 artifacts, extra
+        # compiles in-process
+        hits = hits[:len(hits) // per * per]
+        need = -(-(Tp + req.max_new) // bs) - len(hits)
+        # hits parked refcount-0 in the LRU are about to be revived by
+        # share(): they leave the allocatable set, so the reservation
+        # must clear them TOO or a later lazy alloc() could find the
+        # pool exhausted despite its reservation
+        revive = sum(1 for b in hits if self.pool.refcount(b) == 0)
+        return hashes, hits, need, revive
+
+    def _try_admit(self, req: EngineRequest,
+                   finished: List[EngineRequest]) -> bool:
+        """Admit ``req`` if a slot is free and its reservation fits;
+        the plan is computed ONCE and handed to the admission body."""
+        if not self._free:
+            return False
+        plan = self._admission_plan(req)
+        _, _, need, revive = plan
+        if not self.pool.can_reserve(need + revive):
+            return False
+        self._admit_request(req, finished, plan)
+        return True
+
+    def _admit_request(self, req: EngineRequest,
+                       finished: List[EngineRequest], plan):
+        """Place one admissible request into a slot (the PR-6 admission
+        body). ``plan`` is the caller's ``_admission_plan`` result."""
+        hashes, hits, need, revive = plan
+        slot = self._free.popleft()
+        self.pool.reserve(need)
+        for b in hits:
+            self.pool.share(b)
+        self._pages[slot, :] = 0
+        self._pages[slot, :len(hits)] = hits
+        self._pages_dev = None
+        self._nalloc[slot] = len(hits)
+        self._slot_blocks[slot] = list(hits)
+        self._slot_hashes[slot] = hashes
+        self._slot_off[slot] = len(hits) * self.block_size
+        self._slot_reserved[slot] = need
+        self._slot_prefill_s[slot] = 0.0
+        req.prefix_hit_tokens = len(hits) * self.block_size
+        self._m_prefix_hits.inc(len(hits))
+        # misses are counted as chunks actually run cold
+        # (_prefill_chunk): a block published by a CONCURRENT
+        # same-prefix request mid-prefill is adopted, not missed
+        now = time.perf_counter()
+        req.prefill_t = now
+        if req.preemptions == 0:
+            # re-admissions after a preemption would re-observe the
+            # whole submit->now span on top of the first observation —
+            # the histogram records each request's ORIGINAL queue wait
+            self._m_wait_s.observe(now - req.submit_t)
+        self._ev(req, "queued", "e", now)
+        self._ev(req, "admitted", "n", now, slot=slot,
+                 queue_wait_ms=round(1000 * (now - req.submit_t), 3),
+                 hit_blocks=len(hits), reserved_blocks=need)
+        self._ev(req, "prefill", "b", now)
+        req.slot, req.status = slot, "prefilling"
+        self._slot_req[slot] = req
+        self._charge_tenant(req)
+        if req.replay is not None:
+            # preempt-resume eviction fallback: the prompt re-prefills
+            # on its exact cold chunk grid (cache hits make surviving
+            # chunks free), then the already-emitted history replays
+            # through the decode program without re-emitting
+            self._slot_forced[slot] = deque(req.replay)
+            req.replay = None
+        self._prefilling.append(slot)
+
+    def _admit(self, finished: List[EngineRequest]):
+        """Tiered, budget-aware admission. Priority classes, scanned in
+        order each scheduler step:
+
+        1. **latency-tier queue** (FIFO) — a reservation-blocked head
+           may preempt batch-tier victims; while it stays blocked,
+           nothing below it admits (strict priority).
+        2. **preempted resumes** (oldest first) — ahead of fresh
+           batch admissions so preemption is a delay, not a demotion.
+        3. **batch-tier queue** (FIFO) — head-of-line on reservation,
+           like the single-tenant engine.
+
+        In every class a request whose TENANT budget is exhausted is
+        SKIPPED, not blocked on: token budgets isolate tenants from
+        each other, so one tenant's burst must not head-of-line-block
+        the rest of the fleet. Budget exhaustion therefore queues
+        (the request stays, admitted when its tenant's tokens free) —
+        it never rejects."""
+        blocked = False
+        for req in [r for r in self._queue if r.tier == "latency"]:
+            if not self._budget_ok(req):
+                continue
+            admitted = self._try_admit(req, finished)
+            if not admitted and self._preemption_feasible(req):
+                while not admitted and self._preempt_victim():
+                    admitted = self._try_admit(req, finished)
+            if not admitted:
+                blocked = True
+                break
+            self._queue.remove(req)
+        if not blocked:
+            for req in list(self._preempted):
+                if not self._budget_ok(req):
+                    continue
+                if self._try_resume(req, finished) is None:
+                    blocked = True
                     break
-                hits.append(b)
-            # a PARTIAL-chunk hit run must round DOWN to the chunk
-            # grid: starting prefill mid-chunk would reach (bucket,
-            # span) shapes off the exported grid — KeyError on v4
-            # artifacts, extra compiles in-process
-            hits = hits[:len(hits) // per * per]
-            need = -(-(Tp + req.max_new) // bs) - len(hits)
-            # hits parked refcount-0 in the LRU are about to be revived
-            # by share(): they leave the allocatable set, so the
-            # reservation must clear them TOO or a later lazy alloc()
-            # could find the pool exhausted despite its reservation
-            revive = sum(1 for b in hits if self.pool.refcount(b) == 0)
+                self._preempted.remove(req)
+            if not blocked:
+                for req in [r for r in self._queue
+                            if r.tier == "batch"]:
+                    if not self._budget_ok(req):
+                        continue
+                    if not self._try_admit(req, finished):
+                        break
+                    self._queue.remove(req)
+        self._m_queue.set(len(self._queue) + len(self._preempted))
+
+    def _preemption_feasible(self, req: EngineRequest) -> bool:
+        """Could evicting batch-tier work EVER free enough for ``req``?
+        Worst-case need vs everything not pinned by latency-tier
+        holders. False stops a blocked latency request from pointlessly
+        draining every batch victim it can never benefit from."""
+        held_lat = sum(self._nalloc[s] + self._slot_reserved[s]
+                       for s, r in enumerate(self._slot_req)
+                       if r is not None and r.tier != "batch")
+        need = -(-(req.prompt.size + req.max_new) // self.block_size)
+        return need <= self.num_blocks - held_lat
+
+    def _preempt_victim(self) -> bool:
+        """Preempt ONE batch-tier victim to free blocks (and its slot)
+        for a blocked latency-tier admission. Victim choice: the
+        batch request holding the most pool resources (allocated +
+        still-reserved blocks — what preemption actually frees); ties
+        break toward the most recently admitted (least sunk prefill
+        work). Returns False when no batch-tier work is preemptable."""
+        best, best_key = -1, None
+        for slot, req in enumerate(self._slot_req):
+            if req is None or req.tier != "batch":
+                continue
+            if req.status not in ("prefilling", "running"):
+                continue
+            key = (self._nalloc[slot] + self._slot_reserved[slot],
+                   req.prefill_t or 0.0)
+            if best_key is None or key > best_key:
+                best, best_key = slot, key
+        if best < 0:
+            return False
+        self._preempt(best)
+        return True
+
+    def _preempt(self, slot: int):
+        """Preempt-to-blocks: snapshot the slot's decode cursor, publish
+        every fully-written block (prompt chain continued over the
+        generated tokens, plus the partial tail block under its own
+        chain digest) into the prefix cache, release the pages and the
+        reservation. The pool makes this a pure host operation — no
+        device copy moves — and resume is either a straight re-mapping
+        (blocks survived in the LRU) or a cache-hit chunked prefill
+        plus forced decode replay (blocks evicted). A victim still
+        PREFILLING simply re-queues: its published chunks already sit
+        in the prefix cache, so re-admission hits them."""
+        from paddle_tpu.serving import blocks as _blocks
+        req = self._slot_req[slot]
+        now = time.perf_counter()
+        bs = self.block_size
+        blocks = list(self._slot_blocks[slot])
+        if req.status == "running":
+            if req.decode_open:
+                self._ev(req, "decode", "e", now)
+                req.decode_open = False
+            pos = int(self._pos[slot])
+            seq = np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int32)])
+            nfull = pos // bs
+            hashes = _blocks.prompt_block_hashes(seq[:nfull * bs], bs)
+            tail_len = pos % bs
+            tail_hash = None
+            if tail_len:
+                parent = hashes[-1] if hashes else _blocks.ROOT_HASH
+                tail_hash = _blocks.chain_hash(
+                    parent, seq[nfull * bs:pos])
+            for j, h in enumerate(hashes):
+                self.pool.publish(h, blocks[j])
+            if tail_hash is not None:
+                self.pool.publish(tail_hash, blocks[nfull])
+            req.snapshot = {
+                "hashes": hashes, "tail_hash": tail_hash,
+                "tail_len": tail_len, "pos": pos,
+                "last": int(self._last[slot]),
+                "forced": list(self._slot_forced[slot])}
+            published = nfull + (1 if tail_len else 0)
+            self._active[slot] = False
+        else:                       # mid-prefill: published chunk
+            published = 0           # blocks already carry their hashes
+            self._prefilling.remove(slot)
+            self._ev(req, "prefill", "e", now)   # close the open slice
+            if self._slot_forced[slot]:
+                # a replay-resuming victim preempted AGAIN mid-prefill:
+                # its un-replayed history must survive the re-queue or
+                # the next admission would RE-EMIT already-delivered
+                # tokens (replay restarts from the full emitted list —
+                # the prompt prefill re-derives the earlier part)
+                req.replay = list(req.tokens)
+        for b in blocks:
+            self.pool.release(b)
+        self.pool.unreserve(self._slot_reserved[slot])
+        self._slot_blocks[slot] = []
+        self._slot_hashes[slot] = []
+        self._slot_reserved[slot] = 0
+        self._nalloc[slot] = 0
+        self._slot_off[slot] = 0
+        self._slot_forced[slot] = deque()
+        self._pages[slot, :] = 0
+        self._pages_dev = None
+        self._slot_req[slot] = None
+        self._free.append(slot)
+        self._uncharge_tenant(req)
+        req.slot = -1
+        req.preemptions += 1
+        self._m_preempts.inc()
+        self._ev(req, "preempted", "n", now, tokens=len(req.tokens),
+                 blocks_published=published, was=req.status)
+        # the request is queued again (the resume line or the arrival
+        # queue): open a fresh "queued" slice so the re-admission's
+        # (or remap-resume's) "queued e" stays balanced
+        self._ev(req, "queued", "b", now)
+        if req.status == "running":
+            req.status = "preempted"
+            self._preempted.append(req)
+        else:
+            req.status = "queued"
+            self._queue.appendleft(req)
+
+    def _try_resume(self, req: EngineRequest,
+                    finished: List[EngineRequest]) -> Optional[str]:
+        """Resume one preempted request. Fast path (``"remap"``): every
+        snapshot digest still resolves in the prefix cache — share the
+        blocks back into a fresh page table, un-publish the partial
+        tail (decode writes into it again), restore the cursor; no
+        device work at all, and generation continues bitwise as if
+        never preempted. Eviction fallback (``"replay"``): re-admit
+        through the normal chunked prefill (the prompt's surviving
+        chunks are cache hits on the exact cold grid) and force-feed
+        the already-emitted tokens through the decode program — same
+        program shapes as the original run, so the continuation stays
+        bitwise too. ``None``: blocked on a slot or reservation."""
+        from paddle_tpu.serving import blocks as _blocks
+        if not self._free:
+            return None
+        snap = req.snapshot
+        bs = self.block_size
+        blocks: List[int] = []
+        ok = True
+        for h in snap["hashes"]:
+            b = self.pool.lookup(h)
+            if b is None:
+                ok = False
+                break
+            blocks.append(b)
+        tail_b = None
+        if ok and snap["tail_hash"] is not None:
+            tail_b = self.pool.lookup(snap["tail_hash"])
+            # the tail block gets WRITTEN into: it must be exclusively
+            # ours (refcount-0, LRU-parked); anything else falls back
+            # to replay rather than corrupting a shared block
+            if tail_b is None or self.pool.refcount(tail_b) != 0:
+                ok = False
+            else:
+                blocks.append(tail_b)
+        if ok:
+            need = -(-(req.prompt.size + req.max_new) // bs) \
+                - len(blocks)
+            revive = sum(1 for b in blocks
+                         if self.pool.refcount(b) == 0)
             if not self.pool.can_reserve(need + revive):
-                break               # FIFO head-of-line: wait for blocks
-            self._queue.popleft()
+                return None
+            now = time.perf_counter()
             slot = self._free.popleft()
             self.pool.reserve(need)
-            for b in hits:
+            for b in blocks:
                 self.pool.share(b)
+            if tail_b is not None:
+                self.pool.unpublish(tail_b)
             self._pages[slot, :] = 0
-            self._pages[slot, :len(hits)] = hits
+            self._pages[slot, :len(blocks)] = blocks
             self._pages_dev = None
-            self._nalloc[slot] = len(hits)
-            self._slot_blocks[slot] = list(hits)
-            self._slot_hashes[slot] = hashes
-            self._slot_off[slot] = len(hits) * bs
+            self._nalloc[slot] = len(blocks)
+            self._slot_blocks[slot] = list(blocks)
+            self._slot_hashes[slot] = req.block_hashes or \
+                _blocks.prompt_block_hashes(req.prompt, bs)
+            self._slot_off[slot] = req.prompt.size
             self._slot_reserved[slot] = need
-            self._slot_prefill_s[slot] = 0.0
-            req.prefix_hit_tokens = len(hits) * bs
-            self._m_prefix_hits.inc(len(hits))
-            # misses are counted as chunks actually run cold
-            # (_prefill_chunk): a block published by a CONCURRENT
-            # same-prefix request mid-prefill is adopted, not missed
-            now = time.perf_counter()
-            req.prefill_t = now
-            self._m_wait_s.observe(now - req.submit_t)
-            self._ev(req, "queued", "e", now)
-            self._ev(req, "admitted", "n", now, slot=slot,
-                     queue_wait_ms=round(1000 * (now - req.submit_t), 3),
-                     hit_blocks=len(hits), reserved_blocks=need)
-            self._ev(req, "prefill", "b", now)
-            req.slot, req.status = slot, "prefilling"
+            self._slot_forced[slot] = deque(snap.get("forced", ()))
+            req.slot, req.status = slot, "running"
             self._slot_req[slot] = req
-            self._prefilling.append(slot)
-        self._m_queue.set(len(self._queue))
+            self._active[slot] = True
+            self._pos[slot] = snap["pos"]
+            self._last[slot] = snap["last"]
+            self._temp[slot] = req.temperature
+            self._topk[slot] = req.top_k
+            self._charge_tenant(req)
+            req.snapshot = None
+            self._ev(req, "queued", "e", now)
+            if not req.decode_open:
+                self._ev(req, "decode", "b", now)
+                req.decode_open = True
+            self._m_resumes.inc(mode="remap")
+            self._ev(req, "resumed", "n", now, mode="remap",
+                     blocks=len(blocks))
+            return "remap"
+        # eviction fallback: forced replay through normal admission
+        req.replay = list(req.tokens)
+        if not self._try_admit(req, finished):
+            req.replay = None           # still parked: keep the
+            return None                 # snapshot for the next attempt
+        req.snapshot = None
+        self._m_resumes.inc(mode="replay")
+        self._ev(req, "resumed", "n", time.perf_counter(),
+                 mode="replay", replay_tokens=len(req.tokens))
+        return "replay"
+
+    def _draft_chunk_hook(self, slot: int, padded, c: int, npages: int):
+        """No-op on the plain paged engine; the spec engine mirrors the
+        chunk into the draft pool here."""
 
     def _try_adopt(self, slot: int) -> bool:
         """Map the slot's NEXT chunk straight onto cached blocks when
@@ -1100,6 +1549,10 @@ class PagedDecodeEngine(DecodeEngine):
             np.float32(req.temperature), np.int32(req.top_k),
             self._seed())
         tok = int(np.asarray(tok))
+        # the spec engine's draft model prefills the SAME chunk into
+        # its own pool here (same page vector — one block table maps
+        # both pools, so hits/preemption/eviction stay in lockstep)
+        self._draft_chunk_hook(slot, padded, c, npages)
         now = time.perf_counter()
         # accumulate per-chunk device time; the histogram observes one
         # per-request total at the final chunk so its semantics match
@@ -1134,6 +1587,24 @@ class PagedDecodeEngine(DecodeEngine):
         self._m_prefill_s.observe(self._slot_prefill_s[slot])
         self._m_prefills.inc()
         req.status = "running"
+        if self._slot_forced[slot]:
+            # preempt-resume replay: this prompt's first token was
+            # emitted before the preemption — the chunk grid just
+            # re-derived it (bitwise under greedy; forced regardless,
+            # so sampled histories replay exactly too). Restore the
+            # decode cursor, re-emit nothing; the lifecycle slices
+            # still transition (prefill closes, decode reopens) so the
+            # trace stays b/e-balanced through a replay.
+            self._ev(req, "prefill", "e", now)
+            if not req.decode_open:
+                self._ev(req, "decode", "b", now)
+                req.decode_open = True
+            self._active[slot] = True
+            self._pos[slot] = req.prompt.size
+            self._last[slot] = self._slot_forced[slot].popleft()
+            self._temp[slot] = req.temperature
+            self._topk[slot] = req.top_k
+            return
         if self._emit(req, tok, now):
             finished.append(req)            # blocks released by _finish;
             return                          # published ones park in LRU
@@ -1142,6 +1613,18 @@ class PagedDecodeEngine(DecodeEngine):
         self._last[slot] = tok
         self._temp[slot] = req.temperature
         self._topk[slot] = req.top_k
+
+    def _consume_forced(self, slot: int) -> bool:
+        forced = self._slot_forced[slot]
+        if not forced:
+            return False
+        # replay: the decode step ran at the right (pos, last) and its
+        # pool write is what matters; the sampled id re-derives the
+        # known next token (bitwise under greedy), which advances the
+        # cursor WITHOUT re-emitting — the caller already holds it
+        self._pos[slot] += 1
+        self._last[slot] = forced.popleft()
+        return True
 
     def _finish(self, req: EngineRequest, reason: str, now: float):
         slot = req.slot
@@ -1155,6 +1638,8 @@ class PagedDecodeEngine(DecodeEngine):
             self._nalloc[slot] = 0
             self._pages[slot, :] = 0
             self._pages_dev = None
+            self._slot_forced[slot] = deque()
+            self._uncharge_tenant(req)
         super()._finish(req, reason, now)
 
     def _schedule(self, finished: List[EngineRequest]):
@@ -1206,5 +1691,303 @@ class PagedDecodeEngine(DecodeEngine):
                     "chunk_tokens": self.chunk_tokens,
                     "kv_dtype": self.kv_dtype,
                     "kv_bytes_per_token": self.kv_bytes_per_token,
-                    "pool_bytes": self.pool_bytes})
+                    "pool_bytes": self.pool_bytes,
+                    "preempted_queued": len(self._preempted),
+                    "preemptions": int(self._m_preempts.value())})
+        tenants = sorted(set(self._tenant_used)
+                         | set(self.tenant_budgets))
+        if tenants:
+            doc["tenants"] = {
+                t: {"tokens_in_flight": self._tenant_used.get(t, 0),
+                    "budget": self.tenant_budgets.get(t)}
+                for t in tenants}
+        return doc
+
+
+class SpecDecodeEngine(PagedDecodeEngine):
+    """Speculative decoding over the paged pool: a small DRAFT model
+    proposes ``spec_k`` tokens per scheduler step, the TARGET model
+    verifies the whole window in ONE batched pass, and an on-device
+    accept/reject epilogue emits every accepted draft token plus one
+    correction/bonus token — up to ``spec_k + 1`` tokens per step at
+    one verify dispatch instead of ``spec_k + 1`` decode dispatches.
+
+    **Shared pool.** The draft keeps its own device pool (its layer
+    count / head geometry differ) but with the SAME (num_blocks,
+    block_size) grid, indexed through the SAME page table and host
+    :class:`~paddle_tpu.serving.blocks.BlockPool`: every writer (chunk
+    prefill, verify, propose) writes both pools at the same physical
+    rows, so a content-hash that certifies a target block certifies
+    the draft rows beside it — prefix-cache hits, preemption and
+    resume need no draft-side bookkeeping at all.
+
+    **The step.** ``propose`` runs the k draft decode steps as one
+    ``lax.scan``-fused program (greedy argmax between iterations — one
+    dispatch, not k); ``verify`` runs the ``W = k+1`` window through
+    ``transformer.verify_step_paged`` (every reduction keeps the
+    decode step's axis lengths, so each window row is BITWISE the
+    decode step it replaces) with the accept/reject sampling tail
+    fused in. Greedy output is therefore bitwise-identical to the
+    target-only engine — acceptance changes HOW FAST tokens emit,
+    never WHICH tokens (pinned in tests/test_spec_decode.py).
+
+    Rejected rows' KV stays in the pool above the rewound cursor where
+    nothing reads it; the next window overwrites it. The multi-tenant
+    scheduler (tiers, budgets, preempt-to-blocks) is inherited
+    unchanged — on the eviction-fallback resume the forced history
+    replays through verify windows, with ``draft_verify`` keeping the
+    draft pool position-faithful where propose's own proposals would
+    diverge from the forced tokens.
+    """
+
+    def __init__(self, prefill: Callable, decode: Callable, params,
+                 cache, *, draft_params, draft_cache,
+                 draft_prefill: Callable, propose: Callable,
+                 verify: Callable, draft_verify: Callable, spec_k: int,
+                 tracker: Optional[_ct.CompileTracker] = None,
+                 **kw):
+        if tracker is None and "chunk_tokens" in kw:
+            # the spec engine legitimately compiles roughly TWICE the
+            # paged chunk-grid set (target + draft prefill programs)
+            # plus propose/verify/draft_verify — keep the default
+            # tracker's storm threshold above that
+            chunk = min(int(kw.get("chunk_tokens", 64)),
+                        int(kw["cache_len"]))
+            spans = max(1, int(kw["cache_len"]) // max(chunk, 1))
+            cb = kw.get("chunk_buckets")
+            nb = len(tuple(cb)) if cb else len(
+                default_chunk_buckets(chunk))
+            tracker = _ct.CompileTracker(
+                storm_threshold=2 * spans * nb + 8)
+        super().__init__(prefill, decode, params, cache,
+                         tracker=tracker, **kw)
+        self.spec_k = int(spec_k)
+        if self.spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        self.draft_params = draft_params
+        self.draft_cache = draft_cache
+        self._draft_prefill_fn = draft_prefill
+        self._propose_fn = propose
+        self._verify_fn = verify
+        self._draft_verify_fn = draft_verify
+        self._valid = np.ones(self.batch, np.int32)
+        reg = self.metrics
+        self._m_spec_rounds = reg.counter(
+            "engine_spec_rounds_total",
+            "propose+verify rounds executed")
+        self._m_spec_proposed = reg.counter(
+            "engine_spec_proposed_tokens_total",
+            "draft tokens proposed for verification")
+        self._m_spec_accepted = reg.counter(
+            "engine_spec_accepted_tokens_total",
+            "proposed draft tokens the target accepted (the emitted "
+            "correction/bonus token is not counted — acceptance "
+            "measures the draft's hit rate, not throughput)")
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_params(cls, params, cfg, draft_params, draft_cfg, *,
+                    spec_k: int = 4, batch: int, cache_len: int,
+                    block_size: int = 16,
+                    num_blocks: Optional[int] = None,
+                    chunk_tokens: int = 64,
+                    chunk_buckets: Optional[Sequence[int]] = None,
+                    seed: Optional[int] = None,
+                    pallas: Optional[str] = None,
+                    kv_dtype: Optional[str] = None, **kw):
+        """In-process spec engine: jit the target paged pair plus the
+        draft program set against live params. The draft must share
+        the target's vocab (its proposals are target tokens) and cover
+        ``cache_len`` positions; everything else about it may differ —
+        smaller is the point."""
+        import jax
+        from paddle_tpu.models import transformer
+        from paddle_tpu.ops.pallas import policy as _pallas_policy
+        from paddle_tpu.serving import sampling
+        if draft_cfg.vocab != cfg.vocab:
+            raise ValueError(
+                f"draft vocab {draft_cfg.vocab} != target vocab "
+                f"{cfg.vocab}: proposals must be target token ids")
+        if cache_len > cfg.max_len or cache_len > draft_cfg.max_len:
+            raise ValueError(
+                f"cache_len {cache_len} exceeds max_len (target "
+                f"{cfg.max_len}, draft {draft_cfg.max_len})")
+        nb = int(num_blocks if num_blocks is not None
+                 else batch * (cache_len // block_size))
+        prefill_fn, decode_fn = sampling.paged_step_fns(
+            cfg, block_size, pallas=pallas)
+        spec = sampling.paged_spec_fns(cfg, draft_cfg, block_size,
+                                       spec_k, pallas=pallas)
+        pool = transformer.init_block_pool(cfg, nb, block_size,
+                                           kv_dtype=kv_dtype)
+        draft_pool = transformer.init_block_pool(draft_cfg, nb,
+                                                 block_size)
+        jdf = jax.jit(decode_fn)
+        jvf = jax.jit(spec["verify"])
+        if "decode_flops" not in kw:
+            # MFU accounting numerator = ONE VERIFY ROUND's model FLOPs
+            # (the program this engine actually dispatches per step)
+            pages = np.zeros((batch, cache_len // block_size), np.int32)
+            W = int(spec_k) + 1
+            cost = _costs.lowered_cost(
+                jvf, params, pool, np.zeros((batch, W), np.int32),
+                np.zeros(batch, np.int32), np.ones(batch, np.int32),
+                np.zeros(batch, bool), pages,
+                np.zeros(batch, np.float32), np.zeros(batch, np.int32),
+                np.int32(0))
+            kw["decode_flops"] = (cost or {}).get("flops")
+        return cls(jax.jit(prefill_fn), jdf, params, pool,
+                   draft_params=draft_params, draft_cache=draft_pool,
+                   draft_prefill=jax.jit(spec["draft_prefill"]),
+                   propose=jax.jit(spec["propose"]), verify=jvf,
+                   draft_verify=jax.jit(spec["draft_verify"]),
+                   spec_k=spec_k, batch=batch, cache_len=cache_len,
+                   block_size=block_size, num_blocks=nb,
+                   chunk_tokens=chunk_tokens,
+                   chunk_buckets=chunk_buckets, seed=seed,
+                   kv_dtype=kv_dtype,
+                   pallas_mode=_pallas_policy.pallas_mode(pallas), **kw)
+
+    # -- scheduler ---------------------------------------------------------
+    def _draft_chunk_hook(self, slot: int, padded, c: int, npages: int):
+        jnp = self._jnp
+        self.draft_cache = self._tracker.track_call(
+            "serving_engine.draft_prefill", self._draft_prefill_fn,
+            self.draft_params, self.draft_cache, jnp.asarray(padded),
+            np.int32(c), jnp.asarray(self._pages[slot, :npages]))
+
+    def _pre_decode(self):
+        # a verify round writes up to `valid` rows per slot — allocate
+        # every page the window touches (the admission reservation
+        # covers them: pos + valid - 1 <= Tp + max_new - 1)
+        for slot in np.flatnonzero(self._active):
+            end = int(self._pos[slot]) + int(self._valid[slot]) - 1
+            while end // self.block_size >= self._nalloc[slot]:
+                self._alloc_page(slot)
+
+    def step(self) -> List[EngineRequest]:
+        """One scheduler iteration: admission + chunk prefill as the
+        paged engine, then ONE propose+verify round for everything in
+        flight (instead of one decode step)."""
+        finished: List[EngineRequest] = []
+        self._schedule(finished)
+        if self._active.any():
+            jnp = self._jnp
+            B, W = self.batch, self.spec_k + 1
+            valid = np.ones(B, np.int32)
+            forced = np.zeros(B, bool)
+            for slot in np.flatnonzero(self._active):
+                req = self._slot_req[slot]
+                if self._slot_forced[slot]:
+                    forced[slot] = True
+                    valid[slot] = min(W, 1 + len(self._slot_forced[slot]))
+                else:
+                    cap = (req.prompt.size + req.max_new
+                           - int(self._pos[slot]) - 1)
+                    valid[slot] = max(min(W, cap), 1)
+            self._valid = valid
+            self._pre_decode()
+            t0 = time.perf_counter()
+            pages_dev = self._decode_extra()[0]
+            window = np.zeros((B, W), np.int32)
+            window[:, 0] = self._last
+            act_prop = self._active & ~forced
+            if act_prop.any():
+                props, self.draft_cache = self._tracker.track_call(
+                    "serving_engine.propose", self._propose_fn,
+                    self.draft_params, self.draft_cache,
+                    jnp.asarray(self._last), jnp.asarray(self._pos),
+                    jnp.asarray(act_prop), jnp.asarray(valid),
+                    pages_dev)
+                window[:, 1:] = np.asarray(props)
+            for slot in np.flatnonzero(forced):
+                # replay window: the known history IS the proposal set
+                f = list(self._slot_forced[slot])[:W - 1]
+                window[slot, 1:1 + len(f)] = f
+            win_dev = jnp.asarray(window)
+            if forced.any():
+                # keep the draft pool position-faithful on replay rows
+                # (propose writes were masked off for these slots)
+                self.draft_cache = self._tracker.track_call(
+                    "serving_engine.draft_verify",
+                    self._draft_verify_fn, self.draft_params,
+                    self.draft_cache, win_dev, jnp.asarray(self._pos),
+                    jnp.asarray(valid),
+                    jnp.asarray(forced & self._active), pages_dev)
+            X, n, self.cache = self._tracker.track_call(
+                "serving_engine.verify", self._verify_fn,
+                self.params, self.cache, win_dev,
+                jnp.asarray(self._pos), jnp.asarray(valid),
+                jnp.asarray(self._active), pages_dev,
+                jnp.asarray(self._temp), jnp.asarray(self._topk),
+                self._seed())
+            X, n = np.asarray(X), np.asarray(n)
+            now = time.perf_counter()
+            self._m_step_s.observe(now - t0)
+            self._m_steps.inc()
+            self._m_spec_rounds.inc()
+            mfu = _costs.mfu(self.decode_flops, now - t0,
+                             self._peak_flops)
+            if mfu is not None:
+                self._m_decode_mfu.set(mfu)
+            for slot in np.flatnonzero(self._active):
+                req = self._slot_req[slot]
+                if forced[slot]:
+                    f = self._slot_forced[slot]
+                    m = min(int(valid[slot]), len(f))
+                    for _ in range(m):
+                        tok = f.popleft()
+                    self._pos[slot] += m
+                    self._last[slot] = tok
+                    continue
+                nprop = max(int(valid[slot]) - 1, 0)
+                m = int(n[slot])
+                self._m_spec_proposed.inc(nprop)
+                self._m_spec_accepted.inc(max(m - 1, 0))
+                fin, used = False, 0
+                for j in range(m):
+                    used += 1
+                    if self._emit(req, int(X[slot, j]), now):
+                        fin = True
+                        break
+                if fin:
+                    finished.append(req)
+                else:
+                    self._pos[slot] += used
+                    self._last[slot] = int(X[slot, used - 1])
+        self._update_gauges()
+        return finished
+
+    # -- observability -----------------------------------------------------
+    def acceptance_rate(self) -> Optional[float]:
+        """Lifetime draft acceptance: accepted / proposed (None before
+        the first proposal). 1.0 means every draft token survived
+        verification — e.g. a draft identical to the target under
+        greedy sampling."""
+        prop = self._m_spec_proposed.value()
+        if not prop:
+            return None
+        return self._m_spec_accepted.value() / prop
+
+    def compile_counts(self) -> Dict[str, int]:
+        c = super().compile_counts()
+        c.update({
+            "draft_prefill": self._tracker.count(
+                "serving_engine.draft_prefill"),
+            "propose": self._tracker.count("serving_engine.propose"),
+            "verify": self._tracker.count("serving_engine.verify"),
+            "draft_verify": self._tracker.count(
+                "serving_engine.draft_verify")})
+        return c
+
+    def health(self) -> dict:
+        doc = super().health()
+        acc = self.acceptance_rate()
+        doc["spec"] = {
+            "k": self.spec_k,
+            "rounds": int(self._m_spec_rounds.value()),
+            "proposed": int(self._m_spec_proposed.value()),
+            "accepted": int(self._m_spec_accepted.value()),
+            "acceptance_rate": round(acc, 4) if acc is not None
+            else None}
         return doc

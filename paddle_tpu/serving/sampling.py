@@ -42,6 +42,49 @@ def sample_tokens(logits: jax.Array, key: jax.Array,
     return jnp.where(temperature > 0, sampled, greedy)
 
 
+def spec_accept(sampled: jax.Array, draft: jax.Array,
+                valid: jax.Array) -> jax.Array:
+    """Accept/reject fold of one speculative verify window: ``sampled``
+    [B, W] are the TARGET's own tokens at each window position (greedy
+    argmax or a categorical draw, per row), ``draft`` [B, W-1] the
+    proposals those positions were conditioned on, ``valid`` [B] the
+    usable window rows. Returns ``n`` [B]: how many leading sampled
+    tokens are emitted — position j+1's sample only counts if every
+    draft token before it matched (``cumprod`` of the leading run), so
+    ``n = 1 + run`` emits the accepted drafts plus exactly one
+    correction/bonus token. Because an accepted draft token EQUALS the
+    target's sample at its position, the emitted tokens are always
+    ``sampled[:, :n]`` — distribution-exact for sampled rows, bitwise
+    the target-only sequence for greedy rows."""
+    B, W = sampled.shape
+    if W == 1:
+        return jnp.minimum(jnp.ones((B,), jnp.int32),
+                           valid.astype(jnp.int32))
+    m = ((sampled[:, :W - 1] == draft)
+         & (jnp.arange(1, W, dtype=jnp.int32)[None, :]
+            < valid[:, None]))
+    run = jnp.cumprod(m.astype(jnp.int32), axis=1).sum(axis=1)
+    return jnp.minimum(1 + run, valid).astype(jnp.int32)
+
+
+def spec_verify_tokens(logits: jax.Array, draft: jax.Array,
+                       key: jax.Array, temperature: jax.Array,
+                       top_k: jax.Array, valid: jax.Array):
+    """Verify-window sampling + accept/reject: logits [B, W, V] from
+    ``transformer.verify_step_paged``, draft [B, W-1] proposals,
+    per-slot temperature/top_k [B] (broadcast over the window), valid
+    [B] usable rows → (sampled [B, W] int32, n_emitted [B] int32).
+    Each window row samples through :func:`sample_tokens` — the same
+    greedy/top-k/categorical conventions as the decode step, over the
+    same vocab axis length, so greedy rows are bitwise the target-only
+    engine's argmax."""
+    B, W, V = logits.shape
+    X = sample_tokens(logits.reshape(B * W, V), key,
+                      jnp.repeat(temperature, W),
+                      jnp.repeat(top_k, W)).reshape(B, W)
+    return X, spec_accept(X, draft, valid)
+
+
 def _prefill_live(dequant):
     """Prefill-side weight resolution: an explicit ``dequant`` wins;
     otherwise {"q8","scale"} trees dequantize wholesale (prefill is
@@ -205,3 +248,125 @@ def paged_step_fns(cfg, block_size: int, dequant=None, pallas=None):
         return tail(logits, seed, temperature, top_k), pool
 
     return prefill_fn, decode_fn
+
+
+def _spec_epilogue(mode):
+    """The accept/reject sampling tail of a verify program under the
+    resolved ``PADDLE_TPU_PALLAS`` mode: the Pallas ``fused_sample``
+    kernel per window row + the accept fold
+    (``ops.pallas.decode.fused_spec_verify``) when the kernels are
+    dispatchable, :func:`spec_verify_tokens` otherwise. Both emit the
+    same greedy tokens exactly (the PR-9 fused_sample contract), so the
+    spec engine's bitwise-greedy promise holds on either path."""
+    from paddle_tpu.ops.pallas import decode as _pallas_decode
+    if not _pallas_decode.kernels_dispatchable(mode):
+        def tail(logits, draft, seed, temperature, top_k, valid):
+            key = jax.random.PRNGKey(seed)
+            return spec_verify_tokens(logits, draft, key, temperature,
+                                      top_k, valid)
+    else:
+        def tail(logits, draft, seed, temperature, top_k, valid):
+            return _pallas_decode.fused_spec_verify(
+                logits, draft, seed, temperature, top_k, valid,
+                interpret=(mode == "interpret"))
+    return tail
+
+
+def paged_spec_fns(cfg, draft_cfg, block_size: int, spec_k: int,
+                   dequant=None, pallas=None):
+    """The speculative-decoding program set for the paged spec engine —
+    the three DRAFT-side programs plus the target VERIFY, compiled next
+    to (never instead of) the ``paged_step_fns`` pair. ``spec_k`` fixes
+    the proposal depth; the verify window is ``W = spec_k + 1`` rows
+    (last accepted token + the k proposals).
+
+    Returns a dict of closures:
+
+    - ``propose(draft_params, draft_pool, last [B], pos [B],
+      active [B], valid [B], pages [B, P])`` → (proposals [B, k]
+      int32, draft_pool) — k GREEDY draft decode steps fused into one
+      program via ``lax.scan`` (one dispatch per engine step, the
+      host-overhead half of the spec win; the draft's small weights
+      are re-read per scan step, which is what makes a small draft
+      the right draft). Scan step j's pool write is masked to
+      ``j < valid``: the engine allocates pages only through
+      ``pos + valid - 1``, and an unmasked write past that would land
+      through the zeroed page-table tail in ANOTHER slot's physical
+      block 0 rows of the draft pool. Proposals past the mask are
+      garbage and unused (the verify window masks the same rows).
+    - ``verify(params, pool, window [B, W], pos [B], valid [B],
+      active [B], pages, temperature [B], top_k [B], seed)`` →
+      (sampled [B, W], n_emitted [B], pool) — ONE batched W-token pass
+      (``transformer.verify_step_paged``) with the accept/reject
+      sampling tail fused in; only the small int outputs cross to host.
+    - ``draft_verify(draft_params, draft_pool, window [B, W], pos,
+      valid, active, pages)`` → draft_pool — the draft-side forced
+      window write (no sampling, logits dead-coded): keeps the draft
+      pool position-faithful when a preempted request replays known
+      tokens, where the propose program's own proposals would diverge
+      from the forced history.
+    - ``draft_prefill(draft_params, draft_pool, tokens [1, C], length,
+      pages [P])`` → draft_pool — the draft's chunk prefill on the SAME
+      chunk grid/page vectors as the target's (one draft program per
+      (bucket, span) the target compiles — the draft's own program
+      set), logits discarded (the sampled first token is the target
+      prefill's).
+
+    ``dequant``/``pallas`` follow ``paged_step_fns`` semantics and
+    apply to the TARGET side; the draft runs its params as given (pass
+    a quantized draft tree for int8 draft weights — decode-side
+    consumption is native)."""
+    from paddle_tpu.models import transformer
+    from paddle_tpu.ops.pallas import policy as _pallas_policy
+
+    mode = _pallas_policy.pallas_mode(pallas)
+    _live_d = _decode_live(dequant)
+    spec_tail = _spec_epilogue(mode)
+    k = int(spec_k)
+    if k < 1:
+        raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+
+    def propose_fn(draft_params, draft_pool, last, pos, active, valid,
+                   pages):
+        valid = jnp.asarray(valid, jnp.int32)
+
+        def body(carry, j):
+            pool, toks, p = carry
+            lg, pool = transformer.decode_step_paged(
+                draft_params, pool, toks, p, active & (j < valid),
+                pages, draft_cfg, block_size=block_size, pallas=mode)
+            nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+            return (pool, nxt, p + 1), nxt
+
+        (draft_pool, _, _), props = jax.lax.scan(
+            body, (draft_pool, jnp.asarray(last, jnp.int32),
+                   jnp.asarray(pos, jnp.int32)),
+            jnp.arange(k, dtype=jnp.int32))
+        return jnp.transpose(props), draft_pool        # [k, B] -> [B, k]
+
+    def verify_fn(params, pool, window, pos, valid, active, pages,
+                  temperature, top_k, seed):
+        logits, pool = transformer.verify_step_paged(
+            _live_d(params), pool, window, pos, valid, active, pages,
+            cfg, block_size=block_size)
+        sampled, n = spec_tail(logits, window[:, 1:], seed, temperature,
+                               top_k, valid)
+        return sampled, n, pool
+
+    def draft_verify_fn(draft_params, draft_pool, window, pos, valid,
+                        active, pages):
+        _, draft_pool = transformer.verify_step_paged(
+            draft_params, draft_pool, window, pos, valid, active,
+            pages, draft_cfg, block_size=block_size)
+        return draft_pool
+
+    def draft_prefill_fn(draft_params, draft_pool, tokens, length,
+                         pages):
+        _, draft_pool = transformer.prefill_into_blocks(
+            draft_params, draft_pool, tokens, length, pages, draft_cfg,
+            block_size=block_size, pallas=mode)
+        return draft_pool
+
+    return {"propose": propose_fn, "verify": verify_fn,
+            "draft_verify": draft_verify_fn,
+            "draft_prefill": draft_prefill_fn}

@@ -153,6 +153,75 @@ class TestCliServe:
         assert rc == 1
         assert "engine_buckets" in capsys.readouterr().err
 
+    def test_serve_tenant_tier_fields_and_budget_flags(
+            self, tmp_path, monkeypatch, capsys):
+        """job=serve on a paged artifact: JSONL requests may carry
+        tenant/tier, --tenant-budget caps a tenant, and a malformed
+        tier comes back as a counted error line — never a traceback."""
+        import io
+        import json
+        import sys as _sys
+
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.io import lm_serving
+        from paddle_tpu.models import transformer
+
+        cfg = transformer.TransformerConfig(
+            vocab=40, d_model=16, n_heads=2, n_kv_heads=1, n_layers=2,
+            d_ff=32, max_len=32, dtype=jnp.float32, use_rope=True)
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        model = str(tmp_path / "lm_v4.tar")
+        lm_serving.save_lm_artifact(model, params, cfg, batch=2,
+                                    prompt_len=4, cache_len=32,
+                                    engine_buckets=(8,),
+                                    engine_paged=True,
+                                    engine_block_size=8)
+        rng = np.random.RandomState(0)
+        lines = [
+            json.dumps({"prompt": rng.randint(0, 40, 5).tolist(),
+                        "max_new": 4, "tenant": "acme",
+                        "tier": "latency"}),
+            json.dumps({"prompt": rng.randint(0, 40, 5).tolist(),
+                        "max_new": 4, "tenant": "bulk",
+                        "tier": "batch"}),
+            json.dumps({"prompt": rng.randint(0, 40, 5).tolist(),
+                        "max_new": 4, "tier": "turbo"}),   # malformed
+        ]
+        monkeypatch.setattr(_sys, "stdin",
+                            io.StringIO("\n".join(lines) + "\n"))
+        rc = cli.main(["serve", f"--model={model}",
+                       "--tenant-budget", "acme=64"])
+        assert rc == 0
+        out = [json.loads(l) for l in
+               capsys.readouterr().out.strip().splitlines()]
+        results = [r for r in out if "id" in r]
+        errors = [r for r in out if "error" in r]
+        assert len(results) == 2
+        assert len(errors) == 1 and "tier" in errors[0]["error"]
+
+    def test_serve_malformed_tenant_budget_flag(self, tmp_path,
+                                                capsys):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.io import lm_serving
+        from paddle_tpu.models import transformer
+
+        cfg = transformer.TransformerConfig(
+            vocab=40, d_model=16, n_heads=2, n_kv_heads=1, n_layers=2,
+            d_ff=32, max_len=32, dtype=jnp.float32, use_rope=True)
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        model = str(tmp_path / "lm_v4b.tar")
+        lm_serving.save_lm_artifact(model, params, cfg, batch=2,
+                                    prompt_len=4, cache_len=32,
+                                    engine_buckets=(8,),
+                                    engine_paged=True,
+                                    engine_block_size=8)
+        rc = cli.main(["serve", f"--model={model}",
+                       "--tenant-budget", "acme"])
+        assert rc == 1
+        assert "TENANT=TOKENS" in capsys.readouterr().err
+
     def test_serve_streams_results_while_stdin_open(self, tmp_path):
         """A streaming client that holds the pipe open must get each
         result as its request completes — the engine steps while stdin

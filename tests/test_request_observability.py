@@ -340,8 +340,12 @@ class TestEngineLifecycle:
         assert a.status == "running"
         adversary = eng.submit(rng.randint(0, 40, 56).astype(np.int32),
                                max_new=4)                # 7 chunks
+        # max_new=1: the victim finishes at its first token, so its
+        # lifetime has NO decode component at all — the stall-vs-decode
+        # dominance comparison is structural, not a wall-clock race
+        # between a ~1 ms stall and one (noise-prone) decode step
         victim = eng.submit(rng.randint(0, 40, 4).astype(np.int32),
-                            max_new=2)
+                            max_new=1)
         eng.run_until_idle()
         assert adversary.finish_reason and victim.finish_reason
         rec = next(r for r in eng.request_log.records()
