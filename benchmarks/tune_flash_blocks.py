@@ -5,21 +5,25 @@ Default mode sweeps flash-ATTENTION block sizes and prints the best
 (block_q, block_k) per (seq, head_dim, dtype) — paste winners into
 ops/pallas/attention.py MEASURED_BLOCKS.
 
-``--decode`` sweeps the flash-DECODE kernel over (KV block size,
-kv-page tile) per (span, head_dim, dtype) — paste winners into
-ops/pallas/decode.py MEASURED_DECODE. The block-size axis is advisory
-for ENGINE configuration (the pool layout is the engine's choice); the
-tile axis is the kernel's page-gather granularity, consulted at
-dispatch when the advisory block size matches the pool actually
-handed over (analytic VMEM-budget default otherwise).
+``--decode`` sweeps the flash-DECODE kernel over (KV block size — the
+pool's M-tile, i.e. each grid program's ``(1, block_size, Dh)`` block
+— x pages-per-grid-step tile) per (span, head_dim, dtype) on
+HEAD-MAJOR ``[Hkv, M, Dh]`` pools — paste winners into
+ops/pallas/decode.py MEASURED_DECODE (keys carry the POOL_LAYOUT
+token, so entries swept on another layout are never consulted). The
+block-size axis is advisory for ENGINE configuration (the pool layout
+is the engine's choice); the tile axis is the kernel's streaming
+granularity, consulted at dispatch when the advisory block size
+matches the pool actually handed over (analytic VMEM-budget default
+otherwise).
 
 ``--prefill`` sweeps the chunked-PREFILL kernel
 (``ops.pallas.prefill.flash_chunk_prefill``) over (chunk tokens x
-block size x ctx pages-per-tile) per (context span, head_dim, dtype) —
-paste winners into ops/pallas/prefill.py MEASURED_PREFILL. Same
-advisory-only selection semantics as --decode. ``--dtypes`` may name
-the quantized pool storages ``int8``/``int4`` to sweep the
-fused-dequant gather.
+block size x ctx pages-per-step tile) per (context span, head_dim,
+dtype) — paste winners into ops/pallas/prefill.py MEASURED_PREFILL
+(layout-keyed the same way). Same advisory-only selection semantics
+as --decode. ``--dtypes`` may name the quantized pool storages
+``int8``/``int4`` to sweep the fused-dequant gather.
 
 Usage: python benchmarks/tune_flash_blocks.py [--seqs 2048,8192]
        [--head-dims 64,128] [--dtypes bfloat16,float32] [--iters 20]
@@ -124,8 +128,8 @@ def decode_sweep(args):
                 print(f"  span={span} d={d} {dname} bs={bs}: VMEM "
                       f"over budget, skipped", flush=True)
                 continue
-            k = jnp.asarray(rng.randn(M, Hkv, d), dtype)
-            v = jnp.asarray(rng.randn(M, Hkv, d), dtype)
+            k = jnp.asarray(rng.randn(Hkv, M, d), dtype)   # head-major
+            v = jnp.asarray(rng.randn(Hkv, M, d), dtype)
             pages = jnp.asarray(
                 rng.permutation(M // bs)[:B * P].reshape(B, P)
                 .astype(np.int32))            # scrambled, like production
@@ -157,10 +161,11 @@ def decode_sweep(args):
                     best = (dt, bs, tile)
         if best:
             bucket = 1 << max(0, (span - 1)).bit_length()
-            results[(bucket, d, dname)] = (best[1], best[2])
+            results[(fd.POOL_LAYOUT, bucket, d, dname)] = (best[1],
+                                                           best[2])
             print(f"BEST span={span} d={d} {dname}: "
                   f"({best[1]}, {best[2]})", flush=True)
-    print("\nMEASURED_DECODE entries:")
+    print("\nMEASURED_DECODE entries (layout-keyed):")
     for k, v in sorted(results.items()):
         print(f"    {k}: {v},")
 
@@ -206,16 +211,16 @@ def prefill_sweep(args):
                       f"VMEM over budget, skipped", flush=True)
                 continue
             d_st = d // 2 if dname == "int4" else d
-            if quant:
-                k = jnp.asarray(rng.randint(-127, 128, (M, Hkv, d_st)),
+            if quant:                              # head-major pools
+                k = jnp.asarray(rng.randint(-127, 128, (Hkv, M, d_st)),
                                 jnp.int8)
-                v = jnp.asarray(rng.randint(-127, 128, (M, Hkv, d_st)),
+                v = jnp.asarray(rng.randint(-127, 128, (Hkv, M, d_st)),
                                 jnp.int8)
-                ks = jnp.asarray(rng.rand(M, Hkv), jnp.float32)
-                vs = jnp.asarray(rng.rand(M, Hkv), jnp.float32)
+                ks = jnp.asarray(rng.rand(Hkv, M), jnp.float32)
+                vs = jnp.asarray(rng.rand(Hkv, M), jnp.float32)
             else:
-                k = jnp.asarray(rng.randn(M, Hkv, d), dtype)
-                v = jnp.asarray(rng.randn(M, Hkv, d), dtype)
+                k = jnp.asarray(rng.randn(Hkv, M, d), dtype)
+                v = jnp.asarray(rng.randn(Hkv, M, d), dtype)
                 ks = vs = None
             pages = jnp.asarray(
                 rng.permutation(M // bs)[:P_ctx].astype(np.int32))
@@ -252,10 +257,11 @@ def prefill_sweep(args):
         if best:
             sb = 1 << max(0, (span - 1)).bit_length()
             cb = 1 << max(0, (chunk - 1)).bit_length()
-            results[(sb, cb, d, dname)] = (best[1], best[2])
+            results[(fpf.POOL_LAYOUT, sb, cb, d, dname)] = (best[1],
+                                                            best[2])
             print(f"BEST span={span} C={C} d={d} {dname}: "
                   f"({best[1]}, {best[2]})", flush=True)
-    print("\nMEASURED_PREFILL entries:")
+    print("\nMEASURED_PREFILL entries (layout-keyed):")
     for k_, v_ in sorted(results.items()):
         print(f"    {k_}: {v_},")
 

@@ -272,7 +272,14 @@ def save_lm_artifact(path: str, params, cfg, *, batch: int,
                                  "pages_per_slot": pages,
                                  "chunk_tokens": chunk,
                                  "pallas": engine_pallas,
-                                 "kv_dtype": engine_kv_dtype or "none"}
+                                 "kv_dtype": engine_kv_dtype or "none",
+                                 # the pool array layout the modules
+                                 # were shaped against — the loader
+                                 # refuses to schedule programs from a
+                                 # different layout generation (the
+                                 # pre-relayout slot-major pool)
+                                 "pool_layout":
+                                     transformer.POOL_LAYOUT}
             eng_prefill, eng_decode = _sampling.paged_step_fns(
                 cfg, bs, dequant=dequant)
             pool_shapes = jax.tree_util.tree_map(
@@ -527,6 +534,21 @@ class LMServer:
         cfg = self.cfg
         paged = self.meta.get("engine_paged")
         if paged:
+            # layout fencing: the exported modules bake the pool array
+            # shapes, so a legacy slot-major artifact (pre-head-major
+            # relayout; no pool_layout stamp) cannot be scheduled over
+            # the pool this build constructs — the failure would
+            # otherwise surface as an opaque shape mismatch at the
+            # first prefill call
+            from paddle_tpu.models import transformer
+            stamped = paged.get("pool_layout", "slot_major")
+            if stamped != transformer.POOL_LAYOUT:
+                raise ValueError(
+                    f"artifact's paged-engine modules were exported "
+                    f"against a {stamped!r} KV pool but this build "
+                    f"uses {transformer.POOL_LAYOUT!r} — re-export "
+                    f"with save_lm_artifact(..., engine_paged=True) "
+                    f"to serve it")
             meta_chunk = int(paged.get("chunk_tokens",
                                        max(self.engine_buckets)))
             if chunk_tokens is not None and int(chunk_tokens) != \

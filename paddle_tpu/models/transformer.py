@@ -473,28 +473,47 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
 
+# The pool's array layout generation — stamped into v4/v5 artifacts
+# (io/lm_serving) so a loader never schedules programs compiled against
+# a different layout, and the key prefix of the Pallas MEASURED_*
+# tuning tables. "head_major" is [L, Hkv, M, Dh]: the kv-head axis
+# leads so every Pallas grid program's pool block is a Mosaic-legal
+# (1, block_size, Dh) slab (the pre-relayout "slot_major"
+# [L, M, Hkv, Dh] forced per-head column blocks (M, 1, Dh), which the
+# TPU last-two-dims tiling rule rejects). ONE definition — the kernels
+# own it (ops/pallas/decode.py); this re-export is what the artifact
+# stamping and the engine read, so a future layout bump cannot fence
+# artifacts and key the tuning tables with different strings.
+from paddle_tpu.ops.pallas.decode import POOL_LAYOUT  # noqa: E402
+
+
 def init_block_pool(cfg: TransformerConfig, num_blocks: int,
                     block_size: int, kv_dtype: Optional[str] = None):
-    """Paged KV pool for the block-table decode engine:
-    [L, num_blocks * block_size, kv_heads, Dh] per k/v. Block ``i`` owns
-    the aligned span ``[i*block_size, (i+1)*block_size)`` of the flat
-    position axis; per-slot page tables (``serving/blocks.BlockPool``)
-    map logical positions onto blocks, so HBM is committed per BLOCK
-    actually written instead of ``cache_len`` per arena row.
+    """Paged KV pool for the block-table decode engine, HEAD-MAJOR:
+    [L, kv_heads, num_blocks * block_size, Dh] per k/v — the standard
+    TPU paged-KV layout (kv-head leading). Block ``i`` owns the aligned
+    span ``[i*block_size, (i+1)*block_size)`` of the flat position axis
+    (now the SECOND-to-last axis); per-slot page tables
+    (``serving/blocks.BlockPool``) map logical positions onto blocks,
+    so HBM is committed per BLOCK actually written instead of
+    ``cache_len`` per arena row. Head-major is what makes every Pallas
+    serving kernel's pool block a tiling-legal ``(1, block_size, Dh)``
+    slab placeable by scalar-prefetched page indexing — see
+    ``POOL_LAYOUT`` and ops/pallas/decode.py.
 
     ``kv_dtype`` picks the pool storage width. ``None`` keeps the model
-    dtype ({"k","v"} only — the original layout). ``"int8"`` stores k/v
-    as symmetric int8 with one fp32 scale per (layer, position, head)
-    in ``k_scale``/``v_scale`` tables [L, M, kv_heads] that ride
-    BLOCK-major beside the pool — the page table indexes values and
-    scales alike, so scales travel with their block under any paging.
-    ``"int4"`` packs two nibbles per byte ([..., Dh//2] storage, same
-    scale layout). Scales are per pool ROW (write-local): a decode step
-    writing one token never rescales a block's resident neighbours,
-    which is what keeps hit-replay bitwise and blocks relocatable."""
+    dtype ({"k","v"} only). ``"int8"`` stores k/v as symmetric int8
+    with one fp32 scale per (layer, head, position) in
+    ``k_scale``/``v_scale`` tables [L, kv_heads, M] that ride beside
+    the pool — the page table indexes values and scales alike, so
+    scales travel with their block under any paging. ``"int4"`` packs
+    two nibbles per byte ([..., Dh//2] storage, same scale layout).
+    Scales are per pool ROW (write-local): a decode step writing one
+    token never rescales a block's resident neighbours, which is what
+    keeps hit-replay bitwise and blocks relocatable."""
     M = int(num_blocks) * int(block_size)
     if kv_dtype in (None, "none"):
-        shape = (cfg.n_layers, M, cfg.kv_heads, cfg.head_dim)
+        shape = (cfg.n_layers, cfg.kv_heads, M, cfg.head_dim)
         return {"k": jnp.zeros(shape, cfg.dtype),
                 "v": jnp.zeros(shape, cfg.dtype)}
     from paddle_tpu.ops import q8 as ops_q8
@@ -507,8 +526,8 @@ def init_block_pool(cfg: TransformerConfig, num_blocks: int,
             raise ValueError(f"int4 KV packs nibble pairs: head_dim "
                              f"{Dh} must be even")
         Dh = Dh // 2
-    shape = (cfg.n_layers, M, cfg.kv_heads, Dh)
-    sshape = (cfg.n_layers, M, cfg.kv_heads)
+    shape = (cfg.n_layers, cfg.kv_heads, M, Dh)
+    sshape = (cfg.n_layers, cfg.kv_heads, M)
     return {"k": jnp.zeros(shape, jnp.int8),
             "v": jnp.zeros(shape, jnp.int8),
             "k_scale": jnp.zeros(sshape, jnp.float32),
@@ -781,15 +800,20 @@ def decode_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
     ids → (logits [B, vocab] fp32, updated pool).
 
     The block-table variant of ``decode_step_slots``: the cache is the
-    flat pool ``init_block_pool`` builds ([L, M, Hkv, Dh] with
-    M = num_blocks·block_size) and each slot reads its KV through a
-    gathered logical view ``[B, T]`` (T = P·block_size) built from its
-    page vector — every shape static, so the engine still compiles the
-    decode step exactly ONCE for any paging. Row b writes its new k/v at
-    the physical index ``pages[b, pos[b]//bs]·bs + pos[b]%bs`` via a
-    scatter whose inactive rows target an out-of-bounds index and are
-    DROPPED (mode="drop") — admission/recycling can't perturb in-flight
-    neighbours, matching ``decode_step_slots``'s inactive-row contract.
+    head-major flat pool ``init_block_pool`` builds ([L, Hkv, M, Dh]
+    with M = num_blocks·block_size) and each slot reads its KV through
+    a gathered logical view ``[B, T]`` (T = P·block_size) built from
+    its page vector — every shape static, so the engine still compiles
+    the decode step exactly ONCE for any paging. Row b writes its new
+    k/v at the physical index ``pages[b, pos[b]//bs]·bs + pos[b]%bs``
+    (the pool's position axis) via a scatter whose inactive rows target
+    an out-of-bounds index and are DROPPED (mode="drop") —
+    admission/recycling can't perturb in-flight neighbours, matching
+    ``decode_step_slots``'s inactive-row contract. The XLA path's
+    gathered view transposes back to the [B, T, Hkv, Dh] shape the
+    slot-major pool produced, so the attention arithmetic — and its
+    bitwise contract against ``decode_step_slots`` — is untouched by
+    the relayout.
 
     For a slot whose pages tile a contiguous span (the identity mapping)
     the gathered view IS the old arena row, T equals the arena's
@@ -831,17 +855,22 @@ def decode_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
     H, Dh = cfg.n_heads, cfg.head_dim
     Hkv = cfg.kv_heads
     kvd = Hkv * Dh
-    M = cache["k"].shape[1]
+    M = cache["k"].shape[2]
     quantized = _blocks_quantized(params)
     kvq = pool_kv_dtype(cache, cfg)       # "none" | "int8" | "int4"
     mode = _pallas_policy.pallas_mode(pallas)
-    # dispatchable (backend + Mosaic status) AND the VMEM budget: both
-    # fall back to the pure-XLA path below rather than failing compile
+    # dispatchable (backend), the VMEM budget, AND the per-shape Mosaic
+    # lowering probe: each falls back to the pure-XLA path below rather
+    # than failing the compile
     use_pallas = _pallas_decode.kernels_dispatchable(mode)
-    if use_pallas and mode == "on" and not _pallas_decode.decode_kernel_fits(
-            M, P, bs, H // Hkv, Dh, cache["k"].dtype, kv_dtype=kvq):
+    if use_pallas and mode == "on" and not (
+            _pallas_decode.decode_kernel_fits(
+                M, P, bs, H // Hkv, Dh, cache["k"].dtype, kv_dtype=kvq)
+            and _pallas_decode.decode_lowering_ok(
+                M, P, bs, Hkv, H // Hkv, Dh, cache["k"].dtype,
+                kv_dtype=kvq, q_dtype=cfg.dtype)):
         use_pallas = False          # pure-XLA fallback rather than an
-        #                             opaque Mosaic VMEM failure
+        #                             opaque Mosaic failure
     pos = jnp.asarray(pos, jnp.int32)
     pages = jnp.asarray(pages, jnp.int32)
     x = _embed_rows(params, tokens, cfg)
@@ -863,9 +892,9 @@ def decode_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
 
     def block(x, scanned):
         if kvq != "none":
-            w, li, kc, vc, ksc, vsc = scanned  # + scales [M, Hkv]
+            w, li, kc, vc, ksc, vsc = scanned  # + scales [Hkv, M]
         else:
-            w, li, kc, vc = scanned            # kc/vc [M, Hkv, Dh]
+            w, li, kc, vc = scanned            # kc/vc [Hkv, M, Dh]
             ksc = vsc = None
         if quantized:
             w = _live_layer_weights(w, li)
@@ -880,41 +909,57 @@ def decode_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
         if kvq != "none":
             # write-time quantization: one scale per (row, head); the
             # same scatter discipline drops inactive rows for values
-            # AND scales, so isolation holds for both tables
+            # AND scales, so isolation holds for both tables (the
+            # head-major pool scatters on its position axis, values
+            # transposed to [Hkv, B, ...] — same values, new placement)
             kq, ks_new = ops_q8.quantize_kv(k.reshape(B, Hkv, Dh), kvq)
             vq, vs_new = ops_q8.quantize_kv(v.reshape(B, Hkv, Dh), kvq)
-            kc = kc.at[widx].set(kq, mode="drop")
-            vc = vc.at[widx].set(vq, mode="drop")
-            ksc = ksc.at[widx].set(ks_new, mode="drop")
-            vsc = vsc.at[widx].set(vs_new, mode="drop")
+            kc = kc.at[:, widx].set(jnp.swapaxes(kq, 0, 1),
+                                    mode="drop")
+            vc = vc.at[:, widx].set(jnp.swapaxes(vq, 0, 1),
+                                    mode="drop")
+            ksc = ksc.at[:, widx].set(jnp.swapaxes(ks_new, 0, 1),
+                                      mode="drop")
+            vsc = vsc.at[:, widx].set(jnp.swapaxes(vs_new, 0, 1),
+                                      mode="drop")
         else:
-            kc = kc.at[widx].set(k.reshape(B, Hkv, Dh).astype(kc.dtype),
-                                 mode="drop")
-            vc = vc.at[widx].set(v.reshape(B, Hkv, Dh).astype(vc.dtype),
-                                 mode="drop")
+            kc = kc.at[:, widx].set(
+                jnp.swapaxes(k.reshape(B, Hkv, Dh), 0,
+                             1).astype(kc.dtype), mode="drop")
+            vc = vc.at[:, widx].set(
+                jnp.swapaxes(v.reshape(B, Hkv, Dh), 0,
+                             1).astype(vc.dtype), mode="drop")
         g = H // Hkv
         if use_pallas:
             # the kernel reads the just-written pool (pos attends to
-            # itself) and resolves gidx's page walk internally; for
-            # quantized pools the dequant multiply runs in-register on
-            # the streamed blocks (int8/int4 HBM reads)
+            # itself) and resolves the page walk via scalar prefetch;
+            # for quantized pools the dequant multiply runs in-register
+            # on the streamed blocks (int8/int4 HBM reads)
             attn = _pallas_decode.flash_decode_attention(
                 q.reshape(B, Hkv, g, Dh), kc, vc, pages, pos,
                 block_size=bs, k_scale=ksc, v_scale=vsc, kv_dtype=kvq,
                 interpret=(mode == "interpret"))
         else:
+            # gather on the pool's position axis, then transpose the
+            # logical view back to [B, T, Hkv, ...] — the exact shape
+            # (and values) the slot-major pool produced, so everything
+            # downstream is bitwise the pre-relayout path
             if kvq != "none":
-                # gather int8 rows + their scales, widen in the consumer
-                # (the dequant chain the Pallas kernel replicates)
                 kt = ops_q8.dequantize_kv(
-                    jnp.take(kc, gidx, axis=0),
-                    jnp.take(ksc, gidx, axis=0), kvq)
+                    jnp.transpose(jnp.take(kc, gidx, axis=1),
+                                  (1, 2, 0, 3)),
+                    jnp.transpose(jnp.take(ksc, gidx, axis=1),
+                                  (1, 2, 0)), kvq)
                 vt = ops_q8.dequantize_kv(
-                    jnp.take(vc, gidx, axis=0),
-                    jnp.take(vsc, gidx, axis=0), kvq)
+                    jnp.transpose(jnp.take(vc, gidx, axis=1),
+                                  (1, 2, 0, 3)),
+                    jnp.transpose(jnp.take(vsc, gidx, axis=1),
+                                  (1, 2, 0)), kvq)
             else:
-                kt = jnp.take(kc, gidx, axis=0).astype(jnp.float32)
-                vt = jnp.take(vc, gidx, axis=0).astype(jnp.float32)
+                kt = jnp.transpose(jnp.take(kc, gidx, axis=1),
+                                   (1, 2, 0, 3)).astype(jnp.float32)
+                vt = jnp.transpose(jnp.take(vc, gidx, axis=1),
+                                   (1, 2, 0, 3)).astype(jnp.float32)
             q32 = q.reshape(B, Hkv, g, Dh).astype(jnp.float32)
             s = jnp.einsum("bkgd,btkd->bkgt", q32, kt) / math.sqrt(Dh)
             s = jnp.where(attend[:, None, None, :], s, -1e30)
@@ -1011,7 +1056,7 @@ def verify_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
     H, Dh = cfg.n_heads, cfg.head_dim
     Hkv = cfg.kv_heads
     kvd = Hkv * Dh
-    M = cache["k"].shape[1]
+    M = cache["k"].shape[2]
     quantized = _blocks_quantized(params)
     kvq = pool_kv_dtype(cache, cfg)
     pos = jnp.asarray(pos, jnp.int32)
@@ -1063,26 +1108,42 @@ def verify_step_paged(params, cache, tokens: jax.Array, pos: jax.Array,
         if kvq != "none":
             kq, ks_new = ops_q8.quantize_kv(k.reshape(N, Hkv, Dh), kvq)
             vq, vs_new = ops_q8.quantize_kv(v.reshape(N, Hkv, Dh), kvq)
-            kc = kc.at[widx].set(kq, mode="drop")
-            vc = vc.at[widx].set(vq, mode="drop")
-            ksc = ksc.at[widx].set(ks_new, mode="drop")
-            vsc = vsc.at[widx].set(vs_new, mode="drop")
+            kc = kc.at[:, widx].set(jnp.swapaxes(kq, 0, 1),
+                                    mode="drop")
+            vc = vc.at[:, widx].set(jnp.swapaxes(vq, 0, 1),
+                                    mode="drop")
+            ksc = ksc.at[:, widx].set(jnp.swapaxes(ks_new, 0, 1),
+                                      mode="drop")
+            vsc = vsc.at[:, widx].set(jnp.swapaxes(vs_new, 0, 1),
+                                      mode="drop")
         else:
-            kc = kc.at[widx].set(k.reshape(N, Hkv, Dh).astype(kc.dtype),
-                                 mode="drop")
-            vc = vc.at[widx].set(v.reshape(N, Hkv, Dh).astype(vc.dtype),
-                                 mode="drop")
+            kc = kc.at[:, widx].set(
+                jnp.swapaxes(k.reshape(N, Hkv, Dh), 0,
+                             1).astype(kc.dtype), mode="drop")
+            vc = vc.at[:, widx].set(
+                jnp.swapaxes(v.reshape(N, Hkv, Dh), 0,
+                             1).astype(vc.dtype), mode="drop")
         g = H // Hkv
+        # head-major gather transposed back to the [B, T, Hkv, ...]
+        # logical view (same values/shape as the slot-major path — the
+        # verify rows' bitwise contract vs decode_step_paged rides on
+        # the arithmetic downstream being identical)
         if kvq != "none":
             kt = ops_q8.dequantize_kv(
-                jnp.take(kc, gidx, axis=0),
-                jnp.take(ksc, gidx, axis=0), kvq)
+                jnp.transpose(jnp.take(kc, gidx, axis=1),
+                              (1, 2, 0, 3)),
+                jnp.transpose(jnp.take(ksc, gidx, axis=1),
+                              (1, 2, 0)), kvq)
             vt = ops_q8.dequantize_kv(
-                jnp.take(vc, gidx, axis=0),
-                jnp.take(vsc, gidx, axis=0), kvq)
+                jnp.transpose(jnp.take(vc, gidx, axis=1),
+                              (1, 2, 0, 3)),
+                jnp.transpose(jnp.take(vsc, gidx, axis=1),
+                              (1, 2, 0)), kvq)
         else:
-            kt = jnp.take(kc, gidx, axis=0).astype(jnp.float32)
-            vt = jnp.take(vc, gidx, axis=0).astype(jnp.float32)
+            kt = jnp.transpose(jnp.take(kc, gidx, axis=1),
+                               (1, 2, 0, 3)).astype(jnp.float32)
+            vt = jnp.transpose(jnp.take(vc, gidx, axis=1),
+                               (1, 2, 0, 3)).astype(jnp.float32)
         q32 = q.reshape(B, W, Hkv, g, Dh).astype(jnp.float32)
         s = jnp.einsum("bwkgd,btkd->bwkgt", q32, kt) / math.sqrt(Dh)
         s = jnp.where(attend[:, :, None, None, :], s, -1e30)
@@ -1194,15 +1255,24 @@ def prefill_into_blocks(params, cache, tokens: jax.Array,
     Hkv = cfg.kv_heads
     kvd = Hkv * Dh
     kvq = pool_kv_dtype(cache, cfg)
-    M = cache["k"].shape[1]
+    M = cache["k"].shape[2]
     mode = _pallas_policy.pallas_mode(pallas)
     from paddle_tpu.ops.pallas import decode as _pallas_decode
     use_pallas = _pallas_decode.kernels_dispatchable(mode)
     if use_pallas:
         from paddle_tpu.ops.pallas import prefill as _pallas_prefill
-        if mode == "on" and not _pallas_prefill.prefill_kernel_fits(
-                M, S, C, H // Hkv, Dh, cache["k"].dtype, kv_dtype=kvq):
+        if mode == "on" and not (
+                _pallas_prefill.prefill_kernel_fits(
+                    M, S, C, H // Hkv, Dh, cache["k"].dtype,
+                    kv_dtype=kvq, block_size=bs)
+                and _pallas_prefill.prefill_lowering_ok(
+                    M, S, C, bs, Hkv, H // Hkv, Dh, cache["k"].dtype,
+                    kv_dtype=kvq, q_dtype=cfg.dtype)
+                and _pallas_prefill.span_write_lowering_ok(
+                    M, -(-C // bs), bs, cfg.n_layers, Hkv,
+                    Dh, cache["k"].dtype, kv_dtype=kvq)):
             use_pallas = False      # XLA fallback, not a Mosaic OOM
+            #                         or an opaque tiling rejection
     length = jnp.asarray(length, jnp.int32)
     pages = jnp.asarray(pages, jnp.int32)
     gpos = S + jnp.arange(C, dtype=jnp.int32)            # [C] global
@@ -1227,10 +1297,19 @@ def prefill_into_blocks(params, cache, tokens: jax.Array,
     else:
         # context gather (once, all layers): every context position is
         # real (ctx tokens were written by hits/earlier chunks), no
-        # mask needed
+        # mask needed. The head-major pool gathers on its position
+        # axis, then the view transposes back to the position-leading
+        # [L, S, Hkv, ...] shape the slot-major pool produced — same
+        # values, so the scan body below is bitwise the old path's
         gidx = (pages[:P - pc, None] * bs
                 + jnp.arange(bs, dtype=jnp.int32)[None, :]).reshape(S)
-        ctx_xs = tuple(jnp.take(cache[n], gidx, axis=1)
+
+        def _ctx(n):
+            g = jnp.take(cache[n], gidx, axis=2)   # [L, Hkv, S, ...]
+            perm = (0, 2, 1) + tuple(range(3, g.ndim))
+            return jnp.transpose(g, perm)          # [L, S, Hkv, ...]
+
+        ctx_xs = tuple(_ctx(n)
                        for n in (("k", "v", "k_scale", "v_scale")
                                  if kvq != "none" else ("k", "v")))
     # [C, S+C] mask: context fully visible, chunk causally masked
@@ -1306,23 +1385,27 @@ def prefill_into_blocks(params, cache, tokens: jax.Array,
                    vck.astype(cache["v"].dtype))
 
     x, (ks, vs) = jax.lax.scan(block, x, (params["blocks"],) + ctx_xs)
-    # pool write for the whole chunk, all layers (ks [L, C, Hkv, Dh]):
-    # one masked read-modify-write of the CONTIGUOUS bs-token span per
-    # chunk page — dynamic_update_slice, not a scatter (a [C]-index
-    # scatter into the flat pool is several ms slower per call on CPU).
-    # Padded rows write back the span's old bytes, the RMW equivalent
-    # of the scatter's mode="drop". Quantized pools write int8/int4
-    # values + their per-(layer, token, head) scales the same way.
+    # pool write for the whole chunk, all layers: the scan stacks the
+    # spans position-major ([L, C, Hkv, Dh]); quantization (per
+    # (layer, token, head)) runs on that layout — the same values as
+    # ever — and the spans then transpose to the pool's head-major
+    # [L, Hkv, C, ...] for one masked read-modify-write of the
+    # CONTIGUOUS bs-token span per chunk page — dynamic_update_slice,
+    # not a scatter (a [C]-index scatter into the flat pool is several
+    # ms slower per call on CPU). Padded rows write back the span's
+    # old bytes, the RMW equivalent of the scatter's mode="drop".
     if kvq != "none":
         kq, kscl = ops_q8.quantize_kv(ks, kvq)   # [L,C,Hkv,Dh'], [L,C,Hkv]
         vq, vscl = ops_q8.quantize_kv(vs, kvq)
         spans = {"k": kq, "v": vq, "k_scale": kscl, "v_scale": vscl}
     else:
         spans = {"k": ks, "v": vs}
+    spans = {n: jnp.transpose(a, (0, 2, 1) + tuple(range(3, a.ndim)))
+             for n, a in spans.items()}          # [L, Hkv, C, ...]
     pad = pc * bs - C
     if pad:
-        spans = {n: jnp.pad(a, ((0, 0), (0, pad)) + ((0, 0),) *
-                            (a.ndim - 2)) for n, a in spans.items()}
+        spans = {n: jnp.pad(a, ((0, 0), (0, 0), (0, pad)) + ((0, 0),)
+                            * (a.ndim - 3)) for n, a in spans.items()}
         vfull = jnp.concatenate([valid, jnp.zeros((pad,), bool)])
     else:
         vfull = valid
@@ -1338,14 +1421,14 @@ def prefill_into_blocks(params, cache, tokens: jax.Array,
             dst = tail_pages[j] * bs
             for n, a in spans.items():
                 vmask = vfull[j * bs:(j + 1) * bs].reshape(
-                    (1, bs) + (1,) * (a.ndim - 2))
-                aj = a[:, j * bs:(j + 1) * bs]
+                    (1, 1, bs) + (1,) * (a.ndim - 3))
+                aj = a[:, :, j * bs:(j + 1) * bs]
                 old = jax.lax.dynamic_slice(
-                    new_cache[n], (0, dst) + (0,) * (a.ndim - 2),
-                    (a.shape[0], bs) + a.shape[2:])
+                    new_cache[n], (0, 0, dst) + (0,) * (a.ndim - 3),
+                    a.shape[:2] + (bs,) + a.shape[3:])
                 new_cache[n] = jax.lax.dynamic_update_slice(
                     new_cache[n], jnp.where(vmask, aj, old),
-                    (0, dst) + (0,) * (a.ndim - 2))
+                    (0, 0, dst) + (0,) * (a.ndim - 3))
     # only the last VALID chunk position feeds the vocab head (the
     # gather-head discipline of prefill_into_slot)
     x = jnp.take(x, jnp.reshape(jnp.maximum(length - 1, 0), (1,)), axis=0)

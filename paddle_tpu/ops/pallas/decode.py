@@ -4,21 +4,46 @@ The serving engine's per-token hot path (``transformer.decode_step_paged``)
 is gather-heavy under XLA: every step materializes a ``[B, T, Hkv, Dh]``
 logical KV view out of the block pool, re-reads it for the score einsum,
 and keeps a ``[B, H, T]`` score tensor in HBM between softmax stages.
-``flash_decode_attention`` is the Pallas replacement: one grid program per
-(slot, kv-head) resolves the slot's page-table indices INSIDE the kernel
-and streams the mapped K/V blocks straight from the pool into VMEM — no
-gathered logical view and no batch-wide score tensor ever exist in HBM.
-Per-slot position masking is fused in, accumulation is fp32.
+``flash_decode_attention`` is the Pallas replacement, built around the
+HEAD-MAJOR pool layout ``[Hkv, M, Dh]`` (kv-head leading — the standard
+TPU paged-KV layout ``transformer.init_block_pool`` adopted with it):
+
+- grid ``(slot, kv-head, page-step)``; the page table and per-slot
+  positions ride as **scalar-prefetch** operands
+  (``pltpu.PrefetchScalarGridSpec``), so each grid step's K/V block is
+  PLACED by indexing the pool's BlockSpec through ``pages[b, j]`` —
+  Mosaic's DMA engine streams exactly the slot's MAPPED
+  ``(1, block_size, Dh)`` blocks, and no gathered logical view or
+  batch-wide score tensor ever exists in HBM;
+- each step's partial scores (a ``Dh``-contraction — bitwise the same
+  dot the one-shot einsum computes per column) land in a VMEM score-row
+  scratch, the V block in a VMEM value scratch; the LAST page step
+  masks by the slot's position and applies ONE exact softmax (the same
+  max/exp/sum/divide chain ``jax.nn.softmax`` evaluates — written out
+  explicitly because ``jax.nn.softmax`` carries a ``stop_gradient``
+  Mosaic has no lowering for) before the single ``p @ V`` dot.
 
 Decode's score row is ``O(T)`` per program (one query token), not the
-``O(T²)`` of prefill attention, so the whole masked row fits VMEM and the
-kernel applies ONE exact softmax to it (the same max/exp/sum/divide chain
-``jax.nn.softmax`` runs) instead of the prefill flash kernel's
-online-softmax rescaling chain. That choice is what makes the
+``O(T²)`` of prefill attention, so the whole masked row fits VMEM and
+the exact softmax — not an online-rescaling chain — is what keeps the
 interpret-mode kernel BITWISE-identical to the XLA paged path on aligned
 fp32 shapes (pinned in tests/test_pallas_decode.py): an online softmax
 normalizes ``(p@v)/l`` where XLA computes ``(p/l)@v``, a rounding
 difference the streaming buys nothing for at decode shapes.
+
+Every BlockSpec in this file is **Mosaic-legal** under the TPU tiling
+rule (the last two block dims must each be divisible by the dtype's
+native tile — (8, 128) fp32, (16, 128) bf16, (32, 128) int8 — or equal
+the array dims): the head-major pool makes each program's block
+``(1, block_size, Dh)`` with the singleton on a LEADING dim, quantized
+scale columns ride as ``[Hkv, M, 1]`` views (trailing singleton ==
+array dim), and the page/pos/seed/temperature/top-k vectors live in
+SMEM via scalar prefetch where no tiling rule applies. Whether a given
+shape ACTUALLY lowers is never assumed: dispatch asks
+:func:`decode_lowering_ok` — a cached deviceless XLA:TPU lowering probe
+of the real kernel call — and falls back to the XLA path on a refusal
+(``serving_bench.py --tpu-check`` asserts the probes hold and stamps
+the legal BlockSpecs + VMEM estimates into its artifact).
 
 ``fused_sample`` is the epilogue: greedy / temperature / top-k sampling
 (``serving/sampling.sample_tokens`` semantics, per-slot runtime vectors)
@@ -31,6 +56,9 @@ TPU-only; the hash keeps the kernel interpretable on CPU). Greedy rows
 and the kept top-k SET match ``sample_tokens`` exactly; the categorical
 draw itself matches in distribution, not per-id (different RNG stream —
 the contract tests assert the distribution, greedy ties, and membership).
+Counting/argmax reductions run over exact small-integer fp32 images
+(integer reductions have no Mosaic lowering; fp32 is exact below 2^24,
+far above any vocab).
 
 Dispatch resolves through the package-wide ``PADDLE_TPU_PALLAS`` policy
 (``ops/pallas/policy.py``); the pure-XLA gather path in
@@ -45,70 +73,89 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from paddle_tpu.ops.pallas.attention import VMEM_BYTES
 
 NEG_INF = -1e30
 
-# Whether the SERVING kernels (flash_decode_attention, fused_sample,
-# and ops/pallas/prefill.py's pair) can lower through Mosaic to real
-# TPU hardware in this jax version: they cannot — their per-slot/
-# per-head block layouts put a 1 in the second-to-last block dim of
-# multi-row arrays (pages/pos/logits blocks vs a B-row array, pool
-# head columns (M, 1, Dh) vs an Hkv-head pool), violating the Pallas
-# TPU tiling rule, and the gather loops build their VMEM buffers with
-# value-domain dynamic_update_slice, which has no Mosaic lowering.
-# ``serving_bench.py --tpu-check`` records the diagnostics verbatim;
-# the head-major pool relayout that fixes both is a ROADMAP item.
-# Until then ``mode="on"`` must FALL BACK to the XLA path instead of
-# crashing the first compile on a real chip — interpret mode (the
-# CPU correctness path) is unaffected.
-MOSAIC_LOWERABLE = False
+# The pool layout this kernel generation is built for — the key prefix
+# of the MEASURED_* tuning tables, so sweep entries taken on one layout
+# are never consulted against another (a pre-relayout slot-major entry
+# would otherwise advise tiles for a pool shape that no longer exists).
+POOL_LAYOUT = "head_major"
 
-_warned_fallback = False
+_warned_fallback = set()        # modes that already warned (once per mode)
+
+# cached verdicts of the deviceless Mosaic lowering probes, keyed by
+# (kernel kind, shape/dtype signature) — a probe is one tiny XLA:TPU
+# lowering with no chip attached (~a second, paid once per signature).
+# Refusals keep their diagnostic in _LOWERING_DETAIL (surfaced by the
+# once-per-key warning below and serving_bench --tpu-check), so a
+# silent XLA fallback on a real chip is never undiagnosable.
+_LOWERING_CACHE = {}
+_LOWERING_DETAIL = {}
 
 
 def kernels_dispatchable(mode: str) -> bool:
-    """Whether the resolved ``PADDLE_TPU_PALLAS`` mode may actually
-    place the serving kernels in a compiled program on the current
-    default backend. ``interpret`` always can (the interpreter runs
-    anywhere); ``on`` requires a TPU backend AND Mosaic-lowerable
-    kernels — today's layouts are not (see ``MOSAIC_LOWERABLE``), so
-    ``on`` falls back to the XLA path with a one-time warning rather
-    than failing the first compile. Callers still apply their VMEM
-    ``*_kernel_fits`` guards on top."""
-    global _warned_fallback
+    """Whether the resolved ``PADDLE_TPU_PALLAS`` mode may place the
+    serving kernels in a compiled program on the current default
+    backend. ``interpret`` always can (the interpreter runs anywhere);
+    ``on`` requires a TPU backend — off-TPU it falls back to the XLA
+    path with a once-per-mode warning instead of failing the first
+    compile. On TPU the per-site guards still apply on top: the VMEM
+    ``*_kernel_fits`` budgets and the :func:`decode_lowering_ok` /
+    ``prefill.prefill_lowering_ok`` Mosaic probes (the head-major pool
+    relayout made the kernels lowerable; the probe — not a constant —
+    is what asserts it for the actual shapes)."""
     if mode == "interpret":
         return True
     if mode != "on":
         return False
-    if jax.default_backend() != "tpu" or not MOSAIC_LOWERABLE:
-        if not _warned_fallback:
-            _warned_fallback = True
+    if jax.default_backend() != "tpu":
+        if mode not in _warned_fallback:
+            _warned_fallback.add(mode)
             warnings.warn(
-                "PADDLE_TPU_PALLAS resolved 'on' but the serving "
-                "kernels cannot lower on this backend (Mosaic tiling "
-                "/ missing-primitive limits — see ops/pallas/decode.py "
-                "MOSAIC_LOWERABLE); serving falls back to the pure-XLA "
-                "path. Interpret mode still exercises the kernels.",
+                "PADDLE_TPU_PALLAS resolved 'on' but the default "
+                "backend is not TPU; serving falls back to the "
+                "pure-XLA path (use 'interpret' to exercise the "
+                "kernels off-TPU).",
                 RuntimeWarning, stacklevel=2)
         return False
     return True
 
-# ---------------------------------------------------------------------------
-# tile selection
-# ---------------------------------------------------------------------------
 
-# measured-best (block_size, kv-page tile) keyed (span bucket, head_dim,
-# dtype_name) — filled from on-chip sweeps (benchmarks/tune_flash_blocks.py
-# --decode); consulted before the analytic default. The block_size entry
-# is ADVISORY for engine configuration (the pool layout is the engine's
-# choice); the kernel consults the tile only when the entry's block_size
-# matches the pool it was actually handed. Span buckets are powers of two
-# (lookup rounds up).
-MEASURED_DECODE = {
-    # (span_bucket, head_dim, dtype): (block_size, pages_per_tile)
-}
+def mosaic_lowerable(key, build) -> bool:
+    """Cached deviceless XLA:TPU lowering probe: ``build()`` must
+    return (fn, abstract args); the probe lowers ``jit(fn)`` for the
+    TPU platform with no device attached and records whether Mosaic
+    accepts the kernel. This is the real successor of the old
+    ``MOSAIC_LOWERABLE`` constant — per kernel, per shape signature,
+    measured instead of asserted."""
+    if key in _LOWERING_CACHE:
+        return _LOWERING_CACHE[key]
+    try:
+        import jax.export
+        fn, args = build()
+        jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+        ok = True
+    except Exception as e:                            # noqa: BLE001
+        ok = False
+        _LOWERING_DETAIL[key] = f"{type(e).__name__}: {str(e)[:300]}"
+        warnings.warn(
+            f"Pallas kernel {key[0]!r} failed the Mosaic lowering "
+            f"probe (falls back to the XLA path): "
+            f"{_LOWERING_DETAIL[key]}", RuntimeWarning, stacklevel=2)
+    _LOWERING_CACHE[key] = ok
+    return ok
+
+
+def lowering_failures(kind: Optional[str] = None):
+    """Diagnostics of every probe REFUSAL so far (``{key: detail}``),
+    optionally filtered by kernel kind — what ``serving_bench.py
+    --tpu-check`` surfaces next to a failed ``*_ok`` boolean."""
+    return {k: v for k, v in _LOWERING_DETAIL.items()
+            if kind is None or k[0] == kind}
 
 
 def _kv_store_dims(Dh: int, dtype, kv_dtype: str):
@@ -123,25 +170,104 @@ def _kv_store_dims(Dh: int, dtype, kv_dtype: str):
     return Dh, 1, "int8"
 
 
+def decode_lowering_ok(M: int, P: int, block_size: int, Hkv: int,
+                       G: int, Dh: int, dtype,
+                       kv_dtype: str = "none",
+                       q_dtype=None) -> bool:
+    """Mosaic lowering probe for :func:`flash_decode_attention` at the
+    given pool geometry (deviceless, cached). ``mode="on"`` dispatch
+    asks this before placing the kernel in a program so an unlowerable
+    shape degrades to the XLA path instead of failing the compile.
+    ``q_dtype`` is the ACTIVATION dtype the caller's q arrives in
+    (tiling is dtype-dependent, so the probe must lower the very
+    program the dispatch would build); it defaults to the pool dtype —
+    right for fp pools, but quantized-pool callers must pass their
+    model dtype explicitly."""
+    if q_dtype is None:
+        q_dtype = dtype if kv_dtype in (None, "none") else jnp.float32
+    Dh_st, _, name = _kv_store_dims(Dh, dtype, kv_dtype)
+    quant = kv_dtype not in (None, "none")
+    key = ("decode", M, P, int(block_size), Hkv, G, Dh, name,
+           jnp.dtype(q_dtype).name)
+
+    def build():
+        kv = jax.ShapeDtypeStruct(
+            (Hkv, M, Dh_st),
+            jnp.int8 if quant else jnp.dtype(dtype))
+        sc = jax.ShapeDtypeStruct((Hkv, M), jnp.float32)
+        args = [jax.ShapeDtypeStruct((2, Hkv, G, Dh),
+                                     jnp.dtype(q_dtype)),
+                kv, kv,
+                jax.ShapeDtypeStruct((2, P), jnp.int32),
+                jax.ShapeDtypeStruct((2,), jnp.int32)]
+        fn = functools.partial(
+            flash_decode_attention, block_size=block_size,
+            kv_dtype=kv_dtype)
+        if quant:
+            return (lambda q, k, v, pg, ps, ks, vs: fn(
+                q, k, v, pg, ps, k_scale=ks, v_scale=vs),
+                args + [sc, sc])
+        return fn, args
+
+    return mosaic_lowerable(key, build)
+
+
+def sample_lowering_ok(B: int, V: int) -> bool:
+    """Mosaic lowering probe for :func:`fused_sample` (cached,
+    deviceless) — the epilogue's dispatch guard on TPU."""
+    key = ("sample", B, V)
+
+    def build():
+        return fused_sample, [
+            jax.ShapeDtypeStruct((B, V), jnp.float32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.float32),
+            jax.ShapeDtypeStruct((B,), jnp.int32)]
+
+    return mosaic_lowerable(key, build)
+
+
+# ---------------------------------------------------------------------------
+# tile selection
+# ---------------------------------------------------------------------------
+
+# measured-best (block_size, pages-per-grid-step) keyed (POOL layout,
+# span bucket, head_dim, dtype_name) — filled from on-chip sweeps
+# (benchmarks/tune_flash_blocks.py --decode); consulted before the
+# analytic default. The layout key guarantees entries swept on another
+# pool layout are never consulted. The block_size entry is ADVISORY
+# for engine configuration (the pool layout is the engine's choice);
+# the kernel consults the tile only when the entry's block_size matches
+# the pool it was actually handed. Span buckets are powers of two
+# (lookup rounds up).
+MEASURED_DECODE = {
+    # (POOL_LAYOUT, span_bucket, head_dim, dtype): (block_size, tile)
+}
+
+
 def decode_vmem_bytes(M: int, P: int, block_size: int, G: int, Dh: int,
-                      itemsize: int, kv_dtype: str = "none") -> int:
-    """Upper-bound VMEM residency of one (slot, kv-head) grid program:
-    the pool's head column for k and v (the kernel's blocks), the
-    fp32 gather buffers spanning the slot's T = P·bs logical positions,
-    the q/out tiles, and the score row (s and its softmax). Quantized
-    pools add the two fp32 scale head columns but shrink the value
-    columns to 1 (int8) or 1/2 (int4) byte/elt."""
+                      itemsize: int, kv_dtype: str = "none",
+                      tile: int = 1) -> int:
+    """Upper-bound VMEM residency of one (slot, kv-head) grid program
+    at the head-major layout: the score-row and V scratch buffers
+    spanning the slot's ``T = P·bs`` logical positions (scores counted
+    twice — the softmax exp/normalize temporaries are row-sized), the
+    q/out tiles, and the ``tile`` streamed K/V blocks in flight at
+    their STORED width (double-buffered by the pipeline; quantized
+    pools add the fp32 scale columns). The pool itself never sits in
+    VMEM — scalar-prefetched placement streams only the mapped blocks —
+    so the budget no longer scales with the pool size ``M``."""
+    del M                        # streamed per-block, never resident
     T = P * int(block_size)
     if kv_dtype in (None, "none"):
-        vals, scales = 2 * M * Dh * itemsize, 0
+        blk = int(block_size) * Dh * itemsize
     else:
         Dh_st = Dh // 2 if kv_dtype == "int4" else Dh
-        vals, scales = 2 * M * Dh_st, 2 * M * 4
-    return (vals                         # k/v pool head columns
-            + scales                     # k/v scale head columns
-            + 2 * T * Dh * 4             # fp32 gather buffers
+        blk = int(block_size) * (Dh_st + 4)      # values + scale col
+    return (2 * G * T * 4                # score row + softmax temps
+            + T * Dh * 4                 # V scratch
             + 2 * G * Dh * 4             # q, out
-            + 2 * G * T * 4)             # scores + softmax row
+            + 4 * tile * blk)            # 2x tile in-flight K/V blocks
 
 
 def decode_kernel_fits(M: int, P: int, block_size: int, G: int, Dh: int,
@@ -150,22 +276,25 @@ def decode_kernel_fits(M: int, P: int, block_size: int, G: int, Dh: int,
     dispatch guard: ``mode="on"`` falls back to the XLA gather path when
     this says no, rather than letting Mosaic fail opaquely."""
     itemsize = jnp.dtype(dtype).itemsize
+    tile = select_decode_tile(P, block_size, Dh, dtype, kv_dtype)
     return decode_vmem_bytes(M, P, block_size, G, Dh, itemsize,
-                             kv_dtype) <= VMEM_BYTES
+                             kv_dtype, tile=tile) <= VMEM_BYTES
 
 
 def select_decode_tile(P: int, block_size: int, head_dim: int,
                        dtype, kv_dtype: str = "none") -> int:
-    """Pages gathered per inner-loop iteration: the measured table first
-    (when its advisory block_size matches the pool's), then the analytic
-    default — the largest power-of-two divisor of P keeping the unrolled
-    gather at <= 256 rows per iteration (past that the unroll stops
-    paying and VMEM pressure from in-flight slices grows). Quantized
-    pools key the measured table by their storage name ("int8"/"int4")."""
+    """Pages streamed per grid step (each page is one scalar-prefetch-
+    placed BlockSpec stream — ``tile`` of them run per step, amortizing
+    grid overhead): the measured table first (when its advisory
+    block_size matches the pool's), then the analytic default — the
+    largest power-of-two divisor of P keeping the per-step stream at
+    <= 256 rows (past that the extra in-flight blocks stop paying and
+    VMEM pressure grows). Quantized pools key the measured table by
+    their storage name ("int8"/"int4")."""
     span = P * int(block_size)
     bucket = 1 << max(0, (span - 1)).bit_length()     # next pow2 >= span
     _, _, name = _kv_store_dims(head_dim, dtype, kv_dtype)
-    found = MEASURED_DECODE.get((bucket, head_dim, name))
+    found = MEASURED_DECODE.get((POOL_LAYOUT, bucket, head_dim, name))
     if found and found[0] == block_size and P % found[1] == 0:
         return int(found[1])
     tile = 1
@@ -180,65 +309,74 @@ def select_decode_tile(P: int, block_size: int, head_dim: int,
 # ---------------------------------------------------------------------------
 
 
-def _read_kv_rows(ref, scale_ref, start, bs, kv_dtype):
-    """One block span of a pool head column, widened to fp32 in-register
-    — the fused dequant. ``ref`` holds the stored bytes ((bs, Dh) for
-    fp/int8 pools, (bs, Dh//2) nibble-packed for int4), ``scale_ref``
-    the per-row fp32 scales (quantized pools only). The op chain is
+def _widen_block(ref, scale_ref, kv_dtype):
+    """One streamed pool block ``(1, bs, Dh-stored)`` widened to fp32
+    ``[bs, Dh]`` in-register — the fused dequant. The op chain is
     EXACTLY the XLA quantized path's (``ops/q8.dequantize_kv``): exact
     integer unpack, astype(f32), broadcast row-scale multiply — so the
-    kernel stays bitwise the XLA path whatever the storage width."""
+    kernel stays bitwise the XLA path whatever the storage width (the
+    nibble unpack is all-integer shift arithmetic, bitwise on any
+    backend)."""
     from paddle_tpu.ops import q8 as ops_q8
-    rows = ref[pl.ds(start, bs), 0, :]
+    rows = ref[0]
     if kv_dtype in (None, "none"):
         return rows.astype(jnp.float32)
     if kv_dtype == "int4":
         rows = ops_q8.unpack_int4(rows)
     return (rows.astype(jnp.float32)
-            * scale_ref[pl.ds(start, bs), 0][:, None])
+            * scale_ref[0, :, 0][:, None])
 
 
-def _decode_kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
-                   block_size, P, tile, G, Dh, scale, kv_dtype):
-    """One (slot, kv-head) program. Blocks: pages (1, P), pos (1, 1),
-    q/o (1, 1, G, Dh), k/v the pool's head column (M, 1, Dh-stored) —
-    plus, for quantized pools, the fp32 scale head columns (M, 1). The
-    page-gather loop touches only the slot's MAPPED physical blocks and
-    widens them to fp32 in-register (int8/int4 HBM traffic; the dequant
-    never materializes outside VMEM); everything downstream mirrors the
+def _decode_kernel(pages_ref, pos_ref, q_ref, *refs, block_size, P,
+                   tile, G, Dh, scale, kv_dtype):
+    """One (slot, kv-head, page-step) program. ``pages``/``pos`` are
+    scalar-prefetched (SMEM); q/o blocks are ``(1, 1, G, Dh)``; each of
+    the ``tile`` K/V streams is a ``(1, bs, Dh-stored)`` pool block
+    placed through ``pages[b, j·tile + t]`` (+ a ``(1, bs, 1)`` scale
+    column per stream for quantized pools). Page step ``j`` writes its
+    partial scores (a Dh-contraction, bitwise the one-shot einsum's
+    columns) and fp32-widened V rows into VMEM scratch at the logical
+    offset; the LAST step masks by the slot's position and mirrors the
     XLA gather path's op chain exactly (divide-by-sqrt(Dh), -1e30 mask,
-    jax.nn.softmax) so aligned fp32 shapes — and quantized pools, whose
-    dequant chain is elementwise-identical — reproduce its logits
-    bitwise."""
-    if kv_dtype in (None, "none"):
-        ks_ref = vs_ref = None
-        o_ref = rest[0]
+    max/exp/sum/divide softmax) so aligned fp32 shapes — and quantized
+    pools, whose dequant chain is elementwise-identical — reproduce its
+    logits bitwise."""
+    quant = kv_dtype not in (None, "none")
+    krefs = refs[:tile]
+    vrefs = refs[tile:2 * tile]
+    n_in = 2 * tile + (2 * tile if quant else 0)
+    if quant:
+        ksrefs = refs[2 * tile:3 * tile]
+        vsrefs = refs[3 * tile:4 * tile]
     else:
-        ks_ref, vs_ref, o_ref = rest
+        ksrefs = vsrefs = (None,) * tile
+    o_ref, s_scr, v_scr = refs[n_in], refs[n_in + 1], refs[n_in + 2]
+    b = pl.program_id(0)
+    j = pl.program_id(2)
     bs = int(block_size)
     T = P * bs
-
-    def gather(i, carry):
-        kbuf, vbuf = carry
-        for t in range(tile):           # static unroll: tile pages/iter
-            j = i * tile + t
-            pg = pages_ref[0, j]
-            ks = _read_kv_rows(k_ref, ks_ref, pg * bs, bs, kv_dtype)
-            vs = _read_kv_rows(v_ref, vs_ref, pg * bs, bs, kv_dtype)
-            kbuf = jax.lax.dynamic_update_slice(kbuf, ks, (j * bs, 0))
-            vbuf = jax.lax.dynamic_update_slice(vbuf, vs, (j * bs, 0))
-        return kbuf, vbuf
-
-    kbuf = jnp.zeros((T, Dh), jnp.float32)
-    vbuf = jnp.zeros((T, Dh), jnp.float32)
-    kbuf, vbuf = jax.lax.fori_loop(0, P // tile, gather, (kbuf, vbuf))
     q = q_ref[0, 0].astype(jnp.float32)                  # [G, Dh]
-    s = jnp.einsum("gd,td->gt", q, kbuf) / scale
-    valid = (jax.lax.broadcasted_iota(jnp.int32, (G, T), 1)
-             <= pos_ref[0, 0])                           # logical mask
-    s = jnp.where(valid, s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    o_ref[0, 0] = jnp.einsum("gt,td->gd", p, vbuf)
+    for t in range(tile):           # static unroll: tile pages/step
+        ks = _widen_block(krefs[t], ksrefs[t], kv_dtype)
+        vs = _widen_block(vrefs[t], vsrefs[t], kv_dtype)
+        s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())))
+        off = (j * tile + t) * bs
+        s_scr[:, pl.ds(off, bs)] = s
+        v_scr[pl.ds(off, bs), :] = vs
+
+    @pl.when(j == P // tile - 1)
+    def _finish():
+        s = s_scr[...] / scale
+        valid = (jax.lax.broadcasted_iota(jnp.int32, (G, T), 1)
+                 <= pos_ref[b])                          # logical mask
+        s = jnp.where(valid, s, NEG_INF)
+        # jax.nn.softmax's exact chain, written out (its stop_gradient
+        # has no Mosaic lowering; numerically it is the identity)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        e = jnp.exp(s - m)
+        p = e / jnp.sum(e, axis=-1, keepdims=True)
+        o_ref[0, 0] = jax.lax.dot_general(
+            p, v_scr[...], (((1,), (0,)), ((), ())))
 
 
 def flash_decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -249,28 +387,31 @@ def flash_decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                            v_scale: Optional[jax.Array] = None,
                            kv_dtype: str = "none",
                            interpret: bool = False) -> jax.Array:
-    """One decode step's attention straight off the paged pool.
+    """One decode step's attention straight off the head-major paged
+    pool.
 
     q [B, Hkv, G, Dh] (grouped-query layout, G = n_heads/kv_heads),
-    k/v the flat pool [M, Hkv, Dh], pages [B, P] int32 physical block
+    k/v the flat pool [Hkv, M, Dh], pages [B, P] int32 physical block
     ids, pos [B] int32 per-slot positions → fp32 [B, Hkv, G, Dh]. The
     caller owns the pool WRITE of the step's new k/v (a cheap scatter)
     and must perform it before this reads — position ``pos[b]`` attends
     to itself.
 
     Quantized pools (``kv_dtype`` "int8"/"int4") pass the int8 value
-    arrays ([M, Hkv, Dh] or nibble-packed [M, Hkv, Dh//2]) plus the
-    per-(position, head) fp32 scale tables ``k_scale``/``v_scale``
-    [M, Hkv]: blocks stream into VMEM at their stored width and the
-    dequant multiply runs in-register inside the gather loop — history
-    crosses HBM at 1 (int8) or 1/2 (int4) byte/elt.
+    arrays ([Hkv, M, Dh] or nibble-packed [Hkv, M, Dh//2]) plus the
+    per-(head, position) fp32 scale tables ``k_scale``/``v_scale``
+    [Hkv, M]: blocks stream into VMEM at their stored width and the
+    dequant multiply runs in-register — history crosses HBM at 1 (int8)
+    or 1/2 (int4) byte/elt.
 
-    Grid (slot, kv-head); the per-program working set must pass
-    ``decode_kernel_fits`` (the dispatch in ``decode_step_paged``
-    guards this and falls back to XLA)."""
+    Grid (slot, kv-head, page-step) with ``pages``/``pos`` scalar-
+    prefetched; the per-program working set must pass
+    ``decode_kernel_fits`` and the shape must pass
+    ``decode_lowering_ok`` (the dispatch in ``decode_step_paged``
+    guards both and falls back to XLA)."""
     B, Hkv, G, Dh = q.shape             # Dh is always the LOGICAL dim
     quant = kv_dtype not in (None, "none")
-    M = k.shape[0]
+    M = k.shape[1]
     P = pages.shape[1]
     bs = int(block_size)
     if quant and (k_scale is None or v_scale is None):
@@ -280,31 +421,47 @@ def flash_decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if P % tile:
         raise ValueError(f"flash_decode: tile {tile} must divide the "
                          f"page-vector length {P}")
+    tile = int(tile)
     Dh_st = k.shape[-1]                 # stored last dim (packed int4)
+    T = P * bs
     kernel = functools.partial(
-        _decode_kernel, block_size=bs, P=P, tile=int(tile), G=G, Dh=Dh,
+        _decode_kernel, block_size=bs, P=P, tile=tile, G=G, Dh=Dh,
         scale=math.sqrt(Dh), kv_dtype=kv_dtype if quant else "none")
-    in_specs = [
-        pl.BlockSpec((1, P), lambda b, h: (b, 0)),        # pages
-        pl.BlockSpec((1, 1), lambda b, h: (b, 0)),        # pos
-        pl.BlockSpec((1, 1, G, Dh), lambda b, h: (b, h, 0, 0)),
-        pl.BlockSpec((M, 1, Dh_st), lambda b, h: (0, h, 0)),  # k pool
-        pl.BlockSpec((M, 1, Dh_st), lambda b, h: (0, h, 0)),  # v pool
-    ]
-    args = [pages.astype(jnp.int32),
-            jnp.reshape(pos, (B, 1)).astype(jnp.int32), q, k, v]
+
+    def kv_spec(t):
+        return pl.BlockSpec(
+            (1, bs, Dh_st),
+            lambda b, h, j, pg, ps, t=t: (h, pg[b, j * tile + t], 0))
+
+    def sc_spec(t):
+        return pl.BlockSpec(
+            (1, bs, 1),
+            lambda b, h, j, pg, ps, t=t: (h, pg[b, j * tile + t], 0))
+
+    in_specs = ([pl.BlockSpec((1, 1, G, Dh),
+                              lambda b, h, j, pg, ps: (b, h, 0, 0))]
+                + [kv_spec(t) for t in range(tile)] * 2)
+    args = [q] + [k] * tile + [v] * tile
     if quant:
-        in_specs += [pl.BlockSpec((M, 1), lambda b, h: (0, h)),
-                     pl.BlockSpec((M, 1), lambda b, h: (0, h))]
-        args += [k_scale, v_scale]
+        in_specs += [sc_spec(t) for t in range(tile)] * 2
+        args += ([k_scale.reshape(Hkv, M, 1)] * tile
+                 + [v_scale.reshape(Hkv, M, 1)] * tile)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, P // tile),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, G, Dh),
+                               lambda b, h, j, pg, ps: (b, h, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((G, T), jnp.float32),
+                        pltpu.VMEM((T, Dh), jnp.float32)],
+    )
     return pl.pallas_call(
         kernel,
-        grid=(B, Hkv),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, G, Dh), lambda b, h: (b, h, 0, 0)),
+        grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, Dh), jnp.float32),
         interpret=interpret,
-    )(*args)
+    )(pages.astype(jnp.int32), jnp.asarray(pos, jnp.int32).reshape(B),
+      *args)
 
 
 # ---------------------------------------------------------------------------
@@ -326,13 +483,18 @@ def _kth_key(keys: jax.Array, k: jax.Array) -> jax.Array:
     32-step binary search on the integer threshold — count(keys >= t)
     is monotone, so the invariant count(>= lo) >= k pins lo to the
     exact k-th value after the interval collapses. O(32·V) compares, no
-    sort (lax.sort has no Mosaic lowering; this runs anywhere)."""
+    sort (lax.sort has no Mosaic lowering; this runs anywhere). The
+    count sums an fp32 0/1 image — exact below 2^24, far above any
+    vocab — because integer reductions have no Mosaic lowering
+    either."""
+    kf = k.astype(jnp.float32)
+
     def body(_, lh):
         lo, hi = lh
         d = hi - lo
         mid = lo + (d >> 1) + (d & jnp.uint32(1))   # ceil, overflow-safe
-        cnt = jnp.sum((keys >= mid).astype(jnp.int32))
-        take = cnt >= k
+        cnt = jnp.sum((keys >= mid).astype(jnp.float32))
+        take = cnt >= kf
         return (jnp.where(take, mid, lo),
                 jnp.where(take, hi, mid - jnp.uint32(1)))
     lo, _ = jax.lax.fori_loop(
@@ -361,31 +523,36 @@ def _hash_uniform(seed: jax.Array, row: jax.Array,
 def _first_argmax(x: jax.Array, iota: jax.Array) -> jax.Array:
     """First-index argmax over the last axis ([1, V] -> scalar) — the
     ``jnp.argmax`` tie convention, written as max+where+min because
-    ``lax.argmax`` has no Mosaic lowering."""
+    ``lax.argmax`` has no Mosaic lowering. ``iota`` is the fp32 lane
+    index (exact below 2^24; integer min-reductions don't lower)."""
     m = jnp.max(x, axis=-1, keepdims=True)
     V = x.shape[-1]
-    return jnp.min(jnp.where(x == m, iota, V))
+    return jnp.min(jnp.where(x == m, iota, float(V))).astype(jnp.int32)
 
 
-def _sample_kernel(logits_ref, seed_ref, temp_ref, topk_ref, o_ref):
+def _sample_kernel(seed_ref, temp_ref, topk_ref, logits_ref, o_ref):
     """One batch row: greedy argmax, radix top-k threshold, temperature
     scale, Gumbel-max categorical — ``sample_tokens`` semantics with no
-    full-vocab sort and no second dispatch."""
+    full-vocab sort and no second dispatch. The per-row controls are
+    scalar-prefetched (SMEM); logits ride as a ``(1, 1, V)`` block of
+    the ``[B, 1, V]`` view (tiling-legal: the trailing two block dims
+    equal the array dims)."""
     row = pl.program_id(0)
-    v = logits_ref[0].astype(jnp.float32)[None, :]        # [1, V]
+    v = logits_ref[0, 0].astype(jnp.float32)[None, :]     # [1, V]
     V = v.shape[-1]
-    iota = jax.lax.broadcasted_iota(jnp.int32, (1, V), 1)
+    iota = jax.lax.broadcasted_iota(jnp.float32, (1, V), 1)
     greedy = _first_argmax(v, iota)
-    k = jnp.clip(topk_ref[0, 0], 0, V)
+    k = jnp.clip(topk_ref[row], 0, V)
     keys = _sortable_key(v)
     kstar = _kth_key(keys, jnp.maximum(k, 1))
     keep = (k <= 0) | (keys >= kstar)     # ties at the threshold survive
     z = jnp.where(keep, v, -jnp.inf)
-    temp = temp_ref[0, 0]
+    temp = temp_ref[row]
     z = z / jnp.where(temp > 0, temp, 1.0)
-    g = -jnp.log(-jnp.log(_hash_uniform(seed_ref[0, 0], row, (1, V))))
+    g = -jnp.log(-jnp.log(_hash_uniform(seed_ref[0], row, (1, V))))
     sampled = _first_argmax(z + g, iota)
-    o_ref[0, 0] = jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
+    pick = jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
+    o_ref[...] = jnp.reshape(pick, (1, 1, 1))
 
 
 def fused_sample(logits: jax.Array, seed: jax.Array,
@@ -396,24 +563,27 @@ def fused_sample(logits: jax.Array, seed: jax.Array,
     sampled ids [B] int32. Greedy rows (temperature <= 0) and the kept
     top-k set match ``serving/sampling.sample_tokens`` exactly; the
     categorical draw matches in distribution (hash-Gumbel stream, not
-    jax.random's)."""
+    jax.random's). Seed/temperature/top-k ride as scalar prefetch, so
+    the only tiled operand is the logits view ``[B, 1, V]``."""
     B, V = logits.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B,),
+        in_specs=[pl.BlockSpec((1, 1, V),
+                               lambda b, sd, tp, tk: (b, 0, 0))],
+        out_specs=pl.BlockSpec((1, 1, 1),
+                               lambda b, sd, tp, tk: (b, 0, 0)),
+    )
     out = pl.pallas_call(
         _sample_kernel,
-        grid=(B,),
-        in_specs=[
-            pl.BlockSpec((1, V), lambda b: (b, 0)),
-            pl.BlockSpec((1, 1), lambda b: (0, 0)),
-            pl.BlockSpec((1, 1), lambda b: (b, 0)),
-            pl.BlockSpec((1, 1), lambda b: (b, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1), lambda b: (b, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, 1), jnp.int32),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, 1, 1), jnp.int32),
         interpret=interpret,
-    )(logits, jnp.reshape(jnp.asarray(seed, jnp.int32), (1, 1)),
-      jnp.reshape(temperature, (B, 1)).astype(jnp.float32),
-      jnp.reshape(top_k, (B, 1)).astype(jnp.int32))
-    return out[:, 0]
+    )(jnp.reshape(jnp.asarray(seed, jnp.int32), (1,)),
+      jnp.asarray(temperature, jnp.float32).reshape(B),
+      jnp.asarray(top_k, jnp.int32).reshape(B),
+      logits.reshape(B, 1, V))
+    return out[:, 0, 0]
 
 
 def fused_spec_verify(logits: jax.Array, draft: jax.Array,

@@ -1,4 +1,4 @@
-"""Chunked-prefill Pallas kernels over the paged KV pool.
+"""Chunked-prefill Pallas kernels over the head-major paged KV pool.
 
 The cold-prefill half of TTFT is one ``transformer.prefill_into_blocks``
 call per chunk: under XLA each layer gathers the context out of the pool
@@ -8,36 +8,41 @@ stages; the chunk's KV then lands in the pool as compiler-emitted
 masked-span writes (the exact pattern CUDA-L2 in PAPERS.md shows
 library-emitted kernels leave margin on). Two hand-scheduled kernels
 replace that, behind the same ``PADDLE_TPU_PALLAS`` knob as the decode
-kernels:
+kernels — both built for the head-major pool ``[Hkv, M, Dh]`` and both
+Mosaic-legal under the TPU tiling rule (see ops/pallas/decode.py for
+the rule and the probe machinery):
 
 - :func:`flash_chunk_prefill` — one chunk's attention against its
-  context, straight off the pool: one grid program per kv-head resolves
-  the slot's context pages INSIDE the kernel, streams only the MAPPED
-  blocks into VMEM (widened to fp32 in-register — for quantized pools
-  the dequant multiply is fused into the gather, so history crosses HBM
-  at its stored 1 or 1/2 byte/elt), concatenates the chunk's K/V in
-  VMEM, and applies ONE exact softmax over the
-  context-visible + chunk-causal mask. No gathered context view and no
-  score tensor ever exist in HBM. Exact softmax (not online rescaling)
-  for the same reason as ``flash_decode_attention``: it reproduces the
-  XLA fallback's op chain, so the interpret-mode kernel is BITWISE the
-  XLA path on aligned fp32 shapes (pinned in
+  context, straight off the pool: grid ``(kv-head, ctx-page-step)``
+  with the slot's context pages **scalar-prefetched**, so each step's
+  ``(1, block_size, Dh)`` context block is PLACED by the page table
+  (only MAPPED blocks ever stream; for quantized pools the dequant
+  multiply fuses into the stream, so history crosses HBM at its stored
+  1 or 1/2 byte/elt). Partial scores (Dh-contractions, bitwise the
+  one-shot einsum's columns) accumulate into a VMEM score scratch; the
+  LAST step appends the chunk's own K/V and applies ONE exact softmax
+  under the context-visible + chunk-causal mask. No gathered context
+  view and no score tensor ever exist in HBM. Exact softmax (not
+  online rescaling) for the same reason as ``flash_decode_attention``:
+  it reproduces the XLA fallback's op chain, so the interpret-mode
+  kernel is BITWISE the XLA path on aligned fp32 shapes (pinned in
   tests/test_pallas_prefill.py).
 
 - :func:`paged_span_write` — the chunk's masked span writes: grid over
   the chunk's pages, each program's output block mapped THROUGH the
-  page vector by scalar prefetch (``pltpu.PrefetchScalarGridSpec``),
-  pool buffers aliased in-place. Padded rows keep the span's old bytes
-  (the RMW the XLA fallback expresses as slice + where + update-slice),
-  and quantized pools write values and scale rows through the same
-  kernel.
+  scalar-prefetched page vector, pool buffers aliased in-place. Padded
+  rows keep the span's old bytes (the RMW the XLA fallback expresses
+  as slice + where + update-slice), and quantized pools write values
+  and scale rows through the same kernel (scale tables ride as
+  trailing-singleton ``[L, Hkv, M, 1]`` views — tiling-legal).
 
-Tiling: the context gather unrolls ``tile`` pages per loop iteration —
-measured winners from ``benchmarks/tune_flash_blocks.py --prefill`` go
-in ``MEASURED_PREFILL`` (advisory, exactly like ``MEASURED_DECODE``:
-the block-size entry is an engine-configuration hint, consulted only
-when it matches the pool actually handed over); the analytic default
-mirrors the decode kernel's.
+Tiling: ``tile`` context pages stream per grid step (each its own
+scalar-prefetch-placed BlockSpec) — measured winners from
+``benchmarks/tune_flash_blocks.py --prefill`` go in ``MEASURED_PREFILL``
+(keyed by POOL LAYOUT first, so entries swept on another layout are
+never consulted; the block-size entry stays an engine-configuration
+hint, consulted only when it matches the pool actually handed over);
+the analytic default mirrors the decode kernel's.
 """
 
 import functools
@@ -50,65 +55,169 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from paddle_tpu.ops.pallas.attention import VMEM_BYTES
-from paddle_tpu.ops.pallas.decode import NEG_INF, _read_kv_rows
+from paddle_tpu.ops.pallas.decode import (NEG_INF, POOL_LAYOUT,
+                                          _kv_store_dims, _widen_block,
+                                          mosaic_lowerable)
 
-# measured-best (block_size, ctx pages-per-tile) keyed (context-span
-# bucket, chunk bucket, head_dim, dtype_name) — filled from on-chip
-# sweeps (benchmarks/tune_flash_blocks.py --prefill); consulted before
-# the analytic default. Advisory semantics match MEASURED_DECODE: the
-# block_size entry is a hint for engine configuration, and the tile is
-# used only when that advisory matches the pool the kernel was handed.
+# measured-best (block_size, ctx pages-per-grid-step) keyed (POOL
+# layout, context-span bucket, chunk bucket, head_dim, dtype_name) —
+# filled from on-chip sweeps (benchmarks/tune_flash_blocks.py
+# --prefill); consulted before the analytic default. Advisory semantics
+# match MEASURED_DECODE: the block_size entry is a hint for engine
+# configuration, and the tile is used only when that advisory matches
+# the pool the kernel was handed.
 MEASURED_PREFILL = {
-    # (span_bucket, chunk_bucket, head_dim, dtype): (block_size, tile)
+    # (POOL_LAYOUT, span_bucket, chunk_bucket, head_dim, dtype):
+    #     (block_size, tile)
 }
 
 
 def prefill_vmem_bytes(M: int, S: int, C: int, G: int, Dh: int,
-                       itemsize: int, kv_dtype: str = "none") -> int:
-    """Upper-bound VMEM residency of one kv-head grid program: the
-    pool's head columns (stored width), the fp32 gather buffers over
-    context + chunk, the chunk K/V and q/out tiles, and the
-    ``[C, G, S+C]`` score block (plus its softmax)."""
+                       itemsize: int, kv_dtype: str = "none",
+                       stream_rows: Optional[int] = None) -> int:
+    """Upper-bound VMEM residency of one kv-head grid program at the
+    head-major layout: the ``[C·G, S+C]`` score scratch (counted twice
+    — softmax temporaries are score-sized), the fp32 V scratch over
+    context + chunk, the chunk K/V and q/out tiles, and the streamed
+    context blocks in flight at their stored width (double-buffered;
+    ``stream_rows`` is the per-step stream, ``tile·block_size`` when
+    the caller knows its tile — the analytic selector caps it at 256
+    rows, the default charged here, but a MEASURED_PREFILL winner may
+    exceed it; quantized pools add the fp32 scale columns). The pool
+    itself never sits in VMEM — scalar-prefetched placement streams
+    only the mapped blocks, so the budget no longer scales with the
+    pool size ``M``."""
+    del M                        # streamed per-block, never resident
     T = S + C
     if kv_dtype in (None, "none"):
-        vals, scales = 2 * M * Dh * itemsize, 0
+        blk_row = Dh * itemsize
     else:
         Dh_st = Dh // 2 if kv_dtype == "int4" else Dh
-        vals, scales = 2 * M * Dh_st, 2 * M * 4
-    return (vals + scales                # pool value + scale columns
-            + 2 * T * Dh * 4             # fp32 k/v concat buffers
+        blk_row = Dh_st + 4                  # values + scale col
+    if stream_rows is None:
+        stream_rows = min(max(S, 1), 256)
+    stream = 4 * stream_rows * blk_row
+    return (2 * C * G * T * 4            # scores + softmax temps
+            + T * Dh * 4                 # fp32 V scratch
             + 2 * C * Dh * 4             # chunk k/v tiles
             + 2 * C * G * Dh * 4         # q, out
-            + 2 * C * G * T * 4)         # scores + softmax
+            + stream)                    # in-flight context blocks
 
 
 def prefill_kernel_fits(M: int, S: int, C: int, G: int, Dh: int,
-                        dtype, kv_dtype: str = "none") -> bool:
+                        dtype, kv_dtype: str = "none",
+                        block_size: Optional[int] = None) -> bool:
     """Dispatch guard for ``mode="on"``: fall back to the XLA chunk
     path when the working set exceeds the VMEM budget rather than
-    letting Mosaic fail opaquely."""
+    letting Mosaic fail opaquely. Pass ``block_size`` so the in-flight
+    stream is charged at the tile ``select_prefill_tile`` would
+    actually pick (a MEASURED_PREFILL winner can exceed the analytic
+    256-row cap; without it the default cap is charged)."""
     itemsize = jnp.dtype(dtype).itemsize
-    return prefill_vmem_bytes(M, S, C, G, Dh, itemsize,
-                              kv_dtype) <= VMEM_BYTES
+    stream_rows = None
+    if block_size and S:
+        bs = int(block_size)
+        tile = select_prefill_tile(S // bs, bs, C, Dh, dtype, kv_dtype)
+        stream_rows = tile * bs
+    return prefill_vmem_bytes(M, S, C, G, Dh, itemsize, kv_dtype,
+                              stream_rows=stream_rows) <= VMEM_BYTES
+
+
+def prefill_lowering_ok(M: int, S: int, C: int, block_size: int,
+                        Hkv: int, G: int, Dh: int, dtype,
+                        kv_dtype: str = "none",
+                        q_dtype=None) -> bool:
+    """Mosaic lowering probe for the chunk-prefill ATTENTION kernel at
+    the given geometry — deviceless and cached (see
+    ``decode.mosaic_lowerable``). The ``mode="on"`` dispatch consults
+    this together with :func:`span_write_lowering_ok` (the chunk's
+    other kernel). ``q_dtype`` is the caller's ACTIVATION dtype (q and
+    the chunk's own K/V arrive in it; tiling is dtype-dependent, so
+    the probe lowers the very program dispatch would build); defaults
+    to the pool dtype — quantized-pool callers pass their model dtype
+    explicitly."""
+    bs = int(block_size)
+    if q_dtype is None:
+        q_dtype = dtype if kv_dtype in (None, "none") else jnp.float32
+    Dh_st, _, name = _kv_store_dims(Dh, dtype, kv_dtype)
+    quant = kv_dtype not in (None, "none")
+    key = ("prefill", M, S, C, bs, Hkv, G, Dh, name,
+           jnp.dtype(q_dtype).name)
+
+    def build():
+        kvd = jnp.int8 if quant else jnp.dtype(dtype)
+        qd = jnp.dtype(q_dtype)
+        kv = jax.ShapeDtypeStruct((Hkv, M, Dh_st), kvd)
+        sc = jax.ShapeDtypeStruct((Hkv, M), jnp.float32)
+        P_ctx = S // bs
+        args = [jax.ShapeDtypeStruct((C, Hkv, G, Dh), qd),
+                jax.ShapeDtypeStruct((C, Hkv, Dh), qd),
+                jax.ShapeDtypeStruct((C, Hkv, Dh), qd),
+                kv, kv,
+                jax.ShapeDtypeStruct((P_ctx,), jnp.int32)]
+
+        def probe(q, kck, vck, k, v, pages, *scales):
+            ks, vs = (scales[0], scales[1]) if quant else (None, None)
+            return flash_chunk_prefill(
+                q, kck, vck, k, v, pages, block_size=bs,
+                k_scale=ks, v_scale=vs, kv_dtype=kv_dtype)
+
+        extra = [sc, sc] if quant else []
+        return probe, args + extra
+
+    return mosaic_lowerable(key, build)
+
+
+def span_write_lowering_ok(M: int, pc: int, block_size: int, L: int,
+                           Hkv: int, Dh: int, dtype,
+                           kv_dtype: str = "none") -> bool:
+    """Mosaic lowering probe for :func:`paged_span_write` (aliased
+    pool write, scale tables included for quantized pools) — cached,
+    deviceless."""
+    bs = int(block_size)
+    Dh_st, _, name = _kv_store_dims(Dh, dtype, kv_dtype)
+    quant = kv_dtype not in (None, "none")
+    key = ("span_write", M, pc, bs, L, Hkv, Dh, name)
+
+    def build():
+        kvd = jnp.int8 if quant else jnp.dtype(dtype)
+        span = jax.ShapeDtypeStruct((L, Hkv, pc * bs, Dh_st), kvd)
+        sspan = jax.ShapeDtypeStruct((L, Hkv, pc * bs), jnp.float32)
+        pool_kv = jax.ShapeDtypeStruct((L, Hkv, M, Dh_st), kvd)
+        pool_sc = jax.ShapeDtypeStruct((L, Hkv, M), jnp.float32)
+        args = [pool_kv, pool_kv, span, span,
+                jax.ShapeDtypeStruct((pc,), jnp.int32),
+                jax.ShapeDtypeStruct((pc * bs,), jnp.bool_)]
+
+        def probe(pk, pv, sk, sv, pages, valid, *scales):
+            pool_in = {"k": pk, "v": pv}
+            spans = {"k": sk, "v": sv}
+            if quant:
+                pool_in.update(k_scale=scales[0], v_scale=scales[1])
+                spans.update(k_scale=scales[2], v_scale=scales[3])
+            return paged_span_write(pool_in, spans, pages, valid,
+                                    block_size=bs)
+
+        extra = [pool_sc, pool_sc, sspan, sspan] if quant else []
+        return probe, args + extra
+
+    return mosaic_lowerable(key, build)
 
 
 def select_prefill_tile(P_ctx: int, block_size: int, chunk: int,
                         head_dim: int, dtype,
                         kv_dtype: str = "none") -> int:
-    """Context pages gathered per inner-loop iteration: the measured
-    table first (when its advisory block_size matches the pool's), then
-    the analytic default — largest power-of-two divisor of ``P_ctx``
-    keeping the unrolled gather at <= 256 rows per iteration."""
+    """Context pages streamed per grid step: the measured table first
+    (when its advisory block_size matches the pool's), then the
+    analytic default — largest power-of-two divisor of ``P_ctx``
+    keeping the per-step stream at <= 256 rows."""
     if P_ctx < 1:
         return 1
     span = P_ctx * int(block_size)
     sb = 1 << max(0, (span - 1)).bit_length()
     cb = 1 << max(0, (int(chunk) - 1)).bit_length()
-    if kv_dtype in (None, "none"):
-        name = jnp.dtype(dtype).name
-    else:
-        name = kv_dtype
-    found = MEASURED_PREFILL.get((sb, cb, head_dim, name))
+    _, _, name = _kv_store_dims(head_dim, dtype, kv_dtype)
+    found = MEASURED_PREFILL.get((POOL_LAYOUT, sb, cb, head_dim, name))
     if found and found[0] == block_size and P_ctx % found[1] == 0:
         return int(found[1])
     tile = 1
@@ -123,63 +232,83 @@ def select_prefill_tile(P_ctx: int, block_size: int, chunk: int,
 # ---------------------------------------------------------------------------
 
 
-def _chunk_kernel(*refs, block_size, P_ctx, tile, C, G, Dh, scale,
-                  kv_dtype):
-    """One kv-head program. With context: blocks are pages (1, P_ctx),
-    q (C, 1, G, Dh), chunk k/v (C, 1, Dh), the pool's head columns
-    (M, 1, Dh-stored) (+ scale columns (M, 1) when quantized); without
-    (a cold first chunk), only q and the chunk k/v. The page-gather
-    loop fills the context prefix of the fp32 concat buffer, the
-    chunk's K/V land behind it, and the masked exact softmax mirrors
-    the XLA chunk path's op chain (context fully visible, chunk
-    causal, -1e30 mask, jax.nn.softmax) for the bitwise contract."""
+def _chunk_kernel(pages_ref, *refs, block_size, P_ctx, tile, C, G, Dh,
+                  scale, kv_dtype):
+    """One (kv-head, ctx-page-step) program. The context pages are
+    scalar-prefetched; blocks are q ``(C, 1, G, Dh)``, chunk k/v
+    ``(1, C, Dh)`` (head-major), and per stream one ``(1, bs, Dh-
+    stored)`` pool block (+ ``(1, bs, 1)`` scale column when
+    quantized). Page step ``j`` writes its partial scores and widened V
+    rows into scratch at the logical offset; the LAST step appends the
+    chunk's own K/V behind the context and mirrors the XLA chunk
+    path's op chain exactly (context fully visible, chunk causal,
+    -1e30 mask, max/exp/sum/divide softmax) for the bitwise contract.
+    All dots are 2D (``[C·G, ·]``) — Mosaic's dot only takes rank-2 —
+    which cannot move a single bit: each score/output element is the
+    same length-Dh / length-T contraction either way."""
     quant = kv_dtype not in (None, "none")
-    if P_ctx:
-        if quant:
-            (pages_ref, q_ref, kck_ref, vck_ref, k_ref, v_ref,
-             ks_ref, vs_ref, o_ref) = refs
-        else:
-            (pages_ref, q_ref, kck_ref, vck_ref, k_ref, v_ref,
-             o_ref) = refs
-            ks_ref = vs_ref = None
+    krefs = refs[:tile]
+    vrefs = refs[tile:2 * tile]
+    off = 2 * tile
+    if quant:
+        ksrefs = refs[off:off + tile]
+        vsrefs = refs[off + tile:off + 2 * tile]
+        off += 2 * tile
     else:
-        q_ref, kck_ref, vck_ref, o_ref = refs
+        ksrefs = vsrefs = (None,) * tile
+    q_ref, kck_ref, vck_ref = refs[off], refs[off + 1], refs[off + 2]
+    o_ref, s_scr, v_scr = refs[off + 3], refs[off + 4], refs[off + 5]
+    j = pl.program_id(1)
     bs = int(block_size)
     S = P_ctx * bs
     T = S + C
-    kck = kck_ref[:, 0, :].astype(jnp.float32)            # [C, Dh]
-    vck = vck_ref[:, 0, :].astype(jnp.float32)
-    if P_ctx:
-        def gather(i, carry):
-            kbuf, vbuf = carry
-            for t in range(tile):       # static unroll: tile pages/iter
-                j = i * tile + t
-                pg = pages_ref[0, j]
-                ks = _read_kv_rows(k_ref, ks_ref, pg * bs, bs, kv_dtype)
-                vs = _read_kv_rows(v_ref, vs_ref, pg * bs, bs, kv_dtype)
-                kbuf = jax.lax.dynamic_update_slice(kbuf, ks,
-                                                    (j * bs, 0))
-                vbuf = jax.lax.dynamic_update_slice(vbuf, vs,
-                                                    (j * bs, 0))
-            return kbuf, vbuf
+    q = q_ref[:, 0].astype(jnp.float32).reshape(C * G, Dh)
+    for t in range(tile):           # static unroll: tile pages/step
+        ks = _widen_block(krefs[t], ksrefs[t], kv_dtype)
+        vs = _widen_block(vrefs[t], vsrefs[t], kv_dtype)
+        s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())))
+        o = (j * tile + t) * bs
+        s_scr[:, pl.ds(o, bs)] = s
+        v_scr[pl.ds(o, bs), :] = vs
 
-        kbuf = jnp.zeros((T, Dh), jnp.float32)
-        vbuf = jnp.zeros((T, Dh), jnp.float32)
-        kbuf, vbuf = jax.lax.fori_loop(0, P_ctx // tile, gather,
-                                       (kbuf, vbuf))
-        kbuf = jax.lax.dynamic_update_slice(kbuf, kck, (S, 0))
-        vbuf = jax.lax.dynamic_update_slice(vbuf, vck, (S, 0))
-    else:
-        kbuf, vbuf = kck, vck
-    q = q_ref[:, 0].astype(jnp.float32)                   # [C, G, Dh]
-    s = jnp.einsum("cgd,td->cgt", q, kbuf) / scale
-    # context fully visible, chunk causally masked: position t is
-    # visible to chunk row c iff t <= S + c
-    row = jax.lax.broadcasted_iota(jnp.int32, (C, 1, T), 0)
-    col = jax.lax.broadcasted_iota(jnp.int32, (C, 1, T), 2)
-    s = jnp.where(col <= S + row, s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    o_ref[:, 0] = jnp.einsum("cgt,td->cgd", p, vbuf)
+    @pl.when(j == P_ctx // tile - 1)
+    def _finish():
+        kck = kck_ref[0].astype(jnp.float32)             # [C, Dh]
+        vck = vck_ref[0].astype(jnp.float32)
+        s2 = jax.lax.dot_general(q, kck, (((1,), (1,)), ((), ())))
+        s_scr[:, pl.ds(S, C)] = s2
+        v_scr[pl.ds(S, C), :] = vck
+        s = s_scr[...] / scale
+        # context fully visible, chunk causally masked: position t is
+        # visible to chunk row c iff t <= S + c (row r of the [C·G, T]
+        # image belongs to chunk row r // G)
+        row = jax.lax.broadcasted_iota(jnp.int32, (C * G, T), 0) // G
+        col = jax.lax.broadcasted_iota(jnp.int32, (C * G, T), 1)
+        s = jnp.where(col <= S + row, s, NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        e = jnp.exp(s - m)
+        p = e / jnp.sum(e, axis=-1, keepdims=True)
+        out = jax.lax.dot_general(p, v_scr[...],
+                                  (((1,), (0,)), ((), ())))
+        o_ref[...] = out.reshape(C, 1, G, Dh)
+
+
+def _cold_chunk_kernel(q_ref, kck_ref, vck_ref, o_ref, *, C, G, Dh,
+                       scale):
+    """A cold first chunk (no context): pure chunk-causal attention in
+    registers — no pool inputs, no scratch, same op chain."""
+    q = q_ref[:, 0].astype(jnp.float32).reshape(C * G, Dh)
+    kck = kck_ref[0].astype(jnp.float32)
+    vck = vck_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, kck, (((1,), (1,)), ((), ()))) / scale
+    row = jax.lax.broadcasted_iota(jnp.int32, (C * G, C), 0) // G
+    col = jax.lax.broadcasted_iota(jnp.int32, (C * G, C), 1)
+    s = jnp.where(col <= row, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    p = e / jnp.sum(e, axis=-1, keepdims=True)
+    out = jax.lax.dot_general(p, vck, (((1,), (0,)), ((), ())))
+    o_ref[...] = out.reshape(C, 1, G, Dh)
 
 
 def flash_chunk_prefill(q: jax.Array, k_chunk: jax.Array,
@@ -195,14 +324,16 @@ def flash_chunk_prefill(q: jax.Array, k_chunk: jax.Array,
     q [C, Hkv, G, Dh] (grouped-query layout), k_chunk/v_chunk
     [C, Hkv, Dh] the chunk's OWN fresh K/V (exact, pre-quantization —
     in-chunk attention reads what the forward computed; only the pool
-    write is rounded), k/v the flat pool [M, Hkv, Dh-stored], pages
-    [P_ctx] int32 the slot's context pages (context length S =
+    write is rounded), k/v the head-major flat pool [Hkv, M, Dh-stored],
+    pages [P_ctx] int32 the slot's context pages (context length S =
     P_ctx·block_size is static, like the XLA chunk path's span
     specialization) → fp32 [C, Hkv, G, Dh]. Quantized pools also pass
-    ``k_scale``/``v_scale`` [M, Hkv] and the matching ``kv_dtype``.
+    ``k_scale``/``v_scale`` [Hkv, M] and the matching ``kv_dtype``.
 
     A cold first chunk (P_ctx = 0) skips the pool inputs entirely —
-    the kernel is then pure chunk-causal attention."""
+    the kernel is then pure chunk-causal attention. Contextful chunks
+    run grid (kv-head, ctx-page-step) with the pages scalar-prefetched
+    and each step's context block placed through the page table."""
     C, Hkv, G, Dh = q.shape
     quant = kv_dtype not in (None, "none")
     P_ctx = int(pages.shape[0])
@@ -214,38 +345,74 @@ def flash_chunk_prefill(q: jax.Array, k_chunk: jax.Array,
     if P_ctx and P_ctx % tile:
         raise ValueError(f"flash_chunk_prefill: tile {tile} must "
                          f"divide the context page count {P_ctx}")
+    tile = int(tile)
+    # chunk K/V ride head-major too: the (1, C, Dh) block keeps the
+    # tiling-legal trailing dims (the [C, Hkv, Dh] layout would put the
+    # head singleton second-to-last)
+    kck = jnp.swapaxes(k_chunk, 0, 1)
+    vck = jnp.swapaxes(v_chunk, 0, 1)
+    if not P_ctx:
+        kernel = functools.partial(_cold_chunk_kernel, C=C, G=G, Dh=Dh,
+                                   scale=math.sqrt(Dh))
+        return pl.pallas_call(
+            kernel,
+            grid=(Hkv,),
+            in_specs=[
+                pl.BlockSpec((C, 1, G, Dh), lambda h: (0, h, 0, 0)),
+                pl.BlockSpec((1, C, Dh), lambda h: (h, 0, 0)),
+                pl.BlockSpec((1, C, Dh), lambda h: (h, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((C, 1, G, Dh),
+                                   lambda h: (0, h, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((C, Hkv, G, Dh),
+                                           jnp.float32),
+            interpret=interpret,
+        )(q, kck, vck)
+    M = k.shape[1]
+    Dh_st = k.shape[-1]                 # stored last dim (packed int4)
+    S = P_ctx * bs
     kernel = functools.partial(
-        _chunk_kernel, block_size=bs, P_ctx=P_ctx, tile=int(tile),
-        C=C, G=G, Dh=Dh, scale=math.sqrt(Dh),
+        _chunk_kernel, block_size=bs, P_ctx=P_ctx, tile=tile, C=C,
+        G=G, Dh=Dh, scale=math.sqrt(Dh),
         kv_dtype=kv_dtype if quant else "none")
-    in_specs = [
-        pl.BlockSpec((C, 1, G, Dh), lambda h: (0, h, 0, 0)),   # q
-        pl.BlockSpec((C, 1, Dh), lambda h: (0, h, 0)),         # chunk k
-        pl.BlockSpec((C, 1, Dh), lambda h: (0, h, 0)),         # chunk v
+
+    def kv_spec(t):
+        return pl.BlockSpec(
+            (1, bs, Dh_st),
+            lambda h, j, pg, t=t: (h, pg[j * tile + t], 0))
+
+    def sc_spec(t):
+        return pl.BlockSpec(
+            (1, bs, 1),
+            lambda h, j, pg, t=t: (h, pg[j * tile + t], 0))
+
+    in_specs = [kv_spec(t) for t in range(tile)] * 2
+    args = [k] * tile + [v] * tile
+    if quant:
+        in_specs += [sc_spec(t) for t in range(tile)] * 2
+        args += ([k_scale.reshape(Hkv, M, 1)] * tile
+                 + [v_scale.reshape(Hkv, M, 1)] * tile)
+    in_specs += [
+        pl.BlockSpec((C, 1, G, Dh), lambda h, j, pg: (0, h, 0, 0)),
+        pl.BlockSpec((1, C, Dh), lambda h, j, pg: (h, 0, 0)),
+        pl.BlockSpec((1, C, Dh), lambda h, j, pg: (h, 0, 0)),
     ]
-    args = [q, k_chunk, v_chunk]
-    if P_ctx:
-        M = k.shape[0]
-        Dh_st = k.shape[-1]
-        in_specs = ([pl.BlockSpec((1, P_ctx), lambda h: (0, 0))]
-                    + in_specs
-                    + [pl.BlockSpec((M, 1, Dh_st), lambda h: (0, h, 0)),
-                       pl.BlockSpec((M, 1, Dh_st),
-                                    lambda h: (0, h, 0))])
-        args = ([jnp.reshape(pages, (1, P_ctx)).astype(jnp.int32)]
-                + args + [k, v])
-        if quant:
-            in_specs += [pl.BlockSpec((M, 1), lambda h: (0, h)),
-                         pl.BlockSpec((M, 1), lambda h: (0, h))]
-            args += [k_scale, v_scale]
+    args += [q, kck, vck]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(Hkv, P_ctx // tile),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((C, 1, G, Dh),
+                               lambda h, j, pg: (0, h, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((C * G, S + C), jnp.float32),
+                        pltpu.VMEM((S + C, Dh), jnp.float32)],
+    )
     return pl.pallas_call(
         kernel,
-        grid=(Hkv,),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((C, 1, G, Dh), lambda h: (0, h, 0, 0)),
+        grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((C, Hkv, G, Dh), jnp.float32),
         interpret=interpret,
-    )(*args)
+    )(pages.astype(jnp.int32), *args)
 
 
 # ---------------------------------------------------------------------------
@@ -264,9 +431,9 @@ def _span_write_kernel(n: int):
     def kernel(pages_ref, mask_ref, *refs):
         spans = refs[:n]
         outs = refs[2 * n:]
-        m = mask_ref[0] != 0                              # [bs]
+        m = mask_ref[0, :, 0] != 0                        # [bs]
         for s_ref, o_ref in zip(spans, outs):
-            mv = m.reshape((1, -1) + (1,) * (o_ref.ndim - 2))
+            mv = m.reshape((1, 1, -1) + (1,) * (o_ref.ndim - 3))
             o_ref[...] = jnp.where(mv, s_ref[...], o_ref[...])
 
     return kernel
@@ -279,61 +446,78 @@ def paged_span_write(pool: Dict[str, jax.Array],
                      interpret: bool = False) -> Dict[str, jax.Array]:
     """Write one chunk's spans into its pool pages, masked per row.
 
-    ``pool`` maps array names to pool buffers [L, M, ...]; ``spans``
-    maps the SAME names to the chunk's stacked spans [L, pc·bs, ...]
-    (values and, for quantized pools, scale rows alike); ``pages``
-    [pc] int32 the chunk's physical pages; ``valid`` [pc·bs] bool the
-    per-row write mask (False rows keep the pool's old bytes — the RMW
-    equivalent of the decode scatter's mode="drop"). Returns the
-    updated pool arrays.
+    ``pool`` maps array names to head-major pool buffers
+    [L, Hkv, M, ...]; ``spans`` maps the SAME names to the chunk's
+    stacked spans [L, Hkv, pc·bs, ...] (values and, for quantized
+    pools, scale rows alike — scale tables are the 3D [L, Hkv, M] /
+    [L, Hkv, pc·bs] case and ride as trailing-singleton 4D views);
+    ``pages`` [pc] int32 the chunk's physical pages; ``valid`` [pc·bs]
+    bool the per-row write mask (False rows keep the pool's old bytes —
+    the RMW equivalent of the decode scatter's mode="drop"). Returns
+    the updated pool arrays.
 
     Grid (pc,); each program's blocks are one page's span per array,
     placed by indexing the output BlockSpec through the scalar-
     prefetched page vector — the hand-scheduled form of the masked
     contiguous-span writes XLA emits for the fallback path, with the
     pool aliased in-place instead of round-tripping a pool-sized
-    copy."""
+    copy. Every block keeps its trailing two dims tiling-legal: the
+    page axis sits third-from-last (``(L, Hkv, bs, Dh)`` value blocks,
+    ``(L, Hkv, bs, 1)`` scale blocks, ``(1, bs, 1)`` mask blocks)."""
     names = sorted(spans)
     bs = int(block_size)
     pc = int(pages.shape[0])
     n = len(names)
-    mask = valid.astype(jnp.int32).reshape(pc, bs)
+    mask = valid.astype(jnp.int32).reshape(pc, bs, 1)
+    # 3D arrays (the scale tables) ride as trailing-singleton 4D views
+    # so their blocks end in (bs, 1) — legal under the tiling rule
+    three_d = {nm for nm in names if pool[nm].ndim == 3}
+
+    def view(a):
+        return a[..., None] if a.ndim == 3 else a
+
+    pools4 = {nm: view(pool[nm]) for nm in names}
+    spans4 = {nm: view(spans[nm]) for nm in names}
 
     def span_spec(a):
-        blk = (a.shape[0], bs) + a.shape[2:]
+        blk = a.shape[:2] + (bs,) + a.shape[3:]
         nd = a.ndim
 
         def imap(j, pg, nd=nd):
-            return (0, j) + (0,) * (nd - 2)
+            return (0, 0, j) + (0,) * (nd - 3)
 
         return pl.BlockSpec(blk, imap)
 
     def pool_spec(a):
-        blk = (a.shape[0], bs) + a.shape[2:]
+        blk = a.shape[:2] + (bs,) + a.shape[3:]
         nd = a.ndim
 
         def imap(j, pg, nd=nd):
-            return (0, pg[j]) + (0,) * (nd - 2)
+            return (0, 0, pg[j]) + (0,) * (nd - 3)
 
         return pl.BlockSpec(blk, imap)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(pc,),
-        in_specs=([pl.BlockSpec((1, bs), lambda j, pg: (j, 0))]
-                  + [span_spec(spans[nm]) for nm in names]
-                  + [pool_spec(pool[nm]) for nm in names]),
-        out_specs=[pool_spec(pool[nm]) for nm in names],
+        in_specs=([pl.BlockSpec((1, bs, 1), lambda j, pg: (j, 0, 0))]
+                  + [span_spec(spans4[nm]) for nm in names]
+                  + [pool_spec(pools4[nm]) for nm in names]),
+        out_specs=[pool_spec(pools4[nm]) for nm in names],
     )
     outs = pl.pallas_call(
         _span_write_kernel(n),
         grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct(pool[nm].shape, pool[nm].dtype)
+        out_shape=[jax.ShapeDtypeStruct(pools4[nm].shape,
+                                        pools4[nm].dtype)
                    for nm in names],
-        # pool inputs alias the outputs: index 0 is the scalar-prefetch
-        # pages, 1 the mask, 2..n+1 the spans, n+2.. the pool buffers
+        # pool inputs alias the outputs: scalar-prefetch pages ride
+        # first, then the mask, the spans, and the pool buffers at
+        # kernel-arg indices 1..; the alias indices COUNT the scalar-
+        # prefetch operand, matching pallas_call's flat operand order
         input_output_aliases={2 + n + i: i for i in range(n)},
         interpret=interpret,
     )(pages.astype(jnp.int32), mask,
-      *[spans[nm] for nm in names], *[pool[nm] for nm in names])
-    return dict(zip(names, outs))
+      *[spans4[nm] for nm in names], *[pools4[nm] for nm in names])
+    return {nm: (o[..., 0] if nm in three_d else o)
+            for nm, o in zip(names, outs)}

@@ -884,8 +884,8 @@ class PagedDecodeEngine(DecodeEngine):
         # the page table indexes alongside the values). Derived HBM
         # arithmetic uses the pool SHAPES, so it needs no model config.
         self.kv_dtype = kv_dtype or "none"
-        kshape = cache["k"].shape          # [L, M, Hkv, Dh-stored]
-        L, _, Hkv, Dh_st = kshape
+        kshape = cache["k"].shape          # [L, Hkv, M, Dh-stored]
+        L, Hkv, _, Dh_st = kshape
         per_tok = 2 * Hkv * Dh_st * cache["k"].dtype.itemsize
         if "k_scale" in cache:
             per_tok += 2 * Hkv * 4         # fp32 scale rows (k + v)

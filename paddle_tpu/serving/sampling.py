@@ -125,8 +125,10 @@ def _epilogue(mode):
     ``PADDLE_TPU_PALLAS`` mode: the Pallas ``fused_sample`` kernel
     (greedy/top-k set exact, categorical matching in distribution) when
     the kernels are dispatchable on this backend
-    (``decode.kernels_dispatchable`` — "on" falls back to
-    ``sample_tokens`` until the kernels lower through Mosaic),
+    (``decode.kernels_dispatchable`` — "on" off-TPU falls back to
+    ``sample_tokens`` with a once-per-mode warning) AND, for ``on``,
+    when the cached Mosaic lowering probe
+    (``decode.sample_lowering_ok``) accepts the logits shape;
     ``sample_tokens`` otherwise."""
     from paddle_tpu.ops.pallas import decode as _pallas_decode
     if not _pallas_decode.kernels_dispatchable(mode):
@@ -135,6 +137,10 @@ def _epilogue(mode):
             return sample_tokens(logits, key, temperature, top_k)
     else:
         def tail(logits, seed, temperature, top_k):
+            if mode == "on" and not _pallas_decode.sample_lowering_ok(
+                    logits.shape[0], logits.shape[1]):
+                key = jax.random.PRNGKey(seed)
+                return sample_tokens(logits, key, temperature, top_k)
             return _pallas_decode.fused_sample(
                 logits, seed, temperature, top_k,
                 interpret=(mode == "interpret"))
@@ -266,6 +272,12 @@ def _spec_epilogue(mode):
                                       top_k, valid)
     else:
         def tail(logits, draft, seed, temperature, top_k, valid):
+            B, W, V = logits.shape
+            if mode == "on" and not _pallas_decode.sample_lowering_ok(
+                    B * W, V):
+                key = jax.random.PRNGKey(seed)
+                return spec_verify_tokens(logits, draft, key,
+                                          temperature, top_k, valid)
             return _pallas_decode.fused_spec_verify(
                 logits, draft, seed, temperature, top_k, valid,
                 interpret=(mode == "interpret"))

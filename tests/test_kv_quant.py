@@ -67,15 +67,16 @@ def _cold_pool(prompt, kv_dtype, pages, chunks=(8, 6), params=PARAMS,
 
 def _scramble_quant(pool, pages, rng):
     """Permute physical blocks of a QUANTIZED pool — values and scale
-    tables move together, page table remapped."""
-    M = pool["k"].shape[1]
+    tables move together (the position axis is axis 2 at the
+    head-major layout), page table remapped."""
+    M = pool["k"].shape[2]
     nb = M // BS
     perm = rng.permutation(nb).astype(np.int32)     # old block i -> perm[i]
     gidx = np.empty(M, np.int64)
     for i in range(nb):
         gidx[perm[i] * BS:(perm[i] + 1) * BS] = np.arange(
             i * BS, (i + 1) * BS)
-    pool2 = {k: jnp.asarray(np.asarray(v)[:, gidx])
+    pool2 = {k: jnp.asarray(np.asarray(v)[:, :, gidx])
              for k, v in pool.items()}
     pages2 = jnp.asarray(perm[np.asarray(pages)])
     return pool2, pages2
@@ -114,8 +115,11 @@ class TestKvPrimitives:
         assert q8p["k"].dtype == jnp.int8
         assert q8p["k"].shape[-1] == CFG.head_dim
         assert q4p["k"].shape[-1] == CFG.head_dim // 2
-        assert q8p["k_scale"].shape == (CFG.n_layers, 4 * BS,
-                                        CFG.kv_heads)
+        assert q8p["k"].shape == (CFG.n_layers, CFG.kv_heads, 4 * BS,
+                                  CFG.head_dim)      # head-major
+        assert q8p["k_scale"].shape == (CFG.n_layers, CFG.kv_heads,
+                                        4 * BS)
+        assert transformer.POOL_LAYOUT == "head_major"
         assert transformer.pool_kv_dtype(fp, CFG) == "none"
         assert transformer.pool_kv_dtype(q8p, CFG) == "int8"
         assert transformer.pool_kv_dtype(q4p, CFG) == "int4"
@@ -161,12 +165,12 @@ class TestQuantizedPoolKernels:
         for leaf in ("k", "v", "k_scale", "v_scale"):
             a, b = np.asarray(pool[leaf]), np.asarray(out[leaf])
             # row 1 (inactive) targets blocks 2/3: untouched
-            np.testing.assert_array_equal(a[:, 2 * BS:4 * BS],
-                                          b[:, 2 * BS:4 * BS])
+            np.testing.assert_array_equal(a[:, :, 2 * BS:4 * BS],
+                                          b[:, :, 2 * BS:4 * BS])
         # row 0 (active) did write its position: pos 14 lives in its
         # page-1 block (physical block 1) at offset 6
         w = 1 * BS + 14 % BS
-        assert (np.asarray(out["k_scale"])[:, w] > 0).all()
+        assert (np.asarray(out["k_scale"])[:, :, w] > 0).all()
 
     @pytest.mark.parametrize("kvd", KV_DTYPES)
     def test_page_scramble_invariance_scales_travel(self, kvd, rng):
@@ -227,10 +231,10 @@ class TestQuantizedPoolKernels:
         np.testing.assert_array_equal(np.asarray(lg1), np.asarray(lg2))
         for leaf in ("k", "v", "k_scale", "v_scale"):
             a, b = np.asarray(pool1[leaf]), np.asarray(pool2[leaf])
-            np.testing.assert_array_equal(a[:, 0 * BS:1 * BS],
-                                          b[:, 4 * BS:5 * BS])
-            np.testing.assert_array_equal(a[:, 1 * BS:2 * BS],
-                                          b[:, 2 * BS:3 * BS])
+            np.testing.assert_array_equal(a[:, :, 0 * BS:1 * BS],
+                                          b[:, :, 4 * BS:5 * BS])
+            np.testing.assert_array_equal(a[:, :, 1 * BS:2 * BS],
+                                          b[:, :, 2 * BS:3 * BS])
 
     def test_quant_decode_kernel_bitwise_vs_xla(self, rng):
         """Fused-dequant flash decode == the XLA quantized path,

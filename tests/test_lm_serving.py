@@ -273,7 +273,8 @@ def test_engine_artifact_v4_paged_roundtrip(tmp_path, rng):
     assert srv.meta["engine_paged"] == {
         "block_size": 8, "num_blocks": 8, "pages_per_slot": 4,
         "chunk_tokens": 16, "pallas": pallas_policy.pallas_mode(None),
-        "kv_dtype": "none"}
+        "kv_dtype": "none",
+        "pool_layout": transformer.POOL_LAYOUT}
     assert srv.meta["engine_pallas"] == pallas_policy.pallas_mode(None)
     assert srv.cost_analysis["engine_decode"]["flops"] > 0
     # legacy lockstep path unchanged on a v4 artifact
@@ -306,6 +307,51 @@ def test_engine_artifact_v4_paged_roundtrip(tmp_path, rng):
     # engine() refuses to schedule a different one
     with pytest.raises(ValueError, match="chunk grid"):
         srv.engine(chunk_tokens=8)
+
+
+def test_engine_artifact_legacy_pool_layout_hint(tmp_path, rng):
+    """A v4/v5 artifact whose paged modules were exported against the
+    pre-relayout slot-major pool (no ``pool_layout`` stamp, or a stale
+    one) cannot be scheduled over the head-major pool this build
+    constructs — the exported programs bake the pool array shapes.
+    ``engine()`` must refuse with a one-line re-export hint instead of
+    dying on an opaque shape mismatch at the first prefill; the
+    non-engine paths (``generate``) still serve. Together with the v4
+    roundtrips above this covers both directions: current-layout
+    artifacts roundtrip, legacy-layout artifacts hint."""
+    import io as _io
+    import json
+    import tarfile
+
+    import pytest
+    params = transformer.init_params(jax.random.PRNGKey(0), CFG)
+    path = str(tmp_path / "lm_v4_legacy.tar")
+    lm_serving.save_lm_artifact(path, params, CFG, batch=2,
+                                prompt_len=6, cache_len=32,
+                                engine_buckets=(8, 16),
+                                engine_paged=True, engine_block_size=8)
+    # simulate a pre-relayout artifact: strip the pool_layout stamp
+    # (absent == slot_major, the legacy default)
+    legacy = str(tmp_path / "lm_v4_slotmajor.tar")
+    with tarfile.open(path) as src, tarfile.open(legacy, "w") as dst:
+        for m in src.getmembers():
+            blob = src.extractfile(m).read()
+            if m.name == "meta.json":
+                meta = json.loads(blob)
+                del meta["engine_paged"]["pool_layout"]
+                blob = json.dumps(meta).encode()
+            info = tarfile.TarInfo(m.name)
+            info.size = len(blob)
+            dst.addfile(info, _io.BytesIO(blob))
+    srv = lm_serving.load_lm_artifact(legacy)
+    with pytest.raises(ValueError, match="re-export"):
+        srv.engine(seed=0)
+    # the lockstep path carries no pool and keeps serving
+    prompt = rng.randint(0, 40, (2, 6)).astype(np.int32)
+    got = srv.generate(prompt, max_new=4)
+    want = np.asarray(transformer.generate(
+        params, jnp.asarray(prompt), CFG, max_new=4))
+    np.testing.assert_array_equal(got, want)
 
 
 def test_engine_artifact_v4_int8_roundtrip(tmp_path, rng):

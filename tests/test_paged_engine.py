@@ -25,11 +25,13 @@ BS = 8          # block size shared by the kernel contracts below
 
 
 def _pool_from_arena(cache, cfg):
-    """Arena [L, B, T, Hkv, Dh] -> flat pool with the identity paging
-    (slot b's pages tile its contiguous span)."""
+    """Arena [L, B, T, Hkv, Dh] -> head-major flat pool [L, Hkv, M, Dh]
+    with the identity paging (slot b's pages tile its contiguous
+    span)."""
     L, B, T = cache["k"].shape[:3]
-    pool = {k: jnp.reshape(v, (L, B * T, cfg.kv_heads, cfg.head_dim))
-            for k, v in cache.items()}
+    pool = {k: jnp.moveaxis(jnp.reshape(
+        v, (L, B * T, cfg.kv_heads, cfg.head_dim)), 1, 2)
+        for k, v in cache.items()}
     pages = np.arange(B * (T // BS), dtype=np.int32).reshape(B, T // BS)
     return pool, jnp.asarray(pages)
 
@@ -63,8 +65,9 @@ class TestPagedKernels:
         np.testing.assert_array_equal(np.asarray(l_slot),
                                       np.asarray(l_paged))
         for leaf in ("k", "v"):
-            want = np.asarray(c_slot[leaf]).reshape(
-                np.asarray(c_paged[leaf]).shape)
+            a = np.asarray(c_slot[leaf])            # [L, B, T, Hkv, Dh]
+            want = np.moveaxis(a.reshape(
+                (a.shape[0], -1) + a.shape[3:]), 1, 2)
             np.testing.assert_array_equal(want, np.asarray(c_paged[leaf]))
 
     def test_scrambled_pages_same_logits(self, rng):
@@ -85,7 +88,7 @@ class TestPagedKernels:
         scat = np.empty_like(perm)
         scat[perm] = np.arange(B * P, dtype=np.int32)
         gidx = (perm[:, None] * BS + np.arange(BS)).reshape(-1)
-        pool2 = {k: jnp.asarray(np.asarray(v)[:, gidx])
+        pool2 = {k: jnp.asarray(np.asarray(v)[:, :, gidx])
                  for k, v in pool.items()}
         pages2 = jnp.asarray(scat[np.asarray(pages).reshape(-1)]
                              .reshape(B, P))
@@ -133,10 +136,43 @@ class TestPagedKernels:
         for leaf in ("k", "v"):
             a = np.asarray(pool2[leaf])
             b = np.asarray(pool3[leaf])
-            np.testing.assert_array_equal(a[:, 0 * BS:1 * BS],
-                                          b[:, 4 * BS:5 * BS])
-            np.testing.assert_array_equal(a[:, 1 * BS:2 * BS],
-                                          b[:, 2 * BS:3 * BS])
+            np.testing.assert_array_equal(a[:, :, 0 * BS:1 * BS],
+                                          b[:, :, 4 * BS:5 * BS])
+            np.testing.assert_array_equal(a[:, :, 1 * BS:2 * BS],
+                                          b[:, :, 2 * BS:3 * BS])
+
+    def test_transposed_scatter_touches_only_the_mapped_row(self, rng):
+        """Sentinel-pool isolation of the head-major decode scatter:
+        a decode step writes EXACTLY one pool row per active slot (its
+        page-mapped position) — every other row of every block keeps
+        its sentinel bytes bitwise, on the XLA path AND the interpret
+        kernel. A transpose bug that scattered on the wrong axis (or
+        broadcast across heads) could silently corrupt another slot's
+        blocks while that slot's own logits still looked fine; the
+        sentinel pins it."""
+        M = 6 * BS
+        sentinel = {
+            "k": jnp.full((CFG.n_layers, CFG.kv_heads, M,
+                           CFG.head_dim), 11.5, jnp.float32),
+            "v": jnp.full((CFG.n_layers, CFG.kv_heads, M,
+                           CFG.head_dim), -4.75, jnp.float32)}
+        tok = jnp.asarray([7, 3], jnp.int32)
+        pos = jnp.asarray([9, 4], jnp.int32)       # -> page 1 off 1 / drop
+        active = jnp.asarray([True, False])
+        pages = jnp.asarray([[5, 2], [0, 3]], jnp.int32)
+        w = 2 * BS + 9 % BS                        # slot 0's write row
+        for mode in ("off", "interpret"):
+            _, out = transformer.decode_step_paged(
+                PARAMS, dict(sentinel), tok, pos, active, pages, CFG,
+                block_size=BS, pallas=mode)
+            for leaf, want in (("k", 11.5), ("v", -4.75)):
+                got = np.asarray(out[leaf])
+                mask = np.ones(M, bool)
+                mask[w] = False
+                # every row except the single mapped write: sentinel
+                np.testing.assert_array_equal(got[:, :, mask], want)
+                # the mapped row changed in every layer and head
+                assert (got[:, :, w] != want).any(axis=-1).all()
 
     def test_prefill_into_blocks_matches_slot_prefill(self, rng):
         """Block prefill reproduces prefill_into_slot's gathered-head
@@ -159,7 +195,7 @@ class TestPagedKernels:
         k = np.asarray(pool["k"])
         for b in (0, 1, 2, 4, 5):                            # unmapped
             np.testing.assert_array_equal(
-                k[:, b * BS:(b + 1) * BS], 0.0)
+                k[:, :, b * BS:(b + 1) * BS], 0.0)
 
 
 class TestBlockPool:

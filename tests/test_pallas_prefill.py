@@ -96,9 +96,9 @@ class TestChunkPrefillKernel:
         chunk's valid length write back the span's OLD bytes — pinned
         against a sentinel-filled pool, not just zeros."""
         sentinel = {
-            "k": jnp.full((CFG.n_layers, 6 * BS, CFG.kv_heads,
+            "k": jnp.full((CFG.n_layers, CFG.kv_heads, 6 * BS,
                            CFG.head_dim), 7.5, jnp.float32),
-            "v": jnp.full((CFG.n_layers, 6 * BS, CFG.kv_heads,
+            "v": jnp.full((CFG.n_layers, CFG.kv_heads, 6 * BS,
                            CFG.head_dim), -3.25, jnp.float32)}
         c = 5                                     # bucket 8: 3 padded
         padded = np.zeros((1, 8), np.int32)
@@ -117,11 +117,11 @@ class TestChunkPrefillKernel:
             # padded rows of the written block keep the sentinel
             want = 7.5 if leaf == "k" else -3.25
             np.testing.assert_array_equal(
-                b[:, 2 * BS + c:3 * BS], want)
+                b[:, :, 2 * BS + c:3 * BS], want)
             # untouched blocks fully intact
-            np.testing.assert_array_equal(b[:, :2 * BS], want)
+            np.testing.assert_array_equal(b[:, :, :2 * BS], want)
             # valid rows actually changed
-            assert not (b[:, 2 * BS:2 * BS + c] == want).all()
+            assert not (b[:, :, 2 * BS:2 * BS + c] == want).all()
 
     def test_kernel_direct_tile_sweep(self, rng):
         """flash_chunk_prefill over every legal tile returns identical
@@ -131,8 +131,8 @@ class TestChunkPrefillKernel:
         q = jnp.asarray(rng.randn(C, Hkv, G, Dh).astype(np.float32))
         kck = jnp.asarray(rng.randn(C, Hkv, Dh).astype(np.float32))
         vck = jnp.asarray(rng.randn(C, Hkv, Dh).astype(np.float32))
-        k = jnp.asarray(rng.randn(M, Hkv, Dh).astype(np.float32))
-        v = jnp.asarray(rng.randn(M, Hkv, Dh).astype(np.float32))
+        k = jnp.asarray(rng.randn(Hkv, M, Dh).astype(np.float32))
+        v = jnp.asarray(rng.randn(Hkv, M, Dh).astype(np.float32))
         pages = jnp.asarray(rng.permutation(M // BS)[:P_ctx]
                             .astype(np.int32))
         outs = [np.asarray(fp.flash_chunk_prefill(
@@ -151,8 +151,9 @@ class TestChunkPrefillKernel:
         assert fp.select_prefill_tile(16, 16, 64, 64,
                                       jnp.bfloat16) == 16
         assert fp.select_prefill_tile(6, 16, 64, 64, jnp.bfloat16) == 2
-        # measured table wins only when its advisory block size matches
-        key = (1 << 11, 64, 64, "bfloat16")
+        # measured table is keyed by POOL LAYOUT first and wins only
+        # when its advisory block size matches
+        key = (fp.POOL_LAYOUT, 1 << 11, 64, 64, "bfloat16")
         fp.MEASURED_PREFILL[key] = (16, 4)
         try:
             assert fp.select_prefill_tile(128, 16, 64, 64,
@@ -161,24 +162,32 @@ class TestChunkPrefillKernel:
                                           jnp.bfloat16) != 4
         finally:
             del fp.MEASURED_PREFILL[key]
+        # a pre-relayout-style key (no layout token) is never consulted
+        fp.MEASURED_PREFILL[(1 << 11, 64, 64, "bfloat16")] = (16, 4)
+        try:
+            assert fp.select_prefill_tile(128, 16, 64, 64,
+                                          jnp.bfloat16) == 16
+        finally:
+            del fp.MEASURED_PREFILL[(1 << 11, 64, 64, "bfloat16")]
         # quantized pools key by their storage name
-        key4 = (1 << 11, 64, 64, "int4")
+        key4 = (fp.POOL_LAYOUT, 1 << 11, 64, 64, "int4")
         fp.MEASURED_PREFILL[key4] = (16, 8)
         try:
             assert fp.select_prefill_tile(
                 128, 16, 64, 64, jnp.int8, kv_dtype="int4") == 8
         finally:
             del fp.MEASURED_PREFILL[key4]
-        # budget: serving shapes fit, absurd ones do not — and int8
-        # storage buys headroom at equal span (an 8-slot bf16 pool at
-        # span 2048 is just OVER the 85%-of-16MiB budget; its int8
-        # form fits)
+        # budget: the scalar-prefetched stream made the working set
+        # independent of the pool size M (pre-relayout, two whole
+        # M-row pool head columns sat in VMEM) — a giant pool behind a
+        # serving-sized chunk fits; the score scratch is what binds
+        # now, so a huge (chunk x span) product does not
         assert fp.prefill_kernel_fits(4 * 2048, 2048, 64, 4, 128,
                                       jnp.bfloat16)
-        assert not fp.prefill_kernel_fits(8 * 2048, 2048, 64, 4, 128,
-                                          jnp.bfloat16)
         assert fp.prefill_kernel_fits(8 * 2048, 2048, 64, 4, 128,
-                                      jnp.int8, kv_dtype="int8")
+                                      jnp.bfloat16)
+        assert fp.prefill_kernel_fits(512 * 8192, 2048, 64, 4, 128,
+                                      jnp.bfloat16)
         assert not fp.prefill_kernel_fits(512 * 8192, 8192, 512, 8,
                                           256, jnp.float32)
         span = 64 * 2048

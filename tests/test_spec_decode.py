@@ -30,12 +30,13 @@ BS = 8
 
 
 def _pool_state(params, cfg, rng, B=2, Tp=6, T=32):
-    """(pool, pages, last, pos) after a prefill — decode-ready state."""
+    """(pool, pages, last, pos) after a prefill — decode-ready state
+    (head-major pool [L, Hkv, M, Dh])."""
     prompt = jnp.asarray(rng.randint(0, 40, (B, Tp)), jnp.int32)
     logits, cache = transformer.prefill(params, prompt, cfg, T)
-    pool = {k: jnp.reshape(v, (cfg.n_layers, B * T, cfg.kv_heads,
-                               cfg.head_dim))
-            for k, v in cache.items()}
+    pool = {k: jnp.moveaxis(jnp.reshape(
+        v, (cfg.n_layers, B * T, cfg.kv_heads, cfg.head_dim)), 1, 2)
+        for k, v in cache.items()}
     pages = jnp.asarray(np.arange(B * (T // BS), dtype=np.int32)
                         .reshape(B, T // BS))
     return (pool, pages, jnp.argmax(logits, -1).astype(jnp.int32),
@@ -86,14 +87,14 @@ class TestVerifyStepPaged:
             PARAMS, pool, window, pos, valid, active, pages, CFG,
             block_size=BS)
         k0, k1 = np.asarray(pool["k"]), np.asarray(pool_v["k"])
-        # slot 0 wrote exactly rows pos..pos+1 of its own span
+        # slot 0 wrote exactly rows pos..pos+1 of its own span (the
+        # head-major pool's position axis is axis 2)
         Tp = int(pos[0])
         changed = np.flatnonzero(
-            np.abs(k1 - k0).reshape(CFG.n_layers, -1).sum(0)
-            .reshape(2 * 32, -1).sum(-1))
+            np.abs(k1 - k0).sum(axis=(0, 1, 3)))
         assert set(changed) <= {Tp, Tp + 1}, changed
         # slot 1 (inactive): its physical rows 32..63 untouched
-        np.testing.assert_array_equal(k0[:, 32:], k1[:, 32:])
+        np.testing.assert_array_equal(k0[:, :, 32:], k1[:, :, 32:])
 
     def test_verify_int8_pool_matches_xla_decode(self, rng):
         """Quantized pools ride the verify window with write-time
@@ -311,8 +312,9 @@ class TestSpecEngine:
         fns = sampling.paged_spec_fns(CFG, DRAFT_CFG, BS, 3,
                                       pallas="off")
         pool = transformer.init_block_pool(DRAFT_CFG, 6, BS)
-        # sentinel bytes in physical block 0 (some other slot's rows)
-        pool = {k: v.at[:, :BS].set(7.0) for k, v in pool.items()}
+        # sentinel bytes in physical block 0 (some other slot's rows;
+        # the head-major position axis is axis 2)
+        pool = {k: v.at[:, :, :BS].set(7.0) for k, v in pool.items()}
         pages = jnp.asarray([[3, 0, 0]], jnp.int32)   # 1 allocated page
         pos = jnp.asarray([BS - 1], jnp.int32)        # last row of it
         _, out = fns["propose"](
@@ -320,10 +322,10 @@ class TestSpecEngine:
             jnp.asarray([True]), jnp.asarray([1], jnp.int32), pages)
         for leaf in ("k", "v"):
             np.testing.assert_array_equal(
-                np.asarray(out[leaf])[:, :BS], 7.0)   # block 0 intact
+                np.asarray(out[leaf])[:, :, :BS], 7.0)  # block 0 intact
         # ...while the one VALID step's write landed in block 3
         row = 3 * BS + BS - 1
-        assert np.abs(np.asarray(out["k"])[:, row]).sum() > 0
+        assert np.abs(np.asarray(out["k"])[:, :, row]).sum() > 0
 
     def test_health_reports_spec_section(self, rng):
         eng = _mk_spec()
