@@ -87,6 +87,24 @@ FAMILIES = {
             ("mosaic_lowerable_ok", "true", 0.0),
         ],
     },
+    "router": {
+        # serving-fleet figures (serving_bench.py --fleet artifacts):
+        # the goodput ratio and victim-TTFT ratio are same-machine
+        # A/Bs (the machine mostly cancels — mid band); absolute fleet
+        # throughput breathes with host load; placement hit rate is
+        # near-deterministic on the fixed trace; the two booleans —
+        # every submitted request completed, and disaggregated P/D
+        # generation bitwise the colocated run — must hold outright
+        "glob": "*serving_fleet*.json",
+        "figures": [
+            ("router_goodput_ratio", "higher", 0.15),
+            ("fleet_tokens_per_sec", "higher", 0.25),
+            ("victim_ttft_ratio", "lower", 0.35),
+            ("placement_hit_rate", "higher", 0.10),
+            ("all_requests_completed", "true", 0.0),
+            ("pd_bitwise_ok", "true", 0.0),
+        ],
+    },
     "elastic": {
         # elastic_bench.py recovery figures: wall-clock dominated by
         # worker restart + jax re-init + recompile, so both get the

@@ -105,6 +105,30 @@ def metrics_write(**rec):
     _metrics_write(METRICS_OUT, **rec)
 
 
+def write_artifact(results, suffix, args):
+    """Date-stamped artifact write shared by the serving and fleet
+    phases: same-day reruns get an ordering-preserving _b/_c suffix
+    instead of overwriting the artifact the regression sentinel
+    compares against (the zero_bench convention); --smoke skips the
+    write unless --out was given explicitly."""
+    out = args.out
+    if out is None:
+        base = os.path.join(REPO, "benchmarks", "runs",
+                            f"{datetime.date.today()}_{suffix}")
+        out = base + ".json"
+        i = 0
+        while os.path.exists(out) and not args.smoke:
+            i += 1
+            out = f"{base}_{chr(ord('a') + i)}.json"
+    if args.out or not args.smoke:
+        d = os.path.dirname(out)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(results, f, indent=2)
+        print(f"wrote {out}", file=sys.stderr)
+
+
 def _pct(vals, q):
     if not vals:
         return 0.0
@@ -995,6 +1019,187 @@ def multitenant_phase(args):
     return out
 
 
+def _replay_router(router, work):
+    """Wall-clock trace replay against a fleet Router (mirrors
+    ``_replay``'s arrival discipline; one router.step() per
+    iteration pumps every in-process replica one engine step)."""
+    reqs, i, t0 = [], 0, time.perf_counter()
+    while len(reqs) < len(work) or not router.idle:
+        now = time.perf_counter() - t0
+        while i < len(work) and work[i][0] <= now:
+            _, prompt, max_new = work[i]
+            reqs.append(router.submit(prompt, max_new))
+            i += 1
+        if router.idle:
+            time.sleep(min(max(work[i][0] - now, 0.0), 0.05))
+            continue
+        router.step()
+    return reqs, time.perf_counter() - t0
+
+
+def _fleet_victims(work, burst):
+    """Indices of the burst arrivals compressed behind the adversarial
+    long prompt (the longest prompt in the trace) — the victim set the
+    TTFT figure scores."""
+    lens = [len(p) for _, p, _ in work]
+    adv = int(np.argmax(lens))
+    return adv, set(range(adv + 1, min(adv + 1 + burst, len(work))))
+
+
+def fleet_phase(args):
+    """Serving-fleet A/B: a prefix-aware Router over R in-process
+    replicas vs ONE engine at EQUAL total slots and pool blocks, on
+    the shared-prefix trace with the long-prompt adversary mid-burst.
+
+    Figures: router goodput ratio (fleet tokens/sec over the
+    equal-chip single engine), victim TTFT p99 ratio (the burst
+    arrivals stuck behind the adversary — the fleet quarantines the
+    adversary's chunked prefill on ONE replica while the others keep
+    serving, where the single engine makes every decoder share the
+    stall), placement hit rate (shared-prefix traffic converging onto
+    warm pools), an all-requests-completed bool, and a P/D
+    disaggregation bitwise check (prefill replica exports the KV
+    prefix over the transfer wire, decode replica adopts it via the
+    prefix-cache publish path, outputs equal the colocated run —
+    asserted outright, it must never rot)."""
+    from paddle_tpu.observe.compile_tracker import CompileTracker
+    from paddle_tpu.serving import EngineReplica, default_chunk_buckets
+    from paddle_tpu.serving.router import Router
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import transformer
+
+    R = 2 if args.smoke else 3
+    per_batch = max(2, args.batch // 2)
+    pages = args.cache_len // args.block_size
+    cfg = transformer.TransformerConfig(
+        vocab=args.vocab, d_model=args.d_model,
+        n_heads=max(2, args.d_model // 32), n_kv_heads=0,
+        n_layers=args.layers, d_ff=args.d_model * 4,
+        max_len=args.cache_len,
+        dtype=jnp.float32 if jax.default_backend() == "cpu"
+        else jnp.bfloat16, use_rope=True)
+    params = transformer.init_params(jax.random.PRNGKey(2), cfg)
+    prompt_lens = [int(x) for x in args.prompt_lens.split(",")]
+    max_news = [int(x) for x in args.max_new.split(",")]
+    burst = R * per_batch
+    work = build_workload(
+        args.requests, args.rate, prompt_lens, max_news, args.vocab,
+        args.seed + 5, shared_frac=max(args.shared_prefix_frac, 0.5),
+        shared_len=args.shared_prefix_len, adversarial=True,
+        cache_len=args.cache_len, burst=burst)
+    adv_i, victims = _fleet_victims(work, burst)
+
+    chunk = min(args.chunk_tokens, args.cache_len)
+    storm = (args.cache_len // chunk) * len(
+        default_chunk_buckets(chunk)) + 2
+    mk_rep = paged_factory(
+        params, cfg, batch=per_batch, cache_len=args.cache_len,
+        block_size=args.block_size, chunk_tokens=args.chunk_tokens,
+        num_blocks=per_batch * pages,
+        tracker=CompileTracker(storm_threshold=storm), pallas="off")
+    mk_single = paged_factory(
+        params, cfg, batch=R * per_batch, cache_len=args.cache_len,
+        block_size=args.block_size, chunk_tokens=args.chunk_tokens,
+        num_blocks=R * per_batch * pages,
+        tracker=CompileTracker(storm_threshold=storm), pallas="off")
+    warm_rep = warm_engine(mk_rep, work, args.vocab)
+    warm_single = warm_engine(mk_single, work, args.vocab)
+
+    def once_single():
+        eng = mk_single()
+        reqs, wall, occ_s, occ_b = _replay(eng, work)
+        assert eng.compile_counts() == warm_single, "single recompiled"
+        toks = sum(len(r.tokens) for r in reqs)
+        vt = sorted(r.ttft_s for i, r in enumerate(reqs)
+                    if i in victims)
+        return {"tokens_per_sec": round(toks / wall, 2),
+                "wall_s": round(wall, 4), "tokens": toks,
+                "victim_ttft_p99_s": round(_pct(vt, 0.99), 4),
+                "requests": len(reqs),
+                "completed": sum(1 for r in reqs
+                                 if r.finish_reason is not None)}
+
+    def once_fleet():
+        reps = [EngineReplica(mk_rep(), f"r{i}") for i in range(R)]
+        router = Router(reps, block_size=args.block_size,
+                        chunk_tokens=args.chunk_tokens,
+                        max_in_flight=per_batch * 2,
+                        health_poll_s=0.5)
+        reqs, wall = _replay_router(router, work)
+        for eng in (r.eng for r in reps):
+            assert eng.compile_counts() == warm_rep, "fleet recompiled"
+        toks = sum(len(r.tokens) for r in reqs)
+        vt = sorted(r.ttft_s for i, r in enumerate(reqs)
+                    if i in victims and r.ttft_s is not None)
+        return {"tokens_per_sec": round(toks / wall, 2),
+                "wall_s": round(wall, 4), "tokens": toks,
+                "victim_ttft_p99_s": round(_pct(vt, 0.99), 4),
+                "requests": len(reqs),
+                "completed": sum(1 for r in reqs
+                                 if r.status == "done"),
+                "failed": sum(1 for r in reqs
+                              if r.status == "failed"),
+                "requeued": int(router._m_requeued.value()),
+                "replicas": R, "slots_per_replica": per_batch,
+                "placement_hit_rate": round(
+                    router.placement_hit_rate(), 4)}
+
+    repeats = max(1, args.repeats)
+    single = fleet = None
+    for _ in range(repeats):       # interleaved, best goodput per side
+        s, f = once_single(), once_fleet()
+        if single is None or s["tokens_per_sec"] > \
+                single["tokens_per_sec"]:
+            single = s
+        if fleet is None or f["tokens_per_sec"] > \
+                fleet["tokens_per_sec"]:
+            fleet = f
+
+    # P/D disaggregation bitwise check: colocated reference vs a
+    # 1-prefill + 1-decode router fleet over the SAME compiled programs
+    pd_prompts = [p for _, p, _ in work
+                  if len(p) > args.chunk_tokens][:3]
+    ref_eng = mk_rep()
+    ref_out = []
+    for p in pd_prompts:
+        r = ref_eng.submit(p, 8)
+        ref_eng.run_until_idle()
+        ref_out.append(r.output)
+    pf, dc = EngineReplica(mk_rep(), "pf"), EngineReplica(mk_rep(), "dc")
+    pd_router = Router([pf, dc], block_size=args.block_size,
+                       chunk_tokens=args.chunk_tokens, prefill=["pf"],
+                       health_poll_s=0.5)
+    pd_reqs = [pd_router.submit(p, 8) for p in pd_prompts]
+    pd_router.run_until_idle()
+    pd_ok = all(np.array_equal(r.output, w)
+                for r, w in zip(pd_reqs, ref_out))
+    assert pd_ok, "P/D disaggregated generation diverged from the " \
+                  "colocated run"
+    assert int(pd_router._m_pd_exports.value()) >= 1
+
+    completed_ok = (fleet["failed"] == 0
+                    and fleet["completed"] == len(work)
+                    and fleet["requeued"] == 0)
+    out = {
+        "single": single, "fleet": fleet,
+        "adversary_prompt_tokens": len(work[adv_i][1]),
+        "victims": len(victims),
+        "router_goodput_ratio": round(
+            fleet["tokens_per_sec"]
+            / max(single["tokens_per_sec"], 1e-9), 3),
+        "victim_ttft_ratio": round(
+            fleet["victim_ttft_p99_s"]
+            / max(single["victim_ttft_p99_s"], 1e-9), 3),
+        "placement_hit_rate": fleet["placement_hit_rate"],
+        "all_requests_completed": completed_ok,
+        "pd_bitwise_ok": pd_ok,
+        "pd_blocks_shipped": int(pd_router._m_pd_blocks.value())}
+    assert completed_ok, f"fleet lost requests: {fleet}"
+    return out
+
+
 def lockstep_factory(params, cfg, *, batch, cache_len, buckets):
     """(warm_fn, once_fn) for the pre-engine serving discipline: fill a
     FIFO batch (pad the tail group), share one prompt bucket, decode
@@ -1148,6 +1353,13 @@ def main(argv=None):
                          "fused sampler) lowers through Mosaic at the "
                          "head-major pool layout and stamps the legal "
                          "BlockSpecs + VMEM estimates")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run ONLY the serving-fleet phase (router "
+                         "goodput + victim TTFT vs one engine at "
+                         "equal total slots, placement hit rate, P/D "
+                         "bitwise check) and write the date-stamped "
+                         "serving_fleet artifact the router sentinel "
+                         "family compares")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny preset for the tier-1 fast test: few "
                          "requests, near-zero inter-arrival gaps")
@@ -1167,6 +1379,26 @@ def main(argv=None):
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     import jax.numpy as jnp
+
+    if args.fleet:
+        # standalone fleet run: its own figures, its own date-stamped
+        # artifact (the check_regression `router` family's glob) —
+        # the colocated serving figures above stay untouched
+        results = {"fleet": fleet_phase(args)}
+        line = {"bench": "serving", "phase": "fleet",
+                "platform": jax.default_backend(),
+                **{k: v for k, v in results["fleet"].items()
+                   if not isinstance(v, dict)}}
+        print(json.dumps(line), flush=True)
+        metrics_write(**line)
+        for key in ("router_goodput_ratio", "victim_ttft_ratio",
+                    "placement_hit_rate", "all_requests_completed",
+                    "pd_bitwise_ok"):
+            results[key] = results["fleet"][key]
+        results["fleet_tokens_per_sec"] = \
+            results["fleet"]["fleet"]["tokens_per_sec"]
+        write_artifact(results, "serving_fleet", args)
+        return results
 
     from paddle_tpu.core import ragged
     from paddle_tpu.models import transformer
@@ -1464,6 +1696,19 @@ def main(argv=None):
     results["spec_decode_speedup"] = \
         results["spec_decode"]["spec_decode_speedup"]
 
+    if args.smoke:
+        # fleet phase rides the tier-1 smoke so its bitwise contracts
+        # (P/D disaggregation == colocated, zero lost requests) can't
+        # rot; the goodput/victim-TTFT CLAIMS come from dedicated
+        # --fleet runs and their own artifact
+        results["fleet"] = fleet_phase(args)
+        line = {"bench": "serving", "phase": "fleet",
+                "platform": jax.default_backend(),
+                **{k: v for k, v in results["fleet"].items()
+                   if not isinstance(v, dict)}}
+        print(json.dumps(line), flush=True)
+        metrics_write(**line)
+
     if args.tpu_check:
         results["tpu_check"] = tpu_export_check(
             params, cfg, block_size=args.block_size,
@@ -1553,23 +1798,7 @@ def main(argv=None):
         metrics_write(**line)
         results[metric] = round(value, 3)
 
-    out = args.out
-    if out is None:
-        # same-day reruns get an ordering-preserving _b/_c suffix
-        # instead of overwriting the artifact the regression sentinel
-        # compares against (the zero_bench convention)
-        base = os.path.join(REPO, "benchmarks", "runs",
-                            f"{datetime.date.today()}_serving_paged")
-        out = base + ".json"
-        i = 0
-        while os.path.exists(out) and not args.smoke:
-            i += 1
-            out = f"{base}_{chr(ord('a') + i)}.json"
-    if args.out or not args.smoke:
-        os.makedirs(os.path.dirname(out), exist_ok=True)
-        with open(out, "w") as f:
-            json.dump(results, f, indent=2)
-        print(f"wrote {out}", file=sys.stderr)
+    write_artifact(results, "serving_paged", args)
     return results
 
 

@@ -277,11 +277,13 @@ def job_infer(cfg, args):
 
 
 def job_serve(args):
-    """Continuous-batching LM serving over stdio: load a format-v3
-    ``lm_serving`` artifact, schedule JSONL requests from stdin through
-    the slot-based ``serving.DecodeEngine``, write one JSONL result per
-    request to stdout as it completes (NOT in submission order — that is
-    the point of continuous batching).
+    """Continuous-batching LM serving: load an ``lm_serving`` artifact,
+    schedule JSONL requests through the decode engine, write one JSONL
+    result per request as it completes (NOT in submission order — that
+    is the point of continuous batching). Transport is stdio by
+    default; ``--port`` binds a TCP socket instead (the fleet replica
+    mode), announcing the bound ports as one machine-readable
+    ``{"replica_ready": ...}`` line on stdout.
 
     Request lines:  {"prompt": [ids...], "max_new": 32,
                      "temperature": 0.8, "top_k": 40, "eos_id": 2,
@@ -289,12 +291,19 @@ def job_serve(args):
     Result lines:   {"id": ..., "tokens": [ids...], "finish_reason":
                      "eos"|"max_tokens", "ttft_ms": ..., "latency_ms": ...}
 
+    Paged-engine replicas additionally serve the fleet ops
+    ``export_prefix`` / ``import_prefix`` (P/D disaggregation — see
+    ``serving/replica.py`` for the wire).
+
     ``tenant``/``tier`` are optional: tier "latency" admits ahead of
     "batch" (and may preempt batch work's blocks on a paged engine); a
-    malformed tier is rejected with a counted reason
-    (``engine_requests_rejected_total{reason="bad_tier"}``) and an
-    error line, never a traceback. ``--tenant-budget acme=4096``
+    malformed tier is rejected with a counted reason and an error
+    line, never a traceback. ``--tenant-budget acme=4096``
     (repeatable) caps a tenant's in-flight tokens — exhaustion queues.
+
+    SIGTERM drains gracefully in both transports: stop admitting new
+    requests, finish everything in flight, emit the results, exit 0 —
+    the replica-drain contract the fleet router relies on.
 
     ``--health_port`` exposes the engine's /metrics + /healthz (queue
     depth, slot occupancy, TTFT histograms, per-tier windows) while
@@ -303,6 +312,7 @@ def job_serve(args):
     import json
 
     from paddle_tpu.io import lm_serving
+    from paddle_tpu.serving import replica as _replica
 
     budgets = {}
     for spec in args.tenant_budget:
@@ -341,59 +351,183 @@ def job_serve(args):
         print(f"observability: {health_srv.url}/metrics  "
               f"{health_srv.url}/healthz  {health_srv.url}/requests",
               file=sys.stderr)
-
-    def emit(req):
-        print(json.dumps({
-            "id": req.rid, "tokens": [int(t) for t in req.tokens],
-            "finish_reason": req.finish_reason,
-            "ttft_ms": round(1000 * req.ttft_s, 3),
-            "latency_ms": round(1000 * req.latency_s, 3)}), flush=True)
-
-    # stdin is read on a side thread feeding a queue: the main loop must
-    # keep stepping in-flight requests (and emitting their results)
-    # while a streaming client holds the pipe open between requests — a
-    # blocking `for line in sys.stdin` would stall decode until EOF
-    import queue as _queue
-    import threading
-
-    inbox: "_queue.Queue" = _queue.Queue()
-
-    def _read_stdin():
-        for line in sys.stdin:
-            inbox.put(line)
-        inbox.put(None)                 # EOF marker
-
-    threading.Thread(target=_read_stdin, daemon=True).start()
-    eof = False
     try:
-        while not (eof and eng.idle):
+        if args.port is not None:
+            tcp = _replica.ReplicaServer(
+                eng, host=args.serve_host, port=args.port,
+                default_max_new=args.max_new)
+            restore = _replica.install_drain_handler(tcp.loop)
+            # the ready line is the ONLY stdout in --port mode: fleet
+            # launchers (runtime.master.ServingFleet) parse it to learn
+            # the ephemeral ports
+            print(json.dumps({"replica_ready": {
+                "port": tcp.port,
+                "health_port": health_srv.port if health_srv else None,
+            }}), flush=True)
             try:
-                # busy engine: drain input opportunistically; idle
-                # engine: block briefly so waiting costs no CPU
-                line = inbox.get(timeout=0.05 if eng.idle else 0.0)
-                if line is None:
-                    eof = True
-                elif line.strip():
-                    try:
-                        r = json.loads(line)
-                        eng.submit(
-                            np.asarray(r["prompt"], np.int32),
-                            int(r.get("max_new", args.max_new)),
-                            temperature=float(r.get("temperature", 0.0)),
-                            top_k=int(r.get("top_k", 0)),
-                            eos_id=r.get("eos_id"),
-                            tenant=str(r.get("tenant", "default")),
-                            tier=str(r.get("tier", "batch")))
-                    except (ValueError, KeyError, TypeError) as e:
-                        print(json.dumps({"error": str(e)}), flush=True)
-            except _queue.Empty:
-                pass
-            if not eng.idle:
-                for d in eng.step():
-                    emit(d)
+                return tcp.serve_forever()
+            finally:
+                restore()
+        return _replica.serve_stdio(eng, default_max_new=args.max_new)
     finally:
         if health_srv is not None:
             health_srv.close()
+
+
+def job_route(args):
+    """Serving-fleet router: front N engine replicas with prefix-aware
+    placement, health-driven drain, and optional prefill/decode
+    disaggregation (``serving/router.py``). Same stdio wire as
+    ``serve`` — JSONL requests in, one JSONL result per request out —
+    one tier up: results additionally carry the serving replica.
+
+    Replicas come from either ``--replica HOST:PORT[:HEALTH_PORT]``
+    (repeatable; connect to running ``serve --port`` processes) or
+    ``--model`` + ``--replicas N`` (spawn the fleet locally via
+    ``runtime.master.ServingFleet``). ``--prefill_replicas K`` marks
+    the first K replicas as the disaggregated prefill tier. SIGTERM
+    drains: stop admitting, finish in-flight, emit, exit 0."""
+    import json
+    import queue as _queue
+    import signal
+    import threading
+
+    from paddle_tpu.serving import replica as _replica
+    from paddle_tpu.serving.router import Router, fleet_keying
+
+    fleet = None
+    handles = []
+    router_kw = dict(max_in_flight=args.max_in_flight)
+    if args.ttft_slo_ms:
+        from paddle_tpu.observe import SloConfig
+        router_kw["slo"] = SloConfig(ttft_s=args.ttft_slo_ms / 1000.0,
+                                     target=args.slo_target,
+                                     window_s=args.slo_window_s)
+    try:
+        if args.model:
+            from paddle_tpu.runtime.master import ServingFleet
+            fleet = ServingFleet(args.model, replicas=args.replicas,
+                                 prefill=args.prefill_replicas)
+            fleet.start()
+            router = fleet.router(**router_kw)
+        elif args.replica:
+            for i, spec in enumerate(args.replica):
+                parts = spec.split(":")
+                if len(parts) not in (2, 3):
+                    print(f"route: --replica expects "
+                          f"HOST:PORT[:HEALTH_PORT], got {spec!r}",
+                          file=sys.stderr)
+                    return 1
+                health_url = (f"http://{parts[0]}:{parts[2]}"
+                              if len(parts) == 3 else None)
+                handles.append(_replica.SocketReplica(
+                    f"replica{i}", (parts[0], int(parts[1])),
+                    health_url))
+            # placement keying comes from the engines themselves: the
+            # paged /healthz reports block_size + chunk_tokens
+            bs, chunk = fleet_keying(handles)
+            prefill = [h.name for h in
+                       handles[:max(args.prefill_replicas, 0)]]
+            router = Router(handles, block_size=bs, chunk_tokens=chunk,
+                            prefill=prefill, **router_kw)
+        else:
+            print("route: pass --replica HOST:PORT... or --model + "
+                  "--replicas N", file=sys.stderr)
+            return 1
+
+        health_srv = None
+        if args.health_port is not None:
+            health_srv = router.serve(host=args.health_host,
+                                      port=args.health_port)
+            print(f"observability: {health_srv.url}/metrics  "
+                  f"{health_srv.url}/healthz  "
+                  f"{health_srv.url}/requests", file=sys.stderr)
+
+        inbox: "_queue.Queue" = _queue.Queue()
+        draining = threading.Event()
+
+        def _read_stdin():
+            for line in sys.stdin:
+                inbox.put(line)
+            inbox.put(None)
+
+        threading.Thread(target=_read_stdin, daemon=True,
+                         name="route-stdin").start()
+        if threading.current_thread() is threading.main_thread():
+            signal.signal(signal.SIGTERM, lambda *_: draining.set())
+
+        def emit(req):
+            print(json.dumps({
+                "id": req.xid, "tokens": req.tokens,
+                "finish_reason": req.finish_reason
+                if req.error is None else "error",
+                "error": req.error,
+                "replica": req.replica, "requeues": req.requeues,
+                "ttft_ms": round(1000 * req.ttft_s, 3)
+                if req.ttft_s is not None else None,
+                "latency_ms": round(1000 * req.latency_s, 3)
+                if req.latency_s is not None else None}), flush=True)
+
+        def ingest(line):
+            try:
+                r = json.loads(line)
+                router.submit(
+                    np.asarray(r["prompt"], np.int32),
+                    int(r.get("max_new", args.max_new)),
+                    temperature=float(r.get("temperature", 0.0)),
+                    top_k=int(r.get("top_k", 0)),
+                    eos_id=r.get("eos_id"),
+                    tenant=str(r.get("tenant", "default")),
+                    tier=str(r.get("tier", "batch")))
+            except (ValueError, KeyError, TypeError) as e:
+                print(json.dumps({"error": str(e)}), flush=True)
+
+        eof = False
+        sealed = False
+        try:
+            while True:
+                if draining.is_set() and not sealed:
+                    # seal (the serve-loop contract): lines already
+                    # read were accepted — the drain finishes them;
+                    # anything arriving after is refused below, so the
+                    # drain converges under a streaming client
+                    while True:
+                        try:
+                            item = inbox.get_nowait()
+                        except _queue.Empty:
+                            break
+                        if item is None:
+                            eof = True
+                        elif item.strip():
+                            ingest(item)
+                    sealed = True
+                if ((eof or sealed) and inbox.empty()
+                        and router.idle):
+                    break
+                try:
+                    line = inbox.get(
+                        timeout=0.05 if router.idle else 0.0)
+                    if line is None:
+                        eof = True
+                    elif not line.strip():
+                        pass
+                    elif sealed:
+                        print(json.dumps({"error": "draining: router "
+                                          "not admitting"}), flush=True)
+                    else:
+                        ingest(line)
+                except _queue.Empty:
+                    pass
+                if not router.idle:
+                    for d in router.step():
+                        emit(d)
+        finally:
+            if health_srv is not None:
+                health_srv.close()
+            router.close()
+    finally:
+        if fleet is not None:
+            fleet.close()
     return 0
 
 
@@ -577,10 +711,12 @@ def main(argv=None):
         description="TPU-native trainer CLI (reference: paddle_trainer, "
                     "TrainerMain.cpp)")
     p.add_argument("job", choices=["train", "test", "time", "checkgrad",
-                                   "infer", "stats", "serve"],
+                                   "infer", "stats", "serve", "route"],
                    help="what to run (TrainerMain.cpp:52-61; stats "
                         "renders an observability snapshot; serve runs "
-                        "the continuous-batching LM engine over stdio)")
+                        "the continuous-batching LM engine over stdio "
+                        "or --port TCP; route fronts N serve replicas "
+                        "with the prefix-aware fleet router)")
     p.add_argument("--config", default=None,
                    help="python config file (required for every job "
                         "except stats)")
@@ -591,8 +727,29 @@ def main(argv=None):
                    help="merged-model artifact for job=infer / format-v3 "
                         "lm_serving artifact for job=serve")
     p.add_argument("--max_new", type=int, default=64,
-                   help="default max_new for job=serve requests that "
-                        "omit it")
+                   help="default max_new for job=serve/route requests "
+                        "that omit it")
+    p.add_argument("--port", type=int, default=None,
+                   help="job=serve: serve the JSONL wire on this TCP "
+                        "port instead of stdio (0 = ephemeral; the "
+                        "fleet replica mode — bound ports announced "
+                        "as a replica_ready line on stdout)")
+    p.add_argument("--serve_host", default="127.0.0.1",
+                   help="bind address for --port (default loopback)")
+    p.add_argument("--replica", action="append", default=[],
+                   metavar="HOST:PORT[:HEALTH_PORT]",
+                   help="job=route: connect to a running serve --port "
+                        "replica (repeatable)")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="job=route with --model: spawn this many local "
+                        "replica processes (runtime.master."
+                        "ServingFleet)")
+    p.add_argument("--prefill_replicas", type=int, default=0,
+                   help="job=route: mark the first K replicas as the "
+                        "disaggregated prefill tier (P/D mode; 0 = "
+                        "colocated)")
+    p.add_argument("--max_in_flight", type=int, default=8,
+                   help="job=route: per-replica in-flight cap")
     p.add_argument("--output_path", default=None,
                    help="where job=infer saves outputs (.npz)")
     p.add_argument("--infer_limit", type=int, default=0,
@@ -656,6 +813,8 @@ def main(argv=None):
         if not args.model:
             p.error("--model=lm.tar is required for job=serve")
         return job_serve(args)
+    if args.job == "route":
+        return job_route(args)
     if not args.config:
         p.error(f"--config is required for job={args.job}")
     cfg = _load_config(args.config)

@@ -27,6 +27,7 @@ import os
 import random
 import socket
 import socketserver
+import sys
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
@@ -1004,3 +1005,141 @@ class MasterClient:
                 self.report_done(task.task_id, task.lease)
 
         return gen
+
+
+class ServingFleet:
+    """Spawn and tend N ``paddle_tpu serve --port`` replica processes
+    from one ``lm_serving`` artifact — the serving counterpart of the
+    training gang supervisor, and the fleet glue the ``route`` CLI and
+    the multi-process chaos tests stand on.
+
+    Each replica binds an ephemeral TCP port for the JSONL op wire and
+    an ephemeral HTTP health port, announcing both as one
+    machine-readable ``{"replica_ready": {...}}`` line on stdout;
+    :meth:`start` parses the announcements (with a deadline — a replica
+    that dies during model load raises instead of hanging the fleet)
+    and :meth:`handles` builds ``serving.replica.SocketReplica`` handles
+    over them. :meth:`router` assembles a prefix-aware
+    ``serving.Router``, reading the placement keying (block size /
+    chunk grid) off the first replica's ``/healthz`` so the router's
+    digests match the engines' prefix caches exactly. ``prefill=K``
+    marks the first K replicas as the disaggregated prefill tier.
+
+    :meth:`kill` SIGKILLs one replica (the chaos hook: the router must
+    requeue its in-flight work onto survivors with zero lost requests);
+    :meth:`close` tears the fleet down TERM-then-KILL via
+    ``runtime.launch.terminate_procs`` — TERM is the replicas' graceful
+    drain, so a closing fleet finishes what it accepted."""
+
+    def __init__(self, model: str, replicas: int = 2, *,
+                 prefill: int = 0, args_extra: Sequence[str] = (),
+                 env: Optional[dict] = None,
+                 startup_timeout_s: float = 240.0,
+                 python: Optional[str] = None):
+        if replicas < 1:
+            raise ValueError(f"need >= 1 replicas, got {replicas}")
+        if not 0 <= prefill < replicas:
+            raise ValueError(f"prefill {prefill} must leave at least "
+                             f"one of {replicas} replicas decoding")
+        self.model = str(model)
+        self.n = int(replicas)
+        self.prefill = int(prefill)
+        self.args_extra = list(args_extra)
+        self.env = env
+        self.startup_timeout_s = float(startup_timeout_s)
+        self.python = python or sys.executable
+        self.procs: List = []
+        self.endpoints: List[dict] = []
+        self._handles: List = []
+
+    def start(self) -> "ServingFleet":
+        import subprocess
+        env = dict(os.environ)
+        if self.env:
+            env.update(self.env)
+        # the replicas run `python -m paddle_tpu`: make THIS package
+        # importable regardless of the caller's cwd (the fleet may be
+        # launched from anywhere, not just the repo root)
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + \
+            env.get("PYTHONPATH", "") if env.get("PYTHONPATH") \
+            else pkg_root
+        for i in range(self.n):
+            self.procs.append(subprocess.Popen(
+                [self.python, "-m", "paddle_tpu", "serve",
+                 f"--model={self.model}", "--port=0", "--health_port=0",
+                 *self.args_extra],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, env=env))
+        deadline = time.time() + self.startup_timeout_s
+        for i, p in enumerate(self.procs):
+            self.endpoints.append(self._await_ready(i, p, deadline))
+        return self
+
+    def _await_ready(self, i: int, proc, deadline: float) -> dict:
+        """Parse replica ``i``'s ready line off its stdout, bounded by
+        ``deadline`` (readline on a watchdog thread: a wedged replica
+        must fail the fleet, not hang it)."""
+        box: List[Optional[str]] = [None]
+
+        def _read():
+            box[0] = proc.stdout.readline()
+
+        t = threading.Thread(target=_read, daemon=True)
+        t.start()
+        t.join(max(deadline - time.time(), 0.1))
+        line = box[0]
+        if not line:
+            rc = proc.poll()
+            self.close()
+            raise RuntimeError(
+                f"replica {i} never announced readiness "
+                f"({'exited rc=' + str(rc) if rc is not None else 'timed out'})")
+        doc = json.loads(line)["replica_ready"]
+        return {"name": f"replica{i}", "port": int(doc["port"]),
+                "health_port": doc.get("health_port")}
+
+    def handles(self) -> List:
+        """SocketReplica handles, one per replica (built once)."""
+        from paddle_tpu.serving.replica import SocketReplica
+        if not self._handles:
+            if not self.endpoints:
+                raise RuntimeError("start() the fleet first")
+            for ep in self.endpoints:
+                hp = ep.get("health_port")
+                self._handles.append(SocketReplica(
+                    ep["name"], ("127.0.0.1", ep["port"]),
+                    f"http://127.0.0.1:{hp}" if hp else None))
+        return self._handles
+
+    def router(self, **kw):
+        """A prefix-aware Router over this fleet; keyword args pass
+        through (max_in_flight, slo, ...). Placement keying (block
+        size / chunk grid) is read off the first replica's /healthz so
+        the router's digests match the engines' prefix caches."""
+        from paddle_tpu.serving.router import Router, fleet_keying
+        handles = self.handles()
+        bs, chunk = fleet_keying(handles)
+        prefill = [h.name for h in handles[:self.prefill]]
+        kw.setdefault("block_size", bs)
+        kw.setdefault("chunk_tokens", chunk)
+        return Router(handles, prefill=prefill, **kw)
+
+    def kill(self, i: int):
+        """SIGKILL replica ``i`` — the chaos hook (no drain, no
+        goodbye; the router discovers the death through the dead
+        socket)."""
+        self.procs[i].kill()
+
+    def close(self):
+        from paddle_tpu.runtime import launch
+        for h in self._handles:
+            try:
+                h.close()
+            except Exception:
+                pass
+        self._handles = []
+        if self.procs:
+            launch.terminate_procs(self.procs)
+            self.procs = []

@@ -15,6 +15,17 @@ Public surface:
   prefix_hit_tokens).
 - :class:`~paddle_tpu.serving.blocks.BlockPool` — host-side block
   allocator / prefix cache the paged engine schedules over.
+- :class:`~paddle_tpu.serving.router.Router` — the serving-fleet tier:
+  prefix-aware placement over N replicas (content-chain block hashes
+  as the routing key), three-state-health-driven drain with
+  dead-replica requeue, and prefill/decode disaggregation over the
+  ``serving/transfer.py`` KV-block wire.
+- :class:`~paddle_tpu.serving.replica.EngineReplica` /
+  :class:`~paddle_tpu.serving.replica.SocketReplica` /
+  :class:`~paddle_tpu.serving.replica.ReplicaServer` /
+  :func:`~paddle_tpu.serving.replica.serve_stdio` — the replica
+  handles and JSONL transports (stdio with graceful SIGTERM drain,
+  TCP for multi-process fleets) the router fronts.
 - :func:`~paddle_tpu.serving.sampling.sample_tokens` /
   :func:`~paddle_tpu.serving.sampling.engine_step_fns` /
   :func:`~paddle_tpu.serving.sampling.paged_step_fns` — the pure step
@@ -26,6 +37,11 @@ from paddle_tpu.serving.blocks import (  # noqa: F401
 from paddle_tpu.serving.engine import (  # noqa: F401
     DEFAULT_PREFILL_BUCKETS, VALID_TIERS, DecodeEngine, EngineRequest,
     PagedDecodeEngine, SpecDecodeEngine, default_chunk_buckets)
+from paddle_tpu.serving.replica import (  # noqa: F401
+    EngineLoop, EngineReplica, ReplicaServer, SocketReplica,
+    serve_stdio)
+from paddle_tpu.serving.router import (  # noqa: F401
+    Router, RouterRequest)
 from paddle_tpu.serving.sampling import (  # noqa: F401
     engine_step_fns, paged_spec_fns, paged_step_fns, sample_tokens,
     spec_accept, spec_verify_tokens)

@@ -115,6 +115,12 @@ class BlockPool:
     def refcount(self, block: int) -> int:
         return int(self._ref[block])
 
+    def lru_oldest(self) -> Optional[int]:
+        """The refcount-0 cached block ``alloc()`` would evict next
+        (None when the LRU is empty) — lets a bulk adopter
+        (``import_prefix``) stop before eating its own chain head."""
+        return next(iter(self._lru), None)
+
     @property
     def idle(self) -> bool:
         """True when no slot holds a block and nothing is reserved —

@@ -960,6 +960,14 @@ class PagedDecodeEngine(DecodeEngine):
             "pool's kv_dtype) — the per-token decode-read traffic and "
             "the slots-at-equal-HBM denominator")
         self._m_kv_bytes.set(self.kv_bytes_per_token)
+        self._m_kv_exported = reg.counter(
+            "engine_kv_blocks_exported_total", "prefix-cache blocks "
+            "serialized out over the P/D transfer wire "
+            "(export_prefix — the prefill half of disaggregation)")
+        self._m_kv_imported = reg.counter(
+            "engine_kv_blocks_imported_total", "transferred blocks "
+            "adopted into the pool via the prefix-cache publish path "
+            "(import_prefix — the decode half of disaggregation)")
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -1074,6 +1082,98 @@ class PagedDecodeEngine(DecodeEngine):
             eos_id=eos_id, tenant=str(tenant), tier=str(tier),
             bucket=0, submit_t=time.perf_counter())
         return self._enqueue(req)
+
+    # -- P/D disaggregation (KV transfer over the fleet wire) -------------
+    def prefix_digests(self, prompt) -> List[bytes]:
+        """Content-chain digests of ``prompt``'s TRANSFERABLE prefix:
+        the chunk-aligned full blocks admission can serve as cache hits
+        (the final chunk always recomputes locally — it must produce
+        logits to sample from). This is the P/D transfer unit and the
+        router's placement key."""
+        from paddle_tpu.serving import blocks as _blocks
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        per = self.chunk_tokens // self.block_size
+        usable = ((int(prompt.size) - 1) // self.chunk_tokens) * per
+        if usable <= 0:
+            return []
+        return _blocks.prompt_block_hashes(prompt,
+                                           self.block_size)[:usable]
+
+    def export_prefix(self, prompt) -> Optional[bytes]:
+        """Serialize ``prompt``'s transferable prefix out of this pool
+        — the prefill half of P/D disaggregation. Every prefix block
+        must already be published (run the prompt through the scheduler
+        first, e.g. ``submit(prompt, max_new=1)`` + drain: chunked
+        prefill publishes the blocks as each chunk lands). Returns
+        ``None`` when the prompt has no transferable prefix or any
+        block was evicted before serialization — the receiver then
+        falls back to a cold prefill, which is slower but identical."""
+        from paddle_tpu.serving import transfer as _transfer
+        digests = self.prefix_digests(prompt)
+        if not digests:
+            return None
+        blk = []
+        for h in digests:
+            b = self.pool.lookup(h)
+            if b is None:
+                return None
+            blk.append(b)
+        payload = _transfer.serialize_blocks(
+            self.cache, blk, digests, self.block_size, self.kv_dtype)
+        self._m_kv_exported.inc(len(blk))
+        return payload
+
+    def import_prefix(self, payload: bytes) -> int:
+        """Adopt serialized prefix blocks into this pool via the
+        ordinary prefix-cache publish path — the decode half of P/D
+        disaggregation. Stamp-checked (pool layout / kv_dtype / slab
+        shape must match this pool). Walks the chain in order, skipping
+        digests already cached; stops early when the pool cannot
+        reserve another block (a partial prefix still serves hits —
+        admission stops at the first miss anyway). Returns the blocks
+        newly adopted; they park refcount-0 in the LRU, hit-ready.
+        Generation over adopted blocks is bitwise the colocated run
+        (the PR-6 hit-vs-cold guarantee: identical KV bytes, identical
+        chunk grid for the locally-computed tail)."""
+        from paddle_tpu.serving import transfer as _transfer
+        meta, blocks = _transfer.deserialize_blocks(payload)
+        _transfer.check_pool_match(meta, self.cache, self.block_size,
+                                   self.kv_dtype)
+        n = 0
+        chain_blocks = set()    # pool blocks holding EARLIER digests
+        #                         of this chain — cached before the
+        #                         call or adopted by it
+        pending = []
+        for digest, arrays in blocks:
+            existing = self.pool.lookup(digest)
+            if existing is not None:
+                chain_blocks.add(existing)
+                continue
+            if not self.pool.can_reserve(1):
+                break
+            if (self.pool.free_count == 0
+                    and self.pool.lru_oldest() in chain_blocks):
+                # the next alloc would evict one of THIS chain's own
+                # leading blocks (already-cached head included): a
+                # full-pool import must keep the leading run — a chain
+                # with its head evicted serves zero hits (admission
+                # stops at the first miss)
+                break
+            self.pool.reserve(1)
+            b = self.pool.alloc()
+            pending.append((b, arrays))
+            self.pool.publish(digest, b)
+            self.pool.release(b)    # refcount 0 + published: parks in
+            chain_blocks.add(b)     # the LRU, served as a hit from here
+            n += 1
+        # value writes batched: one scatter per pool leaf for the whole
+        # chain (nothing reads the pool between publish and here — the
+        # engine is single-threaded)
+        self.cache = _transfer.write_blocks(self.cache, pending,
+                                            self.block_size)
+        if n:
+            self._m_kv_imported.inc(n)
+        return n
 
     @property
     def preempted_count(self) -> int:
@@ -1957,6 +2057,18 @@ class SpecDecodeEngine(PagedDecodeEngine):
                     self._last[slot] = int(X[slot, used - 1])
         self._update_gauges()
         return finished
+
+    def import_prefix(self, payload: bytes) -> int:
+        """Refused on the spec engine: the transfer wire ships TARGET
+        pool blocks only, and adopting them would break the shared-pool
+        invariant (every content hash certifies the draft rows beside
+        it — imported blocks have no draft rows, so propose would read
+        garbage KV). Route disaggregated decode at target-only
+        replicas; a spec replica still serves as a prefill exporter."""
+        raise ValueError("import_prefix: a SpecDecodeEngine cannot "
+                         "adopt transferred blocks (no draft-pool rows "
+                         "travel on the wire) — use a target-only "
+                         "decode replica for P/D disaggregation")
 
     # -- observability -----------------------------------------------------
     def acceptance_rate(self) -> Optional[float]:

@@ -1,0 +1,756 @@
+"""Serving-fleet router: prefix-aware placement over N engine replicas.
+
+One engine serves one host's worth of traffic; the fleet tier is this
+router fronting N replicas over the JSONL serve wire (in-process
+:class:`~paddle_tpu.serving.replica.EngineReplica` handles, or
+:class:`~paddle_tpu.serving.replica.SocketReplica` handles to
+``paddle_tpu serve --port`` processes). Three responsibilities:
+
+**Placement.** Admission is prefix-cache-aware: the prompt's
+content-chain block hashes (``serving/blocks.prompt_block_hashes`` —
+the same digests the replicas' prefix caches key on) are the routing
+key. The router remembers which digests it placed on which replica (a
+bounded per-replica hot set); a new request scores each replica by its
+hot leading-digest run and lands where its prefix is hot, so
+shared-prefix tenants converge onto warm pools and the fleet
+cold-prefills a shared system prompt once, not N times. Fallback is
+least-loaded among healthy replicas, under a per-replica in-flight cap.
+
+**Health-driven drain.** Each replica's three-state ``/healthz``
+(PR-7: ok | degraded | unhealthy, plus SLO burn gauges behind it)
+drives admission: ``degraded`` replicas are DEPRIORITIZED (placed only
+when no ok replica has room), ``unhealthy`` replicas stop admitting
+while their in-flight work finishes (drain), and a DEAD replica
+(transport gone) has its in-flight requests re-queued onto survivors —
+every accepted request completes; a re-queued request simply re-runs
+its full prompt (deterministic decoding makes the output identical).
+
+**P/D disaggregation.** With a prefill tier configured, a request
+whose transferable prefix is not hot on any decode replica first runs
+chunked prefill on a PREFILL replica (``export_prefix``); the finished
+KV blocks come back serialized (values + scale tables, layout/kv_dtype
+stamped — ``serving/transfer``) and are shipped to the chosen decode
+replica (``import_prefix``, the prefix-cache publish path) ahead of
+the generate op on the same ordered connection. The decode replica
+admits the request as a prefix-cache hit and recomputes only the final
+chunk — generation is bitwise the colocated run. If the prefill tier
+is busy or dies, the router falls back to a plain colocated placement:
+disaggregation is a throughput optimization, never a correctness
+dependency.
+
+The router is steppable like the engines (``submit`` / ``step`` /
+``run_until_idle`` / ``idle``) and single-threaded: one ``step()``
+pumps in-process replicas, collects results, polls health, and places
+queued work. Observability mirrors the engine surface: a router
+registry (placement/requeue/drain counters, per-replica state and
+in-flight gauges, fleet TTFT windows + SLO burn), a request log, and
+``serve()`` exposing ``/metrics`` + ``/healthz`` + ``/requests``.
+"""
+
+import dataclasses
+import itertools
+import logging
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.observe import metrics as _metrics
+from paddle_tpu.observe import requests as _requests
+from paddle_tpu.observe.window import SloConfig, WindowedQuantiles
+from paddle_tpu.serving import blocks as _blocks
+
+logger = logging.getLogger(__name__)
+
+_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+
+def fleet_keying(handles, default_block_size: int = 16,
+                 default_chunk_tokens: int = 64) -> Tuple[int, int]:
+    """Placement keying (block size / chunk grid) read off the first
+    replica ``/healthz`` that reports it — the one way the router's
+    digest notion is derived from the engines' own prefix caches
+    (``ServingFleet.router`` and the ``route`` CLI both key through
+    here, so they can never drift apart)."""
+    bs, chunk = int(default_block_size), int(default_chunk_tokens)
+    for h in handles:
+        doc = h.health()
+        if doc and doc.get("block_size"):
+            return int(doc["block_size"]), int(
+                doc.get("chunk_tokens", chunk))
+    logger.warning(
+        "fleet_keying: no replica /healthz reported block_size — "
+        "falling back to block_size=%d chunk_tokens=%d; if the engines "
+        "use a different grid, placement digests will never match and "
+        "the prefix-aware path is dead (pass health ports, or "
+        "block_size=/chunk_tokens= explicitly)", bs, chunk)
+    return bs, chunk
+
+# replica states, best-first; the gauge encodes the rank so dashboards
+# can alert on `router_replica_state < 3`
+REPLICA_STATES = ("ok", "degraded", "unhealthy", "dead")
+_STATE_RANK = {"ok": 3, "degraded": 2, "unhealthy": 1, "dead": 0}
+
+
+@dataclasses.dataclass
+class RouterRequest:
+    """One fleet request and its routing lifecycle."""
+    xid: int
+    prompt: np.ndarray
+    max_new: int
+    temperature: float = 0.0
+    top_k: int = 0
+    eos_id: Optional[int] = None
+    tenant: str = "default"
+    tier: str = "batch"
+    # -- routing lifecycle (filled by the router) ------------------------
+    status: str = "queued"      # queued | prefill | placed | done | failed
+    replica: Optional[str] = None           # decode placement
+    prefill_replica: Optional[str] = None   # P/D export source
+    digests: List[bytes] = dataclasses.field(
+        default_factory=list, repr=False)   # full-block chain hashes
+    usable: int = 0             # leading digests admission can hit
+    #                             (chunk-aligned — the placement key)
+    payload: Optional[str] = None           # b64 KV payload awaiting a
+    payload_blocks: int = 0                 # decode slot (P/D flow)
+    prefix_score: int = 0       # hot digests at the chosen replica
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    finish_reason: Optional[str] = None
+    error: Optional[str] = None
+    requeues: int = 0           # dead-replica recoveries
+    placements: int = 0
+    submit_t: float = 0.0
+    placed_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    replica_ttft_ms: Optional[float] = None
+    replica_latency_ms: Optional[float] = None
+
+    @property
+    def output(self) -> np.ndarray:
+        return np.concatenate([self.prompt,
+                               np.asarray(self.tokens, np.int32)])
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.finish_t is None:
+            return None
+        return self.finish_t - self.submit_t
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Fleet TTFT: router queueing + the replica-reported TTFT."""
+        if self.replica_ttft_ms is None or self.placed_t is None:
+            return None
+        return (self.placed_t - self.submit_t
+                + self.replica_ttft_ms / 1000.0)
+
+
+class _Replica:
+    """Router-side state for one replica handle."""
+
+    def __init__(self, handle, cap: int, hot_cap: int):
+        self.handle = handle
+        self.name = handle.name
+        self.state = "ok"
+        self.last_health: dict = {}
+        self.health_t = -1e9
+        # xid -> (req, kind); kind: generate | export | import
+        self.outstanding: "OrderedDict" = OrderedDict()
+        self.cap = int(cap)
+        self.hot: "OrderedDict" = OrderedDict()
+        self.hot_cap = int(hot_cap)
+
+    @property
+    def in_flight(self) -> int:
+        """Work that occupies the replica (import acks don't)."""
+        return sum(1 for _, kind in self.outstanding.values()
+                   if kind != "import")
+
+    def mark_hot(self, digests):
+        for d in digests:
+            if d in self.hot:
+                self.hot.move_to_end(d)
+            else:
+                self.hot[d] = None
+        while len(self.hot) > self.hot_cap:
+            self.hot.popitem(last=False)
+
+    def prefix_score(self, digests) -> int:
+        """Length of the LEADING digest run hot on this replica — the
+        same stop-at-first-miss walk engine admission does."""
+        n = 0
+        for d in digests:
+            if d not in self.hot:
+                break
+            n += 1
+        return n
+
+
+class Router:
+    """Prefix-aware fleet router over replica handles (see module
+    docstring). ``replicas`` are handles implementing the protocol in
+    ``serving/replica.py``; ``prefill`` names the subset serving as
+    the disaggregated prefill tier (those receive only
+    ``export_prefix`` work — P/D mode is off when empty).
+    ``block_size``/``chunk_tokens`` must match the replicas' engines:
+    they derive the placement digests and the transferable-prefix cap
+    exactly as engine admission does."""
+
+    def __init__(self, replicas: Sequence, *, block_size: int = 16,
+                 chunk_tokens: int = 64, prefill: Sequence[str] = (),
+                 max_in_flight: int = 8, health_poll_s: float = 0.25,
+                 hot_digests: int = 4096,
+                 registry: Optional[_metrics.Registry] = None,
+                 slo: Optional[SloConfig] = None):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        bs, chunk = int(block_size), int(chunk_tokens)
+        if bs < 1 or chunk < 1 or chunk % bs:
+            raise ValueError(f"chunk_tokens {chunk} must be a positive "
+                             f"multiple of block_size {bs}")
+        self.block_size, self.chunk_tokens = bs, chunk
+        self._all: List[_Replica] = [
+            _Replica(h, max_in_flight, hot_digests) for h in replicas]
+        names = [st.name for st in self._all]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names: {names}")
+        prefill = set(prefill)
+        unknown = prefill - set(names)
+        if unknown:
+            raise ValueError(f"prefill names {sorted(unknown)} not in "
+                             f"replicas {names}")
+        self._prefill = [st for st in self._all if st.name in prefill]
+        self._decode = [st for st in self._all
+                        if st.name not in prefill]
+        if not self._decode:
+            raise ValueError("every replica is prefill-tier: nothing "
+                             "left to decode")
+        self._health_poll_s = float(health_poll_s)
+        self._queue: deque = deque()
+        self._requests: Dict[int, RouterRequest] = {}
+        self._ids = itertools.count()
+        self.request_log = _requests.RequestLog()
+        self._n_completed = 0
+        self.slo = slo
+        win = slo.window_s if slo is not None else 60.0
+        self._win_ttft = WindowedQuantiles(window_s=win)
+        self._win_tps = WindowedQuantiles(window_s=win)
+        # -- metrics ------------------------------------------------------
+        reg = self.metrics = registry or _metrics.Registry()
+        self._m_requests = reg.counter(
+            "router_requests_total", "requests submitted to the fleet")
+        self._m_completed = reg.counter(
+            "router_requests_completed_total",
+            "fleet requests finished, by finish reason (error = the "
+            "replica rejected the request — malformed, too long)")
+        self._m_tokens = reg.counter(
+            "router_tokens_total", "tokens emitted across the fleet")
+        self._m_placements = reg.counter(
+            "router_placements_total", "generate placements onto "
+            "replicas (a requeued request places again)")
+        self._m_place_hits = reg.counter(
+            "router_placement_prefix_hits_total",
+            "placements that landed where a leading run of the "
+            "prompt's block digests was already hot — the prefix-aware "
+            "hit rate's numerator")
+        self._m_requeued = reg.counter(
+            "router_requeued_total", "in-flight requests re-queued off "
+            "a dead replica onto survivors")
+        self._m_drains = reg.counter(
+            "router_drains_total", "replica drains begun, by reason "
+            "(unhealthy = stop admitting, in-flight finishes; dead = "
+            "transport lost, in-flight re-queued)")
+        self._m_queue = reg.gauge(
+            "router_queue_depth", "requests waiting for a placement")
+        self._m_in_flight = reg.gauge(
+            "router_replica_in_flight", "outstanding work per replica "
+            "(router view: generate + export ops awaiting results)")
+        self._m_replica_queue = reg.gauge(
+            "router_replica_queue_depth", "queue depth each replica "
+            "last reported on /healthz")
+        self._m_state = reg.gauge(
+            "router_replica_state", "replica admission state: 3=ok "
+            "2=degraded 1=unhealthy 0=dead")
+        self._m_ttft = reg.histogram(
+            "router_ttft_seconds", "fleet TTFT: submit -> first token "
+            "(router queueing + replica-reported TTFT)",
+            buckets=_LATENCY_BUCKETS)
+        self._m_win_ttft = reg.gauge(
+            "router_ttft_window_seconds", "rolling fleet TTFT quantile "
+            "over the SLO window (label q)")
+        self._m_win_tps = reg.gauge(
+            "router_tokens_per_sec_window", "rolling per-request "
+            "decode tokens/sec quantile over the SLO window (label q)")
+        self._m_burn = reg.gauge(
+            "router_slo_burn_rate", "fleet TTFT SLO burn rate (0 "
+            "without a configured SLO)")
+        self._m_pd_exports = reg.counter(
+            "router_pd_exports_total", "prefill-tier export_prefix "
+            "ops completed (P/D disaggregation)")
+        self._m_pd_blocks = reg.counter(
+            "router_pd_blocks_shipped_total", "KV blocks shipped over "
+            "the P/D transfer path and adopted by decode replicas")
+        self._m_pd_errors = reg.counter(
+            "router_pd_errors_total", "P/D transfer ops a replica "
+            "refused, by op (export = colocated fallback; import = "
+            "cold prefill on the decode replica — same bits, slower)")
+        for st in self._all:
+            self._m_state.set(_STATE_RANK[st.state], replica=st.name)
+
+    # -- request API -------------------------------------------------------
+    def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
+               top_k: int = 0, eos_id: Optional[int] = None,
+               tenant: str = "default", tier: str = "batch"
+               ) -> RouterRequest:
+        """Queue one fleet request; placement happens in ``step()``."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        req = RouterRequest(
+            xid=next(self._ids), prompt=prompt, max_new=int(max_new),
+            temperature=float(temperature), top_k=int(top_k),
+            eos_id=eos_id, tenant=str(tenant), tier=str(tier),
+            submit_t=time.perf_counter())
+        req.digests = _blocks.prompt_block_hashes(prompt,
+                                                  self.block_size)
+        per = self.chunk_tokens // self.block_size
+        req.usable = min(
+            len(req.digests),
+            ((int(prompt.size) - 1) // self.chunk_tokens) * per)
+        self._queue.append(req)
+        self._requests[req.xid] = req
+        self._m_requests.inc()
+        self._m_queue.set(len(self._queue))
+        return req
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def outstanding(self) -> int:
+        return sum(len(st.outstanding) for st in self._all)
+
+    @property
+    def idle(self) -> bool:
+        return not self._queue and not any(
+            kind != "import"
+            for st in self._all
+            for _, kind in st.outstanding.values())
+
+    def replica_states(self) -> Dict[str, str]:
+        return {st.name: st.state for st in self._all}
+
+    def placement_hit_rate(self) -> float:
+        """Fraction of generate placements that landed on a replica
+        with a hot leading-digest run."""
+        total = self._m_placements.value()
+        if not total:
+            return 0.0
+        return self._m_place_hits.value() / total
+
+    # -- scheduler ---------------------------------------------------------
+    def step(self) -> List[RouterRequest]:
+        """One router iteration: pump in-process replicas, collect
+        results, poll health (requeueing off dead replicas), place
+        queued work. Returns the requests that finished this step."""
+        for st in self._all:
+            if st.state != "dead":
+                st.handle.pump()
+        finished = self._collect()
+        self._poll_health(time.perf_counter())
+        self._place()
+        self._update_gauges()
+        return finished
+
+    def run_until_idle(self, max_steps: int = 200_000
+                       ) -> List[RouterRequest]:
+        done: List[RouterRequest] = []
+        for _ in range(max_steps):
+            if self.idle:
+                return done
+            done.extend(self.step())
+        raise RuntimeError(
+            f"router did not drain in {max_steps} steps "
+            f"({self.queue_depth} queued, {self.outstanding} "
+            f"outstanding, states {self.replica_states()})")
+
+    # -- results -----------------------------------------------------------
+    def _collect(self) -> List[RouterRequest]:
+        finished: List[RouterRequest] = []
+        for st in self._all:
+            for doc in st.handle.poll():
+                ent = st.outstanding.pop(doc.get("id"), None)
+                if ent is None:
+                    # ack for an untracked op, or a late result for a
+                    # request already requeued off this replica —
+                    # first completion wins
+                    continue
+                req, kind = ent
+                if kind == "import":
+                    if "error" in doc:
+                        # a refused adoption (stamp mismatch, spec
+                        # engine) degrades that request to a cold
+                        # prefill — same bits, slower; count + log so
+                        # a misconfigured fleet is visible, never
+                        # silent
+                        self._m_pd_errors.inc(op="import")
+                        logger.warning("import_prefix refused by %s: %s",
+                                       st.name, doc["error"])
+                    else:
+                        self._m_pd_blocks.inc(
+                            int(doc.get("imported") or 0))
+                    continue
+                if kind == "export":
+                    self._on_export(st, req, doc)
+                elif "error" in doc:
+                    err = str(doc["error"])
+                    if err.startswith("draining"):
+                        # the replica sealed for graceful drain after
+                        # placement won the race: not a request
+                        # failure — place it on a survivor
+                        self._requeue(st, req)
+                    else:
+                        self._finish(req, None, error=err)
+                        finished.append(req)
+                else:
+                    self._finish(req, doc)
+                    finished.append(req)
+        return finished
+
+    def _requeue(self, st, req: RouterRequest):
+        """Send ``req`` back to the queue front after ``st`` refused or
+        lost it (drain refusal, dead transport)."""
+        req.requeues += 1
+        req.status = "queued"
+        req.replica = None
+        req.payload, req.payload_blocks = None, 0
+        self._m_requeued.inc()
+        self._set_state(st, "unhealthy")    # stop placing here; the
+        #                                     health poll re-promotes a
+        #                                     replica that recovers
+        self._queue.appendleft(req)
+
+    def _on_export(self, st, req: RouterRequest, doc: dict):
+        req.prefill_replica = st.name
+        if "error" in doc:
+            # a prefill replica that REFUSES the export (non-paged
+            # engine, budget rejection, drain) must not fail the
+            # request — disaggregation is never a correctness
+            # dependency; fall back colocated (prefill_replica is set,
+            # so placement won't retry the prefill tier)
+            self._m_pd_errors.inc(op="export")
+            logger.warning("export_prefix refused by %s (colocated "
+                           "fallback): %s", st.name, doc["error"])
+            req.status = "queued"
+            self._queue.appendleft(req)
+            return
+        self._m_pd_exports.inc()
+        payload = doc.get("payload")
+        if payload:
+            req.payload = payload
+            req.payload_blocks = int(doc.get("blocks", 0))
+            st.mark_hot(req.digests[:req.payload_blocks])
+        # back to the queue FRONT (it already waited through the
+        # prefill stage) awaiting a decode placement; an empty payload
+        # (no transferable prefix / evicted) decodes colocated-style
+        req.status = "queued"
+        self._queue.appendleft(req)
+
+    def _finish(self, req: RouterRequest, doc: Optional[dict],
+                error: Optional[str] = None):
+        now = time.perf_counter()
+        req.finish_t = now
+        self._n_completed += 1
+        if error is not None:
+            req.status, req.error = "failed", error
+            req.finish_reason = "error"
+            self._m_completed.inc(reason="error")
+        else:
+            req.status = "done"
+            req.tokens = [int(t) for t in doc.get("tokens", ())]
+            req.finish_reason = doc.get("finish_reason")
+            req.replica_ttft_ms = doc.get("ttft_ms")
+            req.replica_latency_ms = doc.get("latency_ms")
+            self._m_completed.inc(reason=req.finish_reason or "unknown")
+            self._m_tokens.inc(len(req.tokens))
+            ttft = req.ttft_s
+            if ttft is not None:
+                self._m_ttft.observe(ttft)
+                self._win_ttft.observe(ttft)
+            if req.latency_s and req.tokens:
+                self._win_tps.observe(len(req.tokens) / req.latency_s)
+        self._record_request(req)
+
+    def _record_request(self, req: RouterRequest):
+        def r6(v):
+            return round(v, 6) if v is not None else None
+
+        self.request_log.add({
+            "rid": req.xid, "engine": "router",
+            "trace_id": f"router.r{req.xid}",
+            "finish_reason": req.finish_reason if req.error is None
+            else f"rejected:{req.error[:80]}",
+            "tenant": req.tenant, "tier": req.tier,
+            "replica": req.replica,
+            "prefill_replica": req.prefill_replica,
+            "requeues": req.requeues,
+            "prefix_score": req.prefix_score,
+            "prompt_tokens": int(req.prompt.size),
+            "tokens": len(req.tokens),
+            "queue_wait_s": r6((req.placed_t or req.finish_t)
+                               - req.submit_t),
+            "prefill_own_s": None, "prefill_stall_s": None,
+            "decode_s": None,
+            "ttft_s": r6(req.ttft_s),
+            "latency_s": r6(req.latency_s),
+            "cache_hit_frac": round(
+                req.prefix_score / max(len(req.digests), 1), 4)})
+
+    # -- health / drain ----------------------------------------------------
+    def _poll_health(self, now: float):
+        for st in self._all:
+            if st.state == "dead":
+                continue
+            if not st.handle.alive():
+                self._mark_dead(st)
+                continue
+            if now - st.health_t < self._health_poll_s:
+                # throttle applies even while the endpoint is
+                # unreachable — health() can block (HTTP timeout) and
+                # this loop runs on the single scheduler thread
+                continue
+            st.health_t = now
+            try:
+                doc = st.handle.health()
+            except Exception:
+                doc = None
+            if doc is None:
+                continue    # endpoint unreachable: state unknown,
+            #                 liveness stays the transport's verdict
+            st.last_health = doc
+            status = doc.get("status", "ok")
+            if not doc.get("healthy", True):
+                status = "unhealthy"
+            self._set_state(
+                st, status if status in REPLICA_STATES else "ok")
+
+    def _set_state(self, st, new: str):
+        if new == st.state:
+            return
+        if new == "unhealthy":
+            self._m_drains.inc(reason="unhealthy")
+        st.state = new
+        self._m_state.set(_STATE_RANK[new], replica=st.name)
+
+    def _mark_dead(self, st):
+        if st.state == "dead":
+            return
+        st.state = "dead"
+        self._m_state.set(0, replica=st.name)
+        self._m_drains.inc(reason="dead")
+        requeue: List[RouterRequest] = []
+        for xid, (req, kind) in list(st.outstanding.items()):
+            st.outstanding.pop(xid)
+            if kind == "import":
+                continue
+            req.requeues += 1
+            req.status = "queued"
+            req.replica = None
+            # a payload produced by (or destined for) the dead replica
+            # restarts the whole flow — survivors may have the prefix
+            # hot anyway
+            req.payload, req.payload_blocks = None, 0
+            requeue.append(req)
+        if requeue:
+            self._m_requeued.inc(len(requeue))
+            for req in reversed(requeue):
+                self._queue.appendleft(req)
+
+    # -- placement ---------------------------------------------------------
+    def _place(self):
+        remaining: deque = deque()
+        while self._queue:
+            req = self._queue.popleft()
+            if not self._place_one(req):
+                remaining.append(req)
+        self._queue = remaining
+        self._m_queue.set(len(self._queue))
+
+    def _place_one(self, req: RouterRequest) -> bool:
+        if req.payload is not None:
+            return self._place_decode(req)
+        if (self._prefill and req.usable
+                and req.prefill_replica is None
+                and not self._hot_anywhere(req)):
+            st = self._pick_prefill()
+            if st is not None:
+                st.handle.submit({
+                    "id": req.xid, "op": "export_prefix",
+                    "prompt": [int(t) for t in req.prompt]})
+                st.outstanding[req.xid] = (req, "export")
+                req.status = "prefill"
+                req.prefill_replica = st.name
+                return True
+            # no prefill capacity: colocated fallback — correctness
+            # (and latency) must not wait on the prefill tier
+        return self._place_decode(req)
+
+    def _hot_anywhere(self, req: RouterRequest) -> bool:
+        """True when some decode replica already holds the whole
+        transferable prefix hot — the placement-hit fast path that
+        skips the prefill tier entirely."""
+        usable = req.digests[:req.usable]
+        return any(st.prefix_score(usable) >= req.usable
+                   for st in self._decode
+                   if st.state in ("ok", "degraded"))
+
+    def _pick_prefill(self):
+        best, best_key = None, None
+        for st in self._prefill:
+            if st.state in ("unhealthy", "dead"):
+                continue
+            if st.in_flight >= st.cap:
+                continue
+            key = (1 if st.state == "ok" else 0, -st.in_flight)
+            if best_key is None or key > best_key:
+                best, best_key = st, key
+        return best
+
+    def _pick_decode(self, req: RouterRequest):
+        usable = req.digests[:req.usable]
+        best, best_key = None, None
+        for st in self._decode:
+            if st.state in ("unhealthy", "dead"):
+                continue
+            if st.in_flight >= st.cap:
+                continue
+            # state dominates (degraded replicas only when no ok one
+            # has room), then the hot-prefix run, then load
+            key = (1 if st.state == "ok" else 0,
+                   st.prefix_score(usable), -st.in_flight)
+            if best_key is None or key > best_key:
+                best, best_key = st, key
+        return best
+
+    def _place_decode(self, req: RouterRequest) -> bool:
+        st = self._pick_decode(req)
+        if st is None:
+            return False
+        usable = req.digests[:req.usable]
+        score = st.prefix_score(usable)
+        if req.payload is not None:
+            # ship the KV ahead of the generate op on the same ordered
+            # connection: the import lands before admission runs
+            iid = f"imp{req.xid}.{req.placements}"
+            st.handle.submit({"id": iid, "op": "import_prefix",
+                              "payload": req.payload})
+            st.outstanding[iid] = (req, "import")
+            st.mark_hot(req.digests[:req.payload_blocks])
+            score = max(score, req.payload_blocks)
+            req.payload = None
+        st.handle.submit({
+            "id": req.xid, "prompt": [int(t) for t in req.prompt],
+            "max_new": req.max_new, "temperature": req.temperature,
+            "top_k": req.top_k, "eos_id": req.eos_id,
+            "tenant": req.tenant, "tier": req.tier})
+        st.outstanding[req.xid] = (req, "generate")
+        req.status, req.replica = "placed", st.name
+        req.placed_t = time.perf_counter()
+        req.placements += 1
+        req.prefix_score = score
+        self._m_placements.inc()
+        if score > 0:
+            self._m_place_hits.inc()
+        st.mark_hot(usable)
+        return True
+
+    # -- observability -----------------------------------------------------
+    def _slo_burn_rate(self) -> float:
+        if self.slo is None:
+            return 0.0
+        return self.slo.burn_rate(
+            self._win_ttft.fraction_over(self.slo.ttft_s))
+
+    def _update_gauges(self):
+        """Cheap per-step scalar gauges (the scheduler calls this every
+        iteration — window quantiles live in _update_window_gauges,
+        computed only at scrape time like the engines')."""
+        for st in self._all:
+            self._m_in_flight.set(st.in_flight, replica=st.name)
+            qd = (st.last_health or {}).get("queue_depth")
+            if qd is not None:
+                self._m_replica_queue.set(qd, replica=st.name)
+
+    def _update_window_gauges(self):
+        ttft = self._win_ttft.quantiles((0.5, 0.95, 0.99))
+        tps = self._win_tps.quantiles((0.5, 0.95, 0.99))
+        for lbl, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+            self._m_win_ttft.set(ttft[q], q=lbl)
+            self._m_win_tps.set(tps[q], q=lbl)
+        self._m_burn.set(self._slo_burn_rate())
+
+    def health(self) -> dict:
+        self._update_gauges()
+        self._update_window_gauges()
+        ttft = self._win_ttft.quantiles((0.5, 0.95, 0.99))
+        doc = {
+            "replicas": {
+                st.name: {
+                    "state": st.state,
+                    "role": "prefill" if st in self._prefill
+                    else "decode",
+                    "in_flight": st.in_flight,
+                    "queue_depth": (st.last_health or {}).get(
+                        "queue_depth"),
+                    "slo_burn": ((st.last_health or {}).get("slo")
+                                 or {}).get("ttft_burn_rate")}
+                for st in self._all},
+            "queue_depth": len(self._queue),
+            "requests": int(self._m_requests.value()),
+            "completed": self._n_completed,
+            "requeued": int(self._m_requeued.value()),
+            "placement_hit_rate": round(self.placement_hit_rate(), 4),
+            "window": {"ttft_p50_s": round(ttft[0.5], 6),
+                       "ttft_p99_s": round(ttft[0.99], 6),
+                       "requests": self._win_ttft.count()}}
+        decode_live = [st for st in self._decode
+                       if st.state in ("ok", "degraded")]
+        if not decode_live:
+            doc["healthy"] = False      # nothing can admit: 503
+        elif any(st.state != "ok" for st in self._all):
+            doc["status"] = "degraded"
+            doc["degraded_reason"] = ", ".join(
+                f"{st.name}={st.state}" for st in self._all
+                if st.state != "ok")
+        if self.slo is not None:
+            doc["slo"] = {"ttft_s": self.slo.ttft_s,
+                          "target": self.slo.target,
+                          "burn_rate": round(self._slo_burn_rate(), 4)}
+        return doc
+
+    def requests_doc(self, k: int = 10) -> dict:
+        doc = self.request_log.summary()
+        doc["slowest_by_ttft"] = self.request_log.slowest(k, by="ttft_s")
+        return doc
+
+    def metrics_text(self) -> str:
+        self._update_gauges()
+        self._update_window_gauges()
+        return self.metrics.render_prometheus()
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        """/metrics + /healthz + /requests over the router registry;
+        caller owns ``close()``."""
+        from paddle_tpu.observe.health import HealthServer
+        return HealthServer(registry=self.metrics, health_fn=self.health,
+                            host=host, port=port,
+                            requests_fn=self.requests_doc,
+                            metrics_fn=self.metrics_text)
+
+    def close(self):
+        for st in self._all:
+            try:
+                st.handle.close()
+            except Exception:
+                pass

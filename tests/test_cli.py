@@ -264,3 +264,67 @@ class TestCliServe:
             assert p.wait(timeout=60) == 0
         finally:
             p.kill()
+
+    def test_serve_sigterm_drains_gracefully(self, tmp_path):
+        """SIGTERM mid-request = graceful drain: the in-flight request
+        finishes, its result is emitted, and the process exits 0 (the
+        replica-drain contract the fleet router stands on — the old
+        behavior just died, losing the request). The health endpoint
+        pins that the request was accepted BEFORE the signal."""
+        import json
+        import re
+        import signal
+        import subprocess
+        import sys as _sys
+        import time
+        import urllib.request
+
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.io import lm_serving
+        from paddle_tpu.models import transformer
+
+        cfg = transformer.TransformerConfig(
+            vocab=40, d_model=16, n_heads=2, n_kv_heads=1, n_layers=2,
+            d_ff=32, max_len=64, dtype=jnp.float32, use_rope=True)
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        model = str(tmp_path / "lm_v3_drain.tar")
+        lm_serving.save_lm_artifact(model, params, cfg, batch=2,
+                                    prompt_len=4, cache_len=64,
+                                    engine_buckets=(8,))
+        p = subprocess.Popen(
+            [_sys.executable, "-m", "paddle_tpu", "serve",
+             f"--model={model}", "--health_port=0"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        try:
+            p.stdin.write(json.dumps(
+                {"prompt": [1, 2, 3], "max_new": 40}) + "\n")
+            p.stdin.flush()
+            url = None
+            while url is None:          # jax may log to stderr first
+                line = p.stderr.readline()
+                if not line and p.poll() is not None:
+                    raise AssertionError(
+                        f"serve process died before announcing its "
+                        f"health endpoint (rc={p.poll()})")
+                m = re.search(r"(http://[\d.:]+)/metrics", line)
+                url = m and m.group(1)
+            deadline = time.time() + 120
+            doc = {}
+            while time.time() < deadline:
+                doc = json.loads(urllib.request.urlopen(
+                    url + "/healthz", timeout=5).read())
+                if doc.get("requests", 0) >= 1:
+                    break
+                time.sleep(0.05)
+            assert doc.get("requests", 0) >= 1, doc
+            p.send_signal(signal.SIGTERM)
+            out = json.loads(p.stdout.readline())
+            assert p.wait(timeout=120) == 0
+            assert out["finish_reason"] == "max_tokens"
+            assert len(out["tokens"]) == 40
+        finally:
+            p.kill()

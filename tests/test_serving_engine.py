@@ -341,3 +341,13 @@ class TestServingBenchSmoke:
         assert sd["acceptance_rate"] is not None
         assert sd["spec_tokens_per_sec"] > 0
         assert results["spec_decode_speedup"] > 0
+        # serving-fleet era fields: the router A/B ran under --smoke
+        # with zero lost requests and the P/D disaggregation bitwise
+        # check (asserted INSIDE the phase) held; the goodput /
+        # victim-TTFT CLAIMS are the dedicated --fleet run's
+        fl = results["fleet"]
+        assert fl["all_requests_completed"] is True
+        assert fl["pd_bitwise_ok"] is True
+        assert fl["fleet"]["requeued"] == 0
+        assert fl["fleet"]["tokens_per_sec"] > 0
+        assert fl["pd_blocks_shipped"] >= 1
