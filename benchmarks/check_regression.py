@@ -103,6 +103,16 @@ FAMILIES = {
             ("placement_hit_rate", "higher", 0.10),
             ("all_requests_completed", "true", 0.0),
             ("pd_bitwise_ok", "true", 0.0),
+            # observability plane (PR-16 fields; SKIP against older
+            # artifacts by design): fleet goodput with tracing +
+            # aggregation ON over OFF is a same-machine ratio near 1.0
+            # — a hot-path pessimization in the trace/aggregate code
+            # drags it down and the band catches it; the chaos boolean
+            # (kill-injected run: joined multi-replica trace, labeled
+            # fleet /metrics, dead-replica firing→resolved pair) must
+            # hold outright
+            ("observability_overhead", "higher", 0.15),
+            ("chaos_joined_ok", "true", 0.0),
         ],
     },
     "elastic": {
@@ -163,12 +173,17 @@ def lookup(doc, path):
 def compare_figure(latest, prev, direction, band):
     """(verdict, detail) for one figure of merit; SKIP when either
     artifact lacks it (schema drift is not a regression)."""
+    if direction == "true":
+        # a boolean contract holds (or not) on the latest artifact
+        # alone — a figure new to the schema must not wait one run
+        # before it can gate
+        if latest is None:
+            return "SKIP", "missing in latest"
+        return ("PASS", "still true") if latest else \
+            ("REGRESSED", f"was {prev!r}, now {latest!r}")
     if latest is None or prev is None:
         return "SKIP", "missing in latest" if latest is None \
             else "missing in previous"
-    if direction == "true":
-        return ("PASS", "still true") if latest else \
-            ("REGRESSED", f"was {prev!r}, now {latest!r}")
     latest, prev = float(latest), float(prev)
     if direction == "higher":
         floor = prev * (1.0 - band)

@@ -51,4 +51,19 @@ else:
                       "mosaic_ok": tc.get("mosaic_ok"), **oks}))
 PYEOF
 fi
+# latest fleet observability-overhead figure: traced/untraced goodput
+# ratio + the chaos-run verdict from the newest serving_fleet artifact
+# (run serving_bench.py --fleet to refresh)
+latest_fleet=$(ls benchmarks/runs/*serving_fleet*.json 2>/dev/null | sort | tail -1)
+if [ -n "$latest_fleet" ]; then
+    echo "== OBSERVABILITY OVERHEAD ($latest_fleet) =="
+    python - "$latest_fleet" <<'PYEOF' || true
+import json, sys
+doc = json.load(open(sys.argv[1]))
+print(json.dumps({
+    "observability_overhead": doc.get("observability_overhead", "n/a"),
+    "chaos_joined_ok": doc.get("chaos_joined_ok", "n/a"),
+    "chaos": doc.get("fleet", {}).get("chaos", "n/a")}))
+PYEOF
+fi
 exit $rc

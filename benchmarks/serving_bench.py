@@ -316,6 +316,47 @@ def assert_lifecycles_joined(trace, reqs, buf):
     assert not extra, f"orphan request tracks in trace: {sorted(extra)}"
 
 
+def assert_fleet_lifecycles_joined(trace, reqs, buf):
+    """Router-aware join check for a fleet replay: every completed
+    request's track must be ONE connected tree — balanced b/e, exactly
+    one router-side ``route`` root, the engine lifecycle (queued/
+    prefill/decode/first_token) present on the SAME id — and a
+    requeued request must still be single-rooted (its second placement
+    re-joins the original trace, with the requeue marker and a second
+    ``queued`` open on the track). No orphan tracks."""
+    assert buf.dropped() == 0, (
+        f"trace ring dropped {buf.dropped()} events — joins "
+        f"unverifiable; raise PADDLE_TPU_TRACE_BUFFER")
+    evs = [e for e in trace["traceEvents"]
+           if e.get("cat") == "request" and e.get("ph") in "bne"]
+    by_id = {}
+    for e in evs:
+        by_id.setdefault(e["id"], []).append(e)
+    for r in reqs:
+        assert r.status == "done", f"x{r.xid} ended {r.status!r}"
+        es = by_id.get(r.trace_id)
+        assert es, f"request {r.trace_id}: no lifecycle events"
+        b = sum(1 for e in es if e["ph"] == "b")
+        e_ = sum(1 for e in es if e["ph"] == "e")
+        assert b == e_ >= 1, (
+            f"request {r.trace_id}: orphan async spans "
+            f"({b} opened, {e_} closed)")
+        roots = [e for e in es if e["name"] == "route"
+                 and e["ph"] == "b"]
+        assert len(roots) == 1, (
+            f"request {r.trace_id}: {len(roots)} route roots")
+        names = [e["name"] for e in es]
+        for engine_side in ("queued", "prefill", "decode",
+                            "first_token"):
+            assert engine_side in names, (
+                f"request {r.trace_id}: missing {engine_side}")
+        if r.requeues > 0:
+            assert "requeue" in names and names.count("queued") >= 2, (
+                f"requeued {r.trace_id} did not re-join: {names}")
+    extra = set(by_id) - {r.trace_id for r in reqs}
+    assert not extra, f"orphan request tracks in trace: {sorted(extra)}"
+
+
 def _paged_programs(lens, chunk, bs, buckets):
     """The (chunk bucket, page-vector length) program set a COLD walk
     of the given prompt lengths reaches — one compile each (prefix
@@ -1057,11 +1098,16 @@ def fleet_phase(args):
     adversary's chunked prefill on ONE replica while the others keep
     serving, where the single engine makes every decoder share the
     stall), placement hit rate (shared-prefix traffic converging onto
-    warm pools), an all-requests-completed bool, and a P/D
+    warm pools), an all-requests-completed bool, a P/D
     disaggregation bitwise check (prefill replica exports the KV
     prefix over the transfer wire, decode replica adopts it via the
     prefix-cache publish path, outputs equal the colocated run —
-    asserted outright, it must never rot)."""
+    asserted outright, it must never rot), an observability_overhead
+    figure (fleet goodput with tracing+aggregation ON over OFF — the
+    observability plane must stay off the hot path), and a chaos run
+    (replica kill mid-burst) whose joined multi-replica trace, fleet
+    /metrics render, and dead-replica firing→resolved alert pair are
+    asserted outright (exported via --trace-out)."""
     from paddle_tpu.observe.compile_tracker import CompileTracker
     from paddle_tpu.serving import EngineReplica, default_chunk_buckets
     from paddle_tpu.serving.router import Router
@@ -1121,12 +1167,17 @@ def fleet_phase(args):
                 "completed": sum(1 for r in reqs
                                  if r.finish_reason is not None)}
 
-    def once_fleet():
+    def once_fleet(observed=True):
+        # observed=True is the PRODUCTION configuration (request
+        # tracing + fleet metrics aggregation on, the router default);
+        # observed=False is the dark baseline the observability_
+        # overhead figure compares against
         reps = [EngineReplica(mk_rep(), f"r{i}") for i in range(R)]
         router = Router(reps, block_size=args.block_size,
                         chunk_tokens=args.chunk_tokens,
                         max_in_flight=per_batch * 2,
-                        health_poll_s=0.5)
+                        health_poll_s=0.5, trace=observed,
+                        aggregate=observed)
         reqs, wall = _replay_router(router, work)
         for eng in (r.eng for r in reps):
             assert eng.compile_counts() == warm_rep, "fleet recompiled"
@@ -1147,15 +1198,19 @@ def fleet_phase(args):
                     router.placement_hit_rate(), 4)}
 
     repeats = max(1, args.repeats)
-    single = fleet = None
+    single = fleet = fleet_dark = None
     for _ in range(repeats):       # interleaved, best goodput per side
-        s, f = once_single(), once_fleet()
+        s, f = once_single(), once_fleet(observed=True)
+        fd = once_fleet(observed=False)
         if single is None or s["tokens_per_sec"] > \
                 single["tokens_per_sec"]:
             single = s
         if fleet is None or f["tokens_per_sec"] > \
                 fleet["tokens_per_sec"]:
             fleet = f
+        if fleet_dark is None or fd["tokens_per_sec"] > \
+                fleet_dark["tokens_per_sec"]:
+            fleet_dark = fd
 
     # P/D disaggregation bitwise check: colocated reference vs a
     # 1-prefill + 1-decode router fleet over the SAME compiled programs
@@ -1179,11 +1234,69 @@ def fleet_phase(args):
                   "colocated run"
     assert int(pd_router._m_pd_exports.value()) >= 1
 
+    # chaos + trace-join: the observability acceptance run. One more
+    # fleet with the span buffer captured end-to-end; kill the replica
+    # holding the first placed request mid-run. Every request must
+    # still complete, the requeued requests' spans must re-join their
+    # ORIGINAL trace id (balanced b/e, exactly one router-side `route`
+    # root), the fleet metrics render (what router /metrics serves)
+    # must carry replica-labeled series and the pooled-TTFT quantile
+    # gauges, and the dead-replica alert must fire and then resolve on
+    # admin removal — asserted outright, the joined-timeline contract
+    # must never rot.
+    from paddle_tpu import observe
+    buf = observe.default_buffer()
+    if not buf.enabled or buf.capacity < 65536:
+        buf = observe.set_trace_capacity(65536)
+    buf.clear()
+    ch_reps = [EngineReplica(mk_rep(), f"r{i}") for i in range(R)]
+    ch_router = Router(ch_reps, block_size=args.block_size,
+                       chunk_tokens=args.chunk_tokens,
+                       max_in_flight=per_batch * 2, health_poll_s=0.0)
+    ch_reqs = [ch_router.submit(p, m) for _, p, m in work]
+    for _ in range(3):
+        ch_router.step()
+    placed = [r for r in ch_reqs if r.replica is not None]
+    assert placed, "chaos run placed nothing before the kill"
+    victim = placed[0].replica
+    next(st.handle for st in ch_router._all
+         if st.name == victim).kill()
+    ch_router.run_until_idle()
+    assert all(r.status == "done" for r in ch_reqs), \
+        "chaos run lost requests"
+    ch_requeued = [r for r in ch_reqs if r.requeues > 0]
+    assert ch_requeued, "kill injection requeued nothing"
+    mtext = ch_router.metrics_text()
+    assert "fleet_ttft_window_seconds" in mtext, \
+        "fleet /metrics missing pooled quantile gauges"
+    assert 'fleet_engine_queue_depth{replica="' in mtext, \
+        "fleet /metrics missing replica-labeled series"
+    assert any(a["rule"] == "fleet_dead_replicas"
+               for a in ch_router.alerts.firing()), \
+        "replica death did not fire the dead-replica alert"
+    ch_router.remove_replica(victim)
+    ch_router.step()
+    assert ch_router.alerts.firing() == [], \
+        "dead-replica alert did not resolve after removal"
+    alert_events = [(e["rule"], e["event"])
+                    for e in ch_router.alerts.events]
+    assert ("fleet_dead_replicas", "firing") in alert_events
+    assert ("fleet_dead_replicas", "resolved") in alert_events
+    trace = observe.trace_export(args.trace_out) if args.trace_out \
+        else observe.trace_export()
+    assert_fleet_lifecycles_joined(trace, ch_reqs, buf)
+    if args.trace_out:
+        print(f"wrote fleet trace to {args.trace_out} "
+              f"({len(ch_reqs)} requests, {len(ch_requeued)} "
+              f"requeued through the kill, all lifecycles joined)",
+              file=sys.stderr)
+
     completed_ok = (fleet["failed"] == 0
                     and fleet["completed"] == len(work)
                     and fleet["requeued"] == 0)
     out = {
         "single": single, "fleet": fleet,
+        "fleet_untraced": fleet_dark,
         "adversary_prompt_tokens": len(work[adv_i][1]),
         "victims": len(victims),
         "router_goodput_ratio": round(
@@ -1193,9 +1306,20 @@ def fleet_phase(args):
             fleet["victim_ttft_p99_s"]
             / max(single["victim_ttft_p99_s"], 1e-9), 3),
         "placement_hit_rate": fleet["placement_hit_rate"],
+        # goodput with tracing+aggregation ON over OFF on the same
+        # machine — ~1.0 when the observability plane is off the hot
+        # path; the sentinel holds it inside the noise band
+        "observability_overhead": round(
+            fleet["tokens_per_sec"]
+            / max(fleet_dark["tokens_per_sec"], 1e-9), 3),
         "all_requests_completed": completed_ok,
         "pd_bitwise_ok": pd_ok,
-        "pd_blocks_shipped": int(pd_router._m_pd_blocks.value())}
+        "pd_blocks_shipped": int(pd_router._m_pd_blocks.value()),
+        "chaos_joined_ok": True,      # the asserts above are the proof
+        "chaos": {"requests": len(ch_reqs),
+                  "requeued": len(ch_requeued),
+                  "killed_replica": victim,
+                  "alert_pair_ok": True}}
     assert completed_ok, f"fleet lost requests: {fleet}"
     return out
 
@@ -1342,7 +1466,12 @@ def main(argv=None):
                     help="export the per-request lifecycle trace of a "
                          "dedicated latency-phase replay (Chrome-trace "
                          "JSON) and assert every completed request's "
-                         "lifecycle is fully joined — no orphan spans")
+                         "lifecycle is fully joined — no orphan "
+                         "spans. With --fleet: export the joined "
+                         "multi-replica trace of the chaos run "
+                         "(router route/queue/place spans + engine "
+                         "lifecycles + the kill-and-requeue, one "
+                         "connected tree per request)")
     ap.add_argument("--tpu-check", action="store_true",
                     help="deviceless XLA:TPU export of the paged step "
                          "programs per KV dtype (fp32/int8/int4, XLA "
@@ -1392,8 +1521,9 @@ def main(argv=None):
         print(json.dumps(line), flush=True)
         metrics_write(**line)
         for key in ("router_goodput_ratio", "victim_ttft_ratio",
-                    "placement_hit_rate", "all_requests_completed",
-                    "pd_bitwise_ok"):
+                    "placement_hit_rate", "observability_overhead",
+                    "all_requests_completed", "pd_bitwise_ok",
+                    "chaos_joined_ok"):
             results[key] = results["fleet"][key]
         results["fleet_tokens_per_sec"] = \
             results["fleet"]["fleet"]["tokens_per_sec"]

@@ -441,7 +441,9 @@ def job_route(args):
                                       port=args.health_port)
             print(f"observability: {health_srv.url}/metrics  "
                   f"{health_srv.url}/healthz  "
-                  f"{health_srv.url}/requests", file=sys.stderr)
+                  f"{health_srv.url}/requests  "
+                  f"{health_srv.url}/alerts  (point `paddle_tpu top "
+                  f"--url={health_srv.url}` here)", file=sys.stderr)
 
         inbox: "_queue.Queue" = _queue.Queue()
         draining = threading.Event()
@@ -529,6 +531,97 @@ def job_route(args):
         if fleet is not None:
             fleet.close()
     return 0
+
+
+def _render_top(health: dict, alerts: dict) -> str:
+    """One frame of the `top` view: the fleet summary line, a
+    per-replica table, and the firing-alert panel — pure function of
+    the two endpoint documents so tests can pin the rendering."""
+    def fmt(v, spec="", dash="-"):
+        if v is None:
+            return dash
+        return format(v, spec) if spec else str(v)
+
+    win = health.get("window") or {}
+    lines = [
+        "fleet: {q} queued  {r} requests  {c} completed  {rq} requeued"
+        "  hit_rate {hr}  ttft_p99 {p99}s".format(
+            q=health.get("queue_depth", 0),
+            r=health.get("requests", 0),
+            c=health.get("completed", 0),
+            rq=health.get("requeued", 0),
+            hr=fmt(health.get("placement_hit_rate"), ".2f"),
+            p99=fmt(win.get("fleet_ttft_p99_s",
+                            win.get("ttft_p99_s")), ".4f"))]
+    hdr = (f"{'REPLICA':<12} {'ROLE':<8} {'STATE':<10} {'INFL':>4} "
+           f"{'QUEUE':>5} {'BLOCKS':>11} {'TTFT_P99':>9} {'BURN':>6}")
+    lines.append(hdr)
+    for name, rep in sorted((health.get("replicas") or {}).items()):
+        used, total = rep.get("blocks_in_use"), rep.get("blocks_total")
+        blocks = (f"{used}/{total}" if used is not None
+                  and total is not None else "-")
+        lines.append(
+            f"{name:<12.12} {fmt(rep.get('role')):<8.8} "
+            f"{fmt(rep.get('state')):<10.10} "
+            f"{fmt(rep.get('in_flight')):>4} "
+            f"{fmt(rep.get('queue_depth')):>5} {blocks:>11} "
+            f"{fmt(rep.get('ttft_p99_s'), '.4f'):>9} "
+            f"{fmt(rep.get('slo_burn'), '.2f'):>6}")
+    firing = (alerts.get("firing") if alerts
+              else health.get("alerts_firing")) or []
+    if firing:
+        lines.append("ALERTS FIRING:")
+        for a in firing:
+            lines.append(f"  !! {a.get('rule')}: value "
+                         f"{fmt(a.get('value'), '.4f')} {a.get('op')} "
+                         f"{a.get('threshold')}  {a.get('description')}")
+    else:
+        lines.append("alerts: none firing")
+    return "\n".join(lines)
+
+
+def job_top(args):
+    """Live fleet status: a refresh loop over a running router's
+    ``/healthz`` + ``/alerts`` endpoints (``route --health_port``) —
+    per-replica state / in-flight / KV blocks / TTFT p99 / SLO burn,
+    plus the firing-alert panel. ``--top_iterations`` bounds the loop
+    (0 = until interrupted); on a TTY each frame repaints in place."""
+    import json
+    import time as _time
+    import urllib.request
+
+    if not args.url:
+        print("top: pass --url http://HOST:HEALTH_PORT (a route "
+              "--health_port endpoint)", file=sys.stderr)
+        return 1
+    base = args.url.rstrip("/")
+    n = 0
+    try:
+        while True:
+            try:
+                with urllib.request.urlopen(base + "/healthz",
+                                            timeout=2.0) as r:
+                    health = json.loads(r.read().decode())
+            except Exception as e:
+                health, err = {}, e
+                print(f"top: {base}/healthz unreachable: {e}",
+                      file=sys.stderr)
+            try:
+                with urllib.request.urlopen(base + "/alerts",
+                                            timeout=2.0) as r:
+                    alerts = json.loads(r.read().decode())
+            except Exception:
+                alerts = {}    # router without an evaluator: panel off
+            if health:
+                if sys.stdout.isatty():
+                    print("\x1b[2J\x1b[H", end="")
+                print(_render_top(health, alerts), flush=True)
+            n += 1
+            if args.top_iterations and n >= args.top_iterations:
+                return 0 if health else 1
+            _time.sleep(max(args.top_interval_s, 0.05))
+    except KeyboardInterrupt:
+        return 0
 
 
 def _pct(sorted_vals, q):
@@ -711,12 +804,14 @@ def main(argv=None):
         description="TPU-native trainer CLI (reference: paddle_trainer, "
                     "TrainerMain.cpp)")
     p.add_argument("job", choices=["train", "test", "time", "checkgrad",
-                                   "infer", "stats", "serve", "route"],
+                                   "infer", "stats", "serve", "route",
+                                   "top"],
                    help="what to run (TrainerMain.cpp:52-61; stats "
                         "renders an observability snapshot; serve runs "
                         "the continuous-batching LM engine over stdio "
                         "or --port TCP; route fronts N serve replicas "
-                        "with the prefix-aware fleet router)")
+                        "with the prefix-aware fleet router; top is a "
+                        "live status view over a route --health_port)")
     p.add_argument("--config", default=None,
                    help="python config file (required for every job "
                         "except stats)")
@@ -792,6 +887,15 @@ def main(argv=None):
     p.add_argument("--slo_window_s", type=float, default=60.0,
                    help="rolling window for SLO evaluation, seconds "
                         "(job=serve)")
+    p.add_argument("--url", default=None,
+                   help="job=top: the router's observability base URL "
+                        "(http://HOST:HEALTH_PORT from route "
+                        "--health_port)")
+    p.add_argument("--top_interval_s", type=float, default=2.0,
+                   help="job=top: refresh interval, seconds")
+    p.add_argument("--top_iterations", type=int, default=0,
+                   help="job=top: stop after N frames (0 = until "
+                        "interrupted; tests use 1)")
     p.add_argument("--tenant-budget", "--tenant_budget",
                    action="append", default=[], dest="tenant_budget",
                    metavar="TENANT=TOKENS",
@@ -815,6 +919,8 @@ def main(argv=None):
         return job_serve(args)
     if args.job == "route":
         return job_route(args)
+    if args.job == "top":
+        return job_top(args)
     if not args.config:
         p.error(f"--config is required for job={args.job}")
     cfg = _load_config(args.config)

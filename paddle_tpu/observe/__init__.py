@@ -17,6 +17,10 @@ The pieces:
   snapshot dumped as a JSON post-mortem on NaN/crash.
 - ``observe.health`` — stdlib HTTP ``/metrics`` + ``/healthz`` server
   attachable to the trainer, LMServer, and MasterServer.
+- ``observe.fleet`` — router-side aggregator merging N replica metric
+  registries into one labeled fleet registry (pooled-sample quantiles).
+- ``observe.alerts`` — declarative alert rules with for-duration
+  debounce over any registry, feeding ``/alerts`` and the trace ring.
 - ``observe.report()`` — the one funnel the trainer (and anything else)
   pushes per-step records through: every record goes to the configured
   JSONL sink and to any registered handlers, while the existing
@@ -36,9 +40,13 @@ import os
 import threading
 from typing import Callable, List, Optional
 
+from paddle_tpu.observe.alerts import (  # noqa: F401
+    AlertEvaluator, AlertRule, default_fleet_rules)
 from paddle_tpu.observe.chrome_trace import (  # noqa: F401
     SpanBuffer, default_buffer, record_event, record_span,
     set_trace_capacity, trace_enabled, trace_export)
+from paddle_tpu.observe.fleet import (  # noqa: F401
+    FleetAggregator, death_postmortem)
 from paddle_tpu.observe import bottleneck  # noqa: F401
 from paddle_tpu.observe.bottleneck import attribute_step  # noqa: F401
 from paddle_tpu.observe import costs  # noqa: F401 — observe.costs.*
@@ -51,7 +59,7 @@ from paddle_tpu.observe.flight import (  # noqa: F401
 from paddle_tpu.observe.health import HealthServer  # noqa: F401
 from paddle_tpu.observe.metrics import (  # noqa: F401 — public surface
     Counter, Gauge, Histogram, JsonlSink, Registry, counter,
-    default_registry, gauge, histogram, read_jsonl)
+    default_registry, gauge, histogram, parse_prometheus, read_jsonl)
 from paddle_tpu.observe import requests  # noqa: F401 — observe.requests.*
 from paddle_tpu.observe.requests import (  # noqa: F401
     RequestLog, default_request_log)

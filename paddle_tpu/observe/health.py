@@ -17,6 +17,10 @@ One ``HealthServer`` serves two GET routes:
   decode engines pass theirs): the top-k slowest requests with their
   attributed latency components (``observe/requests.py``), the
   tail-latency post-mortem a dashboard links to.
+- ``/alerts`` — present when an ``alerts_fn`` is supplied (the fleet
+  router passes its evaluator's ``doc``): per-rule state + the recent
+  firing/resolved transition log (``observe/alerts.py``), the surface
+  ``paddle_tpu top`` polls.
 
 Attach points: ``SGD.attach_observability()``, ``LMServer.serve()``,
 ``MasterServer(http_port=...)`` — or construct one directly around any
@@ -37,13 +41,15 @@ class HealthServer:
     def __init__(self, registry=None, health_fn: Optional[Callable[[],
                  dict]] = None, host: str = "127.0.0.1", port: int = 0,
                  requests_fn: Optional[Callable[[], dict]] = None,
-                 metrics_fn: Optional[Callable[[], str]] = None):
+                 metrics_fn: Optional[Callable[[], str]] = None,
+                 alerts_fn: Optional[Callable[[], dict]] = None):
         if registry is None:
             from paddle_tpu.observe.metrics import default_registry
             registry = default_registry()
         self.registry = registry
         self.health_fn = health_fn
         self.requests_fn = requests_fn
+        self.alerts_fn = alerts_fn
         # metrics_fn overrides the registry render for `/metrics` so an
         # owner can refresh derived gauges per scrape (the engines'
         # window quantiles expire with time and must not scrape stale)
@@ -77,6 +83,12 @@ class HealthServer:
                           and outer.requests_fn is not None):
                         from paddle_tpu.observe.metrics import JsonlSink
                         doc = JsonlSink._clean(outer.requests_fn() or {})
+                        self._send(200, json.dumps(doc).encode(),
+                                   "application/json")
+                    elif (path == "/alerts"
+                          and outer.alerts_fn is not None):
+                        from paddle_tpu.observe.metrics import JsonlSink
+                        doc = JsonlSink._clean(outer.alerts_fn() or {})
                         self._send(200, json.dumps(doc).encode(),
                                    "application/json")
                     else:

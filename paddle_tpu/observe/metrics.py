@@ -364,6 +364,97 @@ class Registry:
         return "\n".join(lines) + ("\n" if lines else "")
 
 
+def _parse_labels(s: str) -> Dict[str, str]:
+    """Parse one ``{k="v",...}`` label block (inverse of _fmt_labels,
+    including the escaping)."""
+    out: Dict[str, str] = {}
+    i = 0
+    while i < len(s):
+        eq = s.index("=", i)
+        name = s[i:eq].strip().lstrip(",").strip()
+        assert s[eq + 1] == '"', f"malformed label block {s!r}"
+        j = eq + 2
+        buf = []
+        while s[j] != '"':
+            if s[j] == "\\":
+                j += 1
+                buf.append({"n": "\n"}.get(s[j], s[j]))
+            else:
+                buf.append(s[j])
+            j += 1
+        out[name] = "".join(buf)
+        i = j + 1
+    return out
+
+
+def parse_prometheus(text: str) -> Dict[str, dict]:
+    """Parse Prometheus text exposition back into the
+    :meth:`Registry.snapshot` shape: ``{name: {kind, series: [{labels,
+    value}]}}`` — the inverse the fleet aggregator uses to scrape a
+    replica's ``/metrics`` over HTTP into its own labeled registry.
+
+    Histogram exposition (``_bucket``/``_sum``/``_count`` lines) folds
+    back under the base name as ``{labels, sum, count}`` records (the
+    per-bucket counts are not reconstructed — fleet aggregation pools
+    raw window samples for quantiles, never merges bucket estimates).
+    Unknown/malformed lines are skipped, not fatal: a scrape is
+    best-effort observability, not a parser contract.
+    """
+    kinds: Dict[str, str] = {}
+    out: Dict[str, dict] = {}
+
+    def _series(name: str, labels: Dict[str, str]) -> dict:
+        doc = out.setdefault(name, {"kind": kinds.get(name, "untyped"),
+                                    "series": []})
+        key = _label_key(labels)
+        for rec in doc["series"]:
+            if _label_key(rec["labels"]) == key:
+                return rec
+        rec = {"labels": labels}
+        doc["series"].append(rec)
+        return rec
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                kinds[parts[2]] = parts[3]
+            continue
+        try:
+            lhs, val_s = line.rsplit(None, 1)
+            value = float(val_s)
+            if "{" in lhs:
+                name, rest = lhs.split("{", 1)
+                rest = rest.rstrip()
+                if not rest.endswith("}"):
+                    raise ValueError(f"unterminated label block: "
+                                     f"{line!r}")
+                labels = _parse_labels(rest[:-1])
+            else:
+                name, labels = lhs, {}
+            base = None
+            for suffix, field in (("_bucket", None), ("_sum", "sum"),
+                                  ("_count", "count")):
+                cand = name[:-len(suffix)] if name.endswith(suffix) else None
+                if cand and kinds.get(cand) == "histogram":
+                    base, comp = cand, field
+                    break
+            if base is not None:
+                if comp is None:
+                    continue             # bucket lines: not reconstructed
+                _series(base, labels)[comp] = value
+            else:
+                _series(name, labels)["value"] = value
+        except (ValueError, AssertionError, IndexError):
+            continue
+    for name, doc in out.items():
+        doc["kind"] = kinds.get(name, doc["kind"])
+    return out
+
+
 # -- the global default registry -------------------------------------------
 
 _default = Registry()

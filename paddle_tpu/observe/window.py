@@ -94,6 +94,73 @@ class WindowedQuantiles:
         vals = sorted(self._values(now))
         return {q: _nearest_rank(vals, q) for q in qs}
 
+    def samples(self, now: Optional[float] = None) -> List[tuple]:
+        """Raw ``(t, value)`` pairs of the live window, oldest first.
+
+        This is the export fleet aggregation pools. Quantiles are rank
+        statistics of a distribution, not means: the fleet p99 is the
+        99th percentile of EVERY request the fleet served, which only
+        the pooled samples can answer. Averaging per-replica p99s is
+        wrong twice over — it weights a replica that served 3 requests
+        the same as one that served 3000, and a mean of per-replica
+        tails neither bounds nor tracks the pooled tail (one slow
+        replica's p99 dilutes into the average instead of dominating
+        the fleet tail the way its requests actually do).
+        """
+        now = self._clock() if now is None else float(now)
+        with self._lock:
+            self._expire(now)
+            return list(self._dq)
+
+    def export_samples(self, now: Optional[float] = None) -> List[list]:
+        """Clock-free wire form of :meth:`samples`: ``[age_s, value]``
+        pairs (age relative to now). Timestamps here are this process's
+        monotonic clock — meaningless to another process — so the wire
+        carries ages and :meth:`absorb` re-stamps them into the
+        importer's clock domain."""
+        now = self._clock() if now is None else float(now)
+        return [[now - t, v] for t, v in self.samples(now)]
+
+    def absorb(self, aged_samples, now: Optional[float] = None):
+        """Ingest ``[age_s, value]`` pairs (an :meth:`export_samples`
+        payload, possibly from another process), re-stamped into this
+        window's clock domain. Samples older than ``window_s`` are
+        dropped; the pooled set is re-ordered by time so deque eviction
+        stays oldest-first."""
+        now = self._clock() if now is None else float(now)
+        incoming = [(now - float(age), float(v))
+                    for age, v in aged_samples
+                    if float(age) < self.window_s]
+        if not incoming:
+            return
+        with self._lock:
+            self._expire(now)
+            pooled = sorted(list(self._dq) + incoming)
+            self._dq.clear()
+            self._dq.extend(pooled[-self.max_samples:])
+
+    def merge(self, *others: "WindowedQuantiles",
+              now: Optional[float] = None):
+        """Pool other windows' live samples into this one (same clock
+        domain — in-process replicas; across processes go through
+        :meth:`export_samples` / :meth:`absorb`). After merging,
+        ``quantile(q)`` equals the quantile of the concatenated sample
+        sets — the ONLY correct fleet quantile (see :meth:`samples` on
+        why averaging per-replica quantiles is not)."""
+        now = self._clock() if now is None else float(now)
+        incoming = []
+        for other in others:
+            incoming.extend(other.samples(now))
+        incoming = [(t, v) for t, v in incoming
+                    if t > now - self.window_s]
+        if not incoming:
+            return
+        with self._lock:
+            self._expire(now)
+            pooled = sorted(list(self._dq) + incoming)
+            self._dq.clear()
+            self._dq.extend(pooled[-self.max_samples:])
+
     def fraction_over(self, threshold: float,
                       now: Optional[float] = None) -> float:
         """Fraction of windowed samples strictly above ``threshold``

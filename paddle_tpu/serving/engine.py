@@ -380,8 +380,14 @@ class DecodeEngine:
 
     def _enqueue(self, req: EngineRequest) -> EngineRequest:
         """Shared submit tail: queue the request and open its trace
-        track (async ``request`` slice + nested ``queued`` slice)."""
-        req.trace_id = f"eng{self._engine_id}.r{req.rid}"
+        track (async ``request`` slice + nested ``queued`` slice).
+        A caller-supplied trace id (``submit(trace=...)`` — the fleet
+        router propagating its fleet-unique context over the serve
+        wire) is adopted verbatim so the engine's lifecycle events join
+        the router's ``route``/``place`` spans in one merged timeline;
+        otherwise the engine mints its own per-process id."""
+        if not req.trace_id:
+            req.trace_id = f"eng{self._engine_id}.r{req.rid}"
         self._queue.append(req)
         self._m_requests.inc()
         self._m_queue.set(len(self._queue))
@@ -461,12 +467,14 @@ class DecodeEngine:
 
     def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
                top_k: int = 0, eos_id: Optional[int] = None,
-               tenant: str = "default", tier: str = "batch"
-               ) -> EngineRequest:
+               tenant: str = "default", tier: str = "batch",
+               trace: Optional[str] = None) -> EngineRequest:
         """Queue one request; returns its (live) EngineRequest record.
         ``tenant``/``tier`` ride into the request log and trace events;
-        the row-arena engine schedules FIFO regardless (tiered
-        admission and preemption live in :class:`PagedDecodeEngine`)."""
+        ``trace`` adopts a caller-provided trace id (fleet propagation)
+        instead of minting ``eng<N>.r<rid>``. The row-arena engine
+        schedules FIFO regardless (tiered admission and preemption live
+        in :class:`PagedDecodeEngine`)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         rid = next(self._ids)
         self._validate_submit(rid, prompt, max_new, tier)
@@ -488,8 +496,49 @@ class DecodeEngine:
             rid=rid, prompt=prompt, max_new=int(max_new),
             temperature=float(temperature), top_k=int(top_k),
             eos_id=eos_id, tenant=str(tenant), tier=str(tier),
-            bucket=bucket, submit_t=time.perf_counter())
+            bucket=bucket, submit_t=time.perf_counter(),
+            trace_id=str(trace) if trace else "")
         return self._enqueue(req)
+
+    def abort_requests(self, reason: str = "replica_killed") -> int:
+        """Close every live request's open trace slices (``queued`` /
+        ``prefill`` / ``decode`` / ``request``) with an ``aborted``
+        marker and drop the work. This is the IN-PROCESS analogue of
+        the replica process dying: a real SIGKILL takes its span buffer
+        with it (the merged fleet trace simply never sees the dead
+        attempt), but an in-process fleet shares one buffer, so a kill
+        simulation must close what the dead attempt opened or the
+        joined trace shows unbalanced slices. Trace-level only — block
+        /slot accounting is abandoned, not released, exactly like a
+        dead process; do not reuse the engine afterwards."""
+        now = time.perf_counter()
+        aborted: List[EngineRequest] = []
+        for req in list(self._queue):
+            self._ev(req, "queued", "e", now)
+            aborted.append(req)
+        # preempted-to-blocks requests (paged engine) already closed
+        # their prefill/decode slices at preemption
+        aborted.extend(list(getattr(self, "_preempted", ())))
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            if req.first_token_t is None:
+                self._ev(req, "prefill", "e", now)
+            if req.decode_open:
+                self._ev(req, "decode", "e", now)
+                req.decode_open = False
+            self._active[slot] = False
+            self._slot_req[slot] = None
+            aborted.append(req)
+        for req in aborted:
+            req.status, req.finish_reason = "aborted", reason
+            self._ev(req, "aborted", "n", now, reason=reason)
+            self._ev(req, "request", "e", now)
+        self._queue.clear()
+        if hasattr(self, "_preempted"):
+            self._preempted.clear()
+        self._m_queue.set(0)
+        return len(aborted)
 
     @property
     def active_count(self) -> int:
@@ -712,7 +761,13 @@ class DecodeEngine:
             "ttft_p50_s": round(ttft[0.5], 6),
             "ttft_p95_s": round(ttft[0.95], 6),
             "ttft_p99_s": round(ttft[0.99], 6),
-            "tokens_per_sec_p50": round(self._win_tps.quantile(0.5), 3)}
+            "tokens_per_sec_p50": round(self._win_tps.quantile(0.5), 3),
+            # raw windowed TTFT samples in clock-free [age_s, value]
+            # form (newest 512): the fleet aggregator POOLS these for
+            # its fleet quantiles — per-replica quantiles cannot be
+            # averaged (see WindowedQuantiles.samples)
+            "ttft_samples": [[round(a, 4), round(v, 6)] for a, v in
+                             self._win_ttft.export_samples()[-512:]]}
         if self._win_ttft_tier:
             doc["window"]["tiers"] = {
                 tier: {"requests": win.count(),
@@ -1039,8 +1094,8 @@ class PagedDecodeEngine(DecodeEngine):
 
     def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
                top_k: int = 0, eos_id: Optional[int] = None,
-               tenant: str = "default", tier: str = "batch"
-               ) -> EngineRequest:
+               tenant: str = "default", tier: str = "batch",
+               trace: Optional[str] = None) -> EngineRequest:
         """Queue one request. Unlike the row-arena engine there is no
         largest-bucket rejection: any prompt with
         ``len(prompt) + max_new <= cache_len`` is accepted and prefilled
@@ -1080,7 +1135,8 @@ class PagedDecodeEngine(DecodeEngine):
             rid=rid, prompt=prompt, max_new=int(max_new),
             temperature=float(temperature), top_k=int(top_k),
             eos_id=eos_id, tenant=str(tenant), tier=str(tier),
-            bucket=0, submit_t=time.perf_counter())
+            bucket=0, submit_t=time.perf_counter(),
+            trace_id=str(trace) if trace else "")
         return self._enqueue(req)
 
     # -- P/D disaggregation (KV transfer over the fleet wire) -------------
@@ -1099,7 +1155,8 @@ class PagedDecodeEngine(DecodeEngine):
         return _blocks.prompt_block_hashes(prompt,
                                            self.block_size)[:usable]
 
-    def export_prefix(self, prompt) -> Optional[bytes]:
+    def export_prefix(self, prompt,
+                      trace: Optional[str] = None) -> Optional[bytes]:
         """Serialize ``prompt``'s transferable prefix out of this pool
         — the prefill half of P/D disaggregation. Every prefix block
         must already be published (run the prompt through the scheduler
@@ -1119,7 +1176,8 @@ class PagedDecodeEngine(DecodeEngine):
                 return None
             blk.append(b)
         payload = _transfer.serialize_blocks(
-            self.cache, blk, digests, self.block_size, self.kv_dtype)
+            self.cache, blk, digests, self.block_size, self.kv_dtype,
+            trace=trace)
         self._m_kv_exported.inc(len(blk))
         return payload
 
@@ -1173,6 +1231,16 @@ class PagedDecodeEngine(DecodeEngine):
                                             self.block_size)
         if n:
             self._m_kv_imported.inc(n)
+        if meta.get("trace"):
+            # the payload header carried the fleet trace context across
+            # the P/D hop: mark the adoption on that track, so the
+            # disaggregated prefill→decode handoff is one connected
+            # timeline (the request's prefix_adopt hit follows at
+            # admission)
+            _chrome.record_event(
+                "prefix_import", self._wall(time.perf_counter()), "n",
+                str(meta["trace"]),
+                args={"blocks": n, "chain": len(blocks)})
         return n
 
     @property

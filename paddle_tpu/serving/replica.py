@@ -110,7 +110,8 @@ class EngineLoop:
         self._eof = False
         self._default_max_new = int(default_max_new)
         self._live: Dict[int, Tuple[object, object]] = {}
-        self._exports: Dict[int, Tuple[object, object, np.ndarray]] = {}
+        self._exports: Dict[
+            int, Tuple[object, object, np.ndarray, object]] = {}
 
     # -- ingestion (any thread) -------------------------------------------
     def feed(self, line, reply):
@@ -184,7 +185,8 @@ class EngineLoop:
             top_k=int(r.get("top_k", 0)),
             eos_id=r.get("eos_id"),
             tenant=str(r.get("tenant", "default")),
-            tier=str(r.get("tier", "batch")))
+            tier=str(r.get("tier", "batch")),
+            trace=r.get("trace"))
         self._live[req.rid] = (reply, r.get("id", req.rid))
 
     def _op_export(self, r: dict, reply):
@@ -198,16 +200,19 @@ class EngineLoop:
             reply.write({"id": xid, "op": "export_prefix",
                          "payload": None, "blocks": 0})
             return
-        payload = eng.export_prefix(prompt)
+        payload = eng.export_prefix(prompt, trace=r.get("trace"))
         if payload is not None:      # prefix already hot: serialize now
             reply.write(self._export_doc(xid, payload, len(digests)))
             return
         # cold: run the prompt through the ordinary scheduler (its
         # chunks publish into the prefix cache as each one lands, and
         # interleave with in-flight decode like any admission); the
-        # payload serializes when the warm-up request finishes
-        req = eng.submit(prompt, 1)
-        self._exports[req.rid] = (reply, xid, prompt)
+        # payload serializes when the warm-up request finishes. The
+        # warm-up request adopts the wire trace id so the prefill half
+        # of a disaggregated handoff joins the same fleet timeline as
+        # the decode half.
+        req = eng.submit(prompt, 1, trace=r.get("trace"))
+        self._exports[req.rid] = (reply, xid, prompt, r.get("trace"))
 
     @staticmethod
     def _export_doc(xid, payload: bytes, blocks: int) -> dict:
@@ -225,8 +230,8 @@ class EngineLoop:
 
     def _finish(self, req):
         if req.rid in self._exports:
-            reply, xid, prompt = self._exports.pop(req.rid)
-            payload = self.eng.export_prefix(prompt)
+            reply, xid, prompt, trace = self._exports.pop(req.rid)
+            payload = self.eng.export_prefix(prompt, trace=trace)
             if payload is None:
                 # evicted under pool pressure before serialization: the
                 # requester falls back to a cold prefill (slower, same
@@ -424,23 +429,50 @@ class EngineReplica:
         self.name = str(name)
         self._loop = EngineLoop(eng, default_max_new=default_max_new)
         self._reply = ListReply()
+        self._killed = False
 
     def submit(self, spec: dict):
+        if self._killed:
+            return
         self._loop.feed(dict(spec), self._reply)
 
     def pump(self):
         """Advance the wrapped engine by one scheduler step."""
-        self._loop.step_once()
+        if not self._killed:
+            self._loop.step_once()
 
     def poll(self) -> List[dict]:
+        if self._killed:
+            return []
         docs, self._reply.docs = self._reply.docs, []
         return docs
 
-    def health(self) -> dict:
-        return self.eng.health()
+    def health(self) -> Optional[dict]:
+        return None if self._killed else self.eng.health()
 
     def alive(self) -> bool:
-        return True
+        return not self._killed
+
+    def kill(self):
+        """Simulate process death (chaos tests, the bench's kill
+        injection): the handle goes deaf — ``alive()`` False, submits
+        dropped, results undeliverable — and the wrapped engine closes
+        its live requests' open trace slices (``abort_requests``) the
+        way a real SIGKILL loses them with the process's span buffer.
+        The router's requeue path sees exactly what a dead socket
+        shows it."""
+        self._killed = True
+        if hasattr(self.eng, "abort_requests"):
+            self.eng.abort_requests()
+
+    def metrics_snapshot(self) -> Optional[dict]:
+        """The engine registry's snapshot dict — the fleet aggregator's
+        in-process scrape source (the TCP handle parses `/metrics`
+        text into the same shape)."""
+        if self._killed:
+            return None
+        self.eng._update_window_gauges()
+        return self.eng.metrics.snapshot()
 
     @property
     def idle(self) -> bool:
@@ -515,6 +547,22 @@ class SocketReplica:
                 return json.loads(e.read())   # 503 carries the
             except (ValueError, OSError):     # unhealthy doc
                 return {"status": "unhealthy"}
+        except Exception:
+            return None
+
+    def metrics_snapshot(self) -> Optional[dict]:
+        """Scrape the replica process's `/metrics` into the registry
+        snapshot shape (``observe.metrics.parse_prometheus``) — the
+        fleet aggregator's TCP scrape source. ``None`` when the
+        endpoint is unreachable (the aggregator keeps the last view)."""
+        if self.health_url is None:
+            return None
+        import urllib.request
+        from paddle_tpu.observe.metrics import parse_prometheus
+        url = self.health_url.rstrip("/") + "/metrics"
+        try:
+            with urllib.request.urlopen(url, timeout=2.0) as resp:
+                return parse_prometheus(resp.read().decode("utf-8"))
         except Exception:
             return None
 

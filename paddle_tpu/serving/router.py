@@ -50,18 +50,27 @@ in-flight gauges, fleet TTFT windows + SLO burn), a request log, and
 import dataclasses
 import itertools
 import logging
+import os
 import time
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from paddle_tpu.observe import alerts as _alerts
+from paddle_tpu.observe import chrome_trace as _chrome
+from paddle_tpu.observe import fleet as _fleet
 from paddle_tpu.observe import metrics as _metrics
 from paddle_tpu.observe import requests as _requests
 from paddle_tpu.observe.window import SloConfig, WindowedQuantiles
 from paddle_tpu.serving import blocks as _blocks
 
 logger = logging.getLogger(__name__)
+
+# routers minted per process: the trace-id prefix bakes in pid +
+# instance so every fleet request id is unique across the whole
+# multi-process trace merge (two routers can NEVER collide)
+_ROUTER_IDS = itertools.count()
 
 _LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                     0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
@@ -121,6 +130,7 @@ class RouterRequest:
     error: Optional[str] = None
     requeues: int = 0           # dead-replica recoveries
     placements: int = 0
+    trace_id: str = ""          # fleet-unique; replicas adopt it
     submit_t: float = 0.0
     placed_t: Optional[float] = None
     finish_t: Optional[float] = None
@@ -203,7 +213,10 @@ class Router:
                  max_in_flight: int = 8, health_poll_s: float = 0.25,
                  hot_digests: int = 4096,
                  registry: Optional[_metrics.Registry] = None,
-                 slo: Optional[SloConfig] = None):
+                 slo: Optional[SloConfig] = None,
+                 trace: bool = True, aggregate: bool = True,
+                 fleet_jsonl: Optional[str] = None,
+                 alert_rules: Optional[Sequence] = None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         bs, chunk = int(block_size), int(chunk_tokens)
@@ -296,21 +309,59 @@ class Router:
             "router_pd_errors_total", "P/D transfer ops a replica "
             "refused, by op (export = colocated fallback; import = "
             "cold prefill on the decode replica — same bits, slower)")
+        self._m_hit_rate = reg.gauge(
+            "router_placement_hit_rate", "fraction of generate "
+            "placements that landed on a replica with a hot "
+            "leading-digest run — the prefix-hit-rate alert's input")
         for st in self._all:
             self._m_state.set(_STATE_RANK[st.state], replica=st.name)
+        # -- fleet observability plane ------------------------------------
+        # trace propagation: every accepted request gets a FLEET-unique
+        # trace id (pid + router instance + xid) stamped onto the serve
+        # wire; replicas adopt it, so their engine lifecycle events join
+        # under the router's route/queue/place spans when the per-
+        # process exports merge on pid (observe.trace_export)
+        self.trace_requests = bool(trace)
+        self._trace_prefix = f"fleet{os.getpid()}.{next(_ROUTER_IDS)}"
+        self._wall_anchor = time.time() - time.perf_counter()
+        # metrics aggregation + alerts: the aggregator writes into THIS
+        # registry, so one /metrics scrape answers for the whole fleet;
+        # the evaluator runs over the same registry per scrape round
+        self.aggregate = bool(aggregate)
+        self.fleet = _fleet.FleetAggregator(
+            registry=reg, window_s=win, jsonl_path=fleet_jsonl)
+        self.alerts = _alerts.AlertEvaluator(
+            reg, alert_rules if alert_rules is not None
+            else _alerts.default_fleet_rules())
+        self._scrape_t = -1e9
+
+    # -- trace propagation -------------------------------------------------
+    def _rev(self, req: RouterRequest, name: str, ph: str,
+             perf_t: float, **args):
+        """One router-side lifecycle event on the request's FLEET
+        trace track (same cat/id as the replica engine's events, so
+        the merged export renders one connected tree)."""
+        if req.trace_id:
+            _chrome.record_event(name, self._wall_anchor + perf_t, ph,
+                                 req.trace_id, args=args or None)
 
     # -- request API -------------------------------------------------------
     def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
                top_k: int = 0, eos_id: Optional[int] = None,
                tenant: str = "default", tier: str = "batch"
                ) -> RouterRequest:
-        """Queue one fleet request; placement happens in ``step()``."""
+        """Queue one fleet request; placement happens in ``step()``.
+        The request is stamped with a fleet-unique trace id; its
+        ``route`` slice (the router-side root of the whole cross-
+        process request tree) opens here and closes at completion."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         req = RouterRequest(
             xid=next(self._ids), prompt=prompt, max_new=int(max_new),
             temperature=float(temperature), top_k=int(top_k),
             eos_id=eos_id, tenant=str(tenant), tier=str(tier),
             submit_t=time.perf_counter())
+        if self.trace_requests:
+            req.trace_id = f"{self._trace_prefix}.r{req.xid}"
         req.digests = _blocks.prompt_block_hashes(prompt,
                                                   self.block_size)
         per = self.chunk_tokens // self.block_size
@@ -321,6 +372,10 @@ class Router:
         self._requests[req.xid] = req
         self._m_requests.inc()
         self._m_queue.set(len(self._queue))
+        self._rev(req, "route", "b", req.submit_t, xid=req.xid,
+                  prompt_tokens=int(prompt.size), max_new=req.max_new,
+                  tenant=req.tenant, tier=req.tier)
+        self._rev(req, "queue", "b", req.submit_t)
         return req
 
     @property
@@ -358,7 +413,11 @@ class Router:
             if st.state != "dead":
                 st.handle.pump()
         finished = self._collect()
-        self._poll_health(time.perf_counter())
+        now = time.perf_counter()
+        self._poll_health(now)
+        if self.aggregate and now - self._scrape_t >= self._health_poll_s:
+            self._scrape_t = now
+            self._scrape()
         self._place()
         self._update_gauges()
         return finished
@@ -429,6 +488,12 @@ class Router:
         self._set_state(st, "unhealthy")    # stop placing here; the
         #                                     health poll re-promotes a
         #                                     replica that recovers
+        now = time.perf_counter()
+        self._rev(req, "requeue", "n", now, reason="drain",
+                  replica=st.name, requeues=req.requeues)
+        self._rev(req, "queue", "b", now)   # waiting again: the queue
+        #                                     slice re-opens on the SAME
+        #                                     trace — one connected tree
         self._queue.appendleft(req)
 
     def _on_export(self, st, req: RouterRequest, doc: dict):
@@ -480,6 +545,10 @@ class Router:
                 self._win_ttft.observe(ttft)
             if req.latency_s and req.tokens:
                 self._win_tps.observe(len(req.tokens) / req.latency_s)
+        self._rev(req, "route", "e", now,
+                  reason=req.finish_reason or "error",
+                  tokens=len(req.tokens), requeues=req.requeues,
+                  replica=req.replica)
         self._record_request(req)
 
     def _record_request(self, req: RouterRequest):
@@ -488,7 +557,7 @@ class Router:
 
         self.request_log.add({
             "rid": req.xid, "engine": "router",
-            "trace_id": f"router.r{req.xid}",
+            "trace_id": req.trace_id or f"router.r{req.xid}",
             "finish_reason": req.finish_reason if req.error is None
             else f"rejected:{req.error[:80]}",
             "tenant": req.tenant, "tier": req.tier,
@@ -549,6 +618,7 @@ class Router:
         st.state = "dead"
         self._m_state.set(0, replica=st.name)
         self._m_drains.inc(reason="dead")
+        now = time.perf_counter()
         requeue: List[RouterRequest] = []
         for xid, (req, kind) in list(st.outstanding.items()):
             st.outstanding.pop(xid)
@@ -561,11 +631,80 @@ class Router:
             # restarts the whole flow — survivors may have the prefix
             # hot anyway
             req.payload, req.payload_blocks = None, 0
+            self._rev(req, "requeue", "n", now, reason="dead",
+                      replica=st.name, requeues=req.requeues)
+            self._rev(req, "queue", "b", now)
             requeue.append(req)
         if requeue:
             self._m_requeued.inc(len(requeue))
             for req in reversed(requeue):
                 self._queue.appendleft(req)
+        # the fleet flight hook: bundle the dead member's last-known
+        # state with the router's view into one post-mortem artifact
+        # (only when a flight dir is configured — tests and notebooks
+        # must not litter; same gate as the trainer's crash dumps)
+        self.fleet.drop_replica(st.name)
+        from paddle_tpu.observe import flight as _flight
+        if _flight.configured():
+            _fleet.death_postmortem(
+                st.name, router_view=self.health(),
+                last_health=st.last_health,
+                outstanding=[{"xid": r.xid, "requeues": r.requeues,
+                              "trace": r.trace_id} for r in requeue],
+                alerts=self.alerts.firing())
+
+    # -- fleet aggregation -------------------------------------------------
+    def _scrape(self):
+        """One aggregation round on the health-poll cadence: ingest
+        every live replica's registry snapshot + last health doc into
+        the fleet aggregator (it writes into THIS registry), refresh
+        the derived fleet gauges, then run the alert rules over the
+        result. Dead replicas still report their router-side state so
+        ``fleet_replicas{state="dead"}`` counts them."""
+        for st in self._all:
+            snapshot = None
+            if st.state != "dead":
+                fn = getattr(st.handle, "metrics_snapshot", None)
+                if fn is not None:
+                    try:
+                        snapshot = fn()
+                    except Exception:
+                        snapshot = None
+            self.fleet.observe_replica(
+                st.name, state=st.state,
+                health=st.last_health or None, snapshot=snapshot)
+        self.fleet.finish_scrape()
+        self._update_gauges()
+        self._update_window_gauges()    # burn gauge feeds the TTFT rule
+        self.alerts.evaluate()
+
+    def remove_replica(self, name: str):
+        """Administratively retire a replica: forget its per-replica
+        gauge series and aggregator state so fleet counts (and the
+        dead-replica alert) reflect the intended fleet, not history.
+        The admin surface a future autoscaler's scale-down uses; any
+        in-flight work is requeued first via the dead path."""
+        st = next((s for s in self._all if s.name == name), None)
+        if st is None:
+            raise KeyError(f"no replica named {name!r}")
+        self._mark_dead(st)
+        self._all.remove(st)
+        if st in self._decode:
+            self._decode.remove(st)
+        if st in self._prefill:
+            self._prefill.remove(st)
+        if not self._decode:
+            raise RuntimeError("removed the last decode replica: the "
+                               "router can no longer place work")
+        self.fleet.drop_replica(name)
+        self.fleet.forget_state(name)
+        for g in (self._m_state, self._m_in_flight,
+                  self._m_replica_queue):
+            g.remove(replica=name)
+        try:
+            st.handle.close()
+        except Exception:
+            pass
 
     # -- placement ---------------------------------------------------------
     def _place(self):
@@ -585,12 +724,18 @@ class Router:
                 and not self._hot_anywhere(req)):
             st = self._pick_prefill()
             if st is not None:
-                st.handle.submit({
-                    "id": req.xid, "op": "export_prefix",
-                    "prompt": [int(t) for t in req.prompt]})
+                spec = {"id": req.xid, "op": "export_prefix",
+                        "prompt": [int(t) for t in req.prompt]}
+                if req.trace_id:
+                    # the P/D hop joins the same fleet trace: the
+                    # prefill replica's engine spans land on this id
+                    spec["trace"] = req.trace_id
+                st.handle.submit(spec)
                 st.outstanding[req.xid] = (req, "export")
                 req.status = "prefill"
                 req.prefill_replica = st.name
+                self._rev(req, "place", "n", time.perf_counter(),
+                          kind="export", replica=st.name)
                 return True
             # no prefill capacity: colocated fallback — correctness
             # (and latency) must not wait on the prefill tier
@@ -643,17 +788,26 @@ class Router:
             # ship the KV ahead of the generate op on the same ordered
             # connection: the import lands before admission runs
             iid = f"imp{req.xid}.{req.placements}"
-            st.handle.submit({"id": iid, "op": "import_prefix",
-                              "payload": req.payload})
+            imp = {"id": iid, "op": "import_prefix",
+                   "payload": req.payload}
+            if req.trace_id:
+                imp["trace"] = req.trace_id
+            st.handle.submit(imp)
             st.outstanding[iid] = (req, "import")
             st.mark_hot(req.digests[:req.payload_blocks])
             score = max(score, req.payload_blocks)
             req.payload = None
-        st.handle.submit({
+        spec = {
             "id": req.xid, "prompt": [int(t) for t in req.prompt],
             "max_new": req.max_new, "temperature": req.temperature,
             "top_k": req.top_k, "eos_id": req.eos_id,
-            "tenant": req.tenant, "tier": req.tier})
+            "tenant": req.tenant, "tier": req.tier}
+        if req.trace_id:
+            # the replica engine ADOPTS this id (its _enqueue only
+            # mints one when the wire didn't carry one), so its
+            # queued/prefill/decode spans join this very track
+            spec["trace"] = req.trace_id
+        st.handle.submit(spec)
         st.outstanding[req.xid] = (req, "generate")
         req.status, req.replica = "placed", st.name
         req.placed_t = time.perf_counter()
@@ -663,6 +817,10 @@ class Router:
         if score > 0:
             self._m_place_hits.inc()
         st.mark_hot(usable)
+        self._rev(req, "queue", "e", req.placed_t)
+        self._rev(req, "place", "n", req.placed_t, kind="generate",
+                  replica=st.name, prefix_score=score,
+                  placements=req.placements)
         return True
 
     # -- observability -----------------------------------------------------
@@ -681,6 +839,7 @@ class Router:
             qd = (st.last_health or {}).get("queue_depth")
             if qd is not None:
                 self._m_replica_queue.set(qd, replica=st.name)
+        self._m_hit_rate.set(self.placement_hit_rate())
 
     def _update_window_gauges(self):
         ttft = self._win_ttft.quantiles((0.5, 0.95, 0.99))
@@ -703,6 +862,14 @@ class Router:
                     "in_flight": st.in_flight,
                     "queue_depth": (st.last_health or {}).get(
                         "queue_depth"),
+                    "slots_active": (st.last_health or {}).get(
+                        "slots_active"),
+                    "blocks_in_use": (st.last_health or {}).get(
+                        "blocks_in_use"),
+                    "blocks_total": (st.last_health or {}).get(
+                        "blocks_total"),
+                    "ttft_p99_s": ((st.last_health or {}).get("window")
+                                   or {}).get("ttft_p99_s"),
                     "slo_burn": ((st.last_health or {}).get("slo")
                                  or {}).get("ttft_burn_rate")}
                 for st in self._all},
@@ -711,9 +878,12 @@ class Router:
             "completed": self._n_completed,
             "requeued": int(self._m_requeued.value()),
             "placement_hit_rate": round(self.placement_hit_rate(), 4),
+            "alerts_firing": self.alerts.firing(),
             "window": {"ttft_p50_s": round(ttft[0.5], 6),
                        "ttft_p99_s": round(ttft[0.99], 6),
-                       "requests": self._win_ttft.count()}}
+                       "requests": self._win_ttft.count(),
+                       "fleet_ttft_p99_s": round(
+                           self.fleet.ttft_quantile(0.99), 6)}}
         decode_live = [st for st in self._decode
                        if st.state in ("ok", "degraded")]
         if not decode_live:
@@ -740,13 +910,16 @@ class Router:
         return self.metrics.render_prometheus()
 
     def serve(self, host: str = "127.0.0.1", port: int = 0):
-        """/metrics + /healthz + /requests over the router registry;
-        caller owns ``close()``."""
+        """/metrics + /healthz + /requests + /alerts over the router
+        registry (the aggregator writes fleet series into it, so this
+        one scrape answers for the whole fleet); caller owns
+        ``close()``."""
         from paddle_tpu.observe.health import HealthServer
         return HealthServer(registry=self.metrics, health_fn=self.health,
                             host=host, port=port,
                             requests_fn=self.requests_doc,
-                            metrics_fn=self.metrics_text)
+                            metrics_fn=self.metrics_text,
+                            alerts_fn=self.alerts.doc)
 
     def close(self):
         for st in self._all:
@@ -754,3 +927,4 @@ class Router:
                 st.handle.close()
             except Exception:
                 pass
+        self.fleet.close()

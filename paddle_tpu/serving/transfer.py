@@ -78,14 +78,21 @@ def pool_meta(cache, block_size: int, kv_dtype: str = "none") -> dict:
 
 def serialize_blocks(cache, block_ids: Sequence[int],
                      digests: Sequence[bytes], block_size: int,
-                     kv_dtype: str = "none") -> bytes:
+                     kv_dtype: str = "none",
+                     trace: Optional[str] = None) -> bytes:
     """Pack ``block_ids``'s pool rows (chain order, one digest per
-    block) into one stamped payload."""
+    block) into one stamped payload. ``trace`` rides in the header so
+    the fleet trace context survives the P/D hop INSIDE the payload —
+    the importing replica emits its adoption event on the same track
+    even when the payload is relayed through a router that did not
+    stamp the wire op."""
     if len(block_ids) != len(digests):
         raise ValueError(f"{len(block_ids)} blocks vs "
                          f"{len(digests)} digests")
     meta = pool_meta(cache, block_size, kv_dtype)
     meta["digests"] = [bytes(d).hex() for d in digests]
+    if trace:
+        meta["trace"] = str(trace)
     names = [n for n in ARRAY_ORDER if n in meta["arrays"]]
     header = json.dumps(meta).encode("utf-8")
     out = [MAGIC, struct.pack("<II", VERSION, len(header)), header]

@@ -1,0 +1,533 @@
+"""Fleet observability plane: cross-process trace propagation, the
+router-side metrics aggregator, declarative alert rules, and the `top`
+status surfaces.
+
+The contracts pinned here:
+
+- a fleet request's lifecycle is ONE connected trace tree — router
+  route/queue/place spans and the replica engine's queued/prefill/
+  decode spans join on the same (cat, id) track, across the in-process
+  AND the TCP transport, and across a kill-and-requeue (the requeued
+  request re-joins its original trace id: balanced b/e, exactly one
+  router-side `route` root, no orphan open slices);
+- fleet quantiles come from POOLED raw samples, never averaged
+  per-replica quantiles (merged == pooled is asserted bit-exactly);
+- counters aggregate as reset-safe per-replica deltas; gauges keep
+  their replica label;
+- alert rules debounce with for-duration semantics and emit a
+  firing→resolved event pair (trace slice + counter + /alerts log) —
+  including the dead-replica rule across a kill + admin removal.
+"""
+
+import json
+import math
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observe
+from paddle_tpu.observe import metrics as metrics_mod
+from paddle_tpu.observe.alerts import (AlertEvaluator, AlertRule,
+                                       default_fleet_rules)
+from paddle_tpu.observe.fleet import FleetAggregator
+from paddle_tpu.observe.window import WindowedQuantiles
+from paddle_tpu.serving.replica import (EngineReplica, ReplicaServer,
+                                        SocketReplica)
+from paddle_tpu.serving.router import Router
+
+
+@pytest.fixture(autouse=True)
+def _reset_observe():
+    observe.reset()
+    yield
+    observe.reset()
+
+
+# -- tiny shared model (same recipe as test_fleet.py) -----------------------
+
+def _cfg():
+    import jax.numpy as jnp
+    from paddle_tpu.models import transformer
+    return transformer.TransformerConfig(
+        vocab=40, d_model=16, n_heads=2, n_kv_heads=1, n_layers=2,
+        d_ff=32, max_len=64, dtype=jnp.float32, use_rope=True)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    import jax
+    from paddle_tpu.models import transformer
+    cfg = _cfg()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+_PROGRAMS = {}
+
+
+def _mk_engine(lm, *, batch=2, num_blocks=16):
+    import jax
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving import PagedDecodeEngine, sampling
+    params, cfg = lm
+    if not _PROGRAMS:
+        pf, df = sampling.paged_step_fns(cfg, 8, pallas="off")
+        _PROGRAMS["fns"] = (jax.jit(pf), jax.jit(df))
+    jpf, jdf = _PROGRAMS["fns"]
+    pool = transformer.init_block_pool(cfg, num_blocks, 8)
+    return PagedDecodeEngine(
+        jpf, jdf, params, pool, batch=batch, cache_len=64,
+        block_size=8, num_blocks=num_blocks, chunk_tokens=16, seed=0,
+        decode_flops=None, pallas_mode="off")
+
+
+def _prompts(n=4, seed=3, vocab=40):
+    rng = np.random.RandomState(seed)
+    shared = rng.randint(0, vocab, 24).astype(np.int32)
+    return [np.concatenate([shared, rng.randint(
+        0, vocab, 5 + i).astype(np.int32)]) for i in range(n)]
+
+
+def _tracks(trace):
+    """Group a Chrome-trace export's async events per (cat, id)."""
+    by_id = {}
+    for ev in trace["traceEvents"]:
+        if ev.get("ph") in ("b", "n", "e"):
+            by_id.setdefault((ev["cat"], ev["id"]), []).append(ev)
+    return by_id
+
+
+def _assert_joined(evs, *, requeued=False):
+    """One request's track is a single connected tree: balanced b/e,
+    exactly one router-side `route` root, engine lifecycle present."""
+    names = [(e["name"], e["ph"]) for e in evs]
+    b = sum(1 for e in evs if e["ph"] == "b")
+    e = sum(1 for e in evs if e["ph"] == "e")
+    assert b == e, f"unbalanced b/e: {names}"
+    roots = [ev for ev in evs if ev["name"] == "route"
+             and ev["ph"] == "b"]
+    assert len(roots) == 1, f"want one route root: {names}"
+    flat = [n for n, _ in names]
+    for engine_side in ("queued", "prefill", "decode", "first_token"):
+        assert engine_side in flat, f"missing {engine_side}: {names}"
+    if requeued:
+        assert "requeue" in flat and flat.count("queued") >= 2, names
+
+
+# -- pooled-vs-averaged quantiles -------------------------------------------
+
+def _nearest_rank(sorted_vals, q):
+    """The repo-wide convention (observe.window._nearest_rank)."""
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+class TestWindowMergePooled:
+    def test_merge_equals_pooled(self):
+        """merge() over N windows gives EXACTLY the quantile of the
+        pooled sample multiset — the property that makes fleet
+        quantiles honest."""
+        rng = np.random.RandomState(0)
+        clock = lambda: 100.0  # noqa: E731
+        parts = [[float(v) for v in rng.rand(n)]
+                 for n in (7, 500, 60)]
+        wins = []
+        for vals in parts:
+            w = WindowedQuantiles(window_s=60, clock=clock)
+            for v in vals:
+                w.observe(v)
+            wins.append(w)
+        merged = WindowedQuantiles(window_s=60, clock=clock)
+        merged.merge(*wins)
+        pooled = sorted(v for vals in parts for v in vals)
+        for q in (0.5, 0.9, 0.99):
+            assert merged.quantile(q) == _nearest_rank(pooled, q)
+        assert merged.count() == len(pooled)
+
+    def test_averaging_per_replica_p99_loses_the_tail(self):
+        """The negative space the merge API exists for: a 3-sample
+        replica and a 3000-sample replica averaged per-replica hides
+        the fleet tail; the pooled quantile does not."""
+        clock = lambda: 100.0  # noqa: E731
+        small = WindowedQuantiles(window_s=60, clock=clock)
+        big = WindowedQuantiles(window_s=60, max_samples=4096,
+                                clock=clock)
+        for v in (0.001, 0.001, 0.001):
+            small.observe(v)
+        rng = np.random.RandomState(1)
+        big_vals = [float(v) for v in 0.010 + 0.490 * rng.rand(3000)]
+        for v in big_vals:
+            big.observe(v)
+        averaged = (small.quantile(0.99) + big.quantile(0.99)) / 2
+        merged = WindowedQuantiles(window_s=60, max_samples=8192,
+                                   clock=clock)
+        merged.merge(small, big)
+        truth = _nearest_rank(sorted([0.001] * 3 + big_vals), 0.99)
+        assert merged.quantile(0.99) == truth
+        # the average halves the tail estimate — visibly wrong
+        assert averaged < 0.6 * truth
+
+    def test_export_absorb_roundtrip_across_clock_domains(self):
+        """export_samples() is clock-free [age, value]; absorb()
+        re-stamps into the local clock and drops anything older than
+        the window — the wire form that crosses processes."""
+        src = WindowedQuantiles(window_s=10.0, clock=lambda: 50.0)
+        for v in (0.1, 0.2, 0.3):
+            src.observe(v)
+        aged = src.export_samples()
+        assert all(a == 0.0 for a, _ in aged)
+        dst = WindowedQuantiles(window_s=10.0, clock=lambda: 9999.0)
+        dst.absorb(aged)
+        assert dst.count() == 3
+        assert dst.quantile(0.5) == 0.2
+        # expiry: a sample aged past the window never lands
+        dst2 = WindowedQuantiles(window_s=10.0, clock=lambda: 9999.0)
+        dst2.absorb([[11.0, 0.9], [1.0, 0.4]])
+        assert dst2.count() == 1 and dst2.quantile(0.5) == 0.4
+
+
+# -- prometheus text parsing ------------------------------------------------
+
+class TestParsePrometheus:
+    def test_round_trip(self):
+        reg = metrics_mod.Registry()
+        c = reg.counter("reqs_total", "x")
+        c.inc(3, tenant="a")
+        c.inc(2)
+        reg.gauge("depth", "x").set(7.5, replica="r0")
+        text = reg.render_prometheus()
+        parsed = metrics_mod.parse_prometheus(text)
+        assert parsed["reqs_total"]["kind"] == "counter"
+        got = {tuple(sorted(s["labels"].items())): s["value"]
+               for s in parsed["reqs_total"]["series"]}
+        assert got == {(("tenant", "a"),): 3.0, (): 2.0}
+        (s,) = parsed["depth"]["series"]
+        assert s == {"labels": {"replica": "r0"}, "value": 7.5}
+
+    def test_histogram_sum_count_folded_buckets_skipped(self):
+        reg = metrics_mod.Registry()
+        h = reg.histogram("lat", "x", buckets=(0.1, 1.0))
+        h.observe(0.5)
+        h.observe(2.0)
+        parsed = metrics_mod.parse_prometheus(reg.render_prometheus())
+        assert parsed["lat"]["kind"] == "histogram"
+        (s,) = parsed["lat"]["series"]
+        assert s["count"] == 2.0 and s["sum"] == pytest.approx(2.5)
+        assert "value" not in s
+
+    def test_malformed_lines_skipped(self):
+        text = ("# TYPE ok counter\nok 3\nbroken{ 1\nnot_a_number x\n"
+                "trailing\n")
+        parsed = metrics_mod.parse_prometheus(text)
+        assert parsed["ok"]["series"][0]["value"] == 3.0
+        assert "broken" not in parsed
+
+
+# -- the aggregator ---------------------------------------------------------
+
+def _snap(counter=None, gauge=None):
+    out = {}
+    if counter is not None:
+        out["ctr_total"] = {"kind": "counter", "series": [
+            {"labels": {}, "value": counter}]}
+    if gauge is not None:
+        out["depth"] = {"kind": "gauge", "series": [
+            {"labels": {}, "value": gauge}]}
+    return out
+
+
+class TestFleetAggregator:
+    def test_counters_summed_as_reset_safe_deltas(self):
+        agg = FleetAggregator(clock=lambda: 0.0)
+        agg.observe_replica("a", snapshot=_snap(counter=10))
+        agg.observe_replica("b", snapshot=_snap(counter=5))
+        total = agg.registry.get("fleet_ctr_total")
+        assert total.value() == 15.0
+        # replica 'a' restarts: cumulative drops to 2 — no subtraction
+        agg.observe_replica("a", snapshot=_snap(counter=2))
+        assert total.value() == 15.0
+        agg.observe_replica("a", snapshot=_snap(counter=6))
+        assert total.value() == 19.0
+
+    def test_gauges_keep_replica_label(self):
+        agg = FleetAggregator(clock=lambda: 0.0)
+        agg.observe_replica("a", snapshot=_snap(gauge=3))
+        agg.observe_replica("b", snapshot=_snap(gauge=1))
+        g = agg.registry.get("fleet_depth")
+        assert g._peek({"replica": "a"}).value == 3.0
+        assert g._peek({"replica": "b"}).value == 1.0
+
+    def test_pooled_ttft_with_scrape_drift(self):
+        t = [100.0]
+        agg = FleetAggregator(window_s=60.0, clock=lambda: t[0])
+        agg.observe_replica("a", health={"window": {"ttft_samples": [
+            [0.5, 0.010], [1.0, 0.020]]}})
+        t[0] = 130.0    # 30s later; samples age with the drift
+        agg.observe_replica("b", health={"window": {"ttft_samples": [
+            [0.2, 0.100]]}})
+        pool = agg.pooled_ttft()
+        assert pool.count() == 3
+        assert pool.quantile(0.99) == 0.100
+        t[0] = 161.0    # replica a's samples now ~31+30s old: expired
+        assert agg.pooled_ttft().count() == 1
+        # the latest export REPLACES (re-observing must not duplicate)
+        t[0] = 162.0
+        agg.observe_replica("b", health={"window": {"ttft_samples": [
+            [0.1, 0.100]]}})
+        assert agg.pooled_ttft().count() == 1
+
+    def test_finish_scrape_gauges_and_states(self):
+        agg = FleetAggregator(clock=lambda: 0.0)
+        agg.observe_replica("a", state="ok", health={"window": {
+            "ttft_samples": [[0.1, 0.05]]}})
+        agg.observe_replica("b", state="dead")
+        doc = agg.finish_scrape()
+        assert doc["replicas"] == {"a": "ok", "b": "dead"}
+        reps = agg.registry.get("fleet_replicas")
+        assert reps._peek({"state": "ok"}).value == 1
+        assert reps._peek({"state": "dead"}).value == 1
+        q = agg.registry.get("fleet_ttft_window_seconds")
+        assert q._peek({"q": "p99"}).value == pytest.approx(0.05)
+        # forget_state removes the dead member from the census
+        agg.forget_state("b")
+        agg.finish_scrape()
+        assert reps._peek({"state": "dead"}).value == 0
+
+
+# -- alert rules ------------------------------------------------------------
+
+class TestAlerts:
+    def _reg(self, depth=0.0):
+        reg = metrics_mod.Registry()
+        reg.gauge("router_queue_depth", "x").set(depth)
+        return reg
+
+    def test_for_duration_debounce(self):
+        reg = self._reg(10)
+        ev = AlertEvaluator(reg, [AlertRule(
+            "q", metric="router_queue_depth", op=">", threshold=5,
+            for_s=2.0)])
+        assert ev.evaluate(now=0.0) == []            # pending
+        assert ev.evaluate(now=1.9) == []            # still pending
+        (fired,) = ev.evaluate(now=2.0)
+        assert fired["event"] == "firing" and fired["value"] == 10.0
+        assert ev.firing()[0]["rule"] == "q"
+        reg.get("router_queue_depth").set(0)
+        (res,) = ev.evaluate(now=3.0)
+        assert res["event"] == "resolved"
+        assert ev.firing() == []
+        # a one-poll spike never pages
+        reg.get("router_queue_depth").set(10)
+        ev.evaluate(now=4.0)
+        reg.get("router_queue_depth").set(0)
+        assert ev.evaluate(now=10.0) == []
+        assert ev._m_transitions.value(rule="q", event="firing") == 1
+
+    def test_min_samples_gates_ratio_rules(self):
+        reg = self._reg()
+        reg.gauge("hit_rate", "x").set(0.0)
+        ctr = reg.counter("placements_total", "x")
+        ev = AlertEvaluator(reg, [AlertRule(
+            "cold", metric="hit_rate", op="<", threshold=0.2,
+            samples_metric="placements_total", min_samples=20)])
+        assert ev.evaluate(now=0.0) == []            # 0 placements
+        ctr.inc(25)
+        (fired,) = ev.evaluate(now=1.0)
+        assert fired["event"] == "firing"
+
+    def test_missing_metric_is_not_breached(self):
+        ev = AlertEvaluator(metrics_mod.Registry(), [AlertRule(
+            "ghost", metric="does_not_exist", op=">", threshold=0)])
+        assert ev.evaluate(now=0.0) == []
+        assert ev.doc()["rules"][0]["state"] == "inactive"
+
+    def test_transitions_emit_trace_slices(self):
+        reg = self._reg(10)
+        ev = AlertEvaluator(reg, [AlertRule(
+            "q", metric="router_queue_depth", op=">", threshold=5)])
+        ev.evaluate(now=0.0)
+        reg.get("router_queue_depth").set(0)
+        ev.evaluate(now=1.0)
+        evs = _tracks(observe.trace_export()).get(("alert", "alert.q"))
+        assert [e["ph"] for e in evs] == ["b", "e"]
+        assert evs[0]["args"]["event"] == "firing"
+
+    def test_duplicate_rule_names_rejected(self):
+        r = AlertRule("dup", metric="m", op=">", threshold=0)
+        with pytest.raises(ValueError, match="duplicate"):
+            AlertEvaluator(metrics_mod.Registry(), [r, r])
+        with pytest.raises(ValueError, match="op"):
+            AlertRule("bad", metric="m", op="!=", threshold=0)
+
+
+# -- cross-process trace propagation (real engines) -------------------------
+
+class TestTracePropagation:
+    def test_in_process_lifecycle_joins_router_spans(self, lm):
+        """Both transports share the wire contract; the in-process
+        handle: every request's engine spans ride the router-minted
+        fleet trace id — one track, one route root, balanced."""
+        reps = [EngineReplica(_mk_engine(lm), f"r{i}")
+                for i in range(2)]
+        router = Router(reps, block_size=8, chunk_tokens=16,
+                        health_poll_s=0.0)
+        reqs = [router.submit(p, 4) for p in _prompts()]
+        router.run_until_idle()
+        assert all(r.status == "done" for r in reqs)
+        tracks = _tracks(observe.trace_export())
+        for r in reqs:
+            assert r.trace_id.startswith("fleet")
+            _assert_joined(tracks[("request", r.trace_id)])
+        # engine minted NO id of its own for adopted requests: every
+        # request-cat track is fleet-rooted
+        own = [tid for (cat, tid) in tracks
+               if cat == "request" and not tid.startswith("fleet")]
+        assert own == []
+
+    def test_kill_and_requeue_joins_original_trace(self, lm):
+        """The chaos contract, in-process and fast: kill a replica
+        holding placed work; the survivor re-runs it and every span —
+        both placements, the abort, the requeue — lands on the ORIGINAL
+        trace id as one balanced tree."""
+        reps = [EngineReplica(_mk_engine(lm), f"r{i}")
+                for i in range(2)]
+        router = Router(reps, block_size=8, chunk_tokens=16,
+                        health_poll_s=0.0)
+        reqs = [router.submit(p, 4) for p in _prompts()]
+        for _ in range(3):
+            router.step()
+        placed = [r for r in reqs if r.replica is not None]
+        assert placed
+        victim = placed[0].replica
+        next(st.handle for st in router._all
+             if st.name == victim).kill()
+        router.run_until_idle()
+        assert all(r.status == "done" for r in reqs)
+        requeued = [r for r in reqs if r.requeues > 0]
+        assert requeued
+        tracks = _tracks(observe.trace_export())
+        for r in requeued:
+            evs = tracks[("request", r.trace_id)]
+            _assert_joined(evs, requeued=True)
+            # the in-process kill closes the dead placement's open
+            # slices with an abort marker — no orphan tracks
+            assert any(e["name"] == "aborted" for e in evs)
+        # and the death fired the dead-replica alert
+        assert any(a["rule"] == "fleet_dead_replicas"
+                   for a in router.alerts.firing())
+        # admin removal resolves it
+        router.remove_replica(victim)
+        router.step()
+        assert router.alerts.firing() == []
+        events = [(e["rule"], e["event"]) for e in router.alerts.events]
+        assert ("fleet_dead_replicas", "firing") in events
+        assert ("fleet_dead_replicas", "resolved") in events
+
+    def test_tcp_transport_carries_trace(self, lm):
+        """The TCP wire: the router stamps `trace` on the JSONL op; the
+        remote loop adopts it. The server thread shares this process's
+        span buffer, so the join is assertable directly."""
+        import threading
+        srv = ReplicaServer(_mk_engine(lm), port=0)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            h = SocketReplica("r0", ("127.0.0.1", srv.port))
+            router = Router([h], block_size=8, chunk_tokens=16,
+                            health_poll_s=0.0)
+            reqs = [router.submit(p, 3) for p in _prompts(n=2)]
+            deadline = time.time() + 60
+            while (not router.idle and time.time() < deadline):
+                router.step()
+                time.sleep(0.01)
+            assert all(r.status == "done" for r in reqs)
+            tracks = _tracks(observe.trace_export())
+            for r in reqs:
+                _assert_joined(tracks[("request", r.trace_id)])
+        finally:
+            srv.drain()
+            t.join(timeout=30)
+            h.close()
+
+
+# -- endpoints + top --------------------------------------------------------
+
+class TestEndpointsAndTop:
+    def test_router_serve_fleet_surfaces(self, lm):
+        """One /metrics scrape answers for the fleet (replica-labeled
+        gauges + pooled quantile gauges), /alerts serves the evaluator
+        doc, /healthz carries the per-replica `top` columns."""
+        reps = [EngineReplica(_mk_engine(lm), f"r{i}")
+                for i in range(2)]
+        router = Router(reps, block_size=8, chunk_tokens=16,
+                        health_poll_s=0.0)
+        [router.submit(p, 3) for p in _prompts()]
+        router.run_until_idle()
+        srv = router.serve(port=0)
+        try:
+            text = urllib.request.urlopen(
+                srv.url + "/metrics", timeout=5).read().decode()
+            assert "fleet_ttft_window_seconds" in text
+            assert 'fleet_engine_queue_depth{replica="r0"}' in text
+            assert "fleet_engine_requests_total" in text
+            parsed = metrics_mod.parse_prometheus(text)
+            assert parsed["fleet_engine_requests_total"][
+                "series"][0]["value"] == 4.0
+            al = json.loads(urllib.request.urlopen(
+                srv.url + "/alerts", timeout=5).read().decode())
+            assert {r["rule"] for r in al["rules"]} >= {
+                "fleet_dead_replicas", "fleet_queue_depth"}
+            h = json.loads(urllib.request.urlopen(
+                srv.url + "/healthz", timeout=5).read().decode())
+            assert h["alerts_firing"] == []
+            rep = h["replicas"]["r0"]
+            assert {"blocks_in_use", "blocks_total",
+                    "ttft_p99_s"} <= set(rep)
+        finally:
+            srv.close()
+            router.close()
+
+    def test_render_top_frame(self):
+        from paddle_tpu.cli import _render_top
+        health = {
+            "queue_depth": 2, "requests": 10, "completed": 8,
+            "requeued": 1, "placement_hit_rate": 0.75,
+            "window": {"fleet_ttft_p99_s": 0.0123},
+            "replicas": {
+                "r0": {"state": "ok", "role": "decode", "in_flight": 2,
+                       "queue_depth": 1, "blocks_in_use": 5,
+                       "blocks_total": 16, "ttft_p99_s": 0.01,
+                       "slo_burn": 0.5},
+                "r1": {"state": "dead", "role": "decode",
+                       "in_flight": 0, "queue_depth": None,
+                       "blocks_in_use": None, "blocks_total": None,
+                       "ttft_p99_s": None, "slo_burn": None}}}
+        alerts = {"firing": [{"rule": "fleet_dead_replicas",
+                              "value": 1.0, "op": ">=", "threshold": 1,
+                              "description": "a replica died"}]}
+        frame = _render_top(health, alerts)
+        assert "r0" in frame and "5/16" in frame and "dead" in frame
+        assert "fleet_dead_replicas" in frame and "0.0123" in frame
+        empty = _render_top(health, {})
+        assert "alerts: none firing" in empty or "ALERTS" in empty
+
+    def test_job_top_one_frame_over_http(self, lm, capsys):
+        from paddle_tpu import cli
+        reps = [EngineReplica(_mk_engine(lm), "r0")]
+        router = Router(reps, block_size=8, chunk_tokens=16,
+                        health_poll_s=0.0)
+        router.submit(_prompts(n=1)[0], 3)
+        router.run_until_idle()
+        srv = router.serve(port=0)
+        try:
+            rc = cli.main(["top", "--url", srv.url,
+                           "--top_iterations", "1",
+                           "--top_interval_s", "0.05"])
+        finally:
+            srv.close()
+            router.close()
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "REPLICA" in out and "r0" in out

@@ -102,10 +102,17 @@ class TestTransformer:
             hist.append(float(l))
         assert hist[-1] < hist[0] * 0.5, (hist[0], hist[-1])
 
+    @pytest.mark.slow
     def test_spmd_dp_sp_tp_matches_single_device(self, rng):
         """The full 3-axis GSPMD train step must reproduce single-device
         numerics — DP over batch, ring-attention CP over seq, TP over
-        heads/MLP."""
+        heads/MLP.
+
+        `slow`: one of the two observed crash sites of the full-sweep
+        XLA:CPU `backend_compile` segfault — see the root-cause account
+        on test_ring_matches_full_and_kv_grads_grouped below. The
+        grad-of-shard_map compile here (line "g_got = ...") is where
+        the 2026-08-07 sweep died."""
         cfg = transformer.TransformerConfig(
             vocab=50, d_model=32, n_heads=4, n_layers=2, d_ff=64,
             max_len=32, dtype=jnp.float32, use_ring_attention=True)
@@ -250,9 +257,39 @@ class TestGQAEngines:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("use_flash", [False, True])
     def test_ring_matches_full_and_kv_grads_grouped(self, rng, use_flash):
-        mesh = place.make_mesh((1, 4), (place.AXIS_DATA, place.AXIS_SEQ))
+        """Ring GQA fwd + grouped dk/dv grads match the head-repeated
+        MHA formulation (full_attention with an explicit repeat whose
+        adjoint group-sums).
+
+        `slow` — root-cause findings on the full-sweep XLA:CPU
+        `backend_compile` segfault (ROADMAP housekeeping flag from
+        PR 15, investigated PR 16): when the tier-1 sweep reaches this
+        file at ~80% (~750 s, ~700 tests of jitted programs resident),
+        the process dies with SIGSEGV *inside* XLA:CPU compilation of
+        whichever of this file's big reverse-mode shard_map programs
+        compiles first — PR 15 observed it here, the 2026-08-07 sweep
+        died earlier in the file at test_spmd_dp_sp_tp_matches_
+        single_device (faulthandler: `jax/_src/compiler.py:307
+        backend_compile` under `_scan_transpose`, no repo frame below
+        jax). It is NOT this test's code and not any single suite's
+        state: both parametrizations pass in isolation (~30 s), after
+        the full serving/fleet block (160 tests, one process), and
+        after the master/distributed/elastic block (121 tests —
+        including the six leaked `MasterService._snapshot_loop` /
+        `_beat` daemon threads visible in the crash dump; threads
+        exonerated). Host memory is not a factor (128 GB free, 1-core
+        host, 8 simulated XLA host devices, jax 0.4.37). Everything
+        points at process state accumulated over the FULL sweep
+        (hundreds of live LLVM-JIT'd executables) tripping a bug in
+        XLA:CPU's compiler on these largest-in-repo grad programs —
+        environmental, not reachable from repo code. Marked `slow`
+        (with the spmd test above, the other observed crash site) so
+        the fast tier stops dying at 80% and the ~18% of the suite
+        after this file gets coverage; the slow tier and isolation
+        runs still execute both."""
         B, T, H, Hkv, D = 2, 16, 4, 2, 4
         q, k, v = self._qkv(rng, B, T, H, Hkv, D)
 
