@@ -122,8 +122,33 @@ FAMILIES = {
         "glob": "*elastic_bench*.json",
         "figures": [
             ("recovery_seconds", "lower", 0.5),
-            ("detect_seconds", "lower", 0.5),
+            # detection latency is QUANTIZED: the worker beats every
+            # 0.5 s and the supervisor polls every 0.2 s, so a single
+            # kill sample lands anywhere in 0-0.7 s depending on phase
+            # alone — a prior-run ratio band narrower than one poll
+            # interval (0.133 * 1.5 = 0.20) fires on phase, not code.
+            # The absolute ceiling is the structural bound
+            # (heartbeat cadence + poll interval + margin): a real
+            # detection regression (a scan gone quadratic, a blocking
+            # scrape) blows past 0.8 s outright
+            ("detect_seconds", "ceiling", 0.8),
             ("completed", "true", 0.0),
+            # gang observability plane (PR-17 fields; SKIP against
+            # older artifacts by design): dark-over-traced min steady
+            # step wall is an absolute floor. Calibration (PR-17): the
+            # plane's true per-step cost is ~2 us (scope-pair delta,
+            # buffer on vs off) on a >6 ms step, but alternating
+            # same-code runs on this one-core shared host swing the
+            # min-of-mins +-10% from machine state alone — a 0.97
+            # floor would fire on load, not code, so 0.90 is the gate:
+            # it still catches a structural regression (telemetry or
+            # aggregation moving onto the per-step path costs >=0.5 ms
+            # and shows as <0.9). The ledger boolean (valid checksum,
+            # both coordination epochs, restart gap attributed
+            # post-kill) and its >=90% wall coverage must hold outright
+            ("training_observability_overhead", "floor", 0.90),
+            ("goodput_ledger_ok", "true", 0.0),
+            ("goodput_coverage", "floor", 0.9),
         ],
     },
     "zero": {
@@ -181,6 +206,16 @@ def compare_figure(latest, prev, direction, band):
             return "SKIP", "missing in latest"
         return ("PASS", "still true") if latest else \
             ("REGRESSED", f"was {prev!r}, now {latest!r}")
+    if direction in ("floor", "ceiling"):
+        # an ABSOLUTE bound (band is the bound itself, not a prior-run
+        # ratio): gates on the latest artifact alone, like "true"
+        if latest is None:
+            return "SKIP", "missing in latest"
+        latest = float(latest)
+        ok = (latest >= band if direction == "floor"
+              else latest <= band)
+        return ("PASS" if ok else "REGRESSED"), \
+            f"latest {latest:g} vs absolute {direction} {band:g}"
     if latest is None or prev is None:
         return "SKIP", "missing in latest" if latest is None \
             else "missing in previous"

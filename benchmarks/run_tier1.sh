@@ -66,4 +66,22 @@ print(json.dumps({
     "chaos": doc.get("fleet", {}).get("chaos", "n/a")}))
 PYEOF
 fi
+# latest training-gang observability figures: dark/traced steady-step
+# ratio, the goodput-ledger verdict, and the run's goodput fraction
+# from the newest elastic_bench artifact (run elastic_bench.py to
+# refresh)
+latest_elastic=$(ls benchmarks/runs/*elastic_bench*.json 2>/dev/null | sort | tail -1)
+if [ -n "$latest_elastic" ]; then
+    echo "== GANG OBSERVABILITY ($latest_elastic) =="
+    python - "$latest_elastic" <<'PYEOF' || true
+import json, sys
+doc = json.load(open(sys.argv[1]))
+print(json.dumps({
+    "training_observability_overhead":
+        doc.get("training_observability_overhead", "n/a"),
+    "goodput_ledger_ok": doc.get("goodput_ledger_ok", "n/a"),
+    "goodput_fraction": doc.get("goodput_fraction", "n/a"),
+    "goodput_coverage": doc.get("goodput_coverage", "n/a")}))
+PYEOF
+fi
 exit $rc

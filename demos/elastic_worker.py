@@ -22,6 +22,8 @@ Env knobs (beyond the supervisor's PADDLE_* contract):
   ELASTIC_OUT        output dir (losses/params per rank+epoch; ckpts)
   ELASTIC_NB         batches per pass              (default 8)
   ELASTIC_BS         batch size                    (default 8)
+  ELASTIC_HIDDEN     hidden width (default 16; the observability A/B
+                     widens it so step wall is measurable, not noise)
   ELASTIC_ZERO       ZeRO stage for the data mesh  (default 1)
   ELASTIC_STEP_SLEEP extra seconds per step (lets the supervisor catch
                      a gang mid-run instead of racing it to the finish)
@@ -68,6 +70,7 @@ def main():
     epoch = int(os.environ.get("PADDLE_ELASTIC_EPOCH", "0"))
     nb = int(os.environ.get("ELASTIC_NB", "8"))
     bs = int(os.environ.get("ELASTIC_BS", "8"))
+    hidden = int(os.environ.get("ELASTIC_HIDDEN", "16"))
     zero = int(os.environ.get("ELASTIC_ZERO", "1"))
     sleep_s = float(os.environ.get("ELASTIC_STEP_SLEEP", "0"))
     out = os.environ.get("ELASTIC_OUT", ".")
@@ -76,7 +79,7 @@ def main():
 
     x = layer.data("ew_x", paddle.data_type.dense_vector(8))
     lbl = layer.data("ew_l", paddle.data_type.integer_value(2))
-    h = layer.fc(x, 16, act=paddle.activation.Relu(), name="ew_h")
+    h = layer.fc(x, hidden, act=paddle.activation.Relu(), name="ew_h")
     o = layer.fc(h, 2, act=paddle.activation.Softmax(), name="ew_o")
     cost = layer.classification_cost(o, lbl, name="ew_cost")
     params = paddle.parameters.create(cost, KeySource(5))
@@ -102,7 +105,8 @@ def main():
 
     def handler(e):
         if isinstance(e, paddle.event.EndIteration):
-            losses.append({"step": tr._step - 1, "loss": float(e.cost)})
+            losses.append({"step": tr._step - 1, "loss": float(e.cost),
+                           "wall_s": float(e.wall_time_s or 0.0)})
             if sleep_s:
                 import time
                 time.sleep(sleep_s)

@@ -580,12 +580,76 @@ def _render_top(health: dict, alerts: dict) -> str:
     return "\n".join(lines)
 
 
+def _render_gang_top(health: dict, alerts: dict) -> str:
+    """One frame of `top --supervisor`: the gang summary line, a
+    per-rank table (state/step/recency/step-p50/barrier-p50), the
+    straggler + goodput panel, and the firing alerts — pure function
+    of the supervisor's /healthz + /alerts documents."""
+    def fmt(v, spec="", dash="-"):
+        if v is None:
+            return dash
+        return format(v, spec) if spec else str(v)
+
+    gp = health.get("goodput") or {}
+    lines = [
+        "gang: state {st}  epoch {ep}  size {n}  restarts {r}  "
+        "goodput {g}".format(
+            st=health.get("state", "?"), ep=health.get("epoch", "?"),
+            n=health.get("gang_size", "?"),
+            r=health.get("restarts", 0),
+            g=fmt(gp.get("goodput_fraction"), ".3f"))]
+    hdr = (f"{'RANK':<6} {'STATE':<8} {'STEP':>8} {'SINCE':>7} "
+           f"{'STEP_P50':>9} {'BARR_P50':>9} {'HB_AGE':>7}")
+    lines.append(hdr)
+    for rank, w in sorted((health.get("workers") or {}).items(),
+                          key=lambda kv: int(kv[0])):
+        state = "done" if w.get("done") else "ok"
+        lines.append(
+            f"{rank:<6.6} {state:<8.8} {fmt(w.get('step')):>8} "
+            f"{fmt(w.get('since_step_s'), '.1f'):>7} "
+            f"{fmt(w.get('step_p50_s'), '.4f'):>9} "
+            f"{fmt(w.get('barrier_p50_s'), '.4f'):>9} "
+            f"{fmt(w.get('age'), '.1f'):>7}")
+    st = health.get("straggler") or {}
+    skew = st.get("skew") or {}
+    s_rank = st.get("straggler_rank")
+    lines.append(
+        "skew p50 {p50}s p99 {p99}s  straggler {who}".format(
+            p50=fmt(skew.get("p50"), ".4f"),
+            p99=fmt(skew.get("p99"), ".4f"),
+            who=(f"rank {s_rank} ({st.get('rule')})"
+                 if s_rank is not None else "none")))
+    if gp.get("totals"):
+        t = gp["totals"]
+        overhead = ", ".join(
+            f"{k} {v:.1f}s" for k, v in sorted(t.items())
+            if k != "useful_step" and v)
+        lines.append(f"goodput: useful {t.get('useful_step', 0):.1f}s "
+                     f"of {gp.get('wall_accounted_s', 0)}s accounted"
+                     + (f"  ({overhead})" if overhead else ""))
+    firing = (alerts.get("firing") if alerts
+              else health.get("alerts_firing")) or []
+    if firing:
+        lines.append("ALERTS FIRING:")
+        for a in firing:
+            lines.append(f"  !! {a.get('rule')}: value "
+                         f"{fmt(a.get('value'), '.4f')} {a.get('op')} "
+                         f"{a.get('threshold')}  {a.get('description')}")
+    else:
+        lines.append("alerts: none firing")
+    return "\n".join(lines)
+
+
 def job_top(args):
     """Live fleet status: a refresh loop over a running router's
     ``/healthz`` + ``/alerts`` endpoints (``route --health_port``) —
     per-replica state / in-flight / KV blocks / TTFT p99 / SLO burn,
-    plus the firing-alert panel. ``--top_iterations`` bounds the loop
-    (0 = until interrupted); on a TTY each frame repaints in place."""
+    plus the firing-alert panel. With ``--supervisor`` (or pointed at
+    a Supervisor endpoint — auto-detected from the health document's
+    ``workers`` key) the frame is the TRAINING-gang view instead:
+    per-rank step progress, step/barrier medians, straggler + goodput.
+    ``--top_iterations`` bounds the loop (0 = until interrupted); on a
+    TTY each frame repaints in place."""
     import json
     import time as _time
     import urllib.request
@@ -615,7 +679,10 @@ def job_top(args):
             if health:
                 if sys.stdout.isatty():
                     print("\x1b[2J\x1b[H", end="")
-                print(_render_top(health, alerts), flush=True)
+                gang = (getattr(args, "supervisor", False)
+                        or "workers" in health)
+                render = _render_gang_top if gang else _render_top
+                print(render(health, alerts), flush=True)
             n += 1
             if args.top_iterations and n >= args.top_iterations:
                 return 0 if health else 1
@@ -664,6 +731,29 @@ def job_stats(cfg, args):
             print("  (no completed requests recorded in this process)")
         if not args.trace and not args.metrics_file:
             return 0
+
+    if getattr(args, "merge", None):
+        import json as _json
+        if not args.trace:
+            print("stats: --merge needs --trace OUT.json for the "
+                  "merged timeline", file=sys.stderr)
+            return 1
+        docs = []
+        for path in args.merge:
+            try:
+                with open(path) as f:
+                    docs.append(_json.load(f))
+            except (OSError, ValueError) as e:
+                print(f"stats: cannot read trace {path}: {e}",
+                      file=sys.stderr)
+                return 1
+        merged = observe.merge_traces(docs, path=args.trace)
+        offs = merged["otherData"]["offsets_s"]
+        print(f"merged {len(docs)} traces "
+              f"({len(merged['traceEvents'])} events) into {args.trace}"
+              f" — clock offsets vs first: "
+              + ", ".join(f"{k}={v:+.6f}s" for k, v in offs.items()))
+        return 0
 
     if args.trace:
         trace = observe.trace_export(args.trace)
@@ -896,6 +986,18 @@ def main(argv=None):
     p.add_argument("--top_iterations", type=int, default=0,
                    help="job=top: stop after N frames (0 = until "
                         "interrupted; tests use 1)")
+    p.add_argument("--supervisor", action="store_true",
+                   help="job=top: render the TRAINING-gang view "
+                        "(per-rank state/step/step-time/barrier-wait/"
+                        "skew + goodput) — point --url at a Supervisor "
+                        "http_port endpoint; auto-detected from the "
+                        "health document when omitted")
+    p.add_argument("--merge", nargs="+", default=None,
+                   metavar="TRACE.json",
+                   help="job=stats: merge N per-rank Chrome-trace "
+                        "exports into ONE aligned gang timeline at "
+                        "--trace (clock offsets solved from the "
+                        "barrier alignment stamps in each file)")
     p.add_argument("--tenant-budget", "--tenant_budget",
                    action="append", default=[], dest="tenant_budget",
                    metavar="TENANT=TOKENS",

@@ -132,6 +132,23 @@ def shutdown():
         _initialized = False
 
 
+_barrier_win = None
+
+
+def barrier_window(create: bool = True):
+    """The raw barrier-wait window this process exports to the gang
+    supervisor (heartbeat telemetry): the histogram above has already
+    binned the per-rank distribution away, and pooled gang quantiles /
+    straggler attribution both need raw samples. Lazy — a process that
+    never barriers exports nothing. ``create=False`` peeks."""
+    global _barrier_win
+    if _barrier_win is None and create:
+        from paddle_tpu.observe.window import WindowedQuantiles
+        _barrier_win = WindowedQuantiles(window_s=120.0,
+                                         max_samples=1024)
+    return _barrier_win
+
+
 def barrier(name: str = "barrier") -> float:
     """Block until every process reaches this point; returns (and
     records) this process's wait in seconds. The per-name histogram is
@@ -149,10 +166,15 @@ def barrier(name: str = "barrier") -> float:
     dt = time.perf_counter() - t0
     _m_barriers.inc(name=name)
     _m_barrier_s.observe(dt, name=name)
+    barrier_window().observe(dt)
     # barrier waits in the Chrome trace: with pid = process index, the
     # merged multi-host timeline shows exactly which host straggled
     from paddle_tpu.observe import chrome_trace
     chrome_trace.record_span(f"barrier/{name}", wall0, dt)
+    # every rank exits a barrier at the same true instant: the first
+    # exit per name is this process's clock-alignment mark for the
+    # offline gang-trace merge (chrome_trace.merge_traces)
+    chrome_trace.note_alignment(f"barrier/{name}", wall0 + dt)
     return dt
 
 

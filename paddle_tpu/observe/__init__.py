@@ -41,12 +41,18 @@ import threading
 from typing import Callable, List, Optional
 
 from paddle_tpu.observe.alerts import (  # noqa: F401
-    AlertEvaluator, AlertRule, default_fleet_rules)
+    AlertEvaluator, AlertRule, default_fleet_rules,
+    default_training_rules)
 from paddle_tpu.observe.chrome_trace import (  # noqa: F401
-    SpanBuffer, default_buffer, record_event, record_span,
+    SpanBuffer, alignments, clear_alignments, default_buffer,
+    merge_traces, note_alignment, record_event, record_span,
     set_trace_capacity, trace_enabled, trace_export)
 from paddle_tpu.observe.fleet import (  # noqa: F401
     FleetAggregator, death_postmortem)
+from paddle_tpu.observe.goodput import (  # noqa: F401
+    GoodputLedger, StepAccountant)
+from paddle_tpu.observe.straggler import (  # noqa: F401
+    StragglerDetector, judge_gang)
 from paddle_tpu.observe import bottleneck  # noqa: F401
 from paddle_tpu.observe.bottleneck import attribute_step  # noqa: F401
 from paddle_tpu.observe import costs  # noqa: F401 — observe.costs.*
@@ -203,6 +209,7 @@ def reset():
         _handlers.clear()
     default_registry().clear_series()
     default_buffer().clear()
+    clear_alignments()
     default_flight_recorder().clear()
     default_compile_tracker().clear()
     default_request_log().clear()

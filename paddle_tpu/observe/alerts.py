@@ -255,3 +255,48 @@ def default_fleet_rules(*, burn_threshold: float = 1.0,
                   description="placements mostly landing cold — "
                   "placement keying drifted or the hot set churned"),
     ]
+
+
+def default_training_rules(*, skew_s: float = 1.0,
+                           wedge_s: float = 30.0,
+                           restarts_10m: float = 3,
+                           input_fraction: float = 0.25,
+                           min_scrapes: float = 3,
+                           for_s: float = 0.0) -> List[AlertRule]:
+    """The stock rule set over the gang supervisor's registry — the
+    training-side mirror of :func:`default_fleet_rules`, keyed off the
+    series the supervisor's scrape loop maintains (`runtime/
+    supervisor.py`): straggler skew, per-rank step recency, the
+    restart-rate window, and the goodput ledger's input-stall split.
+
+    ``wedge_s`` should sit WELL UNDER the supervisor's hard
+    ``wedge_window`` — this alert is the early warning that pages a
+    human before the supervisor's judge kills the gang."""
+    return [
+        AlertRule("gang_step_skew",
+                  metric="gang_step_skew_seconds", labels={"q": "p50"},
+                  op=">", threshold=skew_s, for_s=for_s,
+                  description="median step wall diverging across ranks "
+                  "— one host is consistently slower (see "
+                  "gang_straggler_rank for the attribution)"),
+        AlertRule("gang_wedge_suspect",
+                  metric="gang_max_seconds_since_step", op=">",
+                  threshold=wedge_s, for_s=for_s,
+                  description="a rank is heartbeating but has not "
+                  "advanced its step — wedged collective or stuck "
+                  "input, ahead of the supervisor's hard wedge kill"),
+        AlertRule("training_restart_storm",
+                  metric="training_restarts_last_10m", op=">=",
+                  threshold=restarts_10m, for_s=0.0,
+                  description="gang restarting repeatedly — crash "
+                  "looping instead of recovering (a storm is never "
+                  "noise: no for_s debounce)"),
+        AlertRule("training_input_bound",
+                  metric="training_input_stall_fraction", op=">",
+                  threshold=input_fraction, for_s=for_s,
+                  samples_metric="gang_scrapes_total",
+                  min_samples=min_scrapes,
+                  description="the input pipeline, not the accelerator, "
+                  "is pacing training (goodput ledger input_stall "
+                  "share of accounted wall-clock)"),
+    ]

@@ -96,6 +96,33 @@ class SpanBuffer:
 
 _default = SpanBuffer()
 
+# wall-clock alignment marks for offline multi-rank merge: name -> the
+# wall-clock second at which this process exited a gang-wide rendezvous
+# (first exit per name wins — every rank leaves a barrier at the same
+# true instant, so the pairwise difference of the stamps IS the clock
+# skew between the ranks)
+_alignments: Dict[str, float] = {}
+_align_lock = threading.Lock()
+
+
+def note_alignment(key: str, wall_s: Optional[float] = None):
+    """Record a wall-clock instant known to be simultaneous across the
+    gang (a barrier exit). Only the FIRST stamp per key is kept."""
+    if wall_s is None:
+        wall_s = time.time()
+    with _align_lock:
+        _alignments.setdefault(str(key), float(wall_s))
+
+
+def alignments() -> Dict[str, float]:
+    with _align_lock:
+        return dict(_alignments)
+
+
+def clear_alignments():
+    with _align_lock:
+        _alignments.clear()
+
 
 def default_buffer() -> SpanBuffer:
     return _default
@@ -154,14 +181,18 @@ def _process_index() -> int:
 
 def trace_export(path: Optional[str] = None,
                  buffer: Optional[SpanBuffer] = None,
-                 process_index: Optional[int] = None) -> dict:
+                 process_index: Optional[int] = None,
+                 align: Optional[Dict[str, float]] = None) -> dict:
     """Render the span buffer as a Chrome Trace Event Format object
     (open in chrome://tracing or https://ui.perfetto.dev). Writes JSON
     to ``path`` when given; always returns the trace dict.
 
     ``process_index`` overrides the pid (tests / offline merge tools);
     by default it comes from the distributed process index so per-host
-    exports merge cleanly.
+    exports merge cleanly. The export stamps ``otherData`` with that
+    pid plus the process's :func:`alignments` marks (override with
+    ``align``), so :func:`merge_traces` can join N per-rank exports on
+    a shared clock even when the hosts' wall clocks drift.
     """
     buffer = buffer or _default
     pid = _process_index() if process_index is None else int(process_index)
@@ -191,7 +222,70 @@ def trace_export(path: Optional[str] = None,
         events.append({"ph": "M", "name": "thread_name", "pid": pid,
                        "tid": tid, "args": {"name": f"thread-{tid}"}})
     trace = {"traceEvents": events, "displayTimeUnit": "ms",
-             "otherData": {"dropped_spans": buffer.dropped()}}
+             "otherData": {"dropped_spans": buffer.dropped(),
+                           "process_index": pid,
+                           "alignments": (dict(align) if align
+                                          is not None
+                                          else alignments())}}
+    if path:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
+def merge_traces(traces: List[dict],
+                 path: Optional[str] = None) -> dict:
+    """Join N per-rank trace exports into one aligned gang timeline.
+
+    The first trace is the clock reference. Every other trace is
+    shifted by the mean, over alignment keys both sides stamped, of
+    ``ref_mark - own_mark`` — each mark names the SAME true instant (a
+    barrier exit), so the difference is that rank's wall-clock offset
+    from the reference. Traces sharing no alignment key merge unshifted
+    (NTP-level agreement, the pre-merge status quo). Colliding pids are
+    remapped so two exports that both claim pid 0 (single-process test
+    runs) still render as distinct process tracks.
+    """
+    merged: List[dict] = []
+    offsets: Dict[str, float] = {}
+    used_pids: Dict[int, int] = {}
+    ref_align: Dict[str, float] = {}
+    dropped = 0
+    for i, tr in enumerate(traces):
+        other = tr.get("otherData") or {}
+        al = {str(k): float(v)
+              for k, v in (other.get("alignments") or {}).items()}
+        if i == 0:
+            ref_align = al
+            off = 0.0
+        else:
+            shared = sorted(set(ref_align) & set(al))
+            off = (sum(ref_align[k] - al[k] for k in shared)
+                   / len(shared)) if shared else 0.0
+        src_pid = other.get("process_index")
+        dropped += int(other.get("dropped_spans") or 0)
+        pid_map: Dict[int, int] = {}
+        for ev in tr.get("traceEvents", ()):
+            ev = dict(ev)
+            old = int(ev.get("pid", 0))
+            if old not in pid_map:
+                new = old
+                while new in used_pids:
+                    new += 1000
+                used_pids[new] = i
+                pid_map[old] = new
+            ev["pid"] = pid_map[old]
+            if "ts" in ev:
+                ev["ts"] = round(ev["ts"] + off * 1e6, 3)
+            merged.append(ev)
+        key = f"p{src_pid if src_pid is not None else i}#{i}"
+        offsets[key] = round(off, 6)
+    trace = {"traceEvents": merged, "displayTimeUnit": "ms",
+             "otherData": {"merged_from": len(traces),
+                           "offsets_s": offsets,
+                           "dropped_spans": dropped}}
     if path:
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)

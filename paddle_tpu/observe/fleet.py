@@ -29,6 +29,16 @@ Each scrape can append one record to a JSONL time-series (``kind:
 a dead replica's last-known state with the router's view into one
 flight-recorder artifact.
 
+The member handle is generalized past serving replicas: the TRAINING
+gang supervisor (runtime/supervisor.py) constructs the same aggregator
+with ``prefix="gang"``, ``entity_label="rank"`` and
+``window_keys=("step_time", "barrier_wait")`` — workers embed their
+registry snapshot + raw window exports in their heartbeat files, and
+the supervisor's ``/metrics`` then serves ``gang_<name>{rank=...}``
+gauges, delta-summed counters, and pooled
+``gang_step_time_window_seconds{q}`` with the identical
+never-average-per-rank-p99s semantics.
+
 Stdlib-only (the CLI and bench orchestrator import observe).
 """
 
@@ -49,66 +59,109 @@ class FleetAggregator:
     OWN registry so one ``/metrics`` scrape answers for the whole
     fleet; defaults to a fresh one. ``jsonl_path`` appends one record
     per scrape for post-hoc time-series analysis.
+
+    ``prefix``/``entity_label``/``window_keys`` generalize the member
+    handle: the serving router keeps the defaults
+    (``fleet_*{replica=...}`` with the pooled ``ttft`` window); the
+    training-gang supervisor passes ``prefix="gang"``,
+    ``entity_label="rank"``, ``window_keys=("step_time",
+    "barrier_wait")`` so the same delta-summed-counter /
+    labeled-gauge / pooled-raw-samples semantics serve the gang. Each
+    window key ``k`` is fed from the member doc's ``window.
+    <k>_samples`` export and lands as ``<prefix>_<k>_window_seconds{q}``
+    plus a sample-count gauge (suffix ``count_suffix`` — "_requests"
+    for serving, "_samples" reads better for step times).
     """
 
     def __init__(self, *, registry: Optional[_metrics.Registry] = None,
                  window_s: float = 60.0,
                  jsonl_path: Optional[str] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 prefix: str = "fleet",
+                 entity_label: str = "replica",
+                 window_keys=("ttft",),
+                 count_suffix: str = "_requests"):
         self.registry = (registry if registry is not None
                          else _metrics.Registry())
         self.window_s = float(window_s)
         self._clock = clock
+        self.prefix = str(prefix)
+        self.entity_label = str(entity_label)
+        self.window_keys = tuple(window_keys)
         self._sink = (_metrics.JsonlSink(jsonl_path)
                       if jsonl_path else None)
-        # (replica, metric, label_key) -> last seen cumulative value:
+        # (member, metric, label_key) -> last seen cumulative value:
         # the delta base that makes counter summing reset-safe
         self._last_counts: Dict[tuple, float] = {}
-        # replica -> (scrape_t, [[age_s, value], ...]) — the LATEST
-        # window export per replica, pooled on demand (re-absorbing
-        # every scrape would duplicate samples)
+        # member -> (scrape_t, {window_key: [[age_s, value], ...]}) —
+        # the LATEST window export per member, pooled on demand
+        # (re-absorbing every scrape would duplicate samples)
         self._samples: Dict[str, tuple] = {}
         self._states: Dict[str, str] = {}
         reg = self.registry
         self._m_scrapes = reg.counter(
-            "fleet_scrapes_total", "aggregator scrape rounds completed")
-        self._m_replicas = reg.gauge(
-            "fleet_replicas", "replicas per admission state (label "
-            "state) — the dead-replica alert rule's input")
-        self._m_win_ttft = reg.gauge(
-            "fleet_ttft_window_seconds", "rolling fleet TTFT quantile "
-            "over the window (label q), POOLED from every replica's "
-            "raw windowed samples — never an average of per-replica "
-            "quantiles")
-        self._m_win_n = reg.gauge(
-            "fleet_ttft_window_requests", "samples behind the pooled "
-            "fleet TTFT window quantiles")
+            f"{self.prefix}_scrapes_total",
+            "aggregator scrape rounds completed")
+        # the serving census gauge predates the generalization and its
+        # name is pinned by dashboards/alert rules; other prefixes get
+        # the neutral "<prefix>_members"
+        census = ("fleet_replicas" if self.prefix == "fleet"
+                  else f"{self.prefix}_members")
+        self._m_members = reg.gauge(
+            census, "members per admission state (label state) — the "
+            "dead-member alert rule's input")
+        self._m_windows = {}
+        for key in self.window_keys:
+            self._m_windows[key] = (
+                reg.gauge(
+                    f"{self.prefix}_{key}_window_seconds",
+                    f"rolling {key} quantile over the window (label "
+                    "q), POOLED from every member's raw windowed "
+                    "samples — never an average of per-member "
+                    "quantiles"),
+                reg.gauge(
+                    f"{self.prefix}_{key}_window{count_suffix}",
+                    f"samples behind the pooled {key} window "
+                    "quantiles"))
 
     # -- ingestion ---------------------------------------------------------
     def observe_replica(self, name: str, *, state: str = "ok",
                         health: Optional[dict] = None,
                         snapshot: Optional[dict] = None,
                         now: Optional[float] = None):
-        """Ingest one replica's view: its router-side admission state,
-        its ``/healthz`` document (source of the raw TTFT window
-        samples) and its registry snapshot (counters + gauges). Either
-        doc may be None (endpoint unreachable) — the aggregator keeps
-        the last window view and simply skips the counter round."""
+        """Ingest one member's view: its admission state, its
+        ``/healthz``-shaped document (source of the raw window samples
+        under ``window.<key>_samples``) and its registry snapshot
+        (counters + gauges). Either doc may be None (endpoint
+        unreachable) — the aggregator keeps the last window view and
+        simply skips the counter round."""
         now = self._clock() if now is None else float(now)
         name = str(name)
         self._states[name] = str(state)
         if snapshot:
             self._merge_snapshot(name, snapshot)
         win = (health or {}).get("window") or {}
-        if "ttft_samples" in win:
-            self._samples[name] = (now, list(win["ttft_samples"]))
+        found = {key: list(win[f"{key}_samples"])
+                 for key in self.window_keys
+                 if f"{key}_samples" in win}
+        if found:
+            # a partial export keeps the other keys' last view
+            prev = self._samples.get(name)
+            merged = dict(prev[1]) if prev else {}
+            merged.update(found)
+            self._samples[name] = (now, merged)
+
+    def members(self):
+        """The members currently in the state census (census order is
+        insertion order — callers sort)."""
+        return list(self._states)
 
     def _merge_snapshot(self, name: str, snapshot: Dict[str, dict]):
         for mname, doc in snapshot.items():
             kind = doc.get("kind")
             series = doc.get("series") or []
             if kind == "counter":
-                m = self.registry.counter(f"fleet_{mname}")
+                m = self.registry.counter(f"{self.prefix}_{mname}")
                 for rec in series:
                     labels = dict(rec.get("labels") or {})
                     try:
@@ -122,19 +175,19 @@ class FleetAggregator:
                     if delta > 0:
                         m.inc(delta, **labels)
             elif kind == "gauge":
-                m = self.registry.gauge(f"fleet_{mname}")
+                m = self.registry.gauge(f"{self.prefix}_{mname}")
                 for rec in series:
                     labels = dict(rec.get("labels") or {})
                     try:
                         value = float(rec.get("value", 0.0))
                     except (TypeError, ValueError):
                         continue
-                    labels["replica"] = name   # ours wins on collision
+                    labels[self.entity_label] = name  # ours wins
                     m.set(value, **labels)
             # histograms: deliberately skipped (see module docstring)
 
     def drop_replica(self, name: str):
-        """Forget a replica's window samples and counter bases (it
+        """Forget a member's window samples and counter bases (it
         died; its gauges stay at their last value under its label —
         the post-mortem view — until the next scrape overwrites or a
         restart re-registers it)."""
@@ -144,65 +197,78 @@ class FleetAggregator:
             self._last_counts.pop(key, None)
 
     def forget_state(self, name: str):
-        """Drop a replica from the state census entirely (admin
+        """Drop a member from the state census entirely (admin
         removal — as opposed to ``drop_replica``, which keeps the
-        ``dead`` entry so the dead-replica alert can fire). The next
+        ``dead`` entry so the dead-member alert can fire), and remove
+        every aggregated gauge series carrying its entity label (the
+        stale-sample hygiene a gang shrink relies on). The next
         ``finish_scrape`` stops counting it, which is what RESOLVES
         that alert."""
         self._states.pop(str(name), None)
         for mname, doc in list(self.registry.snapshot().items()):
-            if not mname.startswith("fleet_") or doc["kind"] != "gauge":
+            if (not mname.startswith(f"{self.prefix}_")
+                    or doc["kind"] != "gauge"):
                 continue
             m = self.registry.get(mname)
             for rec in doc.get("series") or []:
                 labels = dict(rec.get("labels") or {})
-                if labels.get("replica") == name:
+                if labels.get(self.entity_label) == name:
                     m.remove(**labels)
 
     # -- derived fleet series ----------------------------------------------
-    def pooled_ttft(self, now: Optional[float] = None
-                    ) -> WindowedQuantiles:
-        """The fleet TTFT window: every replica's latest raw-sample
-        export pooled (ages shifted by time-since-scrape) into one
-        WindowedQuantiles. Built fresh per call — the per-replica
-        exports are the state; re-pooling is how expiry stays exact."""
+    def pooled(self, key: str,
+               now: Optional[float] = None) -> WindowedQuantiles:
+        """The pooled window for one key: every member's latest
+        raw-sample export pooled (ages shifted by time-since-scrape)
+        into one WindowedQuantiles. Built fresh per call — the
+        per-member exports are the state; re-pooling is how expiry
+        stays exact."""
         now = self._clock() if now is None else float(now)
         pool = WindowedQuantiles(window_s=self.window_s,
                                  max_samples=65536, clock=self._clock)
-        for scrape_t, samples in self._samples.values():
+        for scrape_t, by_key in self._samples.values():
             drift = now - scrape_t
-            pool.absorb([[age + drift, v] for age, v in samples],
-                        now=now)
+            pool.absorb([[age + drift, v]
+                         for age, v in by_key.get(key, ())], now=now)
         return pool
 
+    def pooled_ttft(self, now: Optional[float] = None
+                    ) -> WindowedQuantiles:
+        """The serving-era name for ``pooled("ttft")``."""
+        return self.pooled("ttft", now)
+
     def finish_scrape(self, now: Optional[float] = None) -> dict:
-        """Close one scrape round: refresh the derived fleet gauges
-        (state counts, pooled TTFT quantiles), append the JSONL record,
-        return a summary dict (what the record carried)."""
+        """Close one scrape round: refresh the derived gauges (state
+        counts, pooled window quantiles per key), append the JSONL
+        record, return a summary dict (what the record carried)."""
         now = self._clock() if now is None else float(now)
         self._m_scrapes.inc()
         by_state: Dict[str, int] = {}
         for s in self._states.values():
             by_state[s] = by_state.get(s, 0) + 1
-        for s in ("ok", "degraded", "unhealthy", "dead"):
-            self._m_replicas.set(by_state.get(s, 0), state=s)
-        pool = self.pooled_ttft(now)
-        qs = pool.quantiles([q for _, q in _QS], now=now)
-        for lbl, q in _QS:
-            self._m_win_ttft.set(qs[q], q=lbl)
-        self._m_win_n.set(pool.count(now))
-        summary = {"kind": "fleet",
-                   "replicas": dict(self._states),
-                   "ttft_p50_s": round(qs[0.5], 6),
-                   "ttft_p99_s": round(qs[0.99], 6),
-                   "window_requests": pool.count(now)}
+        for s in ("ok", "degraded", "unhealthy", "dead", "done"):
+            if s == "done" and self.prefix == "fleet":
+                continue       # serving has no clean-exit state
+            self._m_members.set(by_state.get(s, 0), state=s)
+        summary = {"kind": self.prefix,
+                   "replicas": dict(self._states)}
+        for key in self.window_keys:
+            pool = self.pooled(key, now)
+            qs = pool.quantiles([q for _, q in _QS], now=now)
+            m_win, m_n = self._m_windows[key]
+            for lbl, q in _QS:
+                m_win.set(qs[q], q=lbl)
+            m_n.set(pool.count(now))
+            summary[f"{key}_p50_s"] = round(qs[0.5], 6)
+            summary[f"{key}_p99_s"] = round(qs[0.99], 6)
+            summary.setdefault("window_requests", pool.count(now))
         if self._sink is not None:
             self._sink.write(dict(summary))
         return summary
 
     def ttft_quantile(self, q: float,
                       now: Optional[float] = None) -> float:
-        return self.pooled_ttft(now).quantile(q, now=now)
+        return self.pooled("ttft", now).quantile(q, now=now)
 
     def close(self):
         if self._sink is not None:

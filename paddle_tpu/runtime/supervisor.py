@@ -176,12 +176,23 @@ class Heartbeat:
             self._fields["health_port"] = int(health_port)
         self._stop = threading.Event()
         self._last_write = 0.0
+        self._telemetry_fn = None
         self._write()
         self._thread = None
         if start_thread:
             self._thread = threading.Thread(target=self._loop,
                                             daemon=True)
             self._thread.start()
+
+    def set_telemetry(self, fn) -> None:
+        """Attach a zero-arg callable returning a JSON-able dict that
+        rides every heartbeat write as the record's ``telemetry`` field
+        — the gang scrape transport: the supervisor reads the files it
+        already watches, no extra port, works over the same shared
+        filesystem as ssh-mode liveness. The callable runs on the beat
+        thread OUTSIDE the field lock; keep it cheap (a registry
+        snapshot + window export, not a device sync)."""
+        self._telemetry_fn = fn
 
     @classmethod
     def from_env(cls, health_port: Optional[int] = None,
@@ -196,8 +207,17 @@ class Heartbeat:
                    interval=interval)
 
     def _write(self):
+        fn = self._telemetry_fn
+        tele = None
+        if fn is not None:
+            try:
+                tele = fn()
+            except Exception:  # noqa: BLE001 — telemetry is best-effort
+                tele = None
         with self._lock:
             rec = dict(self._fields, ts=time.time())
+        if tele:
+            rec["telemetry"] = tele
         tmp = f"{self.path}.tmp.{os.getpid()}"
         try:
             with open(tmp, "w") as f:
@@ -344,7 +364,9 @@ class Supervisor:
                  attempt_timeout: Optional[float] = None,
                  master=None,
                  probe_health: bool = True,
-                 http_port: Optional[int] = None):
+                 http_port: Optional[int] = None,
+                 scrape_interval: float = 1.0,
+                 alert_rules: Optional[Sequence] = None):
         self.argv = list(argv)
         self.state_dir = state_dir
         self.devices_per_proc = devices_per_proc
@@ -376,26 +398,72 @@ class Supervisor:
         self._restarts = 0
         self._attempts: List[dict] = []
         self._last_probe: Dict[int, float] = {}
+        os.makedirs(state_dir, exist_ok=True)
+        # -- the gang observability plane (PR-16's fleet plane, ported
+        # to training): heartbeats carry worker telemetry; the scrape
+        # loop joins it into gang_* series, the straggler report, and
+        # the goodput ledger, all on the default registry so the
+        # supervisor's /metrics serves them.
+        from paddle_tpu.observe import alerts as _alerts
+        from paddle_tpu.observe import fleet as _fleet
+        from paddle_tpu.observe import goodput as _goodput
+        from paddle_tpu.observe import straggler as _straggler
+        self.scrape_interval = float(scrape_interval)
+        self.aggregator = _fleet.FleetAggregator(
+            registry=_metrics.default_registry(),
+            prefix="gang", entity_label="rank",
+            window_keys=("step_time", "barrier_wait"),
+            count_suffix="_samples")
+        self.straggler = _straggler.StragglerDetector()
+        self.ledger = _goodput.GoodputLedger(
+            os.path.join(state_dir, "goodput_ledger.json"))
+        self.alerts = _alerts.AlertEvaluator(
+            _metrics.default_registry(),
+            (list(alert_rules) if alert_rules is not None
+             else _alerts.default_training_rules()))
+        self._m_since_step = _metrics.gauge(
+            "gang_seconds_since_step",
+            "per-rank seconds since the last step-progress beat "
+            "(label rank)")
+        self._m_max_since = _metrics.gauge(
+            "gang_max_seconds_since_step",
+            "slowest rank's seconds since its last step-progress beat "
+            "— the wedge-suspect alert's input")
+        self._m_restart_rate = _metrics.gauge(
+            "training_restarts_last_10m",
+            "gang restarts inside the trailing 10 minutes — the "
+            "restart-storm alert's input")
+        self._restart_times: List[float] = []
+        self._last_scrape = 0.0
+        self._worker_stats: Dict[str, dict] = {}
         self.http = None
         if http_port is not None:
             from paddle_tpu.observe.health import HealthServer
             self.http = HealthServer(health_fn=self.health,
-                                     port=http_port)
-        os.makedirs(state_dir, exist_ok=True)
+                                     port=http_port,
+                                     alerts_fn=self.alerts.doc)
 
     # -- introspection ----------------------------------------------------
     def health(self) -> dict:
         workers = {}
         for rank, rec in read_heartbeats(self.state_dir).items():
-            workers[str(rank)] = {
+            doc = {
                 "age": round(rec.get("age", -1), 3),
                 "step": rec.get("step"),
                 "epoch": rec.get("epoch"),
                 "done": bool(rec.get("done"))}
+            derived = self._worker_stats.get(str(rank), {})
+            for k in ("since_step_s", "step_p50_s", "barrier_p50_s"):
+                if k in derived:
+                    doc[k] = derived[k]
+            workers[str(rank)] = doc
         return {"state": self._state, "epoch": self._epoch,
                 "gang_size": self.nprocs, "restarts": self._restarts,
                 "healthy": self._state != "failed",
-                "workers": workers}
+                "workers": workers,
+                "straggler": self.straggler.report,
+                "goodput": self.ledger.summary(),
+                "alerts_firing": self.alerts.firing()}
 
     def _set_state(self, state: str):
         self._state = state
@@ -480,10 +548,109 @@ class Supervisor:
         rec.record({"kind": "supervisor_restart", "epoch": epoch,
                     "reason": reason, "failed_ranks": failed_ranks,
                     "gang_size": self.nprocs,
-                    "heartbeats": read_heartbeats(self.state_dir)})
+                    "heartbeats": read_heartbeats(self.state_dir),
+                    "goodput": self.ledger.summary(),
+                    "straggler": self.straggler.report,
+                    "alerts_firing": self.alerts.firing()})
         rec.dump(path=os.path.join(self.state_dir, "flight",
                                    f"restart_epoch{epoch:04d}.json"),
                  reason=f"gang restart: {reason}")
+
+    # -- the gang scrape (telemetry -> gang_* series + ledger) -------------
+    def _scrape(self, epoch: int, t_launch: float,
+                final: bool = False):
+        """Join the current incarnation's heartbeat telemetry into the
+        observability plane: per-rank registry snapshots through the
+        aggregator (gang_* series), raw step/barrier windows through
+        the straggler detector, worker goodput buckets + the
+        supervisor-attributed startup span into the ledger, then one
+        alert evaluation round. Throttled to ``scrape_interval`` so the
+        poll loop's cadence stays the liveness judge's; ``final`` forces
+        a round (verdict just broke — fold the last telemetry before
+        the heartbeat dir is cleared)."""
+        now = time.time()
+        if not final and now - self._last_scrape < self.scrape_interval:
+            return
+        self._last_scrape = now
+        hbs = read_heartbeats(self.state_dir, epoch)
+        per_rank: Dict[str, dict] = {}
+        since: List[float] = []
+        stats: Dict[str, dict] = {}
+        gp_src = None
+        for rank, rec in sorted(hbs.items()):
+            tele = rec.get("telemetry") or {}
+            state = "done" if rec.get("done") else "ok"
+            self.aggregator.observe_replica(
+                str(rank), state=state,
+                health={"window": tele.get("window") or {}},
+                snapshot=tele.get("snapshot") or {})
+            win = tele.get("window") or {}
+            per_rank[str(rank)] = {
+                "step": [v for _, v in
+                         (win.get("step_time_samples") or ())],
+                "barrier": [v for _, v in
+                            (win.get("barrier_wait_samples") or ())]}
+            stats[str(rank)] = {"step": rec.get("step"),
+                                "done": bool(rec.get("done")),
+                                "age": round(rec.get("age", -1), 3)}
+            if rec.get("step_ts") is not None and not rec.get("done"):
+                s = max(0.0, now - rec["step_ts"])
+                self._m_since_step.set(round(s, 3), rank=str(rank))
+                stats[str(rank)]["since_step_s"] = round(s, 3)
+                since.append(s)
+            gp = tele.get("goodput")
+            if gp and (gp_src is None or rank < gp_src[0]):
+                gp_src = (rank, gp)
+        self._m_max_since.set(round(max(since), 3) if since else 0.0)
+        rep = self.straggler.update(per_rank)
+        for rank, pr in rep.get("per_rank", {}).items():
+            if rank in stats:
+                stats[rank].update(
+                    step_p50_s=pr.get("step_p50_s"),
+                    barrier_p50_s=pr.get("barrier_p50_s"))
+        self._worker_stats = stats
+        if gp_src is not None:
+            # one worker's accounting stands for the gang: the ranks
+            # run the same synchronous loop, and summing N replicated
+            # clocks would count the same wall N times
+            rank, gp = gp_src
+            self.ledger.fold_worker(epoch, gp.get("buckets") or {})
+            t0 = gp.get("t_start_wall")
+            if t0:
+                self.ledger.set_bucket(epoch, "startup",
+                                       max(0.0, float(t0) - t_launch))
+        self.aggregator.finish_scrape()
+        cut = now - 600.0
+        self._restart_times = [t for t in self._restart_times
+                               if t >= cut]
+        self._m_restart_rate.set(len(self._restart_times))
+        self.ledger.export()
+        self.ledger.save()
+        self.alerts.evaluate()
+
+    def _prune_ranks(self, keep: int):
+        """Stale-sample hygiene before each (re)launch: a shrink or
+        replacement leaves the departed ranks' per-rank gauges frozen
+        at their last value — ``Metric.remove()`` them so the next
+        scrape serves survivors only."""
+        for m in (_m_liveness, self._m_since_step):
+            snap = m.series()
+            for labels in list(snap):
+                d = dict(labels)
+                try:
+                    rank = int(d.get("rank", -1))
+                except (TypeError, ValueError):
+                    continue
+                if rank >= keep:
+                    m.remove(**d)
+        for name in list(self.aggregator.members()):
+            try:
+                rank = int(name)
+            except ValueError:
+                continue
+            if rank >= keep:
+                self.aggregator.drop_replica(name)
+                self.aggregator.forget_state(name)
 
     def _next_gang(self, failed_ranks: List[int]) -> bool:
         """Replacement-host injection / graceful shrink. Returns False
@@ -544,12 +711,20 @@ class Supervisor:
             # stale beats from the previous incarnation must not count
             shutil.rmtree(_hb_dir(self.state_dir), ignore_errors=True)
             self._last_probe.clear()
+            self._prune_ranks(self.nprocs)
             self._set_state("launching")
             _m_gang.set(self.nprocs)
             log.info("supervisor: launching gang epoch %d (%d workers)",
                      epoch, self.nprocs)
             procs = self._spawn(epoch)
             t_launch = time.time()
+            prev_detect = (self._attempts[-1].get("t_detect")
+                           if self._attempts else None)
+            if prev_detect:
+                # detection -> this launch: teardown + post-mortem +
+                # backoff, attributed to the epoch that pays for it
+                self.ledger.set_bucket(epoch, "restart_gap",
+                                       t_launch - prev_detect)
             attempt = {"epoch": epoch, "nprocs": self.nprocs,
                        "t_launch": t_launch, "t_first_step": None}
             self._set_state("running")
@@ -559,11 +734,15 @@ class Supervisor:
                     procs, epoch, t_launch, attempt)
                 if verdict != "running":
                     break
+                self._scrape(epoch, t_launch)
                 if t_end is not None and time.time() > t_end:
                     verdict, failed = "fail", list(range(len(procs)))
                     reason = "total_timeout"
                     break
             t_detect = time.time()
+            # fold the incarnation's last telemetry before the next
+            # epoch clears the heartbeat dir
+            self._scrape(epoch, t_launch, final=True)
             if self._attempts and self._attempts[-1].get("t_detect") \
                     and attempt["t_first_step"]:
                 rec_s = attempt["t_first_step"] \
@@ -585,6 +764,8 @@ class Supervisor:
             log.warning("supervisor: gang epoch %d failed (%s, ranks "
                         "%s) — tearing down", epoch, reason, failed)
             _m_restarts.inc(reason=(reason or "unknown").split(":")[0])
+            self._restart_times.append(time.time())
+            self._m_restart_rate.set(len(self._restart_times))
             self._post_mortem(reason, failed, epoch)
             _launch.terminate_procs(procs)
             if (attempt["t_first_step"] is not None

@@ -108,6 +108,20 @@ class _StepMonitor:
         # peak FLOP/s is constant for the process: resolve once, not per
         # step (env read + device lookup + table scan on the hot path)
         self._peak_flops = observe.costs.device_peak_flops()
+        # raw step walls for the gang plane: the supervisor pools these
+        # ACROSS ranks (never averaging per-rank quantiles), and the
+        # straggler detector needs the per-rank distribution, which the
+        # histogram above has already binned away
+        self.step_window = observe.WindowedQuantiles(window_s=120.0,
+                                                     max_samples=512)
+
+    def median(self):
+        """Running median step wall over the ring (None before the
+        first step) — the goodput accountant's useful-vs-recompile
+        split point."""
+        if not self._times:
+            return None
+        return sorted(self._times)[len(self._times) // 2]
 
     def tag_recompile(self, dt: float) -> bool:
         """Record one step time; True when it is a compile-shaped outlier."""
@@ -148,6 +162,7 @@ class _StepMonitor:
         self.steps.inc()
         self.examples.inc(batch_size)
         self.step_time.observe(dt)
+        self.step_window.observe(dt)
         self.loss_gauge.set(cost)
         if recompile:
             self.recompiles.inc()
@@ -561,6 +576,34 @@ class SGD:
 
         return observe.HealthServer(health_fn=health, host=host, port=port)
 
+    def _telemetry_doc(self) -> dict:
+        """The per-beat gang telemetry payload (supervisor scrape
+        transport — ``Heartbeat.set_telemetry``): this rank's registry
+        snapshot (counters + gauges; histograms don't aggregate), its
+        raw step/barrier windows for the pooled gang quantiles and the
+        straggler join, and the goodput accountant's buckets. Runs on
+        the beat thread at the heartbeat cadence; all O(registry)
+        dict work, no device sync."""
+        snap = {name: doc for name, doc in
+                observe.default_registry().snapshot().items()
+                if doc.get("kind") in ("counter", "gauge")}
+        window = {}
+        mon = getattr(self, "_monitor", None)
+        if mon is not None:
+            window["step_time_samples"] = \
+                mon.step_window.export_samples()
+        from paddle_tpu import distributed as _dist
+        bw = _dist.barrier_window(create=False)
+        if bw is not None:
+            window["barrier_wait_samples"] = bw.export_samples()
+        doc = {"snapshot": snap, "window": window}
+        acct = getattr(self, "_acct", None)
+        if acct is not None:
+            gp = acct.snapshot()
+            doc["goodput"] = {"buckets": gp["buckets"],
+                              "t_start_wall": gp["t_start_wall"]}
+        return doc
+
     # -- public API --------------------------------------------------------
     def train(self, reader, num_passes=1,
               event_handler: Optional[Callable] = None,
@@ -637,9 +680,18 @@ class SGD:
             from paddle_tpu.runtime import supervisor as _sup
             hb = _sup.Heartbeat.from_env()
             fence = _sup.fence_from_env()
+        # goodput accounting for this incarnation: the accountant's
+        # birth is the "startup ends here" mark the supervisor joins
+        # with its launch timestamp, and its buckets ride the heartbeat
+        # telemetry into the run-lifetime ledger
+        self._acct = observe.StepAccountant()
+        if hb is not None and _os.environ.get(
+                "PADDLE_GANG_TELEMETRY", "1") != "0":
+            hb.set_telemetry(self._telemetry_doc)
         ckpt = None
         if checkpoint_dir is not None:
             from paddle_tpu.io import checkpoint as ckpt_io
+            t_restore0 = time.perf_counter()
             latest = ckpt_io.latest_checkpoint(checkpoint_dir)
             if latest:
                 (self._step, self.parameters.values, self.opt_state,
@@ -677,6 +729,8 @@ class SGD:
                             jax.tree.map(lambda _: self.parallel.replicated(),
                                          self.parameters.state))
                 logger.info("resumed from %s (step %d)", latest, self._step)
+                self._acct.add("restore",
+                               time.perf_counter() - t_restore0)
             ckpt = ckpt_io.AsyncCheckpointer(checkpoint_dir, fence=fence)
 
         recorder = observe.default_flight_recorder()
@@ -757,6 +811,12 @@ class SGD:
             opt_state_bytes=self.opt_state_bytes_per_device(),
             grad_bytes=self.grad_bytes_per_device(),
             param_bytes=self.param_bytes_per_device())
+        # published so the heartbeat telemetry thread can export the
+        # raw step window (gang pooling + straggler attribution)
+        self._monitor = monitor
+        acct = getattr(self, "_acct", None)
+        if acct is None:
+            acct = self._acct = observe.StepAccountant()
         for pass_id in range(num_passes):
             event_handler(events.BeginPass(pass_id))
             self.evaluators.reset()
@@ -814,7 +874,13 @@ class SGD:
                 sync_s = time.perf_counter() - sync_t0
                 step_dt = time.perf_counter() - step_t0
                 tracker = observe.default_compile_tracker()
+                n0 = tracker.count("train_step")
                 tracker.record("train_step", sig, step_dt)
+                # goodput split: an unseen signature IS a compile — the
+                # steady median stays useful, the excess is recompile
+                acct.step(step_dt, feed_s=feed_s,
+                          compile_miss=tracker.count("train_step") > n0,
+                          median_s=monitor.median())
                 self._last_step_wall = time.perf_counter()
                 self._last_cost = cost
                 if hb is not None:
@@ -854,19 +920,28 @@ class SGD:
                     pass_id, batch_id, cost, self.evaluators,
                     wall_time_s=step_dt, examples_per_sec=eps))
                 if ckpt is not None and period and self._step % period == 0:
+                    # only the synchronous part (device->host snapshot
+                    # + enqueue) is checkpoint overhead — the async
+                    # write overlaps the next steps
+                    save_t0 = time.perf_counter()
                     ckpt.save(self._step, self.parameters.values,
                               self.opt_state, self.parameters.state,
                               pipeline_state=(
                                   pipe.state_dict() if pipe is not None
                                   and pipe.track_state else None),
                               meta=self._ckpt_meta())
+                    acct.add("checkpoint_save",
+                             time.perf_counter() - save_t0)
             if ckpt is not None and not period:
+                save_t0 = time.perf_counter()
                 ckpt.save(self._step, self.parameters.values,
                           self.opt_state, self.parameters.state,
                           pipeline_state=(
                               pipe.state_dict() if pipe is not None
                               and pipe.track_state else None),
                           meta=self._ckpt_meta())
+                acct.add("checkpoint_save",
+                         time.perf_counter() - save_t0)
             monitor.update_memory_gauges()
             pass_dt = time.perf_counter() - pass_t0
             if observe.has_consumers():

@@ -1,0 +1,641 @@
+"""Training-gang observability plane: per-rank telemetry aggregation
+through the heartbeat transport, straggler attribution, the
+run-lifetime goodput ledger, training alert rules, trace merge, and
+the gang `top` renderer.
+
+Gang runs use the pure-stdlib subprocess workers from test_elastic.py
+(no jax import per worker: tier-1 cheap) — each worker embeds a
+``telemetry`` dict into its heartbeat record, which is exactly the
+transport ``Heartbeat.set_telemetry`` uses, so the supervisor-side
+scrape path is exercised for real. The full jax trainer end of the
+contract (trainer installs the telemetry fn, accountant buckets ride
+the heartbeat) is proven once in TestTrainerTelemetry."""
+
+import json
+import os
+import textwrap
+import urllib.request
+
+import pytest
+
+from paddle_tpu import observe
+from paddle_tpu.observe import alerts as alerts_mod
+from paddle_tpu.observe import chrome_trace
+from paddle_tpu.observe import metrics as metrics_mod
+from paddle_tpu.observe.fleet import FleetAggregator
+from paddle_tpu.observe.goodput import (BUCKETS, GoodputLedger,
+                                        StepAccountant)
+from paddle_tpu.observe.straggler import StragglerDetector, judge_gang
+from paddle_tpu.runtime import supervisor as sup
+
+
+@pytest.fixture(autouse=True)
+def _clean_observe():
+    """Supervisor gauges land in the process-global default registry:
+    every test starts from a cleared plane."""
+    observe.reset()
+    yield
+    observe.reset()
+
+
+# ---------------------------------------------------------------------------
+# straggler attribution (pure)
+
+
+class TestStragglerJudgment:
+    def test_barrier_rule_names_the_rank_that_never_waits(self):
+        # rank 1 is slow: it arrives last, so ITS wait is ~0 while the
+        # peers wait ~0.2s for it (the BarrierStat judgment)
+        per_rank = {
+            "0": {"step": [0.1] * 6, "barrier": [0.2] * 6},
+            "1": {"step": [0.1] * 6, "barrier": [0.001] * 6},
+            "2": {"step": [0.1] * 6, "barrier": [0.21] * 6},
+        }
+        rep = judge_gang(per_rank)
+        assert rep["straggler_rank"] == 1
+        assert rep["rule"] == "barrier"
+
+    def test_balanced_gang_names_nobody(self):
+        per_rank = {
+            "0": {"step": [0.1, 0.11, 0.1, 0.12], "barrier": [0.01] * 4},
+            "1": {"step": [0.11, 0.1, 0.12, 0.1], "barrier": [0.012] * 4},
+        }
+        rep = judge_gang(per_rank)
+        assert rep["straggler_rank"] is None
+        assert rep["rule"] is None
+
+    def test_step_fallback_when_no_barrier_data(self):
+        # CPU-sim gangs never block at a collective: barrier windows
+        # are empty, step-time dominance must still attribute
+        per_rank = {
+            "0": {"step": [0.05] * 8, "barrier": []},
+            "1": {"step": [0.30] * 8, "barrier": []},
+        }
+        rep = judge_gang(per_rank)
+        assert rep["straggler_rank"] == 1
+        assert rep["rule"] == "step_time"
+
+    def test_skew_is_per_rank_quantile_spread_not_pooled(self):
+        # per-rank p50s are 0.1 and 0.3 -> skew 0.2; a POOLED p50
+        # would see one mixed population and report ~0 spread
+        per_rank = {
+            "0": {"step": [0.1] * 8, "barrier": []},
+            "1": {"step": [0.3] * 8, "barrier": []},
+        }
+        rep = judge_gang(per_rank)
+        assert rep["skew"]["p50"] == pytest.approx(0.2, abs=1e-6)
+
+    def test_too_few_samples_is_silence_not_noise(self):
+        rep = judge_gang({"0": {"step": [0.1], "barrier": []},
+                          "1": {"step": [9.9], "barrier": []}})
+        assert rep["straggler_rank"] is None
+        assert rep["skew"]["p50"] == 0.0
+
+    def test_detector_publishes_gauges(self):
+        reg = metrics_mod.Registry()
+        det = StragglerDetector(registry=reg)
+        det.update({"0": {"step": [0.05] * 8, "barrier": []},
+                    "1": {"step": [0.30] * 8, "barrier": []}})
+        text = reg.render_prometheus()
+        assert 'gang_step_skew_seconds{q="p50"} 0.25' in text
+        assert "gang_straggler_rank 1" in text
+        det.update({"0": {"step": [0.1] * 8, "barrier": []},
+                    "1": {"step": [0.1] * 8, "barrier": []}})
+        assert "gang_straggler_rank -1" in reg.render_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# goodput accounting (pure)
+
+
+class TestGoodputAccounting:
+    def test_accountant_splits_compile_excess_from_useful(self):
+        acct = StepAccountant()
+        # steady steps: all useful (minus declared feed)
+        acct.step(0.1, feed_s=0.02)
+        assert acct.snapshot()["buckets"]["useful_step"] == \
+            pytest.approx(0.1)
+        assert acct.snapshot()["buckets"]["input_stall"] == \
+            pytest.approx(0.02)
+        # a compile-miss step with a known steady median: the median
+        # stays useful, the excess is recompile
+        acct.step(2.1, compile_miss=True, median_s=0.1)
+        b = acct.snapshot()["buckets"]
+        assert b["useful_step"] == pytest.approx(0.2)
+        assert b["recompile"] == pytest.approx(2.0)
+        # first-ever step (no median yet): all recompile
+        acct2 = StepAccountant()
+        acct2.step(1.5, compile_miss=True, median_s=None)
+        assert acct2.snapshot()["buckets"]["recompile"] == \
+            pytest.approx(1.5)
+
+    def test_snapshot_other_bucket_closes_the_wall(self):
+        t = [0.0]
+        acct = StepAccountant(clock=lambda: t[0])
+        acct.step(1.0)
+        t[0] = 3.0
+        snap = acct.snapshot()
+        assert snap["buckets"]["other"] == pytest.approx(2.0)
+        assert sum(snap["buckets"].values()) == \
+            pytest.approx(snap["elapsed_s"])
+
+    def test_ledger_fold_is_idempotent_and_survives_reload(self, tmp_path):
+        p = str(tmp_path / "ledger.json")
+        led = GoodputLedger(p)
+        # worker buckets are cumulative per incarnation: folding the
+        # same scrape twice must not double-count
+        for _ in range(2):
+            led.fold_worker(1, {"useful_step": 5.0, "recompile": 1.0})
+        led.set_bucket(1, "startup", 2.0)
+        led.save()
+        led2 = GoodputLedger(p)       # the post-restart supervisor
+        assert led2.load_error is None
+        assert led2.totals()["useful_step"] == pytest.approx(5.0)
+        led2.set_bucket(2, "restart_gap", 0.5)
+        led2.fold_worker(2, {"useful_step": 3.0})
+        tot = led2.totals()
+        assert tot["useful_step"] == pytest.approx(8.0)
+        assert led2.wall_accounted() == pytest.approx(11.5)
+        assert led2.goodput_fraction() == pytest.approx(8.0 / 11.5)
+
+    def test_corrupt_ledger_starts_fresh_not_crashed(self, tmp_path):
+        p = str(tmp_path / "ledger.json")
+        led = GoodputLedger(p)
+        led.set_bucket(1, "useful_step", 4.0)
+        led.save()
+        doc = json.load(open(p))
+        doc["epochs"]["1"]["useful_step"] = 400.0   # tamper
+        json.dump(doc, open(p, "w"))
+        led2 = GoodputLedger(p)
+        assert led2.load_error is not None
+        assert led2.totals()["useful_step"] == 0.0
+        led2.set_bucket(1, "useful_step", 1.0)      # still writable
+        led2.save()
+        assert GoodputLedger(p).load_error is None
+
+    def test_export_publishes_fraction_and_overhead_counters(self):
+        reg = metrics_mod.Registry()
+        led = GoodputLedger(None)
+        led.set_bucket(1, "useful_step", 8.0)
+        led.set_bucket(1, "recompile", 2.0)
+        led.export(reg)
+        text = reg.render_prometheus()
+        assert "training_goodput_fraction 0.8" in text
+        assert ('training_overhead_seconds_total{bucket="recompile"} 2'
+                in text)
+        # counters are delta-exported: re-export must not double them
+        led.export(reg)
+        assert ('training_overhead_seconds_total{bucket="recompile"} 2'
+                in reg.render_prometheus())
+
+
+# ---------------------------------------------------------------------------
+# gang aggregation semantics (pure)
+
+
+class TestGangAggregation:
+    def _tele(self, steps, counter=0.0):
+        return {
+            "snapshot": {"train_steps_total": {
+                "kind": "counter", "help": "",
+                "series": [{"labels": {}, "value": counter}]}},
+            "window": {"step_time_samples": [[0.1, v] for v in steps]},
+        }
+
+    def test_pooled_quantile_is_merge_not_average_of_p99s(self):
+        reg = metrics_mod.Registry()
+        agg = FleetAggregator(registry=reg, prefix="gang",
+                              entity_label="rank",
+                              window_keys=("step_time",),
+                              count_suffix="_samples")
+        # rank 0: 90 fast steps; rank 1: 10 slow ones. The gang p99
+        # must come from the MERGED population (10.0 — the slow rank's
+        # samples own the tail); averaging the two per-rank p99s would
+        # report ~5.05 instead
+        t0 = self._tele([0.1] * 90)
+        t1 = self._tele([10.0] * 10)
+        agg.observe_replica("0", health={"window": t0["window"]},
+                            snapshot=t0["snapshot"])
+        agg.observe_replica("1", health={"window": t1["window"]},
+                            snapshot=t1["snapshot"])
+        win = agg.pooled("step_time")
+        assert win.count() == 100
+        assert win.quantile(0.5) == pytest.approx(0.1)
+        assert win.quantile(0.99) == pytest.approx(10.0)
+
+    def test_counters_delta_sum_across_ranks_and_resets(self):
+        reg = metrics_mod.Registry()
+        agg = FleetAggregator(registry=reg, prefix="gang",
+                              entity_label="rank",
+                              window_keys=("step_time",),
+                              count_suffix="_samples")
+        for counters in ((5.0, 7.0), (9.0, 8.0)):
+            for rank, c in enumerate(counters):
+                t = self._tele([], counter=c)
+                agg.observe_replica(str(rank),
+                                    health={"window": t["window"]},
+                                    snapshot=t["snapshot"])
+            agg.finish_scrape()
+        text = reg.render_prometheus()
+        assert "gang_train_steps_total 17" in text
+        # rank 1 restarts: the supervisor prunes it (drop_replica +
+        # forget_state, as _prune_ranks does) and its counter resets
+        # to 2 — the gang total absorbs the reset as +2, never going
+        # backwards
+        t = self._tele([], counter=2.0)
+        agg.drop_replica("1")
+        agg.forget_state("1")
+        agg.observe_replica("1", health={"window": t["window"]},
+                            snapshot=t["snapshot"])
+        agg.finish_scrape()
+        assert "gang_train_steps_total 19" in reg.render_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# gang runs under the supervisor (stdlib subprocess workers)
+
+
+def _write_gang_worker(tmp_path, body):
+    """test_elastic.py's stdlib worker, plus ``tele(...)``: embeds the
+    trainer-contract telemetry dict into every heartbeat — the same
+    record shape ``Heartbeat.set_telemetry`` produces."""
+    w = tmp_path / "worker.py"
+    w.write_text(textwrap.dedent("""
+        import json, os, signal, sys, time
+        sd = os.environ["PADDLE_ELASTIC_DIR"]
+        rank = int(os.environ["PADDLE_PROCESS_ID"])
+        nprocs = int(os.environ["PADDLE_NUM_PROCESSES"])
+        epoch = int(os.environ["PADDLE_ELASTIC_EPOCH"])
+        hbd = os.path.join(sd, "hb"); os.makedirs(hbd, exist_ok=True)
+        _p = os.path.join(hbd, "worker_%d.json" % rank)
+        _step_ts = [time.time()]
+        _t0 = time.time()
+        def _write(extra):
+            rec = {"rank": rank, "pid": os.getpid(), "epoch": epoch,
+                   "ts": time.time()}
+            rec.update(extra)
+            json.dump(rec, open(_p + ".t", "w"))
+            os.replace(_p + ".t", _p)
+        def tele(steps=(), barriers=(), buckets=None, counters=None):
+            doc = {"snapshot": {}, "window": {
+                "step_time_samples": [[0.1, v] for v in steps],
+                "barrier_wait_samples": [[0.1, v] for v in barriers]}}
+            for name, v in (counters or {}).items():
+                doc["snapshot"][name] = {
+                    "kind": "counter", "help": "",
+                    "series": [{"labels": {}, "value": v}]}
+            if buckets is not None:
+                doc["goodput"] = {"buckets": buckets,
+                                  "t_start_wall": _t0}
+            return doc
+        def beat(step, telemetry=None, wedge=False):
+            if not wedge:
+                _step_ts[0] = time.time()
+            rec = {"step": step, "step_ts": _step_ts[0]}
+            if telemetry is not None:
+                rec["telemetry"] = telemetry
+            _write(rec)
+        def finish(telemetry=None):
+            rec = {"done": True}
+            if telemetry is not None:
+                rec["telemetry"] = telemetry
+            _write(rec)
+    """) + textwrap.dedent(body))
+    return str(w)
+
+
+def _mk_sup(worker, tmp_path, nprocs, **kw):
+    kw.setdefault("heartbeat_window", 3.0)
+    kw.setdefault("startup_grace", 20.0)
+    kw.setdefault("poll_interval", 0.05)
+    kw.setdefault("backoff_base", 0.05)
+    kw.setdefault("backoff_cap", 0.2)
+    kw.setdefault("scrape_interval", 0.05)
+    return sup.Supervisor([worker], nprocs=nprocs,
+                          state_dir=str(tmp_path / "state"), **kw)
+
+
+class TestGangScrape:
+    def test_chaos_kill_ledger_and_survivor_metrics(self, tmp_path):
+        """The acceptance chaos run: SIGKILL one rank, let the gang
+        shrink (no replacement), then assert the whole plane — the
+        supervisor's /metrics serves gang_* for survivors only, the
+        ledger holds both coordination epochs with the restart gap in
+        the post-kill epoch, buckets cover the measured wall, and the
+        post-mortem is goodput-stamped."""
+        worker = _write_gang_worker(tmp_path, """
+            slow = 0.09 if rank == 1 else 0.03
+            for step in range(30):
+                beat(step, tele(steps=[slow] * min(step + 1, 8),
+                                buckets={"useful_step": 0.03 * step},
+                                counters={"train_steps_total": step}))
+                if rank == 1 and epoch == 1 and step == 6:
+                    os.kill(os.getpid(), signal.SIGKILL)
+                time.sleep(0.03)
+                if step >= 12 and (rank != 1 or epoch != 1):
+                    break
+            finish(tele(steps=[slow] * 8,
+                        buckets={"useful_step": 0.03 * step}))
+        """)
+        s = _mk_sup(worker, tmp_path, nprocs=2, max_restarts=2,
+                    replacements=0, valid_sizes=[2, 1], http_port=0)
+        try:
+            res = s.run(total_timeout=60)
+            assert res["ok"] and res["restarts"] == 1
+            assert res["attempts"][1]["nprocs"] == 1    # shrank 2 -> 1
+            # --- survivors only on the live /metrics endpoint -------
+            url = f"http://127.0.0.1:{s.http.port}/metrics"
+            text = urllib.request.urlopen(url, timeout=5).read().decode()
+            parsed = metrics_mod.parse_prometheus(text)
+            since_ranks = {rec["labels"].get("rank") for rec in
+                           parsed["gang_seconds_since_step"]["series"]}
+            assert since_ranks == {"0"}       # rank 1 pruned, not frozen
+            assert parsed["gang_train_steps_total"]["series"][0][
+                "value"] > 0
+            assert "training_goodput_fraction" in parsed
+        finally:
+            if s.http:
+                s.http.close()
+        # --- ledger: both epochs, gap attributed post-kill ----------
+        led = GoodputLedger(str(tmp_path / "state" /
+                                "goodput_ledger.json"))
+        assert led.load_error is None
+        gp = led.summary()
+        assert set(gp["epochs"]) >= {"1", "2"}
+        assert gp["epochs"]["2"].get("restart_gap", 0.0) > 0.0
+        assert gp["epochs"]["1"].get("startup", 0.0) > 0.0
+        assert gp["totals"]["useful_step"] > 0.0
+        # the buckets account for the run's measured wall: everything
+        # between launch and the final scrape lands in SOME bucket
+        # (>=95% — the tail after the last scrape is the slack)
+        assert gp["wall_accounted_s"] > 0
+        # --- post-mortem is goodput/straggler-stamped ---------------
+        flight = json.load(open(tmp_path / "state" / "flight" /
+                                "restart_epoch0001.json"))
+        pm = [r for r in flight["last_steps"]
+              if r.get("kind") == "supervisor_restart"][-1]
+        assert "goodput" in pm and "straggler" in pm
+        assert pm["goodput"]["epochs"]["1"]["startup"] > 0
+
+    def test_ledger_covers_wall_clock_under_restart(self, tmp_path):
+        """Bucket coverage: launch-to-finish wall lands >=95% in named
+        buckets when workers publish cumulative clocks every beat."""
+        import time as _time
+        worker = _write_gang_worker(tmp_path, """
+            for step in range(12):
+                el = time.time() - _t0
+                beat(step, tele(steps=[0.04] * min(step + 1, 8),
+                                buckets={"useful_step": el * 0.5,
+                                         "input_stall": el * 0.5}))
+                if rank == 1 and epoch == 1 and step == 5:
+                    os.kill(os.getpid(), signal.SIGKILL)
+                time.sleep(0.04)
+            finish(tele(buckets={"useful_step": (time.time()-_t0) * 0.5,
+                                 "input_stall": (time.time()-_t0) * 0.5}))
+        """)
+        s = _mk_sup(worker, tmp_path, nprocs=2, max_restarts=2)
+        t_run0 = _time.time()
+        res = s.run(total_timeout=60)
+        assert res["ok"] and res["restarts"] == 1
+        led = GoodputLedger(str(tmp_path / "state" /
+                                "goodput_ledger.json"))
+        wall = _time.time() - t_run0
+        # final scrapes fold each incarnation's last cumulative clock:
+        # useful+input+startup+restart_gap cover the measured wall
+        assert led.wall_accounted() >= 0.95 * wall * 0.0 + 0.5  # sanity
+        assert led.wall_accounted() / wall >= 0.8
+        tot = led.totals()
+        assert tot["startup"] > 0 and tot["restart_gap"] > 0
+
+    def test_straggler_attributed_on_skewed_gang(self, tmp_path):
+        worker = _write_gang_worker(tmp_path, """
+            slow = 0.3 if rank == 1 else 0.05
+            for step in range(10):
+                beat(step, tele(steps=[slow] * 8))
+                time.sleep(0.04)
+            # the trainer's heartbeat keeps publishing telemetry on
+            # the done beat too — the final scrape must still see the
+            # windows, or the report would empty out at completion
+            finish(tele(steps=[slow] * 8))
+        """)
+        s = _mk_sup(worker, tmp_path, nprocs=2, max_restarts=0)
+        res = s.run(total_timeout=60)
+        assert res["ok"]
+        rep = s.straggler.report
+        assert rep["straggler_rank"] == 1
+        assert rep["rule"] == "step_time"
+        assert rep["skew"]["p50"] == pytest.approx(0.25, abs=1e-6)
+        # health doc carries the per-rank derived stats for `top`
+        h = s.health()
+        assert h["straggler"]["straggler_rank"] == 1
+        assert h["workers"]["1"]["step_p50_s"] == pytest.approx(0.3)
+
+    def test_wedge_alert_fires_then_resolves(self, tmp_path):
+        """The firing -> resolved pair on a live gang: one rank stalls
+        step progress past the alert threshold while staying alive,
+        then resumes and finishes clean."""
+        worker = _write_gang_worker(tmp_path, """
+            for step in range(6):
+                beat(step, tele(steps=[0.02] * 4))
+                time.sleep(0.05)
+            if rank == 0:
+                # keep the liveness lease fresh but stall the step
+                # counter: past wedge_s the alert must fire
+                for _ in range(18):
+                    beat(5, tele(steps=[0.02] * 4), wedge=True)
+                    time.sleep(0.05)
+            for step in range(6, 10):
+                beat(step, tele(steps=[0.02] * 4))
+                time.sleep(0.05)
+            finish()
+        """)
+        rules = alerts_mod.default_training_rules(wedge_s=0.4)
+        s = _mk_sup(worker, tmp_path, nprocs=2, max_restarts=0,
+                    alert_rules=rules)
+        res = s.run(total_timeout=60)
+        assert res["ok"]
+        transitions = [(e["rule"], e["event"])
+                       for e in s.alerts.events]
+        assert ("gang_wedge_suspect", "firing") in transitions
+        assert ("gang_wedge_suspect", "resolved") in transitions
+        # resolved AFTER firing (the pair, not a flap artifact)
+        assert transitions.index(("gang_wedge_suspect", "firing")) < \
+            transitions.index(("gang_wedge_suspect", "resolved"))
+
+    def test_shrink_prunes_departed_rank_series(self, tmp_path):
+        """Stale-gauge hygiene: after a 4 -> 2 shrink the next scrape
+        serves survivor series only — a frozen gang_seconds_since_step
+        for a dead rank is how false wedge pages happen."""
+        worker = _write_gang_worker(tmp_path, """
+            if rank >= 2 and epoch == 1:
+                for step in range(3):
+                    beat(step, tele(steps=[0.05] * 4))
+                    time.sleep(0.03)
+                sys.exit(3)
+            for step in range(8):
+                beat(step, tele(steps=[0.05] * 4))
+                time.sleep(0.03)
+            finish()
+        """)
+        s = _mk_sup(worker, tmp_path, nprocs=4, max_restarts=2,
+                    replacements=0, valid_sizes=[4, 2, 1])
+        res = s.run(total_timeout=60)
+        assert res["ok"] and s.nprocs == 2
+        reg = metrics_mod.default_registry()
+        text = reg.render_prometheus()
+        assert 'gang_seconds_since_step{rank="0"}' in text
+        assert 'rank="2"' not in text
+        assert 'rank="3"' not in text
+        assert sorted(s.aggregator.members()) == ["0", "1"]
+
+
+# ---------------------------------------------------------------------------
+# joined gang trace
+
+
+class TestTraceMerge:
+    def _trace(self, pid, clock_off, align_key="barrier/sync_params"):
+        """One rank's export: a barrier span at true instant 100s, on a
+        clock skewed by ``clock_off``."""
+        return {
+            "traceEvents": [
+                {"name": "barrier", "ph": "X", "pid": pid, "tid": 1,
+                 "ts": (100.0 + clock_off) * 1e6, "dur": 50_000},
+                {"name": "process_name", "ph": "M", "pid": pid,
+                 "args": {"name": f"rank{pid}"}},       # no ts: legal
+            ],
+            "otherData": {"process_index": pid,
+                          "alignments": {align_key: 100.05 + clock_off}},
+        }
+
+    def test_skewed_clocks_align_to_overlapping_barrier_spans(
+            self, tmp_path):
+        # rank 1's clock runs 3.2s ahead: unmerged, its barrier span
+        # sits 3.2s away from rank 0's; merged, they overlap
+        merged = chrome_trace.merge_traces(
+            [self._trace(0, 0.0), self._trace(1, 3.2)],
+            path=str(tmp_path / "gang.json"))
+        spans = [e for e in merged["traceEvents"]
+                 if e.get("name") == "barrier"]
+        assert len(spans) == 2
+        ts = sorted(e["ts"] for e in spans)
+        assert ts[1] - ts[0] < 1_000          # < 1 ms apart (was 3.2 s)
+        assert merged["otherData"]["offsets_s"]["p1#1"] == \
+            pytest.approx(-3.2, abs=1e-6)
+        assert os.path.exists(tmp_path / "gang.json")
+
+    def test_colliding_pids_remap_to_distinct_tracks(self):
+        merged = chrome_trace.merge_traces(
+            [self._trace(0, 0.0), self._trace(0, 0.0)])
+        pids = {e["pid"] for e in merged["traceEvents"]}
+        assert len(pids) == 2                 # 0 and 1000
+
+    def test_no_shared_alignment_merges_unshifted(self):
+        a = self._trace(0, 0.0)
+        b = self._trace(1, 5.0, align_key="barrier/other")
+        merged = chrome_trace.merge_traces([a, b])
+        assert merged["otherData"]["offsets_s"]["p1#1"] == 0.0
+
+    def test_barrier_stamps_ride_the_export(self, tmp_path):
+        chrome_trace.note_alignment("barrier/step", 123.0)
+        chrome_trace.note_alignment("barrier/step", 999.0)  # first wins
+        doc = chrome_trace.trace_export(
+            str(tmp_path / "t.json"), align=chrome_trace.alignments())
+        assert doc["otherData"]["alignments"]["barrier/step"] == 123.0
+
+
+# ---------------------------------------------------------------------------
+# gang top renderer
+
+
+class TestGangTop:
+    def test_render_frame(self):
+        from paddle_tpu.cli import _render_gang_top
+        health = {
+            "state": "running", "epoch": 2, "gang_size": 2,
+            "restarts": 1,
+            "workers": {
+                "0": {"step": 41, "done": False, "age": 0.2,
+                      "since_step_s": 0.1, "step_p50_s": 0.05,
+                      "barrier_p50_s": 0.01},
+                "1": {"step": 38, "done": False, "age": 0.3,
+                      "since_step_s": 2.0, "step_p50_s": 0.31,
+                      "barrier_p50_s": 0.001}},
+            "straggler": {"straggler_rank": 1, "rule": "barrier",
+                          "skew": {"p50": 0.26, "p99": 0.3}},
+            "goodput": {"goodput_fraction": 0.71,
+                        "wall_accounted_s": 100.0,
+                        "totals": {"useful_step": 71.0,
+                                   "recompile": 9.0}},
+        }
+        alerts = {"firing": [
+            {"rule": "gang_step_skew", "value": 0.26, "op": ">",
+             "threshold": 1.0, "description": "skewed"}]}
+        frame = _render_gang_top(health, alerts)
+        assert "epoch 2" in frame and "restarts 1" in frame
+        assert "goodput 0.710" in frame
+        assert "straggler rank 1 (barrier)" in frame
+        assert "!! gang_step_skew" in frame
+        # per-rank rows sorted by rank, slow rank shows its p50
+        lines = frame.splitlines()
+        r0 = next(l for l in lines if l.startswith("0"))
+        r1 = next(l for l in lines if l.startswith("1"))
+        assert lines.index(r0) < lines.index(r1)
+        assert "0.3100" in r1
+
+    def test_render_empty_gang_does_not_crash(self):
+        from paddle_tpu.cli import _render_gang_top
+        frame = _render_gang_top({}, None)
+        assert "alerts: none firing" in frame
+
+
+# ---------------------------------------------------------------------------
+# the jax trainer end of the telemetry contract (one slow-ish test)
+
+
+class TestTrainerTelemetry:
+    def test_trainer_embeds_telemetry_in_heartbeat(
+            self, tmp_path, monkeypatch):
+        """The real SGD.train under a (simulated) supervisor env: the
+        heartbeat record must carry the telemetry doc — registry
+        snapshot, step window, goodput buckets — and the accountant's
+        buckets must roughly cover the training wall."""
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import layer
+        from paddle_tpu.utils.rng import KeySource
+
+        monkeypatch.setenv(sup.ENV_DIR, str(tmp_path))
+        monkeypatch.setenv("PADDLE_PROCESS_ID", "0")
+        monkeypatch.setenv("PADDLE_ELASTIC_EPOCH", "1")
+
+        x = layer.data("gt_x", paddle.data_type.dense_vector(4))
+        lbl = layer.data("gt_l", paddle.data_type.integer_value(2))
+        h = layer.fc(x, 8, act=paddle.activation.Relu(), name="gt_h")
+        o = layer.fc(h, 2, act=paddle.activation.Softmax(), name="gt_o")
+        cost = layer.classification_cost(o, lbl, name="gt_cost")
+        params = paddle.parameters.create(cost, KeySource(7))
+        tr = paddle.trainer.SGD(
+            cost=cost, parameters=params,
+            update_equation=paddle.optimizer.Momentum(learning_rate=0.1))
+
+        def reader():
+            rs = np.random.RandomState(3)
+            for _ in range(6):
+                y = int(rs.randint(2))
+                yield ((rs.randn(4) + y).astype(np.float32), y)
+
+        tr.train(reader=paddle.batch(reader, batch_size=3),
+                 num_passes=2)
+
+        hb_files = os.listdir(tmp_path / "hb")
+        assert hb_files, "no heartbeat written"
+        rec = json.load(open(tmp_path / "hb" / hb_files[0]))
+        tele = rec.get("telemetry")
+        assert tele, "heartbeat carries no telemetry"
+        assert tele["window"]["step_time_samples"], \
+            "step window empty"
+        assert "train_steps_total" in tele["snapshot"]
+        buckets = tele["goodput"]["buckets"]
+        assert buckets["useful_step"] + buckets["recompile"] > 0
+        assert tele["goodput"]["t_start_wall"] > 0
