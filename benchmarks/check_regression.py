@@ -60,11 +60,16 @@ FAMILIES = {
             # cold TTFT is a single-digit-ms latency on a ONE-core
             # shared host: alternating same-code A/B runs measured
             # 5-45 ms swings purely from harness-process interleaving
-            # (PR-13 calibration), so the 35% band this figure shipped
-            # with fired on machine state, not code — the 2x ceiling
-            # still catches a real structural regression (a chunk-path
-            # pessimization shows up as an order of magnitude)
-            ("cold_prefill.ttft_p50_cold_ms", "lower", 1.0),
+            # (PR-13 calibration), and the 2x prior-run ceiling that
+            # replaced the original 35% band STILL fired on machine
+            # state (PR-18 recalibration: the same commit probed 29 ms
+            # and 67 ms minutes apart; artifact history spans 6-20 ms)
+            # — any prior-run ratio is narrower than the figure's own
+            # variance. Absolute ceiling instead, sized above the
+            # observed same-code range: a real chunk-path
+            # pessimization shows up as an order of magnitude, not a
+            # factor of two
+            ("cold_prefill.ttft_p50_cold_ms", "ceiling", 100.0),
             ("quality.kv_int8_rel_l2", "lower", 0.10),
             ("quality.kv_int4_rel_l2", "lower", 0.10),
             # multi-tenant scheduling + speculative decoding (PR-13
@@ -85,6 +90,16 @@ FAMILIES = {
             # never land silently (present only on --tpu-check runs;
             # SKIP elsewhere by design)
             ("mosaic_lowerable_ok", "true", 0.0),
+            # tiered prefix cache (PR-18 fields; SKIP against older
+            # artifacts by design), both ABSOLUTE bounds on the
+            # 10x-working-set chat trace: the avoided fraction is
+            # counter arithmetic on a fixed trace (deterministic — the
+            # >= 0.5 claim gates outright) and the TTFT ratio is a
+            # same-machine A/B whose 1.0 ceiling is the feature's
+            # existence condition (tiers slower than evict-and-
+            # recompute = demotion/promotion overhead regression)
+            ("cold_prefill_tokens_avoided_frac", "floor", 0.5),
+            ("tiered_ttft_p99_ratio", "ceiling", 1.0),
         ],
     },
     "router": {

@@ -51,6 +51,28 @@ else:
                       "mosaic_ok": tc.get("mosaic_ok"), **oks}))
 PYEOF
 fi
+# latest tiered-prefix-cache figures: cold-prefill blocks the
+# DRAM/disk tiers absorbed + the tiered/baseline TTFT p99 ratio on
+# the 10x-working-set chat trace, from the newest serving artifact
+if [ -n "$latest_serving" ]; then
+    echo "== TIERED PREFIX CACHE ($latest_serving) =="
+    python - "$latest_serving" <<'PYEOF' || true
+import json, sys
+doc = json.load(open(sys.argv[1]))
+tc = doc.get("tiered_cache")
+if not tc:
+    print("no tiered_cache section — rerun serving_bench.py")
+else:
+    print(json.dumps({
+        "cold_prefill_tokens_avoided_frac":
+            doc.get("cold_prefill_tokens_avoided_frac", "n/a"),
+        "tiered_ttft_p99_ratio":
+            doc.get("tiered_ttft_p99_ratio", "n/a"),
+        "working_set_mult": tc.get("working_set_mult"),
+        "tier_hit_blocks": tc.get("tiered", {}).get("tier_hit_blocks"),
+        "demotions": tc.get("tiered", {}).get("demotions")}))
+PYEOF
+fi
 # latest fleet observability-overhead figure: traced/untraced goodput
 # ratio + the chaos-run verdict from the newest serving_fleet artifact
 # (run serving_bench.py --fleet to refresh)

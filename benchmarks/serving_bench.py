@@ -81,6 +81,7 @@ Usage: python benchmarks/serving_bench.py [--requests 96] [--batch 8]
 
 import argparse
 import datetime
+import gc
 import json
 import os
 import sys
@@ -1060,6 +1061,214 @@ def multitenant_phase(args):
     return out
 
 
+def build_chat_workload(n_convos, turns, prefix_tokens, tail_tokens,
+                        max_new, vocab, seed):
+    """[(arrival_s, prompt, max_new)] — a multi-turn chat trace: each
+    conversation carries its OWN ``prefix_tokens``-token system prompt
+    and re-arrives once per turn with a fresh ``tail_tokens`` user
+    message appended. Conversations are ROUND-ROBIN interleaved, so by
+    the time a conversation's next turn lands, every other prefix has
+    marched through the pool — with the working set sized past HBM
+    (``--working-set-mult``) the prefix is always LRU-evicted before
+    its reuse, the regime the tiered spill exists for."""
+    rng = np.random.RandomState(seed)
+    prefixes = [rng.randint(0, vocab, prefix_tokens).astype(np.int32)
+                for _ in range(n_convos)]
+    work = []
+    for _ in range(turns):
+        for c in range(n_convos):
+            tail = rng.randint(0, vocab, tail_tokens).astype(np.int32)
+            work.append((0.0, np.concatenate([prefixes[c], tail]),
+                         max_new))
+    return work
+
+
+def tiered_cache_phase(args):
+    """Tiered prefix cache (HBM -> host DRAM -> disk) vs
+    evict-and-recompute on a multi-turn chat trace whose prefix
+    working set is ``--working-set-mult``x the block pool.
+
+    Both variants replay the SAME saturating trace on the SAME pool
+    size; the baseline's only recourse on prefix reuse is a cold
+    chunked prefill, the tiered engine re-admits demoted blocks
+    through ``import_prefix`` (bitwise — the hit-vs-cold contract
+    crosses tiers). The DRAM arena is sized to ~1/3 of the working
+    set so the disk tier is genuinely exercised, not decorative.
+
+    Figures: ``cold_prefill_tokens_avoided_frac`` (counter-derived,
+    near-deterministic — the fraction of the baseline's cold-prefill
+    block misses the tiers absorbed) and ``tiered_ttft_p99_ratio``
+    (tiered/baseline TTFT p99 — < 1 wherever promotion is cheaper
+    than the prefill FLOPs it replaces). Under ``--smoke`` the phase
+    shrinks the trace and instead pins the BITWISE contract: every
+    tiered-run output identical to a never-evicting big-pool engine's,
+    with DRAM and disk promotions both proven live."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from paddle_tpu.models import transformer
+    from paddle_tpu.observe.compile_tracker import CompileTracker
+    from paddle_tpu.serving import PagedDecodeEngine, sampling
+    mult = max(float(args.working_set_mult), 1.5)
+    if args.smoke:
+        vocab, d_model, layers, heads = 64, 16, 2, 2
+        cache_len, batch = 64, 2
+        bs, chunk, nb, repeats = 8, 16, 12, 1
+        prefix_tokens, tail_tokens, turns, max_new = 16, 8, 2, 3
+        mult = min(mult, 2.0)
+    else:
+        # d_model sized so a 256-token cold prefill costs MATERIAL
+        # compute: the tiers trade a per-block host round-trip
+        # (~size-independent python dispatch) against the prefill
+        # FLOPs it replaces, and a toy width would measure the
+        # dispatch, not the trade the feature exists for
+        vocab, d_model, layers, heads = 256, 192, 2, 6
+        cache_len, batch = 384, 4
+        bs, chunk = 16, 32
+        # two timed replays, not --repeats: the avoided-fraction
+        # figure is counter arithmetic (deterministic), only the TTFT
+        # ratio benefits from a best-of — and each replay pair costs
+        # tens of seconds at this width
+        nb, repeats = 64, max(1, min(2, args.repeats))
+        prefix_tokens, tail_tokens, turns, max_new = 256, 32, 3, 8
+    prefix_blocks = prefix_tokens // bs
+    n_convos = max(2, -(-int(mult * nb) // prefix_blocks))
+    work = build_chat_workload(n_convos, turns, prefix_tokens,
+                               tail_tokens, max_new, vocab,
+                               args.seed + 71)
+    cfg = transformer.TransformerConfig(
+        vocab=vocab, d_model=d_model, n_heads=heads, n_kv_heads=0,
+        n_layers=layers, d_ff=d_model * 4, max_len=cache_len + 32,
+        dtype=jax.numpy.float32, use_rope=True)
+    params = transformer.init_params(jax.random.PRNGKey(args.seed), cfg)
+    prefill_fn, decode_fn = sampling.paged_step_fns(cfg, bs,
+                                                    pallas="off")
+    jpf, jdf = jax.jit(prefill_fn), jax.jit(decode_fn)
+    tracker = CompileTracker(storm_threshold=99)
+    tmp = tempfile.mkdtemp(prefix="paddle_tpu_tiers_bench_")
+    run_seq = [0]
+
+    def mk(tiers=None, num_blocks=nb):
+        pool = transformer.init_block_pool(cfg, num_blocks, bs)
+        return PagedDecodeEngine(
+            jpf, jdf, params, pool, batch=batch, cache_len=cache_len,
+            block_size=bs, chunk_tokens=chunk, num_blocks=num_blocks,
+            seed=0, tracker=tracker, decode_flops=None, tiers=tiers)
+
+    # tier sizing off the REAL pool byte rate: DRAM holds ~1/3 of the
+    # prefix working set (forcing the overflow onto disk), disk holds
+    # the rest with room
+    probe = mk()
+    ws_bytes = int(n_convos * prefix_tokens * probe.kv_bytes_per_token)
+    dram_bytes, disk_bytes = int(ws_bytes * 0.35), int(ws_bytes * 2)
+    # warm every chunk program once (jpf/jdf are shared across engines,
+    # so each timed replay below starts compiled)
+    warm = mk()
+    warm.submit(work[0][1], max_new)
+    warm.run_until_idle()
+
+    def once(tiered):
+        tiers = None
+        if tiered:
+            run_seq[0] += 1
+            d = os.path.join(tmp, f"run{run_seq[0]}")
+            os.makedirs(d)
+            tiers = {"dram_bytes": dram_bytes,
+                     "disk_bytes": disk_bytes, "disk_dir": d}
+        eng = mk(tiers)
+        # GC off for the timed replay: the spill path allocates one
+        # host buffer per demoted/promoted block, and in a process
+        # carrying the earlier phases' object graph each of those
+        # allocations can trigger a full-heap gc scan — a tax on the
+        # tiered variant that scales with BENCH history, not with the
+        # feature (standalone the ratio is ~0.8; late in the full
+        # sweep it read >1 from gc pauses alone)
+        gc.collect()
+        gc.disable()
+        try:
+            reqs, wall, _, occ_blocks = _replay(eng, work)
+        finally:
+            gc.enable()
+        ttft = [r.ttft_s for r in reqs]
+        m = eng.metrics
+        out = {"tokens_per_sec": round(
+                   sum(len(r.tokens) for r in reqs) / wall, 2),
+               "wall_s": round(wall, 3),
+               "ttft_p50_s": round(_pct(ttft, 0.5), 4),
+               "ttft_p99_s": round(_pct(ttft, 0.99), 4),
+               "prefix_hit_blocks": int(m.get(
+                   "engine_prefix_cache_hit_blocks_total").value()),
+               "prefix_miss_blocks": int(m.get(
+                   "engine_prefix_cache_miss_blocks_total").value()),
+               "blocks_in_use_peak": int(max(occ_blocks))}
+        if tiered:
+            out["tier_hit_blocks"] = {
+                t: int(m.get("engine_prefix_tier_hit_blocks_total")
+                       .value(tier=t)) for t in ("hbm", "dram", "disk")}
+            out["demotions"] = {
+                t: int(m.get("engine_tier_demotions_total")
+                       .value(tier=t)) for t in ("dram", "disk")}
+            out["tier_corrupt"] = int(m.get(
+                "engine_tier_corrupt_total").value())
+        assert eng.pool.idle, "block leak after tiered-cache trace"
+        return out, [r.output.tolist() for r in reqs]
+
+    try:
+        runs_t, runs_b = [], []
+        for _ in range(repeats):
+            runs_t.append(once(True))
+            runs_b.append(once(False))
+        best_t = min(runs_t, key=lambda r: r[0]["ttft_p99_s"])
+        best_b = min(runs_b, key=lambda r: r[0]["ttft_p99_s"])
+        if args.smoke:
+            # bitwise across tiers: a never-evicting big-pool engine
+            # serves every request warm — the tiered run (which
+            # demoted, spilled to disk, and promoted back) must emit
+            # IDENTICAL ids for all of them
+            big = mk(num_blocks=len(work) * (
+                -(-(prefix_tokens + tail_tokens + max_new) // bs)) + 8)
+            ref_reqs, _, _, _ = _replay(big, work)
+            ref_out = [r.output.tolist() for r in ref_reqs]
+            assert best_t[1] == ref_out, (
+                "tiered outputs diverged from the big-pool reference "
+                "(hit-vs-cold contract broken across tiers)")
+            assert best_b[1] == ref_out, (
+                "baseline outputs diverged from the big-pool reference")
+        th = best_t[0]["tier_hit_blocks"]
+        assert th["dram"] + th["disk"] > 0, (
+            "tiered trace never promoted a block — the figures would "
+            "certify an idle spill path")
+        assert best_t[0]["tier_corrupt"] == 0, best_t[0]
+        miss_t = best_t[0]["prefix_miss_blocks"]
+        miss_b = best_b[0]["prefix_miss_blocks"]
+        avoided = 1.0 - miss_t / max(miss_b, 1)
+        ratio = (best_t[0]["ttft_p99_s"]
+                 / max(best_b[0]["ttft_p99_s"], 1e-9))
+        out = {"requests": len(work), "conversations": n_convos,
+               "turns": turns, "working_set_mult": round(mult, 2),
+               "num_blocks": nb, "prefix_tokens": prefix_tokens,
+               "dram_bytes": dram_bytes, "disk_bytes": disk_bytes,
+               "tiered": best_t[0], "baseline": best_b[0],
+               "cold_prefill_tokens_avoided_frac": round(avoided, 4),
+               "tiered_ttft_p99_ratio": round(ratio, 4)}
+        if not args.smoke:
+            # the avoided fraction is counter arithmetic on a fixed
+            # trace — assert the >= 0.5 claim outright (the TTFT ratio
+            # breathes with the host and is gated by the sentinel's
+            # absolute ceiling instead)
+            assert avoided >= 0.5, (
+                f"tiers absorbed only {avoided:.1%} of the baseline's "
+                f"cold-prefill misses: {out}")
+            assert th["disk"] > 0, (
+                "disk tier never promoted on the full trace — DRAM "
+                "sizing no longer forces the overflow down a tier")
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _replay_router(router, work):
     """Wall-clock trace replay against a fleet Router (mirrors
     ``_replay``'s arrival discipline; one router.step() per
@@ -1450,6 +1659,11 @@ def main(argv=None):
                          "auto — Pallas on TPU, skipped elsewhere; the "
                          "interpreter is a correctness path, far too "
                          "slow for a timed trace off --smoke)")
+    ap.add_argument("--working-set-mult", type=float, default=10.0,
+                    help="tiered_cache phase: prefix working set as a "
+                         "multiple of the block pool (10x = the "
+                         "capacity-starved regime the HBM->DRAM->disk "
+                         "spill is for)")
     ap.add_argument("--repeats", type=int, default=3,
                     help="replays per (variant, phase); the best run "
                          "is reported (noise-robust on shared hosts)")
@@ -1816,6 +2030,21 @@ def main(argv=None):
         results["multitenant"]["tier_p99_separation_ok"]
     results["goodput_ge_fifo"] = \
         results["multitenant"]["goodput_ge_fifo"]
+
+    # tiered prefix cache (HBM -> DRAM -> disk) vs evict-and-recompute
+    # on the 10x-working-set chat trace; its two figures ride the
+    # artifact top level for the sentinel's absolute floor/ceiling
+    results["tiered_cache"] = tiered_cache_phase(args)
+    line = {"bench": "serving", "phase": "tiered_cache",
+            "platform": jax.default_backend(),
+            **{k: v for k, v in results["tiered_cache"].items()
+               if not isinstance(v, dict)}}
+    print(json.dumps(line), flush=True)
+    metrics_write(**line)
+    results["cold_prefill_tokens_avoided_frac"] = \
+        results["tiered_cache"]["cold_prefill_tokens_avoided_frac"]
+    results["tiered_ttft_p99_ratio"] = \
+        results["tiered_cache"]["tiered_ttft_p99_ratio"]
 
     results["spec_decode"] = spec_phase(args)
     line = {"bench": "serving", "phase": "spec_decode",

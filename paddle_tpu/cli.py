@@ -325,9 +325,18 @@ def job_serve(args):
             print(f"serve: --tenant-budget expects TENANT=TOKENS "
                   f"(TOKENS >= 1), got {spec!r}", file=sys.stderr)
             return 1
+    tiers = None
+    if args.tiers_dram_mb or args.tiers_disk_mb:
+        if args.tiers_disk_mb and not args.tiers_dir:
+            print("serve: --tiers_disk_mb needs --tiers_dir",
+                  file=sys.stderr)
+            return 1
+        tiers = {"dram_bytes": int(args.tiers_dram_mb * 1e6),
+                 "disk_bytes": int(args.tiers_disk_mb * 1e6),
+                 "disk_dir": args.tiers_dir}
     srv = lm_serving.load_lm_artifact(args.model)
     try:
-        eng = srv.engine()
+        eng = srv.engine(tiers=tiers)
     except ValueError as e:
         print(f"serve: {e}", file=sys.stderr)
         return 1
@@ -397,7 +406,8 @@ def job_route(args):
 
     fleet = None
     handles = []
-    router_kw = dict(max_in_flight=args.max_in_flight)
+    router_kw = dict(max_in_flight=args.max_in_flight,
+                     fetch_flops_per_byte=args.fetch_flops_per_byte)
     if args.ttft_slo_ms:
         from paddle_tpu.observe import SloConfig
         router_kw["slo"] = SloConfig(ttft_s=args.ttft_slo_ms / 1000.0,
@@ -554,17 +564,23 @@ def _render_top(health: dict, alerts: dict) -> str:
             p99=fmt(win.get("fleet_ttft_p99_s",
                             win.get("ttft_p99_s")), ".4f"))]
     hdr = (f"{'REPLICA':<12} {'ROLE':<8} {'STATE':<10} {'INFL':>4} "
-           f"{'QUEUE':>5} {'BLOCKS':>11} {'TTFT_P99':>9} {'BURN':>6}")
+           f"{'QUEUE':>5} {'BLOCKS':>11} {'TIERS':>9} {'TTFT_P99':>9} "
+           f"{'BURN':>6}")
     lines.append(hdr)
     for name, rep in sorted((health.get("replicas") or {}).items()):
         used, total = rep.get("blocks_in_use"), rep.get("blocks_total")
         blocks = (f"{used}/{total}" if used is not None
                   and total is not None else "-")
+        tiers = rep.get("tiers") or {}
+        dram, disk = tiers.get("dram"), tiers.get("disk")
+        tier_s = (f"{dram}/{disk}" if dram is not None
+                  and disk is not None else "-")
         lines.append(
             f"{name:<12.12} {fmt(rep.get('role')):<8.8} "
             f"{fmt(rep.get('state')):<10.10} "
             f"{fmt(rep.get('in_flight')):>4} "
             f"{fmt(rep.get('queue_depth')):>5} {blocks:>11} "
+            f"{tier_s:>9} "
             f"{fmt(rep.get('ttft_p99_s'), '.4f'):>9} "
             f"{fmt(rep.get('slo_burn'), '.2f'):>6}")
     firing = (alerts.get("firing") if alerts
@@ -935,6 +951,12 @@ def main(argv=None):
                         "colocated)")
     p.add_argument("--max_in_flight", type=int, default=8,
                    help="job=route: per-replica in-flight cap")
+    p.add_argument("--fetch_flops_per_byte", type=float, default=8.0,
+                   help="job=route: remote-fetch crossover — ship a "
+                        "warm prefix's KV bytes when recomputing them "
+                        "costs more than this many FLOPs per byte "
+                        "shipped (0 = always fetch, huge = always "
+                        "recompute)")
     p.add_argument("--output_path", default=None,
                    help="where job=infer saves outputs (.npz)")
     p.add_argument("--infer_limit", type=int, default=0,
@@ -1006,6 +1028,19 @@ def main(argv=None):
                         "repeatable. Exhaustion queues the tenant's "
                         "requests — it never rejects. Paged-engine "
                         "artifacts only.")
+    p.add_argument("--tiers_dram_mb", type=float, default=0.0,
+                   help="job=serve: host-DRAM spill tier budget in MB "
+                        "(0 disables tiered spill). LRU-evicted prefix "
+                        "blocks demote here instead of vanishing; "
+                        "admissions that miss HBM re-adopt bitwise.")
+    p.add_argument("--tiers_disk_mb", type=float, default=0.0,
+                   help="job=serve: disk spill tier budget in MB below "
+                        "the DRAM tier (needs --tiers_dir; checksummed "
+                        "files, atomic publish, corrupt files served "
+                        "as misses)")
+    p.add_argument("--tiers_dir", default=None,
+                   help="job=serve: directory for the disk spill tier "
+                        "(re-adopted across restarts)")
     args = p.parse_args(argv)
 
     if args.metrics_out:

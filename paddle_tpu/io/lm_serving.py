@@ -511,7 +511,8 @@ class LMServer:
                             host=host, port=port)
 
     def engine(self, *, seed: Optional[int] = None, registry=None,
-               tracker=None, chunk_tokens: Optional[int] = None):
+               tracker=None, chunk_tokens: Optional[int] = None,
+               tiers=None):
         """Continuous-batching engine over this artifact's modules:
         a ``serving.PagedDecodeEngine`` for format-v4 artifacts (paged
         block pool + chunked prefill + prefix cache; the chunk grid is
@@ -598,7 +599,7 @@ class LMServer:
                 decode_flops=self.cost_analysis.get(
                     "engine_decode", {}).get("flops"),
                 pallas_mode=self.meta.get("engine_pallas"),
-                kv_dtype=kvd)
+                kv_dtype=kvd, tiers=tiers)
             spec = self.meta.get("engine_spec")
             if spec:
                 # v5: schedule the SpecDecodeEngine over the stamped
@@ -646,6 +647,10 @@ class LMServer:
                 f"v{self.meta['format_version']}) has no paged engine "
                 f"modules, so prefill cannot be chunked — re-export "
                 f"with save_lm_artifact(..., engine_paged=True)")
+        if tiers is not None:
+            raise ValueError(
+                "tiered spill (tiers=) needs a paged-engine artifact "
+                "— the row arena has no block pool to demote from")
         prefills = {b: jax.export.deserialize(
             self._engine_bins[f"engine_prefill_{b}.bin"]).call
             for b in self.engine_buckets}

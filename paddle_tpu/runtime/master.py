@@ -1066,10 +1066,16 @@ class ServingFleet:
             env.get("PYTHONPATH", "") if env.get("PYTHONPATH") \
             else pkg_root
         for i in range(self.n):
+            # "{name}" in an extra arg expands to this replica's name:
+            # per-replica state that must not be shared (a --tiers_dir
+            # spill directory, say) gets its own path from ONE
+            # args_extra template
+            extra = [a.replace("{name}", f"replica{i}")
+                     for a in self.args_extra]
             self.procs.append(subprocess.Popen(
                 [self.python, "-m", "paddle_tpu", "serve",
                  f"--model={self.model}", "--port=0", "--health_port=0",
-                 *self.args_extra],
+                 *extra],
                 stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
                 text=True, env=env))
         deadline = time.time() + self.startup_timeout_s

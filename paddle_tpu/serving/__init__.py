@@ -15,6 +15,10 @@ Public surface:
   prefix_hit_tokens).
 - :class:`~paddle_tpu.serving.blocks.BlockPool` — host-side block
   allocator / prefix cache the paged engine schedules over.
+- :class:`~paddle_tpu.serving.tiers.TieredStore` — the host-side
+  spill tiers behind the HBM block pool (bounded DRAM arena over a
+  bounded, checksummed disk directory); LRU-evicted prefix blocks
+  demote into it and re-admit bitwise through the import path.
 - :class:`~paddle_tpu.serving.router.Router` — the serving-fleet tier:
   prefix-aware placement over N replicas (content-chain block hashes
   as the routing key), three-state-health-driven drain with
@@ -42,6 +46,8 @@ from paddle_tpu.serving.replica import (  # noqa: F401
     serve_stdio)
 from paddle_tpu.serving.router import (  # noqa: F401
     Router, RouterRequest)
+from paddle_tpu.serving.tiers import (  # noqa: F401
+    TieredStore)
 from paddle_tpu.serving.sampling import (  # noqa: F401
     engine_step_fns, paged_spec_fns, paged_step_fns, sample_tokens,
     spec_accept, spec_verify_tokens)

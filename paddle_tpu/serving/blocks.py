@@ -81,6 +81,14 @@ class BlockPool:
         self._lru: "OrderedDict[int, None]" = OrderedDict()
         self._reserved = 0
         self.evictions = 0                      # lifetime LRU evictions
+        # demotion hook: called as on_evict(block, digest) when alloc()
+        # evicts a refcount-0 cached block, BEFORE the new holder's
+        # refcount is set — the KV bytes still match the digest at that
+        # instant (nothing has scattered over them yet), which is what
+        # lets a tiered store serialize the block on its way out.
+        # unpublish() does NOT fire it: there the bytes are about to
+        # stop matching the digest, so there is nothing worth spilling.
+        self.on_evict = None
 
     # -- occupancy ---------------------------------------------------------
     @property
@@ -156,8 +164,11 @@ class BlockPool:
             b = self._free.popleft()
         elif self._lru:
             b, _ = self._lru.popitem(last=False)      # oldest first
-            del self._index[self._hash.pop(b)]
+            h = self._hash.pop(b)
+            del self._index[h]
             self.evictions += 1
+            if self.on_evict is not None:
+                self.on_evict(b, h)
         else:
             raise RuntimeError("block pool exhausted despite reservation")
         self._ref[b] = 1
@@ -186,6 +197,15 @@ class BlockPool:
                 self._free.append(block)
 
     # -- prefix cache ------------------------------------------------------
+    def cached_digests(self, limit: Optional[int] = None) -> List[bytes]:
+        """Digests currently published in the prefix cache, hottest
+        first (refcount>0 carriers, then LRU newest-to-oldest) — the
+        HBM rows of a fleet cache directory's per-replica listing."""
+        hot = [self._hash[b] for b in self._hash if self._ref[b] > 0]
+        cold = [self._hash[b] for b in reversed(self._lru)]
+        out = hot + cold
+        return out[:limit] if limit else out
+
     def lookup(self, digest: bytes) -> Optional[int]:
         """Cached block for ``digest`` (LRU-parked ones included), or
         None."""

@@ -102,6 +102,14 @@ class EngineRequest:
     #                                     prefix cache (paged engine)
     block_hashes: Optional[List[bytes]] = None  # prompt block digests,
     #                                     memoized at first admission try
+    tier_promote_done: bool = False     # spill-tier promotion attempted
+    #                                     (once per request: a blocked
+    #                                     queue head re-enters admission
+    #                                     every step)
+    tier_promoted_blocks: int = 0       # blocks that promotion just
+    #                                     re-adopted for this request —
+    #                                     admission labels them dram/
+    #                                     disk hits, not hbm
     tokens: List[int] = dataclasses.field(default_factory=list)
     status: str = "queued"              # queued | prefilling (paged,
     #                                     mid-chunk) | running | done
@@ -892,7 +900,8 @@ class PagedDecodeEngine(DecodeEngine):
                  decode_flops: Optional[float] = None,
                  pallas_mode: Optional[str] = None,
                  kv_dtype: Optional[str] = None,
-                 tenant_budgets: Optional[Dict[str, int]] = None):
+                 tenant_budgets: Optional[Dict[str, int]] = None,
+                 tiers=None):
         from paddle_tpu.serving import blocks as _blocks
         bs = int(block_size)
         if bs < 1 or cache_len % bs:
@@ -1023,6 +1032,27 @@ class PagedDecodeEngine(DecodeEngine):
             "engine_kv_blocks_imported_total", "transferred blocks "
             "adopted into the pool via the prefix-cache publish path "
             "(import_prefix — the decode half of disaggregation)")
+        self._m_tier_hits = reg.counter(
+            "engine_prefix_tier_hit_blocks_total", "prompt blocks "
+            "served per tier (label tier): hbm = ordinary prefix-cache "
+            "hit, dram/disk = spilled block re-adopted at admission")
+        self._m_tier_miss = reg.counter(
+            "engine_prefix_tier_miss_blocks_total", "prefix lookups "
+            "that missed a tier (label tier), counted once per "
+            "request's promotion walk — a cold block misses hbm, dram "
+            "AND disk; a disk re-adopt misses hbm and dram")
+        # -- tiered spill store (HBM -> host DRAM -> disk) ---------------
+        # `tiers` is a serving.tiers.TieredStore (tests that want
+        # direct store access) or a kwargs dict for one ({"dram_bytes":
+        # ..., "disk_bytes": ..., "disk_dir": ...}); None (the default)
+        # disables spill entirely — eviction behaves exactly as before.
+        self.tiers = None
+        if tiers is not None:
+            from paddle_tpu.serving import tiers as _tiers
+            self.tiers = (tiers if isinstance(tiers, _tiers.TieredStore)
+                          else _tiers.TieredStore(registry=reg,
+                                                  **dict(tiers)))
+            self.pool.on_evict = self._demote_block
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -1155,8 +1185,8 @@ class PagedDecodeEngine(DecodeEngine):
         return _blocks.prompt_block_hashes(prompt,
                                            self.block_size)[:usable]
 
-    def export_prefix(self, prompt,
-                      trace: Optional[str] = None) -> Optional[bytes]:
+    def export_prefix(self, prompt, trace: Optional[str] = None,
+                      partial: bool = False) -> Optional[bytes]:
         """Serialize ``prompt``'s transferable prefix out of this pool
         — the prefill half of P/D disaggregation. Every prefix block
         must already be published (run the prompt through the scheduler
@@ -1164,21 +1194,56 @@ class PagedDecodeEngine(DecodeEngine):
         prefill publishes the blocks as each chunk lands). Returns
         ``None`` when the prompt has no transferable prefix or any
         block was evicted before serialization — the receiver then
-        falls back to a cold prefill, which is slower but identical."""
+        falls back to a cold prefill, which is slower but identical.
+
+        ``partial=True`` is the fleet cache-fetch mode: serve the
+        LEADING chunk-aligned run from wherever it lives — HBM pool
+        rows AND spilled DRAM/disk-tier payloads mixed in one chain —
+        stopping at the first miss instead of returning None. The
+        receiver cannot tell the sources apart (the spill format is
+        the wire format), and a partial chain still serves hits there
+        because admission stops at its first miss anyway. None only
+        when the leading run is empty."""
         from paddle_tpu.serving import transfer as _transfer
         digests = self.prefix_digests(prompt)
         if not digests:
             return None
-        blk = []
+        names = None
+        items = []
         for h in digests:
             b = self.pool.lookup(h)
-            if b is None:
+            if b is not None:
+                if names is None:
+                    names = [n for n in _transfer.ARRAY_ORDER
+                             if n in self.cache]
+                items.append((h, {
+                    n: np.asarray(_transfer._block_slab(
+                        self.cache[n], int(b), self.block_size))
+                    for n in names}))
+                continue
+            if not partial:
                 return None
-            blk.append(b)
-        payload = _transfer.serialize_blocks(
-            self.cache, blk, digests, self.block_size, self.kv_dtype,
-            trace=trace)
-        self._m_kv_exported.inc(len(blk))
+            got = self.tiers.get(h) if self.tiers is not None else None
+            if got is None:
+                break
+            try:
+                meta, sub = _transfer.deserialize_blocks(got[1])
+                _transfer.check_pool_match(meta, self.cache,
+                                           self.block_size,
+                                           self.kv_dtype)
+                if len(sub) != 1 or sub[0][0] != h:
+                    raise ValueError("spill payload digest mismatch")
+            except (ValueError, KeyError):
+                self.tiers.quarantine(h)
+                break
+            items.append(sub[0])
+        if not items:
+            return None
+        payload = _transfer.serialize_raw_blocks(
+            _transfer.pool_meta(self.cache, self.block_size,
+                                self.kv_dtype),
+            items, trace=trace)
+        self._m_kv_exported.inc(len(items))
         return payload
 
     def import_prefix(self, payload: bytes) -> int:
@@ -1242,6 +1307,93 @@ class PagedDecodeEngine(DecodeEngine):
                 str(meta["trace"]),
                 args={"blocks": n, "chain": len(blocks)})
         return n
+
+    # -- tiered spill (HBM -> host DRAM -> disk) ---------------------------
+    def _demote_block(self, block: int, digest: bytes):
+        """``pool.on_evict`` hook: serialize the LRU-evicted cached
+        block with the transfer wire (the spill format IS the wire
+        format) and park it in the DRAM/disk tiers. Fires inside
+        ``alloc()`` BEFORE the new holder scatters over the rows, so
+        the bytes still match the digest. Never raises into the
+        allocation path — a failed spill is just a lost cache entry,
+        exactly what eviction meant before tiers existed."""
+        from paddle_tpu.serving import transfer as _transfer
+        try:
+            payload = _transfer.serialize_blocks(
+                self.cache, [block], [digest], self.block_size,
+                self.kv_dtype)
+            self.tiers.put(digest, payload)
+        except Exception:
+            pass
+
+    def _promote_for(self, req: EngineRequest):
+        """Re-adopt ``req``'s spilled prefix from the DRAM/disk tiers
+        into the pool at the moment admission is guaranteed, so the
+        re-plan sees the promoted blocks as ordinary prefix-cache hits
+        and the PR-6 bitwise hit-vs-cold contract carries across tiers
+        unchanged.
+        Walks the chain to the chunk-aligned hit cap and stops at the
+        first full miss (a chain with a hole serves no hits past it).
+        Runs ONCE per request (``tier_promote_done``); a corrupt or
+        stamp-mismatched payload is quarantined and treated as the
+        miss it is — never an exception on the admission path."""
+        from paddle_tpu.serving import blocks as _blocks
+        from paddle_tpu.serving import transfer as _transfer
+        req.tier_promote_done = True
+        bs = self.block_size
+        hashes = req.block_hashes
+        if hashes is None:
+            hashes = _blocks.prompt_block_hashes(req.prompt, bs)
+            req.block_hashes = hashes
+        per = self.chunk_tokens // bs
+        usable = ((int(req.prompt.size) - 1) // self.chunk_tokens) * per
+        chain_blocks = set()
+        pending = []
+        promoted = 0
+        for h in hashes[:usable]:
+            existing = self.pool.lookup(h)
+            if existing is not None:
+                chain_blocks.add(existing)
+                continue
+            self._m_tier_miss.inc(tier="hbm")
+            got = self.tiers.get(h)
+            if got is None:
+                self._m_tier_miss.inc(tier="dram")
+                self._m_tier_miss.inc(tier="disk")
+                break
+            tier, payload = got
+            if tier == "disk":
+                self._m_tier_miss.inc(tier="dram")
+            try:
+                meta, items = _transfer.deserialize_blocks(payload)
+                _transfer.check_pool_match(meta, self.cache, bs,
+                                           self.kv_dtype)
+                if len(items) != 1 or items[0][0] != h:
+                    raise ValueError("spill payload digest mismatch")
+            except (ValueError, KeyError):
+                self.tiers.quarantine(h)
+                break
+            if not self.pool.can_reserve(1):
+                break
+            if (self.pool.free_count == 0
+                    and self.pool.lru_oldest() in chain_blocks):
+                # same guard as import_prefix: adopting one more block
+                # must not evict this chain's own head
+                break
+            self.pool.reserve(1)
+            b = self.pool.alloc()
+            pending.append((b, items[0][1]))
+            self.pool.publish(h, b)
+            self.pool.release(b)        # refcount 0 + published: parks
+            chain_blocks.add(b)         # in the LRU, hit-ready
+            self._m_tier_hits.inc(tier=tier)
+            promoted += 1
+        self.cache = _transfer.write_blocks(self.cache, pending, bs)
+        req.tier_promoted_blocks = promoted
+        if promoted:
+            self._m_kv_imported.inc(promoted)
+            self._ev(req, "tier_promote", "n", time.perf_counter(),
+                     blocks=promoted)
 
     @property
     def preempted_count(self) -> int:
@@ -1344,6 +1496,19 @@ class PagedDecodeEngine(DecodeEngine):
         _, _, need, revive = plan
         if not self.pool.can_reserve(need + revive):
             return False
+        # promote ONLY once admission is certain: a promoted block
+        # parks refcount-0 in the LRU, and a queued request's wait can
+        # outlive that parking (other requests' allocs would evict the
+        # promotion before it ever served a hit). Promotion keeps the
+        # reservation check's ground truth intact — each promoted
+        # block moves free -> LRU (allocatable unchanged) and its
+        # digest moves need -> revive (the sum unchanged) — so the
+        # can_reserve verdict above still stands; only the hit list
+        # needs recomputing.
+        if self.tiers is not None and not req.tier_promote_done:
+            self._promote_for(req)
+            if req.tier_promoted_blocks:
+                plan = self._admission_plan(req)
         self._admit_request(req, finished, plan)
         return True
 
@@ -1367,6 +1532,13 @@ class PagedDecodeEngine(DecodeEngine):
         self._slot_prefill_s[slot] = 0.0
         req.prefix_hit_tokens = len(hits) * self.block_size
         self._m_prefix_hits.inc(len(hits))
+        # tier-labeled hit split: blocks _promote_for just re-adopted
+        # were dram/disk hits (counted there); the rest were warm in
+        # HBM all along
+        hbm_hits = len(hits) - req.tier_promoted_blocks
+        req.tier_promoted_blocks = 0
+        if hbm_hits > 0:
+            self._m_tier_hits.inc(hbm_hits, tier="hbm")
         # misses are counted as chunks actually run cold
         # (_prefill_chunk): a block published by a CONCURRENT
         # same-prefix request mid-prefill is adopted, not missed
@@ -1683,6 +1855,7 @@ class PagedDecodeEngine(DecodeEngine):
         self._slot_off[slot] = off + K
         req.prefix_hit_tokens += K
         self._m_prefix_hits.inc(len(blocks))
+        self._m_tier_hits.inc(len(blocks), tier="hbm")
         self._ev(req, "prefix_adopt", "n", time.perf_counter(),
                  hit_blocks=len(blocks), tokens=K)
         return True
@@ -1862,6 +2035,20 @@ class PagedDecodeEngine(DecodeEngine):
                     "pool_bytes": self.pool_bytes,
                     "preempted_queued": len(self._preempted),
                     "preemptions": int(self._m_preempts.value())})
+        # per-token decode FLOPs: the recompute cost the fleet router's
+        # fetch-vs-recompute crossover weighs against kv_bytes_per_token
+        if self.decode_flops:
+            doc["flops_per_token"] = float(self.decode_flops) \
+                / max(self.batch, 1)
+        # tier section: occupancy + a capped newest-first digest listing
+        # per tier (hbm included) — what the router scrapes into its
+        # fleet-global cache directory. Present even without a spill
+        # store so an HBM-only replica still advertises its warm set.
+        tiers_doc = (self.tiers.health() if self.tiers is not None
+                     else {"digests": {}})
+        tiers_doc["digests"]["hbm"] = [
+            d.hex() for d in self.pool.cached_digests(512)]
+        doc["tiers"] = tiers_doc
         tenants = sorted(set(self._tenant_used)
                          | set(self.tenant_budgets))
         if tenants:
@@ -1914,6 +2101,13 @@ class SpecDecodeEngine(PagedDecodeEngine):
                  verify: Callable, draft_verify: Callable, spec_k: int,
                  tracker: Optional[_ct.CompileTracker] = None,
                  **kw):
+        if kw.get("tiers") is not None:
+            # a spilled payload carries only TARGET pool rows; adopting
+            # one would leave the draft pool's rows beside it stale —
+            # the same desync import_prefix refuses below
+            raise ValueError("SpecDecodeEngine does not support tiered "
+                             "spill (draft pool rows cannot ride the "
+                             "single-pool payload)")
         if tracker is None and "chunk_tokens" in kw:
             # the spec engine legitimately compiles roughly TWICE the
             # paged chunk-grid set (target + draft prefill programs)

@@ -200,6 +200,23 @@ class EngineLoop:
             reply.write({"id": xid, "op": "export_prefix",
                          "payload": None, "blocks": 0})
             return
+        if r.get("warm_only"):
+            # fleet cache-directory fetch: serve whatever leading run
+            # is warm HERE (HBM pool + DRAM/disk spill tiers mixed),
+            # never warming the prompt up locally — the requester asked
+            # for our cache, not our compute. An empty run is an empty
+            # payload; the fetcher falls back to a cold prefill.
+            payload = eng.export_prefix(prompt, trace=r.get("trace"),
+                                        partial=True)
+            if payload is None:
+                reply.write({"id": xid, "op": "export_prefix",
+                             "payload": None, "blocks": 0})
+            else:
+                from paddle_tpu.serving import transfer as _transfer
+                meta, _ = _transfer.deserialize_blocks(payload)
+                reply.write(self._export_doc(
+                    xid, payload, len(meta["digests"])))
+            return
         payload = eng.export_prefix(prompt, trace=r.get("trace"))
         if payload is not None:      # prefix already hot: serialize now
             reply.write(self._export_doc(xid, payload, len(digests)))

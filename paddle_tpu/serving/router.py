@@ -102,6 +102,11 @@ def fleet_keying(handles, default_block_size: int = 16,
 REPLICA_STATES = ("ok", "degraded", "unhealthy", "dead")
 _STATE_RANK = {"ok": 3, "degraded": 2, "unhealthy": 1, "dead": 0}
 
+# cache tiers, fastest first — directory entries prefer the fastest
+# replica holding a digest; a fetch is priced off the SLOWEST tier in
+# the source's leading run
+_TIER_RANK = {"hbm": 0, "dram": 1, "disk": 2}
+
 
 @dataclasses.dataclass
 class RouterRequest:
@@ -171,6 +176,12 @@ class _Replica:
         self.cap = int(cap)
         self.hot: "OrderedDict" = OrderedDict()
         self.hot_cap = int(hot_cap)
+        # digest -> tier ("hbm" | "dram" | "disk"): the replica's OWN
+        # advertisement of what it holds warm at any cache tier, rebuilt
+        # from each /healthz scrape's `tiers.digests` listing. `hot` is
+        # the router's placement-side guess; `warm` is ground truth on
+        # the scrape cadence — prefix scoring unions both.
+        self.warm: Dict[bytes, str] = {}
 
     @property
     def in_flight(self) -> int:
@@ -187,15 +198,31 @@ class _Replica:
         while len(self.hot) > self.hot_cap:
             self.hot.popitem(last=False)
 
-    def prefix_score(self, digests) -> int:
-        """Length of the LEADING digest run hot on this replica — the
-        same stop-at-first-miss walk engine admission does."""
-        n = 0
+    def warm_tier(self, digest) -> Optional[str]:
+        """The fastest tier this replica holds ``digest`` at, or None.
+        A placement-marked hot digest counts as HBM (the engine will
+        promote from its own DRAM/disk on admission anyway, so any
+        local tier serves hits without router help)."""
+        if digest in self.hot:
+            return "hbm"
+        return self.warm.get(digest)
+
+    def prefix_run(self, digests) -> Tuple[int, Optional[str]]:
+        """(length, deepest tier) of the LEADING digest run warm at
+        ANY local tier — the same stop-at-first-miss walk engine
+        admission does. The deepest tier prices a remote fetch."""
+        n, deepest = 0, None
         for d in digests:
-            if d not in self.hot:
+            t = self.warm_tier(d)
+            if t is None:
                 break
             n += 1
-        return n
+            if deepest is None or _TIER_RANK[t] > _TIER_RANK[deepest]:
+                deepest = t
+        return n, deepest
+
+    def prefix_score(self, digests) -> int:
+        return self.prefix_run(digests)[0]
 
 
 class Router:
@@ -216,7 +243,8 @@ class Router:
                  slo: Optional[SloConfig] = None,
                  trace: bool = True, aggregate: bool = True,
                  fleet_jsonl: Optional[str] = None,
-                 alert_rules: Optional[Sequence] = None):
+                 alert_rules: Optional[Sequence] = None,
+                 fetch_flops_per_byte: float = 8.0):
         if not replicas:
             raise ValueError("router needs at least one replica")
         bs, chunk = int(block_size), int(chunk_tokens)
@@ -313,6 +341,20 @@ class Router:
             "router_placement_hit_rate", "fraction of generate "
             "placements that landed on a replica with a hot "
             "leading-digest run — the prefix-hit-rate alert's input")
+        self._m_kv_fetches = reg.counter(
+            "router_kv_fetches_total", "remote prefix fetches placed "
+            "through the fleet cache directory, labeled by the "
+            "DEEPEST tier in the source's leading run (the tier that "
+            "priced the fetch)")
+        self._m_dir_size = reg.gauge(
+            "router_directory_size", "distinct digests the fleet "
+            "cache directory currently maps to a live replica+tier")
+        # fetch-vs-recompute crossover: ship the prefix's KV bytes when
+        # recomputing a token costs more than `fetch_flops_per_byte`
+        # device FLOPs per wire byte shipped (both sides linear in
+        # prefix tokens, so the tokens cancel). 0 fetches whenever a
+        # source exists; float("inf") disables fetching entirely.
+        self.fetch_flops_per_byte = float(fetch_flops_per_byte)
         for st in self._all:
             self._m_state.set(_STATE_RANK[st.state], replica=st.name)
         # -- fleet observability plane ------------------------------------
@@ -598,6 +640,20 @@ class Router:
                 continue    # endpoint unreachable: state unknown,
             #                 liveness stays the transport's verdict
             st.last_health = doc
+            # fleet cache directory feed: the replica's /healthz tiers
+            # section lists its warm digests per tier (hbm listing
+            # capped at the engine); rebuild — not merge — so entries
+            # the replica evicted are pruned on this same cadence
+            tiers = (doc.get("tiers") or {}).get("digests") or {}
+            if tiers:
+                warm: Dict[bytes, str] = {}
+                for tname in ("disk", "dram", "hbm"):   # fastest wins
+                    for hexd in tiers.get(tname, ()):
+                        try:
+                            warm[bytes.fromhex(hexd)] = tname
+                        except ValueError:
+                            pass
+                st.warm = warm
             status = doc.get("status", "ok")
             if not doc.get("healthy", True):
                 status = "unhealthy"
@@ -616,6 +672,11 @@ class Router:
         if st.state == "dead":
             return
         st.state = "dead"
+        # prune the dead member's directory entries immediately: a
+        # fetch routed at a corpse would just bounce through the
+        # requeue path, and `directory()` must never advertise one
+        st.warm = {}
+        st.hot.clear()
         self._m_state.set(0, replica=st.name)
         self._m_drains.inc(reason="dead")
         now = time.perf_counter()
@@ -674,6 +735,7 @@ class Router:
                 st.name, state=st.state,
                 health=st.last_health or None, snapshot=snapshot)
         self.fleet.finish_scrape()
+        self._m_dir_size.set(len(self.directory()))
         self._update_gauges()
         self._update_window_gauges()    # burn gauge feeds the TTFT rule
         self.alerts.evaluate()
@@ -719,6 +781,31 @@ class Router:
     def _place_one(self, req: RouterRequest) -> bool:
         if req.payload is not None:
             return self._place_decode(req)
+        if (req.usable and req.prefill_replica is None
+                and not self._warm_on_placeable_decode(req)):
+            # fleet cache directory: the prefix is cold on every decode
+            # replica that could take this request, but may be warm
+            # SOMEWHERE — another replica's HBM, DRAM or disk. Fetch it
+            # over the transfer wire when shipping bytes beats
+            # recomputing FLOPs (the crossover knob); the payload comes
+            # back through the ordinary export relay and ships ahead of
+            # the generate op like a P/D prefill would.
+            src, run, tier = self._pick_fetch_source(req)
+            if src is not None and self._fetch_pays(src):
+                spec = {"id": req.xid, "op": "export_prefix",
+                        "warm_only": True,
+                        "prompt": [int(t) for t in req.prompt]}
+                if req.trace_id:
+                    spec["trace"] = req.trace_id
+                src.handle.submit(spec)
+                src.outstanding[req.xid] = (req, "export")
+                req.status = "prefill"
+                req.prefill_replica = src.name
+                self._m_kv_fetches.inc(tier=tier)
+                self._rev(req, "place", "n", time.perf_counter(),
+                          kind="fetch", replica=src.name,
+                          blocks=run, tier=tier)
+                return True
         if (self._prefill and req.usable
                 and req.prefill_replica is None
                 and not self._hot_anywhere(req)):
@@ -740,6 +827,73 @@ class Router:
             # no prefill capacity: colocated fallback — correctness
             # (and latency) must not wait on the prefill tier
         return self._place_decode(req)
+
+    def _warm_on_placeable_decode(self, req: RouterRequest) -> bool:
+        """True when a decode replica that could take this request NOW
+        (live, under its cap) holds a leading run warm at any local
+        tier — placement lands there and local hits/promotion serve
+        it, so a remote fetch would only burn wire bytes."""
+        usable = req.digests[:req.usable]
+        return any(st.prefix_score(usable) > 0
+                   for st in self._decode
+                   if st.state in ("ok", "degraded")
+                   and st.in_flight < st.cap)
+
+    def _pick_fetch_source(self, req: RouterRequest):
+        """Best remote source for ``req``'s prefix: the live replica
+        (any role — a capped decode replica or the prefill tier both
+        qualify) with the longest leading warm run; ties prefer the
+        least loaded. Returns (replica, run_blocks, deepest_tier) or
+        (None, 0, None)."""
+        usable = req.digests[:req.usable]
+        best, best_key, best_run = None, None, (0, None)
+        for st in self._all:
+            if st.state not in ("ok", "degraded"):
+                continue
+            n, deepest = st.prefix_run(usable)
+            if n <= 0:
+                continue
+            key = (n, -st.in_flight)
+            if best_key is None or key > best_key:
+                best, best_key, best_run = st, key, (n, deepest)
+        return best, best_run[0], best_run[1]
+
+    def _fetch_pays(self, src) -> bool:
+        """The bytes-shipped-vs-FLOPs-recomputed crossover. Both sides
+        are linear in prefix tokens (`kv_bytes_per_token` wire bytes
+        vs `flops_per_token` recompute), so the prefix length cancels
+        and the decision is a per-token rate comparison against the
+        ``fetch_flops_per_byte`` knob. Missing health figures (row
+        engine, no scrape yet) fail toward recompute — the behavior
+        the fleet had before the directory existed."""
+        if self.fetch_flops_per_byte == 0:
+            return True
+        doc = src.last_health or {}
+        flops = doc.get("flops_per_token")
+        kvb = doc.get("kv_bytes_per_token")
+        if not flops or not kvb:
+            return False
+        return float(flops) >= self.fetch_flops_per_byte * float(kvb)
+
+    def directory(self) -> Dict[str, dict]:
+        """The fleet-global cache directory: digest hex -> {replica,
+        tier} over every LIVE replica's advertised warm set (hot-set
+        entries count as hbm), preferring the fastest tier when a
+        digest is warm in several places. Dead replicas never appear —
+        their entries are pruned the moment death is detected."""
+        out: Dict[str, dict] = {}
+        for st in self._all:
+            if st.state == "dead":
+                continue
+            for d in st.hot:
+                cur = out.get(d.hex())
+                if cur is None or _TIER_RANK[cur["tier"]] > 0:
+                    out[d.hex()] = {"replica": st.name, "tier": "hbm"}
+            for d, t in st.warm.items():
+                cur = out.get(d.hex())
+                if cur is None or _TIER_RANK[t] < _TIER_RANK[cur["tier"]]:
+                    out[d.hex()] = {"replica": st.name, "tier": t}
+        return out
 
     def _hot_anywhere(self, req: RouterRequest) -> bool:
         """True when some decode replica already holds the whole
@@ -871,8 +1025,13 @@ class Router:
                     "ttft_p99_s": ((st.last_health or {}).get("window")
                                    or {}).get("ttft_p99_s"),
                     "slo_burn": ((st.last_health or {}).get("slo")
-                                 or {}).get("ttft_burn_rate")}
+                                 or {}).get("ttft_burn_rate"),
+                    "tiers": {
+                        t: ((st.last_health or {}).get("tiers") or {})
+                        .get(t, {}).get("entries")
+                        for t in ("dram", "disk")}}
                 for st in self._all},
+            "directory_size": len(self.directory()),
             "queue_depth": len(self._queue),
             "requests": int(self._m_requests.value()),
             "completed": self._n_completed,

@@ -76,6 +76,29 @@ def pool_meta(cache, block_size: int, kv_dtype: str = "none") -> dict:
             "block_size": int(block_size), "arrays": arrays}
 
 
+def serialize_raw_blocks(meta: dict,
+                         items: Sequence[Tuple[bytes, Dict[str, np.ndarray]]],
+                         trace: Optional[str] = None) -> bytes:
+    """Pack already-materialized ``(digest, {name: slab})`` pairs under
+    a prebuilt :func:`pool_meta` stamp. This is :func:`serialize_blocks`
+    with the pool-slicing step factored out, so a sender can mix slabs
+    read from its HBM pool with slabs round-tripped through a spill
+    tier (``serving/tiers.py``) in ONE chain-ordered payload — the
+    receiving side cannot tell the difference, which is the point."""
+    meta = dict(meta)
+    meta["digests"] = [bytes(d).hex() for d, _ in items]
+    if trace:
+        meta["trace"] = str(trace)
+    names = [n for n in ARRAY_ORDER if n in meta["arrays"]]
+    header = json.dumps(meta).encode("utf-8")
+    out = [MAGIC, struct.pack("<II", VERSION, len(header)), header]
+    for _, arrays in items:
+        for n in names:
+            out.append(np.ascontiguousarray(
+                np.asarray(arrays[n])).tobytes())
+    return b"".join(out)
+
+
 def serialize_blocks(cache, block_ids: Sequence[int],
                      digests: Sequence[bytes], block_size: int,
                      kv_dtype: str = "none",

@@ -1,0 +1,426 @@
+"""Tiered prefix-cache spill (HBM pool -> host DRAM -> disk) and the
+fleet-global cache directory over it.
+
+Contracts pinned here:
+
+- ``serving.tiers.TieredStore`` — bounded DRAM LRU over a bounded,
+  checksummed disk directory: demotion cascades, budget evictions,
+  atomic publish, restart re-scan, and the robustness contract (a
+  corrupt/truncated disk file is a quarantined MISS, never an
+  exception).
+- The engine's demote-on-evict / promote-on-admit loop: a prefix
+  evicted to DRAM or disk re-admits through the ordinary
+  ``import_prefix`` publish path and serves BITWISE the cold-prefill
+  tokens — the PR-6 hit-vs-cold contract crosses tiers, on fp32 AND
+  int8 pools (the wire format IS the spill format, so quantized
+  payloads ride for free).
+- The router as cache directory: digests warm on ANY live replica are
+  never cold-prefilled when the bytes-vs-FLOPs crossover says fetch;
+  dead replicas' directory entries vanish; a source dying mid-fetch
+  degrades to the colocated cold path with zero lost requests.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving.blocks import prompt_block_hashes
+from paddle_tpu.serving.tiers import TieredStore
+
+
+def _payload(seed, n=600):
+    return np.random.RandomState(seed).bytes(n)
+
+
+# -- TieredStore (pure host state) ------------------------------------------
+
+class TestTieredStore:
+    def test_dram_roundtrip_bitwise(self, tmp_path):
+        st = TieredStore(dram_bytes=1 << 20, disk_bytes=1 << 20,
+                         disk_dir=str(tmp_path))
+        pay = _payload(0)
+        st.put(b"a" * 16, pay)
+        assert st.tier_of(b"a" * 16) == "dram"
+        tier, got = st.get(b"a" * 16)
+        assert (tier, got) == ("dram", pay)
+        assert st.get(b"x" * 16) is None
+
+    def test_dram_pressure_cascades_to_disk_oldest_first(self, tmp_path):
+        pay = _payload(1)
+        st = TieredStore(dram_bytes=len(pay) * 2 + 10,
+                         disk_bytes=1 << 20, disk_dir=str(tmp_path))
+        digests = [bytes([i]) * 16 for i in range(4)]
+        for i, d in enumerate(digests):
+            st.put(d, _payload(10 + i, len(pay)))
+        # DRAM holds the two newest, the two oldest demoted to disk
+        assert st.tier_of(digests[3]) == "dram"
+        assert st.tier_of(digests[2]) == "dram"
+        assert st.tier_of(digests[0]) == "disk"
+        assert st.tier_of(digests[1]) == "disk"
+        tier, got = st.get(digests[0])
+        assert tier == "disk" and got == _payload(10, len(pay))
+
+    def test_disk_budget_evicts_oldest(self, tmp_path):
+        pay = _payload(2, 500)
+        blob = len(pay) + 20          # magic + checksum overhead
+        st = TieredStore(dram_bytes=0, disk_bytes=blob * 2 + 10,
+                         disk_dir=str(tmp_path))
+        digests = [bytes([i]) * 16 for i in range(4)]
+        for i, d in enumerate(digests):
+            st.put(d, _payload(20 + i, len(pay)))
+        assert st.tier_of(digests[0]) is None      # evicted
+        assert st.tier_of(digests[1]) is None
+        assert st.tier_of(digests[3]) == "disk"
+        assert st.disk_used <= blob * 2 + 10
+
+    def test_restart_scan_readopts_and_clears_temps(self, tmp_path):
+        st = TieredStore(dram_bytes=0, disk_bytes=1 << 20,
+                         disk_dir=str(tmp_path))
+        st.put(b"a" * 16, _payload(3))
+        st.put(b"b" * 16, _payload(4))
+        # a writer died mid-spill: its temp must never be adopted
+        (tmp_path / ".tmp-deadbeef.123").write_bytes(b"torn")
+        st2 = TieredStore(dram_bytes=0, disk_bytes=1 << 20,
+                          disk_dir=str(tmp_path))
+        assert st2.tier_of(b"a" * 16) == "disk"
+        tier, got = st2.get(b"b" * 16)
+        assert tier == "disk" and got == _payload(4)
+        assert not list(tmp_path.glob(".tmp-*"))
+
+    def test_bit_flip_is_quarantined_miss(self, tmp_path):
+        """The robustness satellite, pinned: flip ONE byte in a spilled
+        file — the read is a miss (None), the file is renamed
+        ``*.corrupt``, the corrupt counter increments, and no
+        exception escapes."""
+        st = TieredStore(dram_bytes=0, disk_bytes=1 << 20,
+                         disk_dir=str(tmp_path))
+        st.put(b"a" * 16, _payload(5))
+        [f] = list(tmp_path.glob("*.kv"))
+        raw = bytearray(f.read_bytes())
+        raw[len(raw) // 2] ^= 0x40
+        f.write_bytes(bytes(raw))
+        assert st.get(b"a" * 16) is None
+        assert st.tier_of(b"a" * 16) is None
+        assert not list(tmp_path.glob("*.kv"))
+        assert list(tmp_path.glob("*.corrupt"))
+        assert st.metrics.get(
+            "engine_tier_corrupt_total").value() == 1
+
+    def test_truncated_file_is_quarantined_miss(self, tmp_path):
+        st = TieredStore(dram_bytes=0, disk_bytes=1 << 20,
+                         disk_dir=str(tmp_path))
+        st.put(b"a" * 16, _payload(6))
+        [f] = list(tmp_path.glob("*.kv"))
+        f.write_bytes(f.read_bytes()[:25])
+        assert st.get(b"a" * 16) is None
+        assert st.metrics.get(
+            "engine_tier_corrupt_total").value() == 1
+
+    def test_dram_only_overflow_drops(self):
+        pay = _payload(7)
+        st = TieredStore(dram_bytes=len(pay) + 10)   # no disk tier
+        st.put(b"a" * 16, pay)
+        st.put(b"b" * 16, _payload(8, len(pay)))
+        assert st.tier_of(b"a" * 16) is None         # dropped, not kept
+        assert st.tier_of(b"b" * 16) == "dram"
+        assert st.metrics.get(
+            "engine_tier_evictions_total").value(tier="dram") == 1
+
+    def test_gauges_track_occupancy(self, tmp_path):
+        st = TieredStore(dram_bytes=1 << 20, disk_bytes=1 << 20,
+                         disk_dir=str(tmp_path))
+        st.put(b"a" * 16, _payload(9))
+        g = st.metrics.get("engine_tier_bytes")
+        assert g.value(tier="dram") > 0
+        assert g.value(tier="disk") == 0
+        assert st.metrics.get(
+            "engine_tier_entries").value(tier="dram") == 1
+
+
+# -- engine demote/promote loop (tiny jitted model) -------------------------
+
+def _cfg():
+    import jax.numpy as jnp
+    from paddle_tpu.models import transformer
+    return transformer.TransformerConfig(
+        vocab=40, d_model=16, n_heads=2, n_kv_heads=1, n_layers=2,
+        d_ff=32, max_len=64, dtype=jnp.float32, use_rope=True)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    import jax
+    from paddle_tpu.models import transformer
+    cfg = _cfg()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+_PROGRAMS = {}
+
+
+def _mk_engine(lm, *, num_blocks=12, kv_dtype=None, tiers=None):
+    import jax
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving import PagedDecodeEngine, sampling
+    params, cfg = lm
+    if not _PROGRAMS:     # one jitted pair for every engine/pool dtype
+        pf, df = sampling.paged_step_fns(cfg, 8, pallas="off")
+        _PROGRAMS["fns"] = (jax.jit(pf), jax.jit(df))
+    jpf, jdf = _PROGRAMS["fns"]
+    pool = transformer.init_block_pool(cfg, num_blocks, 8,
+                                       kv_dtype=kv_dtype)
+    return PagedDecodeEngine(
+        jpf, jdf, params, pool, batch=2, cache_len=64, block_size=8,
+        num_blocks=num_blocks, chunk_tokens=16, seed=0,
+        decode_flops=1e6, pallas_mode="off", kv_dtype=kv_dtype,
+        tiers=tiers)
+
+
+def _run(eng, prompt, max_new=4):
+    r = eng.submit(prompt, max_new)
+    eng.run_until_idle()
+    return list(r.output)
+
+
+def _churn(eng, n=6, seed=100, vocab=40):
+    """Push unrelated prompts through until the pool's LRU has turned
+    over (every previously cached block demoted)."""
+    for i in range(n):
+        p = np.random.RandomState(seed + i).randint(
+            0, vocab, 30).astype(np.int32)
+        _run(eng, p, 2)
+
+
+def _warm_prompt(seed=7, vocab=40):
+    rng = np.random.RandomState(seed)
+    prefix = rng.randint(0, vocab, 16).astype(np.int32)
+    tail = rng.randint(0, vocab, 8).astype(np.int32)
+    return np.concatenate([prefix, tail])
+
+
+class TestTieredEngine:
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_dram_readopt_bitwise(self, lm, kv_dtype):
+        """The acceptance contract at the engine tier: warm, LRU-evict
+        (demote to DRAM), resubmit — output BITWISE the cold run, with
+        the dram hit counter proving promotion served it (the pool's
+        own cache was fully churned)."""
+        prompt = _warm_prompt()
+        want = _run(_mk_engine(lm, kv_dtype=kv_dtype), prompt)
+        eng = _mk_engine(lm, kv_dtype=kv_dtype,
+                         tiers={"dram_bytes": 1 << 20})
+        assert _run(eng, prompt) == want            # cold, tiers idle
+        _churn(eng)
+        assert eng.metrics.get(
+            "engine_tier_demotions_total").value(tier="dram") > 0
+        assert eng.pool.lookup(bytes.fromhex(
+            eng.tiers.digests()["dram"][0])) is None
+        assert _run(eng, prompt) == want            # promoted, bitwise
+        hits = eng.metrics.get("engine_prefix_tier_hit_blocks_total")
+        assert hits.value(tier="dram") >= 2
+        assert eng.metrics.get(
+            "engine_prefix_cache_hit_blocks_total").value() >= 2
+
+    def test_disk_readopt_bitwise(self, lm, tmp_path):
+        """Same contract one tier down: a DRAM arena too small for the
+        working set spills to disk; the disk promotion (checksummed
+        read) still serves bitwise."""
+        prompt = _warm_prompt()
+        want = _run(_mk_engine(lm), prompt)
+        eng = _mk_engine(lm, tiers={"dram_bytes": 1,   # nothing fits
+                                    "disk_bytes": 1 << 20,
+                                    "disk_dir": str(tmp_path)})
+        assert _run(eng, prompt) == want
+        _churn(eng)
+        assert eng.metrics.get(
+            "engine_tier_demotions_total").value(tier="disk") > 0
+        assert _run(eng, prompt) == want
+        assert eng.metrics.get(
+            "engine_prefix_tier_hit_blocks_total").value(
+                tier="disk") >= 2
+        assert eng.metrics.get(
+            "engine_tier_corrupt_total").value() == 0
+
+    def test_corrupt_spill_recomputes_cold_and_bitwise(self, lm,
+                                                       tmp_path):
+        """Corruption on the ADMISSION path: the engine quarantines the
+        bad payload, falls back to cold prefill, and the output is
+        still bitwise — corruption costs compute, never correctness,
+        and never an exception."""
+        prompt = _warm_prompt()
+        want = _run(_mk_engine(lm), prompt)
+        eng = _mk_engine(lm, tiers={"dram_bytes": 1,
+                                    "disk_bytes": 1 << 20,
+                                    "disk_dir": str(tmp_path)})
+        _run(eng, prompt)
+        _churn(eng)
+        for f in tmp_path.glob("*.kv"):
+            raw = bytearray(f.read_bytes())
+            raw[-3] ^= 0xFF
+            f.write_bytes(bytes(raw))
+        assert _run(eng, prompt) == want
+        assert eng.metrics.get(
+            "engine_tier_corrupt_total").value() >= 1
+        assert eng.metrics.get(
+            "engine_prefix_tier_hit_blocks_total").value(
+                tier="disk") == 0
+
+    def test_spill_payload_is_transfer_wire_format(self, lm):
+        """The wire format IS the spill format: a demoted payload
+        deserializes with ``transfer.deserialize_blocks`` and carries
+        the pool stamp ``check_pool_match`` accepts — so remote fetch
+        and local promotion are the same decode path."""
+        from paddle_tpu.serving import transfer
+        eng = _mk_engine(lm, tiers={"dram_bytes": 1 << 20})
+        _run(eng, _warm_prompt())
+        _churn(eng)
+        digests = eng.tiers.digests()["dram"]
+        assert digests
+        d0 = bytes.fromhex(digests[0])
+        tier, payload = eng.tiers.get(d0)
+        meta, items = transfer.deserialize_blocks(payload)
+        transfer.check_pool_match(meta, eng.cache, 8, eng.kv_dtype)
+        assert len(items) == 1 and items[0][0] == d0
+
+    def test_health_reports_tiers_and_crossover_rate(self, lm,
+                                                     tmp_path):
+        eng = _mk_engine(lm, tiers={"dram_bytes": 1 << 20,
+                                    "disk_bytes": 1 << 20,
+                                    "disk_dir": str(tmp_path)})
+        _run(eng, _warm_prompt())
+        _churn(eng)
+        doc = eng.health()
+        assert doc["flops_per_token"] > 0
+        t = doc["tiers"]
+        assert t["dram"]["entries"] > 0
+        assert t["dram"]["capacity_bytes"] == 1 << 20
+        assert set(t["digests"]) == {"hbm", "dram", "disk"}
+        assert t["digests"]["dram"]      # hex digests advertised
+        # an engine WITHOUT tiers still advertises its hot set (the
+        # directory needs hbm entries from every paged replica)
+        doc2 = _mk_engine(lm).health()
+        assert doc2["tiers"]["digests"]["hbm"] == []
+        assert "dram" not in doc2["tiers"]
+
+    def test_spec_engine_rejects_tiers(self, lm):
+        from paddle_tpu.serving import SpecDecodeEngine
+        with pytest.raises(ValueError, match="tiered"):
+            SpecDecodeEngine.__new__(SpecDecodeEngine).__init__(
+                None, None, None, None, draft_params=None,
+                draft_cache=None, draft_prefill=None, propose=None,
+                verify=None, draft_verify=None, spec_k=2,
+                tiers={"dram_bytes": 1})
+
+
+# -- the router as fleet-global cache directory -----------------------------
+
+def _fleet(lm, names=("a", "b"), prefill=(), **kw):
+    from paddle_tpu.serving import Router
+    from paddle_tpu.serving.replica import EngineReplica
+    engines = {n: _mk_engine(lm, tiers={"dram_bytes": 1 << 20})
+               for n in names}
+    reps = [EngineReplica(engines[n], n) for n in names]
+    kw.setdefault("health_poll_s", 0.0)
+    router = Router(reps, block_size=8, chunk_tokens=16,
+                    prefill=list(prefill), **kw)
+    return engines, reps, router
+
+
+class TestFleetDirectory:
+    def test_warm_anywhere_fetches_bitwise(self, lm):
+        """The tentpole at the fleet tier: a prefix warm ONLY on the
+        prefill-role replica is fetched over the transfer relay (never
+        cold-prefilled) and decoded on the cold replica bitwise."""
+        prompt = _warm_prompt()
+        want = _run(_mk_engine(lm), prompt, 6)
+        engines, reps, router = _fleet(lm, prefill=("a",),
+                                       fetch_flops_per_byte=0.0)
+        r0 = engines["a"].submit(prompt, 6)
+        engines["a"].run_until_idle()
+        assert list(r0.output) == want
+        router.step()                    # health poll fills the maps
+        d = router.directory()
+        assert d and all(v["replica"] == "a" for v in d.values())
+        req = router.submit(prompt, 6)
+        router.run_until_idle()
+        assert req.status == "done" and req.replica == "b"
+        assert list(req.output) == want
+        assert router._m_kv_fetches.value(tier="hbm") == 1
+        assert engines["b"].metrics.get(
+            "engine_kv_blocks_imported_total").value() >= 2
+        assert router.health()["directory_size"] == len(d)
+
+    def test_dram_warm_source_fetches_bitwise(self, lm):
+        """The fetch crosses the source's OWN tiers: the prefix sits in
+        replica a's DRAM spill (HBM churned), the directory reports
+        tier=dram, and the relayed payload still decodes bitwise."""
+        prompt = _warm_prompt()
+        want = _run(_mk_engine(lm), prompt, 6)
+        engines, reps, router = _fleet(lm, prefill=("a",),
+                                       fetch_flops_per_byte=0.0)
+        _run(engines["a"], prompt, 6)
+        _churn(engines["a"])
+        router.step()
+        hexes = {h.hex() for h in prompt_block_hashes(prompt, 8)[:2]}
+        d = router.directory()
+        assert {d[h]["tier"] for h in hexes} == {"dram"}
+        req = router.submit(prompt, 6)
+        router.run_until_idle()
+        assert list(req.output) == want
+        assert router._m_kv_fetches.value(tier="dram") == 1
+
+    def test_crossover_knob_suppresses_fetch(self, lm):
+        """fetch_flops_per_byte=inf-ish: shipping never pays, the warm
+        remote prefix is recomputed locally — still bitwise, zero
+        fetches (the evict-and-recompute behavior, by choice)."""
+        prompt = _warm_prompt()
+        want = _run(_mk_engine(lm), prompt, 6)
+        engines, reps, router = _fleet(lm, prefill=("a",),
+                                       fetch_flops_per_byte=1e30)
+        _run(engines["a"], prompt, 6)
+        router.step()
+        req = router.submit(prompt, 6)
+        router.run_until_idle()
+        assert list(req.output) == want
+        assert sum(router._m_kv_fetches.value(tier=t)
+                   for t in ("hbm", "dram", "disk")) == 0
+
+    def test_missing_health_rates_fail_toward_recompute(self, lm):
+        engines, reps, router = _fleet(lm, fetch_flops_per_byte=8.0)
+        st = router._all[0]
+        st.last_health = {"status": "ok"}       # no rate figures
+        assert not router._fetch_pays(st)
+        st.last_health = {"flops_per_token": 1e6,
+                          "kv_bytes_per_token": 10.0}
+        assert router._fetch_pays(st)
+        st.last_health = {"flops_per_token": 10.0,
+                          "kv_bytes_per_token": 1e6}
+        assert not router._fetch_pays(st)
+
+    def test_dead_source_mid_fetch_falls_back_colocated(self, lm):
+        """The source replica dies with the warm_only export
+        outstanding: the request re-queues, cold-prefills colocated,
+        finishes bitwise — and the dead replica's directory entries
+        are gone."""
+        prompt = _warm_prompt()
+        want = _run(_mk_engine(lm), prompt, 6)
+        engines, reps, router = _fleet(lm, prefill=("a",),
+                                       fetch_flops_per_byte=0.0)
+        _run(engines["a"], prompt, 6)
+        router.step()
+        assert any(v["replica"] == "a"
+                   for v in router.directory().values())
+        req = router.submit(prompt, 6)
+        router._place()
+        src = next(st for st in router._all if st.name == "a")
+        assert req.xid in src.outstanding       # export in flight
+        reps[0].kill()
+        router.run_until_idle()
+        assert req.status == "done" and req.replica == "b"
+        assert list(req.output) == want
+        assert req.requeues >= 1
+        assert not any(v["replica"] == "a"
+                       for v in router.directory().values())
+        assert router.replica_states()["a"] == "dead"
