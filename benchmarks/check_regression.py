@@ -130,6 +130,31 @@ FAMILIES = {
             ("chaos_joined_ok", "true", 0.0),
         ],
     },
+    "fleet": {
+        # fleet-control-plane chaos figures (serving_bench.py
+        # --fleet-chaos artifacts): ALL absolute — the phase is a
+        # same-run A/B plus structural booleans, so prior-run ratio
+        # bands would double-count machine noise. Calibration (PR-19,
+        # one-core shared host): latency-tier TTFT p99 under the
+        # saturated diurnal peak lands ~2.2-2.6 s — 6.0 catches a
+        # control plane that stopped holding the band; the controlled/
+        # static ratio lands ~0.7-0.8 — 1.1 means "never WORSE than
+        # doing nothing" with noise headroom; recovery (kill -> the
+        # replacement reporting ok) lands ~0.15-0.19 s with a 0.02 s
+        # heal backoff — 2.0 catches a heal loop gone slow; the
+        # rewarm floor just needs the KV relay to have shipped
+        # ANYTHING (a zero means the replacement came back cold)
+        "glob": "*fleet_chaos*.json",
+        "figures": [
+            ("chaos_latency_ttft_p99_s", "ceiling", 6.0),
+            ("chaos_ttft_ratio", "ceiling", 1.1),
+            ("healed_capacity_frac", "floor", 1.0),
+            ("recovery_s", "ceiling", 2.0),
+            ("rewarm_blocks_avoided", "floor", 1.0),
+            ("shed_before_saturate_ok", "true", 0.0),
+            ("all_admitted_completed", "true", 0.0),
+        ],
+    },
     "elastic": {
         # elastic_bench.py recovery figures: wall-clock dominated by
         # worker restart + jax re-init + recompile, so both get the
@@ -259,9 +284,24 @@ def check_family(name, spec, runs_dir):
     if not paths:
         return [("-", "SKIP", "no artifacts")]
     if len(paths) < 2:
-        return [("-", "BASELINE",
-                 f"only {os.path.basename(paths[-1])} — nothing to "
-                 f"compare against yet")]
+        # a lone artifact still gates its ABSOLUTE figures — "true" /
+        # "floor" / "ceiling" judge the latest alone; relative
+        # directions wait for a second run
+        try:
+            with open(paths[-1]) as f:
+                latest = json.load(f)
+        except (OSError, ValueError) as e:
+            return [("-", "SKIP", f"unreadable artifact: {e}")]
+        lines = [("-", "BASELINE",
+                  f"only {os.path.basename(paths[-1])} — absolute "
+                  f"figures gate, relative ones wait for a second "
+                  f"run")]
+        for path, direction, band in spec["figures"]:
+            if direction in ("true", "floor", "ceiling"):
+                verdict, detail = compare_figure(
+                    lookup(latest, path), None, direction, band)
+                lines.append((path, verdict, detail))
+        return lines
     prev_p, latest_p = paths[-2], paths[-1]
     try:
         with open(prev_p) as f:

@@ -88,6 +88,27 @@ print(json.dumps({
     "chaos": doc.get("fleet", {}).get("chaos", "n/a")}))
 PYEOF
 fi
+# latest fleet-control-plane chaos figures: latency-tier TTFT p99
+# under the diurnal peak, controlled/static ratio, healed capacity,
+# recovery seconds, and the rewarm + shed verdicts from the newest
+# fleet_chaos artifact (run serving_bench.py --fleet-chaos to refresh)
+latest_chaos=$(ls benchmarks/runs/*fleet_chaos*.json 2>/dev/null | sort | tail -1)
+if [ -n "$latest_chaos" ]; then
+    echo "== FLEET CONTROL PLANE ($latest_chaos) =="
+    python - "$latest_chaos" <<'PYEOF' || true
+import json, sys
+doc = json.load(open(sys.argv[1]))
+print(json.dumps({
+    "chaos_latency_ttft_p99_s":
+        doc.get("chaos_latency_ttft_p99_s", "n/a"),
+    "chaos_ttft_ratio": doc.get("chaos_ttft_ratio", "n/a"),
+    "healed_capacity_frac": doc.get("healed_capacity_frac", "n/a"),
+    "recovery_s": doc.get("recovery_s", "n/a"),
+    "rewarm_blocks_avoided": doc.get("rewarm_blocks_avoided", "n/a"),
+    "shed_before_saturate_ok":
+        doc.get("shed_before_saturate_ok", "n/a")}))
+PYEOF
+fi
 # latest training-gang observability figures: dark/traced steady-step
 # ratio, the goodput-ledger verdict, and the run's goodput fraction
 # from the newest elastic_bench artifact (run elastic_bench.py to

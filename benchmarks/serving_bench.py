@@ -83,6 +83,7 @@ import argparse
 import datetime
 import gc
 import json
+import math
 import os
 import sys
 import time
@@ -1533,6 +1534,225 @@ def fleet_phase(args):
     return out
 
 
+def build_chaos_workload(n, rate, prompt_lens, max_news, vocab, seed,
+                         *, shared_len, cache_len, peak_mult=4.0,
+                         lat_frac=0.4):
+    """Diurnal Poisson trace for the fleet-chaos phase: the arrival
+    rate follows one sinusoidal day (trough -> peak at the middle ->
+    trough, peak = ``peak_mult`` x base), ~``lat_frac`` of requests
+    ride the latency tier, and EVERY request opens with one shared
+    system prompt — so survivors hold the prefix warm and the
+    rewarm-after-heal figure has something real to measure."""
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(1, vocab, size=shared_len).astype(np.int32)
+    work, t = [], 0.0
+    for i in range(n):
+        frac = i / max(n - 1, 1)
+        r = rate * (1.0 + (peak_mult - 1.0) * 0.5
+                    * (1.0 - math.cos(2.0 * math.pi * frac)))
+        t += float(rng.exponential(1.0 / r))
+        plen = int(rng.choice(prompt_lens))
+        mn = int(rng.choice(max_news))
+        tail = rng.integers(1, vocab, size=plen).astype(np.int32)
+        prompt = np.concatenate([shared, tail])[:cache_len - mn]
+        tier = "latency" if rng.random() < lat_frac else "batch"
+        work.append((t, prompt, mn, tier))
+    return work
+
+
+def _replay_fleet_chaos(router, work, *, ctrl=None, fleet=None,
+                        kill_at=None):
+    """Wall-clock chaos replay: submit through the door (admission
+    sheds are counted, not errors), kill the busiest replica at
+    ``kill_at`` seconds, step the controller (when given) every
+    router step, and time the recovery."""
+    from paddle_tpu.serving.router import AdmissionError
+    reqs, shed, i = [], 0, 0
+    killed = kill_t = healed_t = None
+    max_q = 0
+    t0 = time.perf_counter()
+    while (i < len(work) or not router.idle
+           or (ctrl is not None and killed is not None
+               and healed_t is None
+               and time.perf_counter() - t0 - kill_t < 30.0)):
+        now = time.perf_counter() - t0
+        while i < len(work) and work[i][0] <= now:
+            _, prompt, mn, tier = work[i]
+            try:
+                reqs.append(router.submit(prompt, mn, tier=tier))
+            except AdmissionError:
+                shed += 1
+            i += 1
+        if kill_at is not None and killed is None and now >= kill_at:
+            live = [st for st in router._all if st.state != "dead"]
+            if any(st.in_flight > 0 for st in live):
+                victim = max(live, key=lambda st: st.in_flight)
+                if fleet is not None:
+                    fleet.kill_name(victim.name)
+                else:
+                    victim.handle.kill()
+                killed, kill_t = victim.name, now
+        router.step()
+        max_q = max(max_q, router.queue_depth)
+        if ctrl is not None:
+            ctrl.step()
+            if (killed is not None and healed_t is None
+                    and router.replica_states().get(killed) == "ok"):
+                healed_t = time.perf_counter() - t0
+        if router.idle:
+            if i < len(work):
+                time.sleep(min(max(work[i][0] - now, 0.0), 0.01))
+            elif killed is not None and healed_t is None:
+                time.sleep(0.002)   # drained: waiting out the heal
+                #                     backoff alone
+    return {"reqs": reqs, "shed": shed,
+            "wall": time.perf_counter() - t0, "killed": killed,
+            "kill_t": kill_t, "healed_t": healed_t, "max_queue": max_q}
+
+
+def fleet_chaos_phase(args):
+    """Fleet-control-plane A/B on a diurnal trace with an injected
+    kill at the peak: a CONTROLLED fleet (FleetController healing +
+    rewarm, door-side admission shedding batch past the queue bound)
+    vs a STATIC baseline (same replicas, no controller, no admission
+    — the dead replica stays dead and the door queues everything).
+
+    Figures: latency-tier TTFT p99 under chaos (absolute ceiling —
+    the band the control plane must hold), controlled-over-static
+    TTFT ratio (the control plane must not be WORSE than doing
+    nothing), healed capacity fraction (live replicas at the end over
+    the provisioned fleet — the heal loop closed), recovery seconds
+    (kill to the replacement reporting ok), rewarm blocks shipped to
+    the replacement (cold-prefill work the KV relay avoided), and a
+    shed-before-saturate boolean (the door shed batch work AND the
+    queue never blew past the latency headroom — rejections happened
+    at the door, not as timeouts in the queue)."""
+    from paddle_tpu.observe import SloConfig
+    from paddle_tpu.serving import EngineReplica
+    from paddle_tpu.serving.autoscale import (FleetController,
+                                              InProcessFleet)
+    from paddle_tpu.serving.router import Router
+    from paddle_tpu.observe.compile_tracker import CompileTracker
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import transformer
+
+    R = 2 if args.smoke else 3
+    per_batch = max(2, args.batch // 2)
+    pages = args.cache_len // args.block_size
+    cfg = transformer.TransformerConfig(
+        vocab=args.vocab, d_model=args.d_model,
+        n_heads=max(2, args.d_model // 32), n_kv_heads=0,
+        n_layers=args.layers, d_ff=args.d_model * 4,
+        max_len=args.cache_len,
+        dtype=jnp.float32 if jax.default_backend() == "cpu"
+        else jnp.bfloat16, use_rope=True)
+    params = transformer.init_params(jax.random.PRNGKey(3), cfg)
+    prompt_lens = [int(x) for x in args.prompt_lens.split(",")]
+    max_news = [int(x) for x in args.max_new.split(",")]
+    rate = min(args.rate, 64.0)     # smoke's all-at-once 1e6 would
+    #                                 erase the diurnal shape entirely
+    work = build_chaos_workload(
+        args.requests, rate, prompt_lens, max_news, args.vocab,
+        args.seed + 7, shared_len=args.shared_prefix_len,
+        cache_len=args.cache_len)
+    kill_at = work[len(work) // 2][0]       # the peak of the day
+    shed_max = max(2, args.requests // 8)
+
+    mk_rep = paged_factory(
+        params, cfg, batch=per_batch, cache_len=args.cache_len,
+        block_size=args.block_size, chunk_tokens=args.chunk_tokens,
+        num_blocks=per_batch * pages,
+        tracker=CompileTracker(storm_threshold=10**9), pallas="off")
+    warm_engine(mk_rep, [w[:3] for w in work], args.vocab)
+
+    def lat_p99(reqs):
+        # admitted latency-tier requests only: the tier the SLO prices
+        vt = sorted(r.ttft_s for r in reqs
+                    if r.tier == "latency" and r.ttft_s is not None)
+        return round(_pct(vt, 0.99), 4)
+
+    def side(reqs, res):
+        return {"requests": len(reqs),
+                "completed": sum(1 for r in reqs
+                                 if r.status == "done"),
+                "shed": res["shed"],
+                "max_queue": res["max_queue"],
+                "latency_ttft_p99_s": lat_p99(reqs),
+                "wall_s": round(res["wall"], 4),
+                "killed_replica": res["killed"]}
+
+    # -- controlled: controller + admission --------------------------------
+    fleet = InProcessFleet(lambda name: mk_rep())
+    for i in range(R):
+        fleet.spawn(f"r{i}")
+    handles = [fleet.handle(f"r{i}") for i in range(R)]
+    router = Router(handles, block_size=args.block_size,
+                    chunk_tokens=args.chunk_tokens,
+                    max_in_flight=per_batch * 2, health_poll_s=0.05,
+                    shed_queue_max=shed_max,
+                    slo=SloConfig(ttft_s=0.5, target=0.99,
+                                  window_s=30.0))
+    ctrl = FleetController(
+        router, fleet, min_replicas=R, max_replicas=R,
+        max_restarts=5, backoff_base=0.02, backoff_cap=0.1,
+        rewarm=True, scale_up_queue=0, scale_down_idle_s=1e9)
+    res_c = _replay_fleet_chaos(router, work, ctrl=ctrl, fleet=fleet,
+                                kill_at=kill_at)
+    reqs_c = res_c["reqs"]
+    assert res_c["killed"] is not None, "chaos kill never fired"
+    assert res_c["healed_t"] is not None, \
+        "the controller never healed the killed replica"
+    for _ in range(500):    # land the rewarm export/import ops the
+        #                     replay left outstanding
+        if router.outstanding == 0:
+            break
+        router.step()
+        time.sleep(0.001)
+    live_end = sum(1 for s in router.replica_states().values()
+                   if s == "ok")
+    rewarm_shipped = int(router._m_rewarm.value(result="shipped"))
+    # no P/D tier in this phase: every imported block is a rewarm
+    # relay — KV the replacement did NOT have to cold-prefill
+    rewarm_blocks = int(router._m_pd_blocks.value())
+    recovery_s = round(res_c["healed_t"] - res_c["kill_t"], 4)
+    controlled = side(reqs_c, res_c)
+    router.close()
+
+    # -- static: same fleet shape, nobody at the wheel ----------------------
+    s_handles = [EngineReplica(mk_rep(), f"r{i}") for i in range(R)]
+    s_router = Router(s_handles, block_size=args.block_size,
+                      chunk_tokens=args.chunk_tokens,
+                      max_in_flight=per_batch * 2, health_poll_s=0.05)
+    res_s = _replay_fleet_chaos(s_router, work, kill_at=kill_at)
+    reqs_s = res_s["reqs"]
+    static = side(reqs_s, res_s)
+    s_router.close()
+
+    admitted_ok = all(r.status == "done" for r in reqs_c)
+    assert admitted_ok, "controlled run lost admitted requests"
+    assert all(r.status == "done" for r in reqs_s), \
+        "static run lost requests"
+    c_p99, s_p99 = controlled["latency_ttft_p99_s"], \
+        static["latency_ttft_p99_s"]
+    shed_ok = (res_c["shed"] > 0
+               and res_c["max_queue"] <= 2 * shed_max)
+    return {
+        "controlled": controlled, "static": static,
+        "replicas": R, "shed_queue_max": shed_max,
+        "kill_at_s": round(kill_at, 4),
+        "chaos_latency_ttft_p99_s": c_p99,
+        "chaos_ttft_ratio": round(c_p99 / max(s_p99, 1e-9), 3),
+        "healed_capacity_frac": round(live_end / R, 3),
+        "recovery_s": recovery_s,
+        "rewarm_exports": rewarm_shipped,
+        "rewarm_blocks_avoided": rewarm_blocks,
+        "shed_before_saturate_ok": shed_ok,
+        "all_admitted_completed": admitted_ok,
+    }
+
+
 def lockstep_factory(params, cfg, *, batch, cache_len, buckets):
     """(warm_fn, once_fn) for the pre-engine serving discipline: fill a
     FIFO batch (pad the tail group), share one prompt bucket, decode
@@ -1703,6 +1923,13 @@ def main(argv=None):
                          "bitwise check) and write the date-stamped "
                          "serving_fleet artifact the router sentinel "
                          "family compares")
+    ap.add_argument("--fleet-chaos", action="store_true",
+                    help="run ONLY the fleet-control-plane chaos "
+                         "phase (diurnal trace + kill at the peak: "
+                         "controlled fleet with healing/rewarm/"
+                         "admission vs a static baseline) and write "
+                         "the date-stamped fleet_chaos artifact the "
+                         "fleet sentinel family compares")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny preset for the tier-1 fast test: few "
                          "requests, near-zero inter-arrival gaps")
@@ -1722,6 +1949,27 @@ def main(argv=None):
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     import jax.numpy as jnp
+
+    if args.fleet_chaos:
+        # standalone control-plane chaos run: its own figures, its
+        # own fleet_chaos artifact (the `fleet` sentinel family's
+        # glob — distinct from serving_fleet, which the `router`
+        # family matches)
+        results = {"fleet_chaos": fleet_chaos_phase(args)}
+        line = {"bench": "serving", "phase": "fleet_chaos",
+                "platform": jax.default_backend(),
+                **{k: v for k, v in results["fleet_chaos"].items()
+                   if not isinstance(v, dict)}}
+        print(json.dumps(line), flush=True)
+        metrics_write(**line)
+        for key in ("chaos_latency_ttft_p99_s", "chaos_ttft_ratio",
+                    "healed_capacity_frac", "recovery_s",
+                    "rewarm_exports", "rewarm_blocks_avoided",
+                    "shed_before_saturate_ok",
+                    "all_admitted_completed"):
+            results[key] = results["fleet_chaos"][key]
+        write_artifact(results, "fleet_chaos", args)
+        return results
 
     if args.fleet:
         # standalone fleet run: its own figures, its own date-stamped

@@ -406,8 +406,20 @@ def job_route(args):
 
     fleet = None
     handles = []
+    budgets = {}
+    for spec in args.tenant_budget:
+        tenant, _, tokens = spec.partition("=")
+        try:
+            budgets[tenant] = int(tokens)
+        except ValueError:
+            print(f"route: --tenant-budget expects TENANT=TOKENS, "
+                  f"got {spec!r}", file=sys.stderr)
+            return 1
     router_kw = dict(max_in_flight=args.max_in_flight,
-                     fetch_flops_per_byte=args.fetch_flops_per_byte)
+                     fetch_flops_per_byte=args.fetch_flops_per_byte,
+                     shed_queue_max=args.shed_queue_max,
+                     shed_burn_max=args.shed_burn_max,
+                     tenant_budgets=budgets or None)
     if args.ttft_slo_ms:
         from paddle_tpu.observe import SloConfig
         router_kw["slo"] = SloConfig(ttft_s=args.ttft_slo_ms / 1000.0,
@@ -445,6 +457,30 @@ def job_route(args):
                   "--replicas N", file=sys.stderr)
             return 1
 
+        controller = None
+        ctrl_srv = None
+        if args.autoscale or args.wedge_timeout_s > 0:
+            if fleet is None:
+                print("route: --autoscale needs --model + --replicas "
+                      "(a locally spawned fleet the controller can "
+                      "respawn into); --replica endpoints have no "
+                      "process lifecycle to drive", file=sys.stderr)
+                return 1
+            from paddle_tpu.serving.autoscale import FleetController
+            controller = FleetController(
+                router, fleet,
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas,
+                max_restarts=args.heal_max_restarts,
+                scale_up_queue=args.scale_up_queue,
+                scale_down_idle_s=args.scale_down_idle_s,
+                wedge_timeout_s=args.wedge_timeout_s)
+            if args.controller_port is not None:
+                ctrl_srv = controller.serve(host=args.health_host,
+                                            port=args.controller_port)
+                print(f"controller: {ctrl_srv.url}/healthz",
+                      file=sys.stderr)
+
         health_srv = None
         if args.health_port is not None:
             health_srv = router.serve(host=args.health_host,
@@ -481,6 +517,7 @@ def job_route(args):
                 if req.latency_s is not None else None}), flush=True)
 
         def ingest(line):
+            from paddle_tpu.serving.router import AdmissionError
             try:
                 r = json.loads(line)
                 router.submit(
@@ -491,6 +528,12 @@ def job_route(args):
                     eos_id=r.get("eos_id"),
                     tenant=str(r.get("tenant", "default")),
                     tier=str(r.get("tier", "batch")))
+            except AdmissionError as e:
+                # a counted rejection, never a timeout: the client
+                # learns the door's reason NOW and can back off
+                print(json.dumps({
+                    "error": f"shed: {e.reason}", "shed": e.reason,
+                    "finish_reason": "shed"}), flush=True)
             except (ValueError, KeyError, TypeError) as e:
                 print(json.dumps({"error": str(e)}), flush=True)
 
@@ -533,7 +576,15 @@ def job_route(args):
                 if not router.idle:
                     for d in router.step():
                         emit(d)
+                elif controller is not None:
+                    router.step()   # liveness + health even while
+                    #                 idle: deaths must be SEEN for
+                    #                 the heal loop to close
+                if controller is not None and not sealed:
+                    controller.step()
         finally:
+            if ctrl_srv is not None:
+                ctrl_srv.close()
             if health_srv is not None:
                 health_srv.close()
             router.close()
@@ -563,6 +614,22 @@ def _render_top(health: dict, alerts: dict) -> str:
             hr=fmt(health.get("placement_hit_rate"), ".2f"),
             p99=fmt(win.get("fleet_ttft_p99_s",
                             win.get("ttft_p99_s")), ".4f"))]
+    if health.get("shed"):
+        lines[0] += f"  shed {health['shed']}"
+    ctl = health.get("controller")
+    if ctl:
+        lines.append(
+            "controller: live {lv} [{mn}..{mx}]  heals {h}  "
+            "wedge_kills {w}  scale {s}  spawn_tokens {t}".format(
+                lv=ctl.get("live"), mn=ctl.get("min"),
+                mx=ctl.get("max"), h=ctl.get("heals", 0),
+                w=ctl.get("wedge_kills", 0),
+                s=ctl.get("scale_events", 0),
+                t=ctl.get("spawn_tokens")))
+        if ctl.get("draining"):
+            lines[-1] += "  draining " + ",".join(ctl["draining"])
+        if ctl.get("abandoned"):
+            lines[-1] += "  ABANDONED " + ",".join(ctl["abandoned"])
     hdr = (f"{'REPLICA':<12} {'ROLE':<8} {'STATE':<10} {'INFL':>4} "
            f"{'QUEUE':>5} {'BLOCKS':>11} {'TIERS':>9} {'TTFT_P99':>9} "
            f"{'BURN':>6}")
@@ -1041,6 +1108,46 @@ def main(argv=None):
     p.add_argument("--tiers_dir", default=None,
                    help="job=serve: directory for the disk spill tier "
                         "(re-adopted across restarts)")
+    p.add_argument("--shed_queue_max", type=int, default=0,
+                   help="job=route: shed batch-tier admits once the "
+                        "router queue holds this many requests "
+                        "(latency tier rides 2x the headroom; 0 "
+                        "disables — the queue grows unbounded)")
+    p.add_argument("--shed_burn_max", type=float, default=0.0,
+                   help="job=route: shed batch-tier admits while the "
+                        "SLO burn rate exceeds this (needs "
+                        "--ttft_slo_ms; 0 disables)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="job=route: run the fleet controller — heal "
+                        "dead replicas under their own name (re-warm "
+                        "from survivors), scale up on sustained queue "
+                        "pressure, drain down when idle. Needs "
+                        "--model + --replicas (a local fleet).")
+    p.add_argument("--min_replicas", type=int, default=1,
+                   help="job=route --autoscale: scale-down floor")
+    p.add_argument("--max_replicas", type=int, default=8,
+                   help="job=route --autoscale: scale-up ceiling")
+    p.add_argument("--scale_up_queue", type=int, default=8,
+                   help="job=route --autoscale: queue depth that, "
+                        "sustained past the hysteresis window, spawns "
+                        "a replica (0 disables scale-up)")
+    p.add_argument("--scale_down_idle_s", type=float, default=30.0,
+                   help="job=route --autoscale: drain the newest "
+                        "replica after this long fully idle (down to "
+                        "--min_replicas)")
+    p.add_argument("--wedge_timeout_s", type=float, default=0.0,
+                   help="job=route: kill a replica that holds work "
+                        "but produces no result/ack/error for this "
+                        "long — healing then respawns it (0 disables; "
+                        "implies the controller)")
+    p.add_argument("--heal_max_restarts", type=int, default=3,
+                   help="job=route --autoscale: restart budget per "
+                        "replica name before its slot is abandoned "
+                        "(a long-stable incarnation refills it)")
+    p.add_argument("--controller_port", type=int, default=None,
+                   help="job=route --autoscale: serve the "
+                        "controller's own /healthz (+ shared "
+                        "/metrics) on this port")
     args = p.parse_args(argv)
 
     if args.metrics_out:

@@ -1051,8 +1051,25 @@ class ServingFleet:
         self.procs: List = []
         self.endpoints: List[dict] = []
         self._handles: List = []
+        # name -> live process; names are CLAIMED under the lock
+        # before any process exists, so two concurrent replacements
+        # can never both launch under one name (and therefore never
+        # share a {name}-derived spill directory)
+        self._lock = threading.Lock()
+        self._by_name: dict = {}
+        self._spawning: set = set()
 
     def start(self) -> "ServingFleet":
+        for i in range(self.n):
+            self.procs.append(self._launch(f"replica{i}"))
+        deadline = time.time() + self.startup_timeout_s
+        for i, p in enumerate(self.procs):
+            self.endpoints.append(self._await_ready(
+                f"replica{i}", p, deadline, close_fleet=True))
+            self._by_name[f"replica{i}"] = p
+        return self
+
+    def _launch(self, name: str):
         import subprocess
         env = dict(os.environ)
         if self.env:
@@ -1065,28 +1082,27 @@ class ServingFleet:
         env["PYTHONPATH"] = pkg_root + os.pathsep + \
             env.get("PYTHONPATH", "") if env.get("PYTHONPATH") \
             else pkg_root
-        for i in range(self.n):
-            # "{name}" in an extra arg expands to this replica's name:
-            # per-replica state that must not be shared (a --tiers_dir
-            # spill directory, say) gets its own path from ONE
-            # args_extra template
-            extra = [a.replace("{name}", f"replica{i}")
-                     for a in self.args_extra]
-            self.procs.append(subprocess.Popen(
-                [self.python, "-m", "paddle_tpu", "serve",
-                 f"--model={self.model}", "--port=0", "--health_port=0",
-                 *extra],
-                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-                text=True, env=env))
-        deadline = time.time() + self.startup_timeout_s
-        for i, p in enumerate(self.procs):
-            self.endpoints.append(self._await_ready(i, p, deadline))
-        return self
+        # "{name}" in an extra arg expands to this replica's name:
+        # per-replica state that must not be shared (a --tiers_dir
+        # spill directory, say) gets its own path from ONE args_extra
+        # template — and a replacement spawned under the SAME name
+        # inherits that path, which is how the disk spill tier hands
+        # over to the healed process
+        extra = [a.replace("{name}", name) for a in self.args_extra]
+        return subprocess.Popen(
+            [self.python, "-m", "paddle_tpu", "serve",
+             f"--model={self.model}", "--port=0", "--health_port=0",
+             *extra],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env)
 
-    def _await_ready(self, i: int, proc, deadline: float) -> dict:
-        """Parse replica ``i``'s ready line off its stdout, bounded by
+    def _await_ready(self, name: str, proc, deadline: float,
+                     close_fleet: bool = False) -> dict:
+        """Parse the replica's ready line off its stdout, bounded by
         ``deadline`` (readline on a watchdog thread: a wedged replica
-        must fail the fleet, not hang it)."""
+        must fail the fleet, not hang it). ``close_fleet`` tears the
+        whole fleet down on failure (the start() all-or-nothing path);
+        a single respawn kills only its own process."""
         box: List[Optional[str]] = [None]
 
         def _read():
@@ -1098,13 +1114,107 @@ class ServingFleet:
         line = box[0]
         if not line:
             rc = proc.poll()
-            self.close()
+            if close_fleet:
+                self.close()
+            else:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
             raise RuntimeError(
-                f"replica {i} never announced readiness "
+                f"replica {name} never announced readiness "
                 f"({'exited rc=' + str(rc) if rc is not None else 'timed out'})")
         doc = json.loads(line)["replica_ready"]
-        return {"name": f"replica{i}", "port": int(doc["port"]),
+        return {"name": name, "port": int(doc["port"]),
                 "health_port": doc.get("health_port")}
+
+    # -- named lifecycle (the fleet controller's surface) ------------------
+    def allocate_name(self) -> str:
+        """The smallest unclaimed ``replica{k}`` (scale-up names)."""
+        with self._lock:
+            k = 0
+            while (f"replica{k}" in self._by_name
+                   or f"replica{k}" in self._spawning):
+                k += 1
+            return f"replica{k}"
+
+    def spawn(self, name: Optional[str] = None) -> dict:
+        """Spawn ONE replica under ``name`` (default: a fresh name)
+        and wait for its ready line. The name is claimed atomically
+        before the process launches: a second concurrent spawn of the
+        same name raises instead of racing it — at most one live
+        process ever owns a name (and its spill directory). Replacing
+        a dead replica's name is allowed once its process exited."""
+        with self._lock:
+            if name is None:
+                k = 0
+                while (f"replica{k}" in self._by_name
+                       or f"replica{k}" in self._spawning):
+                    k += 1
+                name = f"replica{k}"
+            name = str(name)
+            if name in self._spawning:
+                raise RuntimeError(
+                    f"replica {name!r} is already being spawned")
+            cur = self._by_name.get(name)
+            if cur is not None and cur.poll() is None:
+                raise RuntimeError(
+                    f"replica {name!r} is still running — stop or "
+                    f"kill it before respawning")
+            self._spawning.add(name)
+        try:
+            proc = self._launch(name)
+            ep = self._await_ready(
+                name, proc, time.time() + self.startup_timeout_s)
+        finally:
+            with self._lock:
+                self._spawning.discard(name)
+        with self._lock:
+            self._by_name[name] = proc
+            for i, e in enumerate(self.endpoints):
+                if e["name"] == name:
+                    self.endpoints[i] = ep
+                    self.procs[i] = proc
+                    break
+            else:
+                self.endpoints.append(ep)
+                self.procs.append(proc)
+        return ep
+
+    def handle(self, name: str):
+        """A FRESH SocketReplica handle to the named replica (the
+        cached :meth:`handles` list keeps the originals — a healed
+        replica needs a new connection to its new process)."""
+        from paddle_tpu.serving.replica import SocketReplica
+        ep = next((e for e in self.endpoints if e["name"] == name),
+                  None)
+        if ep is None:
+            raise KeyError(f"no replica named {name!r}")
+        hp = ep.get("health_port")
+        return SocketReplica(
+            name, ("127.0.0.1", ep["port"]),
+            f"http://127.0.0.1:{hp}" if hp else None)
+
+    def stop(self, name: str):
+        """Graceful SIGTERM drain of one replica (scale-down): it
+        finishes what it accepted, emits every result, and exits 0."""
+        import signal as _signal
+        with self._lock:
+            proc = self._by_name.get(name)
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(_signal.SIGTERM)
+
+    def kill_name(self, name: str):
+        """SIGKILL by name (the controller's wedge hammer)."""
+        with self._lock:
+            proc = self._by_name.get(name)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+
+    def proc_alive(self, name: str) -> bool:
+        with self._lock:
+            proc = self._by_name.get(name)
+        return proc is not None and proc.poll() is None
 
     def handles(self) -> List:
         """SocketReplica handles, one per replica (built once)."""

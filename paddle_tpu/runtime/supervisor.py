@@ -311,6 +311,48 @@ def _probe_healthz(port: int, host: str = "127.0.0.1",
 # the supervisor
 # ---------------------------------------------------------------------------
 
+class RestartBudget:
+    """Restart policy for one supervised thing (a training gang, a
+    serving replica): a budget of CONSECUTIVE unstable incarnations
+    plus decorrelated-jitter backoff between relaunches.
+
+    An incarnation that did real work (``stepped``) and then survived
+    ``stable_window`` seconds refills the budget and cools the backoff
+    when it eventually dies — routine independent preemptions spread
+    over a job's lifetime must not exhaust a crash-loop guard. The
+    budget is the supervisor's inline logic extracted so the fleet
+    controller heals replicas under the exact same policy."""
+
+    def __init__(self, max_restarts: int = 5,
+                 stable_window: float = 300.0,
+                 backoff_base: float = 0.5,
+                 backoff_cap: float = 15.0):
+        self.max_restarts = int(max_restarts)
+        self.stable_window = float(stable_window)
+        self.backoff = DecorrelatedBackoff(backoff_base, backoff_cap)
+        self.restarts = 0
+
+    def note_failure(self, *, stepped: bool, uptime_s: float):
+        """Record one incarnation's death; call before consulting
+        :attr:`exhausted` / :meth:`delay` for the relaunch."""
+        if stepped and uptime_s >= self.stable_window:
+            self.restarts = 0
+            self.backoff.reset()
+        self.restarts += 1
+
+    @property
+    def exhausted(self) -> bool:
+        return self.restarts > self.max_restarts
+
+    def delay(self) -> float:
+        """Jittered sleep before the next relaunch."""
+        return self.backoff.next()
+
+    def reset(self):
+        self.restarts = 0
+        self.backoff.reset()
+
+
 class Supervisor:
     """Drive a worker gang through launch → watch → teardown → relaunch
     until it completes, the restart budget runs out, or the gang cannot
@@ -385,7 +427,8 @@ class Supervisor:
         self.poll_interval = poll_interval
         self.max_restarts = max_restarts
         self.stable_window = stable_window
-        self._backoff = DecorrelatedBackoff(backoff_base, backoff_cap)
+        self._budget = RestartBudget(max_restarts, stable_window,
+                                     backoff_base, backoff_cap)
         self._replacements = replacements
         self.min_nprocs = min_nprocs
         self.valid_sizes = (sorted(valid_sizes, reverse=True)
@@ -395,7 +438,6 @@ class Supervisor:
         self.probe_health = probe_health
         self._state = "idle"
         self._epoch = current_epoch(state_dir)
-        self._restarts = 0
         self._attempts: List[dict] = []
         self._last_probe: Dict[int, float] = {}
         os.makedirs(state_dir, exist_ok=True)
@@ -539,6 +581,11 @@ class Supervisor:
                 and now - t_launch > self.attempt_timeout):
             return "fail", list(range(len(procs))), "attempt_timeout"
         return "running", [], None
+
+    @property
+    def _restarts(self) -> int:
+        """Consecutive-unstable restart count (the budget owns it)."""
+        return self._budget.restarts
 
     def _post_mortem(self, reason, failed_ranks, epoch):
         """Flight-recorder artifact for this restart: the judgment, the
@@ -768,20 +815,17 @@ class Supervisor:
             self._m_restart_rate.set(len(self._restart_times))
             self._post_mortem(reason, failed, epoch)
             _launch.terminate_procs(procs)
-            if (attempt["t_first_step"] is not None
-                    and t_detect - t_launch >= self.stable_window):
-                # a long-stable incarnation failing is a NEW fault, not
-                # a crash loop: refill the restart budget and cool the
-                # backoff, or a job on a preemption-prone fleet would
-                # die on its (max_restarts+1)-th independent preemption
-                self._restarts = 0
-                self._backoff.reset()
-            self._restarts += 1
+            # a long-stable incarnation failing is a NEW fault, not a
+            # crash loop: the budget refills and the backoff cools
+            # (see RestartBudget)
+            self._budget.note_failure(
+                stepped=attempt["t_first_step"] is not None,
+                uptime_s=t_detect - t_launch)
             fail_why = None
             if reason == "total_timeout" or (
                     t_end is not None and time.time() > t_end):
                 fail_why = "total_timeout"
-            elif self._restarts > self.max_restarts:
+            elif self._budget.exhausted:
                 fail_why = "max_restarts"
             elif reason == "attempt_timeout":
                 # a whole-gang timeout names no dead machine: retry the
@@ -798,7 +842,7 @@ class Supervisor:
                         "restarts": self._restarts, "epoch": epoch,
                         "attempts": self._attempts}
             self._set_state("backoff")
-            delay = self._backoff.next()
+            delay = self._budget.delay()
             log.info("supervisor: restart %d/%d in %.2fs (gang -> %d)",
                      self._restarts, self.max_restarts, delay,
                      self.nprocs)

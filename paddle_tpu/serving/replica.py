@@ -145,6 +145,16 @@ class EngineLoop:
     def idle(self) -> bool:
         return self.eng.idle and self._inbox.empty()
 
+    def _stamp(self, doc: dict) -> dict:
+        # Every result/ack doc carries the spill tiers' eviction epoch
+        # so a router comparing it against the epoch it saw at the last
+        # /healthz scrape learns about full-retirement evictions NOW,
+        # between health cadences, instead of fetching a stale digest.
+        tiers = getattr(self.eng, "tiers", None)
+        if tiers is not None:
+            doc["tier_epoch"] = int(tiers.eviction_epoch)
+        return doc
+
     # -- op dispatch (loop thread only) -----------------------------------
     def _ingest(self, item):
         if item is None:
@@ -197,8 +207,9 @@ class EngineLoop:
         xid = r.get("id")
         digests = eng.prefix_digests(prompt)
         if not digests:
-            reply.write({"id": xid, "op": "export_prefix",
-                         "payload": None, "blocks": 0})
+            reply.write(self._stamp(
+                {"id": xid, "op": "export_prefix",
+                 "payload": None, "blocks": 0}))
             return
         if r.get("warm_only"):
             # fleet cache-directory fetch: serve whatever leading run
@@ -209,17 +220,19 @@ class EngineLoop:
             payload = eng.export_prefix(prompt, trace=r.get("trace"),
                                         partial=True)
             if payload is None:
-                reply.write({"id": xid, "op": "export_prefix",
-                             "payload": None, "blocks": 0})
+                reply.write(self._stamp(
+                    {"id": xid, "op": "export_prefix",
+                     "payload": None, "blocks": 0}))
             else:
                 from paddle_tpu.serving import transfer as _transfer
                 meta, _ = _transfer.deserialize_blocks(payload)
-                reply.write(self._export_doc(
-                    xid, payload, len(meta["digests"])))
+                reply.write(self._stamp(self._export_doc(
+                    xid, payload, len(meta["digests"]))))
             return
         payload = eng.export_prefix(prompt, trace=r.get("trace"))
         if payload is not None:      # prefix already hot: serialize now
-            reply.write(self._export_doc(xid, payload, len(digests)))
+            reply.write(self._stamp(
+                self._export_doc(xid, payload, len(digests))))
             return
         # cold: run the prompt through the ordinary scheduler (its
         # chunks publish into the prefix cache as each one lands, and
@@ -242,8 +255,8 @@ class EngineLoop:
         if not hasattr(eng, "import_prefix"):
             raise ValueError("import_prefix needs a paged engine")
         n = eng.import_prefix(base64.b64decode(r["payload"]))
-        reply.write({"id": r.get("id"), "op": "import_prefix",
-                     "imported": int(n)})
+        reply.write(self._stamp({"id": r.get("id"), "op": "import_prefix",
+                                 "imported": int(n)}))
 
     def _finish(self, req):
         if req.rid in self._exports:
@@ -253,22 +266,24 @@ class EngineLoop:
                 # evicted under pool pressure before serialization: the
                 # requester falls back to a cold prefill (slower, same
                 # bits)
-                reply.write({"id": xid, "op": "export_prefix",
-                             "payload": None, "blocks": 0})
+                reply.write(self._stamp(
+                    {"id": xid, "op": "export_prefix",
+                     "payload": None, "blocks": 0}))
             else:
-                reply.write(self._export_doc(
-                    xid, payload, len(self.eng.prefix_digests(prompt))))
+                reply.write(self._stamp(self._export_doc(
+                    xid, payload,
+                    len(self.eng.prefix_digests(prompt)))))
             return
         reply, xid = self._live.pop(req.rid, (None, None))
         if reply is None:
             return
-        reply.write({
+        reply.write(self._stamp({
             "id": xid, "tokens": [int(t) for t in req.tokens],
             "finish_reason": req.finish_reason,
             "ttft_ms": round(1000 * req.ttft_s, 3)
             if req.ttft_s is not None else None,
             "latency_ms": round(1000 * req.latency_s, 3)
-            if req.latency_s is not None else None})
+            if req.latency_s is not None else None}))
 
     # -- pumping -----------------------------------------------------------
     def ingest_all(self):

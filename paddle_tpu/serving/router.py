@@ -75,6 +75,29 @@ _ROUTER_IDS = itertools.count()
 _LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                     0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
 
+# load-shed reasons the door can refuse with (each a counted
+# rejection, never a timeout):
+#   queue_full    — the router backlog crossed shed_queue_max (the
+#                   latency tier gets 2x headroom before it sheds)
+#   burn_rate     — the fleet TTFT SLO burn rate crossed shed_burn_max;
+#                   batch-tier arrivals shed first, latency keeps
+#                   flowing (the SLO the burn measures IS latency-tier
+#                   experience)
+#   tenant_budget — the request's own reserved-token charge exceeds
+#                   the tenant's FLEET budget: it could never place
+SHED_REASONS = ("queue_full", "burn_rate", "tenant_budget")
+
+
+class AdmissionError(RuntimeError):
+    """The router refused a request at the door (load shed). Carries
+    the machine-readable ``reason`` (one of :data:`SHED_REASONS`) so
+    callers can distinguish back-off-and-retry (``queue_full``,
+    ``burn_rate``) from never-admissible (``tenant_budget``)."""
+
+    def __init__(self, reason: str, msg: str):
+        super().__init__(msg)
+        self.reason = reason
+
 
 def fleet_keying(handles, default_block_size: int = 16,
                  default_chunk_tokens: int = 64) -> Tuple[int, int]:
@@ -162,6 +185,16 @@ class RouterRequest:
                 + self.replica_ttft_ms / 1000.0)
 
 
+@dataclasses.dataclass
+class _RewarmTicket:
+    """Outstanding-table entry for a rewarm export/import relay — NOT
+    a request (never requeued, never finished; its loss is a cache
+    miss for the replacement replica, nothing more)."""
+    rid: str
+    target: str                 # replica name the payload ships to
+    digests: List[bytes]
+
+
 class _Replica:
     """Router-side state for one replica handle."""
 
@@ -171,11 +204,23 @@ class _Replica:
         self.state = "ok"
         self.last_health: dict = {}
         self.health_t = -1e9
-        # xid -> (req, kind); kind: generate | export | import
+        # xid -> (req, kind); kind: generate | export | import | rewarm
         self.outstanding: "OrderedDict" = OrderedDict()
         self.cap = int(cap)
         self.hot: "OrderedDict" = OrderedDict()
         self.hot_cap = int(hot_cap)
+        # administrative drain hold (scale-down): while set, the health
+        # poll must NOT re-promote this replica to ok — it stays
+        # unhealthy (no new admissions) until removed or released
+        self.draining = False
+        # the replica's tier eviction epoch as last seen (health doc or
+        # any op result): a bump between health scrapes means the warm
+        # advertisement is stale NOW — see _note_epoch
+        self.tier_epoch = -1
+        # most recent placement prompts with a usable prefix, keyed by
+        # their leading digest chain — the rewarm seed list a
+        # replacement replica's prefixes are re-imported from
+        self.recent: "OrderedDict" = OrderedDict()
         # digest -> tier ("hbm" | "dram" | "disk"): the replica's OWN
         # advertisement of what it holds warm at any cache tier, rebuilt
         # from each /healthz scrape's `tiers.digests` listing. `hot` is
@@ -188,6 +233,13 @@ class _Replica:
         """Work that occupies the replica (import acks don't)."""
         return sum(1 for _, kind in self.outstanding.values()
                    if kind != "import")
+
+    def note_recent(self, digests: tuple, prompt, cap: int = 16):
+        if digests in self.recent:
+            self.recent.move_to_end(digests)
+        self.recent[digests] = prompt
+        while len(self.recent) > cap:
+            self.recent.popitem(last=False)
 
     def mark_hot(self, digests):
         for d in digests:
@@ -244,7 +296,10 @@ class Router:
                  trace: bool = True, aggregate: bool = True,
                  fleet_jsonl: Optional[str] = None,
                  alert_rules: Optional[Sequence] = None,
-                 fetch_flops_per_byte: float = 8.0):
+                 fetch_flops_per_byte: float = 8.0,
+                 shed_queue_max: int = 0,
+                 shed_burn_max: float = 0.0,
+                 tenant_budgets: Optional[Dict[str, int]] = None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         bs, chunk = int(block_size), int(chunk_tokens)
@@ -252,6 +307,8 @@ class Router:
             raise ValueError(f"chunk_tokens {chunk} must be a positive "
                              f"multiple of block_size {bs}")
         self.block_size, self.chunk_tokens = bs, chunk
+        self._replica_cap = int(max_in_flight)
+        self._hot_cap = int(hot_digests)
         self._all: List[_Replica] = [
             _Replica(h, max_in_flight, hot_digests) for h in replicas]
         names = [st.name for st in self._all]
@@ -269,6 +326,25 @@ class Router:
             raise ValueError("every replica is prefill-tier: nothing "
                              "left to decode")
         self._health_poll_s = float(health_poll_s)
+        # -- admission control (the door) ---------------------------------
+        # 0 disables each shed axis; see SHED_REASONS for semantics
+        self.shed_queue_max = int(shed_queue_max)
+        self.shed_burn_max = float(shed_burn_max)
+        # fleet-wide tenant budgets: tenant -> reserved-token cap
+        # (prompt + max_new summed over the tenant's PLACED work across
+        # every replica). Over-budget tenants QUEUE (skipped by
+        # placement, no head-of-line blocking) — the one rejection is a
+        # single request whose own charge exceeds the budget, mirroring
+        # the engine-level contract.
+        self._tenant_budgets: Dict[str, int] = dict(tenant_budgets or {})
+        self._tenant_used: Dict[str, int] = {}
+        self._charged: set = set()
+        # rewarm state: dead replica name -> its recent prefix prompts
+        self._rewarm_stash: Dict[str, list] = {}
+        self._rewarm_ids = itertools.count()
+        # a FleetController registers its summary callable here so one
+        # router /healthz answers for the control plane too
+        self._controller_summary = None
         self._queue: deque = deque()
         self._requests: Dict[int, RouterRequest] = {}
         self._ids = itertools.count()
@@ -349,6 +425,24 @@ class Router:
         self._m_dir_size = reg.gauge(
             "router_directory_size", "distinct digests the fleet "
             "cache directory currently maps to a live replica+tier")
+        self._m_shed = reg.counter(
+            "router_shed_total", "requests refused at the door, by "
+            "reason (queue_full | burn_rate | tenant_budget) — counted "
+            "rejections, never timeouts")
+        self._m_tenant_flight = reg.gauge(
+            "router_tenant_tokens_in_flight", "reserved tokens "
+            "(prompt + max_new) each tenant has placed fleet-wide — "
+            "the charge the fleet tenant budget caps")
+        self._m_rewarm = reg.counter(
+            "router_rewarm_total", "prefix re-imports attempted for a "
+            "replacement replica, by result (shipped = KV relayed "
+            "from a warm survivor; miss = no warm source / payload "
+            "gone — the replacement cold-prefills that prefix)")
+        self._m_dir_invalidations = reg.counter(
+            "router_directory_invalidations_total", "warm-set "
+            "invalidations forced by a tier eviction-epoch bump seen "
+            "on an op result between health scrapes (the stale-fetch "
+            "prevention path)")
         # fetch-vs-recompute crossover: ship the prefix's KV bytes when
         # recomputing a token costs more than `fetch_flops_per_byte`
         # device FLOPs per wire byte shipped (both sides linear in
@@ -395,8 +489,38 @@ class Router:
         """Queue one fleet request; placement happens in ``step()``.
         The request is stamped with a fleet-unique trace id; its
         ``route`` slice (the router-side root of the whole cross-
-        process request tree) opens here and closes at completion."""
+        process request tree) opens here and closes at completion.
+        Raises :class:`AdmissionError` when the door sheds (see
+        :data:`SHED_REASONS`) — shed BEFORE replicas saturate, never a
+        timeout after they did."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        tier, tenant = str(tier), str(tenant)
+        if self.shed_queue_max:
+            # latency-tier traffic gets 2x headroom: the backlog that
+            # sheds bulk work early is exactly what keeps the latency
+            # tier's TTFT in band
+            limit = (2 * self.shed_queue_max if tier == "latency"
+                     else self.shed_queue_max)
+            if len(self._queue) >= limit:
+                self._m_shed.inc(reason="queue_full")
+                raise AdmissionError(
+                    "queue_full", f"router queue at {len(self._queue)} "
+                    f">= {limit} for tier {tier!r}")
+        if (self.shed_burn_max and tier != "latency"
+                and self._slo_burn_rate() > self.shed_burn_max):
+            self._m_shed.inc(reason="burn_rate")
+            raise AdmissionError(
+                "burn_rate", f"TTFT SLO burn rate "
+                f"{self._slo_burn_rate():.2f} > {self.shed_burn_max} "
+                f"— batch-tier arrivals shed until it recovers")
+        budget = self._tenant_budgets.get(tenant)
+        own = int(prompt.size) + int(max_new)
+        if budget is not None and own > budget:
+            self._m_shed.inc(reason="tenant_budget")
+            raise AdmissionError(
+                "tenant_budget", f"request reserves {own} tokens > "
+                f"tenant {tenant!r} fleet budget {budget} — it could "
+                f"never place")
         req = RouterRequest(
             xid=next(self._ids), prompt=prompt, max_new=int(max_new),
             temperature=float(temperature), top_k=int(top_k),
@@ -419,6 +543,46 @@ class Router:
                   tenant=req.tenant, tier=req.tier)
         self._rev(req, "queue", "b", req.submit_t)
         return req
+
+    # -- fleet-wide tenant accounting -------------------------------------
+    def set_tenant_budget(self, tenant: str, tokens: Optional[int]):
+        """Set (or with ``None`` clear) a tenant's fleet-wide
+        reserved-token budget. Takes effect at the next placement
+        round — work already placed is never clawed back."""
+        if tokens is None:
+            self._tenant_budgets.pop(str(tenant), None)
+        else:
+            self._tenant_budgets[str(tenant)] = int(tokens)
+
+    @staticmethod
+    def _tenant_charge(req: RouterRequest) -> int:
+        return int(req.prompt.size) + int(req.max_new)
+
+    def _charge(self, req: RouterRequest):
+        if req.xid in self._charged:
+            return
+        self._charged.add(req.xid)
+        used = self._tenant_used.get(req.tenant, 0)
+        self._tenant_used[req.tenant] = used + self._tenant_charge(req)
+        self._m_tenant_flight.set(self._tenant_used[req.tenant],
+                                  tenant=req.tenant)
+
+    def _release(self, req: RouterRequest):
+        if req.xid not in self._charged:
+            return
+        self._charged.discard(req.xid)
+        used = self._tenant_used.get(req.tenant, 0)
+        self._tenant_used[req.tenant] = max(
+            0, used - self._tenant_charge(req))
+        self._m_tenant_flight.set(self._tenant_used[req.tenant],
+                                  tenant=req.tenant)
+
+    def _tenant_blocked(self, req: RouterRequest) -> bool:
+        budget = self._tenant_budgets.get(req.tenant)
+        if budget is None:
+            return False
+        return (self._tenant_used.get(req.tenant, 0)
+                + self._tenant_charge(req) > budget)
 
     @property
     def queue_depth(self) -> int:
@@ -481,6 +645,7 @@ class Router:
         finished: List[RouterRequest] = []
         for st in self._all:
             for doc in st.handle.poll():
+                self._note_epoch(st, doc)
                 ent = st.outstanding.pop(doc.get("id"), None)
                 if ent is None:
                     # ack for an untracked op, or a late result for a
@@ -488,6 +653,9 @@ class Router:
                     # first completion wins
                     continue
                 req, kind = ent
+                if kind == "rewarm":
+                    self._on_rewarm(st, req, doc)
+                    continue
                 if kind == "import":
                     if "error" in doc:
                         # a refused adoption (stamp mismatch, spec
@@ -519,6 +687,24 @@ class Router:
                     finished.append(req)
         return finished
 
+    def _note_epoch(self, st, doc: dict):
+        """Tier-directory invalidation fence: every replica op result
+        carries the spill tiers' eviction epoch. A bump relative to
+        what the last health scrape advertised means digests retired
+        BETWEEN scrapes — the warm set is stale NOW. Drop it (fetches
+        stop routing at ghosts immediately) and force a re-scrape at
+        the next poll instead of waiting out the cadence."""
+        ep = doc.get("tier_epoch")
+        if ep is None:
+            return
+        ep = int(ep)
+        if st.tier_epoch >= 0 and ep > st.tier_epoch:
+            if st.warm:
+                st.warm = {}
+                self._m_dir_invalidations.inc()
+            st.health_t = -1e9      # re-scrape on the very next poll
+        st.tier_epoch = max(st.tier_epoch, ep)
+
     def _requeue(self, st, req: RouterRequest):
         """Send ``req`` back to the queue front after ``st`` refused or
         lost it (drain refusal, dead transport)."""
@@ -526,6 +712,7 @@ class Router:
         req.status = "queued"
         req.replica = None
         req.payload, req.payload_blocks = None, 0
+        self._release(req)
         self._m_requeued.inc()
         self._set_state(st, "unhealthy")    # stop placing here; the
         #                                     health poll re-promotes a
@@ -568,6 +755,7 @@ class Router:
                 error: Optional[str] = None):
         now = time.perf_counter()
         req.finish_t = now
+        self._release(req)
         self._n_completed += 1
         if error is not None:
             req.status, req.error = "failed", error
@@ -644,8 +832,14 @@ class Router:
             # section lists its warm digests per tier (hbm listing
             # capped at the engine); rebuild — not merge — so entries
             # the replica evicted are pruned on this same cadence
-            tiers = (doc.get("tiers") or {}).get("digests") or {}
-            if tiers:
+            tiers_doc = (doc.get("tiers") or {})
+            tiers = tiers_doc.get("digests") or {}
+            ep = tiers_doc.get("eviction_epoch")
+            if tiers and not (ep is not None
+                              and int(ep) < st.tier_epoch):
+                # refuse a warm rebuild whose epoch is OLDER than what
+                # op results already proved — its digest list may still
+                # name retired entries; wait for a fresh view
                 warm: Dict[bytes, str] = {}
                 for tname in ("disk", "dram", "hbm"):   # fastest wins
                     for hexd in tiers.get(tname, ()):
@@ -654,8 +848,17 @@ class Router:
                         except ValueError:
                             pass
                 st.warm = warm
+            if ep is not None:
+                # the scrape and its warm rebuild are one atomic view:
+                # record the epoch it was taken at so only LATER bumps
+                # (seen on op results) invalidate it
+                st.tier_epoch = max(st.tier_epoch, int(ep))
             status = doc.get("status", "ok")
             if not doc.get("healthy", True):
+                status = "unhealthy"
+            if st.draining:
+                # administrative drain hold: never re-promote a
+                # replica the controller is scaling down
                 status = "unhealthy"
             self._set_state(
                 st, status if status in REPLICA_STATES else "ok")
@@ -672,6 +875,12 @@ class Router:
         if st.state == "dead":
             return
         st.state = "dead"
+        # rewarm seed: remember what was recently placed here (most
+        # recent last) BEFORE pruning, so a replacement replica can
+        # re-import those prefixes from warm survivors
+        if st.recent:
+            self._rewarm_stash[st.name] = list(st.recent.values())
+            st.recent.clear()
         # prune the dead member's directory entries immediately: a
         # fetch routed at a corpse would just bounce through the
         # requeue path, and `directory()` must never advertise one
@@ -685,6 +894,12 @@ class Router:
             st.outstanding.pop(xid)
             if kind == "import":
                 continue
+            if kind == "rewarm":
+                # a rewarm export lost with its source is just a cache
+                # miss for the replacement — never requeued work
+                self._m_rewarm.inc(result="miss")
+                continue
+            self._release(req)
             req.requeues += 1
             req.status = "queued"
             req.replica = None
@@ -768,6 +983,125 @@ class Router:
         except Exception:
             pass
 
+    # -- fleet lifecycle (the controller's command surface) ----------------
+    def add_replica(self, handle, *, prefill: bool = False):
+        """Register a NEW replica handle (scale-up, or a replacement
+        spawned under a fresh name). It admits immediately as ``ok``;
+        the next health poll corrects that if the replica disagrees."""
+        if any(st.name == handle.name for st in self._all):
+            raise ValueError(f"replica name {handle.name!r} already "
+                             f"registered")
+        st = _Replica(handle, self._replica_cap, self._hot_cap)
+        self._all.append(st)
+        (self._prefill if prefill else self._decode).append(st)
+        self._m_state.set(_STATE_RANK[st.state], replica=st.name)
+        return st
+
+    def replace_replica(self, name: str, handle):
+        """Swap a DEAD replica's handle for its replacement under the
+        SAME name (the healed process inherits the spill dir keyed on
+        it). Role and list position carry over; the warm set starts
+        empty and refills from the replacement's first health scrape
+        (its disk tier re-adopts the spill dir) plus the rewarm path."""
+        st = next((s for s in self._all if s.name == name), None)
+        if st is None:
+            raise KeyError(f"no replica named {name!r}")
+        if st.state != "dead":
+            raise ValueError(f"replica {name!r} is {st.state}, not "
+                             f"dead — drain and remove it instead")
+        if handle.name != name:
+            raise ValueError(f"replacement handle is named "
+                             f"{handle.name!r}, expected {name!r}")
+        try:
+            st.handle.close()
+        except Exception:
+            pass
+        st.handle = handle
+        st.last_health = {}
+        st.health_t = -1e9
+        st.tier_epoch = -1
+        st.draining = False
+        st.outstanding.clear()
+        st.state = "ok"
+        self._m_state.set(_STATE_RANK["ok"], replica=name)
+        return st
+
+    def begin_drain(self, name: str):
+        """Administrative drain (scale-down): stop admitting onto
+        ``name`` and HOLD it unhealthy against health-poll
+        re-promotion. In-flight work finishes normally; the caller
+        watches ``in_flight`` reach 0 and then removes the replica."""
+        st = next((s for s in self._all if s.name == name), None)
+        if st is None:
+            raise KeyError(f"no replica named {name!r}")
+        st.draining = True
+        self._set_state(st, "unhealthy")
+
+    def rewarm_replica(self, name: str, limit: int = 8) -> int:
+        """Re-warm a replacement replica: for each prefix recently
+        placed on the dead incarnation (the stash `_mark_dead` kept),
+        ship its KV from the warmest live survivor over the transfer
+        wire — a ``warm_only`` export relayed as an import, exactly
+        the cache-directory fetch path. Prefixes the replacement
+        already holds warm (its disk tier re-adopted the spill dir)
+        are skipped. Returns the number of rewarm exports issued."""
+        target = next((s for s in self._all if s.name == name), None)
+        if target is None:
+            raise KeyError(f"no replica named {name!r}")
+        stash = self._rewarm_stash.pop(name, [])
+        issued = 0
+        for prompt in reversed(stash):      # most recent first
+            if issued >= int(limit):
+                break
+            digests = _blocks.prompt_block_hashes(
+                np.asarray(prompt, np.int32), self.block_size)
+            if not digests:
+                continue
+            if target.prefix_score(digests) >= len(digests):
+                continue    # already warm (spill-dir re-adoption)
+            src, run, tier = None, 0, None
+            for st in self._all:
+                if st is target or st.state not in ("ok", "degraded"):
+                    continue
+                n, deepest = st.prefix_run(digests)
+                if n > run or (n == run and n > 0 and src is not None
+                               and st.in_flight < src.in_flight):
+                    src, run, tier = st, n, deepest
+            if src is None or run <= 0:
+                self._m_rewarm.inc(result="miss")
+                continue
+            rid = f"rw{next(self._rewarm_ids)}"
+            spec = {"id": rid, "op": "export_prefix", "warm_only": True,
+                    "prompt": [int(t) for t in prompt]}
+            src.handle.submit(spec)
+            # the ticket rides the ordinary outstanding plumbing (the
+            # handle is polled ONLY by _collect); _on_rewarm relays
+            # the payload to the target when the export lands
+            src.outstanding[rid] = (
+                _RewarmTicket(rid, name, list(digests)), "rewarm")
+            self._m_kv_fetches.inc(tier=tier or "dram")
+            issued += 1
+        return issued
+
+    def _on_rewarm(self, src, ticket, doc: dict):
+        """A rewarm export landed: relay the payload to the ticket's
+        target replica as an ordinary import (the prefix-cache publish
+        path), or count the miss if the source had nothing left."""
+        target = next((s for s in self._all
+                       if s.name == ticket.target), None)
+        payload = doc.get("payload") if "error" not in doc else None
+        if (target is None or target.state == "dead" or not payload):
+            self._m_rewarm.inc(result="miss")
+            return
+        blocks = int(doc.get("blocks") or 0)
+        imp = {"id": f"{ticket.rid}.imp", "op": "import_prefix",
+               "payload": payload}
+        target.handle.submit(imp)
+        target.outstanding[f"{ticket.rid}.imp"] = (ticket, "import")
+        target.mark_hot(ticket.digests[:blocks] if blocks
+                        else ticket.digests)
+        self._m_rewarm.inc(result="shipped")
+
     # -- placement ---------------------------------------------------------
     def _place(self):
         remaining: deque = deque()
@@ -779,6 +1113,11 @@ class Router:
         self._m_queue.set(len(self._queue))
 
     def _place_one(self, req: RouterRequest) -> bool:
+        if self._tenant_blocked(req):
+            # over its fleet budget: the request WAITS (placement
+            # skips it without blocking the tenants behind it) until
+            # enough of the tenant's placed work finishes
+            return False
         if req.payload is not None:
             return self._place_decode(req)
         if (req.usable and req.prefill_replica is None
@@ -967,10 +1306,18 @@ class Router:
         req.placed_t = time.perf_counter()
         req.placements += 1
         req.prefix_score = score
+        self._charge(req)
         self._m_placements.inc()
         if score > 0:
             self._m_place_hits.inc()
         st.mark_hot(usable)
+        if req.usable:
+            # rewarm seed: this prompt's leading chunk-aligned prefix
+            # is (about to be) warm here — what a replacement would
+            # want re-imported if this replica dies
+            st.note_recent(
+                tuple(usable),
+                req.prompt[:req.usable * self.block_size].copy())
         self._rev(req, "queue", "e", req.placed_t)
         self._rev(req, "place", "n", req.placed_t, kind="generate",
                   replica=st.name, prefix_score=score,
@@ -1036,6 +1383,8 @@ class Router:
             "requests": int(self._m_requests.value()),
             "completed": self._n_completed,
             "requeued": int(self._m_requeued.value()),
+            "shed": int(sum(c.value for c
+                            in self._m_shed.series().values())),
             "placement_hit_rate": round(self.placement_hit_rate(), 4),
             "alerts_firing": self.alerts.firing(),
             "window": {"ttft_p50_s": round(ttft[0.5], 6),
@@ -1056,6 +1405,15 @@ class Router:
             doc["slo"] = {"ttft_s": self.slo.ttft_s,
                           "target": self.slo.target,
                           "burn_rate": round(self._slo_burn_rate(), 4)}
+        if self._tenant_budgets:
+            doc["tenants"] = {
+                t: {"budget": b, "in_flight": self._tenant_used.get(t, 0)}
+                for t, b in sorted(self._tenant_budgets.items())}
+        if self._controller_summary is not None:
+            try:
+                doc["controller"] = self._controller_summary()
+            except Exception:
+                pass
         return doc
 
     def requests_doc(self, k: int = 10) -> dict:

@@ -92,6 +92,14 @@ class TieredStore:
         self._disk: "OrderedDict[bytes, int]" = OrderedDict()
         self._dram_used = 0
         self._disk_used = 0
+        # bumped every time a digest leaves the store ENTIRELY (budget
+        # eviction, quarantine, unreadable file) — the fleet cache
+        # directory's invalidation fence. A router that advertised this
+        # replica's digests compares the epoch stamped on health docs
+        # AND on every op result: a bump between health scrapes tells
+        # it the advertisement is stale NOW, not at the next cadence.
+        # Demotions (dram -> disk) do not bump: the digest still serves.
+        self.eviction_epoch = 0
         reg = registry if registry is not None else _metrics.Registry()
         self.metrics = reg
         self._m_bytes = reg.gauge(
@@ -163,6 +171,7 @@ class TieredStore:
             "disk": {"bytes": self._disk_used,
                      "capacity_bytes": self.disk_bytes,
                      "entries": len(self._disk)},
+            "eviction_epoch": self.eviction_epoch,
             "digests": self.digests(digest_limit)}
 
     # -- demotion ----------------------------------------------------------
@@ -193,6 +202,7 @@ class TieredStore:
                        direct: bool = False):
         if self.disk_dir is None or self.disk_bytes < len(payload):
             self._m_evictions.inc(tier="dram" if not direct else "disk")
+            self.eviction_epoch += 1
             return
         path = self._path(digest)
         tmp = os.path.join(self.disk_dir,
@@ -216,6 +226,7 @@ class TieredStore:
             except OSError:
                 pass
             self._m_evictions.inc(tier="disk")
+            self.eviction_epoch += 1
             return
         if digest in self._disk:       # republish refreshed the bytes
             self._disk_used -= self._disk.pop(digest)
@@ -230,6 +241,8 @@ class TieredStore:
             except OSError:
                 pass
             self._m_evictions.inc(tier="disk")
+            if old not in self._dram:
+                self.eviction_epoch += 1
 
     # -- promotion ---------------------------------------------------------
     def get(self, digest: bytes) -> Optional[Tuple[str, bytes]]:
@@ -283,6 +296,8 @@ class TieredStore:
         size = self._disk.pop(digest, None)
         if size is not None:
             self._disk_used -= size
+            if digest not in self._dram:
+                self.eviction_epoch += 1
             self._sync_gauges()
 
     def quarantine(self, digest: bytes):
@@ -294,6 +309,8 @@ class TieredStore:
         payload = self._dram.pop(digest, None)
         if payload is not None:
             self._dram_used -= len(payload)
+            if digest not in self._disk:
+                self.eviction_epoch += 1
         if digest in self._disk:
             self._drop_disk(digest)
             path = self._path(digest)

@@ -1,0 +1,574 @@
+"""Fleet control plane: self-healing replicas, door-side admission
+control, and SLO-driven autoscaling (serving/autoscale.py + the
+router/fleet lifecycle surfaces underneath it).
+
+Contracts pinned here:
+
+- a killed replica is respawned under its own name, re-registered
+  with the router, and re-warmed from warm survivors; its restart
+  budget follows the training supervisor's policy (backoff between
+  attempts, exhaustion retires the name);
+- the door sheds with counted reasons (queue_full | burn_rate |
+  tenant_budget) BEFORE replicas saturate — latency-tier traffic
+  keeps flowing while batch sheds;
+- a tenant bursting across N replicas is capped at its FLEET budget
+  (aggregate in-flight charge, not per-replica) while a second
+  tenant's latency-tier requests place without waiting behind it;
+- a tier eviction epoch bumped between health scrapes invalidates the
+  router's warm directory NOW, not at the next cadence;
+- ServingFleet name claims are atomic: two concurrent replacements of
+  one name cannot both launch (and so can never share a spill dir);
+- elastic capacity: sustained queue pressure spawns (hysteresis +
+  spawn budget against flapping), a sustained-idle fleet drains its
+  newest replica down to min_replicas via the graceful path.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving.autoscale import FleetController, InProcessFleet
+from paddle_tpu.serving.replica import EngineLoop, ListReply
+from paddle_tpu.serving.router import AdmissionError, Router
+from paddle_tpu.serving.tiers import TieredStore
+from test_fleet import FakeReplica, _fake_router, _mk_engine, lm  # noqa: F401
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeFleet:
+    """Named-lifecycle fleet over FakeReplica handles."""
+
+    def __init__(self, delay_steps=1):
+        self.handles = {}
+        self.spawn_log = []
+        self.stopped = []
+        self.fail_spawns = 0
+        self.delay_steps = delay_steps
+
+    def adopt(self, handles):
+        for h in handles:
+            self.handles[h.name] = h
+        return self
+
+    def allocate_name(self):
+        k = 0
+        while f"s{k}" in self.handles:
+            k += 1
+        return f"s{k}"
+
+    def spawn(self, name=None):
+        if name is None:
+            name = self.allocate_name()
+        if self.fail_spawns:
+            self.fail_spawns -= 1
+            raise RuntimeError("spawn failed (injected)")
+        cur = self.handles.get(name)
+        if cur is not None and cur.alive():
+            raise RuntimeError(f"{name} still running")
+        h = FakeReplica(name, delay_steps=self.delay_steps)
+        self.handles[name] = h
+        self.spawn_log.append(name)
+        return {"name": name}
+
+    def handle(self, name):
+        return self.handles[name]
+
+    def stop(self, name):
+        self.stopped.append(name)
+
+    def kill_name(self, name):
+        h = self.handles.get(name)
+        if h is not None:
+            h.kill()
+
+
+def _controller(router, fleet, clock, **kw):
+    kw.setdefault("backoff_base", 0.1)
+    kw.setdefault("backoff_cap", 0.2)
+    kw.setdefault("scale_up_queue", 0)       # scaling off unless asked
+    kw.setdefault("scale_up_burn", 0.0)
+    kw.setdefault("scale_down_idle_s", 1e9)
+    kw.setdefault("hysteresis_s", 0.0)
+    return FleetController(router, fleet, clock=clock, **kw)
+
+
+# -- self-healing -----------------------------------------------------------
+
+class TestHealing:
+    def test_killed_replica_healed_and_serving_again(self):
+        reps, router = _fake_router(2, caps=8)
+        fleet = FakeFleet().adopt(reps)
+        clock = Clock()
+        ctrl = _controller(router, fleet, clock, rewarm=False)
+        for i in range(4):
+            router.submit(np.arange(6, dtype=np.int32) + i, 2)
+        router.run_until_idle()
+        reps[0].kill()
+        router.step()                       # death detected
+        assert router.replica_states()["r0"] == "dead"
+        ctrl.step()                         # heal scheduled (backoff)
+        assert router.replica_states()["r0"] == "dead"
+        clock.advance(1.0)
+        ctrl.step()                         # heal fires
+        assert router.replica_states()["r0"] == "ok"
+        assert fleet.spawn_log == ["r0"]
+        assert fleet.handles["r0"] is not reps[0]   # a NEW incarnation
+        assert ctrl._m_heals.value(result="healed") == 1
+        # the healed fleet serves: both replicas take work again
+        reqs = [router.submit(np.arange(6, dtype=np.int32) + i, 2)
+                for i in range(6)]
+        router.run_until_idle()
+        assert all(r.status == "done" for r in reqs)
+
+    def test_heal_respects_backoff_delay(self):
+        reps, router = _fake_router(2, caps=8)
+        fleet = FakeFleet().adopt(reps)
+        clock = Clock()
+        ctrl = _controller(router, fleet, clock, rewarm=False,
+                           backoff_base=5.0, backoff_cap=10.0)
+        reps[0].kill()
+        router.step()
+        ctrl.step()
+        clock.advance(1.0)                  # < backoff_base
+        ctrl.step()
+        assert fleet.spawn_log == []        # still waiting
+        clock.advance(30.0)
+        ctrl.step()
+        assert fleet.spawn_log == ["r0"]
+
+    def test_exhausted_budget_retires_replica(self):
+        reps, router = _fake_router(2, caps=8)
+        fleet = FakeFleet().adopt(reps)
+        fleet.fail_spawns = 99              # every respawn dies
+        clock = Clock()
+        ctrl = _controller(router, fleet, clock, rewarm=False,
+                           max_restarts=2, stable_window=1e9)
+        reps[0].kill()
+        router.step()
+        for _ in range(20):
+            ctrl.step()
+            clock.advance(5.0)
+        assert "r0" not in router.replica_states()  # retired
+        assert ctrl._m_heals.value(result="failed") >= 1
+        assert ctrl._m_abandoned.value() == 1
+        assert ctrl.summary()["abandoned"] == ["r0"]
+        # the surviving replica still serves
+        r = router.submit(np.arange(5, dtype=np.int32), 2)
+        router.run_until_idle()
+        assert r.status == "done"
+
+    def test_rewarm_relays_prefix_from_warm_survivor(self):
+        reps, router = _fake_router(2, caps=16)
+        fleet = FakeFleet().adopt(reps)
+        clock = Clock()
+        ctrl = _controller(router, fleet, clock, rewarm=True)
+        shared = np.arange(17, dtype=np.int32)   # usable = 4 digests
+        reqs = [router.submit(np.concatenate(
+            [shared, np.full(2 + i, 30 + i, np.int32)]), 2)
+            for i in range(3)]
+        router.run_until_idle()
+        home = next(st for st in router._all
+                    if st.name == reqs[0].replica)
+        other = next(st for st in router._all if st is not home)
+        # the survivor holds the prefix warm and will serve the export
+        other.mark_hot(reqs[0].digests[:4])
+        other.handle.export_reply = {
+            "op": "export_prefix", "payload": "QUJD", "blocks": 4}
+        home.handle.kill()
+        router.step()
+        ctrl.step()                         # heal scheduled (backoff)
+        clock.advance(1.0)
+        ctrl.step()                         # heal + rewarm export
+        router.run_until_idle()             # export lands, import relays
+        assert router._m_rewarm.value(result="shipped") == 1
+        healed = fleet.handles[home.name]
+        assert any(s.get("op") == "import_prefix" for s in healed.seen)
+        # the relayed prefix is directory-visible on the replacement
+        assert any(e["replica"] == home.name
+                   for e in router.directory().values())
+
+    def test_wedged_replica_killed_then_work_recovers(self):
+        reps = [FakeReplica("r0", delay_steps=10**9), FakeReplica("r1")]
+        router = Router(reps, block_size=4, chunk_tokens=8,
+                        max_in_flight=4, health_poll_s=0.0)
+        fleet = FakeFleet().adopt(reps)
+        clock = Clock()
+        ctrl = _controller(router, fleet, clock, heal=False,
+                           wedge_timeout_s=5.0)
+        r = router.submit(np.arange(6, dtype=np.int32), 2)
+        router.step()
+        assert r.replica == "r0"            # ties go to the first
+        ctrl.step()                         # progress snapshot
+        clock.advance(6.0)
+        ctrl.step()                         # frozen past timeout: kill
+        assert ctrl._m_wedge.value() == 1
+        assert not reps[0].alive()
+        router.run_until_idle()             # requeued onto r1
+        assert r.status == "done" and r.replica == "r1"
+
+
+# -- admission control (the door) -------------------------------------------
+
+class TestAdmission:
+    def test_queue_full_sheds_batch_before_latency(self):
+        reps, router = _fake_router(1, caps=1, shed_queue_max=2)
+        reps[0].delay = 10**9               # nothing ever finishes
+        router.submit(np.arange(5, dtype=np.int32), 2)
+        router.step()                       # in flight; queue empty
+        router.submit(np.arange(5, dtype=np.int32), 2)
+        router.submit(np.arange(5, dtype=np.int32), 2)
+        with pytest.raises(AdmissionError) as ei:
+            router.submit(np.arange(5, dtype=np.int32), 2)
+        assert ei.value.reason == "queue_full"
+        # the latency tier rides 2x headroom through the same door
+        router.submit(np.arange(5, dtype=np.int32), 2, tier="latency")
+        router.submit(np.arange(5, dtype=np.int32), 2, tier="latency")
+        with pytest.raises(AdmissionError) as ei:
+            router.submit(np.arange(5, dtype=np.int32), 2,
+                          tier="latency")
+        assert ei.value.reason == "queue_full"
+        assert router._m_shed.value(reason="queue_full") == 2
+        assert router.health()["shed"] == 2
+
+    def test_burn_rate_sheds_batch_keeps_latency(self, monkeypatch):
+        reps, router = _fake_router(1, caps=4, shed_burn_max=1.0)
+        monkeypatch.setattr(router, "_slo_burn_rate", lambda: 3.0)
+        with pytest.raises(AdmissionError) as ei:
+            router.submit(np.arange(5, dtype=np.int32), 2)
+        assert ei.value.reason == "burn_rate"
+        # the SLO being burned IS latency-tier experience: keep it
+        r = router.submit(np.arange(5, dtype=np.int32), 2,
+                          tier="latency")
+        router.run_until_idle()
+        assert r.status == "done"
+        assert router._m_shed.value(reason="burn_rate") == 1
+
+    def test_impossible_tenant_charge_rejected_at_door(self):
+        reps, router = _fake_router(1, caps=4,
+                                    tenant_budgets={"a": 10})
+        with pytest.raises(AdmissionError) as ei:
+            router.submit(np.arange(8, dtype=np.int32), 8, tenant="a")
+        assert ei.value.reason == "tenant_budget"
+        assert router._m_shed.value(reason="tenant_budget") == 1
+        # within budget: admitted and completed
+        r = router.submit(np.arange(4, dtype=np.int32), 2, tenant="a")
+        router.run_until_idle()
+        assert r.status == "done"
+
+
+# -- fleet-wide tenant fairness ---------------------------------------------
+
+class TestFleetTenantFairness:
+    def test_burst_capped_at_fleet_budget_across_replicas(self):
+        reps, router = _fake_router(3, caps=8,
+                                    tenant_budgets={"burst": 40})
+        for r in reps:
+            r.delay = 3                     # keep work in flight
+        # 10 tokens reserved each: the fleet budget admits 4 at once
+        # even though 3 replicas x cap 8 could hold all 12
+        reqs = [router.submit(np.arange(8, dtype=np.int32) + i, 2,
+                              tenant="burst") for i in range(12)]
+        peak = 0
+        for _ in range(200):
+            if router.idle:
+                break
+            router.step()
+            peak = max(peak, router._tenant_used.get("burst", 0))
+            placed = sum(1 for r in reqs if r.status == "placed")
+            assert placed <= 4
+        assert peak == 40                   # capped AND utilized
+        assert all(r.status == "done" for r in reqs)   # queued, not shed
+
+    def test_latency_tenant_places_through_the_burst(self):
+        reps, router = _fake_router(3, caps=8,
+                                    tenant_budgets={"burst": 20})
+        for r in reps:
+            r.delay = 4
+        burst = [router.submit(np.arange(8, dtype=np.int32) + i, 2,
+                               tenant="burst") for i in range(10)]
+        fg = [router.submit(np.arange(5, dtype=np.int32) + i, 2,
+                            tenant="fg", tier="latency")
+              for i in range(4)]
+        router.step()                       # ONE placement round
+        # the over-budget burst queues; fg places immediately — no
+        # head-of-line blocking behind a capped tenant
+        assert all(r.status == "placed" for r in fg)
+        assert sum(1 for r in burst if r.status == "placed") == 2
+        router.run_until_idle()
+        assert all(r.status == "done" for r in burst + fg)
+        # fg TTFT stayed in band: placed on the first round means its
+        # queue wait is one step, same as an empty fleet's
+        assert all(r.placed_t - r.submit_t < 1.0 for r in fg)
+
+    def test_budget_editable_at_runtime(self):
+        reps, router = _fake_router(1, caps=8)
+        router.set_tenant_budget("t", 10)
+        with pytest.raises(AdmissionError):
+            router.submit(np.arange(8, dtype=np.int32), 8, tenant="t")
+        router.set_tenant_budget("t", None)
+        r = router.submit(np.arange(8, dtype=np.int32), 8, tenant="t")
+        router.run_until_idle()
+        assert r.status == "done"
+
+
+# -- tier-directory invalidation (sub-cadence eviction) ---------------------
+
+class TestDirectoryInvalidation:
+    def test_epoch_bumps_on_full_retirement_not_demotion(self, tmp_path):
+        ts = TieredStore(dram_bytes=64, disk_bytes=4096,
+                         disk_dir=str(tmp_path))
+        a, b = b"\x01" * 16, b"\x02" * 16
+        ts.put(a, b"x" * 48)
+        ts.put(b, b"y" * 48)                # evicts a -> disk: demotion
+        assert ts.get(a) is not None
+        assert ts.eviction_epoch == 0       # still serving: no bump
+        ts.quarantine(b)                    # gone entirely
+        assert ts.eviction_epoch >= 1
+        assert ts.health()["eviction_epoch"] == ts.eviction_epoch
+
+    def test_epoch_bumps_when_disk_budget_drops_payload(self):
+        ts = TieredStore(dram_bytes=64, disk_bytes=0)   # no disk tier
+        ts.put(b"\x01" * 16, b"x" * 48)
+        ts.put(b"\x02" * 16, b"y" * 48)     # evicts the first: GONE
+        assert ts.eviction_epoch == 1
+
+    def test_engine_result_docs_carry_epoch(self, lm):  # noqa: F811
+        eng = _mk_engine(lm)
+        eng.tiers = TieredStore(dram_bytes=1 << 16)
+        loop, reply = EngineLoop(eng), ListReply()
+        loop.feed({"id": 1, "prompt": [1, 2, 3], "max_new": 2}, reply)
+        while not reply.docs:
+            loop.step_once()
+        assert reply.docs[0]["tier_epoch"] == 0
+        eng.tiers.eviction_epoch = 5
+        loop.feed({"id": 2, "prompt": [1, 2, 3], "max_new": 2}, reply)
+        while len(reply.docs) < 2:
+            loop.step_once()
+        assert reply.docs[1]["tier_epoch"] == 5
+
+    def test_router_invalidates_directory_between_scrapes(self):
+        rep = FakeReplica("r0")
+        hexd = ("ab" * 16)
+        rep.health_doc = {
+            "status": "ok", "queue_depth": 0,
+            "tiers": {"eviction_epoch": 1,
+                      "digests": {"dram": [hexd]}}}
+        router = Router([rep], block_size=4, chunk_tokens=8,
+                        health_poll_s=1e9)  # ONE scrape, then silence
+        router.step()
+        assert hexd in router.directory()   # advertised
+        # an eviction between scrapes: the next op result carries the
+        # bumped epoch — even an untracked ack invalidates
+        rep.out.append({"id": "zz", "tier_epoch": 2})
+        router.step()
+        assert router.directory() == {}     # stale entry GONE now,
+        #                                     not at the next cadence
+        assert router._m_dir_invalidations.value() == 1
+        # same-epoch results never invalidate (the scrape's own view)
+        rep.health_doc["tiers"]["eviction_epoch"] = 2
+        rep.health_doc["tiers"]["digests"]["dram"] = [hexd]
+        st = router._all[0]
+        st.health_t = -1e9
+        router.step()                       # re-scrape re-advertises
+        assert hexd in router.directory()
+        rep.out.append({"id": "zz2", "tier_epoch": 2})
+        router.step()
+        assert hexd in router.directory()
+        assert router._m_dir_invalidations.value() == 1
+
+
+# -- atomic name claims (ServingFleet) --------------------------------------
+
+class _FakeProc:
+    def __init__(self, rc=None):
+        self.rc = rc
+
+    def poll(self):
+        return self.rc
+
+    def kill(self):
+        self.rc = -9
+
+
+class TestNameClaim:
+    def _fleet(self, monkeypatch, launch_delay=0.0):
+        from paddle_tpu.runtime.master import ServingFleet
+        fleet = ServingFleet("model.npz", replicas=1)
+
+        def _launch(name):
+            if launch_delay:
+                time.sleep(launch_delay)
+            return _FakeProc()
+
+        monkeypatch.setattr(fleet, "_launch", _launch)
+        monkeypatch.setattr(
+            fleet, "_await_ready",
+            lambda name, proc, deadline, close_fleet=False: {
+                "name": name, "port": 1, "health_port": None})
+        return fleet
+
+    def test_concurrent_replacements_cannot_share_a_name(
+            self, monkeypatch):
+        fleet = self._fleet(monkeypatch, launch_delay=0.05)
+        results = []
+
+        def worker():
+            try:
+                results.append(fleet.spawn("replica0"))
+            except RuntimeError as e:
+                results.append(e)
+
+        ts = [threading.Thread(target=worker) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        oks = [r for r in results if isinstance(r, dict)]
+        errs = [r for r in results if isinstance(r, RuntimeError)]
+        assert len(oks) == 1 and len(errs) == 1
+        assert len(fleet.endpoints) == 1    # one claim, one endpoint
+
+    def test_replacement_inherits_name_and_slot(self, monkeypatch):
+        fleet = self._fleet(monkeypatch)
+        fleet.spawn("replica0")
+        fleet._by_name["replica0"].rc = -9  # the incarnation died
+        fleet.spawn("replica0")             # replacement: same name
+        assert [e["name"] for e in fleet.endpoints] == ["replica0"]
+        assert len(fleet.procs) == 1        # replaced in place
+        # a LIVE replica's name cannot be stolen
+        with pytest.raises(RuntimeError):
+            fleet.spawn("replica0")
+
+    def test_allocate_name_skips_claimed(self, monkeypatch):
+        fleet = self._fleet(monkeypatch)
+        fleet.spawn("replica0")
+        assert fleet.allocate_name() == "replica1"
+
+
+# -- elastic capacity -------------------------------------------------------
+
+class TestScaling:
+    def test_scale_up_after_hysteresis_only(self):
+        reps, router = _fake_router(1, caps=1)
+        reps[0].delay = 10**9
+        fleet = FakeFleet().adopt(reps)
+        clock = Clock()
+        ctrl = _controller(router, fleet, clock, scale_up_queue=3,
+                           hysteresis_s=5.0, max_replicas=4)
+        reqs = [router.submit(np.arange(5, dtype=np.int32) + i, 2)
+                for i in range(6)]
+        router.step()
+        ctrl.step()                         # pressure noticed, armed
+        clock.advance(1.0)
+        ctrl.step()
+        assert len(router._all) == 1        # hysteresis holds
+        clock.advance(6.0)
+        ctrl.step()
+        assert len(router._all) == 2        # spawned + registered
+        assert ctrl._m_scale.value(direction="up") == 1
+        reps[0].delay = 1                   # unstick r0 and the
+        reps[0].work[0][1] = 1              # request it holds
+        router.run_until_idle()             # new capacity drains the
+        assert all(r.status == "done" for r in reqs)  # backlog
+
+    def test_spawn_budget_caps_flapping(self):
+        reps, router = _fake_router(1, caps=1)
+        reps[0].delay = 10**9
+        fleet = FakeFleet().adopt(reps)
+        clock = Clock()
+        ctrl = _controller(router, fleet, clock, scale_up_queue=2,
+                           hysteresis_s=0.0, max_replicas=8,
+                           spawn_budget=2, spawn_budget_window_s=300.0)
+        for i in range(40):
+            router.submit(np.arange(5, dtype=np.int32) + i, 2,
+                          tier="latency")
+        for _ in range(6):
+            router.step()
+            ctrl.step()
+            clock.advance(1.0)
+        assert len(router._all) == 3        # 1 seed + budget of 2
+        assert ctrl._m_scale_blocked.value(reason="budget") >= 1
+        assert ctrl.summary()["spawn_tokens"] == 0
+
+    def test_scale_down_drains_newest_to_min(self):
+        reps, router = _fake_router(3, caps=8)
+        fleet = FakeFleet().adopt(reps)
+        clock = Clock()
+        ctrl = _controller(router, fleet, clock, min_replicas=2,
+                           scale_down_idle_s=10.0)
+        r = router.submit(np.arange(5, dtype=np.int32), 2)
+        router.run_until_idle()
+        assert r.status == "done"
+        ctrl.step()                         # idle noticed, armed
+        clock.advance(11.0)
+        ctrl.step()                         # drain begins (newest: r2)
+        for _ in range(3):                  # idle drain completes fast
+            ctrl.step()
+        assert "r2" not in router.replica_states()
+        assert fleet.stopped == ["r2"]
+        assert ctrl._m_scale.value(direction="down") == 1
+        # min_replicas floors further scale-down
+        clock.advance(11.0)
+        for _ in range(3):
+            ctrl.step()
+        assert len(router._all) == 2
+
+    def test_drain_hold_survives_health_repromotion(self):
+        reps, router = _fake_router(2, caps=8)
+        reps[0].delay = 3                   # keep work in flight
+        r = router.submit(np.arange(5, dtype=np.int32), 2)
+        router.step()
+        assert r.replica == "r0"
+        router.begin_drain("r0")
+        assert router.replica_states()["r0"] == "unhealthy"
+        router.step()                       # health poll (status ok)
+        assert router.replica_states()["r0"] == "unhealthy"  # held
+        router.run_until_idle()             # in-flight work finishes
+        assert r.status == "done" and r.replica == "r0"
+
+    def test_controller_summary_in_router_health(self):
+        reps, router = _fake_router(2, caps=8)
+        fleet = FakeFleet().adopt(reps)
+        ctrl = _controller(router, fleet, Clock())
+        doc = router.health()
+        assert doc["controller"]["live"] == 2
+        assert doc["controller"]["min"] == 1
+        assert ctrl.health()["healthy"]
+
+
+# -- in-process fleet backend (the bench's substrate) -----------------------
+
+class TestInProcessFleet:
+    def test_spawn_heal_roundtrip(self, lm):  # noqa: F811
+        fleet = InProcessFleet(lambda name: _mk_engine(lm))
+        fleet.spawn("replica0")
+        fleet.spawn("replica1")
+        handles = [fleet.handle(f"replica{i}") for i in range(2)]
+        router = Router(handles, block_size=8, chunk_tokens=16,
+                        health_poll_s=0.0)
+        reqs = [router.submit(np.arange(9, dtype=np.int32) + i, 3)
+                for i in range(4)]
+        router.run_until_idle()
+        assert all(r.status == "done" for r in reqs)
+        with pytest.raises(RuntimeError):
+            fleet.spawn("replica0")         # alive: name protected
+        fleet.kill_name("replica0")
+        router.step()
+        fleet.spawn("replica0")
+        router.replace_replica("replica0", fleet.handle("replica0"))
+        reqs = [router.submit(np.arange(9, dtype=np.int32) + i, 3)
+                for i in range(4)]
+        router.run_until_idle()
+        assert all(r.status == "done" for r in reqs)

@@ -513,6 +513,22 @@ class TestEndpointsAndTop:
         empty = _render_top(health, {})
         assert "alerts: none firing" in empty or "ALERTS" in empty
 
+    def test_render_top_controller_line(self):
+        from paddle_tpu.cli import _render_top
+        health = {
+            "queue_depth": 0, "requests": 4, "completed": 4,
+            "requeued": 0, "shed": 3, "window": {},
+            "replicas": {},
+            "controller": {"live": 2, "min": 1, "max": 8,
+                           "heals": 1, "wedge_kills": 0,
+                           "scale_events": 2, "spawn_tokens": 4,
+                           "draining": ["r2"], "abandoned": []}}
+        frame = _render_top(health, {})
+        assert "shed 3" in frame
+        assert "controller: live 2 [1..8]" in frame
+        assert "heals 1" in frame and "spawn_tokens 4" in frame
+        assert "draining r2" in frame and "ABANDONED" not in frame
+
     def test_job_top_one_frame_over_http(self, lm, capsys):
         from paddle_tpu import cli
         reps = [EngineReplica(_mk_engine(lm), "r0")]

@@ -351,3 +351,28 @@ class TestServingBenchSmoke:
         assert fl["fleet"]["requeued"] == 0
         assert fl["fleet"]["tokens_per_sec"] > 0
         assert fl["pd_blocks_shipped"] >= 1
+
+    def test_bench_smoke_fleet_chaos_phase(self):
+        """Tier-1 exercise of the control-plane chaos path (--smoke
+        --fleet-chaos): the kill fires at the peak, the controller
+        heals the fleet back to full capacity, and every admitted
+        request completes. The TTFT-band / shed / rewarm CLAIMS are
+        the dedicated full-size run's (the fleet sentinel family)."""
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "serving_bench_chaos_under_test",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+                "benchmarks", "serving_bench.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        results = mod.main(["--smoke", "--fleet-chaos"])
+        fc = results["fleet_chaos"]
+        assert fc["controlled"]["killed_replica"] is not None
+        assert fc["healed_capacity_frac"] == 1.0
+        assert fc["recovery_s"] is not None and fc["recovery_s"] > 0
+        assert fc["all_admitted_completed"] is True
+        assert fc["controlled"]["completed"] == \
+            fc["controlled"]["requests"]
+        assert fc["static"]["completed"] == fc["static"]["requests"]
